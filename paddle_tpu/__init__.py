@@ -1,0 +1,65 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid (reference: /root/reference, a ~1.6-dev snapshot).
+
+Architecture (see SURVEY.md §7): a serializable Program/Block/Op IR is built
+from Python (reference: python/paddle/fluid/framework.py:3349 Program), then
+*functionalized* and lowered to a single JAX computation compiled by XLA —
+replacing the reference's op-by-op C++ interpreter (framework/executor.cc:437)
+and its hand-built multi-device SSA graph + NCCL op handles
+(framework/details/) with jit/GSPMD over a `jax.sharding.Mesh`.
+
+Public surface mirrors the reference's `paddle.fluid` namespace.
+"""
+
+from . import core
+from . import ops  # populate the op registry before any layer builds
+from .core import framework
+from .core.framework import (
+    Program,
+    Block,
+    Operator,
+    Variable,
+    Parameter,
+    program_guard,
+    default_main_program,
+    default_startup_program,
+    unique_name,
+    in_dygraph_mode,
+)
+from .core.executor import Executor, global_scope, scope_guard, Scope
+from .core.backward import append_backward, gradients
+from .core.compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from .core import places
+from .core.places import CPUPlace, TPUPlace, CUDAPlace, is_compiled_with_tpu
+from . import layers
+from . import initializer
+from . import regularizer
+from . import clip
+from . import optimizer
+from . import metrics
+from . import io
+from .io import save, load, save_inference_model, load_inference_model
+from . import data_feeder
+from .data_feeder import DataFeeder
+from . import reader
+from .reader import DataLoader, PyReader
+from . import dygraph
+from .dygraph.base import enable_dygraph, disable_dygraph
+from . import profiler
+from . import amp
+from . import param_attr
+from .param_attr import ParamAttr, WeightNormParamAttr
+from . import nets
+from . import backward as backward_module
+from . import dataset
+from .version import __version__
+
+# `paddle_tpu.fluid`-style alias so reference code reads naturally.
+import sys as _sys
+
+fluid = _sys.modules[__name__]
+
+
+def set_global_seed(seed: int):
+    """Seed program-level RNG (reference: fluid.Program.random_seed)."""
+    framework.set_global_seed(seed)

@@ -1,0 +1,17 @@
+"""Automatic mixed precision.
+
+Reference: python/paddle/fluid/contrib/mixed_precision/ (decorator.py:208
+`decorate` wraps the optimizer; fp16_lists.py white/black op lists; static +
+dynamic loss scaling).
+
+TPU-native: the preferred low-precision dtype is **bfloat16**, which needs NO
+loss scaling (same exponent range as fp32) — `decorate` with
+use_bf16=True (default) simply casts white-list op inputs to bf16 and keeps
+master weights in fp32. The fp16 path with dynamic loss scaling is kept for
+parity.
+"""
+
+from .decorator import decorate, OptimizerWithMixedPrecision
+from .fp16_lists import AutoMixedPrecisionLists
+
+__all__ = ["decorate", "OptimizerWithMixedPrecision", "AutoMixedPrecisionLists"]

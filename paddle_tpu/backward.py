@@ -1,0 +1,6 @@
+"""Module alias: `paddle_tpu.backward` mirrors the reference's
+python/paddle/fluid/backward.py public surface."""
+
+from .core.backward import append_backward, gradients  # noqa: F401
+
+__all__ = ["append_backward", "gradients"]

@@ -1,0 +1,14 @@
+"""Core runtime: IR descs, op registry, lowering, executor, autodiff.
+
+Maps to the reference's `paddle/fluid/framework/` layer (SURVEY.md §2.1), but
+the execution model is compile-once (JAX/XLA) instead of interpret-per-op.
+"""
+
+from . import ir
+from . import registry
+from . import framework
+from . import lowering
+from . import executor
+from . import backward
+from . import compiler
+from . import places

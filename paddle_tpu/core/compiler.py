@@ -1,0 +1,250 @@
+"""CompiledProgram / BuildStrategy / ExecutionStrategy.
+
+Reference: python/paddle/fluid/compiler.py:65 (CompiledProgram,
+`with_data_parallel` :138) backed by C++ ParallelExecutor
+(framework/parallel_executor.cc) — clone the graph per GPU, insert NCCL
+all-reduce op handles (details/all_reduce_op_handle.cc:48), schedule with a
+threaded SSA executor.
+
+TPU-native replacement: ONE jitted computation over a `jax.sharding.Mesh`.
+Feeds are sharded on the batch dim across the 'data' axis, parameters are
+replicated, and GSPMD inserts the gradient all-reduce that the reference
+builds by hand in multi_devices_graph_pass.cc:454. BuildStrategy knobs that
+steer the reference's pass pipeline (fusion, memory opt, inplace) are
+accepted for compatibility and recorded, but XLA already performs those
+optimizations on the lowered program.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import framework, lowering
+from .executor import RNG_STATE_VAR, Scope, _as_fetch_name, global_scope
+from .framework import Program
+from .ir import normalize_dtype
+
+
+class ReduceStrategy(enum.IntEnum):
+    """reference: details/build_strategy.h:58 — AllReduce replicates the
+    optimizer per device; Reduce shards it (closer to ZeRO). On TPU both are
+    sharding choices: Reduce maps to sharding optimizer state over 'data'."""
+
+    AllReduce = 0
+    Reduce = 1
+
+
+class GradientScaleStrategy(enum.IntEnum):
+    CoeffNumDevice = 0
+    One = 1
+    Customized = 2
+
+
+class BuildStrategy:
+    """reference: details/build_strategy.h:37."""
+
+    ReduceStrategy = ReduceStrategy
+    GradientScaleStrategy = GradientScaleStrategy
+
+    def __init__(self):
+        self.reduce_strategy = ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = GradientScaleStrategy.CoeffNumDevice
+        # Fusion/memory knobs: handled by XLA; recorded for API parity.
+        self.fuse_elewise_add_act_ops = False
+        self.fuse_bn_act_ops = False
+        self.fuse_all_optimizer_ops = False
+        self.fuse_all_reduce_ops = False
+        self.fuse_broadcast_ops = False
+        self.fuse_relu_depthwise_conv = False
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.cache_runtime_context = False
+        self.sync_batch_norm = False
+        self.enable_sequential_execution = False
+        self.remove_unnecessary_lock = True
+        # Multi-host data parallel (reference: num_trainers/trainer_id wired
+        # into NCCL rank math, parallel_executor.cc:469).
+        self.num_trainers = 1
+        self.trainer_id = 0
+        self.trainers_endpoints: List[str] = []
+        self.use_hierarchical_allreduce = False
+        self.hierarchical_allreduce_inter_nranks = 0
+        self.nccl_comm_num = 1  # multi-ring: ICI makes this moot; recorded.
+        self.debug_graphviz_path = ""
+
+
+class ExecutorType(enum.IntEnum):
+    Default = 0
+    Experimental = 1
+
+
+class ExecutionStrategy:
+    """reference: details/execution_strategy.h. Thread counts are meaningless
+    for a single compiled XLA program; kept for API parity."""
+
+    ExecutorType = ExecutorType
+
+    def __init__(self):
+        self.num_threads = 0
+        self.num_iteration_per_drop_scope = 1
+        self.num_iteration_per_run = 1
+        self.use_experimental_executor = False
+        self.use_thread_barrier = False
+
+
+class CompiledProgram:
+    """reference: compiler.py:65."""
+
+    def __init__(self, program_or_graph, build_strategy: Optional[BuildStrategy] = None):
+        if not isinstance(program_or_graph, Program):
+            raise TypeError("CompiledProgram expects a Program")
+        self._program = program_or_graph
+        self._build_strategy = build_strategy or BuildStrategy()
+        self._exec_strategy = ExecutionStrategy()
+        self._loss_name: Optional[str] = None
+        self._places: Optional[Sequence] = None
+        self._is_data_parallel = False
+        self._mesh: Optional[Mesh] = None
+        self._cache: Dict[Any, Any] = {}
+        self._share_vars_from = None
+
+    # -- reference API -------------------------------------------------------
+
+    def with_data_parallel(self, loss_name: Optional[str] = None,
+                           build_strategy: Optional[BuildStrategy] = None,
+                           exec_strategy: Optional[ExecutionStrategy] = None,
+                           share_vars_from: Optional["CompiledProgram"] = None,
+                           places: Optional[Sequence] = None) -> "CompiledProgram":
+        self._is_data_parallel = True
+        self._loss_name = loss_name
+        if build_strategy is not None:
+            self._build_strategy = build_strategy
+        if exec_strategy is not None:
+            self._exec_strategy = exec_strategy
+        self._places = places
+        self._share_vars_from = share_vars_from
+        return self
+
+    @property
+    def program(self) -> Program:
+        return self._program
+
+    @property
+    def build_strategy(self) -> BuildStrategy:
+        return self._build_strategy
+
+    # -- execution -----------------------------------------------------------
+
+    def _get_mesh(self) -> Mesh:
+        if self._mesh is None:
+            if self._places:
+                devices = [p.jax_device() for p in self._places]
+            else:
+                devices = jax.devices()
+            self._mesh = Mesh(np.array(devices), ("data",))
+        return self._mesh
+
+    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+        import jax.numpy as jnp
+
+        program = self._program
+        scope = scope if scope is not None else global_scope()
+        feed = dict(feed or {})
+        fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
+        mesh = self._get_mesh()
+
+        norm_feed = {}
+        for name, val in feed.items():
+            vdesc = None
+            for b in program.desc.blocks:
+                if name in b.vars:
+                    vdesc = b.vars[name]
+                    break
+            arr = jnp.asarray(val)
+            if vdesc is not None:
+                want = np.dtype(normalize_dtype(vdesc.dtype))
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            norm_feed[name] = arr
+
+        feed_sig = tuple(sorted((k, tuple(v.shape), str(v.dtype)) for k, v in norm_feed.items()))
+        key = (program._version, feed_sig, fetch_names)
+        step = self._cache.get(key)
+        if step is None:
+            step = _ShardedStep(program, tuple(norm_feed), fetch_names, mesh,
+                                self._build_strategy)
+            self._cache[key] = step
+
+        rng = executor._get_rng(scope, program)
+        fetches, new_rng = step(scope, norm_feed, rng)
+        scope.set_var(RNG_STATE_VAR, new_rng)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+
+class _ShardedStep:
+    """Data-parallel jitted step: the whole fed batch is sharded on dim 0
+    over the mesh 'data' axis (matching the reference's semantics where
+    ParallelExecutor splits the fed batch across devices)."""
+
+    def __init__(self, program: Program, feed_names, fetch_names, mesh: Mesh,
+                 strategy: BuildStrategy):
+        desc = program.desc
+        self.mesh = mesh
+        reads, writes = lowering.analyze_state_vars(desc, set(feed_names))
+        persistable = {v.name for b in desc.blocks for v in b.vars.values() if v.persistable}
+        for n in fetch_names:
+            if n in persistable and n not in reads and n not in writes:
+                reads.append(n)
+        self.const_reads = tuple(n for n in reads if n not in writes)
+        self.mut_reads = tuple(n for n in reads if n in writes)
+        self.writes = tuple(writes)
+        self.fetch_names = fetch_names
+        is_test = program._is_test
+
+        repl = NamedSharding(mesh, P())
+        batch = NamedSharding(mesh, P("data"))
+        self._feed_shardings = {n: batch for n in feed_names}
+        self._repl = repl
+
+        def step(feeds, const_states, mut_states, rng):
+            env = dict(const_states)
+            env.update(mut_states)
+            env.update(feeds)
+            step_key, new_rng = jax.random.split(rng)
+            lowering.lower_block(desc, 0, env, rng_key=step_key, is_test=is_test)
+            fetches = [env[n] for n in fetch_names]
+            new_states = {n: env[n] for n in self.writes if n in env}
+            return fetches, new_states, new_rng
+
+        self.fn = jax.jit(
+            step,
+            in_shardings=({n: batch for n in feed_names},
+                          {n: repl for n in self.const_reads},
+                          {n: repl for n in self.mut_reads},
+                          repl),
+            donate_argnums=(2,),
+        )
+
+    def __call__(self, scope: Scope, feed, rng):
+        def _state(n):
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"variable '{n}' missing from scope — run the startup "
+                    f"program first")
+            return v
+
+        const_states = {n: _state(n) for n in self.const_reads}
+        mut_states = {n: _state(n) for n in self.mut_reads}
+        feed = {n: jax.device_put(v, self._feed_shardings[n]) for n, v in feed.items()}
+        fetches, new_states, new_rng = self.fn(feed, const_states, mut_states, rng)
+        for n, v in new_states.items():
+            scope.set_var(n, v)
+        return fetches, new_rng

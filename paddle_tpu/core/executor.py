@@ -1,0 +1,262 @@
+"""Scope + Executor.
+
+Reference: `Scope` (paddle/fluid/framework/scope.h:46) is a hierarchical
+name→Variable map; `Executor::Run` (framework/executor.cc:178) interprets a
+block op-by-op against it. Here the executor *compiles* the whole program:
+scope reads become jit inputs, scope writes become jit outputs
+(core/lowering.py), and the compiled step is cached per
+(program, feed-signature, fetch-list) — the role of the reference's
+ExecutorPrepareContext cache (executor.py:831 program cache).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import framework, lowering
+from .framework import Program, Variable
+from .ir import normalize_dtype
+from .places import CPUPlace, Place, default_place
+
+RNG_STATE_VAR = "__rng_state__"
+
+
+class Scope:
+    """Hierarchical variable store (reference: framework/scope.h:46)."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self._vars: Dict[str, Any] = {}
+        self.parent = parent
+        self.kids: List[Scope] = []
+
+    def var(self, name: str):
+        if name not in self._vars:
+            self._vars[name] = None
+        return self._vars[name]
+
+    def find_var(self, name: str):
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s._vars:
+                return s._vars[name]
+            s = s.parent
+        return None
+
+    def has_var(self, name: str) -> bool:
+        return self.find_var(name) is not None
+
+    def set_var(self, name: str, value):
+        self._vars[name] = value
+
+    def erase(self, names: Sequence[str]):
+        for n in names:
+            self._vars.pop(n, None)
+
+    def new_scope(self) -> "Scope":
+        kid = Scope(self)
+        self.kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self.kids.clear()
+
+    def local_var_names(self) -> List[str]:
+        return list(self._vars)
+
+    # numpy convenience used everywhere in tests
+    def get(self, name: str) -> np.ndarray:
+        v = self.find_var(name)
+        if v is None:
+            raise KeyError(f"variable '{name}' not found in scope")
+        return np.asarray(v)
+
+
+_global_scope = Scope()
+_scope_stack: List[Scope] = []
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1] if _scope_stack else _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+
+def _as_fetch_name(f) -> str:
+    if isinstance(f, Variable):
+        return f.name
+    return str(f)
+
+
+class _CompiledStep:
+    """One jitted program specialization."""
+
+    def __init__(self, program: Program, feed_names: Tuple[str, ...],
+                 fetch_names: Tuple[str, ...], is_test: bool):
+        desc = program.desc
+        reads, writes = lowering.analyze_state_vars(desc, set(feed_names))
+        persistable = {
+            v.name
+            for b in desc.blocks
+            for v in b.vars.values()
+            if v.persistable
+        }
+        for n in fetch_names:
+            if n in persistable and n not in reads and n not in writes:
+                reads.append(n)
+        self.const_reads = tuple(n for n in reads if n not in writes)
+        self.mut_reads = tuple(n for n in reads if n in writes)
+        self.writes = tuple(writes)
+        self.fetch_names = fetch_names
+        self.feed_names = feed_names
+
+        def step(feeds, const_states, mut_states, rng):
+            env = dict(const_states)
+            env.update(mut_states)
+            env.update(feeds)
+            step_key, new_rng = jax.random.split(rng)
+            lowering.lower_block(desc, 0, env, rng_key=step_key, is_test=is_test)
+            fetches = []
+            for n in fetch_names:
+                if n not in env:
+                    raise lowering.LoweringError(
+                        f"fetch var '{n}' was not produced by the program")
+                fetches.append(env[n])
+            new_states = {n: env[n] for n in self.writes if n in env}
+            return fetches, new_states, new_rng
+
+        # mut_states (param updates) are donated: in-place on device, the
+        # reference's overwrite-in-scope semantics without a copy.
+        self.fn = jax.jit(step, donate_argnums=(2,))
+
+    def __call__(self, scope: Scope, feed: Dict[str, Any], rng):
+        const_states = {}
+        for n in self.const_reads:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"variable '{n}' is read by the program but missing from "
+                    f"the scope — run the startup program first")
+            const_states[n] = v
+        mut_states = {}
+        for n in self.mut_reads:
+            v = scope.find_var(n)
+            if v is None:
+                raise RuntimeError(
+                    f"variable '{n}' is updated in place but missing from the "
+                    f"scope — run the startup program first")
+            mut_states[n] = v
+        fetches, new_states, new_rng = self.fn(feed, const_states, mut_states, rng)
+        for n, v in new_states.items():
+            scope.set_var(n, v)
+        return fetches, new_rng
+
+
+class Executor:
+    """reference: python/paddle/fluid/executor.py:418."""
+
+    def __init__(self, place: Optional[Place] = None):
+        self.place = place or default_place()
+        self._cache: Dict[Any, _CompiledStep] = {}
+
+    def close(self):
+        self._cache.clear()
+
+    def run(
+        self,
+        program: Optional[Program] = None,
+        feed: Optional[Dict[str, Any]] = None,
+        fetch_list: Optional[Sequence] = None,
+        feed_var_name: str = "feed",
+        fetch_var_name: str = "fetch",
+        scope: Optional[Scope] = None,
+        return_numpy: bool = True,
+        use_program_cache: bool = True,
+    ):
+        # CompiledProgram carries its own sharded run path (core/compiler.py).
+        from .compiler import CompiledProgram
+
+        if isinstance(program, CompiledProgram):
+            return program._run(self, feed, fetch_list, scope, return_numpy)
+
+        program = program if program is not None else framework.default_main_program()
+        scope = scope if scope is not None else global_scope()
+        feed = dict(feed or {})
+        fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
+
+        # Normalize feeds to jnp arrays with declared dtype.
+        norm_feed = {}
+        for name, val in feed.items():
+            vdesc = None
+            for b in program.desc.blocks:
+                if name in b.vars:
+                    vdesc = b.vars[name]
+                    break
+            arr = jnp.asarray(val)
+            if vdesc is not None:
+                want = np.dtype(normalize_dtype(vdesc.dtype))
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            norm_feed[name] = arr
+
+        feed_sig = tuple(sorted((k, tuple(v.shape), str(v.dtype)) for k, v in norm_feed.items()))
+        key = (id(program), program._version, feed_sig, fetch_names, program._is_test)
+        step = self._cache.get(key) if use_program_cache else None
+        if step is None:
+            step = _CompiledStep(program, tuple(norm_feed), fetch_names, program._is_test)
+            if use_program_cache:
+                self._cache[key] = step
+
+        rng = self._get_rng(scope, program)
+        with jax.default_device(self.place.jax_device()):
+            fetches, new_rng = step(scope, norm_feed, rng)
+        scope.set_var(RNG_STATE_VAR, new_rng)
+
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    def _get_rng(self, scope: Scope, program: Program):
+        rng = scope.find_var(RNG_STATE_VAR)
+        if rng is None:
+            seed = program.random_seed or framework.global_seed()
+            rng = jax.random.key(seed)
+            scope.set_var(RNG_STATE_VAR, rng)
+        return rng
+
+    # ------------------------------------------------------------------
+    # Dataset entry points (reference: executor.py train_from_dataset) are
+    # provided by paddle_tpu.trainer; thin delegation keeps API parity.
+    # ------------------------------------------------------------------
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        from ..trainer import train_from_dataset
+
+        return train_from_dataset(self, program, dataset, scope, thread, debug,
+                                  fetch_list, fetch_info, print_period)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        from ..trainer import infer_from_dataset
+
+        return infer_from_dataset(self, program, dataset, scope, thread, debug,
+                                  fetch_list, fetch_info, print_period)

@@ -1,0 +1,167 @@
+"""Program → JAX lowering.
+
+The reference interprets a ProgramDesc op-by-op in C++
+(framework/executor.cc:437 `for (op : ops) op->Run(scope, place)`); here the
+whole block is *functionalized* into one pure JAX function — scope reads
+become function inputs, scope writes become function outputs — and compiled
+once by XLA. This single decision subsumes the reference's kernel-fusion
+passes (ir/fc_fuse_pass.cc etc.: XLA fuses), memory-optimize passes
+(buffer_shared_inplace_op_pass.cc: XLA buffer-assigns), and garbage collector
+(framework/garbage_collector.h: nothing to collect in a compiled program).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import registry
+from .ir import OpDesc, ProgramDesc, VarType
+from .registry import KernelCtx
+
+# Ops handled by the executor itself, not lowered as kernels.
+STRUCTURAL_OPS = {"feed", "fetch"}
+
+
+class LoweringError(RuntimeError):
+    pass
+
+
+def lower_block(
+    program_desc: ProgramDesc,
+    block_idx: int,
+    env: Dict[str, Any],
+    rng_key=None,
+    is_test: bool = False,
+) -> Dict[str, Any]:
+    """Execute (trace) every op in a block against `env` (name -> jnp value).
+
+    Mutates and returns env. Kernels for ops with sub-block attrs receive a
+    ctx whose lower_block recursively invokes this.
+    """
+    block = program_desc.block(block_idx)
+
+    def _lower_sub(sub_idx: int, sub_env: Dict[str, Any], ctx: KernelCtx):
+        return lower_block(program_desc, sub_idx, sub_env, rng_key=rng_key, is_test=is_test)
+
+    for op in block.ops:
+        if op.type in STRUCTURAL_OPS:
+            continue
+        run_op(op, env, program_desc, block_idx, _lower_sub, rng_key, is_test)
+    return env
+
+
+def run_op(
+    op: OpDesc,
+    env: Dict[str, Any],
+    program_desc: Optional[ProgramDesc],
+    block_idx: int,
+    lower_sub: Optional[Callable],
+    rng_key,
+    is_test: bool,
+):
+    opdef = registry.get_op_def(op.type)
+    ins: Dict[str, List] = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if not n:
+                vals.append(None)
+            elif n in env:
+                vals.append(env[n])
+            else:
+                raise LoweringError(
+                    f"op '{op.type}': input var '{n}' has no value (not fed, "
+                    f"not in scope, and not produced by an earlier op)"
+                )
+        ins[slot] = vals
+    ctx = KernelCtx(
+        op,
+        lower_block_fn=lower_sub,
+        rng_key=rng_key,
+        is_test=is_test or bool(op.attrs.get("is_test", False)),
+        program=program_desc,
+        block_idx=block_idx,
+        env=env,
+    )
+    outs = opdef.call(ins, op.attrs, ctx)
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot, [])
+        for i, n in enumerate(names):
+            if not n:
+                continue
+            if i < len(vals) and vals[i] is not None:
+                env[n] = vals[i]
+    return env
+
+
+def make_infer_lower_block_fn(program) -> Callable:
+    """Sub-block lowering callback used during eval_shape-based inference."""
+
+    def fn(sub_idx: int, sub_env: Dict[str, Any], ctx: KernelCtx):
+        return lower_block(program.desc, sub_idx, sub_env)
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Static analysis: which scope vars does a program read / write?
+# ---------------------------------------------------------------------------
+
+
+def analyze_state_vars(
+    program_desc: ProgramDesc,
+    feed_names: Set[str],
+) -> Tuple[List[str], List[str]]:
+    """Return (reads, writes): persistable/state vars the program reads from
+    the scope before writing, and those it writes back.
+
+    This is what turns scope mutation (reference: framework/scope.h) into
+    explicit functional state threading.
+    """
+    persistable: Set[str] = set()
+    for b in program_desc.blocks:
+        for name, v in b.vars.items():
+            if v.persistable:
+                persistable.add(name)
+
+    reads: List[str] = []
+    writes: List[str] = []
+    seen_read: Set[str] = set()
+    seen_write: Set[str] = set()
+    defined: Set[str] = set(feed_names)
+
+    def visit(block_idx: int):
+        block = program_desc.block(block_idx)
+        for op in block.ops:
+            if op.type in STRUCTURAL_OPS:
+                continue
+            for n in op.input_names():
+                if n in persistable and n not in seen_write and n not in seen_read:
+                    seen_read.add(n)
+                    reads.append(n)
+            for sub in op.sub_block_ids():
+                visit(sub)
+            for n in op.output_names():
+                defined.add(n)
+                if n in persistable and n not in seen_write:
+                    seen_write.add(n)
+                    writes.append(n)
+
+    visit(0)
+    return reads, writes
+
+
+def collect_feed_fetch(program_desc: ProgramDesc) -> Tuple[List[str], List[str]]:
+    """Names used by feed/fetch ops if the program carries them (reference
+    injects feed/fetch ops into block 0; we also accept executor-side
+    binding)."""
+    feeds, fetches = [], []
+    for op in program_desc.block(0).ops:
+        if op.type == "feed":
+            feeds.extend(op.output_names())
+        elif op.type == "fetch":
+            fetches.extend(op.input_names())
+    return feeds, fetches

@@ -1,0 +1,75 @@
+"""Device places (reference: paddle/fluid/platform/place.h:26-52).
+
+On TPU the device taxonomy is owned by JAX/PJRT; Place objects survive as
+thin user-facing handles so `Executor(fluid.TPUPlace(0))` reads like the
+reference's `Executor(fluid.CUDAPlace(0))`.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    device_id: int = 0
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == getattr(other, "device_id", 0)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+    def jax_device(self):
+        devs = jax.devices(self.backend) if self.backend else jax.devices()
+        return devs[self.device_id]
+
+    backend = None
+
+
+class CPUPlace(Place):
+    backend = "cpu"
+
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class TPUPlace(Place):
+    backend = None  # default backend (tpu when present)
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"TPUPlace({self.device_id})"
+
+
+# Reference-compat alias: scripts written against fluid.CUDAPlace(0) run on
+# the accelerator (TPU) unchanged.
+CUDAPlace = TPUPlace
+XPUPlace = TPUPlace
+
+
+class TPUPinnedPlace(Place):
+    backend = "cpu"
+
+    def __repr__(self):
+        return "TPUPinnedPlace"
+
+
+CUDAPinnedPlace = TPUPinnedPlace
+
+
+def is_compiled_with_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def is_compiled_with_cuda() -> bool:
+    # Reference-compat shim: "is there an accelerator".
+    return is_compiled_with_tpu()
+
+
+def default_place() -> Place:
+    return TPUPlace(0) if is_compiled_with_tpu() else CPUPlace()

@@ -1,0 +1,333 @@
+"""Operator registry.
+
+The reference registers each op as C++ metadata + per-device kernels + a
+hand-written GradOpMaker (reference: paddle/fluid/framework/op_registry.h:199,
+grad_op_desc_maker.h). On TPU every kernel is a JAX lowering, which buys two
+big simplifications:
+
+* **Generic gradients** — the grad op for `foo` is `foo_grad`, whose kernel is
+  `jax.vjp` of foo's forward kernel. No per-op grad code; XLA CSE dedups the
+  replayed forward. Ops can still override with a custom grad kernel.
+* **Generic shape/dtype inference** — `jax.eval_shape` over the kernel replaces
+  per-op InferShape (reference: framework/shape_inference.h). Dynamic (-1)
+  dims are inferred via a sentinel substitution.
+
+Kernel signature: ``kernel(ins, attrs, ctx) -> outs`` where ins/outs map slot
+name -> list of jnp arrays (a single array or None is normalized), and ctx is
+a KernelCtx giving RNG, sub-block lowering and requested-output info.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ir import OpDesc, VarDesc, normalize_dtype
+
+# Sentinel used to stand in for -1 dims during eval_shape-based inference.
+# A distinctive prime so it never collides with a real computed dim.
+_DYN_SENTINEL = 97
+
+GRAD_PREFIX_IN = "fwd_in::"
+GRAD_PREFIX_OUT = "fwd_out::"
+GRAD_PREFIX_OG = "out_grad::"
+GRAD_PREFIX_IG = "in_grad::"
+
+
+class KernelCtx:
+    """Execution context handed to kernels (reference: ExecutionContext,
+    framework/operator.h:231)."""
+
+    def __init__(
+        self,
+        op: OpDesc,
+        lower_block_fn: Optional[Callable] = None,
+        rng_key=None,
+        is_test: bool = False,
+        program=None,
+        block_idx: int = 0,
+        env: Optional[dict] = None,
+    ):
+        self.op = op
+        self._lower_block_fn = lower_block_fn
+        self._rng_key = rng_key
+        self.is_test = is_test
+        self.program = program
+        self.block_idx = block_idx
+        self.env = env  # live name->value environment (control-flow ops)
+
+    def rng(self) -> jax.Array:
+        """Deterministic per-op PRNG key: fold the per-step key with the op's
+        build-time-assigned uid (replayed identically by the vjp grad)."""
+        if self._rng_key is None:
+            # eval_shape / no-rng-state path: fixed key keeps tracing total.
+            base = jax.random.key(0)
+        else:
+            base = self._rng_key
+        uid = int(self.op.attrs.get("__rng_uid__", 0))
+        return jax.random.fold_in(base, uid)
+
+    def lower_block(self, block_idx: int, env: Dict[str, Any]) -> Dict[str, Any]:
+        """Lower a sub-block (control flow) into the current trace."""
+        assert self._lower_block_fn is not None, "no sub-block lowering available"
+        return self._lower_block_fn(block_idx, env, self)
+
+    def requested_outputs(self) -> Set[str]:
+        return {k for k, v in self.op.outputs.items() if any(v)}
+
+    def child(self, op: OpDesc) -> "KernelCtx":
+        return KernelCtx(
+            op,
+            lower_block_fn=self._lower_block_fn,
+            rng_key=self._rng_key,
+            is_test=self.is_test,
+            program=self.program,
+            block_idx=self.block_idx,
+            env=self.env,
+        )
+
+
+class OpDef:
+    def __init__(
+        self,
+        type: str,
+        kernel: Callable,
+        grad: Optional[str | Callable] = "generic",
+        nondiff_inputs: Sequence[str] = (),
+        infer_shape: Optional[Callable] = None,
+        is_random: bool = False,
+        default_attrs: Optional[Dict[str, Any]] = None,
+        intermediate_outputs: Sequence[str] = (),
+    ):
+        self.type = type
+        self.kernel = kernel
+        self.grad = grad  # 'generic' | None | callable custom grad kernel
+        self.nondiff_inputs = set(nondiff_inputs)
+        self.custom_infer_shape = infer_shape
+        self.is_random = is_random
+        self.default_attrs = dict(default_attrs or {})
+        self.intermediate_outputs = set(intermediate_outputs)
+
+    # -- invocation helpers --------------------------------------------------
+
+    def call(self, ins: Dict[str, List], attrs: Dict[str, Any], ctx: KernelCtx):
+        merged = {**self.default_attrs, **attrs}
+        outs = self.kernel(ins, merged, ctx)
+        return normalize_outs(outs)
+
+    def has_grad(self) -> bool:
+        return self.grad is not None
+
+
+def normalize_outs(outs) -> Dict[str, List]:
+    if outs is None:
+        return {}
+    norm = {}
+    for k, v in outs.items():
+        if v is None:
+            norm[k] = []
+        elif isinstance(v, (list, tuple)):
+            norm[k] = list(v)
+        else:
+            norm[k] = [v]
+    return norm
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(
+    type: str,
+    grad: Optional[str | Callable] = "generic",
+    nondiff_inputs: Sequence[str] = (),
+    infer_shape: Optional[Callable] = None,
+    is_random: bool = False,
+    default_attrs: Optional[Dict[str, Any]] = None,
+    intermediate_outputs: Sequence[str] = (),
+):
+    """Decorator registering a kernel (reference: REGISTER_OPERATOR,
+    op_registry.h:199)."""
+
+    def deco(fn):
+        _REGISTRY[type] = OpDef(
+            type,
+            fn,
+            grad=grad,
+            nondiff_inputs=nondiff_inputs,
+            infer_shape=infer_shape,
+            is_random=is_random,
+            default_attrs=default_attrs,
+            intermediate_outputs=intermediate_outputs,
+        )
+        return fn
+
+    return deco
+
+
+def get_op_def(type: str) -> OpDef:
+    if type in _REGISTRY:
+        return _REGISTRY[type]
+    if type.endswith("_grad"):
+        fwd = _REGISTRY.get(type[: -len("_grad")])
+        if fwd is not None and fwd.grad == "generic":
+            gd = OpDef(type, make_generic_grad_kernel(fwd), grad=None)
+            _REGISTRY[type] = gd
+            return gd
+        if fwd is not None and callable(fwd.grad):
+            gd = OpDef(type, fwd.grad, grad=None)
+            _REGISTRY[type] = gd
+            return gd
+    raise KeyError(f"operator '{type}' is not registered")
+
+
+def has_op(type: str) -> bool:
+    try:
+        get_op_def(type)
+        return True
+    except KeyError:
+        return False
+
+
+def registered_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Generic vjp-based gradient
+# ---------------------------------------------------------------------------
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype if not hasattr(x, "dtype") else x.dtype, jnp.floating)
+
+
+def make_generic_grad_kernel(fwd: OpDef) -> Callable:
+    """Build the kernel for `<type>_grad` from the forward kernel via jax.vjp.
+
+    Grad-op slot convention (replaces the reference's GradOpDescMaker naming
+    X / Out / Out@GRAD / X@GRAD, grad_op_desc_maker.h):
+      inputs : fwd_in::<slot>, fwd_out::<slot>, out_grad::<slot>
+      outputs: in_grad::<slot>
+    """
+
+    def grad_kernel(ins, attrs, ctx: KernelCtx):
+        fwd_ins: Dict[str, List] = {}
+        out_grads: Dict[str, List] = {}
+        for k, v in ins.items():
+            if k.startswith(GRAD_PREFIX_IN):
+                fwd_ins[k[len(GRAD_PREFIX_IN):]] = v
+            elif k.startswith(GRAD_PREFIX_OG):
+                out_grads[k[len(GRAD_PREFIX_OG):]] = v
+            # fwd_out:: values not needed — forward is replayed (XLA CSE dedups)
+
+        requested = {
+            k[len(GRAD_PREFIX_IG):]
+            for k in ctx.requested_outputs()
+            if k.startswith(GRAD_PREFIX_IG)
+        }
+
+        # Split differentiable vs. static inputs.
+        diff_ins: Dict[str, List] = {}
+        rest_ins: Dict[str, List] = {}
+        for slot, vals in fwd_ins.items():
+            if slot in fwd.nondiff_inputs or slot not in requested:
+                rest_ins[slot] = vals
+            else:
+                d, r = [], []
+                for x in vals:
+                    (d if x is not None and _is_float(x) else r).append(x)
+                if d and not r:
+                    diff_ins[slot] = vals
+                else:
+                    rest_ins[slot] = vals
+
+        def f(dins):
+            all_ins = {**rest_ins, **dins}
+            outs = fwd.call(all_ins, attrs, ctx)
+            # Only float outputs participate in the cotangent structure.
+            return {
+                k: [o for o in v if o is not None and _is_float(o)]
+                for k, v in outs.items()
+                if k not in fwd.intermediate_outputs or k in out_grads
+            }
+
+        primal_out, vjp_fn = jax.vjp(f, diff_ins)
+
+        cots = {}
+        for slot, vals in primal_out.items():
+            given = out_grads.get(slot)
+            cots[slot] = [
+                (given[i] if given is not None and i < len(given) and given[i] is not None
+                 else jnp.zeros(v.shape, v.dtype))
+                for i, v in enumerate(vals)
+            ]
+        (gins,) = vjp_fn(cots)
+
+        outs = {}
+        for slot, gvals in gins.items():
+            outs[GRAD_PREFIX_IG + slot] = gvals
+        # Requested grads for non-differentiable inputs come back as zeros.
+        for slot in requested:
+            if slot not in gins and slot in fwd_ins:
+                outs[GRAD_PREFIX_IG + slot] = [
+                    jnp.zeros(jnp.shape(x), jnp.result_type(x)) if x is not None else None
+                    for x in fwd_ins[slot]
+                ]
+        return outs
+
+    return grad_kernel
+
+
+# ---------------------------------------------------------------------------
+# Generic shape/dtype inference via eval_shape
+# ---------------------------------------------------------------------------
+
+
+def infer_op_outputs(
+    op: OpDesc,
+    input_descs: Dict[str, VarDesc],
+    lower_block_fn: Optional[Callable] = None,
+    program=None,
+) -> Dict[str, "jax.ShapeDtypeStruct"]:
+    """Infer output shapes/dtypes for `op` given input VarDescs.
+
+    Returns {var_name: ShapeDtypeStruct}; -1 dims round-trip via a sentinel.
+    """
+    opdef = get_op_def(op.type)
+    if opdef.custom_infer_shape is not None:
+        return opdef.custom_infer_shape(op, input_descs)
+
+    ins = {}
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if not n:
+                vals.append(None)
+                continue
+            d = input_descs[n]
+            shape = tuple(_DYN_SENTINEL if s == -1 else s for s in (d.shape or ()))
+            vals.append(jax.ShapeDtypeStruct(shape, np.dtype(normalize_dtype(d.dtype))))
+        ins[slot] = vals
+
+    ctx = KernelCtx(op, lower_block_fn=lower_block_fn, program=program)
+
+    def f(ins):
+        return opdef.call(ins, op.attrs, ctx)
+
+    outs = jax.eval_shape(f, ins)
+
+    result: Dict[str, jax.ShapeDtypeStruct] = {}
+    for slot, names in op.outputs.items():
+        vals = outs.get(slot, [])
+        for i, n in enumerate(names):
+            if not n:
+                continue
+            if i < len(vals) and vals[i] is not None:
+                v = vals[i]
+                shape = tuple(-1 if s == _DYN_SENTINEL else s for s in v.shape)
+                result[n] = jax.ShapeDtypeStruct(shape, v.dtype)
+    return result

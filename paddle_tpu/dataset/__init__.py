@@ -1,0 +1,13 @@
+"""Datasets (reference: python/paddle/dataset/ — mnist, cifar, uci_housing,
+imdb, ... download+parse+reader creators).
+
+This environment has zero egress, so each dataset ships a deterministic
+synthetic generator with the real schema/shapes (enough for the book-test
+training loops); pass a local path to use real data when available.
+"""
+
+from . import mnist
+from . import cifar
+from . import uci_housing
+from . import imdb
+from . import imikolov

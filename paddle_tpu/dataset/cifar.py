@@ -1,0 +1,49 @@
+"""CIFAR reader creators (reference: python/paddle/dataset/cifar.py).
+Synthetic fallback: colored gradient patches per class."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _synthetic(n, num_classes, seed):
+    rng = np.random.RandomState(seed)
+    ys = rng.randint(0, num_classes, size=n)
+    yy, xx = np.mgrid[0:32, 0:32].astype(np.float32) / 32.0
+    xs = np.zeros((n, 3, 32, 32), dtype=np.float32)
+    for i in range(n):
+        k = ys[i]
+        base = np.stack([
+            np.sin(xx * (k % 5 + 1) * 2),
+            np.cos(yy * (k % 7 + 1) * 2),
+            np.sin((xx + yy) * (k % 3 + 1) * 3),
+        ])
+        xs[i] = np.clip(base + rng.normal(0, 0.2, (3, 32, 32)), -1, 1)
+    return xs.reshape(n, -1), ys.astype(np.int64)
+
+
+def _creator(n, num_classes, seed):
+    def reader():
+        xs, ys = _synthetic(n, num_classes, seed)
+        for x, y in zip(xs, ys):
+            yield x, int(y)
+
+    return reader
+
+
+def train10(data_dir=None):
+    return _creator(2048, 10, 0)
+
+
+def test10(data_dir=None):
+    return _creator(512, 10, 1)
+
+
+def train100(data_dir=None):
+    return _creator(2048, 100, 2)
+
+
+def test100(data_dir=None):
+    return _creator(512, 100, 3)

@@ -1,0 +1,36 @@
+"""IMDB sentiment (reference: python/paddle/dataset/imdb.py) — synthetic
+fallback: token sequences whose class-conditional token distribution differs."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "word_dict"]
+
+_VOCAB = 5147
+
+
+def word_dict():
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _creator(n, seed, maxlen=100):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = rng.randint(0, 2)
+            length = rng.randint(10, maxlen)
+            center = _VOCAB // 4 if label == 0 else 3 * _VOCAB // 4
+            toks = np.clip(rng.normal(center, _VOCAB // 8, length).astype(np.int64),
+                           0, _VOCAB - 1)
+            yield toks.tolist(), label
+
+    return reader
+
+
+def train(word_idx=None):
+    return _creator(2000, seed=0)
+
+
+def test(word_idx=None):
+    return _creator(500, seed=1)

@@ -1,0 +1,38 @@
+"""PTB-style language-model dataset (reference:
+python/paddle/dataset/imikolov.py — n-gram reader for word2vec book test)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "build_dict"]
+
+_VOCAB = 2073
+
+
+def build_dict(min_word_freq=50):
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _creator(n_sent, seed, gram_n=5):
+    def reader():
+        rng = np.random.RandomState(seed)
+        # Markov chain: next word ~ (2*current + noise) mod V — learnable
+        for _ in range(n_sent):
+            length = rng.randint(gram_n + 1, 30)
+            sent = [int(rng.randint(0, _VOCAB))]
+            for _ in range(length - 1):
+                nxt = (2 * sent[-1] + rng.randint(0, 5)) % _VOCAB
+                sent.append(int(nxt))
+            for i in range(len(sent) - gram_n + 1):
+                yield tuple(sent[i:i + gram_n])
+
+    return reader
+
+
+def train(word_idx=None, n=5):
+    return _creator(500, seed=0, gram_n=n)
+
+
+def test(word_idx=None, n=5):
+    return _creator(100, seed=1, gram_n=n)

@@ -1,0 +1,68 @@
+"""MNIST reader creators (reference: python/paddle/dataset/mnist.py).
+
+With no network access, generates a deterministic synthetic digit set: class
+k = a blurred template of stripes at angle k*18° + noise — linearly separable
+enough for LeNet to reach high accuracy, exercising the same training path.
+If `data_dir` contains the real idx files, they are used instead.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+_SYN_TRAIN = 2048
+_SYN_TEST = 512
+
+
+def _synthetic(n, seed):
+    rng = np.random.RandomState(seed)
+    xs = np.zeros((n, 784), dtype=np.float32)
+    ys = rng.randint(0, 10, size=n)
+    yy, xx = np.mgrid[0:28, 0:28]
+    for i in range(n):
+        k = ys[i]
+        angle = k * np.pi / 10.0
+        stripe = np.sin((xx * np.cos(angle) + yy * np.sin(angle)) * 0.7 + k)
+        img = (stripe > 0.3).astype(np.float32)
+        img += rng.normal(0, 0.15, (28, 28))
+        xs[i] = np.clip(img, 0, 1).reshape(-1) * 2.0 - 1.0
+    return xs, ys.astype(np.int64)
+
+
+def _load_idx(data_dir, image_file, label_file):
+    with gzip.open(os.path.join(data_dir, image_file), "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        images = np.frombuffer(f.read(), dtype=np.uint8).reshape(n, rows * cols)
+        images = images.astype(np.float32) / 127.5 - 1.0
+    with gzip.open(os.path.join(data_dir, label_file), "rb") as f:
+        struct.unpack(">II", f.read(8))
+        labels = np.frombuffer(f.read(), dtype=np.uint8).astype(np.int64)
+    return images, labels
+
+
+def _reader_creator(images, labels):
+    def reader():
+        for img, lbl in zip(images, labels):
+            yield img, int(lbl)
+
+    return reader
+
+
+def train(data_dir=None):
+    if data_dir and os.path.exists(os.path.join(data_dir, "train-images-idx3-ubyte.gz")):
+        return _reader_creator(*_load_idx(data_dir, "train-images-idx3-ubyte.gz",
+                                          "train-labels-idx1-ubyte.gz"))
+    return _reader_creator(*_synthetic(_SYN_TRAIN, seed=0))
+
+
+def test(data_dir=None):
+    if data_dir and os.path.exists(os.path.join(data_dir, "t10k-images-idx3-ubyte.gz")):
+        return _reader_creator(*_load_idx(data_dir, "t10k-images-idx3-ubyte.gz",
+                                          "t10k-labels-idx1-ubyte.gz"))
+    return _reader_creator(*_synthetic(_SYN_TEST, seed=1))

@@ -1,0 +1,39 @@
+"""UCI housing (reference: python/paddle/dataset/uci_housing.py) — linear
+regression dataset; synthetic fallback is an actual noisy linear system."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+_W = None
+
+
+def _data(n, seed):
+    global _W
+    rng = np.random.RandomState(7)
+    if _W is None:
+        _W = rng.normal(0, 1, size=(13,)).astype(np.float32)
+    rng2 = np.random.RandomState(seed)
+    x = rng2.normal(0, 1, size=(n, 13)).astype(np.float32)
+    y = x @ _W + 3.0 + rng2.normal(0, 0.1, size=n).astype(np.float32)
+    return x, y.astype(np.float32)
+
+
+def train():
+    def reader():
+        xs, ys = _data(404, seed=0)
+        for x, y in zip(xs, ys):
+            yield x, np.array([y], dtype=np.float32)
+
+    return reader
+
+
+def test():
+    def reader():
+        xs, ys = _data(102, seed=1)
+        for x, y in zip(xs, ys):
+            yield x, np.array([y], dtype=np.float32)
+
+    return reader

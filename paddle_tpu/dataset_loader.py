@@ -1,0 +1,13 @@
+"""DatasetLoader (reference: reader.py:990 DatasetLoader — iterate a
+Dataset's batches through the loader interface)."""
+
+from __future__ import annotations
+
+
+class DatasetLoader:
+    def __init__(self, dataset, places=None, drop_last=True):
+        self._dataset = dataset
+        self._drop_last = drop_last
+
+    def __iter__(self):
+        yield from self._dataset._iter_batches()

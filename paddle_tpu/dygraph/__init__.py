@@ -1,0 +1,23 @@
+"""Dygraph (eager) mode.
+
+Reference: paddle/fluid/imperative/ (C++ tracer, SURVEY §2.6) +
+python/paddle/fluid/dygraph/. Eager mode on TPU is just JAX: ops execute
+immediately on device arrays; the tracer records a tape of (op, inputs,
+outputs) and `backward()` replays it with the same generic vjp kernels used
+by the static path — one op registry serves both modes (SURVEY §7 step 9).
+"""
+
+from . import base
+from .base import guard, enable_dygraph, disable_dygraph, to_variable, enabled, grad
+from .tracer import Tracer
+from .varbase import VarBase
+from .layers import Layer
+from . import nn
+from .nn import (Conv2D, Linear, FC, BatchNorm, Embedding, LayerNorm, GRUUnit,
+                 Pool2D, Dropout)
+from .parallel import DataParallel, ParallelEnv, prepare_context
+from .checkpoint import save_dygraph, load_dygraph
+from .learning_rate_scheduler import (NoamDecay, PiecewiseDecay,
+                                      NaturalExpDecay, ExponentialDecay,
+                                      InverseTimeDecay, PolynomialDecay,
+                                      CosineDecay)

@@ -1,0 +1,69 @@
+"""Dygraph mode switches (reference: python/paddle/fluid/dygraph/base.py —
+guard :89, to_variable :151)."""
+
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+
+from ..core import framework
+from .tracer import Tracer, get_tracer
+from .varbase import VarBase
+
+_enabled = False
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable_dygraph(place=None):
+    global _enabled
+    _enabled = True
+    framework._set_dygraph_tracer(get_tracer())
+
+
+def disable_dygraph():
+    global _enabled
+    _enabled = False
+    framework._set_dygraph_tracer(None)
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    enable_dygraph(place)
+    try:
+        yield
+    finally:
+        disable_dygraph()
+
+
+def to_variable(value, name=None, zero_copy=None):
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(np.asarray(value), name=name)
+
+
+@contextlib.contextmanager
+def no_grad():
+    t = get_tracer()
+    old = t._no_grad
+    t._no_grad = True
+    try:
+        yield
+    finally:
+        t._no_grad = old
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """reference: dygraph grad API — here via tape backward then collect."""
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    for o in outputs:
+        o.backward(retain_graph=True)
+    res = [i.grad for i in inputs]
+    if not retain_graph:
+        get_tracer().reset()
+    return res

@@ -1,0 +1,206 @@
+"""Dygraph NN modules (reference: python/paddle/fluid/dygraph/nn.py —
+Conv2D, Pool2D, FC/Linear, BatchNorm, Embedding, LayerNorm, GRUUnit, ...)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..initializer import ConstantInitializer, NormalInitializer
+from .layers import Layer
+from .tracer import get_tracer
+from .varbase import VarBase
+
+
+def _op(op_type, ins, outs, attrs=None):
+    return get_tracer().trace_op(op_type, ins, outs, attrs or {})
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, use_cudnn=True, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
+        self._attrs = {"strides": [stride] * 2 if isinstance(stride, int) else list(stride),
+                       "paddings": [padding] * 2 if isinstance(padding, int) else list(padding),
+                       "dilations": [dilation] * 2 if isinstance(dilation, int) else list(dilation),
+                       "groups": groups}
+        fan_in = (num_channels // groups) * fs[0] * fs[1]
+        std = (2.0 / fan_in) ** 0.5
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups] + list(fs), attr=param_attr,
+            default_initializer=NormalInitializer(0.0, std))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_filters], attr=bias_attr, is_bias=True)
+        self._act = act
+
+    def forward(self, x):
+        out = _op("conv2d", {"Input": [x], "Filter": [self.weight]},
+                  {"Output": [None]}, self._attrs)["Output"][0]
+        if self.bias is not None:
+            out = _op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                      {"Out": [None]}, {"axis": 1})["Out"][0]
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": [None]})["Out"][0]
+        return out
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter([input_dim, output_dim], attr=param_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [output_dim], attr=bias_attr, is_bias=True)
+        self._act = act
+
+    def forward(self, x):
+        out = _op("mul", {"X": [x], "Y": [self.weight]}, {"Out": [None]},
+                  {"x_num_col_dims": len(x.shape) - 1})["Out"][0]
+        if self.bias is not None:
+            out = _op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                      {"Out": [None]}, {"axis": len(out.shape) - 1})["Out"][0]
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": [None]})["Out"][0]
+        return out
+
+
+class FC(Linear):
+    """reference: dygraph/nn.py FC (pre-Linear API)."""
+
+    def __init__(self, name_scope, size, num_flatten_dims=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32", input_dim=None):
+        if input_dim is None:
+            raise ValueError("FC requires input_dim on TPU (static shapes)")
+        super().__init__(input_dim, size, param_attr, bias_attr, act, dtype)
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 data_layout="NCHW", dtype="float32", use_global_stats=False):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [num_channels], attr=param_attr,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([num_channels], attr=bias_attr, is_bias=True)
+        self._mean = VarBase(np.zeros(num_channels, dtype), persistable=True,
+                             stop_gradient=True)
+        self._variance = VarBase(np.ones(num_channels, dtype), persistable=True,
+                                 stop_gradient=True)
+        self._attrs = {"momentum": momentum, "epsilon": epsilon,
+                       "data_layout": data_layout,
+                       "use_global_stats": use_global_stats, "is_test": is_test}
+        self._act = act
+
+    def forward(self, x):
+        attrs = dict(self._attrs)
+        attrs["is_test"] = attrs["is_test"] or not self.training
+        outs = _op("batch_norm",
+                   {"X": [x], "Scale": [self.weight], "Bias": [self.bias],
+                    "Mean": [self._mean], "Variance": [self._variance]},
+                   {"Y": [None], "MeanOut": [None], "VarianceOut": [None],
+                    "SavedMean": [None], "SavedVariance": [None]}, attrs)
+        if not attrs["is_test"]:
+            self._mean.set_value(outs["MeanOut"][0].value)
+            self._variance.set_value(outs["VarianceOut"][0].value)
+        y = outs["Y"][0]
+        if self._act:
+            y = _op(self._act, {"X": [y]}, {"Out": [None]})["Out"][0]
+        return y
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, is_distributed=False,
+                 padding_idx=None, param_attr=None, dtype="float32",
+                 name_scope=None):
+        super().__init__(name_scope, dtype=dtype)
+        self.weight = self.create_parameter(list(size), attr=param_attr)
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+
+    def forward(self, ids):
+        return _op("lookup_table_v2", {"W": [self.weight], "Ids": [ids]},
+                   {"Out": [None]}, {"padding_idx": self._padding_idx})["Out"][0]
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, scale=True, shift=True, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        n = int(np.prod(normalized_shape))
+        self.weight = self.create_parameter(
+            [n], attr=param_attr, default_initializer=ConstantInitializer(1.0)) if scale else None
+        self.bias = self.create_parameter([n], attr=bias_attr, is_bias=True) if shift else None
+        self._epsilon = epsilon
+        self._act = act
+
+    def forward(self, x):
+        ins = {"X": [x]}
+        if self.weight is not None:
+            ins["Scale"] = [self.weight]
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        out = _op("layer_norm", ins,
+                  {"Y": [None], "Mean": [None], "Variance": [None]},
+                  {"begin_norm_axis": len(x.shape) - 1,
+                   "epsilon": self._epsilon})["Y"][0]
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": [None]})["Out"][0]
+        return out
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, use_cudnn=True,
+                 ceil_mode=False, exclusive=True, dtype="float32"):
+        super().__init__(dtype=dtype)
+        p = lambda v: [v] * 2 if isinstance(v, int) else list(v)
+        self._attrs = {"pooling_type": pool_type, "ksize": p(pool_size),
+                       "strides": p(pool_stride), "paddings": p(pool_padding),
+                       "global_pooling": global_pooling, "ceil_mode": ceil_mode,
+                       "exclusive": exclusive}
+
+    def forward(self, x):
+        return _op("pool2d", {"X": [x]}, {"Out": [None]}, self._attrs)["Out"][0]
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, dropout_implementation="downgrade_in_infer"):
+        super().__init__()
+        self._attrs = {"dropout_prob": p,
+                       "dropout_implementation": dropout_implementation}
+
+    def forward(self, x):
+        attrs = dict(self._attrs, is_test=not self.training)
+        return _op("dropout", {"X": [x]}, {"Out": [None], "Mask": [None]},
+                   attrs)["Out"][0]
+
+
+class GRUUnit(Layer):
+    def __init__(self, size, param_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid", dtype="float32"):
+        super().__init__(dtype=dtype)
+        d = size // 3
+        self._d = d
+        self.weight = self.create_parameter([d, d * 3], attr=param_attr)
+        self.bias = self.create_parameter([1, d * 3], attr=bias_attr, is_bias=True)
+
+    def forward(self, input, hidden):
+        # input: [N, 3D] projected x; hidden: [N, D]
+        d = self._d
+        import jax.numpy as jnp
+
+        gates = _op("mul", {"X": [hidden], "Y": [self.weight]}, {"Out": [None]},
+                    {})["Out"][0]
+        gates = _op("elementwise_add", {"X": [gates], "Y": [input]},
+                    {"Out": [None]}, {"axis": -1})["Out"][0]
+        gates = _op("elementwise_add", {"X": [gates], "Y": [self.bias]},
+                    {"Out": [None]}, {"axis": -1})["Out"][0]
+        # split u, r, c
+        value = gates.value
+        u = VarBase(jnp.tanh(value[:, 2 * d:]))
+        return u, u

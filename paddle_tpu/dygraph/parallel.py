@@ -1,0 +1,101 @@
+"""Dygraph DataParallel (reference: python/paddle/fluid/dygraph/parallel.py
+— Env :30, prepare_context :54, DataParallel :84 + imperative/nccl_context).
+
+TPU-native: multi-process NCCL rings become `jax.distributed` processes; the
+grad coalesce-allreduce (apply_collective_grads) is a psum over all local
+devices via jax.pmap-free direct device reduction. Single-host multi-chip
+eager DP averages grads across a batch that the user shards manually."""
+
+from __future__ import annotations
+
+import os
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from .layers import Layer
+from .varbase import VarBase
+
+
+class ParallelEnv:
+    """reference: dygraph/parallel.py Env — PADDLE_TRAINER_* env vars; here
+    backed by jax.process_index/count."""
+
+    def __init__(self):
+        self._nranks = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                          jax.process_count()))
+        self._local_rank = int(os.environ.get("PADDLE_TRAINER_ID",
+                                              jax.process_index()))
+
+    @property
+    def nranks(self):
+        return self._nranks
+
+    @property
+    def local_rank(self):
+        return self._local_rank
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+
+
+Env = ParallelEnv
+
+
+def prepare_context(strategy=None):
+    """reference: prepare_context bootstraps NCCL; jax.distributed.initialize
+    is the TPU equivalent (done by the launcher)."""
+    return ParallelEnv()
+
+
+class DataParallel(Layer):
+    """reference: dygraph/parallel.py:84 — scale_loss + allreduce grads."""
+
+    def __init__(self, layers: Layer, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._strategy = strategy or ParallelEnv()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss: VarBase) -> VarBase:
+        n = getattr(self._strategy, "nranks", 1)
+        if n <= 1:
+            return loss
+        return loss * (1.0 / n)
+
+    def apply_collective_grads(self):
+        """Coalesce + allreduce gradients (reference coalesces into fused
+        buffers then c_allreduce per buffer; XLA fuses the psum here)."""
+        n = getattr(self._strategy, "nranks", 1)
+        if n <= 1:
+            return
+        # multi-process: allreduce via jax.distributed collective
+        import numpy as np
+
+        for p in self._layers.parameters():
+            if p._grad is None:
+                continue
+            # process-level psum via device put to replicated sharding
+            g = jax.experimental.multihost_utils.process_allgather(p._grad)
+            p._grad = jnp.sum(g, axis=0) if g.ndim > p._grad.ndim else p._grad
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_dict(self, *a, **k):
+        return self._layers.set_dict(*a, **k)

@@ -1,0 +1,141 @@
+"""Eager tracer (reference: imperative/tracer.cc:45 Tracer::TraceOp runs the
+kernel immediately and records the grad graph; engine.cc BasicEngine does the
+reverse sweep). Same structure here: ops run eagerly through the shared op
+registry; a tape records entries; run_backward replays vjp kernels."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import registry
+from ..core.ir import OpDesc
+from ..core.registry import (GRAD_PREFIX_IG, GRAD_PREFIX_IN, GRAD_PREFIX_OG,
+                             GRAD_PREFIX_OUT, KernelCtx)
+from .varbase import VarBase
+
+
+class TapeEntry:
+    __slots__ = ("op_type", "ins", "outs", "attrs")
+
+    def __init__(self, op_type, ins, outs, attrs):
+        self.op_type = op_type
+        self.ins = ins      # slot -> [VarBase|None]
+        self.outs = outs    # slot -> [VarBase|None]
+        self.attrs = attrs
+
+
+class Tracer:
+    def __init__(self):
+        self._tape: List[TapeEntry] = []
+        self._rng = jax.random.key(0)
+        self._no_grad = False
+        self.train_mode = True
+
+    def seed(self, s: int):
+        self._rng = jax.random.key(s)
+
+    def _next_key(self):
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    # -- forward -------------------------------------------------------------
+
+    def trace_op(self, op_type: str, ins: Dict[str, List], outs: Dict[str, List],
+                 attrs: Dict[str, Any]) -> Dict[str, List[VarBase]]:
+        opdef = registry.get_op_def(op_type)
+        raw_ins = {
+            slot: [v.value if isinstance(v, VarBase) else v for v in vals]
+            for slot, vals in ins.items()
+        }
+        desc = OpDesc(type=op_type, inputs={}, outputs={}, attrs=dict(attrs))
+        ctx = KernelCtx(desc, rng_key=self._next_key(),
+                        is_test=not self.train_mode)
+        raw_outs = opdef.call(raw_ins, attrs, ctx)
+        out_vbs: Dict[str, List[VarBase]] = {}
+        for slot, vals in raw_outs.items():
+            out_vbs[slot] = [VarBase(v) if v is not None else None for v in vals]
+        requires_grad = (not self._no_grad) and opdef.has_grad() and any(
+            isinstance(v, VarBase) and not v.stop_gradient
+            for vals in ins.values() for v in vals)
+        if requires_grad:
+            self._tape.append(TapeEntry(op_type, dict(ins), out_vbs, dict(attrs)))
+        else:
+            for vals in out_vbs.values():
+                for v in vals:
+                    if v is not None:
+                        v.stop_gradient = True
+        return out_vbs
+
+    # -- backward ------------------------------------------------------------
+
+    def run_backward(self, loss: VarBase, retain_graph=False):
+        grads: Dict[int, jnp.ndarray] = {id(loss): jnp.ones_like(loss.value)}
+        holders: Dict[int, VarBase] = {id(loss): loss}
+        for entry in reversed(self._tape):
+            out_has_grad = any(
+                v is not None and id(v) in grads
+                for vals in entry.outs.values() for v in vals)
+            if not out_has_grad:
+                continue
+            opdef = registry.get_op_def(entry.op_type)
+            gins: Dict[str, List] = {}
+            for slot, vals in entry.ins.items():
+                gins[GRAD_PREFIX_IN + slot] = [
+                    v.value if isinstance(v, VarBase) else v for v in vals]
+            for slot, vals in entry.outs.items():
+                gins[GRAD_PREFIX_OUT + slot] = [
+                    v.value if v is not None else None for v in vals]
+                gins[GRAD_PREFIX_OG + slot] = [
+                    grads.get(id(v)) if v is not None else None for v in vals]
+            out_slots = {}
+            for slot, vals in entry.ins.items():
+                if slot in opdef.nondiff_inputs:
+                    continue
+                names = []
+                for v in vals:
+                    want = isinstance(v, VarBase) and not v.stop_gradient and \
+                        jnp.issubdtype(v.value.dtype, jnp.floating)
+                    names.append("g" if want else "")
+                if any(names):
+                    out_slots[GRAD_PREFIX_IG + slot] = names
+            if not out_slots:
+                continue
+            gdesc = OpDesc(type=entry.op_type + "_grad", inputs={},
+                           outputs=out_slots, attrs=dict(entry.attrs))
+            gctx = KernelCtx(gdesc, rng_key=None, is_test=not self.train_mode)
+            # replay rng identically: fold from stored uid attr if any
+            grad_kernel = registry.get_op_def(entry.op_type + "_grad")
+            gouts = grad_kernel.call(gins, entry.attrs, gctx)
+            for slot, vals in entry.ins.items():
+                key = GRAD_PREFIX_IG + slot
+                if key not in gouts:
+                    continue
+                for v, g in zip(vals, gouts[key]):
+                    if not isinstance(v, VarBase) or g is None or v.stop_gradient:
+                        continue
+                    if id(v) in grads:
+                        grads[id(v)] = grads[id(v)] + g
+                    else:
+                        grads[id(v)] = g
+                        holders[id(v)] = v
+        for vid, g in grads.items():
+            vb = holders[vid]
+            vb._grad = g if vb._grad is None else vb._grad + g
+        if not retain_graph:
+            self._tape.clear()
+
+    def reset(self):
+        self._tape.clear()
+
+
+_TRACER: Optional[Tracer] = None
+
+
+def get_tracer() -> Tracer:
+    global _TRACER
+    if _TRACER is None:
+        _TRACER = Tracer()
+    return _TRACER

@@ -1,0 +1,109 @@
+"""VarBase — eager tensor (reference: imperative/layer.h:55 VarBase =
+variable + grad var + grad op metadata)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.ir import normalize_dtype
+
+
+class VarBase:
+    def __init__(self, value, name: Optional[str] = None, stop_gradient=False,
+                 persistable=False, trainable=True):
+        self._value = jnp.asarray(value)
+        self.name = name or f"eager_tmp_{id(self)}"
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = trainable
+        self._grad: Optional[jnp.ndarray] = None
+        # tape bookkeeping
+        self._producer = None  # (TapeEntry, out_index)
+
+    # -- data access ---------------------------------------------------------
+
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self):
+        return normalize_dtype(self._value.dtype)
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def set_value(self, v):
+        self._value = jnp.asarray(v)
+
+    def detach(self) -> "VarBase":
+        return VarBase(self._value, stop_gradient=True)
+
+    # -- autograd ------------------------------------------------------------
+
+    @property
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def backward(self, retain_graph=False):
+        from .tracer import get_tracer
+
+        get_tracer().run_backward(self, retain_graph=retain_graph)
+
+    # -- operators -----------------------------------------------------------
+
+    def _binary(self, other, op_type):
+        from .tracer import get_tracer
+
+        if not isinstance(other, VarBase):
+            other = VarBase(jnp.asarray(other, self._value.dtype), stop_gradient=True)
+        out = get_tracer().trace_op(op_type, {"X": [self], "Y": [other]},
+                                    {"Out": [None]}, {"axis": -1})
+        return out["Out"][0]
+
+    def __add__(self, other):
+        return self._binary(other, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binary(other, "elementwise_sub")
+
+    def __mul__(self, other):
+        return self._binary(other, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binary(other, "elementwise_div")
+
+    def __neg__(self):
+        from .tracer import get_tracer
+
+        out = get_tracer().trace_op("scale", {"X": [self]}, {"Out": [None]},
+                                    {"scale": -1.0})
+        return out["Out"][0]
+
+    def __repr__(self):
+        return f"VarBase(name={self.name}, shape={self.shape}, dtype={self.dtype})\n{self.numpy()}"
+
+    def astype(self, dtype):
+        from .tracer import get_tracer
+
+        out = get_tracer().trace_op("cast", {"X": [self]}, {"Out": [None]},
+                                    {"out_dtype": str(dtype)})
+        return out["Out"][0]

@@ -1,0 +1,202 @@
+"""Checkpointing / model serialization (reference:
+python/paddle/fluid/io.py — save_vars :135, save_params :268,
+save_persistables :501, load_persistables :769, save_inference_model :979,
+load_inference_model :1171; C++ save_op.cc/load_op.cc).
+
+Format: one .npy per var (like the reference's one-file-per-var save ops) or
+a single .npz when `filename` is given (save_combine_op.cc equivalent);
+programs serialize as JSON (`__model__`)."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .core import framework
+from .core.executor import Executor, global_scope
+from .core.framework import Program, Variable, default_main_program
+from .core.ir import OpDesc
+
+__all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
+           "load_params", "load_persistables", "save_inference_model",
+           "load_inference_model", "save", "load", "get_program_persistable_vars"]
+
+
+def _is_persistable(var: Variable) -> bool:
+    return var.persistable and var.desc.type not in ("reader", "raw")
+
+
+def _is_parameter(var: Variable) -> bool:
+    from .core.framework import Parameter
+
+    return isinstance(var, Parameter) or var.desc.is_parameter
+
+
+def get_program_persistable_vars(program: Program) -> List[Variable]:
+    return [v for v in program.list_vars() if _is_persistable(v)]
+
+
+def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    """reference: io.py:135."""
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if predicate is None or predicate(v)]
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    if filename is None:
+        for v in vars:
+            val = scope.find_var(v.name)
+            if val is None:
+                continue
+            np.save(os.path.join(dirname, v.name.replace("/", "%2F")), np.asarray(val))
+    else:
+        data = {}
+        for v in vars:
+            val = scope.find_var(v.name)
+            if val is not None:
+                data[v.name] = np.asarray(val)
+        np.savez(os.path.join(dirname, filename), **data)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
+              filename=None):
+    """reference: io.py load_vars."""
+    main_program = main_program or default_main_program()
+    if vars is None:
+        vars = [v for v in main_program.list_vars() if predicate is None or predicate(v)]
+    scope = global_scope()
+    if filename is not None:
+        data = np.load(os.path.join(dirname, filename)
+                       if not filename.endswith(".npz")
+                       else os.path.join(dirname, filename), allow_pickle=False)
+        for v in vars:
+            if v.name in data:
+                scope.set_var(v.name, data[v.name])
+        return
+    for v in vars:
+        path = os.path.join(dirname, v.name.replace("/", "%2F") + ".npy")
+        if os.path.exists(path):
+            scope.set_var(v.name, np.load(path))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename)
+
+
+# ---------------------------------------------------------------------------
+# Program pruning (reference: framework/prune.cc + Program._prune)
+# ---------------------------------------------------------------------------
+
+
+def _prune_for_inference(program: Program, feed_names: Sequence[str],
+                         fetch_names: Sequence[str]) -> Program:
+    pruned = program.clone(for_test=True)
+    block = pruned.global_block()
+    needed = set(fetch_names)
+    keep: List[OpDesc] = []
+    for op in reversed(block.desc.ops):
+        if any(o in needed for o in op.output_names()):
+            keep.append(op)
+            needed.update(n for n in op.input_names())
+    keep.reverse()
+    # drop backward/optimizer-only ops and dead code
+    block.desc.ops = keep
+    used = set(feed_names) | set(fetch_names)
+    for op in keep:
+        used.update(op.input_names())
+        used.update(op.output_names())
+    block.desc.vars = {k: v for k, v in block.desc.vars.items() if k in used}
+    pruned._rebuild_from_desc()
+    return pruned
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True,
+                         program_only=False):
+    """reference: io.py:979 — prune to the inference subgraph + save params."""
+    main_program = main_program or default_main_program()
+    fetch_names = [v.name if isinstance(v, Variable) else str(v) for v in target_vars]
+    pruned = _prune_for_inference(main_program, feeded_var_names, fetch_names)
+    pruned._attrs["feed_names"] = list(feeded_var_names)
+    pruned._attrs["fetch_names"] = fetch_names
+    os.makedirs(dirname, exist_ok=True)
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    import json
+
+    payload = {"program": pruned.desc.to_dict(),
+               "feed_names": list(feeded_var_names),
+               "fetch_names": fetch_names}
+    with open(model_path, "w") as f:
+        json.dump(payload, f)
+    if not program_only:
+        save_persistables(executor, dirname, main_program=pruned,
+                          filename=params_filename)
+    return fetch_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """reference: io.py:1171 → (program, feed_names, fetch_vars)."""
+    import json
+
+    model_path = os.path.join(dirname, model_filename or "__model__")
+    with open(model_path) as f:
+        payload = json.load(f)
+    from .core.ir import ProgramDesc
+
+    program = Program()
+    program.desc = ProgramDesc.from_dict(payload["program"])
+    program._rebuild_from_desc()
+    program._is_test = True
+    load_persistables(executor, dirname, main_program=program,
+                      filename=params_filename)
+    fetch_vars = [program.global_block().var(n) for n in payload["fetch_names"]]
+    return program, payload["feed_names"], fetch_vars
+
+
+# -- new-style single-file API (reference: io.py:1449 save / :1497 load) ----
+
+
+def save(program: Program, model_path: str):
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    scope = global_scope()
+    data = {}
+    for v in get_program_persistable_vars(program):
+        val = scope.find_var(v.name)
+        if val is not None:
+            data[v.name] = np.asarray(val)
+    np.savez(model_path + ".pdparams", **data)
+    with open(model_path + ".pdmodel", "wb") as f:
+        f.write(program.to_bytes())
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    scope = global_scope()
+    data = np.load(model_path + ".pdparams.npz"
+                   if os.path.exists(model_path + ".pdparams.npz")
+                   else model_path + ".pdparams")
+    names = ([v.name for v in var_list] if var_list
+             else [v.name for v in get_program_persistable_vars(program)])
+    for n in names:
+        if n in data:
+            scope.set_var(n, data[n])

@@ -1,0 +1,321 @@
+"""Control-flow layers.
+
+Reference: python/paddle/fluid/layers/control_flow.py — `cond`, `While`,
+`StaticRNN`, switch/case, increments. Sub-blocks are built with
+program._create_block() and lowered to lax.cond/while_loop/scan
+(ops/control_flow.py). The LoD machinery (lod_rank_table, DynamicRNN,
+array_to_lod_tensor) has no TPU equivalent — padded batches + `scan` with
+masks replace it (SURVEY §5).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ..core import framework
+from ..core.framework import Variable
+from ..layer_helper import LayerHelper
+
+__all__ = ["cond", "While", "StaticRNN", "increment", "array_write",
+           "array_read", "array_length", "create_array", "less_than", "Switch",
+           "case", "switch_case"]
+
+
+def _collect_block(program, build_fn):
+    """Run build_fn inside a fresh sub-block; return (block, returned vars)."""
+    block = program._create_block()
+    try:
+        ret = build_fn()
+    finally:
+        program._rollback()
+    if ret is None:
+        rets = []
+    elif isinstance(ret, (list, tuple)):
+        rets = list(ret)
+    else:
+        rets = [ret]
+    return block, rets
+
+
+def cond(pred: Variable, true_fn: Callable, false_fn: Callable, name=None):
+    """reference: layers/control_flow.py `cond` (pair of conditional_block
+    ops + select_input) → one `cond` op lowered to lax.cond."""
+    helper = LayerHelper("cond", name=name)
+    program = helper.main_program
+
+    true_block, true_outs = _collect_block(program, true_fn)
+    false_block, false_outs = _collect_block(program, false_fn)
+    if len(true_outs) != len(false_outs):
+        raise ValueError("true_fn and false_fn must return the same number of outputs")
+
+    # Vars read by either branch that exist outside — passed as Input so
+    # grads flow (see ops/control_flow.py docstring).
+    outer_reads: List[str] = []
+    for blk in (true_block, false_block):
+        defined = set()
+        for op in blk.desc.ops:
+            for n in op.input_names():
+                if n not in defined and not blk.has_var(n) or (
+                        n not in defined and blk.program.global_block().has_var(n)):
+                    if n not in outer_reads and program.global_block().has_var(n):
+                        outer_reads.append(n)
+            defined.update(op.output_names())
+
+    out_names = []
+    outs = []
+    for tv, fv in zip(true_outs, false_outs):
+        out = helper.create_variable_for_type_inference(tv.dtype)
+        out.desc.shape = tv.desc.shape
+        out_names.append(out.name)
+        outs.append(out)
+
+    # The op's out_names refer to in-branch var names; emit per-branch assigns
+    # so both branches define the same output names.
+    for blk, branch_outs in ((true_block, true_outs), (false_block, false_outs)):
+        for out, bv in zip(outs, branch_outs):
+            blk.desc.ops.append(
+                __import__("paddle_tpu.core.ir", fromlist=["OpDesc"]).OpDesc(
+                    type="assign", inputs={"X": [bv.name]}, outputs={"Out": [out.name]}))
+
+    helper.append_op(
+        type="cond",
+        inputs={"Cond": pred,
+                "Input": [program.global_block().var(n) for n in outer_reads]},
+        outputs={"Out": outs},
+        attrs={"true_block": {"__block__": true_block.idx},
+               "false_block": {"__block__": false_block.idx},
+               "input_names": outer_reads,
+               "out_names": out_names})
+    if len(outs) == 1:
+        return outs[0]
+    return outs
+
+
+class While:
+    """reference: layers/control_flow.py `While` — usage:
+        w = While(cond_var)
+        with w.block():
+            ... ops writing loop vars and recomputing cond_var ...
+    Forward-only (lax.while_loop); use StaticRNN/scan for differentiable
+    recurrences."""
+
+    def __init__(self, cond: Variable, is_test=False, name=None):
+        self.helper = LayerHelper("while", name=name)
+        self.cond_var = cond
+
+    class _BlockGuard:
+        def __init__(self, w):
+            self.w = w
+
+        def __enter__(self):
+            program = self.w.helper.main_program
+            self.w._block = program._create_block()
+            return self.w._block
+
+        def __exit__(self, exc_type, *a):
+            program = self.w.helper.main_program
+            program._rollback()
+            if exc_type is not None:
+                return False
+            blk = self.w._block
+            carry = []
+            for op in blk.desc.ops:
+                for n in op.output_names():
+                    if n and n not in carry and program.global_block().has_var(n):
+                        carry.append(n)
+            if self.w.cond_var.name not in carry:
+                raise ValueError("While block must update the condition variable")
+            outs = [program.global_block().var(n) for n in carry]
+            self.w.helper.append_op(
+                type="while",
+                inputs={"Condition": self.w.cond_var, "X": outs},
+                outputs={"Out": outs},
+                attrs={"sub_block": {"__block__": blk.idx},
+                       "carry_names": carry,
+                       "cond_name": self.w.cond_var.name})
+            return False
+
+    def block(self):
+        return While._BlockGuard(self)
+
+
+class StaticRNN:
+    """reference: layers/control_flow.py `StaticRNN` (recurrent_op) — lowered
+    to one differentiable `scan` op (lax.scan).
+
+    Usage:
+        rnn = StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x_TND)          # slice along time (axis 0)
+            h_prev = rnn.memory(init=h0)          # loop-carried state
+            h = some_layers(x_t, h_prev)
+            rnn.update_memory(h_prev, h)
+            rnn.step_output(h)
+        outs = rnn()                              # [T, N, D] stacked
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._seq_inputs = []      # (outer var, in-block var)
+        self._memories = []        # (in-block prev var, init var, updated name)
+        self._outputs = []         # in-block vars
+        self._extras = []          # (outer var, in-block name)
+        self._block = None
+        self._result_vars = None
+
+    class _StepGuard:
+        def __init__(self, rnn):
+            self.rnn = rnn
+
+        def __enter__(self):
+            self.rnn._block = self.rnn.helper.main_program._create_block()
+            return self.rnn
+
+        def __exit__(self, exc_type, *a):
+            self.rnn.helper.main_program._rollback()
+            if exc_type is None:
+                self.rnn._complete()
+            return False
+
+    def step(self):
+        return StaticRNN._StepGuard(self)
+
+    def step_input(self, x: Variable) -> Variable:
+        blk = self.rnn_block()
+        v = blk.create_var(shape=x.shape[1:], dtype=x.dtype)
+        self._seq_inputs.append((x, v))
+        return Variable(blk, v.desc) if not isinstance(v, Variable) else v
+
+    def rnn_block(self):
+        return self._block
+
+    def memory(self, init: Optional[Variable] = None, shape=None,
+               batch_ref=None, init_value=0.0, dtype="float32") -> Variable:
+        if init is None:
+            from .tensor import fill_constant
+
+            # build init in the *outer* block
+            program = self.helper.main_program
+            cur = program._current_block_idx
+            program._current_block_idx = self._block.parent_idx
+            try:
+                init = fill_constant(shape, dtype, init_value)
+            finally:
+                program._current_block_idx = cur
+        blk = self._block
+        prev = blk.create_var(shape=init.shape, dtype=init.dtype)
+        self._memories.append([prev, init, None])
+        return prev
+
+    def update_memory(self, mem: Variable, var: Variable):
+        for m in self._memories:
+            if m[0].name == mem.name:
+                m[2] = var.name
+                return
+        raise ValueError(f"unknown memory {mem.name}")
+
+    def step_output(self, o: Variable):
+        self._outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def _complete(self):
+        program = self.helper.main_program
+        for m in self._memories:
+            if m[2] is None:
+                raise ValueError("memory never updated — call update_memory")
+        seq_outer = [x for x, _ in self._seq_inputs]
+        seq_names = [v.name for _, v in self._seq_inputs]
+        init_vars = [m[1] for m in self._memories]
+        state_names = [m[0].name for m in self._memories]
+        state_out_names = [m[2] for m in self._memories]
+        out_names = [o.name for o in self._outputs]
+
+        # params read inside the block get grads via Extra
+        defined = set(seq_names) | set(state_names)
+        extra_names = []
+        for op in self._block.desc.ops:
+            for n in op.input_names():
+                if n and n not in defined and n not in extra_names:
+                    if program.global_block().has_var(n):
+                        extra_names.append(n)
+            defined.update(op.output_names())
+        extra_vars = [program.global_block().var(n) for n in extra_names]
+
+        results = []
+        finals = []
+        for o in self._outputs:
+            v = self.helper.create_variable_for_type_inference(o.dtype)
+            results.append(v)
+        for m in self._memories:
+            v = self.helper.create_variable_for_type_inference(m[1].dtype)
+            finals.append(v)
+        self.helper.append_op(
+            type="scan",
+            inputs={"SeqIn": seq_outer, "InitState": init_vars, "Extra": extra_vars},
+            outputs={"Out": results, "FinalState": finals},
+            attrs={"sub_block": {"__block__": self._block.idx},
+                   "seq_names": seq_names, "state_names": state_names,
+                   "state_out_names": state_out_names,
+                   "extra_names": extra_names, "out_names": out_names})
+        self._result_vars = results
+
+    def __call__(self):
+        if len(self._result_vars) == 1:
+            return self._result_vars[0]
+        return self._result_vars
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment")
+    out = x if in_place else helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="increment", inputs={"X": x}, outputs={"Out": out},
+                     attrs={"step": float(value)})
+    return out
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    from .ops import less_than as _lt
+
+    return _lt(x, y, cond)
+
+
+# -- tensor arrays: static-shape stand-ins ---------------------------------
+
+def create_array(dtype):
+    raise NotImplementedError(
+        "LoDTensorArray has no static-shape TPU equivalent; use StaticRNN "
+        "(lax.scan) whose outputs are stacked [T, ...] tensors")
+
+
+array_write = array_read = array_length = create_array
+
+
+class Switch:
+    """reference: layers/control_flow.py `Switch` — built on nested cond."""
+
+    def __init__(self, name=None):
+        raise NotImplementedError("use layers.case / layers.cond")
+
+
+def case(pred_fn_pairs, default=None):
+    """Nested lax.cond chain."""
+    if not pred_fn_pairs:
+        raise ValueError("empty pred_fn_pairs")
+    (pred, fn), rest = pred_fn_pairs[0], pred_fn_pairs[1:]
+    if rest or default:
+        return cond(pred, fn, (lambda: case(rest, default)) if rest else default)
+    return cond(pred, fn, default)
+
+
+def switch_case(branch_index, branch_fns, default=None):
+    from .ops import equal as _eq
+    from .tensor import fill_constant
+
+    pairs = []
+    for idx, fn in (branch_fns.items() if isinstance(branch_fns, dict) else enumerate(branch_fns)):
+        c = _eq(branch_index, fill_constant([1], branch_index.dtype, idx))
+        pairs.append((c, fn))
+    return case(pairs, default)

@@ -1,0 +1,23 @@
+"""Input layers (reference: python/paddle/fluid/layers/io.py — `data` :40)."""
+
+from __future__ import annotations
+
+from ..core import framework
+from ..core.framework import Variable
+
+__all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", append_batch_size=True,
+         lod_level=0, type=None, stop_gradient=True) -> Variable:
+    """Declare a feed variable (reference: layers/io.py:40). The reference
+    injects feed ops reading from a feed-var holder (executor.py:233); here
+    the executor binds feeds by name directly into the compiled step."""
+    shape = list(shape)
+    if append_batch_size and (not shape or shape[0] != -1):
+        shape = [-1] + shape
+    block = framework.default_main_program().current_block()
+    var = block.create_var(name=name, shape=shape, dtype=dtype,
+                           stop_gradient=stop_gradient)
+    var.desc.need_check_feed = True
+    return var

@@ -1,0 +1,121 @@
+"""Loss layers (reference: python/paddle/fluid/layers/nn.py loss section)."""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy",
+    "sigmoid_cross_entropy_with_logits", "square_error_cost", "smooth_l1",
+    "huber_loss", "kldiv_loss", "margin_rank_loss", "hinge_loss", "bce_loss",
+    "mse_loss",
+]
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    helper = LayerHelper("cross_entropy")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="cross_entropy", inputs={"X": input, "Label": label},
+                     outputs={"Y": out},
+                     attrs={"soft_label": soft_label, "ignore_index": ignore_index})
+    return out
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    helper = LayerHelper("softmax_with_cross_entropy")
+    loss = helper.create_variable_for_type_inference(logits.dtype)
+    softmax = helper.create_variable_for_type_inference(logits.dtype)
+    helper.append_op(type="softmax_with_cross_entropy",
+                     inputs={"Logits": logits, "Label": label},
+                     outputs={"Loss": loss, "Softmax": softmax},
+                     attrs={"soft_label": soft_label, "ignore_index": ignore_index,
+                            "axis": axis})
+    if return_softmax:
+        return loss, softmax
+    return loss
+
+
+def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100, name=None,
+                                      normalize=False):
+    helper = LayerHelper("sigmoid_cross_entropy_with_logits", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sigmoid_cross_entropy_with_logits",
+                     inputs={"X": x, "Label": label}, outputs={"Out": out},
+                     attrs={"ignore_index": ignore_index, "normalize": normalize})
+    return out
+
+
+def square_error_cost(input, label):
+    helper = LayerHelper("square_error_cost")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="square_error_cost", inputs={"X": input, "Y": label},
+                     outputs={"Out": out})
+    return out
+
+
+def mse_loss(input, label):
+    from .nn import mean
+
+    return mean(square_error_cost(input, label))
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    helper = LayerHelper("smooth_l1_loss")
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    diff = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": x, "Y": y}
+    if inside_weight is not None:
+        inputs["InsideWeight"] = inside_weight
+    if outside_weight is not None:
+        inputs["OutsideWeight"] = outside_weight
+    helper.append_op(type="smooth_l1_loss", inputs=inputs,
+                     outputs={"Out": loss, "Diff": diff},
+                     attrs={"sigma": sigma or 1.0})
+    return loss
+
+
+def huber_loss(input, label, delta):
+    helper = LayerHelper("huber_loss")
+    loss = helper.create_variable_for_type_inference(input.dtype)
+    residual = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="huber_loss", inputs={"X": input, "Y": label},
+                     outputs={"Out": loss, "Residual": residual},
+                     attrs={"delta": float(delta)})
+    return loss
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    helper = LayerHelper("kldiv_loss", name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="kldiv_loss", inputs={"X": x, "Target": target},
+                     outputs={"Loss": loss}, attrs={"reduction": reduction})
+    return loss
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    helper = LayerHelper("margin_rank_loss", name=name)
+    out = helper.create_variable_for_type_inference(left.dtype)
+    act = helper.create_variable_for_type_inference(left.dtype)
+    helper.append_op(type="margin_rank_loss",
+                     inputs={"Label": label, "X1": left, "X2": right},
+                     outputs={"Out": out, "Activated": act},
+                     attrs={"margin": float(margin)})
+    return out
+
+
+def hinge_loss(input, label, name=None):
+    helper = LayerHelper("hinge_loss", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="hinge_loss", inputs={"Logits": input, "Labels": label},
+                     outputs={"Loss": out})
+    return out
+
+
+def bce_loss(input, label, name=None):
+    helper = LayerHelper("bce_loss", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="bce_loss", inputs={"X": input, "Label": label},
+                     outputs={"Out": out})
+    return out

@@ -1,0 +1,19 @@
+"""Operator library: every kernel is a JAX lowering (reference:
+paddle/fluid/operators/ — ~510 C++/CUDA ops, SURVEY.md §2.3).
+
+Importing this package registers all ops. Grad ops are generated generically
+via jax.vjp (core/registry.py) unless an op overrides.
+"""
+
+from . import tensor
+from . import math
+from . import activation
+from . import reduce
+from . import compare
+from . import nn
+from . import optimizer_ops
+from . import control_flow
+from . import metrics_ops
+from . import sequence
+from . import detection
+from . import collective

@@ -1,0 +1,175 @@
+"""Activation ops (reference: paddle/fluid/operators/activation_op.cc —
+~24 activations registered from one macro table; same idea here)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _unary(fn):
+    def kernel(ins, attrs, ctx):
+        return {"Out": fn(ins["X"][0], attrs)}
+
+    return kernel
+
+
+_SIMPLE = {
+    "relu": lambda x, a: jax.nn.relu(x),
+    "sigmoid": lambda x, a: jax.nn.sigmoid(x),
+    "logsigmoid": lambda x, a: jax.nn.log_sigmoid(x),
+    "tanh": lambda x, a: jnp.tanh(x),
+    "tanh_shrink": lambda x, a: x - jnp.tanh(x),
+    "exp": lambda x, a: jnp.exp(x),
+    "log": lambda x, a: jnp.log(x),
+    "log1p": lambda x, a: jnp.log1p(x),
+    "log2": lambda x, a: jnp.log2(x),
+    "log10": lambda x, a: jnp.log10(x),
+    "abs": lambda x, a: jnp.abs(x),
+    "square": lambda x, a: jnp.square(x),
+    "sqrt": lambda x, a: jnp.sqrt(x),
+    "rsqrt": lambda x, a: jax.lax.rsqrt(x),
+    "reciprocal": lambda x, a: 1.0 / x,
+    "softsign": lambda x, a: jax.nn.soft_sign(x),
+    "sin": lambda x, a: jnp.sin(x),
+    "cos": lambda x, a: jnp.cos(x),
+    "tan": lambda x, a: jnp.tan(x),
+    "asin": lambda x, a: jnp.arcsin(x),
+    "acos": lambda x, a: jnp.arccos(x),
+    "atan": lambda x, a: jnp.arctan(x),
+    "sinh": lambda x, a: jnp.sinh(x),
+    "cosh": lambda x, a: jnp.cosh(x),
+    "erf": lambda x, a: jax.scipy.special.erf(x),
+    "floor": lambda x, a: jnp.floor(x),
+    "ceil": lambda x, a: jnp.ceil(x),
+    "round": lambda x, a: jnp.round(x),
+    "sign": lambda x, a: jnp.sign(x),
+    "silu": lambda x, a: jax.nn.silu(x),
+    "mish": lambda x, a: x * jnp.tanh(jax.nn.softplus(x)),
+}
+
+for _name, _fn in _SIMPLE.items():
+    grad = None if _name in ("floor", "ceil", "round", "sign") else "generic"
+    register_op(_name, grad=grad)(_unary(_fn))
+
+
+@register_op("gelu")
+def gelu(ins, attrs, ctx):
+    x = ins["X"][0]
+    return {"Out": jax.nn.gelu(x, approximate=bool(attrs.get("approximate", False)))}
+
+
+@register_op("leaky_relu")
+def leaky_relu(ins, attrs, ctx):
+    x = ins["X"][0]
+    alpha = attrs.get("alpha", 0.02)
+    return {"Out": jnp.where(x >= 0, x, alpha * x)}
+
+
+@register_op("elu")
+def elu(ins, attrs, ctx):
+    x = ins["X"][0]
+    return {"Out": jax.nn.elu(x, alpha=attrs.get("alpha", 1.0))}
+
+
+@register_op("selu")
+def selu(ins, attrs, ctx):
+    return {"Out": jax.nn.selu(ins["X"][0])}
+
+
+@register_op("relu6")
+def relu6(ins, attrs, ctx):
+    x = ins["X"][0]
+    return {"Out": jnp.clip(x, 0.0, attrs.get("threshold", 6.0))}
+
+
+@register_op("brelu")
+def brelu(ins, attrs, ctx):
+    x = ins["X"][0]
+    return {"Out": jnp.clip(x, attrs.get("t_min", 0.0), attrs.get("t_max", 24.0))}
+
+
+@register_op("softplus")
+def softplus(ins, attrs, ctx):
+    return {"Out": jax.nn.softplus(ins["X"][0])}
+
+
+@register_op("softshrink")
+def softshrink(ins, attrs, ctx):
+    x = ins["X"][0]
+    lam = attrs.get("lambda", 0.5)
+    return {"Out": jnp.where(x > lam, x - lam, jnp.where(x < -lam, x + lam, 0.0))}
+
+
+@register_op("hard_shrink")
+def hard_shrink(ins, attrs, ctx):
+    x = ins["X"][0]
+    t = attrs.get("threshold", 0.5)
+    return {"Out": jnp.where(jnp.abs(x) > t, x, 0.0)}
+
+
+@register_op("thresholded_relu")
+def thresholded_relu(ins, attrs, ctx):
+    x = ins["X"][0]
+    t = attrs.get("threshold", 1.0)
+    return {"Out": jnp.where(x > t, x, 0.0)}
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid(ins, attrs, ctx):
+    x = ins["X"][0]
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    return {"Out": jnp.clip(slope * x + offset, 0.0, 1.0)}
+
+
+@register_op("hard_swish")
+def hard_swish(ins, attrs, ctx):
+    x = ins["X"][0]
+    t = attrs.get("threshold", 6.0)
+    s = attrs.get("scale", 6.0)
+    o = attrs.get("offset", 3.0)
+    return {"Out": x * jnp.clip(x + o, 0.0, t) / s}
+
+
+@register_op("swish")
+def swish(ins, attrs, ctx):
+    x = ins["X"][0]
+    beta = attrs.get("beta", 1.0)
+    return {"Out": x * jax.nn.sigmoid(beta * x)}
+
+
+@register_op("stanh")
+def stanh(ins, attrs, ctx):
+    x = ins["X"][0]
+    a = attrs.get("scale_a", 0.67)
+    b = attrs.get("scale_b", 1.7159)
+    return {"Out": b * jnp.tanh(a * x)}
+
+
+@register_op("prelu")
+def prelu(ins, attrs, ctx):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel" and alpha.ndim == 1:
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": jnp.where(x >= 0, x, alpha * x)}
+
+
+@register_op("pow")
+def pow_op(ins, attrs, ctx):
+    x = ins["X"][0]
+    f = attrs.get("factor", 1.0)
+    if ins.get("FactorTensor") and ins["FactorTensor"][0] is not None:
+        f = ins["FactorTensor"][0]
+    return {"Out": jnp.power(x, f)}
+
+
+@register_op("maxout")
+def maxout(ins, attrs, ctx):
+    x = ins["X"][0]  # NCHW
+    groups = int(attrs["groups"])
+    n, c, h, w = x.shape
+    return {"Out": jnp.max(x.reshape(n, c // groups, groups, h, w), axis=2)}

@@ -1,0 +1,104 @@
+"""Collective ops.
+
+Reference: paddle/fluid/operators/collective/ — c_allreduce_{sum,max,min,prod},
+c_broadcast, c_allgather, c_reducescatter, plus the comm-bootstrap ops
+(c_comm_init, c_gen_nccl_id) and stream-sync ops.
+
+TPU-native: these lower to `jax.lax` collectives over a named mesh axis
+(SURVEY §5: ring_id → mesh axis). They are only meaningful when the program
+is lowered inside shard_map (paddle_tpu.parallel); under plain jit GSPMD
+inserts collectives automatically and explicit ones are unnecessary. The
+bootstrap/stream ops are no-ops: `jax.distributed.initialize` replaces
+gen_nccl_id (no NCCL rings to build), and XLA owns stream ordering.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _axis(attrs):
+    # ring_id selected a NCCLCommContext in the reference; here it names a
+    # mesh axis (default the data axis).
+    return attrs.get("axis_name", "data")
+
+
+def _allreduce(op):
+    def kernel(ins, attrs, ctx):
+        x = ins["X"][0]
+        return {"Out": op(x, _axis(attrs))}
+
+    return kernel
+
+
+register_op("c_allreduce_sum")(_allreduce(lambda x, a: jax.lax.psum(x, a)))
+register_op("c_allreduce_max", grad=None)(_allreduce(lambda x, a: jax.lax.pmax(x, a)))
+register_op("c_allreduce_min", grad=None)(_allreduce(lambda x, a: jax.lax.pmin(x, a)))
+register_op("c_allreduce_prod", grad=None)(
+    _allreduce(lambda x, a: jnp.exp(jax.lax.psum(jnp.log(x), a))))
+
+
+@register_op("c_broadcast")
+def c_broadcast(ins, attrs, ctx):
+    x = ins["X"][0]
+    root = int(attrs.get("root", 0))
+    axis = _axis(attrs)
+    idx = jax.lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return {"Out": jax.lax.psum(masked, axis)}
+
+
+@register_op("c_allgather")
+def c_allgather(ins, attrs, ctx):
+    x = ins["X"][0]
+    return {"Out": jax.lax.all_gather(x, _axis(attrs), tiled=True)}
+
+
+@register_op("c_reducescatter")
+def c_reducescatter(ins, attrs, ctx):
+    x = ins["X"][0]
+    return {"Out": jax.lax.psum_scatter(x, _axis(attrs), tiled=True)}
+
+
+@register_op("c_ppermute")
+def c_ppermute(ins, attrs, ctx):
+    """Ring permute — the building block of ring attention / pipeline comm
+    (no reference counterpart; exposed because ICI rings make it cheap)."""
+    x = ins["X"][0]
+    axis = _axis(attrs)
+    shift = int(attrs.get("shift", 1))
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return {"Out": jax.lax.ppermute(x, axis, perm)}
+
+
+def _noop(ins, attrs, ctx):
+    xs = ins.get("X", [])
+    return {"Out": list(xs)} if xs else {}
+
+
+# Bootstrap / stream ops: no-ops on TPU (see module docstring).
+register_op("c_comm_init", grad=None)(_noop)
+register_op("c_comm_init_all", grad=None)(_noop)
+register_op("c_gen_nccl_id", grad=None)(_noop)
+register_op("c_sync_calc_stream", grad=None)(_noop)
+register_op("c_sync_comm_stream", grad=None)(_noop)
+register_op("c_wait_compute", grad=None)(_noop)
+register_op("c_wait_comm", grad=None)(_noop)
+
+
+@register_op("c_embedding", nondiff_inputs=("Ids",))
+def c_embedding(ins, attrs, ctx):
+    """Sharded embedding lookup (vocab-parallel): each shard holds rows
+    [start, start+per_part); out-of-range ids contribute zeros, combined by
+    psum (reference: collective/c_embedding_op.cc pattern)."""
+    w, ids = ins["W"][0], ins["Ids"][0]
+    start = int(attrs.get("start_index", 0))
+    idx = ids.astype(jnp.int32) - start
+    valid = (idx >= 0) & (idx < w.shape[0])
+    safe = jnp.clip(idx, 0, w.shape[0] - 1)
+    out = jnp.take(w, safe, axis=0)
+    return {"Out": jnp.where(valid[..., None], out, 0.0)}

@@ -1,0 +1,64 @@
+"""Comparison / logical ops (reference: operators/controlflow/compare_op.cc,
+logical_op.cc; isfinite operators/isfinite_op.cc)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _cmp(fn):
+    def kernel(ins, attrs, ctx):
+        return {"Out": fn(ins["X"][0], ins["Y"][0])}
+
+    return kernel
+
+
+register_op("equal", grad=None)(_cmp(jnp.equal))
+register_op("not_equal", grad=None)(_cmp(jnp.not_equal))
+register_op("less_than", grad=None)(_cmp(jnp.less))
+register_op("less_equal", grad=None)(_cmp(jnp.less_equal))
+register_op("greater_than", grad=None)(_cmp(jnp.greater))
+register_op("greater_equal", grad=None)(_cmp(jnp.greater_equal))
+register_op("logical_and", grad=None)(_cmp(jnp.logical_and))
+register_op("logical_or", grad=None)(_cmp(jnp.logical_or))
+register_op("logical_xor", grad=None)(_cmp(jnp.logical_xor))
+
+
+@register_op("logical_not", grad=None)
+def logical_not(ins, attrs, ctx):
+    return {"Out": jnp.logical_not(ins["X"][0])}
+
+
+@register_op("isinf", grad=None)
+def isinf(ins, attrs, ctx):
+    return {"Out": jnp.any(jnp.isinf(ins["X"][0])).reshape(1)}
+
+
+@register_op("isnan", grad=None)
+def isnan(ins, attrs, ctx):
+    return {"Out": jnp.any(jnp.isnan(ins["X"][0])).reshape(1)}
+
+
+@register_op("isfinite", grad=None)
+def isfinite(ins, attrs, ctx):
+    return {"Out": jnp.all(jnp.isfinite(ins["X"][0])).reshape(1)}
+
+
+@register_op("isinf_v2", grad=None)
+def isinf_v2(ins, attrs, ctx):
+    return {"Out": jnp.isinf(ins["X"][0])}
+
+
+@register_op("isnan_v2", grad=None)
+def isnan_v2(ins, attrs, ctx):
+    return {"Out": jnp.isnan(ins["X"][0])}
+
+
+@register_op("allclose", grad=None)
+def allclose(ins, attrs, ctx):
+    x, y = ins["Input"][0], ins["Other"][0]
+    return {"Out": jnp.allclose(x, y, rtol=float(attrs.get("rtol", 1e-5)),
+                                atol=float(attrs.get("atol", 1e-8)),
+                                equal_nan=bool(attrs.get("equal_nan", False)))}

@@ -1,0 +1,131 @@
+"""Control-flow ops with nested sub-blocks.
+
+Reference: operators/controlflow/conditional_block_op.cc, while_op.cc,
+recurrent_op.cc — sub-blocks stored as BLOCK attrs, interpreted by nested
+executors with step-scopes.
+
+TPU-native: sub-blocks lower into `lax.cond` / `lax.while_loop` / `lax.scan`
+inside the same XLA computation. `scan` replaces recurrent_op/StaticRNN and
+is reverse-differentiable via the generic vjp grad (lax.scan supports vjp);
+`while` is forward-only (XLA's while has no reverse-mode — the reference's
+while_grad re-runs the block per step, which scan covers).
+
+Grad note: outer vars captured by a sub-block only receive gradients if
+passed through the op's "Input" slot (declared in `input_names`) — the layers
+API does this for parameters.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _block_idx(attrs, key):
+    v = attrs[key]
+    if isinstance(v, dict):
+        return v["__block__"]
+    return int(v)
+
+
+@register_op("cond", nondiff_inputs=("Cond",))
+def cond_op(ins, attrs, ctx):
+    """Two-branch conditional (replaces the reference's pair of
+    conditional_block ops + select_input used by layers.cond)."""
+    pred = ins["Cond"][0].reshape(())
+    input_names = list(attrs.get("input_names", []))
+    operands = list(ins.get("Input", []))
+    out_names = list(attrs["out_names"])
+    tb = _block_idx(attrs, "true_block")
+    fb = _block_idx(attrs, "false_block")
+
+    def make_branch(bidx):
+        def branch(ops):
+            env = dict(ctx.env or {})
+            env.update(zip(input_names, ops))
+            ctx.lower_block(bidx, env)
+            return [env[n] for n in out_names]
+
+        return branch
+
+    outs = jax.lax.cond(pred, make_branch(tb), make_branch(fb), operands)
+    return {"Out": list(outs)}
+
+
+@register_op("while", grad=None, nondiff_inputs=("Condition", "X"))
+def while_op(ins, attrs, ctx):
+    """reference: controlflow/while_op.cc. Loop-carried vars are every var
+    the sub-block writes (attr carry_names), incl. the condition var."""
+    bidx = _block_idx(attrs, "sub_block")
+    carry_names = list(attrs["carry_names"])
+    cond_name = attrs["cond_name"]
+    env0 = dict(ctx.env or {})
+    init = [env0[n] for n in carry_names]
+    cond0 = ins["Condition"][0].reshape(())
+
+    def cond_fun(state):
+        pred, _ = state
+        return pred
+
+    def body_fun(state):
+        _, carry = state
+        env = dict(env0)
+        env.update(zip(carry_names, carry))
+        ctx.lower_block(bidx, env)
+        new_carry = [env[n] for n in carry_names]
+        new_pred = env[cond_name].reshape(())
+        return new_pred, new_carry
+
+    _, final = jax.lax.while_loop(cond_fun, body_fun, (cond0, init))
+    return {"Out": list(final)}
+
+
+@register_op("scan")
+def scan_op(ins, attrs, ctx):
+    """Sequence recurrence via lax.scan — the TPU-native recurrent_op
+    (reference: recurrent_op.cc, StaticRNN layers/control_flow.py). Inputs:
+      SeqIn    : tensors [T, ...] sliced per step (in-block names seq_names)
+      InitState: initial states (in-block prev-state names state_names;
+                 the block writes state_out_names each step)
+      Extra    : extra captured tensors needing grads (extra_names)
+    Outputs: per-step outs stacked [T, ...] (out_names) + FinalState.
+    Differentiable (generic vjp through lax.scan)."""
+    bidx = _block_idx(attrs, "sub_block")
+    seq_names = list(attrs.get("seq_names", []))
+    state_names = list(attrs.get("state_names", []))
+    state_out_names = list(attrs.get("state_out_names", []))
+    extra_names = list(attrs.get("extra_names", []))
+    out_names = list(attrs.get("out_names", []))
+    reverse = bool(attrs.get("is_reverse", False))
+
+    seqs = list(ins.get("SeqIn", []))
+    init = list(ins.get("InitState", []))
+    extras = list(ins.get("Extra", []))
+    env0 = dict(ctx.env or {})
+
+    def body(carry, xs):
+        env = dict(env0)
+        env.update(zip(extra_names, extras))
+        env.update(zip(state_names, carry))
+        env.update(zip(seq_names, xs))
+        ctx.lower_block(bidx, env)
+        new_carry = [env[n] for n in state_out_names]
+        step_outs = [env[n] for n in out_names]
+        return new_carry, step_outs
+
+    final, ys = jax.lax.scan(body, init, seqs, reverse=reverse)
+    return {"Out": list(ys), "FinalState": list(final)}
+
+
+@register_op("select_input", nondiff_inputs=("Mask",))
+def select_input(ins, attrs, ctx):
+    mask = ins["Mask"][0].reshape(()).astype(jnp.int32)
+    xs = ins["X"]
+    return {"Out": jax.lax.switch(mask, [lambda i=i: xs[i] for i in range(len(xs))])}
+
+
+@register_op("assign_skip", grad=None)
+def assign_skip(ins, attrs, ctx):
+    return {"Out": ins["X"][0]}

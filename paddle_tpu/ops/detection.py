@@ -1,0 +1,196 @@
+"""Detection ops (reference: paddle/fluid/operators/detection/ — 28 ops).
+
+Round-1 coverage: the geometry ops (box_coder, prior_box, iou_similarity,
+yolo_box); NMS-family ops need sorted dynamic shapes and follow in a later
+round as masked fixed-size variants.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+@register_op("iou_similarity", grad=None)
+def iou_similarity(ins, attrs, ctx):
+    x, y = ins["X"][0], ins["Y"][0]  # [N,4],[M,4] xyxy
+    area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    return {"Out": inter / (area_x[:, None] + area_y[None, :] - inter + 1e-10)}
+
+
+@register_op("box_coder", grad=None)
+def box_coder(ins, attrs, ctx):
+    """reference: detection/box_coder_op.cc."""
+    prior, tb = ins["PriorBox"][0], ins["TargetBox"][0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    norm = attrs.get("box_normalized", True)
+    pv = ins.get("PriorBoxVar")
+    pv = pv[0] if pv and pv[0] is not None else None
+    one = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph = prior[:, 3] - prior[:, 1] + one
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[:, None, 2] - tb[:, None, 0] + one
+        th = tb[:, None, 3] - tb[:, None, 1] + one
+        tcx = tb[:, None, 0] + tw * 0.5
+        tcy = tb[:, None, 1] + th * 0.5
+        ox = (tcx - pcx) / pw
+        oy = (tcy - pcy) / ph
+        ow = jnp.log(jnp.abs(tw / pw))
+        oh = jnp.log(jnp.abs(th / ph))
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        if pv is not None:
+            out = out / pv[None, :, :]
+        return {"OutputBox": out}
+    # decode_center_size
+    if tb.ndim == 2:
+        tb = tb[:, None, :]
+    var = pv[None, :, :] if pv is not None else 1.0
+    t = tb * var if pv is not None else tb
+    ocx = t[..., 0] * pw + pcx
+    ocy = t[..., 1] * ph + pcy
+    ow = jnp.exp(t[..., 2]) * pw
+    oh = jnp.exp(t[..., 3]) * ph
+    out = jnp.stack([ocx - ow / 2, ocy - oh / 2,
+                     ocx + ow / 2 - one, ocy + oh / 2 - one], axis=-1)
+    return {"OutputBox": out}
+
+
+@register_op("prior_box", grad=None)
+def prior_box(ins, attrs, ctx):
+    """reference: detection/prior_box_op.cc (SSD anchors)."""
+    inp, image = ins["Input"][0], ins["Image"][0]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = [float(a) for a in attrs.get("aspect_ratios", [1.0])]
+    flip = attrs.get("flip", False)
+    clip = attrs.get("clip", False)
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    offset = attrs.get("offset", 0.5)
+    ih, iw = image.shape[2], image.shape[3]
+    fh, fw = inp.shape[2], inp.shape[3]
+    sw = attrs.get("step_w", 0.0) or iw / fw
+    sh = attrs.get("step_h", 0.0) or ih / fh
+
+    full_ars = []
+    for a in ars:
+        full_ars.append(a)
+        if flip and a != 1.0:
+            full_ars.append(1.0 / a)
+
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        for a in full_ars:
+            boxes.append((ms * np.sqrt(a), ms / np.sqrt(a)))
+            if a == 1.0 and ms_i < len(max_sizes):
+                s = np.sqrt(ms * max_sizes[ms_i])
+                boxes.append((s, s))
+    num_priors = len(boxes)
+    cx = (jnp.arange(fw) + offset) * sw
+    cy = (jnp.arange(fh) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    wh = jnp.asarray(boxes)  # [P, 2]
+    out = jnp.stack([
+        (cxg[..., None] - wh[None, None, :, 0] / 2) / iw,
+        (cyg[..., None] - wh[None, None, :, 1] / 2) / ih,
+        (cxg[..., None] + wh[None, None, :, 0] / 2) / iw,
+        (cyg[..., None] + wh[None, None, :, 1] / 2) / ih,
+    ], axis=-1)  # [fh, fw, P, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), out.shape)
+    return {"Boxes": out, "Variances": var}
+
+
+@register_op("yolo_box", grad=None)
+def yolo_box(ins, attrs, ctx):
+    """reference: detection/yolo_box_op.cc."""
+    x, img_size = ins["X"][0], ins["ImgSize"][0]
+    anchors = [int(a) for a in attrs["anchors"]]
+    class_num = int(attrs["class_num"])
+    conf_thresh = attrs.get("conf_thresh", 0.01)
+    downsample = int(attrs.get("downsample_ratio", 32))
+    n, c, h, w = x.shape
+    an_num = len(anchors) // 2
+    x = x.reshape(n, an_num, 5 + class_num, h, w)
+    grid_x = jnp.arange(w).reshape(1, 1, 1, w)
+    grid_y = jnp.arange(h).reshape(1, 1, h, 1)
+    import jax
+
+    bx = (jax.nn.sigmoid(x[:, :, 0]) + grid_x) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) + grid_y) / h
+    aw = jnp.asarray(anchors[0::2]).reshape(1, an_num, 1, 1)
+    ah = jnp.asarray(anchors[1::2]).reshape(1, an_num, 1, 1)
+    input_size = downsample * h
+    bw = jnp.exp(x[:, :, 2]) * aw / input_size
+    bh = jnp.exp(x[:, :, 3]) * ah / input_size
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    img_h = img_size[:, 0].reshape(n, 1, 1, 1).astype(x.dtype)
+    img_w = img_size[:, 1].reshape(n, 1, 1, 1).astype(x.dtype)
+    boxes = jnp.stack([
+        (bx - bw / 2) * img_w, (by - bh / 2) * img_h,
+        (bx + bw / 2) * img_w, (by + bh / 2) * img_h,
+    ], axis=-1)
+    keep = (conf > conf_thresh)[..., None]
+    boxes = jnp.where(keep, boxes, 0.0).reshape(n, -1, 4)
+    scores = jnp.where(conf[..., None] > conf_thresh,
+                       probs.transpose(0, 1, 3, 4, 2), 0.0).reshape(n, -1, class_num)
+    return {"Boxes": boxes, "Scores": scores}
+
+
+@register_op("roi_align")
+def roi_align(ins, attrs, ctx):
+    """reference: detection/roi_align_op.cc — bilinear-sampled ROI pooling."""
+    import jax
+
+    x, rois = ins["X"][0], ins["ROIs"][0]  # x: [N,C,H,W], rois: [R,4]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = attrs.get("spatial_scale", 1.0)
+    ratio = int(attrs.get("sampling_ratio", -1))
+    if ratio <= 0:
+        ratio = 2
+    n, c, h, w = x.shape
+
+    def one_roi(roi):
+        x1, y1, x2, y2 = roi * scale
+        rh = jnp.maximum(y2 - y1, 1.0)
+        rw = jnp.maximum(x2 - x1, 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        ys = y1 + (jnp.arange(ph * ratio) + 0.5) * bin_h / ratio
+        xs = x1 + (jnp.arange(pw * ratio) + 0.5) * bin_w / ratio
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = ys - jnp.floor(ys)
+        wx = xs - jnp.floor(xs)
+        # feat: [C, ph*ratio, pw*ratio] bilinear
+        f = (x[0, :, y0][:, :, x0] * ((1 - wy)[None, :, None] * (1 - wx)[None, None, :])
+             + x[0, :, y1i][:, :, x0] * (wy[None, :, None] * (1 - wx)[None, None, :])
+             + x[0, :, y0][:, :, x1i] * ((1 - wy)[None, :, None] * wx[None, None, :])
+             + x[0, :, y1i][:, :, x1i] * (wy[None, :, None] * wx[None, None, :]))
+        return jnp.mean(f.reshape(c, ph, ratio, pw, ratio), axis=(2, 4))
+
+    out = jax.vmap(one_roi)(rois)
+    return {"Out": out}
+
+
+@register_op("box_clip", grad=None)
+def box_clip(ins, attrs, ctx):
+    boxes, im_info = ins["Input"][0], ins["ImInfo"][0]
+    h = im_info[0, 0] - 1
+    w = im_info[0, 1] - 1
+    return {"Output": jnp.stack([
+        jnp.clip(boxes[..., 0], 0, w), jnp.clip(boxes[..., 1], 0, h),
+        jnp.clip(boxes[..., 2], 0, w), jnp.clip(boxes[..., 3], 0, h)], axis=-1)}

@@ -1,0 +1,176 @@
+"""Math ops: elementwise (with the reference's axis-broadcast semantics),
+matmul family, sum/scale.
+
+Reference: paddle/fluid/operators/elementwise/ (16 ops), matmul_op.cc,
+mul_op.cc, sum_op.cc, scale_op.cc; BLAS dispatch operators/math/blas.h —
+on TPU jnp.matmul lowers straight to MXU dots.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+def _bcast(x, y, axis: int):
+    """Reference broadcast (elementwise_op_function.h): align y's dims to x
+    starting at `axis` (axis=-1 → trailing alignment)."""
+    if x.shape == y.shape:
+        return x, y
+    if axis == -1 or y.ndim == 0:
+        return x, y
+    # pad y's shape with trailing 1s so it aligns at `axis`
+    new_shape = [1] * x.ndim
+    for i, s in enumerate(y.shape):
+        new_shape[axis + i] = s
+    return x, y.reshape(new_shape)
+
+
+def _ew(fn):
+    def kernel(ins, attrs, ctx):
+        x, y = ins["X"][0], ins["Y"][0]
+        x, y = _bcast(x, y, int(attrs.get("axis", -1)))
+        return {"Out": fn(x, y)}
+
+    return kernel
+
+
+register_op("elementwise_add")(_ew(jnp.add))
+register_op("elementwise_sub")(_ew(jnp.subtract))
+register_op("elementwise_mul")(_ew(jnp.multiply))
+register_op("elementwise_div")(_ew(jnp.divide))
+register_op("elementwise_max")(_ew(jnp.maximum))
+register_op("elementwise_min")(_ew(jnp.minimum))
+register_op("elementwise_pow")(_ew(jnp.power))
+register_op("elementwise_mod", grad=None)(_ew(jnp.mod))
+register_op("elementwise_floordiv", grad=None)(_ew(jnp.floor_divide))
+
+
+@register_op("sum")
+def sum_op(ins, attrs, ctx):
+    """Multi-input add (reference: operators/sum_op.cc) — the grad
+    accumulator emitted by backward.py."""
+    xs = [x for x in ins["X"] if x is not None]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": out}
+
+
+@register_op("scale")
+def scale(ins, attrs, ctx):
+    x = ins["X"][0]
+    s = attrs.get("scale", 1.0)
+    if ins.get("ScaleTensor") and ins["ScaleTensor"][0] is not None:
+        s = ins["ScaleTensor"][0]
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        out = x * jnp.asarray(s, x.dtype) + jnp.asarray(b, x.dtype)
+    else:
+        out = (x + jnp.asarray(b, x.dtype)) * jnp.asarray(s, x.dtype)
+    return {"Out": out}
+
+
+@register_op("mul")
+def mul(ins, attrs, ctx):
+    """reference: operators/mul_op.cc — flatten X to 2D at x_num_col_dims,
+    Y at y_num_col_dims, then GEMM (the `fc` workhorse → MXU)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xnc = int(attrs.get("x_num_col_dims", 1))
+    ync = int(attrs.get("y_num_col_dims", 1))
+    xm = x.reshape((int(np.prod(x.shape[:xnc])), -1))
+    ym = y.reshape((int(np.prod(y.shape[:ync])), -1))
+    out = xm @ ym
+    out_shape = x.shape[:xnc] + y.shape[ync:]
+    return {"Out": out.reshape(out_shape)}
+
+
+@register_op("matmul")
+def matmul(ins, attrs, ctx):
+    """reference: operators/matmul_op.cc (transpose_X/Y, alpha; batched via
+    cublas strided-batch — here one MXU dot_general)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    tx, ty = attrs.get("transpose_X", False), attrs.get("transpose_Y", False)
+    alpha = attrs.get("alpha", 1.0)
+    if x.ndim == 1:
+        x = x[None, :] if not tx else x[:, None]
+    if tx:
+        x = jnp.swapaxes(x, -1, -2)
+    if ty and y.ndim > 1:
+        y = jnp.swapaxes(y, -1, -2)
+    out = jnp.matmul(x, y)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, out.dtype)
+    return {"Out": out}
+
+
+@register_op("matmul_v2")
+def matmul_v2(ins, attrs, ctx):
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("trans_x", False):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("trans_y", False):
+        y = jnp.swapaxes(y, -1, -2)
+    return {"Out": jnp.matmul(x, y)}
+
+
+@register_op("bmm")
+def bmm(ins, attrs, ctx):
+    return {"Out": jnp.matmul(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("dot")
+def dot(ins, attrs, ctx):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": jnp.sum(x * y, axis=-1, keepdims=True)}
+
+
+@register_op("addmm")
+def addmm(ins, attrs, ctx):
+    inp, x, y = ins["Input"][0], ins["X"][0], ins["Y"][0]
+    return {"Out": attrs.get("Beta", 1.0) * inp + attrs.get("Alpha", 1.0) * (x @ y)}
+
+
+@register_op("kron")
+def kron(ins, attrs, ctx):
+    return {"Out": jnp.kron(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("trace")
+def trace_op(ins, attrs, ctx):
+    x = ins["Input"][0]
+    return {"Out": jnp.trace(x, offset=int(attrs.get("offset", 0)),
+                             axis1=int(attrs.get("axis1", 0)),
+                             axis2=int(attrs.get("axis2", 1)))}
+
+
+@register_op("cholesky")
+def cholesky(ins, attrs, ctx):
+    x = ins["X"][0]
+    if attrs.get("upper", False):
+        return {"Out": jnp.swapaxes(jnp.linalg.cholesky(x), -1, -2)}
+    return {"Out": jnp.linalg.cholesky(x)}
+
+
+@register_op("inverse")
+def inverse(ins, attrs, ctx):
+    return {"Out": jnp.linalg.inv(ins["Input"][0])}
+
+
+@register_op("max", grad="generic")
+def max_op(ins, attrs, ctx):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": jnp.maximum(x, y)}
+
+
+@register_op("maximum")
+def maximum(ins, attrs, ctx):
+    return {"Out": jnp.maximum(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("minimum")
+def minimum(ins, attrs, ctx):
+    return {"Out": jnp.minimum(ins["X"][0], ins["Y"][0])}

@@ -1,0 +1,69 @@
+"""Metric ops (reference: paddle/fluid/operators/metrics/: accuracy_op.cc,
+auc_op.cc, precision_recall_op.cc)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("accuracy", grad=None)
+def accuracy(ins, attrs, ctx):
+    """reference: metrics/accuracy_op.cc — Out: topk values, Indices: topk
+    indices, Label: [N,1] int64."""
+    indices, label = ins["Indices"][0], ins["Label"][0]
+    if label.ndim == indices.ndim:
+        lbl = label
+    else:
+        lbl = label[:, None]
+    correct = jnp.any(indices == lbl.astype(indices.dtype), axis=-1)
+    num_correct = jnp.sum(correct.astype(jnp.float32))
+    total = jnp.asarray(indices.shape[0], jnp.float32)
+    return {
+        "Accuracy": (num_correct / total).reshape(1),
+        "Correct": num_correct.astype(jnp.int32).reshape(1),
+        "Total": total.astype(jnp.int32).reshape(1),
+    }
+
+
+@register_op("auc", grad=None)
+def auc(ins, attrs, ctx):
+    """reference: metrics/auc_op.cc — streaming AUC with bucketed positive/
+    negative histograms carried as state tensors."""
+    predict, label = ins["Predict"][0], ins["Label"][0]
+    stat_pos, stat_neg = ins["StatPos"][0], ins["StatNeg"][0]
+    num_thresholds = int(attrs.get("num_thresholds", 4095))
+    pos_score = predict[:, 1] if predict.ndim == 2 and predict.shape[1] == 2 else predict.reshape(-1)
+    lbl = label.reshape(-1).astype(jnp.float32)
+    bucket = jnp.clip((pos_score * num_thresholds).astype(jnp.int32), 0, num_thresholds)
+    pos_new = stat_pos.at[bucket].add(lbl)
+    neg_new = stat_neg.at[bucket].add(1.0 - lbl)
+    # trapezoid integration over buckets (descending threshold)
+    tp = jnp.cumsum(pos_new[::-1])
+    fp = jnp.cumsum(neg_new[::-1])
+    tot_pos = tp[-1]
+    tot_neg = fp[-1]
+    tp0 = jnp.concatenate([jnp.zeros(1, tp.dtype), tp[:-1]])
+    fp0 = jnp.concatenate([jnp.zeros(1, fp.dtype), fp[:-1]])
+    area = jnp.sum((fp - fp0) * (tp + tp0) / 2.0)
+    auc_val = jnp.where(tot_pos * tot_neg > 0, area / (tot_pos * tot_neg + 1e-12), 0.0)
+    return {"AUC": auc_val.reshape(1), "StatPosOut": pos_new, "StatNegOut": neg_new}
+
+
+@register_op("precision_recall", grad=None)
+def precision_recall(ins, attrs, ctx):
+    pred, label = ins["MaxProbs"][0], ins["Labels"][0]
+    idx = ins["Indices"][0].reshape(-1)
+    lbl = label.reshape(-1).astype(idx.dtype)
+    cls = int(attrs.get("class_number", 2))
+    tp = jnp.zeros(cls).at[idx].add((idx == lbl).astype(jnp.float32))
+    fp = jnp.zeros(cls).at[idx].add((idx != lbl).astype(jnp.float32))
+    fn = jnp.zeros(cls).at[lbl].add((idx != lbl).astype(jnp.float32))
+    precision = tp / jnp.maximum(tp + fp, 1.0)
+    recall = tp / jnp.maximum(tp + fn, 1.0)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-6)
+    macro = jnp.stack([jnp.mean(precision), jnp.mean(recall), jnp.mean(f1)])
+    return {"BatchMetrics": macro, "AccumMetrics": macro,
+            "AccumStatesInfo": jnp.stack([tp, fp, fn], axis=1)}

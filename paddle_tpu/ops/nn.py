@@ -1,0 +1,538 @@
+"""NN ops: conv/pool/norm/dropout/softmax/losses.
+
+Reference: paddle/fluid/operators/{conv_op.cc,conv_cudnn_op.cu.cc,
+pool_op.cc,batch_norm_op.cc,layer_norm_op.cc,dropout_op.cc,softmax_op.cc,
+cross_entropy_op.cc,softmax_with_cross_entropy_op.cc,...}. The cuDNN
+dispatch (`use_cudnn` attr) has no TPU meaning: XLA lowers conv/matmul onto
+the MXU directly, so the attr is accepted and ignored.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# Convolutions (NCHW like the reference; lax conv handles layout for TPU)
+# ---------------------------------------------------------------------------
+
+
+def _conv_padding(attrs, spatial_rank, strides, x_spatial, k_spatial, dilations):
+    algo = attrs.get("padding_algorithm", "EXPLICIT")
+    if algo == "SAME":
+        return "SAME"
+    if algo == "VALID":
+        return "VALID"
+    pads = [int(p) for p in attrs.get("paddings", [0] * spatial_rank)]
+    if len(pads) == spatial_rank:
+        return [(p, p) for p in pads]
+    # [before0, after0, before1, after1, ...]
+    return [(pads[2 * i], pads[2 * i + 1]) for i in range(spatial_rank)]
+
+
+def _conv_nd(x, w, attrs, nd, feature_group_count=None):
+    strides = tuple(int(s) for s in attrs.get("strides", [1] * nd))
+    dilations = tuple(int(d) for d in attrs.get("dilations", [1] * nd))
+    groups = int(attrs.get("groups", 1)) if feature_group_count is None else feature_group_count
+    padding = _conv_padding(attrs, nd, strides, x.shape[2:], w.shape[2:], dilations)
+    dn_str = ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCDHW", "OIDHW", "NCDHW")
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, dn_str)
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        rhs_dilation=dilations, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+    ).astype(x.dtype)
+
+
+@register_op("conv2d", nondiff_inputs=())
+def conv2d(ins, attrs, ctx):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    out = _conv_nd(x, w, attrs, 2)
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
+    return {"Output": out}
+
+
+@register_op("depthwise_conv2d")
+def depthwise_conv2d(ins, attrs, ctx):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    # reference: groups == in_channels; lax expects OIHW with I = C/groups = 1
+    out = _conv_nd(x, w, attrs, 2, feature_group_count=x.shape[1])
+    return {"Output": out}
+
+
+@register_op("conv3d")
+def conv3d(ins, attrs, ctx):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    return {"Output": _conv_nd(x, w, attrs, 3)}
+
+
+@register_op("conv2d_transpose")
+def conv2d_transpose(ins, attrs, ctx):
+    x, w = ins["Input"][0], ins["Filter"][0]  # w: [C_in, C_out/groups, H, W]
+    strides = tuple(int(s) for s in attrs.get("strides", [1, 1]))
+    dilations = tuple(int(d) for d in attrs.get("dilations", [1, 1]))
+    pads = attrs.get("paddings", [0, 0])
+    if len(pads) == 2:
+        padding = [(p, p) for p in pads]
+    else:
+        padding = [(pads[0], pads[1]), (pads[2], pads[3])]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "IOHW", "NCHW"))
+    out = jax.lax.conv_transpose(
+        x, w, strides=strides, padding=padding,
+        rhs_dilation=dilations, dimension_numbers=dn, transpose_kernel=True)
+    return {"Output": out}
+
+
+# ---------------------------------------------------------------------------
+# Pooling (reference: operators/pool_op.cc; math/pooling.{cc,cu})
+# ---------------------------------------------------------------------------
+
+
+def _pool2d(x, attrs):
+    ptype = attrs.get("pooling_type", "max")
+    ksize = [int(k) for k in attrs.get("ksize", [2, 2])]
+    strides = [int(s) for s in attrs.get("strides", ksize)]
+    pads = [int(p) for p in attrs.get("paddings", [0, 0])]
+    if attrs.get("global_pooling", False) or attrs.get("adaptive", False) and all(
+            k == 1 for k in ksize):
+        if ptype == "max":
+            return jnp.max(x, axis=(2, 3), keepdims=True)
+        return jnp.mean(x, axis=(2, 3), keepdims=True)
+    if attrs.get("adaptive", False):
+        n, c, h, w = x.shape
+        oh, ow = ksize
+        assert h % oh == 0 and w % ow == 0, "adaptive pool needs divisible dims"
+        xr = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        return jnp.max(xr, axis=(3, 5)) if ptype == "max" else jnp.mean(xr, axis=(3, 5))
+
+    window = (1, 1, ksize[0], ksize[1])
+    strides_ = (1, 1, strides[0], strides[1])
+    if len(pads) == 2:
+        padding = [(0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1])]
+    else:
+        padding = [(0, 0), (0, 0), (pads[0], pads[1]), (pads[2], pads[3])]
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return jax.lax.reduce_window(x, init, jax.lax.max, window, strides_, padding)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_, padding)
+    if attrs.get("exclusive", True) and any(p != (0, 0) for p in padding):
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides_, padding)
+        return s / counts
+    return s / (ksize[0] * ksize[1])
+
+
+@register_op("pool2d")
+def pool2d(ins, attrs, ctx):
+    return {"Out": _pool2d(ins["X"][0], attrs)}
+
+
+@register_op("pool3d")
+def pool3d(ins, attrs, ctx):
+    x = ins["X"][0]
+    ptype = attrs.get("pooling_type", "max")
+    if attrs.get("global_pooling", False):
+        fn = jnp.max if ptype == "max" else jnp.mean
+        return {"Out": fn(x, axis=(2, 3, 4), keepdims=True)}
+    ksize = [int(k) for k in attrs.get("ksize", [2, 2, 2])]
+    strides = [int(s) for s in attrs.get("strides", ksize)]
+    pads = [int(p) for p in attrs.get("paddings", [0, 0, 0])]
+    window = (1, 1) + tuple(ksize)
+    strides_ = (1, 1) + tuple(strides)
+    padding = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    if ptype == "max":
+        return {"Out": jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides_, padding)}
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides_, padding)
+    return {"Out": s / float(np.prod(ksize))}
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+
+@register_op("batch_norm", nondiff_inputs=("Mean", "Variance"),
+             intermediate_outputs=("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"))
+def batch_norm(ins, attrs, ctx):
+    """reference: operators/batch_norm_op.cc.
+
+    NOTE (TPU semantics): under data-parallel GSPMD sharding the batch
+    reductions below become *global* (cross-replica) reductions — i.e. this
+    is automatically sync-BN (reference needs BuildStrategy.sync_batch_norm +
+    sync_batch_norm_op.cu).
+    """
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = bool(attrs.get("is_test", False)) or ctx.is_test
+    use_global = bool(attrs.get("use_global_stats", False)) or is_test
+    layout = attrs.get("data_layout", "NCHW")
+    axes = tuple(i for i in range(x.ndim) if i != (1 if layout == "NCHW" else x.ndim - 1))
+    ch_shape = [1] * x.ndim
+    ch_shape[1 if layout == "NCHW" else -1] = x.shape[1 if layout == "NCHW" else -1]
+
+    if use_global:
+        m, v = mean, var
+        y = (x - m.reshape(ch_shape)) * (scale.reshape(ch_shape) *
+             jax.lax.rsqrt(v.reshape(ch_shape) + eps)) + bias.reshape(ch_shape)
+        return {"Y": y, "MeanOut": mean, "VarianceOut": var,
+                "SavedMean": mean, "SavedVariance": var}
+
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=axes)
+    v = jnp.mean(jnp.square(xf), axis=axes) - jnp.square(m)
+    y = (xf - m.reshape(ch_shape)) * jax.lax.rsqrt(v.reshape(ch_shape) + eps)
+    y = y.astype(x.dtype) * scale.reshape(ch_shape) + bias.reshape(ch_shape)
+    new_mean = mean * momentum + m * (1.0 - momentum)
+    new_var = var * momentum + v * (1.0 - momentum)
+    return {"Y": y, "MeanOut": new_mean, "VarianceOut": new_var,
+            "SavedMean": m, "SavedVariance": jax.lax.rsqrt(v + eps)}
+
+
+@register_op("sync_batch_norm", nondiff_inputs=("Mean", "Variance"),
+             intermediate_outputs=("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"))
+def sync_batch_norm(ins, attrs, ctx):
+    # identical to batch_norm: GSPMD makes batch reductions global
+    return batch_norm(ins, attrs, ctx)
+
+
+@register_op("layer_norm", intermediate_outputs=("Mean", "Variance"))
+def layer_norm(ins, attrs, ctx):
+    """reference: operators/layer_norm_op.cc (begin_norm_axis flattening)."""
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    bna = int(attrs.get("begin_norm_axis", 1))
+    axes = tuple(range(bna, x.ndim))
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=axes, keepdims=True)
+    v = jnp.mean(jnp.square(xf - m), axis=axes, keepdims=True)
+    y = (xf - m) * jax.lax.rsqrt(v + eps)
+    y = y.astype(x.dtype)
+    norm_shape = x.shape[bna:]
+    if ins.get("Scale") and ins["Scale"][0] is not None:
+        y = y * ins["Scale"][0].reshape(norm_shape)
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        y = y + ins["Bias"][0].reshape(norm_shape)
+    return {"Y": y, "Mean": m.reshape(x.shape[:bna]), "Variance": v.reshape(x.shape[:bna])}
+
+
+@register_op("group_norm", intermediate_outputs=("Mean", "Variance"))
+def group_norm(ins, attrs, ctx):
+    x = ins["X"][0]  # NCHW
+    groups = int(attrs["groups"])
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, groups, c // groups) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    m = jnp.mean(xg, axis=axes, keepdims=True)
+    v = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - m) * jax.lax.rsqrt(v + eps)).reshape(x.shape)
+    ch = [1, c] + [1] * (x.ndim - 2)
+    if ins.get("Scale") and ins["Scale"][0] is not None:
+        y = y * ins["Scale"][0].reshape(ch)
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        y = y + ins["Bias"][0].reshape(ch)
+    return {"Y": y, "Mean": m.reshape(n, groups), "Variance": v.reshape(n, groups)}
+
+
+@register_op("instance_norm", intermediate_outputs=("SavedMean", "SavedVariance"))
+def instance_norm(ins, attrs, ctx):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(2, x.ndim))
+    m = jnp.mean(x, axis=axes, keepdims=True)
+    v = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - m) * jax.lax.rsqrt(v + eps)
+    ch = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    if ins.get("Scale") and ins["Scale"][0] is not None:
+        y = y * ins["Scale"][0].reshape(ch)
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        y = y + ins["Bias"][0].reshape(ch)
+    return {"Y": y, "SavedMean": jnp.squeeze(m), "SavedVariance": jnp.squeeze(v)}
+
+
+@register_op("l2_normalize")
+def l2_normalize(ins, attrs, ctx):
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", -1))
+    eps = attrs.get("epsilon", 1e-10)
+    return {"Out": x * jax.lax.rsqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)}
+
+
+# ---------------------------------------------------------------------------
+# Dropout / softmax
+# ---------------------------------------------------------------------------
+
+
+@register_op("dropout", is_random=True, intermediate_outputs=("Mask",))
+def dropout(ins, attrs, ctx):
+    """reference: operators/dropout_op.cc (upscale_in_train vs
+    downgrade_in_infer implementations)."""
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    is_test = bool(attrs.get("is_test", False)) or ctx.is_test
+    if is_test or p == 0.0:
+        out = x if impl == "upscale_in_train" else x * (1.0 - p)
+        return {"Out": out, "Mask": jnp.ones_like(x, dtype=jnp.uint8)}
+    keep = jax.random.bernoulli(ctx.rng(), 1.0 - p, x.shape)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    else:
+        out = jnp.where(keep, x, 0.0).astype(x.dtype)
+    return {"Out": out, "Mask": keep.astype(jnp.uint8)}
+
+
+@register_op("softmax")
+def softmax(ins, attrs, ctx):
+    x = ins["X"][0]
+    axis = int(attrs.get("axis", -1))
+    return {"Out": jax.nn.softmax(x, axis=axis)}
+
+
+@register_op("log_softmax")
+def log_softmax(ins, attrs, ctx):
+    x = ins["X"][0]
+    return {"Out": jax.nn.log_softmax(x, axis=int(attrs.get("axis", -1)))}
+
+
+# ---------------------------------------------------------------------------
+# Losses
+# ---------------------------------------------------------------------------
+
+
+@register_op("cross_entropy", nondiff_inputs=("Label",))
+def cross_entropy(ins, attrs, ctx):
+    """reference: operators/cross_entropy_op.cc — X is a probability
+    distribution; hard or soft labels."""
+    x, label = ins["X"][0], ins["Label"][0]
+    ignore_index = int(attrs.get("ignore_index", -100))
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(jnp.maximum(x, 1e-20)), axis=-1, keepdims=True)
+        return {"Y": loss}
+    idx = label.astype(jnp.int32)
+    if idx.ndim == x.ndim and idx.shape[-1] == 1:
+        idx = idx[..., 0]
+    picked = jnp.take_along_axis(x, idx[..., None], axis=-1)
+    loss = -jnp.log(jnp.maximum(picked, 1e-20))
+    if ignore_index != -100:
+        loss = jnp.where(idx[..., None] == ignore_index, 0.0, loss)
+    return {"Y": loss}
+
+
+@register_op("softmax_with_cross_entropy", nondiff_inputs=("Label",),
+             intermediate_outputs=("Softmax",))
+def softmax_with_cross_entropy(ins, attrs, ctx):
+    """reference: operators/softmax_with_cross_entropy_op.cc — numerically
+    stable fused version (the BERT/Transformer loss)."""
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    axis = int(attrs.get("axis", -1))
+    lse = jax.scipy.special.logsumexp(logits, axis=axis, keepdims=True)
+    log_probs = logits - lse
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * log_probs, axis=axis, keepdims=True)
+    else:
+        idx = label.astype(jnp.int32)
+        if idx.ndim == logits.ndim and idx.shape[axis] == 1:
+            idx = jnp.squeeze(idx, axis)
+        picked = jnp.take_along_axis(log_probs, jnp.expand_dims(idx, axis), axis=axis)
+        loss = -picked
+        ignore_index = int(attrs.get("ignore_index", -100))
+        if ignore_index >= 0:
+            loss = jnp.where(jnp.expand_dims(idx, axis) == ignore_index, 0.0, loss)
+    return {"Loss": loss, "Softmax": jnp.exp(log_probs)}
+
+
+@register_op("sigmoid_cross_entropy_with_logits", nondiff_inputs=("Label",))
+def sigmoid_cross_entropy_with_logits(ins, attrs, ctx):
+    x, label = ins["X"][0], ins["Label"][0]
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore_index = int(attrs.get("ignore_index", -100))
+    if ignore_index != -100:
+        loss = jnp.where(label == ignore_index, 0.0, loss)
+    if attrs.get("normalize", False):
+        n = jnp.maximum(jnp.sum(label != ignore_index), 1.0)
+        loss = loss / n
+    return {"Out": loss}
+
+
+@register_op("square_error_cost", nondiff_inputs=())
+def square_error_cost(ins, attrs, ctx):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": jnp.square(x - y)}
+
+
+@register_op("smooth_l1_loss", nondiff_inputs=("InsideWeight", "OutsideWeight"),
+             intermediate_outputs=("Diff",))
+def smooth_l1_loss(ins, attrs, ctx):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma = attrs.get("sigma", 1.0)
+    sigma2 = sigma * sigma
+    diff = x - y
+    if ins.get("InsideWeight") and ins["InsideWeight"][0] is not None:
+        diff = diff * ins["InsideWeight"][0]
+    abs_diff = jnp.abs(diff)
+    loss = jnp.where(abs_diff < 1.0 / sigma2,
+                     0.5 * sigma2 * jnp.square(diff),
+                     abs_diff - 0.5 / sigma2)
+    if ins.get("OutsideWeight") and ins["OutsideWeight"][0] is not None:
+        loss = loss * ins["OutsideWeight"][0]
+    return {"Out": jnp.sum(loss, axis=tuple(range(1, loss.ndim)), keepdims=False)[..., None],
+            "Diff": diff}
+
+
+@register_op("huber_loss", intermediate_outputs=("Residual",))
+def huber_loss(ins, attrs, ctx):
+    x, y = ins["X"][0], ins["Y"][0]
+    delta = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * jnp.square(r), delta * (ar - 0.5 * delta))
+    return {"Out": loss, "Residual": r}
+
+
+@register_op("kldiv_loss", nondiff_inputs=("Target",))
+def kldiv_loss(ins, attrs, ctx):
+    x, t = ins["X"][0], ins["Target"][0]
+    loss = t * (jnp.log(jnp.maximum(t, 1e-20)) - x)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        return {"Loss": jnp.mean(loss)}
+    if red == "sum":
+        return {"Loss": jnp.sum(loss)}
+    if red == "batchmean":
+        return {"Loss": jnp.sum(loss) / x.shape[0]}
+    return {"Loss": loss}
+
+
+@register_op("bce_loss", nondiff_inputs=("Label",))
+def bce_loss(ins, attrs, ctx):
+    x, label = ins["X"][0], ins["Label"][0]
+    return {"Out": -(label * jnp.log(jnp.maximum(x, 1e-12))
+                     + (1 - label) * jnp.log(jnp.maximum(1 - x, 1e-12)))}
+
+
+@register_op("margin_rank_loss", nondiff_inputs=("Label",),
+             intermediate_outputs=("Activated",))
+def margin_rank_loss(ins, attrs, ctx):
+    x1, x2, label = ins["X1"][0], ins["X2"][0], ins["Label"][0]
+    margin = attrs.get("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": out, "Activated": (out > 0).astype(x1.dtype)}
+
+
+@register_op("hinge_loss", nondiff_inputs=("Labels",))
+def hinge_loss(ins, attrs, ctx):
+    logits, labels = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": jnp.maximum(0.0, 1.0 - (2.0 * labels - 1.0) * logits)}
+
+
+# ---------------------------------------------------------------------------
+# Interpolation / resampling
+# ---------------------------------------------------------------------------
+
+
+def _interp(ins, attrs, method):
+    x = ins["X"][0]  # NCHW
+    n, c, h, w = x.shape
+    if attrs.get("out_h", -1) > 0:
+        oh, ow = int(attrs["out_h"]), int(attrs["out_w"])
+    else:
+        scale = attrs.get("scale", 1.0)
+        oh, ow = int(h * scale), int(w * scale)
+    out = jax.image.resize(x, (n, c, oh, ow), method=method)
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("bilinear_interp")
+def bilinear_interp(ins, attrs, ctx):
+    return _interp(ins, attrs, "bilinear")
+
+
+@register_op("nearest_interp")
+def nearest_interp(ins, attrs, ctx):
+    return _interp(ins, attrs, "nearest")
+
+
+@register_op("grid_sampler")
+def grid_sampler(ins, attrs, ctx):
+    """reference: operators/grid_sampler_op.cc (cudnn spatial sampler) —
+    bilinear sampling from normalized [-1,1] grid coords."""
+    x, grid = ins["X"][0], ins["Grid"][0]  # x: NCHW, grid: NHW2
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+    gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+    x0 = jnp.floor(gx).astype(jnp.int32)
+    y0 = jnp.floor(gy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx1, wy1 = gx - x0, gy - y0
+    wx0, wy0 = 1.0 - wx1, 1.0 - wy1
+
+    def sample(yy, xx):
+        yy = jnp.clip(yy, 0, h - 1)
+        xx = jnp.clip(xx, 0, w - 1)
+        batch_idx = jnp.arange(n)[:, None, None]
+        return x[batch_idx, :, yy, xx]  # N,H',W',C
+
+    v00 = sample(y0, x0) * (wy0 * wx0)[..., None]
+    v01 = sample(y0, x1) * (wy0 * wx1)[..., None]
+    v10 = sample(y1, x0) * (wy1 * wx0)[..., None]
+    v11 = sample(y1, x1) * (wy1 * wx1)[..., None]
+    out = (v00 + v01 + v10 + v11).transpose(0, 3, 1, 2)
+    return {"Output": out.astype(x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# Misc NN
+# ---------------------------------------------------------------------------
+
+
+@register_op("pixel_shuffle")
+def pixel_shuffle(ins, attrs, ctx):
+    x = ins["X"][0]
+    r = int(attrs.get("upscale_factor", 1))
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3).reshape(n, c // (r * r), h * r, w * r)
+    return {"Out": out}
+
+
+@register_op("temporal_shift")
+def temporal_shift(ins, attrs, ctx):
+    x = ins["X"][0]
+    seg = int(attrs["seg_num"])
+    ratio = attrs.get("shift_ratio", 0.25)
+    nt, c, h, w = x.shape
+    n = nt // seg
+    xr = x.reshape(n, seg, c, h, w)
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    fwd = jnp.concatenate([xr[:, 1:, :c1], jnp.zeros_like(xr[:, :1, :c1])], axis=1)
+    back = jnp.concatenate([jnp.zeros_like(xr[:, :1, c1:c2]), xr[:, :-1, c1:c2]], axis=1)
+    rest = xr[:, :, c2:]
+    return {"Out": jnp.concatenate([fwd, back, rest], axis=2).reshape(nt, c, h, w)}
+
+
+@register_op("label_smooth", nondiff_inputs=("PriorDist",))
+def label_smooth(ins, attrs, ctx):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.0)
+    k = x.shape[-1]
+    if ins.get("PriorDist") and ins["PriorDist"][0] is not None:
+        return {"Out": (1 - eps) * x + eps * ins["PriorDist"][0]}
+    return {"Out": (1 - eps) * x + eps / k}
+
+
+@register_op("embedding_with_scaled_gradient", nondiff_inputs=("Ids",))
+def embedding_with_scaled_gradient(ins, attrs, ctx):
+    from .tensor import lookup_table_v2
+
+    return lookup_table_v2(ins, attrs, ctx)

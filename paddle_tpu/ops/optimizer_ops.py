@@ -1,0 +1,237 @@
+"""Optimizer ops — run inside the compiled step like the reference's
+graph-embedded optimizer ops (reference: paddle/fluid/operators/optimizers/:
+sgd_op.cc, momentum_op.cc, adam_op.cc, lamb_op.cc, lars_momentum_op.cc, ...).
+
+All are grad=None (no second-order through optimizer updates) and write
+Param/moments in place via the functional name-rebinding in lowering.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _lr(ins):
+    lr = ins["LearningRate"][0]
+    return lr.reshape(()) if getattr(lr, "ndim", 0) else lr
+
+
+@register_op("sgd", grad=None)
+def sgd(ins, attrs, ctx):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    return {"ParamOut": p - _lr(ins).astype(p.dtype) * g.astype(p.dtype)}
+
+
+@register_op("momentum", grad=None)
+def momentum(ins, attrs, ctx):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = attrs.get("mu", 0.9)
+    lr = _lr(ins).astype(p.dtype)
+    v_new = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    return {"ParamOut": p_new, "VelocityOut": v_new}
+
+
+@register_op("lars_momentum", grad=None)
+def lars_momentum(ins, attrs, ctx):
+    """reference: optimizers/lars_momentum_op.cc — layer-wise adaptive rate
+    scaling for large-batch training."""
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = attrs.get("mu", 0.9)
+    coeff = attrs.get("lars_coeff", 0.001)
+    wd = attrs.get("lars_weight_decay", 0.0005)
+    eps = attrs.get("epsilon", 0.0)
+    lr = _lr(ins).astype(p.dtype)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * coeff * p_norm / (g_norm + wd * p_norm + eps),
+        lr)
+    v_new = mu * v + local_lr * (g + wd * p)
+    return {"ParamOut": p - v_new, "VelocityOut": v_new}
+
+
+@register_op("adam", grad=None)
+def adam(ins, attrs, ctx):
+    """reference: optimizers/adam_op.cc (Beta1Pow/Beta2Pow threaded as 1-elem
+    tensors exactly like the reference)."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins).astype(jnp.float32)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    p_new = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
+    return {"ParamOut": p_new.astype(p.dtype), "Moment1Out": m1n, "Moment2Out": m2n,
+            "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+
+
+@register_op("adamw", grad=None)
+def adamw(ins, attrs, ctx):
+    outs = adam(ins, attrs, ctx)
+    wd = attrs.get("coeff", attrs.get("weight_decay", 0.01))
+    p = ins["Param"][0]
+    lr = _lr(ins).astype(p.dtype)
+    outs["ParamOut"] = outs["ParamOut"] - lr * wd * p
+    return outs
+
+
+@register_op("adamax", grad=None)
+def adamax(ins, attrs, ctx):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, u = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0]
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins).astype(p.dtype)
+    m_new = b1 * m + (1 - b1) * g
+    u_new = jnp.maximum(b2 * u, jnp.abs(g))
+    p_new = p - (lr / (1 - b1p.reshape(()))) * m_new / (u_new + eps)
+    return {"ParamOut": p_new, "MomentOut": m_new, "InfNormOut": u_new}
+
+
+@register_op("adagrad", grad=None)
+def adagrad(ins, attrs, ctx):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    eps = attrs.get("epsilon", 1e-6)
+    lr = _lr(ins).astype(p.dtype)
+    mom_new = mom + jnp.square(g)
+    return {"ParamOut": p - lr * g / (jnp.sqrt(mom_new) + eps), "MomentOut": mom_new}
+
+
+@register_op("decayed_adagrad", grad=None)
+def decayed_adagrad(ins, attrs, ctx):
+    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    lr = _lr(ins).astype(p.dtype)
+    mom_new = decay * mom + (1 - decay) * jnp.square(g)
+    return {"ParamOut": p - lr * g / (jnp.sqrt(mom_new) + eps), "MomentOut": mom_new}
+
+
+@register_op("adadelta", grad=None)
+def adadelta(ins, attrs, ctx):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq, avg_upd = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    avg_sq_new = rho * avg_sq + (1 - rho) * jnp.square(g)
+    upd = jnp.sqrt(avg_upd + eps) / jnp.sqrt(avg_sq_new + eps) * g
+    avg_upd_new = rho * avg_upd + (1 - rho) * jnp.square(upd)
+    return {"ParamOut": p - upd, "AvgSquaredGradOut": avg_sq_new,
+            "AvgSquaredUpdateOut": avg_upd_new}
+
+
+@register_op("rmsprop", grad=None)
+def rmsprop(ins, attrs, ctx):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mu = attrs.get("momentum", 0.0)
+    lr = _lr(ins).astype(p.dtype)
+    ms_new = rho * ms + (1 - rho) * jnp.square(g)
+    if attrs.get("centered", False):
+        mg = ins["MeanGrad"][0]
+        mg_new = rho * mg + (1 - rho) * g
+        mom_new = mu * mom + lr * g / jnp.sqrt(ms_new - jnp.square(mg_new) + eps)
+        return {"ParamOut": p - mom_new, "MeanSquareOut": ms_new,
+                "MomentOut": mom_new, "MeanGradOut": mg_new}
+    mom_new = mu * mom + lr * g / jnp.sqrt(ms_new + eps)
+    return {"ParamOut": p - mom_new, "MeanSquareOut": ms_new, "MomentOut": mom_new}
+
+
+@register_op("ftrl", grad=None)
+def ftrl(ins, attrs, ctx):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    lr_power = attrs.get("lr_power", -0.5)
+    lr = _lr(ins).astype(p.dtype)
+    new_sq = sq + jnp.square(g)
+    sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    quad = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    p_new = pre / quad
+    return {"ParamOut": p_new, "SquaredAccumOut": new_sq, "LinearAccumOut": new_lin}
+
+
+@register_op("lamb", grad=None)
+def lamb(ins, attrs, ctx):
+    """reference: optimizers/lamb_op.cc — layer-adaptive large-batch Adam."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    lr = _lr(ins).astype(jnp.float32)
+    m1n = b1 * m1 + (1 - b1) * g
+    m2n = b2 * m2 + (1 - b2) * jnp.square(g)
+    m1h = m1n / (1 - b1p.reshape(()))
+    m2h = m2n / (1 - b2p.reshape(()))
+    r = m1h / (jnp.sqrt(m2h) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    p_new = p - lr * trust * r
+    return {"ParamOut": p_new.astype(p.dtype), "Moment1Out": m1n, "Moment2Out": m2n,
+            "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
+
+
+@register_op("dpsgd", grad=None, is_random=True)
+def dpsgd(ins, attrs, ctx):
+    """reference: optimizers/dpsgd_op.cc — differentially-private SGD
+    (clip + gaussian noise)."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    clip = attrs.get("clip", 10.0)
+    batch_size = attrs.get("batch_size", 16.0)
+    sigma = attrs.get("sigma", 1.0)
+    lr = _lr(ins).astype(p.dtype)
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g = g / jnp.maximum(1.0, g_norm / clip)
+    noise = sigma * clip * jax.random.normal(ctx.rng(), g.shape, dtype=jnp.float32)
+    return {"ParamOut": p - lr * (g + noise.astype(p.dtype)) / batch_size}
+
+
+# -- DGC: deep gradient compression (reference: optimizers/dgc_momentum_op +
+# details/sparse_all_reduce_op_handle.cc:44; paper arxiv 1712.01887) --------
+
+
+@register_op("dgc_momentum", grad=None)
+def dgc_momentum(ins, attrs, ctx):
+    """Top-k sparsified momentum step. On TPU the sparse allgather of the
+    reference (sparseAllGReduce) is replaced by dense psum of the sparsified
+    (mostly-zero) gradient — GSPMD handles the collective; the compression
+    semantic (only top-k% of grads applied, rest accumulated locally) is
+    preserved via the U/V accumulators."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    u, v = ins["U"][0], ins["V"][0]
+    mu = attrs.get("mu", 0.9)
+    ratio = attrs.get("sparsity_ratio", 0.001)
+    lr = _lr(ins).astype(p.dtype)
+    u_new = mu * u + g
+    v_new = v + u_new
+    flat = jnp.abs(v_new).reshape(-1)
+    k = max(1, int(flat.size * ratio))
+    thresh = jax.lax.top_k(flat, k)[0][-1]
+    mask = jnp.abs(v_new) >= thresh
+    sparse_grad = jnp.where(mask, v_new, 0.0)
+    u_out = jnp.where(mask, 0.0, u_new)
+    v_out = jnp.where(mask, 0.0, v_new)
+    return {"ParamOut": p - lr * sparse_grad, "UOut": u_out, "VOut": v_out,
+            "GradOut": sparse_grad}

@@ -1,0 +1,61 @@
+"""Reduction ops (reference: paddle/fluid/operators/reduce_ops/ — shared
+reduce_op.h template over sum/mean/max/min/prod/all/any; same table here)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _axes(attrs, ndim):
+    if attrs.get("reduce_all", False):
+        return None
+    dim = attrs.get("dim", [0])
+    if isinstance(dim, int):
+        dim = [dim]
+    return tuple(d % ndim for d in dim)
+
+
+def _reduce(fn, differentiable=True):
+    def kernel(ins, attrs, ctx):
+        x = ins["X"][0]
+        axes = _axes(attrs, x.ndim)
+        keep = attrs.get("keep_dim", False)
+        return {"Out": fn(x, axis=axes, keepdims=keep)}
+
+    return kernel
+
+
+register_op("reduce_sum")(_reduce(jnp.sum))
+register_op("reduce_mean")(_reduce(jnp.mean))
+register_op("reduce_max")(_reduce(jnp.max))
+register_op("reduce_min")(_reduce(jnp.min))
+register_op("reduce_prod")(_reduce(jnp.prod))
+register_op("reduce_all", grad=None)(_reduce(jnp.all))
+register_op("reduce_any", grad=None)(_reduce(jnp.any))
+
+
+@register_op("logsumexp")
+def logsumexp(ins, attrs, ctx):
+    import jax
+
+    x = ins["X"][0]
+    axes = _axes(attrs, x.ndim)
+    keep = attrs.get("keep_dim", False)
+    return {"Out": jax.scipy.special.logsumexp(x, axis=axes, keepdims=keep)}
+
+
+@register_op("mean")
+def mean(ins, attrs, ctx):
+    """reference: operators/mean_op.cc — full mean to scalar [1]."""
+    x = ins["X"][0]
+    return {"Out": jnp.mean(x).reshape(1)}
+
+
+@register_op("frobenius_norm")
+def frobenius_norm(ins, attrs, ctx):
+    x = ins["X"][0]
+    axes = _axes(attrs, x.ndim)
+    keep = attrs.get("keep_dim", False)
+    return {"Out": jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=keep))}

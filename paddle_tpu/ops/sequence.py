@@ -1,0 +1,129 @@
+"""Sequence ops — mask/segment based.
+
+Reference: operators/sequence_ops/ (26 LoD-based ops, SURVEY.md §2.3). The
+reference's variable-length story is LoD offset tables (lod_tensor.h:215);
+XLA wants static shapes, so the TPU-native encoding is *padded batches +
+lengths/masks* (SURVEY §5 "Long-context"): a [N, T, ...] tensor plus a
+[N] lengths vector replaces a LoDTensor. Each op takes Length input instead
+of reading LoD metadata.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _mask(lengths, maxlen, dtype=jnp.float32):
+    return (jnp.arange(maxlen)[None, :] < lengths.reshape(-1, 1)).astype(dtype)
+
+
+@register_op("sequence_mask", grad=None, nondiff_inputs=("X",))
+def sequence_mask(ins, attrs, ctx):
+    """reference: sequence_ops/sequence_mask_op.cc."""
+    x = ins["X"][0]
+    maxlen = int(attrs.get("maxlen", -1))
+    if maxlen < 0:
+        maxlen = int(jnp.max(x))  # requires static value; prefer explicit maxlen
+    from ..core.ir import normalize_dtype
+    import numpy as np
+
+    dt = np.dtype(normalize_dtype(attrs.get("out_dtype", "int64")))
+    return {"Y": _mask(x, maxlen, dt)}
+
+
+@register_op("sequence_pool", nondiff_inputs=("Length",))
+def sequence_pool(ins, attrs, ctx):
+    """Masked pooling over the time axis of a padded [N,T,D] batch
+    (reference: sequence_ops/sequence_pool_op.cc over LoD)."""
+    x = ins["X"][0]
+    ptype = attrs.get("pooltype", "SUM").upper()
+    if ins.get("Length") and ins["Length"][0] is not None:
+        m = _mask(ins["Length"][0], x.shape[1], x.dtype)[..., None]
+    else:
+        m = jnp.ones(x.shape[:2], x.dtype)[..., None]
+    if ptype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(jnp.maximum(jnp.sum(m, axis=1), 1.0))
+    elif ptype == "MAX":
+        neg = jnp.asarray(jnp.finfo(x.dtype).min, x.dtype)
+        out = jnp.max(jnp.where(m > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        idx = jnp.maximum(jnp.sum(m[:, :, 0], axis=1).astype(jnp.int32) - 1, 0)
+        out = x[jnp.arange(x.shape[0]), idx]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unsupported pooltype {ptype}")
+    return {"Out": out, "MaxIndex": None}
+
+
+@register_op("sequence_softmax", nondiff_inputs=("Length",))
+def sequence_softmax(ins, attrs, ctx):
+    x = ins["X"][0]  # [N, T]
+    if ins.get("Length") and ins["Length"][0] is not None:
+        m = _mask(ins["Length"][0], x.shape[-1], x.dtype)
+        x = jnp.where(m > 0, x, jnp.asarray(-1e9, x.dtype))
+    return {"Out": jax.nn.softmax(x, axis=-1)}
+
+
+@register_op("sequence_reverse", nondiff_inputs=("Length",))
+def sequence_reverse(ins, attrs, ctx):
+    x = ins["X"][0]  # [N, T, ...]
+    if ins.get("Length") and ins["Length"][0] is not None:
+        lengths = ins["Length"][0]
+        t = x.shape[1]
+        idx = jnp.arange(t)[None, :]
+        rev = lengths.reshape(-1, 1) - 1 - idx
+        gather_idx = jnp.where(idx < lengths.reshape(-1, 1), rev, idx)
+        return {"Y": jnp.take_along_axis(
+            x, gather_idx.reshape(gather_idx.shape + (1,) * (x.ndim - 2)).astype(jnp.int32),
+            axis=1)}
+    return {"Y": jnp.flip(x, axis=1)}
+
+
+@register_op("sequence_expand", nondiff_inputs=("Y",))
+def sequence_expand(ins, attrs, ctx):
+    # padded-batch equivalent: broadcast x rows along a repeat count — with
+    # static shapes this is tile along axis 1
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    return {"Out": jnp.repeat(x, y.shape[1] // max(x.shape[1], 1), axis=1)
+            if x.ndim > 1 else x}
+
+
+@register_op("sequence_concat")
+def sequence_concat(ins, attrs, ctx):
+    xs = [x for x in ins["X"] if x is not None]
+    return {"Out": jnp.concatenate(xs, axis=1)}
+
+
+@register_op("sequence_slice")
+def sequence_slice(ins, attrs, ctx):
+    x, off, length = ins["X"][0], ins["Offset"][0], ins["Length"][0]
+    o = int(off.reshape(-1)[0])
+    l = int(length.reshape(-1)[0])
+    return {"Out": x[:, o:o + l]}
+
+
+@register_op("im2sequence")
+def im2sequence(ins, attrs, ctx):
+    """reference: im2sequence_op.cc — sliding-window patches to sequence
+    (OCR models). [N,C,H,W] -> [N, H'*W', C*kh*kw]."""
+    x = ins["X"][0]
+    kh, kw = [int(k) for k in attrs["kernels"]]
+    sh, sw = [int(s) for s in attrs.get("strides", [1, 1])]
+    pads = [int(p) for p in attrs.get("paddings", [0, 0, 0, 0])]
+    x = jnp.pad(x, [(0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])])
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, oh, ow] -> [N, oh*ow, C*kh*kw]
+    return {"Out": patches.reshape(n, c * kh * kw, oh * ow).transpose(0, 2, 1)}

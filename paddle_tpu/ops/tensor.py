@@ -1,0 +1,555 @@
+"""Tensor creation / manipulation ops.
+
+Reference: paddle/fluid/operators/{fill_constant,uniform_random,
+gaussian_random,cast,concat,split,stack,reshape,transpose,squeeze,unsqueeze,
+expand,slice,gather,scatter,assign,shape,one_hot,lookup_table,...}_op.cc
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.ir import normalize_dtype
+from ..core.registry import register_op
+
+
+def _dt(attrs, key="dtype", default="float32"):
+    return np.dtype(normalize_dtype(attrs.get(key, default)))
+
+
+def _x(ins, slot="X"):
+    return ins[slot][0]
+
+
+# ---------------------------------------------------------------------------
+# Creation
+# ---------------------------------------------------------------------------
+
+
+@register_op("fill_constant", grad=None)
+def fill_constant(ins, attrs, ctx):
+    shape = [int(s) for s in attrs.get("shape", [1])]
+    val = attrs.get("value", 0.0)
+    return {"Out": jnp.full(shape, val, dtype=_dt(attrs))}
+
+
+@register_op("fill_constant_batch_size_like", grad=None, nondiff_inputs=("Input",))
+def fill_constant_batch_size_like(ins, attrs, ctx):
+    ref = ins["Input"][0]
+    shape = [int(s) for s in attrs["shape"]]
+    in_idx = int(attrs.get("input_dim_idx", 0))
+    out_idx = int(attrs.get("output_dim_idx", 0))
+    shape[out_idx] = ref.shape[in_idx]
+    return {"Out": jnp.full(shape, attrs.get("value", 0.0), dtype=_dt(attrs))}
+
+
+@register_op("fill_zeros_like", grad=None, nondiff_inputs=("X",))
+def fill_zeros_like(ins, attrs, ctx):
+    x = _x(ins)
+    return {"Out": jnp.zeros_like(x)}
+
+
+@register_op("uniform_random", grad=None, is_random=True)
+def uniform_random(ins, attrs, ctx):
+    shape = [int(s) for s in attrs["shape"]]
+    lo, hi = attrs.get("min", -1.0), attrs.get("max", 1.0)
+    out = jax.random.uniform(ctx.rng(), shape, dtype=jnp.float32, minval=lo, maxval=hi)
+    return {"Out": out.astype(_dt(attrs))}
+
+
+@register_op("gaussian_random", grad=None, is_random=True)
+def gaussian_random(ins, attrs, ctx):
+    shape = [int(s) for s in attrs["shape"]]
+    mean, std = attrs.get("mean", 0.0), attrs.get("std", 1.0)
+    out = mean + std * jax.random.normal(ctx.rng(), shape, dtype=jnp.float32)
+    return {"Out": out.astype(_dt(attrs))}
+
+
+@register_op("truncated_gaussian_random", grad=None, is_random=True)
+def truncated_gaussian_random(ins, attrs, ctx):
+    shape = [int(s) for s in attrs["shape"]]
+    mean, std = attrs.get("mean", 0.0), attrs.get("std", 1.0)
+    out = mean + std * jax.random.truncated_normal(ctx.rng(), -2.0, 2.0, shape, jnp.float32)
+    return {"Out": out.astype(_dt(attrs))}
+
+
+@register_op("randint", grad=None, is_random=True)
+def randint(ins, attrs, ctx):
+    shape = [int(s) for s in attrs["shape"]]
+    out = jax.random.randint(ctx.rng(), shape, attrs.get("low", 0), attrs.get("high", 100))
+    return {"Out": out.astype(_dt(attrs, default="int64"))}
+
+
+@register_op("range", grad=None, nondiff_inputs=("Start", "End", "Step"))
+def range_op(ins, attrs, ctx):
+    start, end, step = ins["Start"][0], ins["End"][0], ins["Step"][0]
+    # static shapes: bounds must be trace-time constants
+    s, e, st = float(start), float(end), float(step)
+    return {"Out": jnp.arange(s, e, st, dtype=start.dtype)}
+
+
+@register_op("assign")
+def assign(ins, attrs, ctx):
+    return {"Out": _x(ins)}
+
+
+@register_op("assign_value", grad=None)
+def assign_value(ins, attrs, ctx):
+    shape = [int(s) for s in attrs["shape"]]
+    vals = attrs.get("fp32_values") or attrs.get("int32_values") or attrs.get("values")
+    return {"Out": jnp.asarray(vals, dtype=_dt(attrs)).reshape(shape)}
+
+
+@register_op("shape", grad=None, nondiff_inputs=("Input",))
+def shape_op(ins, attrs, ctx):
+    x = ins["Input"][0]
+    return {"Out": jnp.asarray(x.shape, dtype=jnp.int32)}
+
+
+@register_op("size", grad=None, nondiff_inputs=("Input",))
+def size_op(ins, attrs, ctx):
+    x = ins["Input"][0]
+    return {"Out": jnp.asarray(x.size, dtype=jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# Casting / copy
+# ---------------------------------------------------------------------------
+
+
+@register_op("cast")
+def cast(ins, attrs, ctx):
+    return {"Out": _x(ins).astype(_dt(attrs, "out_dtype"))}
+
+
+@register_op("increment", grad=None)
+def increment(ins, attrs, ctx):
+    x = _x(ins)
+    return {"Out": x + jnp.asarray(attrs.get("step", 1.0), x.dtype)}
+
+
+# ---------------------------------------------------------------------------
+# Shape manipulation
+# ---------------------------------------------------------------------------
+
+
+@register_op("reshape2", intermediate_outputs=("XShape",))
+def reshape2(ins, attrs, ctx):
+    x = _x(ins)
+    if ins.get("Shape") and ins["Shape"][0] is not None:
+        shape = [int(s) for s in np.asarray(ins["Shape"][0])]
+    else:
+        shape = [int(s) for s in attrs["shape"]]
+    # paddle semantics: 0 means copy the input dim at that position
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return {"Out": jnp.reshape(x, shape), "XShape": None}
+
+
+@register_op("reshape")
+def reshape(ins, attrs, ctx):
+    return {"Out": reshape2(ins, attrs, ctx)["Out"]}
+
+
+@register_op("transpose2", intermediate_outputs=("XShape",))
+def transpose2(ins, attrs, ctx):
+    x = _x(ins)
+    return {"Out": jnp.transpose(x, attrs["axis"]), "XShape": None}
+
+
+@register_op("transpose")
+def transpose(ins, attrs, ctx):
+    return {"Out": jnp.transpose(_x(ins), attrs["axis"])}
+
+
+@register_op("squeeze2", intermediate_outputs=("XShape",))
+def squeeze2(ins, attrs, ctx):
+    x = _x(ins)
+    axes = attrs.get("axes", [])
+    if not axes:
+        return {"Out": jnp.squeeze(x), "XShape": None}
+    return {"Out": jnp.squeeze(x, axis=tuple(int(a) for a in axes)), "XShape": None}
+
+
+@register_op("unsqueeze2", intermediate_outputs=("XShape",))
+def unsqueeze2(ins, attrs, ctx):
+    x = _x(ins)
+    for a in sorted(int(a) for a in attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return {"Out": x, "XShape": None}
+
+
+@register_op("squeeze")
+def squeeze(ins, attrs, ctx):
+    return {"Out": squeeze2(ins, attrs, ctx)["Out"]}
+
+
+@register_op("unsqueeze")
+def unsqueeze(ins, attrs, ctx):
+    return {"Out": unsqueeze2(ins, attrs, ctx)["Out"]}
+
+
+@register_op("flatten2", intermediate_outputs=("XShape",))
+def flatten2(ins, attrs, ctx):
+    x = _x(ins)
+    axis = int(attrs.get("axis", 1))
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return {"Out": jnp.reshape(x, (lead, -1)), "XShape": None}
+
+
+@register_op("flatten")
+def flatten(ins, attrs, ctx):
+    return {"Out": flatten2(ins, attrs, ctx)["Out"]}
+
+
+@register_op("concat")
+def concat(ins, attrs, ctx):
+    xs = [x for x in ins["X"] if x is not None]
+    return {"Out": jnp.concatenate(xs, axis=int(attrs.get("axis", 0)))}
+
+
+@register_op("split")
+def split(ins, attrs, ctx):
+    x = _x(ins)
+    axis = int(attrs.get("axis", 0))
+    sections = attrs.get("sections") or []
+    num = int(attrs.get("num", 0))
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack")
+def stack(ins, attrs, ctx):
+    xs = [x for x in ins["X"] if x is not None]
+    return {"Y": jnp.stack(xs, axis=int(attrs.get("axis", 0)))}
+
+
+@register_op("unstack")
+def unstack(ins, attrs, ctx):
+    x = _x(ins)
+    axis = int(attrs.get("axis", 0))
+    n = x.shape[axis]
+    return {"Y": [jnp.squeeze(s, axis) for s in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("expand")
+def expand(ins, attrs, ctx):
+    x = _x(ins)
+    times = [int(t) for t in attrs["expand_times"]]
+    return {"Out": jnp.tile(x, times)}
+
+
+@register_op("expand_as")
+def expand_as(ins, attrs, ctx):
+    x, target = ins["X"][0], ins["target_tensor"][0]
+    times = [t // s for t, s in zip(target.shape, x.shape)]
+    return {"Out": jnp.tile(x, times)}
+
+
+@register_op("tile")
+def tile(ins, attrs, ctx):
+    return {"Out": jnp.tile(_x(ins), [int(t) for t in attrs["repeat_times"]])}
+
+
+@register_op("slice")
+def slice_op(ins, attrs, ctx):
+    x = ins["Input"][0]
+    axes = [int(a) for a in attrs["axes"]]
+    starts = [int(s) for s in attrs["starts"]]
+    ends = [int(e) for e in attrs["ends"]]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    out = x[tuple(idx)]
+    if attrs.get("decrease_axis"):
+        out = jnp.squeeze(out, axis=tuple(int(a) for a in attrs["decrease_axis"]))
+    return {"Out": out}
+
+
+@register_op("strided_slice")
+def strided_slice(ins, attrs, ctx):
+    x = ins["Input"][0]
+    axes = [int(a) for a in attrs["axes"]]
+    starts, ends = [int(s) for s in attrs["starts"]], [int(e) for e in attrs["ends"]]
+    strides = [int(s) for s in attrs.get("strides", [1] * len(axes))]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(axes, starts, ends, strides):
+        idx[a] = slice(s, e, st)
+    return {"Out": x[tuple(idx)]}
+
+
+@register_op("reverse")
+def reverse(ins, attrs, ctx):
+    x = _x(ins)
+    return {"Out": jnp.flip(x, axis=tuple(int(a) for a in attrs["axis"]))}
+
+
+@register_op("pad")
+def pad(ins, attrs, ctx):
+    x = _x(ins)
+    p = attrs["paddings"]
+    pairs = [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(x.ndim)]
+    return {"Out": jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))}
+
+
+@register_op("pad2d")
+def pad2d(ins, attrs, ctx):
+    x = _x(ins)  # NCHW
+    t, b, l, r = [int(v) for v in attrs["paddings"]]
+    mode = attrs.get("mode", "constant")
+    pairs = [(0, 0), (0, 0), (t, b), (l, r)]
+    if mode == "constant":
+        return {"Out": jnp.pad(x, pairs, constant_values=attrs.get("pad_value", 0.0))}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": jnp.pad(x, pairs, mode=jmode)}
+
+
+# ---------------------------------------------------------------------------
+# Indexing
+# ---------------------------------------------------------------------------
+
+
+@register_op("gather", nondiff_inputs=("Index",))
+def gather(ins, attrs, ctx):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": jnp.take(x, idx.astype(jnp.int32), axis=0)}
+
+
+@register_op("gather_nd", nondiff_inputs=("Index",))
+def gather_nd(ins, attrs, ctx):
+    x, idx = ins["X"][0], ins["Index"][0]
+    nd = idx.shape[-1]
+    flat_idx = tuple(idx[..., i] for i in range(nd))
+    return {"Out": x[flat_idx]}
+
+
+@register_op("scatter", nondiff_inputs=("Ids",))
+def scatter(ins, attrs, ctx):
+    x, ids, updates = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    ids = ids.astype(jnp.int32).reshape(-1)
+    if attrs.get("overwrite", True):
+        return {"Out": x.at[ids].set(updates)}
+    return {"Out": x.at[ids].add(updates)}
+
+
+@register_op("scatter_nd_add", nondiff_inputs=("Index",))
+def scatter_nd_add(ins, attrs, ctx):
+    x, idx, upd = ins["X"][0], ins["Index"][0], ins["Updates"][0]
+    nd = idx.shape[-1]
+    return {"Out": x.at[tuple(idx[..., i] for i in range(nd))].add(upd)}
+
+
+@register_op("index_select", nondiff_inputs=("Index",))
+def index_select(ins, attrs, ctx):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": jnp.take(x, idx.astype(jnp.int32), axis=int(attrs.get("dim", 0)))}
+
+
+@register_op("one_hot", grad=None, nondiff_inputs=("X",))
+def one_hot(ins, attrs, ctx):
+    x = _x(ins)
+    depth = int(attrs["depth"])
+    flat = x.reshape(x.shape[:-1]) if x.shape and x.shape[-1] == 1 else x
+    return {"Out": jax.nn.one_hot(flat, depth, dtype=jnp.float32)}
+
+
+@register_op("lookup_table", nondiff_inputs=("Ids",))
+def lookup_table(ins, attrs, ctx):
+    """reference: operators/lookup_table_op.cc — Ids [...,1] int64, W [V,D]."""
+    w, ids = ins["W"][0], ins["Ids"][0]
+    padding_idx = int(attrs.get("padding_idx", -1))
+    idx = ids.astype(jnp.int32)
+    squeeze_last = idx.ndim > 1 and idx.shape[-1] == 1
+    if squeeze_last:
+        idx = idx[..., 0]
+    out = jnp.take(w, idx, axis=0)
+    if padding_idx != -1:
+        mask = (idx == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+    return {"Out": out}
+
+
+@register_op("lookup_table_v2", nondiff_inputs=("Ids",))
+def lookup_table_v2(ins, attrs, ctx):
+    w, ids = ins["W"][0], ins["Ids"][0]
+    padding_idx = int(attrs.get("padding_idx", -1))
+    idx = ids.astype(jnp.int32)
+    out = jnp.take(w, idx, axis=0)
+    if padding_idx != -1:
+        mask = (idx == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+    return {"Out": out}
+
+
+@register_op("where", nondiff_inputs=("Condition",))
+def where(ins, attrs, ctx):
+    c, x, y = ins["Condition"][0], ins["X"][0], ins["Y"][0]
+    return {"Out": jnp.where(c, x, y)}
+
+
+@register_op("where_index", grad=None, nondiff_inputs=("Condition",))
+def where_index(ins, attrs, ctx):
+    # dynamic-shape op: only usable at trace boundaries / eager mode
+    c = ins["Condition"][0]
+    return {"Out": jnp.stack(jnp.nonzero(c), axis=1).astype(jnp.int64)}
+
+
+# ---------------------------------------------------------------------------
+# Sorting / search
+# ---------------------------------------------------------------------------
+
+
+@register_op("top_k", nondiff_inputs=(), intermediate_outputs=("Indices",))
+def top_k(ins, attrs, ctx):
+    x = _x(ins)
+    k = int(attrs["k"]) if "k" in attrs else int(ins["K"][0])
+    vals, idx = jax.lax.top_k(x, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("top_k_v2", intermediate_outputs=("Indices",))
+def top_k_v2(ins, attrs, ctx):
+    x = _x(ins)
+    k = int(attrs["k"])
+    axis = int(attrs.get("axis", -1))
+    if axis not in (-1, x.ndim - 1):
+        x = jnp.moveaxis(x, axis, -1)
+    vals, idx = jax.lax.top_k(x, k)
+    if axis not in (-1, x.ndim - 1):
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return {"Out": vals, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("arg_max", grad=None, nondiff_inputs=("X",))
+def arg_max(ins, attrs, ctx):
+    x = _x(ins)
+    return {"Out": jnp.argmax(x, axis=int(attrs.get("axis", -1))).astype(jnp.int64)}
+
+
+@register_op("arg_min", grad=None, nondiff_inputs=("X",))
+def arg_min(ins, attrs, ctx):
+    x = _x(ins)
+    return {"Out": jnp.argmin(x, axis=int(attrs.get("axis", -1))).astype(jnp.int64)}
+
+
+@register_op("argsort", grad=None, nondiff_inputs=("X",))
+def argsort(ins, attrs, ctx):
+    x = _x(ins)
+    axis = int(attrs.get("axis", -1))
+    if attrs.get("descending", False):
+        idx = jnp.argsort(-x, axis=axis)
+    else:
+        idx = jnp.argsort(x, axis=axis)
+    out = jnp.take_along_axis(x, idx, axis=axis)
+    return {"Out": out, "Indices": idx.astype(jnp.int64)}
+
+
+@register_op("unique", grad=None, nondiff_inputs=("X",))
+def unique(ins, attrs, ctx):
+    x = _x(ins)
+    out, idx = np.unique(np.asarray(x), return_inverse=True)
+    return {"Out": jnp.asarray(out), "Index": jnp.asarray(idx.astype(np.int64))}
+
+
+# ---------------------------------------------------------------------------
+# Clipping / norms
+# ---------------------------------------------------------------------------
+
+
+@register_op("clip")
+def clip(ins, attrs, ctx):
+    x = _x(ins)
+    return {"Out": jnp.clip(x, attrs.get("min"), attrs.get("max"))}
+
+
+@register_op("clip_by_norm")
+def clip_by_norm(ins, attrs, ctx):
+    x = _x(ins)
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return {"Out": x * scale.astype(x.dtype)}
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(ins, attrs, ctx):
+    x = _x(ins)
+    return {"Out": jnp.sum(jnp.square(x)).reshape(1)}
+
+
+@register_op("norm", intermediate_outputs=("Norm",))
+def norm(ins, attrs, ctx):
+    x = _x(ins)
+    axis = int(attrs.get("axis", -1))
+    eps = attrs.get("epsilon", 1e-10)
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": x / n, "Norm": n}
+
+
+@register_op("p_norm")
+def p_norm(ins, attrs, ctx):
+    x = _x(ins)
+    p = attrs.get("porder", 2.0)
+    axis = int(attrs.get("axis", -1))
+    keep = attrs.get("keepdim", False)
+    out = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=keep) ** (1.0 / p)
+    return {"Out": out}
+
+
+# ---------------------------------------------------------------------------
+# Misc
+# ---------------------------------------------------------------------------
+
+
+@register_op("dlpack/identity", grad=None)
+def identity(ins, attrs, ctx):
+    return {"Out": _x(ins)}
+
+
+@register_op("print", grad=None)
+def print_op(ins, attrs, ctx):
+    x = _x(ins)
+    jax.debug.print("{} {}", attrs.get("message", ""), x)
+    return {"Out": x}
+
+
+@register_op("is_empty", grad=None, nondiff_inputs=("X",))
+def is_empty(ins, attrs, ctx):
+    x = _x(ins)
+    return {"Out": jnp.asarray(x.size == 0)}
+
+
+@register_op("cumsum")
+def cumsum(ins, attrs, ctx):
+    x = _x(ins)
+    axis = int(attrs.get("axis", -1))
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    if attrs.get("reverse", False):
+        out = jnp.flip(jnp.cumsum(jnp.flip(x, axis), axis=axis), axis)
+        if attrs.get("exclusive", False):
+            out = out - x
+    return {"Out": out}
+
+
+@register_op("linspace", grad=None, nondiff_inputs=("Start", "Stop", "Num"))
+def linspace(ins, attrs, ctx):
+    s, e, n = ins["Start"][0], ins["Stop"][0], ins["Num"][0]
+    return {"Out": jnp.linspace(float(s), float(e), int(n), dtype=_dt(attrs))}
+
+
+@register_op("eye", grad=None)
+def eye(ins, attrs, ctx):
+    n = int(attrs["num_rows"])
+    m = int(attrs.get("num_columns", n))
+    return {"Out": jnp.eye(n, m, dtype=_dt(attrs))}

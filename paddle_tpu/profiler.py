@@ -1,0 +1,101 @@
+"""Profiler (reference: python/paddle/fluid/profiler.py:228 context manager
+→ C++ host profiler + CUPTI DeviceTracer, SURVEY §5 'Tracing/profiling').
+
+TPU-native: jax.profiler captures both host and device timelines into
+XPlane/perfetto traces — the role of profiler.proto + tools/timeline.py.
+`RecordEvent`-style op annotation maps to jax.profiler.TraceAnnotation."""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Optional
+
+import jax
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
+           "RecordEvent", "cuda_profiler", "npu_profiler"]
+
+_trace_dir: Optional[str] = None
+_host_events = defaultdict(list)
+_active = False
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option="Default"):
+    """reference: profiler.py:228 — `with profiler.profiler('All'):`"""
+    start_profiler(state, profile_path)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+def start_profiler(state="All", profile_path="/tmp/profile", tracer_option=None):
+    global _trace_dir, _active
+    _trace_dir = profile_path if os.path.isdir(profile_path) or not \
+        os.path.splitext(profile_path)[1] else os.path.dirname(profile_path)
+    os.makedirs(_trace_dir or ".", exist_ok=True)
+    jax.profiler.start_trace(_trace_dir)
+    _active = True
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    global _active
+    if _active:
+        jax.profiler.stop_trace()
+        _active = False
+    _print_host_events(sorted_key)
+
+
+def reset_profiler():
+    _host_events.clear()
+
+
+def _print_host_events(sorted_key=None):
+    if not _host_events:
+        return
+    rows = []
+    for name, times in _host_events.items():
+        total = sum(times)
+        rows.append((name, len(times), total, total / len(times)))
+    if sorted_key in (None, "total"):
+        rows.sort(key=lambda r: -r[2])
+    elif sorted_key == "calls":
+        rows.sort(key=lambda r: -r[1])
+    print(f"{'Event':40s} {'Calls':>8s} {'Total(ms)':>12s} {'Avg(ms)':>10s}")
+    for name, calls, total, avg in rows:
+        print(f"{name:40s} {calls:8d} {total * 1e3:12.3f} {avg * 1e3:10.3f}")
+
+
+class RecordEvent:
+    """reference: platform/profiler.h:81 RecordEvent RAII — host-side named
+    span + device TraceAnnotation."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ann = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *a):
+        self._ann.__exit__(*a)
+        _host_events[self.name].append(time.perf_counter() - self._t0)
+        return False
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file=None, output_mode=None, config=None):
+    """reference: profiler.py:39 — accelerator-profiler passthrough."""
+    with profiler(profile_path=output_file or "/tmp/profile"):
+        yield
+
+
+npu_profiler = cuda_profiler

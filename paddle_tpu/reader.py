@@ -1,0 +1,156 @@
+"""DataLoader / PyReader (reference: python/paddle/fluid/reader.py —
+DataLoader.from_generator :73, GeneratorLoader :298, PyReader :569).
+
+The reference pushes LoDTensors into a C++ LoDTensorBlockingQueue consumed by
+a graph-embedded `read` op with double-buffering to GPU
+(operators/reader/buffered_reader.cc). The TPU-native pipeline keeps the
+same shape: a background thread runs the user generator into a bounded
+queue (the C++ datafeed library provides the high-throughput path, see
+paddle_tpu/data/), and iteration yields feed dicts; device transfer overlaps
+via jax async dispatch.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from .core.framework import Variable
+
+__all__ = ["DataLoader", "PyReader", "GeneratorLoader"]
+
+# reuse the reference's decorator library semantics
+from .reader_decorators import batch, shuffle, buffered, cache, chain, compose, map_readers, firstn  # noqa: F401,E402
+
+
+class GeneratorLoader:
+    """reference: reader.py:298."""
+
+    def __init__(self, feed_list: Sequence[Variable], capacity: int = 64,
+                 iterable: bool = True, return_list: bool = False,
+                 use_double_buffer: bool = True):
+        self._feed_list = list(feed_list)
+        self._capacity = capacity
+        self._iterable = iterable
+        self._return_list = return_list
+        self._generator: Optional[Callable] = None
+        self._places = None
+        self._batched = False
+
+    # -- configuration (reference API) --------------------------------------
+
+    def set_sample_generator(self, reader, batch_size, drop_last=True, places=None):
+        def batched():
+            buf = []
+            for sample in reader():
+                if not isinstance(sample, (list, tuple)):
+                    sample = (sample,)
+                buf.append(sample)
+                if len(buf) == batch_size:
+                    yield self._collate(buf)
+                    buf = []
+            if buf and not drop_last:
+                yield self._collate(buf)
+
+        self._generator = batched
+        self._places = places
+        return self
+
+    def set_sample_list_generator(self, reader, places=None):
+        def gen():
+            for samples in reader():
+                yield self._collate(samples)
+
+        self._generator = gen
+        self._places = places
+        return self
+
+    def set_batch_generator(self, reader, places=None):
+        def gen():
+            for batch_data in reader():
+                if isinstance(batch_data, dict):
+                    yield batch_data
+                else:
+                    yield {v.name: np.asarray(a)
+                           for v, a in zip(self._feed_list, batch_data)}
+
+        self._generator = gen
+        self._places = places
+        return self
+
+    def _collate(self, samples):
+        from .data_feeder import DataFeeder
+
+        return DataFeeder(self._feed_list).feed(samples)
+
+    # -- iteration -----------------------------------------------------------
+
+    def __iter__(self):
+        assert self._generator is not None, "call set_*_generator first"
+        q: "queue.Queue" = queue.Queue(maxsize=self._capacity)
+        stop = object()
+
+        def producer():
+            try:
+                for item in self._generator():
+                    q.put(item)
+            finally:
+                q.put(stop)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is stop:
+                break
+            yield item
+
+    # non-iterable (start/reset) mode used with graph readers in the
+    # reference; provided for API parity
+    def start(self):
+        self._it = iter(self)
+
+    def reset(self):
+        self._it = None
+
+    def next(self):
+        return next(self._it)
+
+
+class DataLoader:
+    """reference: reader.py:73."""
+
+    @staticmethod
+    def from_generator(feed_list=None, capacity=64, use_double_buffer=True,
+                       iterable=True, return_list=False, use_multiprocess=False):
+        return GeneratorLoader(feed_list or [], capacity, iterable, return_list,
+                               use_double_buffer)
+
+    @staticmethod
+    def from_dataset(dataset, places=None, drop_last=True):
+        from .dataset_loader import DatasetLoader
+
+        return DatasetLoader(dataset, places, drop_last)
+
+
+class PyReader(GeneratorLoader):
+    """reference: reader.py:569 (older API surface over the same loader)."""
+
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        super().__init__(feed_list or [], capacity, iterable, return_list,
+                         use_double_buffer)
+
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        return self.set_sample_generator(sample_generator, batch_size,
+                                         drop_last, places)
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        return self.set_sample_list_generator(reader, places)
+
+    def decorate_batch_generator(self, reader, places=None):
+        return self.set_batch_generator(reader, places)

@@ -1,0 +1,114 @@
+"""Reader decorators (reference: python/paddle/reader/decorator.py —
+map_readers, buffered, compose, chain, shuffle, firstn, cache, batch)."""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+
+__all__ = ["map_readers", "buffered", "compose", "chain", "shuffle",
+           "firstn", "cache", "batch"]
+
+
+def map_readers(func, *readers):
+    def reader():
+        for vals in zip(*[r() for r in readers]):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        buf = []
+        rng = random.Random(0)
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        rng.shuffle(buf)
+        yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            yield from r()
+
+    return reader
+
+
+def compose(*readers, check_alignment=True):
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            out = []
+            for it in items:
+                if isinstance(it, tuple):
+                    out.extend(it)
+                else:
+                    out.append(it)
+            yield tuple(out)
+
+    return reader
+
+
+def buffered(reader, size):
+    def buffered_reader():
+        q: "queue.Queue" = queue.Queue(maxsize=size)
+        end = object()
+
+        def worker():
+            try:
+                for d in reader():
+                    q.put(d)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is end:
+                break
+            yield e
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    def reader_n():
+        yield from itertools.islice(reader(), n)
+
+    return reader_n
+
+
+def cache(reader):
+    all_data = None
+
+    def cached():
+        nonlocal all_data
+        if all_data is None:
+            all_data = list(reader())
+        yield from all_data
+
+    return cached
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batched():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched

@@ -1,0 +1,43 @@
+"""Trainer stack entry points (reference: Executor.train_from_dataset →
+TrainerFactory → C++ MultiTrainer/DistMultiTrainer + DeviceWorkers,
+framework/trainer.h:38, device_worker.h:103, SURVEY §3.6).
+
+Round-1: a host-side trainer loop over a Dataset's file shards feeding the
+compiled step (HogwildWorker semantics, hogwild_worker.cc:163); the C++
+datafeed library (paddle_tpu/data/) supplies the pipelined batch source.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def train_from_dataset(executor, program=None, dataset=None, scope=None,
+                       thread=0, debug=False, fetch_list=None,
+                       fetch_info=None, print_period=100):
+    from .core import framework
+
+    program = program or framework.default_main_program()
+    if dataset is None:
+        raise ValueError("dataset is required")
+    fetch_list = fetch_list or []
+    step = 0
+    for feed in dataset._iter_batches():
+        vals = executor.run(program, feed=feed, fetch_list=fetch_list,
+                            scope=scope)
+        if debug and fetch_list and step % print_period == 0:
+            names = fetch_info or [getattr(f, "name", str(f)) for f in fetch_list]
+            print(f"step {step}: " + ", ".join(
+                f"{n}={v}" for n, v in zip(names, vals)))
+        step += 1
+    return None
+
+
+def infer_from_dataset(executor, program=None, dataset=None, scope=None,
+                       thread=0, debug=False, fetch_list=None,
+                       fetch_info=None, print_period=100):
+    infer_prog = (program or __import__("paddle_tpu.core.framework",
+                                        fromlist=["default_main_program"]
+                                        ).default_main_program()).clone(for_test=True)
+    return train_from_dataset(executor, infer_prog, dataset, scope, thread,
+                              debug, fetch_list, fetch_info, print_period)
