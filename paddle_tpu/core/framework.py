@@ -39,7 +39,6 @@ class OpRole:
 
 
 _global_seed = 0
-_rng_uid_counter = itertools.count(1)
 
 
 def set_global_seed(seed: int):
@@ -370,7 +369,9 @@ class Block:
         try:
             opdef = registry.get_op_def(type)
             if opdef.is_random and "__rng_uid__" not in attrs:
-                attrs["__rng_uid__"] = next(_rng_uid_counter)
+                # per-Program counter: two identically-built programs with the
+                # same random_seed replay identical random streams
+                attrs["__rng_uid__"] = self.program._next_rng_uid()
         except KeyError:
             pass  # allow structural ops unknown to the registry (feed/fetch)
         return OpDesc(type=type, inputs=ins, outputs=outs, attrs=attrs)
@@ -426,6 +427,10 @@ class Program:
         # arbitrary metadata bag (distributed strategies annotate here)
         self._attrs: Dict[str, Any] = {}
         self._version = 0  # bumped on every mutation → executor cache key
+        self._rng_uid = itertools.count(1)
+
+    def _next_rng_uid(self) -> int:
+        return next(self._rng_uid)
 
     # -- blocks --------------------------------------------------------------
 
@@ -493,6 +498,11 @@ class Program:
                     b.vars[name] = Variable(b, vdesc)
             b.ops = [Operator(b, od) for od in b.desc.ops]
         self._current_block_idx = 0
+        # resume uid allocation past any uid carried in the descs so random
+        # ops appended after clone/deserialize don't replay existing streams
+        max_uid = max((int(op.attrs.get("__rng_uid__", 0))
+                       for b in self.desc.blocks for op in b.ops), default=0)
+        self._rng_uid = itertools.count(max_uid + 1)
         self._version += 1
 
     def to_bytes(self) -> bytes:

@@ -14,11 +14,29 @@ from typing import Callable, List, Optional
 
 from ..core import framework
 from ..core.framework import Variable
+from ..core.ir import OpDesc
 from ..layer_helper import LayerHelper
 
-__all__ = ["cond", "While", "StaticRNN", "increment", "array_write",
-           "array_read", "array_length", "create_array", "less_than", "Switch",
-           "case", "switch_case"]
+__all__ = ["cond", "While", "while_loop", "StaticRNN", "increment",
+           "array_write", "array_read", "array_length", "create_array",
+           "less_than", "Switch", "case", "switch_case"]
+
+
+def _outer_reads(program, blocks, bound_names=()):
+    """Names read by ops in `blocks` that are defined in an enclosing block
+    (captured vars — passed explicitly so shape inference and grads work)."""
+    reads: List[str] = []
+    bound = set(bound_names)
+    for blk in blocks:
+        defined = set(bound)
+        for op in blk.desc.ops:
+            for n in op.input_names():
+                if (n and n not in defined and n not in reads
+                        and n not in blk.desc.vars
+                        and program.global_block().has_var(n)):
+                    reads.append(n)
+            defined.update(op.output_names())
+    return reads
 
 
 def _collect_block(program, build_fn):
@@ -48,19 +66,6 @@ def cond(pred: Variable, true_fn: Callable, false_fn: Callable, name=None):
     if len(true_outs) != len(false_outs):
         raise ValueError("true_fn and false_fn must return the same number of outputs")
 
-    # Vars read by either branch that exist outside — passed as Input so
-    # grads flow (see ops/control_flow.py docstring).
-    outer_reads: List[str] = []
-    for blk in (true_block, false_block):
-        defined = set()
-        for op in blk.desc.ops:
-            for n in op.input_names():
-                if n not in defined and not blk.has_var(n) or (
-                        n not in defined and blk.program.global_block().has_var(n)):
-                    if n not in outer_reads and program.global_block().has_var(n):
-                        outer_reads.append(n)
-            defined.update(op.output_names())
-
     out_names = []
     outs = []
     for tv, fv in zip(true_outs, false_outs):
@@ -74,8 +79,12 @@ def cond(pred: Variable, true_fn: Callable, false_fn: Callable, name=None):
     for blk, branch_outs in ((true_block, true_outs), (false_block, false_outs)):
         for out, bv in zip(outs, branch_outs):
             blk.desc.ops.append(
-                __import__("paddle_tpu.core.ir", fromlist=["OpDesc"]).OpDesc(
-                    type="assign", inputs={"X": [bv.name]}, outputs={"Out": [out.name]}))
+                OpDesc(type="assign", inputs={"X": [bv.name]},
+                       outputs={"Out": [out.name]}))
+
+    # Vars read by either branch that exist outside — passed as Input so
+    # shape inference sees them and grads flow (ops/control_flow.py docstring).
+    outer_reads = _outer_reads(program, (true_block, false_block))
 
     helper.append_op(
         type="cond",
@@ -137,6 +146,47 @@ class While:
 
     def block(self):
         return While._BlockGuard(self)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Functional while (reference: layers/control_flow.py while_loop) —
+    `cond(*loop_vars) -> bool Variable`, `body(*loop_vars) -> new loop vars`.
+    Lowered to lax.while_loop via the `while_v2` op (forward-only, like the
+    reference's while without grad)."""
+    helper = LayerHelper("while_loop", name=name)
+    program = helper.main_program
+    if not loop_vars:
+        raise ValueError("loop_vars must be non-empty")
+
+    cond_block, cond_outs = _collect_block(program, lambda: cond(*loop_vars))
+    if len(cond_outs) != 1:
+        raise ValueError("cond must return a single boolean Variable")
+    body_block, body_outs = _collect_block(program, lambda: body(*loop_vars))
+    if len(body_outs) != len(loop_vars):
+        raise ValueError("body must return as many vars as loop_vars")
+
+    carry_names = [v.name for v in loop_vars]
+    extra_names = _outer_reads(program, (cond_block, body_block),
+                               bound_names=carry_names)
+    extra_vars = [program.global_block().var(n) for n in extra_names]
+
+    outs = []
+    for v in loop_vars:
+        out = helper.create_variable_for_type_inference(v.dtype)
+        out.desc.shape = v.desc.shape
+        outs.append(out)
+
+    helper.append_op(
+        type="while_v2",
+        inputs={"X": list(loop_vars), "Extra": extra_vars},
+        outputs={"Out": outs},
+        attrs={"cond_block": {"__block__": cond_block.idx},
+               "body_block": {"__block__": body_block.idx},
+               "carry_names": carry_names,
+               "extra_names": extra_names,
+               "pred_name": cond_outs[0].name,
+               "body_out_names": [v.name for v in body_outs]})
+    return outs
 
 
 class StaticRNN:

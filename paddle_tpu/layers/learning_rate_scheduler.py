@@ -25,7 +25,9 @@ def _global_step():
     gb = main.global_block()
     if gb.has_var(_STEP_VAR):
         return gb.var(_STEP_VAR)
-    var = _tensor.create_global_var([1], 0.0, "float32", persistable=True,
+    # init to -1 so the prepended increment makes the first run observe 0
+    # (reference: _decay_step_counter(begin=0)).
+    var = _tensor.create_global_var([1], -1.0, "float32", persistable=True,
                                     name=_STEP_VAR)
     gb.prepend_op(type="increment", inputs={"X": var}, outputs={"Out": var},
                   attrs={"step": 1.0})
@@ -100,8 +102,12 @@ def piecewise_decay(boundaries, values):
 
 
 def noam_decay(d_model, warmup_steps, learning_rate=1.0):
-    """reference: noam_decay — the Transformer LR schedule."""
-    step = _global_step()
+    """reference: noam_decay — the Transformer LR schedule. The reference
+    counts from begin=1 here (learning_rate_scheduler.py:95) while the other
+    schedules count from 0, so shift the shared counter by +1 (0**-0.5 = inf
+    would zero the first step otherwise)."""
+    step = _ops.elementwise_add(
+        _global_step(), _tensor.fill_constant([1], "float32", 1.0))
     a = _ops.elementwise_pow(step, _tensor.fill_constant([1], "float32", -0.5))
     b = _ops.elementwise_mul(step, _tensor.fill_constant(
         [1], "float32", warmup_steps ** -1.5))

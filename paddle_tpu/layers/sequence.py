@@ -1,0 +1,115 @@
+"""Sequence layers over *padded* batches.
+
+Reference: python/paddle/fluid/layers (sequence_pool/softmax/reverse/... over
+LoD tensors, backed by operators/sequence_ops/). The TPU equivalents take
+dense [N, T, ...] padded batches plus an optional per-row `length` tensor —
+the LoD offset table becomes explicit lengths/masking (SURVEY.md §5
+long-context note).
+"""
+
+from __future__ import annotations
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "sequence_mask", "sequence_pool", "sequence_softmax", "sequence_reverse",
+    "sequence_expand", "sequence_concat", "sequence_slice", "im2sequence",
+    "sequence_first_step", "sequence_last_step",
+]
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    if maxlen is None:
+        raise ValueError(
+            "sequence_mask requires an explicit maxlen on TPU: XLA needs a "
+            "static output shape, so the reference's data-dependent "
+            "max(lengths) default cannot be traced. Pass maxlen=<padded T>.")
+    helper = LayerHelper("sequence_mask", name=name)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="sequence_mask", inputs={"X": x},
+                     outputs={"Y": out},
+                     attrs={"maxlen": int(maxlen), "out_dtype": dtype})
+    return out
+
+
+def sequence_pool(input, pool_type="sum", length=None, is_test=False, name=None):
+    helper = LayerHelper("sequence_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": input}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(type="sequence_pool", inputs=inputs,
+                     outputs={"Out": out},
+                     attrs={"pooltype": pool_type.upper()})
+    return out
+
+
+def sequence_first_step(input, length=None):
+    return sequence_pool(input, "first", length)
+
+
+def sequence_last_step(input, length=None):
+    return sequence_pool(input, "last", length)
+
+
+def sequence_softmax(input, length=None, use_cudnn=False, name=None):
+    helper = LayerHelper("sequence_softmax", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": input}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(type="sequence_softmax", inputs=inputs,
+                     outputs={"Out": out}, attrs={})
+    return out
+
+
+def sequence_reverse(x, length=None, name=None):
+    helper = LayerHelper("sequence_reverse", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": x}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(type="sequence_reverse", inputs=inputs,
+                     outputs={"Y": out}, attrs={})
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_expand", inputs={"X": x, "Y": y},
+                     outputs={"Out": out}, attrs={"ref_level": ref_level})
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", name=name)
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type="sequence_concat", inputs={"X": input},
+                     outputs={"Out": out}, attrs={})
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_slice",
+                     inputs={"X": input, "Offset": offset, "Length": length},
+                     outputs={"Out": out}, attrs={})
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    helper = LayerHelper("im2sequence", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ks = filter_size if isinstance(filter_size, (list, tuple)) else [filter_size] * 2
+    st = stride if isinstance(stride, (list, tuple)) else [stride] * 2
+    pd = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    helper.append_op(type="im2sequence", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"kernels": list(ks), "strides": list(st),
+                            "paddings": list(pd)})
+    return out

@@ -53,7 +53,8 @@ def cast(x, dtype):
 
 def concat(input, axis=0, name=None):
     helper = LayerHelper("concat", name=name)
-    out = helper.create_variable_for_type_inference(helper.input_dtype("input") if isinstance(input, (list, tuple)) else input.dtype)
+    first = input[0] if isinstance(input, (list, tuple)) else input
+    out = helper.create_variable_for_type_inference(first.dtype)
     helper.append_op(type="concat", inputs={"X": input}, outputs={"Out": out},
                      attrs={"axis": axis})
     return out

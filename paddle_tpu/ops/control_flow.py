@@ -82,6 +82,33 @@ def while_op(ins, attrs, ctx):
     return {"Out": list(final)}
 
 
+@register_op("while_v2", grad=None, nondiff_inputs=("X", "Extra"))
+def while_v2_op(ins, attrs, ctx):
+    """Functional while: separate cond and body sub-blocks over an explicit
+    carry (layers.while_loop). Forward-only like the reference's while."""
+    cb = _block_idx(attrs, "cond_block")
+    bb = _block_idx(attrs, "body_block")
+    carry_names = list(attrs["carry_names"])
+    extra_names = list(attrs.get("extra_names", []))
+    pred_name = attrs["pred_name"]
+    body_out_names = list(attrs["body_out_names"])
+    extras = list(ins.get("Extra", []))
+    env0 = dict(ctx.env or {})
+    env0.update(zip(extra_names, extras))
+
+    def run_block(bidx, carry, out_names):
+        env = dict(env0)
+        env.update(zip(carry_names, carry))
+        ctx.lower_block(bidx, env)
+        return [env[n] for n in out_names]
+
+    final = jax.lax.while_loop(
+        lambda c: run_block(cb, c, [pred_name])[0].reshape(()),
+        lambda c: run_block(bb, c, body_out_names),
+        list(ins["X"]))
+    return {"Out": list(final)}
+
+
 @register_op("scan")
 def scan_op(ins, attrs, ctx):
     """Sequence recurrence via lax.scan — the TPU-native recurrent_op

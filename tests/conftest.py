@@ -1,0 +1,55 @@
+"""Test harness configuration.
+
+Mirrors the reference's test ladder (SURVEY.md §4): numpy reference → CPU
+execution → multi-device. Tests run on a *virtual 8-device CPU mesh* so every
+sharding/collective path compiles and executes without TPU hardware
+(reference analogue: localhost-subprocess "clusters" in
+python/paddle/fluid/tests/unittests/test_dist_base.py:461).
+"""
+
+import os
+
+# Must be set before jax initializes its backends.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# A baked sitecustomize may force-register a TPU PJRT plugin and override
+# jax_platforms after env parsing; pin the config back to CPU before any
+# backend initializes so tests run on the virtual 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
+# float64 available for finite-difference oracles (framework code still
+# declares float32 explicitly everywhere it matters).
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_programs():
+    """Each test gets fresh default programs + scope (the reference's tests
+    rely on new Program() per test; we also reset the global singletons)."""
+    import paddle_tpu as pt
+    from paddle_tpu.core import executor as executor_mod
+    from paddle_tpu.core import framework as fw
+
+    old_main = fw.switch_main_program(pt.Program())
+    old_startup = fw.switch_startup_program(pt.Program())
+    old_scope = executor_mod._global_scope
+    executor_mod._global_scope = executor_mod.Scope()
+    fw.unique_name.generator = fw.UniqueNameGenerator()
+    yield
+    fw.switch_main_program(old_main)
+    fw.switch_startup_program(old_startup)
+    executor_mod._global_scope = old_scope
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(1234)

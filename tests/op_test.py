@@ -1,0 +1,200 @@
+"""OpTest-equivalent harness.
+
+Reference: python/paddle/fluid/tests/unittests/op_test.py:135 — declare
+op_type/inputs/outputs/attrs as numpy, `check_output` runs the single-op
+program and compares against the numpy reference, `check_grad` compares
+analytic gradients against numeric finite differences
+(op_test.py:57 get_numeric_gradient).
+
+Here the "program" is the op kernel lowered by JAX; check_grad exercises the
+generically-derived `<op>_grad` kernel (paddle_tpu/core/registry.py vjp path)
+exactly as the executor's backward pass would run it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core import registry
+from paddle_tpu.core.ir import OpDesc
+from paddle_tpu.core.registry import (
+    GRAD_PREFIX_IG,
+    GRAD_PREFIX_IN,
+    GRAD_PREFIX_OG,
+    KernelCtx,
+)
+
+
+def _norm_ins(inputs: Dict[str, Any]) -> Dict[str, List]:
+    norm = {}
+    for slot, v in inputs.items():
+        if isinstance(v, (list, tuple)):
+            norm[slot] = [None if x is None else jnp.asarray(x) for x in v]
+        else:
+            norm[slot] = [jnp.asarray(v)]
+    return norm
+
+
+def run_op(op_type: str, inputs: Dict[str, Any], attrs: Optional[Dict] = None,
+           outputs: Sequence[str] = ("Out",), is_test: bool = False,
+           rng_seed: Optional[int] = None) -> Dict[str, List[np.ndarray]]:
+    """Run a single op kernel under jit; returns {slot: [np arrays]}."""
+    attrs = dict(attrs or {})
+    ins = _norm_ins(inputs)
+    opdef = registry.get_op_def(op_type)
+    op = OpDesc(type=op_type,
+                inputs={k: [f"{k}_{i}" for i in range(len(v))] for k, v in ins.items()},
+                outputs={o: [f"{o}_out"] for o in outputs},
+                attrs=attrs)
+    rng_key = jax.random.key(rng_seed) if rng_seed is not None else None
+
+    def f(ins):
+        ctx = KernelCtx(op, rng_key=rng_key, is_test=is_test)
+        return opdef.call(ins, attrs, ctx)
+
+    outs = jax.jit(f)(ins)
+    return {k: [None if x is None else np.asarray(x) for x in v]
+            for k, v in outs.items()}
+
+
+class OpTest:
+    """Subclass and set op_type/inputs/outputs/attrs (numpy), then call
+    check_output / check_grad.  API shape follows the reference op_test."""
+
+    op_type: str = ""
+    inputs: Dict[str, Any] = {}
+    outputs: Dict[str, Any] = {}
+    attrs: Dict[str, Any] = {}
+
+    def check_output(self, atol=1e-5, rtol=1e-5, is_test: bool = False):
+        got = run_op(self.op_type, self.inputs, self.attrs,
+                     outputs=tuple(self.outputs), is_test=is_test)
+        for slot, want in self.outputs.items():
+            want_list = want if isinstance(want, (list, tuple)) else [want]
+            got_list = got[slot]
+            assert len(got_list) >= len(want_list), (
+                f"{self.op_type}: slot {slot} produced {len(got_list)} "
+                f"outputs, want {len(want_list)}")
+            for i, w in enumerate(want_list):
+                np.testing.assert_allclose(
+                    got_list[i], w, atol=atol, rtol=rtol,
+                    err_msg=f"{self.op_type} output {slot}[{i}]")
+
+    def check_grad(self, inputs_to_check: Sequence[str], output_name: str = "Out",
+                   max_relative_error: float = 5e-3, delta: float = 1e-3,
+                   atol: float = 1e-4):
+        check_grad(self.op_type, self.inputs, self.attrs, inputs_to_check,
+                   output_name=output_name,
+                   max_relative_error=max_relative_error, delta=delta,
+                   atol=atol)
+
+
+def analytic_grads(op_type: str, inputs: Dict[str, Any], attrs: Dict,
+                   inputs_to_check: Sequence[str], output_name: str,
+                   out_grad: Dict[str, List[np.ndarray]]):
+    """Run the generically-derived grad op the way backward.py wires it:
+    fwd_in::<slot> inputs + out_grad::<slot> cotangents → in_grad::<slot>."""
+    attrs = dict(attrs or {})
+    ins = _norm_ins(inputs)
+    grad_def = registry.get_op_def(op_type + "_grad")
+
+    g_ins = {GRAD_PREFIX_IN + k: v for k, v in ins.items()}
+    for slot, vals in out_grad.items():
+        g_ins[GRAD_PREFIX_OG + slot] = [jnp.asarray(v) for v in vals]
+
+    g_op = OpDesc(
+        type=op_type + "_grad",
+        inputs={k: [f"{k}_{i}" for i in range(len(v))] for k, v in g_ins.items()},
+        outputs={GRAD_PREFIX_IG + s: [f"{s}_grad_{i}" for i in range(len(ins[s]))]
+                 for s in inputs_to_check},
+        attrs=attrs,
+    )
+
+    def f(g_ins):
+        ctx = KernelCtx(g_op, rng_key=None, is_test=False)
+        return grad_def.call(g_ins, attrs, ctx)
+
+    outs = jax.jit(f)(g_ins)
+    return {s: [None if x is None else np.asarray(x) for x in
+                outs.get(GRAD_PREFIX_IG + s, [])]
+            for s in inputs_to_check}
+
+
+def numeric_grads(op_type: str, inputs: Dict[str, Any], attrs: Dict,
+                  input_to_check: str, output_name: str,
+                  out_grad: Dict[str, List[np.ndarray]], delta: float):
+    """Central finite differences of sum(out * out_grad) w.r.t. one input
+    (reference: op_test.py get_numeric_gradient :57). Compiles ONE scalar-loss
+    function and re-invokes it per probe."""
+    base = _norm_ins(inputs)
+    opdef = registry.get_op_def(op_type)
+    op = OpDesc(type=op_type,
+                inputs={k: [f"{k}_{i}" for i in range(len(v))] for k, v in base.items()},
+                outputs={o: [f"{o}_out"] for o in out_grad},
+                attrs=dict(attrs or {}))
+    cots = {k: [jnp.asarray(np.asarray(g, np.float64)) for g in v]
+            for k, v in out_grad.items()}
+
+    @jax.jit
+    def scalar_loss(ins):
+        ctx = KernelCtx(op, rng_key=None, is_test=False)
+        outs = opdef.call(ins, op.attrs, ctx)
+        total = jnp.zeros((), jnp.result_type(jnp.float32, *[g.dtype for gs in cots.values() for g in gs]))
+        for slot, gs in cots.items():
+            for i, g in enumerate(gs):
+                total = total + jnp.sum(outs[slot][i].astype(total.dtype) * g.astype(total.dtype))
+        return total
+
+    grads = []
+    for xi, x0 in enumerate(base[input_to_check]):
+        x0 = np.asarray(x0)
+        g = np.zeros(x0.shape, np.float64)
+        flat = np.asarray(x0, np.float64).reshape(-1)
+        gflat = g.reshape(-1)
+        for j in range(flat.size):
+            def probe(v):
+                w = flat.copy(); w[j] = v
+                feed = {k: list(vv) for k, vv in base.items()}
+                feed[input_to_check][xi] = jnp.asarray(
+                    w.reshape(x0.shape).astype(x0.dtype))
+                return float(scalar_loss(feed))
+            gflat[j] = (probe(flat[j] + delta) - probe(flat[j] - delta)) / (2 * delta)
+        grads.append(g)
+    return grads
+
+
+def check_grad(op_type: str, inputs: Dict[str, Any], attrs: Optional[Dict],
+               inputs_to_check: Sequence[str], output_name: str = "Out",
+               output_names: Optional[Sequence[str]] = None,
+               max_relative_error: float = 5e-3, delta: float = 1e-3,
+               atol: float = 1e-4, out_grad: Optional[Dict] = None):
+    """Compare the vjp-derived grad kernel against finite differences."""
+    attrs = dict(attrs or {})
+    out_names = list(output_names) if output_names else [output_name]
+    fwd = run_op(op_type, inputs, attrs, outputs=tuple(out_names))
+    if out_grad is None:
+        rng = np.random.RandomState(7)
+        out_grad = {
+            slot: [rng.uniform(-1, 1, np.asarray(v).shape).astype(np.float64)
+                   .astype(np.asarray(v).dtype) for v in fwd[slot]]
+            for slot in out_names
+        }
+
+    analytic = analytic_grads(op_type, inputs, attrs, inputs_to_check,
+                              output_name, out_grad)
+    for slot in inputs_to_check:
+        numeric = numeric_grads(op_type, inputs, attrs, slot, output_name,
+                                out_grad, delta)
+        for i, num in enumerate(numeric):
+            ana = np.asarray(analytic[slot][i], np.float64)
+            num = np.asarray(num, np.float64)
+            denom = np.maximum(np.maximum(np.abs(ana), np.abs(num)), atol / max_relative_error)
+            rel = np.abs(ana - num) / denom
+            assert rel.max() <= max_relative_error, (
+                f"{op_type} grad of {slot}[{i}]: max rel err {rel.max():.3e} "
+                f"(analytic {ana.reshape(-1)[np.argmax(rel)]:.6f} vs numeric "
+                f"{num.reshape(-1)[np.argmax(rel)]:.6f})")

@@ -1,0 +1,98 @@
+"""append_backward / gradients tests.
+
+Reference analogues: test_backward.py, test_calc_gradient.py — here the
+top-level oracle is finite differences through the *whole program*.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def _mlp(main, startup):
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[6], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        h = pt.layers.fc(input=x, size=5, act="tanh")
+        pred = pt.layers.fc(input=h, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred, label=y))
+    return x, y, loss
+
+
+def test_append_backward_creates_param_grads():
+    main, startup = pt.Program(), pt.Program()
+    x, y, loss = _mlp(main, startup)
+    with pt.program_guard(main, startup):
+        p2g = pt.backward.append_backward(loss)
+    assert len(p2g) == 4  # 2 fc layers x (w, b)
+    for p, g in p2g:
+        assert g.name.endswith("@GRAD")
+        assert tuple(p.shape) == tuple(g.shape)
+
+
+def test_gradients_match_finite_differences(rng):
+    main, startup = pt.Program(), pt.Program()
+    x, y, loss = _mlp(main, startup)
+    with pt.program_guard(main, startup):
+        p2g = pt.backward.append_backward(loss)
+
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(8, 6).astype("float32")
+    Y = rng.rand(8, 1).astype("float32")
+    feed = {"x": X, "y": Y}
+    scope = pt.global_scope()
+
+    grads = exe.run(main, feed=feed, fetch_list=[g for _, g in p2g])
+    for (param, _), g in zip(p2g, grads):
+        w0 = np.array(scope.get(param.name), np.float64)
+        num = np.zeros_like(w0)
+        delta = 1e-3
+        flat_w = w0.reshape(-1)
+        flat_g = num.reshape(-1)
+        # probe a subset of entries for speed
+        idx = rng.choice(flat_w.size, size=min(6, flat_w.size), replace=False)
+        for j in idx:
+            for sign in (+1, -1):
+                w = flat_w.copy()
+                w[j] += sign * delta
+                scope.set_var(param.name, w.reshape(w0.shape).astype("float32"))
+                l = float(exe.run(main, feed=feed, fetch_list=[loss],
+                                  use_program_cache=True)[0])
+                flat_g[j] += sign * l / (2 * delta)
+            scope.set_var(param.name, w0.astype("float32"))
+        ana = np.asarray(g, np.float64).reshape(-1)
+        for j in idx:
+            assert abs(ana[j] - flat_g[j]) <= 2e-2 * max(1.0, abs(flat_g[j])), (
+                f"{param.name}[{j}]: analytic {ana[j]} vs numeric {flat_g[j]}")
+
+
+def test_gradients_api_intermediate_var(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        x.stop_gradient = False
+        h = pt.layers.scale(x, scale=3.0)
+        loss = pt.layers.mean(h)
+        (gx,) = pt.backward.gradients(loss, x)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(2, 4).astype("float32")
+    g = exe.run(main, feed={"x": X}, fetch_list=[gx])[0]
+    np.testing.assert_allclose(g, np.full_like(X, 3.0 / X.size), rtol=1e-5)
+
+
+def test_stop_gradient_blocks_path(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        h1 = pt.layers.fc(input=x, size=4)
+        h1.stop_gradient = True
+        h2 = pt.layers.fc(input=h1, size=1)
+        loss = pt.layers.mean(h2)
+        p2g = pt.backward.append_backward(loss)
+    grad_params = {p.name for p, _ in p2g}
+    # first fc's params are behind the stop_gradient cut
+    all_params = {v.name for v in main.list_vars() if isinstance(v, pt.Parameter)}
+    assert len(grad_params) == 2
+    assert grad_params < all_params

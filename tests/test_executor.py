@@ -1,0 +1,102 @@
+"""Executor + Scope tests (reference analogues:
+test_executor_and_use_program_cache.py, test_exe*.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _linreg_program():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[13], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(input=x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred, label=y))
+        pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, startup, loss
+
+
+def test_linreg_converges(rng):
+    main, startup, loss = _linreg_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(64, 13).astype("float32")
+    Y = (X @ rng.rand(13, 1)).astype("float32")
+    losses = [float(exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])[0])
+              for _ in range(60)]
+    assert losses[-1] < losses[0] * 0.05
+
+
+def test_program_cache_and_recompile(rng):
+    main, startup, loss = _linreg_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(16, 13).astype("float32")
+    Y = rng.rand(16, 1).astype("float32")
+    exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    n_cached = len(exe._cache)
+    exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    assert len(exe._cache) == n_cached  # same signature reused
+    # different batch size -> new specialization
+    exe.run(main, feed={"x": X[:8], "y": Y[:8]}, fetch_list=[loss])
+    assert len(exe._cache) == n_cached + 1
+
+
+def test_scope_isolation(rng):
+    main, startup, loss = _linreg_program()
+    exe = pt.Executor(pt.CPUPlace())
+    s1, s2 = pt.Scope(), pt.Scope()
+    X = rng.rand(8, 13).astype("float32")
+    Y = rng.rand(8, 1).astype("float32")
+    param_names = [v.name for v in main.list_vars() if isinstance(v, pt.Parameter)]
+    with pt.scope_guard(s1):
+        exe.run(startup)
+        exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        w1 = {n: np.array(s1.get(n)) for n in param_names}
+    with pt.scope_guard(s2):
+        exe.run(startup)
+        for _ in range(10):
+            exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        w2 = {n: np.array(s2.get(n)) for n in param_names}
+    # s1 params untouched by s2 training
+    for n in param_names:
+        np.testing.assert_array_equal(np.array(s1.get(n)), w1[n])
+        assert not np.array_equal(w1[n], w2[n])
+
+
+def test_fetch_variable_and_missing_feed_error(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[3], dtype="float32")
+        out = pt.layers.scale(x, scale=2.0)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(4, 3).astype("float32")
+    res = exe.run(main, feed={"x": X}, fetch_list=[out])[0]
+    np.testing.assert_allclose(res, X * 2.0, rtol=1e-6)
+    with pytest.raises(Exception):
+        exe.run(main, feed={}, fetch_list=[out])
+
+
+def test_rng_determinism():
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = 42
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[100], dtype="float32")
+        out = pt.layers.dropout(x, dropout_prob=0.5)
+    exe = pt.Executor(pt.CPUPlace())
+    X = np.ones((4, 100), "float32")
+
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        a = exe.run(main, feed={"x": X}, fetch_list=[out])[0]
+        b = exe.run(main, feed={"x": X}, fetch_list=[out])[0]
+    # rng state advances between steps
+    assert not np.array_equal(a, b)
+    # fresh scope with same seed replays the same stream
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        a2 = exe.run(main, feed={"x": X}, fetch_list=[out])[0]
+    np.testing.assert_array_equal(a, a2)

@@ -1,0 +1,57 @@
+"""Program IR roundtrip + Program builder tests.
+
+Reference analogues: test_program.py, test_operator_desc.py,
+test_protobuf_descs.py in python/paddle/fluid/tests/unittests/.
+"""
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.core.ir import BlockDesc, OpDesc, ProgramDesc, VarDesc
+
+
+def test_desc_json_roundtrip():
+    p = ProgramDesc()
+    b = p.block(0)
+    b.vars["x"] = VarDesc(name="x", shape=(-1, 4), dtype="float32")
+    b.vars["w"] = VarDesc(name="w", shape=(4, 2), persistable=True, is_parameter=True)
+    b.ops.append(OpDesc(type="mul", inputs={"X": ["x"], "Y": ["w"]},
+                        outputs={"Out": ["y"]},
+                        attrs={"x_num_col_dims": 1, "y_num_col_dims": 1}))
+    sub = p.append_block(parent_idx=0)
+    b.ops.append(OpDesc(type="cond", attrs={"sub_block": {"__block__": sub.idx}}))
+
+    p2 = ProgramDesc.from_json(p.to_json())
+    assert len(p2.blocks) == 2
+    assert p2.block(0).vars["w"].persistable
+    assert p2.block(0).ops[0].type == "mul"
+    assert p2.block(0).ops[1].block_attr("sub_block") == 1
+    assert p2.block(0).ops[0].input_names() == ["x", "w"]
+
+
+def test_program_builder_and_clone():
+    main = pt.Program()
+    with pt.program_guard(main):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        y = pt.layers.fc(input=x, size=2)
+    assert x.shape[0] == -1  # batch dim dynamic
+    assert y.shape[-1] == 2
+    params = [v for v in main.list_vars() if isinstance(v, pt.Parameter)]
+    assert len(params) == 2  # weight + bias
+
+    cloned = main.clone()
+    assert len(cloned.desc.block(0).ops) == len(main.desc.block(0).ops)
+    # clone is independent
+    with pt.program_guard(cloned):
+        pt.layers.fc(input=x, size=3)
+    assert len(cloned.desc.block(0).ops) != len(main.desc.block(0).ops)
+
+
+def test_program_test_clone_stops_dropout():
+    main = pt.Program()
+    with pt.program_guard(main):
+        x = pt.layers.data(name="x", shape=[8], dtype="float32")
+        h = pt.layers.dropout(pt.layers.fc(input=x, size=8), dropout_prob=0.5)
+        pt.layers.mean(h)
+    infer = main.clone(for_test=True)
+    assert infer._is_test

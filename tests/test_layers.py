@@ -1,0 +1,132 @@
+"""Layers API smoke + semantics tests (reference: test_layers.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _run(main, startup, feed, fetch):
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_fc_act_and_bias(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        out = pt.layers.fc(input=x, size=3, act="relu")
+    (res,) = _run(main, startup, {"x": rng.rand(2, 4).astype("float32")}, [out])
+    assert res.shape == (2, 3)
+    assert (res >= 0).all()
+
+
+def test_conv_bn_pool_stack(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = pt.layers.data(name="img", shape=[3, 16, 16], dtype="float32")
+        c = pt.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                             padding=1, act="relu")
+        b = pt.layers.batch_norm(input=c)
+        p = pt.layers.pool2d(input=b, pool_size=2, pool_stride=2,
+                             pool_type="max")
+    (res,) = _run(main, startup, {"img": rng.rand(2, 3, 16, 16).astype("float32")}, [p])
+    assert res.shape == (2, 8, 8, 8)
+
+
+def test_embedding_and_sequence_pool(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = pt.layers.data(name="ids", shape=[5, 1], dtype="int64")
+        emb = pt.layers.embedding(input=ids, size=[20, 8])
+        pooled = pt.layers.sequence_pool(input=emb, pool_type="average")
+    ids_np = rng.randint(0, 20, (3, 5, 1)).astype("int64")
+    (res,) = _run(main, startup, {"ids": ids_np}, [pooled])
+    assert res.shape[0] == 3 and res.shape[-1] == 8
+
+
+def test_concat_split_reshape(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        a = pt.layers.data(name="a", shape=[4], dtype="float32")
+        b = pt.layers.data(name="b", shape=[4], dtype="float32")
+        cat = pt.layers.concat([a, b], axis=1)
+        r = pt.layers.reshape(cat, shape=[-1, 2, 4])
+    A = rng.rand(3, 4).astype("float32")
+    B = rng.rand(3, 4).astype("float32")
+    (res,) = _run(main, startup, {"a": A, "b": B}, [r])
+    np.testing.assert_allclose(res, np.concatenate([A, B], 1).reshape(3, 2, 4),
+                               rtol=1e-6)
+
+
+def test_math_op_patch_operators(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        y = (x * 2.0 + 1.0) / 2.0 - x
+    X = rng.rand(2, 4).astype("float32")
+    (res,) = _run(main, startup, {"x": X}, [y])
+    np.testing.assert_allclose(res, (X * 2 + 1) / 2 - X, rtol=1e-5)
+
+
+def test_cond_layer(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[1], dtype="float32")
+        big = pt.layers.fill_constant([1], "float32", 10.0)
+        small = pt.layers.fill_constant([1], "float32", 0.1)
+        pred = pt.layers.reduce_sum(x) > 1.0
+        out = pt.layers.cond(pred, lambda: big, lambda: small)
+    (r1,) = _run(main, startup, {"x": np.array([[5.0]], "float32")}, [out])
+    assert float(r1.reshape(())) == 10.0
+    exe = pt.Executor(pt.CPUPlace())
+    (r2,) = exe.run(main, feed={"x": np.array([[0.0]], "float32")},
+                    fetch_list=[out])
+    assert abs(float(np.asarray(r2).reshape(())) - 0.1) < 1e-6
+
+
+def test_while_loop(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        i = pt.layers.fill_constant([1], "float32", 0.0)
+        ten = pt.layers.fill_constant([1], "float32", 10.0)
+
+        def cond(i):
+            return pt.layers.less_than(i, ten)
+
+        def body(i):
+            return pt.layers.elementwise_add(i, pt.layers.fill_constant([1], "float32", 1.0))
+
+        out = pt.layers.while_loop(cond, body, [i])
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    res = exe.run(main, feed={}, fetch_list=[out[0]])[0]
+    assert float(np.asarray(res).reshape(())) == 10.0
+
+
+def test_layer_norm_layer(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[6], dtype="float32")
+        out = pt.layers.layer_norm(input=x)
+    X = rng.rand(3, 6).astype("float32")
+    (res,) = _run(main, startup, {"x": X}, [out])
+    np.testing.assert_allclose(res.mean(-1), np.zeros(3), atol=1e-5)
+    np.testing.assert_allclose(res.std(-1), np.ones(3), atol=1e-2)
+
+
+def test_dropout_is_test_flag(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[50], dtype="float32")
+        out = pt.layers.dropout(
+            x, dropout_prob=0.5, dropout_implementation="upscale_in_train")
+    infer = main.clone(for_test=True)
+    X = np.ones((4, 50), "float32")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    train_out = exe.run(main, feed={"x": X}, fetch_list=[out])[0]
+    infer_out = exe.run(infer, feed={"x": X}, fetch_list=[out])[0]
+    assert (np.asarray(train_out) == 0).any()
+    np.testing.assert_allclose(infer_out, X)

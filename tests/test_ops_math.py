@@ -1,0 +1,188 @@
+"""Math / elementwise / reduce op tests vs numpy (reference:
+test_elementwise_*_op.py, test_matmul_op.py, test_reduce_op.py...)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest, check_grad, run_op
+
+
+class TestElementwiseAdd(OpTest):
+    op_type = "elementwise_add"
+
+    def setup(self, rng):
+        self.inputs = {"X": rng.rand(3, 4).astype("float32"),
+                       "Y": rng.rand(3, 4).astype("float32")}
+        self.outputs = {"Out": self.inputs["X"] + self.inputs["Y"]}
+
+    def test_fwd_and_grad(self, rng):
+        self.setup(rng)
+        self.check_output()
+        self.check_grad(["X", "Y"])
+
+
+class TestElementwiseAddBroadcastAxis(OpTest):
+    op_type = "elementwise_add"
+
+    def test_axis_broadcast(self, rng):
+        # reference broadcast: y aligned at axis=1 (elementwise_op_function.h)
+        x = rng.rand(2, 3, 4).astype("float32")
+        y = rng.rand(3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y.reshape(1, 3, 1)}
+        self.check_output()
+
+
+@pytest.mark.parametrize("op,fn", [
+    ("elementwise_sub", np.subtract),
+    ("elementwise_mul", np.multiply),
+    ("elementwise_div", np.divide),
+    ("elementwise_max", np.maximum),
+    ("elementwise_min", np.minimum),
+])
+def test_elementwise_family(rng, op, fn):
+    x = (rng.rand(4, 5) + 0.5).astype("float32")
+    y = (rng.rand(4, 5) + 0.5).astype("float32")
+    got = run_op(op, {"X": x, "Y": y})["Out"][0]
+    np.testing.assert_allclose(got, fn(x, y), rtol=1e-5)
+    check_grad(op, {"X": x, "Y": y}, {}, ["X", "Y"])
+
+
+def test_mul_flattens(rng):
+    x = rng.rand(2, 3, 4).astype("float32")
+    y = rng.rand(12, 5).astype("float32")
+    got = run_op("mul", {"X": x, "Y": y},
+                 {"x_num_col_dims": 1, "y_num_col_dims": 1})["Out"][0]
+    np.testing.assert_allclose(got, x.reshape(2, 12) @ y, rtol=1e-4)
+    check_grad("mul", {"X": x, "Y": y},
+               {"x_num_col_dims": 1, "y_num_col_dims": 1}, ["X", "Y"],
+               max_relative_error=1e-2)
+
+
+def test_matmul_transpose(rng):
+    x = rng.rand(3, 4).astype("float32")
+    y = rng.rand(5, 4).astype("float32")
+    got = run_op("matmul", {"X": x, "Y": y},
+                 {"transpose_X": False, "transpose_Y": True})["Out"][0]
+    np.testing.assert_allclose(got, x @ y.T, rtol=1e-4)
+
+
+def test_matmul_batched(rng):
+    x = rng.rand(2, 3, 4).astype("float32")
+    y = rng.rand(2, 4, 5).astype("float32")
+    got = run_op("matmul", {"X": x, "Y": y})["Out"][0]
+    np.testing.assert_allclose(got, x @ y, rtol=1e-4)
+    check_grad("matmul", {"X": x, "Y": y}, {}, ["X", "Y"], max_relative_error=1e-2)
+
+
+@pytest.mark.parametrize("op,npfn", [
+    ("reduce_sum", np.sum),
+    ("reduce_mean", np.mean),
+    ("reduce_max", np.max),
+    ("reduce_min", np.min),
+    ("reduce_prod", np.prod),
+])
+def test_reduce_family(rng, op, npfn):
+    x = (rng.rand(3, 4, 5) + 0.1).astype("float32")
+    got = run_op(op, {"X": x}, {"dim": [1], "keep_dim": False})["Out"][0]
+    np.testing.assert_allclose(got, npfn(x, axis=1), rtol=1e-5)
+    got_all = run_op(op, {"X": x}, {"reduce_all": True})["Out"][0]
+    np.testing.assert_allclose(got_all, npfn(x), rtol=1e-5)
+
+
+def test_reduce_sum_grad(rng):
+    x = rng.rand(3, 4).astype("float32")
+    check_grad("reduce_sum", {"X": x}, {"dim": [0], "keep_dim": False}, ["X"])
+
+
+def test_sum_multi_input(rng):
+    xs = [rng.rand(2, 3).astype("float32") for _ in range(3)]
+    got = run_op("sum", {"X": xs})["Out"][0]
+    np.testing.assert_allclose(got, sum(xs), rtol=1e-6)
+
+
+def test_scale_bias(rng):
+    x = rng.rand(3, 3).astype("float32")
+    got = run_op("scale", {"X": x}, {"scale": 2.0, "bias": 1.0,
+                                     "bias_after_scale": False})["Out"][0]
+    np.testing.assert_allclose(got, (x + 1.0) * 2.0, rtol=1e-6)
+
+
+def test_cast():
+    x = np.arange(6, dtype="float32").reshape(2, 3)
+    got = run_op("cast", {"X": x}, {"out_dtype": "int64"})["Out"][0]
+    assert got.dtype == np.int64
+
+
+def test_softmax_and_grad(rng):
+    x = rng.rand(4, 7).astype("float32")
+    got = run_op("softmax", {"X": x})["Out"][0]
+    e = np.exp(x - x.max(-1, keepdims=True))
+    np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True), rtol=1e-5)
+    check_grad("softmax", {"X": x}, {}, ["X"])
+
+
+def test_log_softmax(rng):
+    x = rng.rand(4, 7).astype("float32")
+    got = run_op("log_softmax", {"X": x})["Out"][0]
+    e = np.exp(x - x.max(-1, keepdims=True))
+    np.testing.assert_allclose(got, np.log(e / e.sum(-1, keepdims=True)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_transpose_reshape_concat_split(rng):
+    x = rng.rand(2, 3, 4).astype("float32")
+    got = run_op("transpose2", {"X": x}, {"axis": [2, 0, 1]},
+                 outputs=("Out",))["Out"][0]
+    np.testing.assert_array_equal(got, x.transpose(2, 0, 1))
+
+    got = run_op("reshape2", {"X": x}, {"shape": [6, 4]}, outputs=("Out",))["Out"][0]
+    np.testing.assert_array_equal(got, x.reshape(6, 4))
+
+    a, b = rng.rand(2, 3).astype("float32"), rng.rand(2, 5).astype("float32")
+    got = run_op("concat", {"X": [a, b]}, {"axis": 1})["Out"][0]
+    np.testing.assert_array_equal(got, np.concatenate([a, b], 1))
+
+    parts = run_op("split", {"X": got}, {"num": 2, "axis": 1},
+                   outputs=("Out",))["Out"]
+    assert len(parts) == 2 and parts[0].shape == (2, 4)
+
+
+def test_topk_argmax(rng):
+    x = rng.rand(3, 10).astype("float32")
+    out = run_op("top_k", {"X": x}, {"k": 3}, outputs=("Out", "Indices"))
+    np.testing.assert_allclose(out["Out"][0], np.sort(x, -1)[:, ::-1][:, :3],
+                               rtol=1e-6)
+    got = run_op("arg_max", {"X": x}, {"axis": 1})["Out"][0]
+    np.testing.assert_array_equal(got, x.argmax(1))
+
+
+def test_activation_ops(rng):
+    x = (rng.rand(3, 4).astype("float32") - 0.5) * 4
+    for op, fn in [
+        ("relu", lambda v: np.maximum(v, 0)),
+        ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+        ("tanh", np.tanh),
+        ("exp", np.exp),
+        ("sqrt", np.sqrt),
+    ]:
+        inp = np.abs(x) + 1.0 if op == "sqrt" else x
+        got = run_op(op, {"X": inp})["Out"][0]
+        np.testing.assert_allclose(got, fn(inp), rtol=1e-4, atol=1e-5,
+                                   err_msg=op)
+    check_grad("tanh", {"X": x}, {}, ["X"])
+
+
+def test_gather_scatter(rng):
+    x = rng.rand(5, 3).astype("float32")
+    idx = np.array([0, 2, 4], "int64")
+    got = run_op("gather", {"X": x, "Index": idx})["Out"][0]
+    np.testing.assert_array_equal(got, x[idx])
+
+
+def test_lookup_table(rng):
+    w = rng.rand(10, 4).astype("float32")
+    ids = np.array([[1], [3], [7]], "int64")
+    got = run_op("lookup_table", {"W": w, "Ids": ids})["Out"][0]
+    np.testing.assert_allclose(got.reshape(3, 4), w[[1, 3, 7]], rtol=1e-6)

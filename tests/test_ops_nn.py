@@ -1,0 +1,194 @@
+"""NN op tests vs numpy (reference: test_conv2d_op.py, test_batch_norm_op.py,
+test_layer_norm_op.py, test_pool2d_op.py, test_cross_entropy_op.py...)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_op
+
+
+def _np_conv2d(x, w, stride, pad):
+    n, c, h, wdt = x.shape
+    oc, ic, kh, kw = w.shape
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wdt + 2 * pad - kw) // stride + 1
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    out = np.zeros((n, oc, oh, ow), x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh, j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+def test_conv2d_matches_numpy(rng):
+    x = rng.rand(2, 3, 8, 8).astype("float32")
+    w = rng.rand(4, 3, 3, 3).astype("float32")
+    got = run_op("conv2d", {"Input": x, "Filter": w},
+                 {"strides": [2, 2], "paddings": [1, 1]},
+                 outputs=("Output",))["Output"][0]
+    want = _np_conv2d(x, w, 2, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv2d_grad(rng):
+    x = rng.rand(1, 2, 5, 5).astype("float32")
+    w = rng.rand(3, 2, 3, 3).astype("float32")
+    check_grad("conv2d", {"Input": x, "Filter": w},
+               {"strides": [1, 1], "paddings": [1, 1]},
+               ["Input", "Filter"], output_name="Output",
+               output_names=["Output"], max_relative_error=2e-2, delta=1e-2)
+
+
+def test_depthwise_conv2d(rng):
+    x = rng.rand(2, 3, 6, 6).astype("float32")
+    w = rng.rand(3, 1, 3, 3).astype("float32")
+    got = run_op("depthwise_conv2d", {"Input": x, "Filter": w},
+                 {"strides": [1, 1], "paddings": [1, 1], "groups": 3},
+                 outputs=("Output",))["Output"][0]
+    assert got.shape == (2, 3, 6, 6)
+    # per-channel conv equals grouped conv
+    for c in range(3):
+        want = _np_conv2d(x[:, c:c + 1], w[c:c + 1], 1, 1)
+        np.testing.assert_allclose(got[:, c:c + 1], want, rtol=1e-4, atol=1e-5)
+
+
+def test_pool2d(rng):
+    x = rng.rand(2, 3, 4, 4).astype("float32")
+    got = run_op("pool2d", {"X": x},
+                 {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2]})["Out"][0]
+    want = x.reshape(2, 3, 2, 2, 2, 2).max(5).max(3)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    got = run_op("pool2d", {"X": x},
+                 {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2]})["Out"][0]
+    want = x.reshape(2, 3, 2, 2, 2, 2).mean(5).mean(3)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    got = run_op("pool2d", {"X": x}, {"pooling_type": "avg", "global_pooling": True})["Out"][0]
+    np.testing.assert_allclose(got, x.mean((2, 3), keepdims=True), rtol=1e-5)
+
+
+def test_batch_norm_train_and_infer(rng):
+    x = rng.rand(4, 3, 5, 5).astype("float32")
+    scale = rng.rand(3).astype("float32")
+    bias = rng.rand(3).astype("float32")
+    mean = np.zeros(3, "float32")
+    var = np.ones(3, "float32")
+
+    outs = run_op("batch_norm",
+                  {"X": x, "Scale": scale, "Bias": bias, "Mean": mean,
+                   "Variance": var},
+                  {"epsilon": 1e-5, "momentum": 0.9, "is_test": False},
+                  outputs=("Y", "MeanOut", "VarianceOut", "SavedMean",
+                           "SavedVariance"))
+    bm = x.mean((0, 2, 3))
+    bv = x.var((0, 2, 3))
+    want = (x - bm.reshape(1, 3, 1, 1)) / np.sqrt(bv.reshape(1, 3, 1, 1) + 1e-5)
+    want = want * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+    np.testing.assert_allclose(outs["Y"][0], want, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(outs["MeanOut"][0], 0.9 * mean + 0.1 * bm, rtol=1e-4)
+
+    # inference path uses running stats
+    outs = run_op("batch_norm",
+                  {"X": x, "Scale": scale, "Bias": bias, "Mean": bm,
+                   "Variance": bv},
+                  {"epsilon": 1e-5, "is_test": True},
+                  outputs=("Y",), is_test=True)
+    np.testing.assert_allclose(outs["Y"][0], want, rtol=1e-4, atol=1e-5)
+
+
+def test_layer_norm(rng):
+    x = rng.rand(4, 10).astype("float32")
+    scale = rng.rand(10).astype("float32")
+    bias = rng.rand(10).astype("float32")
+    got = run_op("layer_norm", {"X": x, "Scale": scale, "Bias": bias},
+                 {"epsilon": 1e-5, "begin_norm_axis": 1},
+                 outputs=("Y",))["Y"][0]
+    mu = x.mean(-1, keepdims=True)
+    sig = x.var(-1, keepdims=True)
+    want = (x - mu) / np.sqrt(sig + 1e-5) * scale + bias
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_layer_norm_grad(rng):
+    x = rng.rand(3, 6).astype("float32")
+    scale = rng.rand(6).astype("float32")
+    bias = rng.rand(6).astype("float32")
+    check_grad("layer_norm", {"X": x, "Scale": scale, "Bias": bias},
+               {"epsilon": 1e-5, "begin_norm_axis": 1},
+               ["X", "Scale", "Bias"], output_name="Y", output_names=["Y"],
+               max_relative_error=2e-2, delta=1e-2)
+
+
+def test_dropout_train_vs_test(rng):
+    x = np.ones((100, 100), "float32")
+    # downgrade_in_infer (default): inference scales by (1-p), dropout_op.cc
+    got_test = run_op("dropout", {"X": x}, {"dropout_prob": 0.3},
+                      is_test=True)["Out"][0]
+    np.testing.assert_allclose(got_test, x * 0.7, rtol=1e-6)
+    got_test = run_op("dropout", {"X": x},
+                      {"dropout_prob": 0.3,
+                       "dropout_implementation": "upscale_in_train"},
+                      is_test=True)["Out"][0]
+    np.testing.assert_allclose(got_test, x)
+    got = run_op("dropout", {"X": x},
+                 {"dropout_prob": 0.3,
+                  "dropout_implementation": "upscale_in_train"},
+                 rng_seed=3)["Out"][0]
+    keep = (got != 0).mean()
+    assert abs(keep - 0.7) < 0.05
+    nz = got[got != 0]
+    np.testing.assert_allclose(nz, np.full_like(nz, 1 / 0.7), rtol=1e-5)
+
+
+def test_cross_entropy_and_softmax_with_ce(rng):
+    logits = rng.rand(5, 7).astype("float32")
+    labels = rng.randint(0, 7, (5, 1)).astype("int64")
+    sm = np.exp(logits - logits.max(-1, keepdims=True))
+    sm = sm / sm.sum(-1, keepdims=True)
+    want = -np.log(sm[np.arange(5), labels[:, 0]]).reshape(5, 1)
+
+    got = run_op("cross_entropy", {"X": sm, "Label": labels},
+                 {"soft_label": False})["Y"][0]
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    outs = run_op("softmax_with_cross_entropy",
+                  {"Logits": logits, "Label": labels},
+                  outputs=("Softmax", "Loss"))
+    np.testing.assert_allclose(outs["Loss"][0], want, rtol=1e-4)
+    np.testing.assert_allclose(outs["Softmax"][0], sm, rtol=1e-4)
+
+
+def test_sigmoid_cross_entropy_with_logits(rng):
+    x = rng.randn(4, 3).astype("float32")
+    label = rng.rand(4, 3).astype("float32")
+    got = run_op("sigmoid_cross_entropy_with_logits",
+                 {"X": x, "Label": label})["Out"][0]
+    want = np.maximum(x, 0) - x * label + np.log1p(np.exp(-np.abs(x)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_grad_is_dense_scatter(rng):
+    w = rng.rand(8, 4).astype("float32")
+    ids = np.array([[1], [3], [1]], "int64")
+    check_grad("lookup_table", {"W": w, "Ids": ids}, {}, ["W"],
+               max_relative_error=1e-2)
+
+
+def test_interpolate(rng):
+    x = rng.rand(1, 1, 2, 2).astype("float32")
+    got = run_op("nearest_interp", {"X": x},
+                 {"out_h": 4, "out_w": 4, "interp_method": "nearest"})["Out"][0]
+    assert got.shape == (1, 1, 4, 4)
+    np.testing.assert_allclose(got[0, 0, :2, :2],
+                               np.repeat(np.repeat(x[0, 0, :1, :1], 2, 0), 2, 1),
+                               rtol=1e-6)
+
+
+def test_one_hot():
+    ids = np.array([[0], [2], [1]], "int64")
+    got = run_op("one_hot", {"X": ids}, {"depth": 4})["Out"][0]
+    want = np.zeros((3, 4), "float32")
+    want[np.arange(3), ids[:, 0]] = 1
+    np.testing.assert_allclose(got.reshape(3, 4), want)
