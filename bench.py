@@ -1,0 +1,98 @@
+"""Flagship benchmark: BERT-base pretraining step throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = achieved MFU / 0.50 (the north-star target from BASELINE.json:
+>=50% MFU on v5e; the reference publishes no TPU numbers, so the target
+ratio is the comparison point).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+
+# Fast counter-based PRNG: threefry costs ~25% of the BERT step (dropout
+# masks); rbg is the standard choice for TPU training loops.
+jax.config.update("jax_default_prng_impl", "unsafe_rbg")
+
+import jax.numpy as jnp  # noqa: E402
+
+# v5e (v5 lite) peak bf16 matmul throughput per chip.
+PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12, "gpu": 100e12}
+
+
+def main():
+    import optax
+
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel import MeshConfig, make_mesh, mesh_guard
+    from paddle_tpu.parallel.train import TrainStrategy, make_train_step
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    cfg = bert.BertConfig.base() if on_tpu else bert.BertConfig.tiny()
+    seq_len = 128 if on_tpu else 64
+    batch_sizes = [256, 512, 128, 64, 32] if on_tpu else [16]
+
+    mesh = make_mesh(MeshConfig(dp=-1), devices=jax.devices()[:1]) \
+        if len(jax.devices()) == 1 else make_mesh(MeshConfig(dp=-1))
+    n_chips = mesh.devices.size
+
+    params, axes = bert.init(jax.random.key(0), cfg)
+
+    def loss_fn(p, batch, rng):
+        return bert.pretrain_loss(p, cfg, batch, rng=rng, deterministic=False)
+
+    last_err = None
+    for bs in batch_sizes:
+        try:
+            with mesh_guard(mesh):
+                init_state, step = make_train_step(
+                    loss_fn, optax.adamw(1e-4), mesh, axes,
+                    strategy=TrainStrategy(shard_optimizer_states=True))
+                state = init_state(params)
+                batch = bert.make_batch(jax.random.key(1), cfg,
+                                        batch_size=bs, seq_len=seq_len)
+                # warmup / compile (float() forces host sync — on tunneled
+                # backends block_until_ready can return before execution)
+                state, loss = step(state, batch, jax.random.key(2))
+                float(loss)
+                n_steps = 20 if on_tpu else 3
+                t0 = time.perf_counter()
+                for i in range(n_steps):
+                    state, loss = step(state, batch, jax.random.key(3 + i))
+                final_loss = float(loss)  # syncs the whole chain
+                dt = time.perf_counter() - t0
+            samples_per_sec = bs * n_steps / dt
+            sps_chip = samples_per_sec / n_chips
+            n_masked = batch["masked_positions"].shape[1]
+            mfu = (samples_per_sec * cfg.train_flops_per_seq(seq_len, n_masked) /
+                   (n_chips * PEAK_FLOPS.get(platform, 1e12)))
+            print(json.dumps({
+                "metric": "bert_base_train_samples_per_sec_per_chip"
+                          if on_tpu else "bert_tiny_cpu_samples_per_sec",
+                "value": round(sps_chip, 2),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(mfu / 0.50, 4),
+                "detail": {"batch_size": bs, "seq_len": seq_len,
+                           "chips": n_chips, "platform": platform,
+                           "mfu": round(mfu, 4),
+                           "step_ms": round(1000 * dt / n_steps, 2),
+                           "final_loss": final_loss},
+            }))
+            return 0
+        except Exception as e:  # OOM → try smaller batch
+            last_err = e
+            continue
+    print(json.dumps({"metric": "bert_base_train_samples_per_sec_per_chip",
+                      "value": 0.0, "unit": "samples/s/chip",
+                      "vs_baseline": 0.0,
+                      "error": str(last_err)[:200]}))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

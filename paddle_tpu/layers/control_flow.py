@@ -284,14 +284,8 @@ class StaticRNN:
         out_names = [o.name for o in self._outputs]
 
         # params read inside the block get grads via Extra
-        defined = set(seq_names) | set(state_names)
-        extra_names = []
-        for op in self._block.desc.ops:
-            for n in op.input_names():
-                if n and n not in defined and n not in extra_names:
-                    if program.global_block().has_var(n):
-                        extra_names.append(n)
-            defined.update(op.output_names())
+        extra_names = _outer_reads(program, (self._block,),
+                                   bound_names=seq_names + state_names)
         extra_vars = [program.global_block().var(n) for n in extra_names]
 
         results = []

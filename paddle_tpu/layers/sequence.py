@@ -91,11 +91,22 @@ def sequence_concat(input, name=None):
 
 
 def sequence_slice(input, offset, length, name=None):
+    """`length` must be a static int (XLA shapes are static); `offset` may be
+    an int or a traced Variable (lowered to lax.dynamic_slice)."""
+    if not isinstance(length, int):
+        raise ValueError(
+            "sequence_slice requires a static int length on TPU (the output "
+            "shape must be known at compile time); got a Variable")
     helper = LayerHelper("sequence_slice", name=name)
     out = helper.create_variable_for_type_inference(input.dtype)
-    helper.append_op(type="sequence_slice",
-                     inputs={"X": input, "Offset": offset, "Length": length},
-                     outputs={"Out": out}, attrs={})
+    inputs = {"X": input}
+    attrs = {"length": int(length)}
+    if isinstance(offset, int):
+        attrs["offset"] = offset
+    else:
+        inputs["Offset"] = offset
+    helper.append_op(type="sequence_slice", inputs=inputs,
+                     outputs={"Out": out}, attrs=attrs)
     return out
 
 

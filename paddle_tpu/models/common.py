@@ -1,0 +1,113 @@
+"""Shared building blocks for the JAX-native model zoo.
+
+Params are flat dicts {name: array}; logical sharding axes are returned
+alongside as {name: (logical axes...)} consumed by
+parallel.sharding.shard_params_spec. This mirrors how the reference keeps
+parameters in a Scope keyed by name (framework/scope.h) rather than nested
+module trees — and keeps checkpoint compatibility with the Program path
+trivial (same flat names).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, jax.Array]
+ParamAxes = Dict[str, Tuple[Optional[str], ...]]
+
+
+class ParamStore:
+    """Accumulates params + logical axes during init."""
+
+    def __init__(self, rng: jax.Array, dtype=jnp.float32):
+        self.rng = rng
+        self.dtype = dtype
+        self.params: Params = {}
+        self.axes: ParamAxes = {}
+
+    def next_rng(self) -> jax.Array:
+        self.rng, k = jax.random.split(self.rng)
+        return k
+
+    def add(self, name: str, value: jax.Array, axes: Tuple[Optional[str], ...]):
+        assert name not in self.params, f"duplicate param {name}"
+        assert value.ndim == len(axes), (name, value.shape, axes)
+        self.params[name] = value
+        self.axes[name] = axes
+        return value
+
+    def dense(self, name: str, d_in: int, d_out: int,
+              axes=("embed", "mlp"), bias: bool = True,
+              init_scale: Optional[float] = None):
+        scale = init_scale if init_scale is not None else math.sqrt(2.0 / (d_in + d_out))
+        w = jax.random.normal(self.next_rng(), (d_in, d_out), self.dtype) * scale
+        self.add(f"{name}.w", w, axes)
+        if bias:
+            self.add(f"{name}.b", jnp.zeros((d_out,), self.dtype), (axes[1],))
+
+    def layer_norm(self, name: str, dim: int, axis: Optional[str] = None):
+        self.add(f"{name}.scale", jnp.ones((dim,), self.dtype), (axis,))
+        self.add(f"{name}.bias", jnp.zeros((dim,), self.dtype), (axis,))
+
+    def embedding(self, name: str, vocab: int, dim: int,
+                  axes=("vocab", "embed"), scale: float = 0.02):
+        w = jax.random.normal(self.next_rng(), (vocab, dim), self.dtype) * scale
+        self.add(f"{name}.w", w, axes)
+
+    def conv(self, name: str, kh: int, kw: int, cin: int, cout: int,
+             axes=(None, None, None, "conv_out")):
+        fan_in = kh * kw * cin
+        w = jax.random.normal(self.next_rng(), (kh, kw, cin, cout),
+                              self.dtype) * math.sqrt(2.0 / fan_in)
+        self.add(f"{name}.w", w, axes)
+
+    def bn(self, name: str, dim: int):
+        self.add(f"{name}.scale", jnp.ones((dim,), self.dtype), (None,))
+        self.add(f"{name}.bias", jnp.zeros((dim,), self.dtype), (None,))
+        # running stats are non-trainable state, kept in the same dict with
+        # a marker prefix (filtered out of the optimizer by is_trainable)
+        self.add(f"{name}.mean", jnp.zeros((dim,), jnp.float32), (None,))
+        self.add(f"{name}.var", jnp.ones((dim,), jnp.float32), (None,))
+
+
+def is_trainable(name: str) -> bool:
+    return not (name.endswith(".mean") or name.endswith(".var"))
+
+
+def dense(params: Params, name: str, x: jax.Array, act=None) -> jax.Array:
+    w = params[f"{name}.w"]
+    y = x @ w.astype(x.dtype)
+    b = params.get(f"{name}.b")
+    if b is not None:
+        y = y + b.astype(y.dtype)
+    if act is not None:
+        y = act(y)
+    return y
+
+
+def layer_norm(params: Params, name: str, x: jax.Array, eps=1e-12) -> jax.Array:
+    # compute in fp32 for stability under bf16 activations
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + eps)
+    y = y * params[f"{name}.scale"].astype(jnp.float32) + \
+        params[f"{name}.bias"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def gelu(x):
+    return jax.nn.gelu(x, approximate=True)
+
+
+def dropout(rng: Optional[jax.Array], x: jax.Array, rate: float,
+            deterministic: bool) -> jax.Array:
+    if deterministic or rate == 0.0 or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0).astype(x.dtype)

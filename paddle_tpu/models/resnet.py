@@ -1,0 +1,150 @@
+"""ResNet v1.5 (50/101/152) — ImageNet CNN config of the ladder.
+
+Reference capability: ResNet-50 is the reference's flagship CV benchmark
+(contrib/float16/float16_benchmark.md:40; test_dist_se_resnext lineage).
+TPU-first design: NHWC layout (TPU conv native), bf16 activations, fused
+batch-norm as explicit scale/shift math (XLA fuses into the conv), batch
+stats via masked mean (sync-BN over 'dp' comes from GSPMD when the batch is
+sharded — BuildStrategy.sync_batch_norm for free).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding import shard
+from .common import ParamStore, Params, dense
+
+DEPTHS = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+
+@dataclasses.dataclass
+class ResNetConfig:
+    depth: int = 50
+    n_classes: int = 1000
+    width: int = 64
+    dtype: str = "bfloat16"
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+
+    @staticmethod
+    def resnet50():
+        return ResNetConfig(50)
+
+    @staticmethod
+    def tiny():
+        return ResNetConfig(depth=50, n_classes=10, width=8)
+
+    def flops_per_image(self, hw: int = 224) -> float:
+        # ~4.1 GFLOPs fwd for RN50@224 (scaled by width); x3 for training
+        base = 4.1e9 * (self.width / 64) ** 2 * (hw / 224) ** 2
+        return 3 * base * (1 if self.depth == 50 else self.depth / 50)
+
+
+def _bn_init(s: ParamStore, name: str, dim: int):
+    s.bn(name, dim)
+
+
+def init(rng: jax.Array, cfg: ResNetConfig) -> Tuple[Params, Dict]:
+    s = ParamStore(rng)
+    w = cfg.width
+    s.conv("stem", 7, 7, 3, w)
+    _bn_init(s, "stem.bn", w)
+    cin = w
+    for gi, blocks in enumerate(DEPTHS[cfg.depth]):
+        mid = w * (2 ** gi)
+        cout = mid * 4
+        for bi in range(blocks):
+            p = f"g{gi}.b{bi}"
+            s.conv(f"{p}.conv1", 1, 1, cin, mid)
+            _bn_init(s, f"{p}.bn1", mid)
+            s.conv(f"{p}.conv2", 3, 3, mid, mid)
+            _bn_init(s, f"{p}.bn2", mid)
+            s.conv(f"{p}.conv3", 1, 1, mid, cout)
+            _bn_init(s, f"{p}.bn3", cout)
+            if bi == 0:
+                s.conv(f"{p}.proj", 1, 1, cin, cout)
+                _bn_init(s, f"{p}.proj.bn", cout)
+            cin = cout
+    s.dense("head", cin, cfg.n_classes, axes=("embed", "vocab"))
+    return s.params, s.axes
+
+
+def _conv(params, name, x, stride=1, padding="SAME"):
+    w = params[f"{name}.w"].astype(x.dtype)
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _bn(params, state_updates, name, x, cfg, train: bool):
+    """BN in fp32; updates running stats into state_updates when training.
+    When the batch axis is sharded over 'dp', XLA computes the mean/var with
+    a cross-device reduction — sync-BN semantics by construction."""
+    xf = x.astype(jnp.float32)
+    if train:
+        mean = xf.mean((0, 1, 2))
+        var = xf.var((0, 1, 2))
+        m = cfg.bn_momentum
+        state_updates[f"{name}.mean"] = m * params[f"{name}.mean"] + (1 - m) * mean
+        state_updates[f"{name}.var"] = m * params[f"{name}.var"] + (1 - m) * var
+    else:
+        mean = params[f"{name}.mean"]
+        var = params[f"{name}.var"]
+    inv = jax.lax.rsqrt(var + cfg.bn_eps) * params[f"{name}.scale"]
+    y = (xf - mean) * inv + params[f"{name}.bias"]
+    return y.astype(x.dtype)
+
+
+def apply(params: Params, cfg: ResNetConfig, img: jax.Array,
+          train: bool = False) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """img [B, 3, H, W] (reference NCHW interface) -> (logits, bn_updates)."""
+    adt = jnp.dtype(cfg.dtype)
+    x = img.transpose(0, 2, 3, 1).astype(adt)  # NHWC
+    x = shard(x, ("batch", None, None, None))
+    upd: Dict[str, jax.Array] = {}
+    x = _conv(params, "stem", x, stride=2)
+    x = jax.nn.relu(_bn(params, upd, "stem.bn", x, cfg, train))
+    x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)), constant_values=-jnp.inf if False else 0)
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "VALID")
+    for gi, blocks in enumerate(DEPTHS[cfg.depth]):
+        for bi in range(blocks):
+            p = f"g{gi}.b{bi}"
+            stride = 2 if (bi == 0 and gi > 0) else 1
+            sc = x
+            if bi == 0:
+                sc = _conv(params, f"{p}.proj", x, stride=stride)
+                sc = _bn(params, upd, f"{p}.proj.bn", sc, cfg, train)
+            h = jax.nn.relu(_bn(params, upd, f"{p}.bn1",
+                                _conv(params, f"{p}.conv1", x), cfg, train))
+            h = jax.nn.relu(_bn(params, upd, f"{p}.bn2",
+                                _conv(params, f"{p}.conv2", h, stride=stride),
+                                cfg, train))
+            h = _bn(params, upd, f"{p}.bn3",
+                    _conv(params, f"{p}.conv3", h), cfg, train)
+            x = jax.nn.relu(h + sc)
+    x = x.mean((1, 2))  # global avg pool
+    logits = dense(params, "head", x.astype(jnp.float32))
+    return logits, upd
+
+
+def loss_fn(params: Params, cfg: ResNetConfig, batch, rng=None,
+            train: bool = True):
+    logits, upd = apply(params, cfg, batch["img"], train=train)
+    labels = batch["label"].reshape(-1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    loss = -jnp.take_along_axis(logp, labels[:, None], 1).mean()
+    return loss, upd
+
+
+def make_batch(rng: jax.Array, cfg: ResNetConfig, batch_size: int, hw: int = 224):
+    k1, k2 = jax.random.split(rng)
+    return {
+        "img": jax.random.normal(k1, (batch_size, 3, hw, hw), jnp.float32),
+        "label": jax.random.randint(k2, (batch_size,), 0, cfg.n_classes),
+    }

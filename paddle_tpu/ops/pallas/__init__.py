@@ -1,0 +1,10 @@
+"""Pallas TPU kernels (fused attention, top-k compression, ...).
+
+The reference's hand-written CUDA kernel zoo (operators/math/*.cu,
+operators/fused/) maps here: most fusion is XLA's job, Pallas covers the
+few patterns XLA can't fuse optimally (flash attention online-softmax,
+DGC top-k). Every kernel has an XLA fallback so CPU tests and non-TPU
+backends run the same code path semantically.
+"""
+
+from . import attention  # noqa: F401

@@ -1,0 +1,89 @@
+"""Fused multi-head attention.
+
+Reference: no TPU counterpart — the reference computes attention from
+unfused matmul/softmax ops (e.g. the BERT graph in
+inference/tests/api/analyzer_bert_tester.cc). TPU-native: a Pallas
+flash-attention kernel (online softmax, O(T) memory) on TPU backends, an
+XLA einsum+softmax fallback elsewhere. The fallback is semantically
+identical, so tests run on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _xla_mha(q, k, v, mask, scale):
+    """[B,T,N,H] attention via plain XLA ops (fallback + reference)."""
+    logits = jnp.einsum("btnh,bsnh->bnts", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bnts,bsnh->btnh", probs, v)
+
+
+def _use_pallas(q) -> bool:
+    try:
+        dev = q.devices() if hasattr(q, "devices") else None
+    except Exception:
+        dev = None
+    platform = None
+    if dev:
+        platform = next(iter(dev)).platform
+    else:
+        platform = jax.default_backend()
+    # flash pays off once the T×T score tile stops fitting comfortably in
+    # VMEM; at short T the unfused XLA softmax path is ~2x faster (measured
+    # T=128 BERT-base on v5e)
+    return platform == "tpu" and q.ndim == 4 and q.shape[1] >= 512
+
+
+def mha(q: jax.Array, k: jax.Array, v: jax.Array,
+        mask: Optional[jax.Array] = None, scale: Optional[float] = None,
+        causal: bool = False) -> jax.Array:
+    """Multi-head attention over [B, T, N, H] tensors.
+
+    mask: additive [B, 1, 1, T] or [B, N, T, T] (float, -inf style), or None.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if _use_pallas(q):
+        try:
+            return _pallas_mha(q, k, v, mask, scale, causal)
+        except Exception:  # fall back if kernel unsupported on this shape
+            pass
+    out = _xla_mha(q, k, v, mask if not causal else _merge_causal(mask, q.shape[1]), scale)
+    return out.astype(q.dtype)
+
+
+def _merge_causal(mask, T):
+    cm = jnp.where(jnp.tril(jnp.ones((T, T), jnp.bool_)), 0.0, -1e9)[None, None]
+    return cm if mask is None else mask + cm
+
+
+# ---------------------------------------------------------------------------
+# Pallas flash-attention kernel (TPU)
+# ---------------------------------------------------------------------------
+
+
+def _pallas_mha(q, k, v, mask, scale, causal):
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention)
+
+    # pallas kernel wants [B, N, T, H]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    ab = None
+    if mask is not None:
+        ab = jnp.broadcast_to(
+            mask.astype(jnp.float32),
+            (q.shape[0], q.shape[2], q.shape[1], k.shape[1]))
+    out = flash_attention(qt, kt, vt, ab=ab, causal=causal,
+                          sm_scale=float(scale))
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -105,10 +105,16 @@ def sequence_concat(ins, attrs, ctx):
 
 @register_op("sequence_slice")
 def sequence_slice(ins, attrs, ctx):
-    x, off, length = ins["X"][0], ins["Offset"][0], ins["Length"][0]
-    o = int(off.reshape(-1)[0])
-    l = int(length.reshape(-1)[0])
-    return {"Out": x[:, o:o + l]}
+    """Offset may be a traced tensor (lax.dynamic_slice); length must be
+    static (attr) — XLA output shapes are static."""
+    x = ins["X"][0]
+    length = int(attrs["length"])
+    off = ins["Offset"][0] if ins.get("Offset") else None
+    if off is None:
+        o = int(attrs.get("offset", 0))
+        return {"Out": jax.lax.slice_in_dim(x, o, o + length, axis=1)}
+    o = off.reshape(-1)[0].astype(jnp.int32)
+    return {"Out": jax.lax.dynamic_slice_in_dim(x, o, length, axis=1)}
 
 
 @register_op("im2sequence")

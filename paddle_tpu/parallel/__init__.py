@@ -1,0 +1,25 @@
+"""TPU-native parallelism.
+
+This package is the rebuild of the reference's entire multi-device stack —
+ParallelExecutor + multi_devices_graph_pass + NCCL op handles
+(paddle/fluid/framework/parallel_executor.cc, details/all_reduce_op_handle.cc)
+and the distributed frontend (python/paddle/fluid/transpiler/,
+incubate/fleet/) — on top of jax.sharding:
+
+- mesh.py      : device Mesh management (dp/tp/pp/sp/ep axes; ICI×DCN
+                 factorization replaces hierarchical allreduce)
+- sharding.py  : logical-axis sharding rules (the BuildStrategy equivalent)
+- train.py     : sharded train-step builder (the ParallelExecutor equivalent)
+- strategy.py  : fleet DistributedStrategy parity object
+- fleet.py     : fleet facade (init / distributed_optimizer / barriers)
+- launch.py    : multi-host launcher over jax.distributed.initialize
+"""
+
+from .mesh import (  # noqa: F401
+    MeshConfig, auto_mesh, current_mesh, get_mesh, mesh_guard, make_mesh,
+)
+from .sharding import (  # noqa: F401
+    LogicalRules, NO_SHARD, logical_to_mesh, shard, shard_params_spec,
+    with_rules, current_rules,
+)
+from . import collective  # noqa: F401

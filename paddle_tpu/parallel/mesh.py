@@ -1,0 +1,106 @@
+"""Device mesh management.
+
+Reference equivalents: NCCLContextMap/NCCLCommunicator ring construction
+(paddle/fluid/platform/nccl_helper.h:90,179) and the num_trainers/trainer_id
+rank math (parallel_executor.cc:469). On TPU a single `jax.sharding.Mesh`
+with named axes replaces all ring bookkeeping; XLA chooses the collective
+algorithm per axis.
+
+Axis conventions (used by models/ and __graft_entry__):
+  dp — data parallel (batch dim)         ↔ reference AllReduce builder
+  tp — tensor parallel (hidden dims)     ↔ absent in reference (free on TPU)
+  sp — sequence/context parallel         ↔ absent in reference
+  pp — pipeline stages                   ↔ PipelineTrainer/SectionWorker
+  ep — expert parallel (MoE)             ↔ absent in reference
+
+The hierarchical-allreduce knob (BuildStrategy.use_hierarchical_allreduce,
+nccl_helper.h:246) maps to mesh factorization: put DCN-connected hosts on the
+outer axis of `create_hybrid_device_mesh` so 'dp' gradients reduce
+intra-slice over ICI first.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")  # outer (slow, DCN-ish) → inner (ICI)
+
+
+@dataclasses.dataclass
+class MeshConfig:
+    """Named axis sizes; -1 on one axis = absorb remaining devices."""
+
+    dp: int = -1
+    tp: int = 1
+    pp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = {a: getattr(self, a) for a in AXIS_ORDER}
+        fixed = [a for a, s in sizes.items() if s != -1]
+        free = [a for a, s in sizes.items() if s == -1]
+        prod = math.prod(sizes[a] for a in fixed)
+        if free:
+            if len(free) > 1:
+                raise ValueError("at most one mesh axis may be -1")
+            if n_devices % prod:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}")
+            sizes[free[0]] = n_devices // prod
+        elif prod != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {prod} devices, have {n_devices}")
+        return sizes
+
+
+def make_mesh(config: Optional[MeshConfig] = None,
+              devices: Optional[Sequence] = None,
+              **axis_sizes) -> Mesh:
+    """Build a Mesh with the standard axis order. `make_mesh(dp=4, tp=2)`."""
+    if config is None:
+        config = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig()
+    devices = list(devices) if devices is not None else jax.devices()
+    sizes = config.resolve(len(devices))
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AXIS_ORDER)
+
+
+def auto_mesh(n_devices: Optional[int] = None, model_parallel: int = 1) -> Mesh:
+    """Data-parallel mesh with optional inner tensor-parallel axis —
+    the default the reference's ParallelExecutor gives you."""
+    devs = jax.devices()[:n_devices] if n_devices else jax.devices()
+    return make_mesh(MeshConfig(dp=-1, tp=model_parallel), devices=devs)
+
+
+_mesh_stack: List[Mesh] = []
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _mesh_stack[-1] if _mesh_stack else None
+
+
+def get_mesh() -> Mesh:
+    m = current_mesh()
+    if m is None:
+        m = auto_mesh()
+        _mesh_stack.append(m)
+    return m
+
+
+@contextlib.contextmanager
+def mesh_guard(mesh: Mesh):
+    _mesh_stack.append(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _mesh_stack.pop()

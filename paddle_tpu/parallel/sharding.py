@@ -1,0 +1,110 @@
+"""Logical-axis sharding rules.
+
+The reference expresses device placement imperatively — graph passes clone
+ops per device and insert collectives (ir/multi_devices_graph_pass/). The
+TPU-native equivalent is declarative: tensors carry *logical* axis names
+("batch", "embed", "mlp", ...) and a rule table maps logical axes to mesh
+axes; GSPMD inserts the collectives. This is the BuildStrategy of the
+rebuild: switching dp→dp+tp is a rule-table change, not a graph rewrite.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Logical axis marker for "never shard this axis".
+NO_SHARD = None
+
+LogicalAxes = Tuple[Optional[str], ...]
+
+
+class LogicalRules:
+    """Ordered mapping logical-axis-name -> mesh axis (or None)."""
+
+    def __init__(self, rules: Union[Dict[str, Optional[str]],
+                                    Sequence[Tuple[str, Optional[str]]]]):
+        self._rules = dict(rules)
+
+    def mesh_axis(self, logical: Optional[str]) -> Optional[str]:
+        if logical is None:
+            return None
+        return self._rules.get(logical)
+
+    def spec(self, axes: Sequence[Optional[str]]) -> P:
+        return P(*(self.mesh_axis(a) for a in axes))
+
+    def updated(self, **kw) -> "LogicalRules":
+        d = dict(self._rules)
+        d.update(kw)
+        return LogicalRules(d)
+
+    def __repr__(self):
+        return f"LogicalRules({self._rules})"
+
+
+# The default rule table used by models/: megatron-style TP + batch DP + SP.
+DEFAULT_RULES = LogicalRules({
+    "batch": "dp",
+    "seq": "sp",          # sequence/context parallelism
+    "embed": None,        # hidden dim of activations stays replicated-ish
+    "heads": "tp",
+    "kv": None,
+    "mlp": "tp",
+    "vocab": "tp",
+    "expert": "ep",
+    "stage": "pp",
+    "conv_out": None,
+})
+
+_rules_stack: List[LogicalRules] = []
+
+
+def current_rules() -> LogicalRules:
+    return _rules_stack[-1] if _rules_stack else DEFAULT_RULES
+
+
+@contextlib.contextmanager
+def with_rules(rules: LogicalRules):
+    _rules_stack.append(rules)
+    try:
+        yield rules
+    finally:
+        _rules_stack.pop()
+
+
+def logical_to_mesh(axes: Sequence[Optional[str]],
+                    rules: Optional[LogicalRules] = None) -> P:
+    return (rules or current_rules()).spec(axes)
+
+
+def shard(x, axes: Sequence[Optional[str]],
+          rules: Optional[LogicalRules] = None):
+    """Annotate a traced value with a sharding constraint by logical axes —
+    the in-graph replacement for the reference's per-device graph cloning.
+    No-op outside a mesh_guard (single-device eager use)."""
+    from .mesh import current_mesh
+
+    mesh = current_mesh()
+    if mesh is None:
+        return x
+    spec = logical_to_mesh(axes, rules)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def shard_params_spec(param_axes: Dict[str, LogicalAxes],
+                      rules: Optional[LogicalRules] = None) -> Dict[str, P]:
+    """Map {param name: logical axes} -> {param name: PartitionSpec}."""
+    rules = rules or current_rules()
+    return {k: rules.spec(v) for k, v in param_axes.items()}
+
+
+def named_sharding_tree(mesh: Mesh, spec_tree):
+    """PartitionSpec pytree -> NamedSharding pytree."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda s: isinstance(s, P))

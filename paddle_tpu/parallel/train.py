@@ -1,0 +1,227 @@
+"""Sharded train-step builder — the ParallelExecutor of the rebuild.
+
+Reference: ParallelExecutor clones the graph per device and inserts NCCL
+all-reduces (parallel_executor.cc, multi_devices_graph_pass.cc:454). Here ONE
+jit over a Mesh with NamedShardings on params/optimizer state/batch does the
+same: GSPMD partitions the computation and inserts the collectives. The
+BuildStrategy knobs map to:
+
+  reduce_strategy AllReduce ↔ optimizer state replicated over 'dp'
+  reduce_strategy Reduce    ↔ optimizer state sharded over 'dp' (ZeRO-1)
+  gradient merge / batch-merge pass ↔ accum_steps (lax.scan of microbatches)
+  recompute ↔ jax.checkpoint on the loss fn
+  AMP ↔ bf16 activations in the model + fp32 params here
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.common import Params, ParamAxes, is_trainable
+from .sharding import LogicalRules, current_rules, named_sharding_tree
+
+
+@dataclasses.dataclass
+class TrainStrategy:
+    """The rebuild's BuildStrategy (details/build_strategy.h:37)."""
+
+    shard_optimizer_states: bool = True   # Reduce/ZeRO-1 vs AllReduce
+    accum_steps: int = 1                  # gradient merge (multi_batch_merge_pass)
+    recompute: bool = False               # RecomputeOptimizer
+    clip_global_norm: Optional[float] = None
+
+
+class TrainState:
+    """params + opt state + step, all sharded."""
+
+    def __init__(self, params, opt_state, step):
+        self.params = params
+        self.opt_state = opt_state
+        self.step = step
+
+    def tree_flatten(self):
+        return (self.params, self.opt_state, self.step), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    TrainState, TrainState.tree_flatten, TrainState.tree_unflatten)
+
+
+def param_shardings(mesh: Mesh, axes: ParamAxes,
+                    rules: Optional[LogicalRules] = None) -> Dict[str, NamedSharding]:
+    rules = rules or current_rules()
+    return {k: NamedSharding(mesh, rules.spec(v)) for k, v in axes.items()}
+
+
+def opt_state_sharding_like(opt_state, pspec_of_param, mesh: Mesh,
+                            shard_over_dp: bool):
+    """Optimizer moments inherit their param's spec; scalars replicated.
+    With shard_over_dp (ZeRO-1), moments additionally shard their first
+    unsharded axis over 'dp'."""
+
+    def one(leaf_path_spec):
+        return leaf_path_spec
+
+    def spec_for(leaf, pspec: P):
+        if not hasattr(leaf, "ndim") or leaf.ndim == 0:
+            return NamedSharding(mesh, P())
+        spec = list(pspec) + [None] * (leaf.ndim - len(pspec))
+        if shard_over_dp:
+            # shard the largest unsharded dim over dp if divisible
+            for i, s in enumerate(spec):
+                if s is None and leaf.shape[i] % mesh.shape["dp"] == 0 and \
+                        leaf.shape[i] >= mesh.shape["dp"]:
+                    spec[i] = "dp"
+                    break
+        return NamedSharding(mesh, P(*spec))
+
+    return spec_for
+
+
+def make_train_step(
+    loss_fn: Callable[[Params, Dict[str, jax.Array], jax.Array], jax.Array],
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    param_axes: ParamAxes,
+    rules: Optional[LogicalRules] = None,
+    strategy: Optional[TrainStrategy] = None,
+    batch_spec: Optional[P] = None,
+    has_aux: bool = False,
+):
+    """Returns (init_state_fn, step_fn).
+
+    loss_fn(params, batch, rng) -> scalar loss. step_fn(state, batch, rng)
+    -> (state, loss), jitted over `mesh` with full shardings.
+    """
+    strategy = strategy or TrainStrategy()
+    rules = rules or current_rules()
+    p_shardings = param_shardings(mesh, param_axes, rules)
+    batch_spec = batch_spec if batch_spec is not None else rules.spec(("batch", "seq"))
+    repl = NamedSharding(mesh, P())
+
+    if strategy.recompute:
+        loss_fn = jax.checkpoint(loss_fn)
+
+    tx = optimizer
+    if strategy.clip_global_norm:
+        tx = optax.chain(optax.clip_by_global_norm(strategy.clip_global_norm),
+                         optimizer)
+
+    def mask_fn(params):
+        return {k: is_trainable(k) for k in params}
+
+    tx = optax.masked(tx, mask_fn)
+
+    def init_state(params: Params) -> TrainState:
+        """Takes ownership of `params`: buffers may be aliased into the
+        donated TrainState (the reference's overwrite-in-scope semantics,
+        scope.h). Re-init or copy if the caller needs them afterwards."""
+        params = {
+            k: jax.device_put(v, p_shardings[k]) for k, v in params.items()
+        }
+        opt_state = jax.jit(
+            tx.init,
+            out_shardings=_opt_shardings(tx, params, p_shardings))(params)
+        step = jax.device_put(jnp.zeros((), jnp.int32), repl)
+        return TrainState(params, opt_state, step)
+
+    def _opt_shardings(tx, params, p_shardings):
+        shape = jax.eval_shape(tx.init, params)
+        spec_for = opt_state_sharding_like(
+            None, None, mesh, strategy.shard_optimizer_states)
+
+        def leaf_sharding(path, leaf):
+            # moments are dicts keyed like params → reuse param specs
+            name = None
+            for e in path:
+                if hasattr(e, "key") and isinstance(getattr(e, "key"), str) \
+                        and e.key in p_shardings:
+                    name = e.key
+            if name is not None:
+                return spec_for(leaf, p_shardings[name].spec)
+            return NamedSharding(mesh, P())
+
+        return jax.tree_util.tree_map_with_path(leaf_sharding, shape)
+
+    def microbatch_grads(params, batch, rng):
+        if strategy.accum_steps == 1:
+            if has_aux:
+                (loss, aux), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, batch, rng)
+                return loss, grads, aux
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+            return loss, grads, {}
+        # gradient merge: scan over accum_steps microbatches
+        # (reference: multi_batch_merge_pass.cc / gradient_merge)
+        def mb(carry, xs):
+            acc, loss_sum = carry
+            mb_batch, mb_rng = xs
+            if has_aux:
+                (loss, aux), g = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, mb_batch, mb_rng)
+            else:
+                loss, g = jax.value_and_grad(loss_fn)(params, mb_batch, mb_rng)
+                aux = {}
+            acc = jax.tree.map(jnp.add, acc, g)
+            return (acc, loss_sum + loss), aux
+
+        zero = jax.tree.map(jnp.zeros_like, params)
+        n = strategy.accum_steps
+        mb_batches = jax.tree.map(
+            lambda x: x.reshape((n, x.shape[0] // n) + x.shape[1:]), batch)
+        rngs = jax.random.split(rng, n)
+        (grads, loss_sum), auxs = jax.lax.scan(mb, (zero, 0.0), (mb_batches, rngs))
+        # state updates (BN stats): keep the last microbatch's values
+        aux = jax.tree.map(lambda a: a[-1], auxs) if has_aux else {}
+        inv = 1.0 / n
+        return loss_sum * inv, jax.tree.map(lambda g: g * inv, grads), aux
+
+    def step_fn(state: TrainState, batch, rng):
+        loss, grads, aux = microbatch_grads(state.params, batch, rng)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        # aux = non-trainable state updates keyed like params (BN stats)
+        for k, v in aux.items():
+            params[k] = v.astype(params[k].dtype)
+        return TrainState(params, opt_state, state.step + 1), loss
+
+    state_shardings_cache = {}
+
+    def jitted_step(state: TrainState, batch, rng):
+        key = id(mesh)
+        if key not in state_shardings_cache:
+            st_sh = TrainState(
+                p_shardings,
+                jax.tree.map(lambda x: x.sharding, state.opt_state),
+                repl)
+            def leaf_sharding(x):
+                spec = []
+                for i, ax in enumerate(tuple(batch_spec)[:x.ndim]):
+                    if isinstance(ax, str) and x.shape[i] % mesh.shape[ax] == 0:
+                        spec.append(ax)
+                    else:
+                        spec.append(None)  # indivisible dim stays replicated
+                return NamedSharding(mesh, P(*spec))
+
+            batch_shardings = jax.tree.map(leaf_sharding, batch)
+            state_shardings_cache[key] = jax.jit(
+                step_fn,
+                in_shardings=(st_sh, batch_shardings, repl),
+                out_shardings=(st_sh, repl),
+                donate_argnums=(0,),
+            )
+        return state_shardings_cache[key](state, batch, rng)
+
+    return init_state, jitted_step
