@@ -90,15 +90,20 @@ def dense(params: Params, name: str, x: jax.Array, act=None) -> jax.Array:
     return y
 
 
-def layer_norm(params: Params, name: str, x: jax.Array, eps=1e-12) -> jax.Array:
+def raw_layer_norm(x: jax.Array, scale: jax.Array, bias: jax.Array,
+                   eps: float = 1e-12) -> jax.Array:
     # compute in fp32 for stability under bf16 activations
     xf = x.astype(jnp.float32)
     mu = xf.mean(-1, keepdims=True)
     var = xf.var(-1, keepdims=True)
     y = (xf - mu) * jax.lax.rsqrt(var + eps)
-    y = y * params[f"{name}.scale"].astype(jnp.float32) + \
-        params[f"{name}.bias"].astype(jnp.float32)
+    y = y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
     return y.astype(x.dtype)
+
+
+def layer_norm(params: Params, name: str, x: jax.Array, eps=1e-12) -> jax.Array:
+    return raw_layer_norm(x, params[f"{name}.scale"], params[f"{name}.bias"],
+                          eps)
 
 
 def gelu(x):

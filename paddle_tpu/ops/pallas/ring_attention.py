@@ -1,0 +1,101 @@
+"""Ring attention: sequence-parallel exact attention over the 'sp' mesh axis.
+
+No reference counterpart (SURVEY §2.5: the reference has no sequence/context
+parallelism — its long-sequence story is LoD). TPU-native: each device holds
+a sequence chunk of Q/K/V; K/V blocks rotate around the ring via
+lax.ppermute while a flash-style online softmax accumulates partial results,
+overlapping compute with ICI transfers. Memory per device is O(T/sp), so
+context length scales linearly with the ring size.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, q_off, k_off, causal, Tq, Tk):
+    """Partial (unnormalized) attention of local q against one k/v block.
+    q: [B,Tq,N,H]; k,v: [B,Tk,N,H]. Returns (acc, m, l) contributions."""
+    logits = jnp.einsum("btnh,bsnh->bnts", q, k).astype(jnp.float32) * scale
+    if causal:
+        qpos = q_off + jnp.arange(Tq)
+        kpos = k_off + jnp.arange(Tk)
+        mask = qpos[:, None] >= kpos[None, :]
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)                          # [B,N,Tq]
+    p = jnp.exp(logits - m[..., None])
+    l = jnp.sum(p, axis=-1)                               # [B,N,Tq]
+    acc = jnp.einsum("bnts,bsnh->btnh", p.astype(v.dtype), v)
+    return acc, m, l
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   mesh: Mesh, axis: str = "sp",
+                   causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """Exact attention with q/k/v sharded [B, T, N, H] on T over `axis`.
+
+    Must run inside jit under `mesh`. Equivalent to full attention; the
+    sequence never materializes on one device.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    S = mesh.shape[axis]
+    if S == 1:
+        from .attention import mha
+
+        return mha(q, k, v, scale=scale, causal=causal)
+
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    # under an active context mesh (set by jit/mesh_guard) the abstract mesh
+    # must be passed to shard_map — a concrete mesh no longer matches
+    abstract = jax.sharding.get_abstract_mesh()
+    sm_mesh = abstract if (abstract is not None and not abstract.empty) else mesh
+
+    @functools.partial(
+        jax.shard_map, mesh=sm_mesh,
+        in_specs=(P(None, axis, None, None),) * 3,
+        out_specs=P(None, axis, None, None),
+        axis_names={axis},
+        check_vma=False)
+    def run(q, k, v):
+        s = jax.lax.axis_index(axis)
+        B, Tl, N, H = q.shape
+        q_off = s * Tl
+
+        def step(carry, i):
+            kv, acc, m, l = carry
+            kb, vb = kv
+            # block index currently held: it started at (s - i) ... ring hops
+            src = (s - i) % S
+            k_off = src * Tl
+            a, bm, bl = _block_attn(q, kb, vb, scale, q_off, k_off,
+                                    causal, Tl, Tl)
+            m_new = jnp.maximum(m, bm)
+            c_old = jnp.exp(m - m_new)
+            c_blk = jnp.exp(bm - m_new)
+            acc = (acc * c_old.transpose(0, 2, 1)[..., None]
+                   + a.astype(jnp.float32) * c_blk.transpose(0, 2, 1)[..., None])
+            l = l * c_old + bl * c_blk
+            kv = jax.tree.map(lambda x: jax.lax.ppermute(x, axis, perm),
+                              (kb, vb))
+            return (kv, acc, m_new, l), None
+
+        acc0 = jnp.zeros((B, Tl, N, H), jnp.float32)
+        m0 = jnp.full((B, N, Tl), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, N, Tl), jnp.float32)
+        (kv, acc, m, l), _ = jax.lax.scan(
+            step, ((k, v), acc0, m0, l0), jnp.arange(S))
+        out = acc / jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+        return out.astype(q.dtype)
+
+    return run(q, k, v)

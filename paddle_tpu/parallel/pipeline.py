@@ -1,0 +1,108 @@
+"""Pipeline parallelism: GPipe schedule over the 'pp' mesh axis.
+
+Reference: PipelineTrainer/SectionWorker (paddle/fluid/framework/trainer.h:113,
+device_worker.h:267, section_worker.cc:141) — program sections run in
+threads connected by blocking ScopeQueues, microbatches flowing through.
+
+TPU-native: shard_map over 'pp' + lax.ppermute. Layer parameters are stacked
+[S, ...] and sharded so each device holds one stage; a lax.scan runs
+n_micro + S - 1 ticks, each tick computing the local stage on the activation
+in flight and collective-permuting it to the next stage. Reverse-mode autodiff
+through scan+ppermute gives the backward pipeline for free (the reference's
+async pipeline needed hand-built section workers).
+
+The schedule bubble is (S-1)/(n_micro + S - 1) — same as GPipe; raise
+n_microbatches to amortize.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline_apply(
+    stage_fn: Callable,          # (stage_params, x) -> y, stage-local
+    stage_params,                # pytree, leaves stacked [S, ...]
+    x,                           # [n_micro, mb, ...] microbatched input
+    mesh: Mesh,
+    axis: str = "pp",
+    data_axis: str = "dp",
+):
+    """Run the GPipe pipeline; returns [n_micro, mb, ...] outputs.
+
+    Call inside jit under the `mesh` context. 'pp' AND 'dp' are manualized
+    (the microbatch dim is split over dp — data parallelism composes with
+    the pipeline by construction; partial-manual regions with auto-dp
+    consumers crash XLA's SPMD partitioner in this build). tp/sp act on the
+    stage body only through the enclosing program's GSPMD shardings.
+    """
+    S = mesh.shape[axis]
+    n_micro = x.shape[0]
+    if S == 1:
+        def body1(carry, xm):
+            return carry, stage_fn(
+                jax.tree.map(lambda p: p[0], stage_params), xm)
+        _, ys = jax.lax.scan(body1, 0, x)
+        return ys
+
+    # XLA's CPU SPMD partitioner CHECK-fails resharding bf16 copies in
+    # manual regions ("Invalid binary instruction opcode copy"); stream f32
+    # there. TPU keeps the native dtype (half the ppermute ICI traffic).
+    stream_dtype = x.dtype
+    cpu_bf16_bug = (jax.default_backend() == "cpu"
+                    and x.dtype == jnp.bfloat16)
+    if cpu_bf16_bug:
+        x = x.astype(jnp.float32)
+
+    T = n_micro + S - 1
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    stage_spec = jax.tree.map(lambda _: P(axis), stage_params)
+    manual = {axis}
+    stream_spec = P(None)
+    if data_axis in mesh.axis_names and mesh.shape[data_axis] > 1 \
+            and x.shape[1] % mesh.shape[data_axis] == 0:
+        manual.add(data_axis)
+        stream_spec = P(None, data_axis)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(stage_spec, stream_spec),
+        out_specs=stream_spec,
+        axis_names=manual,
+        check_vma=False)
+    def run(local_params, stream):
+        lp = jax.tree.map(lambda p: p[0], local_params)
+        idx = jax.lax.axis_index(axis)
+        mb_shape = stream.shape[1:]
+        is_first = (idx == 0)
+        is_last = (idx == S - 1)
+
+        def tick(carry, t):
+            state, outputs = carry
+            inject = jax.lax.dynamic_index_in_dim(stream, jnp.minimum(t, n_micro - 1),
+                                                  keepdims=False)
+            x_in = jnp.where(is_first, inject, state)
+            y = stage_fn(lp, x_in)
+            # last stage's result for microbatch (t - S + 1); writes for
+            # t < S-1 land clamped on slot 0 and are overwritten by the
+            # real slot-0 write at t = S-1 (time-ordered scan)
+            out_t = jnp.clip(t - (S - 1), 0, n_micro - 1)
+            outputs = jax.lax.dynamic_update_index_in_dim(outputs, y, out_t, 0)
+            state = jax.lax.ppermute(y, axis, perm)
+            return (state, outputs), None
+
+        init_state = jnp.zeros(mb_shape, stream.dtype)
+        outputs0 = jnp.zeros((n_micro,) + mb_shape, stream.dtype)
+        (_, outputs), _ = jax.lax.scan(tick, (init_state, outputs0),
+                                       jnp.arange(T))
+        # only the last stage's buffer is meaningful — mask & sum-broadcast
+        outputs = jnp.where(is_last, outputs, jnp.zeros_like(outputs))
+        return jax.lax.psum(outputs, axis)
+
+    out = run(stage_params, x)
+    return out.astype(stream_dtype) if cpu_bf16_bug else out

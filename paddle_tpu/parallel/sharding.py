@@ -81,6 +81,15 @@ def logical_to_mesh(axes: Sequence[Optional[str]],
     return (rules or current_rules()).spec(axes)
 
 
+def in_manual_region() -> bool:
+    """True when tracing inside a manual shard_map region (e.g. the 'pp'
+    pipeline). XLA's partial-manual partitioner cannot handle nested manual
+    subregions or extra sharding constraints there — callers skip both."""
+    abstract = jax.sharding.get_abstract_mesh()
+    return (abstract is not None and not abstract.empty
+            and bool(getattr(abstract, "manual_axes", ())))
+
+
 def shard(x, axes: Sequence[Optional[str]],
           rules: Optional[LogicalRules] = None):
     """Annotate a traced value with a sharding constraint by logical axes —
@@ -89,7 +98,7 @@ def shard(x, axes: Sequence[Optional[str]],
     from .mesh import current_mesh
 
     mesh = current_mesh()
-    if mesh is None:
+    if mesh is None or in_manual_region():
         return x
     spec = logical_to_mesh(axes, rules)
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
