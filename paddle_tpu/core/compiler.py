@@ -21,6 +21,7 @@ import enum
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -150,8 +151,6 @@ class CompiledProgram:
         return self._mesh
 
     def _run(self, executor, feed, fetch_list, scope, return_numpy):
-        import jax.numpy as jnp
-
         program = self._program
         scope = scope if scope is not None else global_scope()
         feed = dict(feed or {})
@@ -213,7 +212,14 @@ class _ShardedStep:
         self._feed_shardings = {n: batch for n in feed_names}
         self._repl = repl
 
+        multiproc = jax.process_count() > 1
+        self._multiproc = multiproc
+
         def step(feeds, const_states, mut_states, rng):
+            # multi-host passes the key as raw uint32 data (key arrays can't
+            # round-trip through process-local numpy)
+            if not jnp.issubdtype(rng.dtype, jax.dtypes.prng_key):
+                rng = jax.random.wrap_key_data(rng)
             env = dict(const_states)
             env.update(mut_states)
             env.update(feeds)
@@ -221,6 +227,8 @@ class _ShardedStep:
             lowering.lower_block(desc, 0, env, rng_key=step_key, is_test=is_test)
             fetches = [env[n] for n in fetch_names]
             new_states = {n: env[n] for n in self.writes if n in env}
+            if multiproc:
+                new_rng = jax.random.key_data(new_rng)
             return fetches, new_states, new_rng
 
         self.fn = jax.jit(
@@ -229,6 +237,11 @@ class _ShardedStep:
                           {n: repl for n in self.const_reads},
                           {n: repl for n in self.mut_reads},
                           repl),
+            # fetches/state replicated: every process can read them (multi-
+            # host) and scope state round-trips without resharding
+            out_shardings=([repl] * len(fetch_names),
+                           {n: repl for n in self.writes},
+                           repl),
             donate_argnums=(2,),
         )
 
@@ -243,7 +256,40 @@ class _ShardedStep:
 
         const_states = {n: _state(n) for n in self.const_reads}
         mut_states = {n: _state(n) for n in self.mut_reads}
-        feed = {n: jax.device_put(v, self._feed_shardings[n]) for n, v in feed.items()}
+        if self._multiproc:
+            # multi-host: each process feeds its local shard of the global
+            # batch (reference: per-trainer readers in NCCL2 mode); state
+            # becomes a replicated global array on first use, a key becomes
+            # raw key data
+            def _global(v, sharding):
+                if isinstance(v, jax.Array) and v.sharding == sharding:
+                    return v
+                if hasattr(v, "dtype") and jnp.issubdtype(v.dtype,
+                                                          jax.dtypes.prng_key):
+                    v = jax.random.key_data(v)
+                return jax.make_array_from_process_local_data(
+                    sharding, np.asarray(v))
+
+            feed = {n: jax.make_array_from_process_local_data(
+                        self._feed_shardings[n], np.asarray(v))
+                    for n, v in feed.items()}
+
+            def _global_named(n, v):
+                try:
+                    return _global(v, self._repl)
+                except RuntimeError as e:
+                    raise RuntimeError(
+                        f"state var '{n}' (sharding "
+                        f"{getattr(v, 'sharding', None)}): {e}") from e
+
+            const_states = {n: _global_named(n, v)
+                            for n, v in const_states.items()}
+            mut_states = {n: _global_named(n, v)
+                          for n, v in mut_states.items()}
+            rng = _global(rng, self._repl)
+        else:
+            feed = {n: jax.device_put(v, self._feed_shardings[n])
+                    for n, v in feed.items()}
         fetches, new_states, new_rng = self.fn(feed, const_states, mut_states, rng)
         for n, v in new_states.items():
             scope.set_var(n, v)

@@ -380,6 +380,14 @@ class Block:
         """Run generic shape inference and fill output var descs."""
         if not registry.has_op(desc.type):
             return
+        if desc.sub_block_ids():
+            # control-flow op whose outputs were shaped by the layer: skip —
+            # eval_shape would trace the sub-block, which may contain
+            # collectives that only lower under shard_map
+            outs = [n for n in desc.output_names() if n]
+            if all((v := self._find_var_recursive(n)) is not None
+                   and v.desc.shape is not None for n in outs):
+                return
         input_descs: Dict[str, VarDesc] = {}
         for n in desc.input_names():
             v = self._find_var_recursive(n)

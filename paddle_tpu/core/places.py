@@ -20,7 +20,11 @@ class Place:
         return hash((type(self).__name__, self.device_id))
 
     def jax_device(self):
-        devs = jax.devices(self.backend) if self.backend else jax.devices()
+        # local_devices: under multi-host (jax.distributed) a process may
+        # only place computations on its own devices; jax.devices()[0] would
+        # be process 0's device everywhere
+        devs = (jax.local_devices(backend=self.backend) if self.backend
+                else jax.local_devices())
         return devs[self.device_id]
 
     backend = None

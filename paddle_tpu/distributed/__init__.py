@@ -1,0 +1,5 @@
+"""Distributed launchers + multi-host bootstrap (reference:
+python/paddle/distributed/).
+
+`python -m paddle_tpu.distributed.launch` — import of the submodule stays
+lazy here so runpy doesn't warn about double import."""

@@ -1,0 +1,111 @@
+"""Multi-process launcher.
+
+Reference: python/paddle/distributed/launch.py — spawns one worker process
+per selected device, exporting PADDLE_TRAINER_ID / PADDLE_CURRENT_ENDPOINT /
+PADDLE_TRAINER_ENDPOINTS / PADDLE_TRAINERS_NUM (launch.py:147,217-223).
+
+TPU-native: one process per HOST (a process owns all its local chips — the
+JAX model), same env contract so fleet.PaddleCloudRoleMaker works unchanged.
+`--backend cpu --nproc_per_node N` forces single-chip-per-process CPU
+processes for localhost cluster simulation (the test_dist_base pattern).
+
+Usage:
+    python -m paddle_tpu.distributed.launch --nproc_per_node 2 train.py ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List
+
+
+def _free_ports(n: int) -> List[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def launch_main(argv=None):
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nproc_per_node", type=int, default=1)
+    parser.add_argument("--ips", type=str, default="127.0.0.1",
+                        help="comma-separated host ips (reference --cluster_node_ips)")
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--started_port", type=int, default=0)
+    parser.add_argument("--backend", type=str, default="",
+                        help="cpu = force JAX_PLATFORMS=cpu per proc (local sim)")
+    parser.add_argument("--devices_per_proc", type=int, default=0,
+                        help="with --backend cpu: virtual device count per proc")
+    parser.add_argument("--log_dir", type=str, default="")
+    parser.add_argument("training_script")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    nproc = args.nproc_per_node
+    ips = args.ips.split(",")
+    if args.started_port:
+        ports = [args.started_port + i for i in range(nproc)]
+    elif len(ips) > 1:
+        # multi-node: every node must compute identical endpoints, so random
+        # free ports are not an option (reference launch.py default 6170)
+        ports = [6170 + i for i in range(nproc)]
+    else:
+        ports = _free_ports(nproc)
+    endpoints = [f"{ip}:{port}" for ip in ips for port in ports]
+
+    procs = []
+    base = args.node_rank * nproc
+    for local_rank in range(nproc):
+        rank = base + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "FLAGS_selected_tpus": str(local_rank),
+        })
+        if args.backend == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PADDLE_TPU_FORCE_CPU"] = "1"
+            if args.devices_per_proc:
+                env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                    f" --xla_force_host_platform_device_count="
+                                    f"{args.devices_per_proc}").strip()
+        cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
+        out = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            out = open(os.path.join(args.log_dir, f"worker.{rank}.log"), "w")
+        procs.append((subprocess.Popen(cmd, env=env, stdout=out, stderr=out), out))
+
+    code = 0
+    try:
+        for p, out in procs:
+            rc = p.wait()
+            code = code or rc
+    except KeyboardInterrupt:
+        for p, _ in procs:
+            p.send_signal(signal.SIGTERM)
+        code = 1
+    finally:
+        for _, out in procs:
+            if out:
+                out.close()
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(launch_main())

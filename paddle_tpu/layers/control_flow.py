@@ -17,9 +17,9 @@ from ..core.framework import Variable
 from ..core.ir import OpDesc
 from ..layer_helper import LayerHelper
 
-__all__ = ["cond", "While", "while_loop", "StaticRNN", "increment",
-           "array_write", "array_read", "array_length", "create_array",
-           "less_than", "Switch", "case", "switch_case"]
+__all__ = ["cond", "cond_state", "While", "while_loop", "StaticRNN",
+           "increment", "array_write", "array_read", "array_length",
+           "create_array", "less_than", "Switch", "case", "switch_case"]
 
 
 def _outer_reads(program, blocks, bound_names=()):
@@ -146,6 +146,62 @@ class While:
 
     def block(self):
         return While._BlockGuard(self)
+
+
+def cond_state(pred: Variable, build_fn: Callable, name=None):
+    """Run `build_fn`'s ops only when `pred` is true, with writes to
+    enclosing-block variables PERSISTING (the reference's
+    conditional_block_op writes into the outer scope,
+    controlflow/conditional_block_op.cc). The gate behind periodic behaviors:
+    gradient merge, LocalSGD's every-k sync, EMA/ModelAverage windows.
+    """
+    helper = LayerHelper("cond_state", name=name)
+    program = helper.main_program
+
+    true_block, _ = _collect_block(program, build_fn)
+
+    # every enclosing-block var the branch writes must round-trip through
+    # cond outputs (branch env is isolated, ops/control_flow.py)
+    written: List[str] = []
+    for op in true_block.desc.ops:
+        for n in op.output_names():
+            if n and n not in written and program.global_block().has_var(n):
+                written.append(n)
+    if not written:
+        return
+
+    outs = []
+    out_names = []
+    for n in written:
+        v = program.global_block().var(n)
+        out = helper.create_variable_for_type_inference(v.dtype)
+        out.desc.shape = v.desc.shape
+        outs.append(out)
+        out_names.append(out.name)
+
+    # true branch: forward the written values; false branch: originals
+    false_block = program._create_block()
+    program._rollback()
+    for blk in (true_block, false_block):
+        for n, out in zip(written, outs):
+            blk.desc.ops.append(OpDesc(type="assign", inputs={"X": [n]},
+                                       outputs={"Out": [out.name]}))
+
+    outer_reads = _outer_reads(program, (true_block, false_block))
+    helper.append_op(
+        type="cond",
+        inputs={"Cond": pred,
+                "Input": [program.global_block().var(n) for n in outer_reads]},
+        outputs={"Out": outs},
+        attrs={"true_block": {"__block__": true_block.idx},
+               "false_block": {"__block__": false_block.idx},
+               "input_names": outer_reads,
+               "out_names": out_names})
+    # write results back onto the original names
+    from .tensor import assign
+
+    for n, out in zip(written, outs):
+        assign(out, program.global_block().var(n))
 
 
 def while_loop(cond, body, loop_vars, is_test=False, name=None):

@@ -20,6 +20,33 @@ import jax.numpy as jnp
 from ..core.registry import register_op
 
 
+def _same_shape_infer(op, input_descs):
+    """Collectives keep (or statically transform) shapes; eval_shape-based
+    inference would trace psum outside shard_map and fail on the unbound
+    axis, so shape inference is static here."""
+    import jax
+    import numpy as np
+
+    from ..core.ir import normalize_dtype
+
+    out = {}
+    in_names = op.inputs.get("X", [])
+    out_names = op.outputs.get("Out", [])
+    for i, n in enumerate(out_names):
+        if not n:
+            continue
+        src = input_descs[in_names[min(i, len(in_names) - 1)]]
+        shape = list(src.shape or ())
+        nranks = int(op.attrs.get("nranks", 0))
+        if op.type == "c_allgather" and nranks and shape:
+            shape[0] = shape[0] * nranks if shape[0] != -1 else -1
+        elif op.type == "c_reducescatter" and nranks and shape:
+            shape[0] = shape[0] // nranks if shape[0] != -1 else -1
+        out[n] = jax.ShapeDtypeStruct(
+            tuple(shape), np.dtype(normalize_dtype(src.dtype)))
+    return out
+
+
 def _axis(attrs):
     # ring_id selected a NCCLCommContext in the reference; here it names a
     # mesh axis (default the data axis).
@@ -34,14 +61,14 @@ def _allreduce(op):
     return kernel
 
 
-register_op("c_allreduce_sum")(_allreduce(lambda x, a: jax.lax.psum(x, a)))
-register_op("c_allreduce_max", grad=None)(_allreduce(lambda x, a: jax.lax.pmax(x, a)))
-register_op("c_allreduce_min", grad=None)(_allreduce(lambda x, a: jax.lax.pmin(x, a)))
-register_op("c_allreduce_prod", grad=None)(
+register_op("c_allreduce_sum", infer_shape=_same_shape_infer)(_allreduce(lambda x, a: jax.lax.psum(x, a)))
+register_op("c_allreduce_max", grad=None, infer_shape=_same_shape_infer)(_allreduce(lambda x, a: jax.lax.pmax(x, a)))
+register_op("c_allreduce_min", grad=None, infer_shape=_same_shape_infer)(_allreduce(lambda x, a: jax.lax.pmin(x, a)))
+register_op("c_allreduce_prod", grad=None, infer_shape=_same_shape_infer)(
     _allreduce(lambda x, a: jnp.exp(jax.lax.psum(jnp.log(x), a))))
 
 
-@register_op("c_broadcast")
+@register_op("c_broadcast", infer_shape=_same_shape_infer)
 def c_broadcast(ins, attrs, ctx):
     x = ins["X"][0]
     root = int(attrs.get("root", 0))
@@ -51,19 +78,19 @@ def c_broadcast(ins, attrs, ctx):
     return {"Out": jax.lax.psum(masked, axis)}
 
 
-@register_op("c_allgather")
+@register_op("c_allgather", infer_shape=_same_shape_infer)
 def c_allgather(ins, attrs, ctx):
     x = ins["X"][0]
     return {"Out": jax.lax.all_gather(x, _axis(attrs), tiled=True)}
 
 
-@register_op("c_reducescatter")
+@register_op("c_reducescatter", infer_shape=_same_shape_infer)
 def c_reducescatter(ins, attrs, ctx):
     x = ins["X"][0]
     return {"Out": jax.lax.psum_scatter(x, _axis(attrs), tiled=True)}
 
 
-@register_op("c_ppermute")
+@register_op("c_ppermute", infer_shape=_same_shape_infer)
 def c_ppermute(ins, attrs, ctx):
     """Ring permute — the building block of ring attention / pipeline comm
     (no reference counterpart; exposed because ICI rings make it cheap)."""

@@ -32,7 +32,7 @@ __all__ = [
     "RMSPropOptimizer", "Ftrl", "FtrlOptimizer", "Lamb", "LambOptimizer",
     "LarsMomentum", "LarsMomentumOptimizer", "DGCMomentumOptimizer",
     "ModelAverage", "ExponentialMovingAverage", "LookaheadOptimizer",
-    "RecomputeOptimizer", "PipelineOptimizer",
+    "RecomputeOptimizer", "PipelineOptimizer", "GradientMerge", "GradientMergeOptimizer",
 ]
 
 
@@ -128,7 +128,9 @@ class Optimizer:
             params_grads = append_gradient_clip_ops(params_grads)
         params_grads = append_regularization_ops(params_grads, self.regularization)
 
-        block = default_main_program().global_block()
+        # current_block (not global): lets the optimize ops be collected
+        # into a conditional sub-block (GradientMergeOptimizer's every-k gate)
+        block = default_main_program().current_block()
         with op_role_guard(OpRole.Optimize):
             self._create_global_learning_rate()
             self._create_accumulators(block, [pg[0] for pg in params_grads])
@@ -769,6 +771,85 @@ class RecomputeOptimizer(Optimizer):
         return self.apply_gradients(params_grads), params_grads
 
 
+class GradientMergeOptimizer:
+    """Gradient merge / accumulation over k steps (reference:
+    ir/multi_devices_graph_pass/multi_batch_merge_pass.cc + fleet's
+    gradient_merge): gradients accumulate into persistable buffers every
+    step; the inner optimizer runs only on every k-th step inside a
+    state-writing conditional (layers.cond_state), then the buffers reset.
+    Inner optimizer state (moments, beta pows) advances only on apply steps
+    — exact large-batch semantics."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self.inner_opt = inner_optimizer
+        self.k_steps = int(k_steps)
+        self.avg = avg
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self.inner_opt.backward(loss, startup_program, parameter_list,
+                                       no_grad_set, callbacks)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from . import layers as L
+        from .layers import control_flow, tensor as ltensor
+
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        if self.k_steps <= 1:
+            return self.inner_opt.apply_gradients(params_grads), params_grads
+
+        main = default_main_program()
+        block = main.global_block()
+        with op_role_guard(OpRole.Optimize):
+            # step counter
+            step = ltensor.create_global_var(
+                [1], 0.0, "float32", persistable=True,
+                name=unique_name.generate("@GRAD_MERGE_STEP@"))
+            block.append_op(type="increment", inputs={"X": step},
+                            outputs={"Out": step}, attrs={"step": 1.0})
+            # accumulate grads
+            accs = []
+            for p, g in params_grads:
+                acc = block.create_var(
+                    name=unique_name.generate(f"{p.name}@GRAD_MERGE"),
+                    shape=p.shape, dtype=g.dtype, persistable=True)
+                sb = default_startup_program().global_block()
+                sv = sb.create_var(name=acc.name, shape=p.shape,
+                                   dtype=g.dtype, persistable=True)
+                sb.append_op(type="fill_constant", outputs={"Out": sv},
+                             attrs={"shape": list(p.shape), "dtype": g.dtype,
+                                    "value": 0.0})
+                block.append_op(type="elementwise_add",
+                                inputs={"X": acc, "Y": g},
+                                outputs={"Out": acc})
+                accs.append(acc)
+
+            k = ltensor.fill_constant([1], "float32", float(self.k_steps))
+            rem = block.create_var(
+                name=unique_name.generate("gm_rem"), shape=[1], dtype="float32")
+            block.append_op(type="elementwise_mod", inputs={"X": step, "Y": k},
+                            outputs={"Out": rem})
+            pred = L.equal(rem, ltensor.fill_constant([1], "float32", 0.0))
+
+            def apply_fn():
+                scaled = []
+                for (p, _), acc in zip(params_grads, accs):
+                    eff = acc
+                    if self.avg:
+                        eff = L.scale(acc, scale=1.0 / self.k_steps)
+                    scaled.append((p, eff))
+                self.inner_opt.apply_gradients(scaled)
+                blk = main.current_block()
+                for acc in accs:
+                    blk.append_op(type="scale", inputs={"X": acc},
+                                  outputs={"Out": acc}, attrs={"scale": 0.0})
+
+            control_flow.cond_state(pred, apply_fn)
+        return [], params_grads
+
+
 class PipelineOptimizer:
     """reference: optimizer.py:2974 + framework/pipeline_trainer.cc +
     section_worker.cc — split the program into sections at cut points, run
@@ -808,3 +889,4 @@ RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
 Lamb = LambOptimizer
 LarsMomentum = LarsMomentumOptimizer
+GradientMerge = GradientMergeOptimizer

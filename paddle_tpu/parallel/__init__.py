@@ -19,7 +19,13 @@ from .mesh import (  # noqa: F401
     MeshConfig, auto_mesh, current_mesh, get_mesh, mesh_guard, make_mesh,
 )
 from .sharding import (  # noqa: F401
-    LogicalRules, NO_SHARD, logical_to_mesh, shard, shard_params_spec,
-    with_rules, current_rules,
+    LogicalRules, NO_SHARD, in_manual_region, logical_to_mesh, shard,
+    shard_params_spec, with_rules, current_rules,
 )
 from . import collective  # noqa: F401
+from .strategy import DistributedStrategy  # noqa: F401
+from .role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker, Role, RoleMakerBase, UserDefinedRoleMaker,
+)
+from .fleet import fleet, Fleet, DistributedOptimizer  # noqa: F401
+from .spmd_executor import SPMDRunner  # noqa: F401

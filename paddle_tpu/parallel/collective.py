@@ -27,7 +27,7 @@ def _grad_outputs(program: Program) -> List[str]:
                 if n.endswith("@GRAD") and n not in seen:
                     pv = n[: -len("@GRAD")]
                     v = program.global_block().vars.get(pv)
-                    if v is not None and getattr(v, "is_parameter", False):
+                    if v is not None and v.desc.is_parameter:
                         seen.add(n)
                         grads.append(n)
     return grads
@@ -73,30 +73,61 @@ class GradAllReduce:
 
 class LocalSGD:
     """Periodic parameter averaging (reference: transpiler/collective.py:269):
-    every k steps params are allreduce-averaged instead of per-step grad sync.
-    Emitted as in-graph ops gated by a step counter + cond."""
+    every k steps params are allreduce-averaged instead of per-step grad
+    sync, gated by a step counter inside a state-writing conditional
+    (layers.cond_state)."""
 
     def __init__(self, nranks: Optional[int] = None, axis_name: str = "dp",
                  k_steps: int = 1):
         self.nranks = nranks
         self.axis_name = axis_name
-        self.k_steps = k_steps
+        self.k_steps = max(1, int(k_steps))
 
     def transpile(self, program: Program, startup_program: Optional[Program] = None):
+        from ..core.framework import program_guard, unique_name
         from ..core.ir import OpDesc
+        from .. import layers as L
+        from ..layers import control_flow, tensor as ltensor
 
-        block = program.global_block()
         params = [p.name for p in program.all_parameters()]
         if not params:
             return program
-        for p in params:
-            block.desc.ops.append(OpDesc(
-                type="c_allreduce_sum", inputs={"X": [p]}, outputs={"Out": [p]},
-                attrs={"axis_name": self.axis_name,
-                       OpRole.AttrName: OpRole.Optimize}))
-            block.desc.ops.append(OpDesc(
-                type="scale", inputs={"X": [p]}, outputs={"Out": [p]},
-                attrs={"scale": 1.0 / (self.nranks or 1),
-                       OpRole.AttrName: OpRole.Optimize}))
-        program._rebuild_from_desc()
+
+        def _emit_averaging():
+            block = program.current_block()
+            for p in params:
+                block.append_op(
+                    type="c_allreduce_sum", inputs={"X": block.program.global_block().var(p)},
+                    outputs={"Out": block.program.global_block().var(p)},
+                    attrs={"axis_name": self.axis_name,
+                           OpRole.AttrName: OpRole.Optimize})
+                block.append_op(
+                    type="scale", inputs={"X": block.program.global_block().var(p)},
+                    outputs={"Out": block.program.global_block().var(p)},
+                    attrs={"scale": 1.0 / (self.nranks or 1),
+                           OpRole.AttrName: OpRole.Optimize})
+
+        sp = startup_program
+        from ..core import framework as fw
+
+        guard_sp = sp if sp is not None else fw.default_startup_program()
+        with program_guard(program, guard_sp):
+            if self.k_steps == 1:
+                _emit_averaging()
+            else:
+                step = ltensor.create_global_var(
+                    [1], 0.0, "float32", persistable=True,
+                    name=unique_name.generate("@LOCAL_SGD_STEP@"))
+                program.global_block().append_op(
+                    type="increment", inputs={"X": step},
+                    outputs={"Out": step}, attrs={"step": 1.0})
+                k = ltensor.fill_constant([1], "float32", float(self.k_steps))
+                rem = program.global_block().create_var(
+                    name=unique_name.generate("lsgd_rem"), shape=[1],
+                    dtype="float32")
+                program.global_block().append_op(
+                    type="elementwise_mod", inputs={"X": step, "Y": k},
+                    outputs={"Out": rem})
+                pred = L.equal(rem, ltensor.fill_constant([1], "float32", 0.0))
+                control_flow.cond_state(pred, _emit_averaging)
         return program

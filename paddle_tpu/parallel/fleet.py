@@ -1,0 +1,193 @@
+"""Fleet — the unified distributed-training facade.
+
+Reference: incubate/fleet/base/fleet_base.py:38 (Fleet), fleet/collective
+(`CollectiveOptimizer`), used as:
+
+    fleet.init(PaddleCloudRoleMaker())
+    optimizer = fleet.distributed_optimizer(optimizer, strategy)
+    optimizer.minimize(loss)
+    ... exe.run(fleet.main_program)
+
+TPU-native: init() wires jax.distributed for multi-host (the coordinator
+replaces gen_nccl_id RPC bootstrap, SURVEY §5), builds the global mesh from
+the strategy's parallel degrees, and distributed_optimizer returns a wrapper
+that applies the Program-IR transpiles (grad allreduce / local sgd /
+gradient merge / recompute) before minimize.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+
+from ..core import framework
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+from .strategy import DistributedStrategy
+
+
+class Fleet:
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._mesh = None
+        self._mesh_key = None
+        self._inited = False
+
+    # -- lifecycle (reference fleet_base.py:64 init) -----------------------
+
+    def init(self, role_maker: Optional[RoleMakerBase] = None,
+             is_collective: bool = True):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(is_collective)
+        n = self._role_maker.worker_num()
+        if n > 1 and not jax.distributed.is_initialized():
+            coord = self._role_maker.coordinator_address()
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=n,
+                process_id=self._role_maker.worker_index())
+        self._inited = True
+        return self
+
+    @property
+    def inited(self) -> bool:
+        return self._inited
+
+    # -- identity ----------------------------------------------------------
+
+    def is_first_worker(self) -> bool:
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self) -> int:
+        return self._role_maker.worker_index()
+
+    def worker_num(self) -> int:
+        return self._role_maker.worker_num()
+
+    def is_worker(self) -> bool:
+        return self._role_maker.is_worker()
+
+    def is_server(self) -> bool:
+        return self._role_maker.is_server()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker.get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_endpoints(self, to_string=False):
+        eps = self._role_maker.get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def barrier_worker(self):
+        if jax.distributed.is_initialized() and jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("fleet_barrier_worker")
+
+    # -- mesh --------------------------------------------------------------
+
+    def mesh(self, strategy: Optional[DistributedStrategy] = None):
+        from .mesh import make_mesh
+
+        strategy = strategy or self._strategy or DistributedStrategy()
+        cfg = strategy.mesh_config()
+        key = tuple(sorted(cfg.resolve(len(jax.devices())).items()))
+        if self._mesh is None or self._mesh_key != key:
+            self._mesh = make_mesh(cfg)
+            self._mesh_key = key
+        return self._mesh
+
+    # -- the optimizer wrapper (reference CollectiveOptimizer) -------------
+
+    def distributed_optimizer(self, optimizer,
+                              strategy: Optional[DistributedStrategy] = None):
+        self._strategy = strategy or DistributedStrategy()
+        return DistributedOptimizer(self, optimizer, self._strategy)
+
+    # -- program accessors (reference fleet_base properties) ---------------
+
+    @property
+    def main_program(self):
+        return framework.default_main_program()
+
+    @property
+    def startup_program(self):
+        return framework.default_startup_program()
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        from .. import io
+
+        if self.is_first_worker():
+            io.save_persistables(executor, dirname, main_program)
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None, **kw):
+        from .. import io
+
+        if self.is_first_worker():
+            io.save_inference_model(dirname, feeded_var_names, target_vars,
+                                    executor, main_program=main_program, **kw)
+
+
+class DistributedOptimizer:
+    """reference: incubate/fleet/collective/__init__.py:117
+    CollectiveOptimizer — wraps a regular optimizer, applies distributed
+    rewrites during minimize."""
+
+    def __init__(self, fleet: Fleet, optimizer, strategy: DistributedStrategy):
+        self._fleet = fleet
+        self._inner = optimizer
+        self._strategy = strategy
+
+    def backward(self, loss, **kw):
+        return self._inner.backward(loss, **kw)
+
+    def apply_gradients(self, params_grads):
+        return self._inner.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        from .. import optimizer as opt_mod
+
+        inner = self._inner
+        st = self._strategy
+        if st.use_dgc and not isinstance(inner, opt_mod.DGCMomentumOptimizer):
+            raise ValueError(
+                "use_dgc requires passing a DGCMomentumOptimizer as the "
+                "inner optimizer (reference: fleet applies DGC through the "
+                "optimizer, optimizer.py:868)")
+        if st.use_amp:
+            from ..amp import decorate as amp_decorate
+
+            inner = amp_decorate(inner,
+                                 init_loss_scaling=st.amp_loss_scale)
+        if st.recompute:
+            rc = opt_mod.RecomputeOptimizer(inner)
+            rc._set_checkpoints(st.recompute_checkpoints or [])
+            inner = rc
+        if st.gradient_merge_k > 1:
+            inner = opt_mod.GradientMergeOptimizer(
+                inner, k_steps=st.gradient_merge_k)
+        ops, p2g = inner.minimize(loss, startup_program, parameter_list,
+                                  no_grad_set)
+
+        # Explicit in-graph collectives only for the SPMDRunner execution
+        # mode (reference collective-transpiler semantics); the default
+        # CompiledProgram/GSPMD path derives the reduction from shardings.
+        if st.use_graph_collectives:
+            program = loss.block.program
+            mesh = self._fleet.mesh(st)
+            n = mesh.shape["dp"]
+            if st.use_local_sgd:
+                from .collective import LocalSGD
+
+                LocalSGD(nranks=n, k_steps=st.local_sgd_steps).transpile(program)
+            else:
+                from .collective import GradAllReduce
+
+                GradAllReduce(nranks=n).transpile(program)
+        return ops, p2g
+
+
+fleet = Fleet()

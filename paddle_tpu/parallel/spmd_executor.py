@@ -1,0 +1,175 @@
+"""SPMD (per-device-graph) program execution — the collective-transpiler
+runtime.
+
+Reference execution model: transpiler/collective.py rewrites the single-device
+program with explicit c_allreduce ops, then EACH process runs its own graph
+and the collectives synchronize (multi-process NCCL2 mode, SURVEY §2.5).
+
+TPU-native: one process runs the program under jax.shard_map with the 'dp'
+axis manual — each device traces the same op sequence on its batch shard, and
+the program's explicit collective ops (ops/collective.py) lower to real
+lax.psum/all_gather over the axis. This is the runtime that makes the c_*
+collective op family first-class (under plain pjit GSPMD they'd be
+redundant)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import framework, lowering
+from ..core.executor import RNG_STATE_VAR, Scope, _as_fetch_name, global_scope
+from ..core.framework import Program
+from ..core.ir import normalize_dtype
+
+
+class SPMDRunner:
+    """Run a (collective-transpiled) Program with the 'dp' axis manualized.
+
+    Feeds are split on dim 0 across 'dp'; persistable state is replicated.
+    Fetches are averaged over devices unless reduce='first'.
+    """
+
+    def __init__(self, program: Program, mesh: Mesh, axis: str = "dp",
+                 reduce: str = "mean"):
+        self.program = program
+        self.mesh = mesh
+        self.axis = axis
+        self.reduce = reduce
+        self._cache: Dict[Any, Any] = {}
+
+    def run(self, executor, feed=None, fetch_list=None, scope: Optional[Scope] = None,
+            return_numpy: bool = True):
+        program = self.program
+        scope = scope if scope is not None else global_scope()
+        feed = dict(feed or {})
+        fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
+
+        norm_feed = {}
+        for name, val in feed.items():
+            vdesc = None
+            for b in program.desc.blocks:
+                if name in b.vars:
+                    vdesc = b.vars[name]
+                    break
+            arr = jnp.asarray(val)
+            if vdesc is not None:
+                want = np.dtype(normalize_dtype(vdesc.dtype))
+                if arr.dtype != want:
+                    arr = arr.astype(want)
+            norm_feed[name] = arr
+
+        sig = tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                           for k, v in norm_feed.items()))
+        key = (program._version, sig, fetch_names)
+        step = self._cache.get(key)
+        if step is None:
+            step = self._build(tuple(norm_feed), fetch_names)
+            self._cache[key] = step
+
+        rng = executor._get_rng(scope, program)
+        fetches, new_states, new_rng = step(scope, norm_feed, rng)
+        for n, v in new_states.items():
+            scope.set_var(n, v)
+        scope.set_var(RNG_STATE_VAR, new_rng)
+        if return_numpy:
+            return [np.asarray(f) for f in fetches]
+        return list(fetches)
+
+    def _build(self, feed_names: Tuple[str, ...], fetch_names: Tuple[str, ...]):
+        desc = self.program.desc
+        axis = self.axis
+        n_dev = self.mesh.shape[axis]
+        reads, writes = lowering.analyze_state_vars(desc, set(feed_names))
+        persistable = {v.name for b in desc.blocks for v in b.vars.values()
+                       if v.persistable}
+        for n in fetch_names:
+            if n in persistable and n not in reads and n not in writes:
+                reads.append(n)
+        const_reads = tuple(n for n in reads if n not in writes)
+        mut_reads = tuple(n for n in reads if n in writes)
+        writes = tuple(writes)
+        is_test = self.program._is_test
+        reduce = self.reduce
+
+        # classify fetches statically by their inferred var shapes: scalar
+        # fetches (loss-like) reduce across devices; batched fetches
+        # concatenate shards (reference: FetchOpHandle merges per-device
+        # results)
+        def _is_scalar_fetch(n):
+            vd = None
+            for b in desc.blocks:
+                if n in b.vars:
+                    vd = b.vars[n]
+                    break
+            shp = vd.shape if vd is not None else None
+            return shp is None or len(shp) == 0 or \
+                (len(shp) == 1 and shp[0] == 1)
+
+        scalar_fetch = {n: _is_scalar_fetch(n) for n in fetch_names}
+
+        def device_step(feeds, const_states, mut_states, rng):
+            env = dict(const_states)
+            env.update(mut_states)
+            env.update(feeds)
+            # per-device rng stream (reference: different seed per trainer)
+            rng_local = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+            step_key, new_rng = jax.random.split(rng_local)
+            lowering.lower_block(desc, 0, env, rng_key=step_key,
+                                 is_test=is_test)
+            fetches = []
+            for n in fetch_names:
+                if n not in env:
+                    raise lowering.LoweringError(
+                        f"fetch var '{n}' was not produced by the program")
+                v = env[n]
+                if scalar_fetch[n] and reduce == "mean":
+                    v = jax.lax.pmean(v.astype(jnp.float32),
+                                      axis).astype(v.dtype)
+                fetches.append(v)
+            new_states = {n: env[n] for n in writes if n in env}
+            # advance the global rng identically on all devices
+            new_global_rng = jax.random.split(rng)[1]
+            return fetches, new_states, new_global_rng
+
+        feed_specs = {n: P(axis) for n in feed_names}
+        fetch_specs = [P() if scalar_fetch[n] else P(axis)
+                       for n in fetch_names]
+        sm = jax.shard_map(
+            device_step,
+            mesh=self.mesh,
+            in_specs=(feed_specs,
+                      {n: P() for n in const_reads},
+                      {n: P() for n in mut_reads},
+                      P()),
+            out_specs=(fetch_specs,
+                       {n: P() for n in writes},
+                       P()),
+            axis_names={axis},
+            check_vma=False)
+        jitted = jax.jit(sm)
+
+        def step(scope: Scope, feed, rng):
+            def _state(n):
+                v = scope.find_var(n)
+                if v is None:
+                    raise RuntimeError(
+                        f"variable '{n}' missing from scope — run the "
+                        f"startup program first")
+                return v
+
+            const_states = {n: _state(n) for n in const_reads}
+            mut_states = {n: _state(n) for n in mut_reads}
+            for n, v in feed.items():
+                if v.shape and v.shape[0] % n_dev:
+                    raise ValueError(
+                        f"feed '{n}' batch {v.shape[0]} not divisible by "
+                        f"{n_dev} devices on axis '{axis}'")
+            return jitted(feed, const_states, mut_states, rng)
+
+        return step

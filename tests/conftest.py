@@ -31,6 +31,11 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running multi-process tests")
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Each test gets fresh default programs + scope (the reference's tests

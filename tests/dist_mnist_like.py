@@ -1,0 +1,59 @@
+"""Worker script for multi-process distributed tests (reference pattern:
+python/paddle/fluid/tests/unittests/dist_mnist.py run by test_dist_base.py).
+
+Trains a small MLP data-parallel via fleet + CompiledProgram across
+processes started by paddle_tpu.distributed.launch; prints final losses as
+JSON on the last line."""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+import jax
+
+if os.environ.get("PADDLE_TPU_FORCE_CPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as pt
+from paddle_tpu.parallel import DistributedStrategy, PaddleCloudRoleMaker, fleet
+
+
+def main():
+    fleet.init(PaddleCloudRoleMaker())
+    rank = fleet.worker_index()
+
+    main_prog, startup = pt.Program(), pt.Program()
+    main_prog.random_seed = startup.random_seed = 5
+    with pt.framework.unique_name.guard(), pt.program_guard(main_prog, startup):
+        x = pt.layers.data(name="x", shape=[16], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        h = pt.layers.fc(input=x, size=32, act="relu")
+        pred = pt.layers.fc(input=h, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred, label=y))
+        opt = fleet.distributed_optimizer(
+            pt.optimizer.SGD(learning_rate=0.1), DistributedStrategy())
+        opt.minimize(loss)
+
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    prog = pt.CompiledProgram(main_prog).with_data_parallel(loss_name=loss.name)
+
+    # deterministic global dataset; each process feeds its slice
+    rng = np.random.RandomState(3)
+    X = rng.rand(64, 16).astype("float32")
+    Y = (X @ rng.rand(16, 1)).astype("float32")
+    n = fleet.worker_num()
+    lo = rank * (64 // n)
+    hi = lo + 64 // n
+    losses = []
+    for _ in range(10):
+        l = exe.run(prog, feed={"x": X[lo:hi], "y": Y[lo:hi]},
+                    fetch_list=[loss])[0]
+        losses.append(float(np.asarray(l).reshape(())))
+    print(json.dumps({"rank": rank, "losses": losses}))
+
+
+if __name__ == "__main__":
+    main()

@@ -146,3 +146,52 @@ def test_grad_clip_by_global_norm(rng):
     w1 = np.array(scope.get(params[0].name))
     # update magnitude bounded by clip_norm * lr
     assert np.linalg.norm(w1 - w0) <= 1.0 + 1e-4
+
+
+def test_gradient_merge_applies_every_k(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(input=x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred, label=y))
+        pt.optimizer.GradientMergeOptimizer(
+            pt.optimizer.SGD(0.1), k_steps=4).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    scope = pt.global_scope()
+    pname = [v.name for v in main.list_vars() if isinstance(v, pt.Parameter)][0]
+    X = rng.rand(8, 4).astype("float32")
+    Y = rng.rand(8, 1).astype("float32")
+    prev = np.array(scope.get(pname))
+    changed = []
+    for i in range(8):
+        exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        cur = np.array(scope.get(pname))
+        changed.append(not np.array_equal(cur, prev))
+        prev = cur
+    assert changed == [False, False, False, True] * 2
+
+
+def test_cond_state_writes_persist(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        flag = pt.layers.data(name="flag", shape=[1], dtype="float32")
+        counter = pt.layers.create_global_var([1], 0.0, "float32",
+                                              persistable=True, name="ctr")
+        pred = pt.layers.reduce_sum(flag) > 0.0
+
+        def bump():
+            blk = main.current_block()
+            blk.append_op(type="increment", inputs={"X": counter},
+                          outputs={"Out": counter}, attrs={"step": 1.0})
+
+        pt.layers.cond_state(pred, bump)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    scope = pt.global_scope()
+    on = np.array([[1.0]], "float32")
+    off = np.array([[0.0]], "float32")
+    for f in (on, off, on, on):
+        exe.run(main, feed={"flag": f}, fetch_list=[])
+    assert float(scope.get("ctr").reshape(())) == 3.0
