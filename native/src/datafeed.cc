@@ -1,0 +1,290 @@
+// Native host-side data pipeline.
+//
+// Reference: paddle/fluid/framework/data_feed.h:61 (DataFeed,
+// MultiSlotDataFeed), data_set.h:41 (Dataset: file-list sharding,
+// pipe_command preprocessing, in-memory global shuffle, channels feeding
+// worker threads). The reference implements this stack in C++ because the
+// Python GIL cannot sustain industrial CTR ingest rates; the same argument
+// holds on TPU hosts, where the input pipeline must outrun the MXU.
+//
+// This library keeps the same architecture: a reader thread per file shard
+// pushes parsed records into a bounded channel (the reference's
+// ChannelObject, framework/channel.h), an optional shuffle buffer
+// randomizes order, and batches are assembled into contiguous buffers the
+// Python side wraps zero-copy as numpy arrays.
+//
+// C ABI (consumed via ctypes, paddle_tpu/io/native.py):
+//   ptio_create / ptio_destroy
+//   ptio_set_filelist, ptio_set_pipe_command, ptio_set_slots,
+//   ptio_set_batch_size, ptio_set_shuffle, ptio_set_num_threads,
+//   ptio_start, ptio_next_batch, ptio_release_batch, ptio_stats
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Record {
+  std::vector<float> values;  // all slots concatenated
+};
+
+// Bounded MPMC channel (reference: framework/channel.h ChannelObject).
+class Channel {
+ public:
+  explicit Channel(size_t cap) : cap_(cap) {}
+
+  bool push(Record&& r) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_push_.wait(lk, [&] { return q_.size() < cap_ || closed_; });
+    if (closed_) return false;
+    q_.push(std::move(r));
+    cv_pop_.notify_one();
+    return true;
+  }
+
+  bool pop(Record* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_pop_.wait(lk, [&] { return !q_.empty() || done_writing_; });
+    if (q_.empty()) return false;
+    *out = std::move(q_.front());
+    q_.pop();
+    cv_push_.notify_one();
+    return true;
+  }
+
+  void writer_done() {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (--writers_ == 0) done_writing_ = true;
+    cv_pop_.notify_all();
+  }
+
+  void add_writer() {
+    std::lock_guard<std::mutex> lk(mu_);
+    ++writers_;
+    done_writing_ = false;
+  }
+
+  void close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    done_writing_ = true;
+    cv_push_.notify_all();
+    cv_pop_.notify_all();
+  }
+
+ private:
+  size_t cap_;
+  std::mutex mu_;
+  std::condition_variable cv_push_, cv_pop_;
+  std::queue<Record> q_;
+  int writers_ = 0;
+  bool done_writing_ = false;
+  bool closed_ = false;
+};
+
+struct Dataset {
+  std::vector<std::string> files;
+  std::string pipe_command;          // preprocess each file through a shell pipe
+  std::vector<int64_t> slot_sizes;   // flattened length per slot
+  int64_t record_len = 0;
+  int batch_size = 1;
+  int shuffle_buffer = 0;            // 0 = no shuffle
+  uint64_t seed = 0;
+  int num_threads = 1;
+  int trainer_id = 0;                // file-shard across trainers
+  int num_trainers = 1;
+  bool drop_last = true;
+
+  Channel channel{4096};
+  std::vector<std::thread> readers;
+  std::atomic<int64_t> records_read{0};
+  std::atomic<int64_t> lines_skipped{0};
+  std::atomic<bool> started{false};
+
+  // shuffle state (single consumer assembles batches)
+  std::vector<Record> shuffle_buf;
+  std::mt19937_64 rng;
+
+  ~Dataset() { stop(); }
+
+  void stop() {
+    channel.close();
+    for (auto& t : readers)
+      if (t.joinable()) t.join();
+    readers.clear();
+  }
+};
+
+void read_file(Dataset* ds, const std::string& path) {
+  FILE* f = nullptr;
+  bool is_pipe = false;
+  if (!ds->pipe_command.empty()) {
+    // reference: data_feed pipe_command — arbitrary shell preprocessing.
+    // Shell-quote the path: close-quote, escaped quote, reopen-quote for
+    // any embedded single quotes.
+    std::string quoted = "'";
+    for (char c : path) {
+      if (c == '\'')
+        quoted += "'\\''";
+      else
+        quoted += c;
+    }
+    quoted += "'";
+    std::string cmd = ds->pipe_command + " < " + quoted;
+    f = popen(cmd.c_str(), "r");
+    is_pipe = true;
+  } else {
+    f = fopen(path.c_str(), "r");
+  }
+  if (!f) return;
+
+  char* line = nullptr;
+  size_t cap = 0;
+  ssize_t n;
+  while ((n = getline(&line, &cap, f)) != -1) {
+    Record r;
+    r.values.reserve(ds->record_len);
+    char* p = line;
+    char* end = line + n;
+    while (p < end) {
+      char* next = nullptr;
+      float v = strtof(p, &next);
+      if (next == p) break;
+      r.values.push_back(v);
+      p = next;
+    }
+    if ((int64_t)r.values.size() != ds->record_len) {
+      ds->lines_skipped.fetch_add(1);
+      continue;  // malformed line: skip (reference logs + drops)
+    }
+    ds->records_read.fetch_add(1);
+    if (!ds->channel.push(std::move(r))) break;  // closed
+  }
+  free(line);
+  if (is_pipe)
+    pclose(f);
+  else
+    fclose(f);
+}
+
+void reader_thread(Dataset* ds, int tid) {
+  // file shard: trainer-level shard first (reference:
+  // DatasetImpl::SetFileList + trainer file split), then thread-level
+  for (size_t i = 0; i < ds->files.size(); ++i) {
+    if ((int)(i % ds->num_trainers) != ds->trainer_id) continue;
+    size_t local_idx = i / ds->num_trainers;
+    if ((int)(local_idx % ds->num_threads) != tid) continue;
+    read_file(ds, ds->files[i]);
+  }
+  ds->channel.writer_done();
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptio_create() { return new Dataset(); }
+
+void ptio_destroy(void* h) { delete static_cast<Dataset*>(h); }
+
+void ptio_set_filelist(void* h, const char** paths, int n) {
+  auto* ds = static_cast<Dataset*>(h);
+  ds->files.assign(paths, paths + n);
+}
+
+void ptio_set_pipe_command(void* h, const char* cmd) {
+  static_cast<Dataset*>(h)->pipe_command = cmd ? cmd : "";
+}
+
+void ptio_set_slots(void* h, const int64_t* sizes, int n) {
+  auto* ds = static_cast<Dataset*>(h);
+  ds->slot_sizes.assign(sizes, sizes + n);
+  ds->record_len = 0;
+  for (int i = 0; i < n; ++i) ds->record_len += sizes[i];
+}
+
+void ptio_set_batch_size(void* h, int bs) {
+  static_cast<Dataset*>(h)->batch_size = bs;
+}
+
+void ptio_set_shuffle(void* h, int buffer, uint64_t seed) {
+  auto* ds = static_cast<Dataset*>(h);
+  ds->shuffle_buffer = buffer;
+  ds->seed = seed;
+}
+
+void ptio_set_num_threads(void* h, int n) {
+  static_cast<Dataset*>(h)->num_threads = n > 0 ? n : 1;
+}
+
+void ptio_set_trainer(void* h, int trainer_id, int num_trainers) {
+  auto* ds = static_cast<Dataset*>(h);
+  ds->trainer_id = trainer_id;
+  ds->num_trainers = num_trainers > 0 ? num_trainers : 1;
+}
+
+void ptio_set_drop_last(void* h, int drop) {
+  static_cast<Dataset*>(h)->drop_last = drop != 0;
+}
+
+int ptio_start(void* h) {
+  auto* ds = static_cast<Dataset*>(h);
+  if (ds->started.exchange(true)) return -1;
+  ds->rng.seed(ds->seed);
+  for (int t = 0; t < ds->num_threads; ++t) ds->channel.add_writer();
+  for (int t = 0; t < ds->num_threads; ++t)
+    ds->readers.emplace_back(reader_thread, ds, t);
+  return 0;
+}
+
+// Fills caller-provided buffer [batch_size * record_len] floats.
+// Returns number of records in the batch (0 = end of data).
+int ptio_next_batch(void* h, float* out) {
+  auto* ds = static_cast<Dataset*>(h);
+  int got = 0;
+  while (got < ds->batch_size) {
+    Record r;
+    bool ok;
+    if (ds->shuffle_buffer > 1) {
+      // reservoir-style shuffle: keep the buffer full, emit random evictions
+      while ((int)ds->shuffle_buf.size() < ds->shuffle_buffer &&
+             ds->channel.pop(&r)) {
+        ds->shuffle_buf.push_back(std::move(r));
+      }
+      if (ds->shuffle_buf.empty()) break;
+      size_t j = ds->rng() % ds->shuffle_buf.size();
+      r = std::move(ds->shuffle_buf[j]);
+      ds->shuffle_buf[j] = std::move(ds->shuffle_buf.back());
+      ds->shuffle_buf.pop_back();
+      ok = true;
+    } else {
+      ok = ds->channel.pop(&r);
+      if (!ok) break;
+    }
+    if (ok) {
+      memcpy(out + (int64_t)got * ds->record_len, r.values.data(),
+             ds->record_len * sizeof(float));
+      ++got;
+    }
+  }
+  if (got < ds->batch_size && ds->drop_last) return 0;
+  return got;
+}
+
+void ptio_stats(void* h, int64_t* records, int64_t* skipped) {
+  auto* ds = static_cast<Dataset*>(h);
+  *records = ds->records_read.load();
+  *skipped = ds->lines_skipped.load();
+}
+
+}  // extern "C"

@@ -270,8 +270,7 @@ class _ShardedStep:
                 return jax.make_array_from_process_local_data(
                     sharding, np.asarray(v))
 
-            feed = {n: jax.make_array_from_process_local_data(
-                        self._feed_shardings[n], np.asarray(v))
+            feed = {n: _global(v, self._feed_shardings[n])
                     for n, v in feed.items()}
 
             def _global_named(n, v):

@@ -277,6 +277,11 @@ class Block:
     def create_var(self, name: Optional[str] = None, shape=None, dtype="float32",
                    type: str = VarType.DENSE_TENSOR, persistable: bool = False,
                    stop_gradient: bool = False, **kw) -> Variable:
+        if in_dygraph_mode():
+            # eager mode: layers get a VarBase placeholder the tracer fills
+            from ..dygraph.varbase import VarBase
+
+            return VarBase(None, name=name, stop_gradient=stop_gradient)
         if name is None:
             name = unique_name.generate("_generated_var")
         if name in self.vars:

@@ -91,14 +91,27 @@ def launch_main(argv=None):
             out = open(os.path.join(args.log_dir, f"worker.{rank}.log"), "w")
         procs.append((subprocess.Popen(cmd, env=env, stdout=out, stderr=out), out))
 
+    # supervise the group: first nonzero exit tears everything down
+    # (reference launcher terminates all children on failure; otherwise the
+    # surviving ranks hang in collectives waiting for the dead peer)
     code = 0
     try:
-        for p, out in procs:
-            rc = p.wait()
-            code = code or rc
+        live = {p.pid: p for p, _ in procs}
+        while live:
+            for pid, p in list(live.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                del live[pid]
+                if rc != 0:
+                    code = code or rc
+                    for q in live.values():
+                        q.send_signal(signal.SIGTERM)
+            time.sleep(0.2)
     except KeyboardInterrupt:
         for p, _ in procs:
-            p.send_signal(signal.SIGTERM)
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
         code = 1
     finally:
         for _, out in procs:

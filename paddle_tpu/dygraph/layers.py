@@ -11,6 +11,20 @@ from ..initializer import XavierInitializer, ConstantInitializer
 from ..param_attr import ParamAttr
 from .varbase import VarBase
 
+import weakref
+
+# Every live dygraph parameter (reference: the tracer's VarBase registry) —
+# optimizer.minimize falls back to this when no parameter_list is given.
+_ALL_PARAMETERS: "weakref.WeakSet[VarBase]" = weakref.WeakSet()
+
+
+def _register_parameter(p: VarBase):
+    _ALL_PARAMETERS.add(p)
+
+
+def all_registered_parameters() -> List[VarBase]:
+    return list(_ALL_PARAMETERS)
+
 
 def _init_numpy(initializer, shape, dtype, rng):
     """Materialize an initializer eagerly (no startup program in dygraph)."""
@@ -76,6 +90,7 @@ class Layer:
         name = attr.name or f"{self._full_name}_{'b' if is_bias else 'w'}_{len(self._parameters)}"
         p = VarBase(value, name=name, persistable=True, trainable=attr.trainable)
         p.stop_gradient = not attr.trainable
+        _register_parameter(p)
         return p
 
     def parameters(self, include_sublayers=True) -> List[VarBase]:

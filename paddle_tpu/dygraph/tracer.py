@@ -46,6 +46,9 @@ class Tracer:
     def trace_op(self, op_type: str, ins: Dict[str, List], outs: Dict[str, List],
                  attrs: Dict[str, Any]) -> Dict[str, List[VarBase]]:
         opdef = registry.get_op_def(op_type)
+        # normalize slot values: a bare VarBase means a one-element slot
+        ins = {slot: (list(v) if isinstance(v, (list, tuple)) else [v])
+               for slot, v in ins.items()}
         raw_ins = {
             slot: [v.value if isinstance(v, VarBase) else v for v in vals]
             for slot, vals in ins.items()
@@ -54,9 +57,22 @@ class Tracer:
         ctx = KernelCtx(desc, rng_key=self._next_key(),
                         is_test=not self.train_mode)
         raw_outs = opdef.call(raw_ins, attrs, ctx)
+        outs = {slot: (list(v) if isinstance(v, (list, tuple)) else [v])
+                for slot, v in (outs or {}).items()}
         out_vbs: Dict[str, List[VarBase]] = {}
         for slot, vals in raw_outs.items():
-            out_vbs[slot] = [VarBase(v) if v is not None else None for v in vals]
+            placeholders = outs.get(slot, [])
+            row: List[Optional[VarBase]] = []
+            for i, v in enumerate(vals):
+                if v is None:
+                    row.append(None)
+                    continue
+                if i < len(placeholders) and isinstance(placeholders[i], VarBase):
+                    placeholders[i].set_value(v)
+                    row.append(placeholders[i])
+                else:
+                    row.append(VarBase(v))
+            out_vbs[slot] = row
         requires_grad = (not self._no_grad) and opdef.has_grad() and any(
             isinstance(v, VarBase) and not v.stop_gradient
             for vals in ins.values() for v in vals)

@@ -14,7 +14,9 @@ from ..core.ir import normalize_dtype
 class VarBase:
     def __init__(self, value, name: Optional[str] = None, stop_gradient=False,
                  persistable=False, trainable=True):
-        self._value = jnp.asarray(value)
+        # value=None → placeholder filled in by the tracer (static-graph
+        # layers pre-create their outputs before the op runs)
+        self._value = None if value is None else jnp.asarray(value)
         self.name = name or f"eager_tmp_{id(self)}"
         self.stop_gradient = stop_gradient
         self.persistable = persistable

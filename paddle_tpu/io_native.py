@@ -1,0 +1,175 @@
+"""ctypes bindings for the native C++ data pipeline (native/src/datafeed.cc).
+
+Reference: the Python side of Dataset/DataFeed (python/paddle/fluid/
+dataset.py:22 InMemoryDataset/QueueDataset) driving the C++ pipeline via
+pybind (pybind/data_set_py.cc). Here the binding is ctypes over a C ABI —
+no pybind11 in the image — and batches arrive as numpy views over
+C-allocated buffers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_REPO, "native", "src", "datafeed.cc")
+_LIB_DIR = os.path.join(_REPO, "native", "build")
+_LIB = os.path.join(_LIB_DIR, "libptio.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build_lib():
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _LIB]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def get_lib():
+    """Load (building on first use) the native library."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB) or (
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            _build_lib()
+        lib = ctypes.CDLL(_LIB)
+        lib.ptio_create.restype = ctypes.c_void_p
+        lib.ptio_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptio_set_filelist.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int]
+        lib.ptio_set_pipe_command.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.ptio_set_slots.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int]
+        lib.ptio_set_batch_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptio_set_shuffle.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_uint64]
+        lib.ptio_set_num_threads.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptio_set_trainer.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        lib.ptio_set_drop_last.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ptio_start.argtypes = [ctypes.c_void_p]
+        lib.ptio_start.restype = ctypes.c_int
+        lib.ptio_next_batch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float)]
+        lib.ptio_next_batch.restype = ctypes.c_int
+        lib.ptio_stats.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_int64),
+                                   ctypes.POINTER(ctypes.c_int64)]
+        _lib = lib
+        return _lib
+
+
+class NativeDataset:
+    """File-backed dataset with C++ reader threads, pipe_command
+    preprocessing, trainer file-sharding and global shuffle (reference:
+    dataset.py InMemoryDataset / QueueDataset over framework/data_set.h).
+
+    Records are lines of whitespace-separated floats; `slots` declares
+    (name, flattened_size, shape) so batches come back as named numpy
+    arrays. Use `pipe_command` to adapt any on-disk format.
+    """
+
+    def __init__(self, slots: Sequence[Tuple[str, Sequence[int]]],
+                 batch_size: int = 1,
+                 shuffle_buffer: int = 0, seed: int = 0,
+                 num_threads: int = 1, pipe_command: str = "",
+                 trainer_id: int = 0, num_trainers: int = 1,
+                 drop_last: bool = True):
+        self._lib = get_lib()
+        self.slots = [(name, tuple(shape)) for name, shape in slots]
+        self._sizes = [int(np.prod(shape)) for _, shape in self.slots]
+        self.record_len = sum(self._sizes)
+        self.batch_size = batch_size
+        self._cfg = dict(shuffle_buffer=shuffle_buffer, seed=seed,
+                         num_threads=num_threads, pipe_command=pipe_command,
+                         trainer_id=trainer_id, num_trainers=num_trainers,
+                         drop_last=drop_last)
+        self._files: List[str] = []
+        self._h = None
+        self._epoch = 0
+        self._last_stats = (0, 0)
+
+    def set_filelist(self, files: Sequence[str]):
+        self._files = list(files)
+
+    def _new_handle(self):
+        h = self._lib.ptio_create()
+        arr = (ctypes.c_int64 * len(self._sizes))(*self._sizes)
+        self._lib.ptio_set_slots(h, arr, len(self._sizes))
+        self._lib.ptio_set_batch_size(h, self.batch_size)
+        cfg = self._cfg
+        # vary the shuffle stream per epoch like the reference's per-epoch
+        # reshuffle
+        self._lib.ptio_set_shuffle(h, cfg["shuffle_buffer"],
+                                   cfg["seed"] + self._epoch)
+        self._lib.ptio_set_num_threads(h, cfg["num_threads"])
+        self._lib.ptio_set_trainer(h, cfg["trainer_id"], cfg["num_trainers"])
+        self._lib.ptio_set_drop_last(h, 1 if cfg["drop_last"] else 0)
+        if cfg["pipe_command"]:
+            self._lib.ptio_set_pipe_command(h, cfg["pipe_command"].encode())
+        enc = [f.encode() for f in self._files]
+        arr = (ctypes.c_char_p * len(enc))(*enc)
+        self._lib.ptio_set_filelist(h, arr, len(enc))
+        return h
+
+    def _destroy_handle(self):
+        if self._h is not None:
+            self._lib.ptio_destroy(self._h)
+            self._h = None
+
+    def __iter__(self) -> Iterator[dict]:
+        """Each iteration is one epoch: a fresh set of C++ reader threads
+        re-reads the filelist (the reference's Dataset is re-loadable per
+        epoch, data_set.h LoadIntoMemory/ReleaseMemory)."""
+        self._destroy_handle()
+        self._h = self._new_handle()
+        self._epoch += 1
+        if self._lib.ptio_start(self._h) != 0:
+            raise RuntimeError("failed to start dataset readers")
+        buf = np.empty((self.batch_size, self.record_len), np.float32)
+        ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        try:
+            while True:
+                n = self._lib.ptio_next_batch(self._h, ptr)
+                if n <= 0:
+                    break
+                batch = {}
+                off = 0
+                for name, shape in self.slots:
+                    size = int(np.prod(shape))
+                    batch[name] = (buf[:n, off:off + size]
+                                   .reshape((n,) + shape).copy())
+                    off += size
+                yield batch
+        finally:
+            rec = ctypes.c_int64()
+            skip = ctypes.c_int64()
+            self._lib.ptio_stats(self._h, ctypes.byref(rec),
+                                 ctypes.byref(skip))
+            self._last_stats = (rec.value, skip.value)
+
+    def stats(self) -> Tuple[int, int]:
+        """(records_read, lines_skipped) of the current or last epoch."""
+        if self._h is not None:
+            rec = ctypes.c_int64()
+            skip = ctypes.c_int64()
+            self._lib.ptio_stats(self._h, ctypes.byref(rec),
+                                 ctypes.byref(skip))
+            return rec.value, skip.value
+        return self._last_stats
+
+    def __del__(self):
+        try:
+            self._destroy_handle()
+        except Exception:
+            pass

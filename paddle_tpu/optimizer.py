@@ -145,17 +145,95 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, grad_clip=None):
+        if framework.in_dygraph_mode():
+            return self._minimize_dygraph(loss, parameter_list)
         self.helper = LayerHelper(self.__class__.__name__)
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
         optimize_ops = self.apply_gradients(params_grads)
         return optimize_ops, params_grads
 
+    # -- eager (dygraph) updates --------------------------------------------
+
+    def _eager_lr(self) -> float:
+        if isinstance(self._learning_rate, Variable):
+            raise NotImplementedError(
+                "dygraph mode uses python-number learning rates")
+        return float(self._learning_rate)
+
+    def _eager_update(self, pid, value, grad):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no dygraph update; use SGD, "
+            f"Momentum or Adam in imperative mode")
+
+    def _eager_regularize(self, p, grad):
+        reg = getattr(p, "regularizer", None) or self.regularization
+        if reg is None:
+            return grad
+        import jax.numpy as jnp
+
+        from .regularizer import L1DecayRegularizer, L2DecayRegularizer
+
+        if isinstance(reg, L2DecayRegularizer):
+            return grad + reg._coeff * p.value
+        if isinstance(reg, L1DecayRegularizer):
+            return grad + reg._coeff * jnp.sign(p.value)
+        raise NotImplementedError(
+            f"dygraph regularizer {type(reg).__name__}")
+
+    def _eager_clip(self, pairs):
+        import jax.numpy as jnp
+
+        from .clip import (GradientClipByGlobalNorm, GradientClipByNorm,
+                           GradientClipByValue)
+
+        clip = self._grad_clip
+        if clip is None:
+            return pairs
+        if isinstance(clip, GradientClipByValue):
+            return [(p, jnp.clip(g, clip.min, clip.max)) for p, g in pairs]
+        if isinstance(clip, GradientClipByNorm):
+            out = []
+            for p, g in pairs:
+                n = jnp.sqrt(jnp.sum(g * g))
+                out.append((p, g * jnp.minimum(1.0, clip.clip_norm /
+                                               jnp.maximum(n, 1e-12))))
+            return out
+        if isinstance(clip, GradientClipByGlobalNorm):
+            total = sum(jnp.sum(g.astype(jnp.float32) ** 2) for _, g in pairs)
+            gn = jnp.sqrt(total)
+            scale = clip.clip_norm / jnp.maximum(gn, clip.clip_norm)
+            return [(p, g * scale) for p, g in pairs]
+        raise NotImplementedError(f"dygraph clip {type(clip).__name__}")
+
+    def _minimize_dygraph(self, loss, parameter_list=None):
+        import weakref
+
+        if parameter_list is None:
+            raise ValueError(
+                "dygraph minimize requires parameter_list (e.g. "
+                "opt.minimize(loss, parameter_list=model.parameters())): a "
+                "global fallback would update every live model's parameters")
+        if not hasattr(self, "_eager_state"):
+            # weak keys: state dies with its parameter (no id() reuse)
+            self._eager_state = weakref.WeakKeyDictionary()
+        pairs = [(p, p.grad) for p in parameter_list
+                 if not p.stop_gradient and getattr(p, "trainable", True)
+                 and p.grad is not None]
+        pairs = [(p, self._eager_regularize(p, g)) for p, g in pairs]
+        pairs = self._eager_clip(pairs)
+        for p, g in pairs:
+            p.set_value(self._eager_update(p, p.value, g))
+        return [], [(p, None) for p, _ in pairs]
+
 
 class SGDOptimizer(Optimizer):
     """reference: optimizer.py:690."""
 
     type = "sgd"
+
+    def _eager_update(self, pid, value, grad):
+        return value - self._eager_lr() * grad
 
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad
@@ -175,6 +253,17 @@ class MomentumOptimizer(Optimizer):
         super().__init__(learning_rate, **kw)
         self._momentum = momentum
         self._use_nesterov = use_nesterov
+
+    def _eager_update(self, pid, value, grad):
+        import jax.numpy as jnp
+
+        st = self._eager_state.setdefault(pid, {"v": jnp.zeros_like(value)})
+        v = self._momentum * st["v"] + grad
+        st["v"] = v
+        lr = self._eager_lr()
+        if self._use_nesterov:
+            return value - lr * (grad + self._momentum * v)
+        return value - lr * v
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -248,6 +337,19 @@ class AdamOptimizer(Optimizer):
     """reference: optimizer.py:1340."""
 
     type = "adam"
+
+    def _eager_update(self, pid, value, grad):
+        import jax.numpy as jnp
+
+        st = self._eager_state.setdefault(
+            pid, {"m": jnp.zeros_like(value), "v": jnp.zeros_like(value),
+                  "t": 0})
+        st["t"] += 1
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        st["m"] = b1 * st["m"] + (1 - b1) * grad
+        st["v"] = b2 * st["v"] + (1 - b2) * grad * grad
+        lr_t = self._eager_lr() * (1 - b2 ** st["t"]) ** 0.5 / (1 - b1 ** st["t"])
+        return value - lr_t * st["m"] / (jnp.sqrt(st["v"]) + eps)
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_mode=False, **kw):

@@ -1,0 +1,106 @@
+"""Checkpoint/inference-model io, AMP decorator, dygraph tests
+(reference analogues: test_save_load.py (io), test_imperative_basic.py,
+contrib/tests/test_image_classification_fp16.py (AMP))."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _model():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        pred = pt.layers.fc(input=x, size=2)
+        loss = pt.layers.mean(pred)
+        pt.optimizer.SGD(0.1).minimize(loss)
+    return main, startup, pred, loss
+
+
+def test_save_load_persistables_roundtrip(tmp_path, rng):
+    main, startup, pred, loss = _model()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(8, 4).astype("float32")
+    exe.run(main, feed={"x": X}, fetch_list=[loss])
+    scope = pt.global_scope()
+    pt.io.save_persistables(exe, str(tmp_path), main)
+    w0 = np.array(scope.get("fc_0.w_0"))
+    scope.set_var("fc_0.w_0", np.zeros_like(w0))
+    pt.io.load_persistables(exe, str(tmp_path), main)
+    np.testing.assert_array_equal(np.array(scope.get("fc_0.w_0")), w0)
+
+
+def test_save_inference_model_prunes_and_runs(tmp_path, rng):
+    main, startup, pred, loss = _model()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(8, 4).astype("float32")
+    pt.io.save_inference_model(str(tmp_path), ["x"], [pred], exe,
+                               main_program=main)
+    prog2, feeds, fetches = pt.io.load_inference_model(str(tmp_path), exe)
+    # pruned: no optimizer ops in the inference program
+    types = [op.type for op in prog2.global_block().ops]
+    assert "sgd" not in types
+    out = exe.run(prog2, feed={feeds[0]: X}, fetch_list=fetches)[0]
+    ref = exe.run(main, feed={"x": X}, fetch_list=[pred])[0]
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_amp_decorate_trains_and_scales_loss(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[8], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        h = pt.layers.fc(input=x, size=16, act="relu")
+        pred = pt.layers.fc(input=h, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred, label=y))
+        opt = pt.amp.decorate(pt.optimizer.SGD(0.05),
+                              init_loss_scaling=128.0)
+        opt.minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(16, 8).astype("float32")
+    Y = (X @ rng.rand(8, 1)).astype("float32")
+    losses = [float(np.asarray(exe.run(main, feed={"x": X, "y": Y},
+                                       fetch_list=[loss])[0]).reshape(()))
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_dygraph_layer_training(rng):
+    with pt.dygraph.guard():
+        linear = pt.dygraph.nn.Linear(4, 1)
+        opt = pt.optimizer.SGD(learning_rate=0.1)
+        X = rng.rand(16, 4).astype("float32")
+        Y = (X @ rng.rand(4, 1)).astype("float32")
+        losses = []
+        for _ in range(30):
+            xv = pt.dygraph.to_variable(X)
+            yv = pt.dygraph.to_variable(Y)
+            pred = linear(xv)
+            loss = pt.layers.mean(pt.layers.square_error_cost(input=pred,
+                                                              label=yv))
+            loss.backward()
+            opt.minimize(loss, parameter_list=linear.parameters())
+            linear.clear_gradients()
+            losses.append(float(np.asarray(loss.numpy()).reshape(())))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+
+def test_dygraph_matches_static(rng):
+    """reference pattern: test_imperative_mnist.py compares dygraph vs
+    static results for the same weights."""
+    X = rng.rand(4, 6).astype("float32")
+    W = rng.rand(6, 3).astype("float32")
+    b = rng.rand(3).astype("float32")
+
+    with pt.dygraph.guard():
+        lin = pt.dygraph.nn.Linear(6, 3)
+        lin.weight.set_value(W)
+        lin.bias.set_value(b)
+        dy = np.asarray(lin(pt.dygraph.to_variable(X)).numpy())
+    np.testing.assert_allclose(dy, X @ W + b, rtol=1e-5)

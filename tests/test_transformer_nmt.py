@@ -1,0 +1,87 @@
+"""Transformer NMT + beam search tests (reference analogues:
+test_transformer_api-era models, test_beam_search_op.py /
+test_beam_search_decode_op.py over LoD beams — here static-shape)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from paddle_tpu.models import transformer as tr
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = tr.TransformerConfig.tiny()
+    params, axes = tr.init(jax.random.key(0), cfg)
+    batch = tr.make_batch(jax.random.key(1), cfg, 8)
+    return cfg, params, axes, batch
+
+
+def test_nmt_loss_sane_and_trains(setup):
+    cfg, params, axes, batch = setup
+    l0 = float(tr.nmt_loss(params, cfg, batch))
+    assert abs(l0 - np.log(cfg.tgt_vocab)) < 1.5
+
+    tx = optax.adam(1e-3)
+    opt = tx.init(params)
+
+    @jax.jit
+    def step(p, o, b):
+        loss, g = jax.value_and_grad(tr.nmt_loss)(p, cfg, b)
+        upd, o = tx.update(g, o)
+        return optax.apply_updates(p, upd), o, loss
+
+    p = params
+    losses = []
+    for i in range(15):
+        p, opt, loss = step(p, opt, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_padding_mask_blocks_encoder(setup):
+    cfg, params, _, _ = setup
+    src = jnp.ones((2, 8), jnp.int32) * 5
+    lens = jnp.array([4, 8])
+    m1 = tr.encode(params, cfg, src, lens)
+    # change padded positions of row 0 — visible region must not move
+    src2 = src.at[0, 4:].set(7)
+    m2 = tr.encode(params, cfg, src2, lens)
+    np.testing.assert_allclose(np.asarray(m1[0, :4], np.float32),
+                               np.asarray(m2[0, :4], np.float32), atol=2e-2)
+
+
+def test_greedy_decode_shapes_and_eos(setup):
+    cfg, params, _, batch = setup
+    toks = tr.greedy_decode(params, cfg, batch["src_ids"][:4],
+                            batch["src_len"][:4], max_len=12)
+    assert toks.shape == (4, 12)
+    assert toks.dtype == jnp.int32
+
+
+def test_beam_search_beats_greedy(setup):
+    cfg, params, _, batch = setup
+    src = batch["src_ids"][:4]
+    sl = batch["src_len"][:4]
+    _, s1 = tr.beam_search(params, cfg, src, sl, beam_size=1, max_len=10,
+                           length_penalty=0.0)
+    _, s4 = tr.beam_search(params, cfg, src, sl, beam_size=4, max_len=10,
+                           length_penalty=0.0)
+    # the best of 4 beams can never be worse than the single greedy beam
+    assert (np.asarray(s4[:, 0]) >= np.asarray(s1[:, 0]) - 1e-4).all()
+
+
+def test_beam_search_finished_beams_freeze(setup):
+    cfg, params, _, batch = setup
+    toks, _ = tr.beam_search(params, cfg, batch["src_ids"][:2],
+                             batch["src_len"][:2], beam_size=3, max_len=10)
+    t = np.asarray(toks)
+    # after the first eos, everything must stay eos
+    for b in range(t.shape[0]):
+        for k in range(t.shape[1]):
+            row = t[b, k]
+            eos_pos = np.where(row == cfg.eos_id)[0]
+            if eos_pos.size:
+                assert (row[eos_pos[0]:] == cfg.eos_id).all()
