@@ -228,6 +228,26 @@ class Executor:
             fetches, new_rng = step(scope, norm_feed, rng)
         scope.set_var(RNG_STATE_VAR, new_rng)
 
+        from .flags import get_flag
+
+        if get_flag("FLAGS_check_nan_inf"):
+            # reference: FLAGS_check_nan_inf (flags.cc:44) — per-op NaN scan;
+            # here the post-step scan covers every written state + fetch
+            for n in step.writes:
+                v = scope.find_var(n)
+                if v is not None and jnp.issubdtype(
+                        jnp.asarray(v).dtype, jnp.floating):
+                    if not bool(jnp.isfinite(v).all()):
+                        raise RuntimeError(
+                            f"FLAGS_check_nan_inf: variable '{n}' contains "
+                            f"NaN/Inf after this step")
+            for name, f in zip(fetch_names, fetches):
+                if jnp.issubdtype(jnp.asarray(f).dtype, jnp.floating) and \
+                        not bool(jnp.isfinite(f).all()):
+                    raise RuntimeError(
+                        f"FLAGS_check_nan_inf: fetch '{name}' contains "
+                        f"NaN/Inf")
+
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)

@@ -97,6 +97,7 @@ def launch_main(argv=None):
     code = 0
     try:
         live = {p.pid: p for p, _ in procs}
+        term_deadline = None
         while live:
             for pid, p in list(live.items()):
                 rc = p.poll()
@@ -105,8 +106,17 @@ def launch_main(argv=None):
                 del live[pid]
                 if rc != 0:
                     code = code or rc
-                    for q in live.values():
-                        q.send_signal(signal.SIGTERM)
+                    if term_deadline is None:
+                        term_deadline = time.time() + 15.0
+                        for q in live.values():
+                            q.send_signal(signal.SIGTERM)
+            if term_deadline is not None and time.time() > term_deadline:
+                # SIGTERM grace expired (rank wedged in a collective or
+                # masking signals) — escalate
+                for q in live.values():
+                    if q.poll() is None:
+                        q.kill()
+                term_deadline = time.time() + 3600  # don't re-kill in a loop
             time.sleep(0.2)
     except KeyboardInterrupt:
         for p, _ in procs:

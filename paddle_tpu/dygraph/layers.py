@@ -11,19 +11,6 @@ from ..initializer import XavierInitializer, ConstantInitializer
 from ..param_attr import ParamAttr
 from .varbase import VarBase
 
-import weakref
-
-# Every live dygraph parameter (reference: the tracer's VarBase registry) —
-# optimizer.minimize falls back to this when no parameter_list is given.
-_ALL_PARAMETERS: "weakref.WeakSet[VarBase]" = weakref.WeakSet()
-
-
-def _register_parameter(p: VarBase):
-    _ALL_PARAMETERS.add(p)
-
-
-def all_registered_parameters() -> List[VarBase]:
-    return list(_ALL_PARAMETERS)
 
 
 def _init_numpy(initializer, shape, dtype, rng):
@@ -90,7 +77,9 @@ class Layer:
         name = attr.name or f"{self._full_name}_{'b' if is_bias else 'w'}_{len(self._parameters)}"
         p = VarBase(value, name=name, persistable=True, trainable=attr.trainable)
         p.stop_gradient = not attr.trainable
-        _register_parameter(p)
+        # per-parameter regularizer travels with the VarBase so the eager
+        # optimizer honors it like the static path (regularizer.py)
+        p.regularizer = attr.regularizer
         return p
 
     def parameters(self, include_sublayers=True) -> List[VarBase]:

@@ -95,7 +95,6 @@ class NativeDataset:
                          trainer_id=trainer_id, num_trainers=num_trainers,
                          drop_last=drop_last)
         self._files: List[str] = []
-        self._h = None
         self._epoch = 0
         self._last_stats = (0, 0)
 
@@ -122,25 +121,21 @@ class NativeDataset:
         self._lib.ptio_set_filelist(h, arr, len(enc))
         return h
 
-    def _destroy_handle(self):
-        if self._h is not None:
-            self._lib.ptio_destroy(self._h)
-            self._h = None
-
     def __iter__(self) -> Iterator[dict]:
         """Each iteration is one epoch: a fresh set of C++ reader threads
         re-reads the filelist (the reference's Dataset is re-loadable per
-        epoch, data_set.h LoadIntoMemory/ReleaseMemory)."""
-        self._destroy_handle()
-        self._h = self._new_handle()
+        epoch, data_set.h LoadIntoMemory/ReleaseMemory). The handle is local
+        to the generator, so concurrent iterators don't alias."""
+        h = self._new_handle()
         self._epoch += 1
-        if self._lib.ptio_start(self._h) != 0:
+        if self._lib.ptio_start(h) != 0:
+            self._lib.ptio_destroy(h)
             raise RuntimeError("failed to start dataset readers")
         buf = np.empty((self.batch_size, self.record_len), np.float32)
         ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
         try:
             while True:
-                n = self._lib.ptio_next_batch(self._h, ptr)
+                n = self._lib.ptio_next_batch(h, ptr)
                 if n <= 0:
                     break
                 batch = {}
@@ -154,22 +149,10 @@ class NativeDataset:
         finally:
             rec = ctypes.c_int64()
             skip = ctypes.c_int64()
-            self._lib.ptio_stats(self._h, ctypes.byref(rec),
-                                 ctypes.byref(skip))
+            self._lib.ptio_stats(h, ctypes.byref(rec), ctypes.byref(skip))
             self._last_stats = (rec.value, skip.value)
+            self._lib.ptio_destroy(h)
 
     def stats(self) -> Tuple[int, int]:
-        """(records_read, lines_skipped) of the current or last epoch."""
-        if self._h is not None:
-            rec = ctypes.c_int64()
-            skip = ctypes.c_int64()
-            self._lib.ptio_stats(self._h, ctypes.byref(rec),
-                                 ctypes.byref(skip))
-            return rec.value, skip.value
+        """(records_read, lines_skipped) of the last finished epoch."""
         return self._last_stats
-
-    def __del__(self):
-        try:
-            self._destroy_handle()
-        except Exception:
-            pass

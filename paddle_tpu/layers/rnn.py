@@ -1,0 +1,130 @@
+"""Recurrent layers: LSTM / GRU over padded batches.
+
+Reference: dynamic_lstm/dynamic_gru (operators/lstm_op.cc, gru_op.cc +
+math/lstm_compute, gru_compute) consume LoD sequences; StaticRNN unrolls.
+TPU-native: one differentiable `scan` op per layer over the time axis of a
+padded [N, T, D] batch (SURVEY §5: LoD → padded + lengths). Gate math
+matches the reference kernels, so converged weights transfer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+__all__ = ["lstm", "dynamic_lstm", "gru", "dynamic_gru"]
+
+
+def lstm(input, hidden_size, num_layers=1, is_reverse=False,
+         param_attr=None, bias_attr=None, h0=None, c0=None, name=None):
+    """LSTM over [N, T, D] padded input → (hidden [N, T, H], last_h, last_c).
+
+    Gate layout follows the reference lstm_op: i, f, c̃, o with combined
+    input-and-recurrent weight [D + H, 4H].
+    """
+    helper = LayerHelper("lstm", name=name)
+    out = input
+    last_h = last_c = None
+    for layer in range(num_layers):
+        D = out.shape[-1]
+        w = helper.create_parameter(
+            param_attr, shape=[D + hidden_size, 4 * hidden_size],
+            dtype=input.dtype)
+        b = helper.create_parameter(
+            bias_attr, shape=[4 * hidden_size], dtype=input.dtype,
+            is_bias=True)
+        hidden = helper.create_variable_for_type_inference(input.dtype)
+        lh = helper.create_variable_for_type_inference(input.dtype)
+        lc = helper.create_variable_for_type_inference(input.dtype)
+        inputs = {"Input": out, "Weight": w, "Bias": b}
+        if h0 is not None and layer == 0:
+            inputs["H0"] = h0
+        if c0 is not None and layer == 0:
+            inputs["C0"] = c0
+        helper.append_op(
+            type="lstm_v2",
+            inputs=inputs,
+            outputs={"Hidden": hidden, "LastH": lh, "LastC": lc},
+            attrs={"hidden_size": hidden_size, "is_reverse": is_reverse})
+        out, last_h, last_c = hidden, lh, lc
+    return out, last_h, last_c
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=False, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None):
+    """reference: layers/nn.py dynamic_lstm — input is the pre-projected
+    [N, T, 4H]; returns (hidden, cell)."""
+    helper = LayerHelper("dynamic_lstm", name=name)
+    hidden_size = size // 4
+    w = helper.create_parameter(
+        param_attr, shape=[hidden_size, 4 * hidden_size], dtype=dtype)
+    b = helper.create_parameter(
+        bias_attr, shape=[4 * hidden_size], dtype=dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": input, "Weight": w, "Bias": b}
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    if c_0 is not None:
+        inputs["C0"] = c_0
+    helper.append_op(
+        type="dynamic_lstm_v2",
+        inputs=inputs,
+        outputs={"Hidden": hidden, "Cell": cell},
+        attrs={"hidden_size": hidden_size, "is_reverse": is_reverse})
+    return hidden, cell
+
+
+def gru(input, hidden_size, num_layers=1, is_reverse=False, param_attr=None,
+        bias_attr=None, h0=None, name=None):
+    """GRU over [N, T, D] → (hidden [N, T, H], last_h). Gate math follows
+    the reference gru_op (update z, reset r, candidate c̃)."""
+    helper = LayerHelper("gru", name=name)
+    out = input
+    last_h = None
+    for layer in range(num_layers):
+        D = out.shape[-1]
+        w = helper.create_parameter(
+            param_attr, shape=[D + hidden_size, 3 * hidden_size],
+            dtype=input.dtype)
+        b = helper.create_parameter(
+            bias_attr, shape=[3 * hidden_size], dtype=input.dtype,
+            is_bias=True)
+        hidden = helper.create_variable_for_type_inference(input.dtype)
+        lh = helper.create_variable_for_type_inference(input.dtype)
+        inputs = {"Input": out, "Weight": w, "Bias": b}
+        if h0 is not None and layer == 0:
+            inputs["H0"] = h0
+        helper.append_op(
+            type="gru_v2",
+            inputs=inputs,
+            outputs={"Hidden": hidden, "LastH": lh},
+            attrs={"hidden_size": hidden_size, "is_reverse": is_reverse})
+        out, last_h = hidden, lh
+    return out, last_h
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, name=None):
+    """reference: layers/nn.py dynamic_gru — input pre-projected [N,T,3H]."""
+    helper = LayerHelper("dynamic_gru", name=name)
+    w = helper.create_parameter(param_attr, shape=[size, 3 * size],
+                                dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=[3 * size],
+                                dtype=input.dtype, is_bias=True)
+    hidden = helper.create_variable_for_type_inference(input.dtype)
+    lh = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Input": input, "Weight": w, "Bias": b}
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    helper.append_op(
+        type="dynamic_gru_v2",
+        inputs=inputs,
+        outputs={"Hidden": hidden, "LastH": lh},
+        attrs={"hidden_size": size, "is_reverse": is_reverse})
+    return hidden, lh

@@ -15,5 +15,6 @@ from . import optimizer_ops
 from . import control_flow
 from . import metrics_ops
 from . import sequence
+from . import rnn
 from . import detection
 from . import collective

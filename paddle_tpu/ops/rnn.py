@@ -1,0 +1,138 @@
+"""LSTM / GRU kernels via lax.scan.
+
+Reference: operators/lstm_op.cc + math/lstm_compute (gate order i,f,c̃,o),
+gru_op.cc + math/gru_compute (z,r,c̃). One scan over time replaces the
+reference's per-step BLAS loop; XLA keeps the [B,·]×[·,H] gate matmuls on
+the MXU and the scan carries (h, c) in registers/VMEM.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _lstm_scan(x_proj, w_hh, h0, c0):
+    """x_proj: [N, T, 4H] (input projection + bias already added),
+    w_hh: [H, 4H]. Returns (hidden [N,T,H], last_h, last_c)."""
+    H = w_hh.shape[0]
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt + h @ w_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    xs = jnp.swapaxes(x_proj, 0, 1)  # [T, N, 4H]
+    (h_last, c_last), hs = jax.lax.scan(step, (h0, c0), xs)
+    return jnp.swapaxes(hs, 0, 1), h_last, c_last
+
+
+@register_op("lstm_v2", nondiff_inputs=())
+def lstm_v2(ins, attrs, ctx):
+    x = ins["Input"][0]                      # [N, T, D]
+    w = ins["Weight"][0]                     # [D+H, 4H]
+    b = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    H = int(attrs["hidden_size"])
+    N = x.shape[0]
+    if bool(attrs.get("is_reverse", False)):
+        x = jnp.flip(x, axis=1)
+    w_ih, w_hh = w[:-H], w[-H:]
+    x_proj = jnp.einsum("ntd,dh->nth", x, w_ih)
+    if b is not None:
+        x_proj = x_proj + b
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else \
+        jnp.zeros((N, H), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") and ins["C0"][0] is not None else \
+        jnp.zeros((N, H), x.dtype)
+    hidden, h_last, c_last = _lstm_scan(x_proj, w_hh, h0, c0)
+    if bool(attrs.get("is_reverse", False)):
+        hidden = jnp.flip(hidden, axis=1)
+    return {"Hidden": hidden, "LastH": h_last, "LastC": c_last}
+
+
+@register_op("dynamic_lstm_v2", nondiff_inputs=())
+def dynamic_lstm_v2(ins, attrs, ctx):
+    """Pre-projected input [N, T, 4H] (reference dynamic_lstm contract)."""
+    x = ins["Input"][0]
+    w = ins["Weight"][0]                     # [H, 4H]
+    b = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    H = int(attrs["hidden_size"])
+    N = x.shape[0]
+    if bool(attrs.get("is_reverse", False)):
+        x = jnp.flip(x, axis=1)
+    if b is not None:
+        x = x + b
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else \
+        jnp.zeros((N, H), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") and ins["C0"][0] is not None else \
+        jnp.zeros((N, H), x.dtype)
+    hidden, h_last, c_last = _lstm_scan(x, w, h0, c0)
+    if bool(attrs.get("is_reverse", False)):
+        hidden = jnp.flip(hidden, axis=1)
+    return {"Hidden": hidden, "Cell": c_last}
+
+
+def _gru_scan(x_proj, w_hh, h0):
+    """x_proj [N,T,3H], w_hh [H, 3H] (z|r|c layout)."""
+    H = w_hh.shape[0]
+    w_zr, w_c = w_hh[:, :2 * H], w_hh[:, 2 * H:]
+
+    def step(h, xt):
+        zr = jax.nn.sigmoid(xt[..., :2 * H] + h @ w_zr)
+        z, r = jnp.split(zr, 2, axis=-1)
+        c = jnp.tanh(xt[..., 2 * H:] + (r * h) @ w_c)
+        h = (1 - z) * h + z * c
+        return h, h
+
+    xs = jnp.swapaxes(x_proj, 0, 1)
+    h_last, hs = jax.lax.scan(step, h0, xs)
+    return jnp.swapaxes(hs, 0, 1), h_last
+
+
+@register_op("gru_v2", nondiff_inputs=())
+def gru_v2(ins, attrs, ctx):
+    x = ins["Input"][0]
+    w = ins["Weight"][0]                     # [D+H, 3H]
+    b = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    H = int(attrs["hidden_size"])
+    N = x.shape[0]
+    if bool(attrs.get("is_reverse", False)):
+        x = jnp.flip(x, axis=1)
+    w_ih, w_hh = w[:-H], w[-H:]
+    x_proj = jnp.einsum("ntd,dh->nth", x, w_ih)
+    if b is not None:
+        x_proj = x_proj + b
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else \
+        jnp.zeros((N, H), x.dtype)
+    hidden, h_last = _gru_scan(x_proj, w_hh, h0)
+    if bool(attrs.get("is_reverse", False)):
+        hidden = jnp.flip(hidden, axis=1)
+    return {"Hidden": hidden, "LastH": h_last}
+
+
+@register_op("dynamic_gru_v2", nondiff_inputs=())
+def dynamic_gru_v2(ins, attrs, ctx):
+    x = ins["Input"][0]                      # [N, T, 3H]
+    w = ins["Weight"][0]                     # [H, 3H]
+    b = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None else None
+    H = int(attrs["hidden_size"])
+    N = x.shape[0]
+    if bool(attrs.get("is_reverse", False)):
+        x = jnp.flip(x, axis=1)
+    if b is not None:
+        x = x + b
+    h0 = ins["H0"][0] if ins.get("H0") and ins["H0"][0] is not None else \
+        jnp.zeros((N, H), x.dtype)
+    hidden, h_last = _gru_scan(x, w, h0)
+    if bool(attrs.get("is_reverse", False)):
+        hidden = jnp.flip(hidden, axis=1)
+    return {"Hidden": hidden, "LastH": h_last}

@@ -146,7 +146,7 @@ class Optimizer:
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, grad_clip=None):
         if framework.in_dygraph_mode():
-            return self._minimize_dygraph(loss, parameter_list)
+            return self._minimize_dygraph(loss, parameter_list, no_grad_set)
         self.helper = LayerHelper(self.__class__.__name__)
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
@@ -206,7 +206,7 @@ class Optimizer:
             return [(p, g * scale) for p, g in pairs]
         raise NotImplementedError(f"dygraph clip {type(clip).__name__}")
 
-    def _minimize_dygraph(self, loss, parameter_list=None):
+    def _minimize_dygraph(self, loss, parameter_list=None, no_grad_set=None):
         import weakref
 
         if parameter_list is None:
@@ -217,9 +217,11 @@ class Optimizer:
         if not hasattr(self, "_eager_state"):
             # weak keys: state dies with its parameter (no id() reuse)
             self._eager_state = weakref.WeakKeyDictionary()
+        skip = {n if isinstance(n, str) else n.name
+                for n in (no_grad_set or ())}
         pairs = [(p, p.grad) for p in parameter_list
                  if not p.stop_gradient and getattr(p, "trainable", True)
-                 and p.grad is not None]
+                 and p.grad is not None and p.name not in skip]
         pairs = [(p, self._eager_regularize(p, g)) for p, g in pairs]
         pairs = self._eager_clip(pairs)
         for p, g in pairs:
@@ -257,7 +259,9 @@ class MomentumOptimizer(Optimizer):
     def _eager_update(self, pid, value, grad):
         import jax.numpy as jnp
 
-        st = self._eager_state.setdefault(pid, {"v": jnp.zeros_like(value)})
+        if pid not in self._eager_state:
+            self._eager_state[pid] = {"v": jnp.zeros_like(value)}
+        st = self._eager_state[pid]
         v = self._momentum * st["v"] + grad
         st["v"] = v
         lr = self._eager_lr()
@@ -341,9 +345,10 @@ class AdamOptimizer(Optimizer):
     def _eager_update(self, pid, value, grad):
         import jax.numpy as jnp
 
-        st = self._eager_state.setdefault(
-            pid, {"m": jnp.zeros_like(value), "v": jnp.zeros_like(value),
-                  "t": 0})
+        if pid not in self._eager_state:
+            self._eager_state[pid] = {"m": jnp.zeros_like(value),
+                                      "v": jnp.zeros_like(value), "t": 0}
+        st = self._eager_state[pid]
         st["t"] += 1
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
         st["m"] = b1 * st["m"] + (1 - b1) * grad

@@ -1,0 +1,144 @@
+"""LSTM/GRU layers + inference Predictor + flags tests (reference analogues:
+test_lstm_op.py, test_gru_op.py, inference api_impl_tester.cc,
+test_nan_inf.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _run(main, startup, feed, fetch):
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_lstm_matches_numpy(rng):
+    N, T, D, H = 2, 5, 3, 4
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[T, D], dtype="float32")
+        hidden, lh, lc = pt.layers.lstm(x, hidden_size=H)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(N, T, D).astype("float32")
+    hid, hlast, clast = exe.run(main, feed={"x": X}, fetch_list=[hidden, lh, lc])
+    scope = pt.global_scope()
+    w = np.array(scope.get([v.name for v in main.list_vars()
+                            if isinstance(v, pt.Parameter) and "w" in v.name][0]))
+    b = np.array(scope.get([v.name for v in main.list_vars()
+                            if isinstance(v, pt.Parameter) and "b" in v.name][0]))
+    w_ih, w_hh = w[:-H], w[-H:]
+
+    def sigmoid(v):
+        return 1 / (1 + np.exp(-v))
+
+    h = np.zeros((N, H)); c = np.zeros((N, H))
+    for t in range(T):
+        g = X[:, t] @ w_ih + b + h @ w_hh
+        i, f, gg, o = np.split(g, 4, -1)
+        c = sigmoid(f) * c + sigmoid(i) * np.tanh(gg)
+        h = sigmoid(o) * np.tanh(c)
+        np.testing.assert_allclose(hid[:, t], h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(hlast, h, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(clast, c, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_shapes_and_grads(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[6, 5], dtype="float32")
+        hidden, lh = pt.layers.gru(x, hidden_size=8)
+        loss = pt.layers.mean(hidden)
+        pt.optimizer.SGD(0.5).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(3, 6, 5).astype("float32")
+    losses = [float(np.asarray(_l).reshape(()))
+              for _ in range(10)
+              for _l in exe.run(main, feed={"x": X}, fetch_list=[loss])]
+    assert losses[-1] < losses[0]  # mean(hidden) decreases under SGD
+
+
+def test_sentiment_style_model_trains(rng):
+    """reference: tests/book understand_sentiment (emb → lstm → pool → fc)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        ids = pt.layers.data(name="ids", shape=[12, 1], dtype="int64")
+        label = pt.layers.data(name="label", shape=[1], dtype="int64")
+        emb = pt.layers.embedding(input=ids, size=[50, 16])
+        emb = pt.layers.reshape(emb, shape=[-1, 12, 16])
+        hidden, _, _ = pt.layers.lstm(emb, hidden_size=16)
+        pooled = pt.layers.sequence_pool(hidden, "max")
+        logits = pt.layers.fc(input=pooled, size=2)
+        loss = pt.layers.mean(pt.layers.softmax_with_cross_entropy(
+            logits=logits, label=label))
+        pt.optimizer.Adam(0.01).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    IDS = rng.randint(0, 50, (16, 12, 1)).astype("int64")
+    LAB = (IDS[:, 0] % 2).astype("int64")
+    losses = [float(np.asarray(exe.run(main, feed={"ids": IDS, "label": LAB},
+                                       fetch_list=[loss])[0]).reshape(()))
+              for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_predictor_roundtrip(tmp_path, rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        pred = pt.layers.fc(input=x, size=3, act="softmax")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(5, 4).astype("float32")
+    ref = exe.run(main, feed={"x": X}, fetch_list=[pred])[0]
+    pt.io.save_inference_model(str(tmp_path), ["x"], [pred], exe,
+                               main_program=main)
+
+    cfg = pt.AnalysisConfig(str(tmp_path))
+    predictor = pt.create_paddle_predictor(cfg)
+    assert predictor.get_input_names() == ["x"]
+    out = predictor.predict(x=X)
+    np.testing.assert_allclose(list(out.values())[0], ref, atol=1e-5)
+    # second signature compiles separately
+    out2 = predictor.predict(x=X[:2])
+    assert list(out2.values())[0].shape == (2, 3)
+    assert len(predictor._cache) == 2
+
+
+def test_predictor_aot(tmp_path, rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        pred = pt.layers.fc(input=x, size=2)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    pt.io.save_inference_model(str(tmp_path), ["x"], [pred], exe,
+                               main_program=main)
+    cfg = pt.AnalysisConfig(str(tmp_path))
+    cfg.enable_aot()
+    predictor = pt.create_paddle_predictor(cfg)
+    X = rng.rand(3, 4).astype("float32")
+    out = predictor.predict(x=X)
+    assert list(out.values())[0].shape == (3, 2)
+
+
+def test_check_nan_inf_flag(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[2], dtype="float32")
+        out = pt.layers.log(x)  # log(negative) = nan
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(RuntimeError, match="NaN/Inf"):
+            exe.run(main, feed={"x": np.array([[-1.0, 2.0]], "float32")},
+                    fetch_list=[out])
+        # clean input passes
+        exe.run(main, feed={"x": np.array([[1.0, 2.0]], "float32")},
+                fetch_list=[out])
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
