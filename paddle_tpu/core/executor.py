@@ -200,6 +200,19 @@ class Executor:
         feed = dict(feed or {})
         fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
 
+        # pserver program: a single listen_and_serv op — run the host server
+        # loop, blocking like the reference (listen_and_serv_op.cc)
+        ops0 = program.desc.block(0).ops
+        if len(ops0) == 1 and ops0[0].type == "listen_and_serv":
+            from ..ps.server import ParameterServer
+
+            a = ops0[0].attrs
+            server = ParameterServer(a["endpoint"], int(a["num_trainers"]),
+                                     mode=a.get("mode", "sync"))
+            scope.set_var("__pserver__", server)
+            server.serve_forever()  # blocks until shutdown request
+            return []
+
         # Normalize feeds to jnp arrays with declared dtype.
         norm_feed = {}
         for name, val in feed.items():

@@ -16,5 +16,6 @@ from . import control_flow
 from . import metrics_ops
 from . import sequence
 from . import rnn
+from . import distributed
 from . import detection
 from . import collective

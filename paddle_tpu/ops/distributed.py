@@ -1,0 +1,111 @@
+"""PS send/recv ops — RPC from inside the compiled step.
+
+Reference: operators/distributed_ops/send_op.cc, recv_op.cc,
+send_barrier_op.cc, fetch_barrier_op.cc, listen_and_serv_op.cc. The
+reference's ops call the gRPC client mid-graph; here they lower to
+jax.experimental.io_callback (ordered) so the RPC happens at the same
+program point under jit. The active client is process-global state set by
+`bind_client` (the reference's RPCClient singleton, rpc_client.h:122).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.registry import register_op
+
+_CLIENT = None
+
+
+def bind_client(client):
+    """Install the PSClient used by ps_send/ps_recv in this process."""
+    global _CLIENT
+    _CLIENT = client
+
+
+def get_client():
+    if _CLIENT is None:
+        raise RuntimeError(
+            "no PSClient bound — call paddle_tpu.ops.distributed.bind_client "
+            "(the transpiler-run trainer does this in its startup)")
+    return _CLIENT
+
+
+@register_op("ps_send", grad=None, nondiff_inputs=("X",))
+def ps_send(ins, attrs, ctx):
+    name = attrs["var_name"]
+    x = ins["X"][0]
+
+    def _send(g):
+        get_client().push_grad(name, np.asarray(g))
+        return np.zeros((), np.int32)
+
+    token = jax.experimental.io_callback(
+        _send, jax.ShapeDtypeStruct((), jnp.int32), x, ordered=True)
+    return {"Out": token}
+
+
+@register_op("ps_send_aux", grad=None, nondiff_inputs=("X",))
+def ps_send_aux(ins, attrs, ctx):
+    """Refresh a trainer-maintained optimizer aux var (decayed LR, ...) on
+    every server before the barrier (reference: the transpiler moves
+    lr_decay ops to the pserver; here the trainer stays authoritative and
+    ships the value per step)."""
+    name = attrs["var_name"]
+    x = ins["X"][0]
+
+    def _send(v):
+        get_client().set_aux_all(name, np.asarray(v))
+        return np.zeros((), np.int32)
+
+    token = jax.experimental.io_callback(
+        _send, jax.ShapeDtypeStruct((), jnp.int32), x, ordered=True)
+    return {"Out": token}
+
+
+@register_op("ps_send_barrier", grad=None)
+def ps_send_barrier(ins, attrs, ctx):
+    def _barrier():
+        get_client().send_barrier()
+        return np.zeros((), np.int32)
+
+    token = jax.experimental.io_callback(
+        _barrier, jax.ShapeDtypeStruct((), jnp.int32), ordered=True)
+    return {"Out": token}
+
+
+@register_op("ps_recv", grad=None)
+def ps_recv(ins, attrs, ctx):
+    name = attrs["var_name"]
+    # output shape comes from the program's var desc (static!)
+    out_names = ctx.op.outputs.get("Out", [])
+    shape = dtype = None
+    if ctx.program is not None and out_names:
+        for b in ctx.program.blocks:
+            if out_names[0] in b.vars:
+                vd = b.vars[out_names[0]]
+                shape = tuple(vd.shape)
+                from ..core.ir import normalize_dtype
+
+                dtype = np.dtype(normalize_dtype(vd.dtype))
+                break
+    if shape is None:
+        raise RuntimeError(f"ps_recv: unknown shape for {name}")
+
+    def _pull():
+        return get_client().pull(name).astype(dtype)
+
+    val = jax.experimental.io_callback(
+        _pull, jax.ShapeDtypeStruct(shape, dtype), ordered=True)
+    return {"Out": val}
+
+
+@register_op("listen_and_serv", grad=None)
+def listen_and_serv(ins, attrs, ctx):
+    raise RuntimeError(
+        "listen_and_serv cannot be jit-compiled; Executor.run detects it "
+        "and runs the server loop on the host (core/executor.py)")

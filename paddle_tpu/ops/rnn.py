@@ -1,6 +1,6 @@
 """LSTM / GRU kernels via lax.scan.
 
-Reference: operators/lstm_op.cc + math/lstm_compute (gate order i,f,c̃,o),
+Reference: operators/lstm_op.cc + math/lstm_compute (gate order c̃,i,f,o),
 gru_op.cc + math/gru_compute (z,r,c̃). One scan over time replaces the
 reference's per-step BLAS loop; XLA keeps the [B,·]×[·,H] gate matmuls on
 the MXU and the scan carries (h, c) in registers/VMEM.
@@ -16,24 +16,27 @@ from ..core.registry import register_op
 
 def _lstm_scan(x_proj, w_hh, h0, c0):
     """x_proj: [N, T, 4H] (input projection + bias already added),
-    w_hh: [H, 4H]. Returns (hidden [N,T,H], last_h, last_c)."""
+    w_hh: [H, 4H]. Gate slice order is c̃,i,f,o — the reference's memory
+    layout (math/detail/lstm_cpu_kernel.h: candidate +0, input +H,
+    forget +2H, output +3H), so converged reference weights transfer.
+    Returns (hidden [N,T,H], cell [N,T,H], last_h, last_c)."""
     H = w_hh.shape[0]
 
     def step(carry, xt):
         h, c = carry
         gates = xt + h @ w_hh
-        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        g, i, f, o = jnp.split(gates, 4, axis=-1)
         i = jax.nn.sigmoid(i)
         f = jax.nn.sigmoid(f)
         g = jnp.tanh(g)
         o = jax.nn.sigmoid(o)
         c = f * c + i * g
         h = o * jnp.tanh(c)
-        return (h, c), h
+        return (h, c), (h, c)
 
     xs = jnp.swapaxes(x_proj, 0, 1)  # [T, N, 4H]
-    (h_last, c_last), hs = jax.lax.scan(step, (h0, c0), xs)
-    return jnp.swapaxes(hs, 0, 1), h_last, c_last
+    (h_last, c_last), (hs, cs) = jax.lax.scan(step, (h0, c0), xs)
+    return (jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1), h_last, c_last)
 
 
 @register_op("lstm_v2", nondiff_inputs=())
@@ -53,7 +56,7 @@ def lstm_v2(ins, attrs, ctx):
         jnp.zeros((N, H), x.dtype)
     c0 = ins["C0"][0] if ins.get("C0") and ins["C0"][0] is not None else \
         jnp.zeros((N, H), x.dtype)
-    hidden, h_last, c_last = _lstm_scan(x_proj, w_hh, h0, c0)
+    hidden, _, h_last, c_last = _lstm_scan(x_proj, w_hh, h0, c0)
     if bool(attrs.get("is_reverse", False)):
         hidden = jnp.flip(hidden, axis=1)
     return {"Hidden": hidden, "LastH": h_last, "LastC": c_last}
@@ -75,10 +78,12 @@ def dynamic_lstm_v2(ins, attrs, ctx):
         jnp.zeros((N, H), x.dtype)
     c0 = ins["C0"][0] if ins.get("C0") and ins["C0"][0] is not None else \
         jnp.zeros((N, H), x.dtype)
-    hidden, h_last, c_last = _lstm_scan(x, w, h0, c0)
+    hidden, cell, h_last, c_last = _lstm_scan(x, w, h0, c0)
     if bool(attrs.get("is_reverse", False)):
         hidden = jnp.flip(hidden, axis=1)
-    return {"Hidden": hidden, "Cell": c_last}
+        cell = jnp.flip(cell, axis=1)
+    # Cell is the per-step cell-state SEQUENCE (reference lstm_op contract)
+    return {"Hidden": hidden, "Cell": cell}
 
 
 def _gru_scan(x_proj, w_hh, h0):

@@ -1,0 +1,19 @@
+"""Parameter-server training (reference: operators/distributed/ — gRPC/BRPC
+RPC layer, request handlers, Communicator; transpiler/distribute_transpiler.py).
+
+TPU-native shape of the same capability:
+- protocol.py : length-prefixed pickle frames over TCP (the reference's
+                send_recv.proto over gRPC; zero-egress image has no grpcio)
+- server.py   : var store + sync/async/GEO apply loops + heartbeat monitor
+                (listen_and_serv_op.cc RunSyncLoop/RunAsyncLoop,
+                 heart_beat_monitor.h)
+- client.py   : trainer-side client incl. the merging AsyncCommunicator
+- transpiler.py: DistributeTranspiler — splits optimize ops onto pservers,
+                rewrites the trainer program with send/recv ops
+- ops (ops/distributed.py): send/recv lower to jax io_callbacks so RPC
+                happens mid-step exactly where the reference places the ops
+"""
+
+from .client import PSClient  # noqa: F401
+from .server import ParameterServer  # noqa: F401
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401

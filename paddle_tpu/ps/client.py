@@ -1,0 +1,202 @@
+"""Trainer-side PS client + async communicator.
+
+Reference: operators/distributed/grpc/grpc_client.h (AsyncSendVar/
+AsyncGetVar), communicator.h:166/276 (AsyncCommunicator merges up to
+max_merge_var_num gradients in background send threads),
+parameter_send/recv.cc (rows-split send).
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import zlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .protocol import recv_msg, send_msg
+
+
+class _Conn:
+    def __init__(self, endpoint: str):
+        if ":" not in endpoint:
+            raise ValueError(
+                f"malformed pserver endpoint '{endpoint}' — expected "
+                f"host:port (check PADDLE_PSERVERS_IP_PORT_LIST)")
+        host, port = endpoint.rsplit(":", 1)
+        self.sock = socket.create_connection((host, int(port)))
+        self.lock = threading.Lock()
+
+    def call(self, msg) -> dict:
+        with self.lock:
+            send_msg(self.sock, msg)
+            return recv_msg(self.sock)
+
+
+class PSClient:
+    """Connects to every pserver; vars are placed by the transpiler's
+    dispatcher (name -> endpoint)."""
+
+    def __init__(self, endpoints: List[str], trainer_id: int = 0):
+        self.endpoints = list(endpoints)
+        self.trainer_id = trainer_id
+        self._conns = {ep: _Conn(ep) for ep in self.endpoints}
+        self.placement: Dict[str, str] = {}
+        self.generation = 0
+
+    def place(self, name: str) -> str:
+        ep = self.placement.get(name)
+        if ep is None:
+            # HashName dispatcher (transpiler/ps_dispatcher.py:46)
+            ep = self.endpoints[zlib.crc32(name.encode()) % len(self.endpoints)]
+            self.placement[name] = ep
+        return ep
+
+    def _call(self, name, msg) -> dict:
+        out = self._conns[self.place(name)].call(msg)
+        if "error" in out:
+            raise RuntimeError(f"pserver: {out['error']}")
+        return out
+
+    # -- var lifecycle ------------------------------------------------------
+
+    def init_var(self, name: str, value: np.ndarray, opt_descs=None):
+        self._call(name, {"op": "init_var", "name": name,
+                          "value": np.asarray(value),
+                          "opt_descs": opt_descs or []})
+
+    def init_aux(self, name: str, value: np.ndarray, owner: str):
+        """Optimizer accumulator co-located with its param `owner`."""
+        self._conns[self.place(owner)].call(
+            {"op": "init_aux", "name": name, "value": np.asarray(value)})
+
+    # -- dense path ---------------------------------------------------------
+
+    def push_grad(self, name: str, grad: np.ndarray):
+        self._call(name, {"op": "send_grad", "name": name,
+                          "grad": np.asarray(grad),
+                          "trainer_id": self.trainer_id})
+
+    def pull(self, name: str) -> np.ndarray:
+        out = self._call(name, {"op": "get", "name": name,
+                                "generation": self.generation})
+        return np.asarray(out["value"])
+
+    def send_barrier(self):
+        """reference: send_barrier_op — one per pserver per step."""
+        gens = []
+        for ep, c in self._conns.items():
+            out = c.call({"op": "send_barrier"})
+            gens.append(out.get("generation", 0))
+        self.generation = max(self.generation + 1, *gens) if gens else 0
+
+    # -- GEO ----------------------------------------------------------------
+
+    def push_delta(self, name: str, delta: np.ndarray):
+        self._call(name, {"op": "send_delta", "name": name,
+                          "delta": np.asarray(delta)})
+
+    # -- sparse -------------------------------------------------------------
+
+    def pull_sparse(self, name: str, ids: np.ndarray) -> np.ndarray:
+        out = self._call(name, {"op": "pull_sparse", "name": name, "ids": ids})
+        return np.asarray(out["rows"])
+
+    def push_sparse_grad(self, name: str, ids: np.ndarray, grads: np.ndarray,
+                         lr: float = 0.01):
+        self._call(name, {"op": "push_sparse_grad", "name": name, "ids": ids,
+                          "grads": grads, "lr": lr})
+
+    def set_aux_all(self, name: str, value: np.ndarray):
+        """Refresh an optimizer aux var (e.g. a decayed learning rate) on
+        EVERY server — the trainer-side scheduler stays authoritative."""
+        for c in self._conns.values():
+            c.call({"op": "init_aux", "name": name,
+                    "value": np.asarray(value)})
+
+    def wait_var(self, name: str, timeout: float = 60.0) -> bool:
+        """Poll until a var exists on its owner (trainer-0 publish sync)."""
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            out = self._conns[self.place(name)].call(
+                {"op": "has_var", "name": name})
+            if out.get("ok"):
+                return True
+            time.sleep(0.1)
+        return False
+
+    def wait_all_completed(self, timeout: float = 120.0) -> bool:
+        import time
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(c.call({"op": "all_completed"}).get("ok")
+                   for c in self._conns.values()):
+                return True
+            time.sleep(0.1)
+        return False
+
+    def heartbeat(self, state: Optional[int] = None):
+        for c in self._conns.values():
+            c.call({"op": "heartbeat", "trainer_id": self.trainer_id,
+                    "state": state})
+
+    def shutdown_servers(self):
+        for c in self._conns.values():
+            try:
+                c.call({"op": "shutdown"})
+            except Exception:
+                pass
+
+
+class AsyncCommunicator:
+    """reference: communicator.h:276 — background send threads merge up to
+    max_merge_var_num gradients per var before pushing (async PS mode)."""
+
+    def __init__(self, client: PSClient, max_merge_var_num: int = 20,
+                 send_wait_times: float = 0.005):
+        self.client = client
+        self.max_merge = max_merge_var_num
+        self.wait = send_wait_times
+        self._queues: Dict[str, queue.Queue] = {}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def start(self):
+        self._stop.clear()
+
+    def push(self, name: str, grad: np.ndarray):
+        q = self._queues.get(name)
+        if q is None:
+            q = self._queues[name] = queue.Queue()
+            t = threading.Thread(target=self._sender, args=(name, q),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        q.put(np.asarray(grad))
+
+    def _sender(self, name: str, q: "queue.Queue"):
+        while not self._stop.is_set():
+            try:
+                g = q.get(timeout=self.wait * 10)
+            except queue.Empty:
+                continue
+            merged, count = g.astype(np.float64), 1
+            while count < self.max_merge:
+                try:
+                    merged += q.get_nowait()
+                    count += 1
+                except queue.Empty:
+                    break
+            self.client.push_grad(name, (merged / count).astype(g.dtype))
+
+    def stop(self):
+        self._stop.set()
+        # drain remaining
+        for name, q in self._queues.items():
+            while not q.empty():
+                self.client.push_grad(name, q.get())

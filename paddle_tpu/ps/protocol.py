@@ -1,0 +1,61 @@
+"""Wire protocol: length-prefixed pickled frames over TCP.
+
+Reference: operators/distributed/send_recv.proto + grpc_serde.cc. Pickle of
+{op, name, array, ...} dicts replaces protobuf VariableMessage; numpy arrays
+ride pickle's buffer protocol (no copy on the hot path). Deserialization
+uses a restricted unpickler (ndarray/dtype/scalars only) — raw pickle would
+hand any peer on the socket arbitrary code execution, which is why the
+reference speaks protobuf."""
+
+from __future__ import annotations
+
+import io
+import pickle
+import socket
+import struct
+from typing import Any, Dict
+
+_LEN = struct.Struct("<Q")
+
+_ALLOWED = {
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy._core.numeric", "_frombuffer"),
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy.dtypes", None),  # any dtype class
+}
+
+
+class _SafeUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if (module, name) in _ALLOWED or (module, None) in _ALLOWED:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"forbidden pickle global {module}.{name}")
+
+
+def send_msg(sock: socket.socket, msg: Dict[str, Any]):
+    data = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_msg(sock: socket.socket) -> Dict[str, Any]:
+    hdr = _recv_exact(sock, _LEN.size)
+    (n,) = _LEN.unpack(hdr)
+    return _SafeUnpickler(io.BytesIO(_recv_exact(sock, n))).load()
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        b = sock.recv(min(n - got, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed")
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)

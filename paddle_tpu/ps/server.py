@@ -1,0 +1,272 @@
+"""Parameter server.
+
+Reference: operators/distributed_ops/listen_and_serv_op.cc — the pserver
+event loop. Sync mode (:110): wait for send-barrier from all trainers, run
+the optimize blocks on the accumulated gradients, release the get-barrier.
+Async mode (:226): apply the optimize block per arriving gradient. GEO mode
+(communicator.h:323): trainers push parameter deltas that are summed in.
+
+The optimize logic reuses the framework's own op kernels (the reference
+runs the very optimize sub-blocks the transpiler moved over) — the
+transpiler ships each param's optimize OpDescs; the server executes them
+eagerly on CPU via the shared registry. A HeartBeatMonitor
+(heart_beat_monitor.h:54) tracks per-trainer liveness.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .protocol import recv_msg, send_msg
+
+
+class HeartBeatMonitor:
+    """reference: operators/distributed/heart_beat_monitor.h:54 — worker
+    states UNINITED/RUNNING/COMPLETED; a thread logs workers that stop
+    beating."""
+
+    UNINITED, RUNNING, COMPLETED = 0, 1, 2
+
+    def __init__(self, num_trainers: int, timeout_s: float = 60.0):
+        self.states = {i: self.UNINITED for i in range(num_trainers)}
+        self.last_beat = {i: 0.0 for i in range(num_trainers)}
+        self.timeout_s = timeout_s
+        self.lost: List[int] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+
+    def beat(self, trainer_id: int, state: Optional[int] = None):
+        with self._lock:
+            self.last_beat[trainer_id] = time.time()
+            self.states[trainer_id] = (self.RUNNING if state is None
+                                       else state)
+
+    def _watch(self):
+        while not self._stop.wait(self.timeout_s / 4):
+            now = time.time()
+            with self._lock:
+                for tid, st in self.states.items():
+                    if st == self.RUNNING and \
+                            now - self.last_beat[tid] > self.timeout_s and \
+                            tid not in self.lost:
+                        self.lost.append(tid)
+                        print(f"[ps] LostWorkerMonitor: trainer {tid} "
+                              f"missed heartbeats for {self.timeout_s}s")
+
+    def stop(self):
+        self._stop.set()
+
+
+class _VarState:
+    __slots__ = ("value", "grad_sum", "grad_count", "opt_descs", "lock")
+
+    def __init__(self, value, opt_descs):
+        self.value = value
+        self.grad_sum = None
+        self.grad_count = 0
+        self.opt_descs = opt_descs  # [OpDesc dicts] from the transpiler
+        self.lock = threading.Lock()
+
+
+class ParameterServer:
+    """One endpoint's server. mode: 'sync' | 'async' | 'geo'."""
+
+    def __init__(self, endpoint: str, num_trainers: int, mode: str = "sync"):
+        self.host, port = endpoint.rsplit(":", 1)
+        self.port = int(port)
+        self.num_trainers = num_trainers
+        self.mode = mode
+        self.vars: Dict[str, _VarState] = {}
+        self.aux: Dict[str, np.ndarray] = {}   # optimizer accumulators
+        self.monitor = HeartBeatMonitor(num_trainers)
+        self._barrier_lock = threading.Lock()
+        self._send_barrier = 0
+        self._step_done = threading.Condition(self._barrier_lock)
+        self._generation = 0
+        self._server: Optional[socketserver.ThreadingTCPServer] = None
+
+    # -- optimize-block execution (shared op registry) ---------------------
+
+    def _run_opt(self, vs: _VarState, name: str, grad: np.ndarray):
+        """Run the param's shipped optimize OpDescs eagerly on CPU."""
+        import jax
+
+        from ..core import registry
+        from ..core.ir import OpDesc
+        from ..core.registry import KernelCtx
+
+        env: Dict[str, Any] = {name: vs.value, name + "@GRAD": grad}
+        env.update(self.aux)
+        for od in vs.opt_descs:
+            op = OpDesc.from_dict(od)
+            opdef = registry.get_op_def(op.type)
+            ins = {slot: [env.get(n) for n in names]
+                   for slot, names in op.inputs.items()}
+            ctx = KernelCtx(op)
+            outs = opdef.call(ins, op.attrs, ctx)
+            for slot, names in op.outputs.items():
+                vals = outs.get(slot, [])
+                for i, n in enumerate(names):
+                    if n and i < len(vals) and vals[i] is not None:
+                        env[n] = vals[i]
+        vs.value = np.asarray(env[name])
+        # write back ONLY the aux vars this param's optimize ops output —
+        # writing the whole env snapshot would clobber concurrent handlers'
+        # fresh moments with stale copies (async mode races)
+        written = set()
+        for od in vs.opt_descs:
+            for names in od["outputs"].values():
+                written.update(n for n in names if n)
+        for k in written:
+            if k in self.aux and k in env:
+                self.aux[k] = np.asarray(env[k])
+
+    # -- request handlers (reference: request_handler_impl.cc) -------------
+
+    def handle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        op = msg["op"]
+        if op == "init_var":
+            name = msg["name"]
+            self.vars[name] = _VarState(np.asarray(msg["value"]),
+                                        msg.get("opt_descs", []))
+            return {"ok": True}
+        if op == "init_aux":
+            self.aux[msg["name"]] = np.asarray(msg["value"])
+            return {"ok": True}
+        if op == "get":
+            vs = self.vars.get(msg["name"])
+            if vs is None:
+                return {"error": f"unknown var {msg['name']}"}
+            if self.mode == "sync":
+                # get-barrier: serve only after the current step applied
+                gen = msg.get("generation", 0)
+                with self._step_done:
+                    ok = self._step_done.wait_for(
+                        lambda: self._generation >= gen, timeout=120)
+                if not ok:
+                    return {"error":
+                            f"sync get-barrier timeout: generation "
+                            f"{self._generation} < requested {gen} (a peer "
+                            f"trainer is likely dead or wedged)"}
+            with vs.lock:
+                return {"value": vs.value}
+        if op == "send_grad":
+            self.monitor.beat(msg.get("trainer_id", 0))
+            name = msg["name"]
+            vs = self.vars.get(name)
+            if vs is None:
+                return {"error": f"unknown var {name}"}
+            grad = np.asarray(msg["grad"])
+            if self.mode == "async":
+                with vs.lock:
+                    self._run_opt(vs, name, grad)
+            else:  # sync: accumulate until barrier
+                with vs.lock:
+                    vs.grad_sum = grad if vs.grad_sum is None else \
+                        vs.grad_sum + grad
+                    vs.grad_count += 1
+            return {"ok": True}
+        if op == "send_delta":  # GEO-SGD (communicator.h:323)
+            name = msg["name"]
+            vs = self.vars.get(name)
+            if vs is None:
+                return {"error": f"unknown var {name}"}
+            with vs.lock:
+                vs.value = vs.value + np.asarray(msg["delta"])
+            return {"ok": True}
+        if op == "send_barrier":
+            # all grads of this trainer are in; when every trainer has
+            # barriered, apply optimize blocks (RunSyncLoop :110)
+            with self._barrier_lock:
+                self._send_barrier += 1
+                if self._send_barrier >= self.num_trainers:
+                    self._send_barrier = 0
+                    for name, vs in self.vars.items():
+                        with vs.lock:
+                            if vs.grad_sum is not None:
+                                g = vs.grad_sum / max(vs.grad_count, 1)
+                                self._run_opt(vs, name, g)
+                                vs.grad_sum = None
+                                vs.grad_count = 0
+                    self._generation += 1
+                    self._step_done.notify_all()
+            return {"ok": True, "generation": self._generation}
+        if op == "pull_sparse":
+            vs = self.vars.get(msg["name"])
+            if vs is None:
+                return {"error": f"unknown var {msg['name']}"}
+            ids = np.asarray(msg["ids"]).reshape(-1)
+            return {"rows": vs.value[ids]}
+        if op == "push_sparse_grad":
+            vs = self.vars.get(msg["name"])
+            if vs is None:
+                return {"error": f"unknown var {msg['name']}"}
+            ids = np.asarray(msg["ids"]).reshape(-1)
+            grads = np.asarray(msg["grads"])
+            lr = float(msg.get("lr", 0.01))
+            with vs.lock:
+                np.subtract.at(vs.value, ids, lr * grads)
+            return {"ok": True}
+        if op == "heartbeat":
+            self.monitor.beat(msg["trainer_id"], msg.get("state"))
+            return {"ok": True}
+        if op == "has_var":
+            return {"ok": msg["name"] in self.vars}
+        if op == "all_completed":
+            with self.monitor._lock:
+                done = all(s == HeartBeatMonitor.COMPLETED
+                           for s in self.monitor.states.values())
+            return {"ok": done}
+        if op == "barrier_ping":
+            return {"generation": self._generation}
+        if op == "shutdown":
+            threading.Thread(target=self.stop, daemon=True).start()
+            return {"ok": True}
+        return {"error": f"unknown op {op}"}
+
+    # -- socket plumbing ----------------------------------------------------
+
+    def serve_forever(self):
+        ps = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        msg = recv_msg(self.request)
+                        send_msg(self.request, ps.handle(msg))
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((self.host, self.port), Handler)
+        self._server.serve_forever()
+
+    def start_background(self):
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        # wait for the socket to bind
+        for _ in range(100):
+            try:
+                s = socket.create_connection((self.host, self.port), 0.2)
+                s.close()
+                return t
+            except OSError:
+                time.sleep(0.05)
+        raise RuntimeError(f"pserver failed to bind {self.host}:{self.port}")
+
+    def stop(self):
+        self.monitor.stop()
+        if self._server is not None:
+            self._server.shutdown()

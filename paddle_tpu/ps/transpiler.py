@@ -1,0 +1,176 @@
+"""DistributeTranspiler — parameter-server program rewriting.
+
+Reference: python/paddle/fluid/transpiler/distribute_transpiler.py:230 —
+`transpile(trainer_id, program, pservers, trainers)`; trainer program
+replaces optimizer ops with send/recv (+barriers), pserver program is a
+single listen_and_serv op whose sub-blocks hold each param's optimize ops
+(get_pserver_program :974). Param→server placement uses the HashName
+dispatcher (ps_dispatcher.py:46).
+
+Differences from the reference, by TPU design: gradients are NOT split into
+blocks across servers (VarBlock :70) — whole-var placement keeps the XLA
+graph static; sync is generation-counted instead of barrier-op counted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.framework import OpRole, Program, default_startup_program
+from ..core.ir import OpDesc
+
+
+@dataclasses.dataclass
+class DistributeTranspilerConfig:
+    """reference: distribute_transpiler.py:131."""
+
+    slice_var_up: bool = False      # whole-var placement (see module doc)
+    split_method: str = "HashName"
+    min_block_size: int = 8192
+    sync_mode: bool = True
+    geo_sgd_mode: bool = False
+    geo_sgd_need_push_nums: int = 100
+
+
+class DistributeTranspiler:
+    def __init__(self, config: Optional[DistributeTranspilerConfig] = None):
+        self.config = config or DistributeTranspilerConfig()
+        self._trainer_program: Optional[Program] = None
+        self._param_opt_descs: Dict[str, List[dict]] = {}
+        self._param_grads: List = []
+        self._endpoints: List[str] = []
+        self._trainers = 1
+        self._trainer_id = 0
+        self._sync_mode = True
+
+    # -- api ----------------------------------------------------------------
+
+    def transpile(self, trainer_id: int, program: Optional[Program] = None,
+                  pservers: str = "", trainers: int = 1,
+                  sync_mode: bool = True, startup_program: Optional[Program] = None):
+        from ..core import framework
+
+        program = program or framework.default_main_program()
+        self._trainer_id = trainer_id
+        self._endpoints = [e for e in pservers.split(",") if e]
+        self._trainers = trainers
+        self._sync_mode = sync_mode and not self.config.geo_sgd_mode
+
+        block = program.global_block()
+        # collect optimize-role ops per parameter (they move to the pserver)
+        opt_ops = []
+        for op in block.ops:
+            if int(op.attrs.get(OpRole.AttrName, 0)) & OpRole.Optimize:
+                opt_ops.append(op)
+        param_of_op = {}
+        for op in opt_ops:
+            pnames = [n for n in op.desc.inputs.get("Param", []) if n]
+            if pnames:
+                self._param_opt_descs.setdefault(pnames[0], []).append(
+                    op.desc.to_dict())
+                param_of_op[id(op)] = pnames[0]
+
+        # grads produced for those params
+        self._grad_of = {}
+        for op in block.ops:
+            gnames = [n for n in op.desc.inputs.get("Grad", []) if n]
+            pnames = [n for n in op.desc.inputs.get("Param", []) if n]
+            if gnames and pnames:
+                self._grad_of[pnames[0]] = gnames[0]
+
+        # trainer program: everything except optimize-role ops, plus
+        # send/recv ops bound to the PS client (ops/distributed.py)
+        trainer = Program()
+        trainer.desc = program.desc.clone()
+        tb = trainer.desc.block(0)
+        tb.ops = [od for od in tb.ops
+                  if not (int(od.attrs.get(OpRole.AttrName, 0)) & OpRole.Optimize)]
+        for pname, gname in self._grad_of.items():
+            if pname not in self._param_opt_descs:
+                continue
+            tb.ops.append(OpDesc(
+                type="ps_send", inputs={"X": [gname]}, outputs={},
+                attrs={"var_name": pname, OpRole.AttrName: OpRole.RPC}))
+        # aux vars the optimize descs read that the TRAINER still updates
+        # (LR schedulers & their counters) must refresh server-side every
+        # step — the init-time snapshot would freeze the decay
+        trainer_written = set()
+        for od in tb.ops:
+            trainer_written.update(od.output_names())
+        aux_inputs = set()
+        for descs in self._param_opt_descs.values():
+            for od in descs:
+                for names in od["inputs"].values():
+                    aux_inputs.update(n for n in names if n)
+        for pname in self._param_opt_descs:
+            aux_inputs.discard(pname)
+            aux_inputs.discard(pname + "@GRAD")
+        for aname in sorted(aux_inputs & trainer_written):
+            tb.ops.append(OpDesc(
+                type="ps_send_aux", inputs={"X": [aname]}, outputs={},
+                attrs={"var_name": aname, OpRole.AttrName: OpRole.RPC}))
+        tb.ops.append(OpDesc(type="ps_send_barrier", inputs={}, outputs={},
+                             attrs={"sync": self._sync_mode,
+                                    OpRole.AttrName: OpRole.RPC}))
+        for pname in self._param_opt_descs:
+            tb.ops.append(OpDesc(
+                type="ps_recv", inputs={}, outputs={"Out": [pname]},
+                attrs={"var_name": pname, OpRole.AttrName: OpRole.RPC}))
+        trainer._rebuild_from_desc()
+        self._trainer_program = trainer
+        self._origin_program = program
+        return self
+
+    def get_trainer_program(self, wait_port=True) -> Program:
+        return self._trainer_program
+
+    def get_pserver_program(self, endpoint: str) -> Program:
+        """A program whose single op is listen_and_serv; the Executor runs
+        the server loop directly (the reference blocks inside the op)."""
+        prog = Program()
+        placed = [p for p in self._param_opt_descs
+                  if self._place(p) == endpoint]
+        prog.global_block().desc.ops.append(OpDesc(
+            type="listen_and_serv", inputs={}, outputs={},
+            attrs={"endpoint": endpoint,
+                   "num_trainers": self._trainers,
+                   "mode": ("sync" if self._sync_mode else
+                            ("geo" if self.config.geo_sgd_mode else "async")),
+                   "params": placed}))
+        prog._rebuild_from_desc()
+        return prog
+
+    def get_pserver_programs(self, endpoint: str):
+        return self.get_pserver_program(endpoint), Program()
+
+    def get_startup_program(self, endpoint: str, pserver_program=None) -> Program:
+        return Program()
+
+    # -- runtime helpers (called by the trainer process) --------------------
+
+    def _place(self, name: str) -> str:
+        return self._endpoints[zlib.crc32(name.encode()) % len(self._endpoints)]
+
+    def publish_params(self, scope, client):
+        """Push initial params + their optimize descs and accumulators to
+        the owning pservers (reference: trainer 0 does init broadcast)."""
+        import numpy as np
+
+        for pname, descs in self._param_opt_descs.items():
+            client.placement[pname] = self._place(pname)
+            client.init_var(pname, np.asarray(scope.find_var(pname)), descs)
+            # ship every aux var the optimize descs reference (moments, lr)
+            aux_names = set()
+            for od in descs:
+                for names in od["inputs"].values():
+                    aux_names.update(n for n in names if n)
+            aux_names.discard(pname)
+            aux_names.discard(pname + "@GRAD")
+            for an in sorted(aux_names):
+                v = scope.find_var(an)
+                if v is not None:
+                    client.init_aux(an, np.asarray(v), owner=pname)

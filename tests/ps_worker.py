@@ -1,0 +1,94 @@
+"""PS-mode worker script (reference pattern: dist_mnist.py subclassing
+TestDistRunnerBase with run_pserver/run_trainer, test_dist_base.py:61).
+
+Roles via env: TRAINING_ROLE=PSERVER|TRAINER, PADDLE_PSERVERS_IP_PORT_LIST,
+PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PS_SYNC_MODE, PS_CURRENT_ENDPOINT.
+Trainers print JSON losses on the last line."""
+
+import json
+import os
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as pt
+from paddle_tpu.ops.distributed import bind_client
+from paddle_tpu.ps import DistributeTranspiler, DistributeTranspilerConfig, PSClient
+
+
+def build():
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = 7
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[8], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        h = pt.layers.fc(input=x, size=16, act="relu")
+        pred = pt.layers.fc(input=h, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred, label=y))
+        pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def data(trainer_id, trainers):
+    rng = np.random.RandomState(5)
+    X = rng.rand(32, 8).astype("float32")
+    Y = (X @ rng.rand(8, 1)).astype("float32")
+    n = 32 // trainers
+    lo = trainer_id * n
+    return X[lo:lo + n], Y[lo:lo + n], X, Y
+
+
+def main():
+    role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+    pservers = os.environ["PADDLE_PSERVERS_IP_PORT_LIST"]
+    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    sync = os.environ.get("PS_SYNC_MODE", "1") == "1"
+
+    main_prog, startup, loss = build()
+    cfg = DistributeTranspilerConfig()
+    cfg.sync_mode = sync
+    t = DistributeTranspiler(cfg)
+    t.transpile(trainer_id, program=main_prog, pservers=pservers,
+                trainers=trainers, sync_mode=sync)
+    exe = pt.Executor(pt.CPUPlace())
+
+    if role == "PSERVER":
+        ep = os.environ["PS_CURRENT_ENDPOINT"]
+        prog = t.get_pserver_program(ep)
+        exe.run(prog)  # blocks
+        return
+
+    # trainer
+    exe.run(startup)
+    client = PSClient(pservers.split(","), trainer_id=trainer_id)
+    bind_client(client)
+    pnames = sorted(t._param_opt_descs)
+    if trainer_id == 0:
+        t.publish_params(pt.global_scope(), client)
+    else:
+        # real sync: poll until trainer 0 published every param
+        for n in pnames:
+            assert client.wait_var(n, timeout=120), f"publish timeout: {n}"
+    trainer_prog = t.get_trainer_program()
+    X, Y, _, _ = data(trainer_id, trainers)
+    losses = []
+    for _ in range(10):
+        l = exe.run(trainer_prog, feed={"x": X, "y": Y}, fetch_list=[loss])[0]
+        losses.append(float(np.asarray(l).reshape(())))
+    # final params live on the pservers — pull for the parity oracle
+    params = {n: client.pull(n).tolist() for n in pnames}
+    client.heartbeat(state=2)  # COMPLETED
+    if trainer_id == 0:
+        # shut down only after every trainer reported COMPLETED
+        assert client.wait_all_completed(timeout=120)
+        client.shutdown_servers()
+    print(json.dumps({"rank": trainer_id, "losses": losses,
+                      "params": params}))
+
+
+if __name__ == "__main__":
+    main()

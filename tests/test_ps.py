@@ -1,0 +1,230 @@
+"""Parameter-server tests (reference: test_dist_base.py:461 — pserver +
+trainer subprocesses on localhost, losses vs local baseline; test_communicator,
+heart_beat_monitor)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_ports(n):
+    socks = []
+    ports = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spawn(role, pservers, trainers, trainer_id=0, sync=True, endpoint=""):
+    env = dict(os.environ)
+    env.update({
+        "TRAINING_ROLE": role,
+        "PADDLE_PSERVERS_IP_PORT_LIST": pservers,
+        "PADDLE_TRAINERS_NUM": str(trainers),
+        "PADDLE_TRAINER_ID": str(trainer_id),
+        "PS_SYNC_MODE": "1" if sync else "0",
+        "PS_CURRENT_ENDPOINT": endpoint,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    })
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests", "ps_worker.py")],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO)
+
+
+def _local_baseline():
+    """Same model/data trained locally (the reference's _run_local).
+    Returns (losses, params)."""
+    import jax
+
+    import paddle_tpu as pt
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import ps_worker
+
+    main, startup, loss = ps_worker.build()
+    exe = pt.Executor(pt.CPUPlace())
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        _, _, X, Y = ps_worker.data(0, 1)
+        losses = [float(np.asarray(exe.run(main, feed={"x": X, "y": Y},
+                                           fetch_list=[loss])[0]).reshape(()))
+                  for _ in range(10)]
+        params = {v.name: np.array(scope.get(v.name)).tolist()
+                  for v in main.list_vars() if isinstance(v, pt.Parameter)}
+    return losses, params
+
+
+@pytest.mark.slow
+def test_sync_ps_two_servers_two_trainers_loss_parity():
+    p1, p2 = _free_ports(2)
+    pservers = f"127.0.0.1:{p1},127.0.0.1:{p2}"
+    servers = [_spawn("PSERVER", pservers, 2, endpoint=f"127.0.0.1:{p}")
+               for p in (p1, p2)]
+    time.sleep(1.5)
+    trainers = [_spawn("TRAINER", pservers, 2, trainer_id=i) for i in (0, 1)]
+    outs = []
+    for t in trainers:
+        so, se = t.communicate(timeout=240)
+        assert t.returncode == 0, so + se
+        outs.append(json.loads([l for l in so.splitlines()
+                                if l.startswith("{")][-1]))
+    for s in servers:
+        s.wait(timeout=60)
+
+    # each trainer's loss on its own shard decreases
+    for o in outs:
+        assert o["losses"][-1] < o["losses"][0]
+    # both trainers pulled identical final params (sync barrier semantics)
+    for n in outs[0]["params"]:
+        np.testing.assert_allclose(outs[0]["params"][n],
+                                   outs[1]["params"][n], rtol=1e-6)
+    # parity oracle: averaged shard grads == full-batch grads, so PS params
+    # must match local full-batch training (reference: test_dist_base
+    # delta<=1e-5; fp32 ordering gives a bit more slack)
+    _, base_params = _local_baseline()
+    for n, v in base_params.items():
+        np.testing.assert_allclose(outs[0]["params"][n], v,
+                                   rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_async_ps_trains():
+    (p1,) = _free_ports(1)
+    pservers = f"127.0.0.1:{p1}"
+    server = _spawn("PSERVER", pservers, 1, sync=False,
+                    endpoint=f"127.0.0.1:{p1}")
+    time.sleep(1.5)
+    tr = _spawn("TRAINER", pservers, 1, trainer_id=0, sync=False)
+    so, se = tr.communicate(timeout=240)
+    assert tr.returncode == 0, so + se
+    out = json.loads([l for l in so.splitlines() if l.startswith("{")][-1])
+    assert out["losses"][-1] < out["losses"][0]
+    server.wait(timeout=60)
+
+
+def test_sparse_pull_push_inproc():
+    """Distributed lookup-table primitive ops (reference:
+    distributed_lookup_table_op.cc + parameter_prefetch.cc)."""
+    from paddle_tpu.ps import ParameterServer, PSClient
+
+    (port,) = _free_ports(1)
+    server = ParameterServer(f"127.0.0.1:{port}", num_trainers=1,
+                             mode="async")
+    server.start_background()
+    client = PSClient([f"127.0.0.1:{port}"])
+    table = np.arange(50, dtype=np.float32).reshape(10, 5)
+    client.init_var("emb", table)
+    rows = client.pull_sparse("emb", np.array([1, 3, 7]))
+    np.testing.assert_array_equal(rows, table[[1, 3, 7]])
+    g = np.ones((3, 5), np.float32)
+    client.push_sparse_grad("emb", np.array([1, 3, 7]), g, lr=0.5)
+    rows2 = client.pull_sparse("emb", np.array([1, 3, 7]))
+    np.testing.assert_allclose(rows2, table[[1, 3, 7]] - 0.5)
+    server.stop()
+
+
+def test_heartbeat_monitor_detects_lost_worker():
+    from paddle_tpu.ps.server import HeartBeatMonitor
+
+    mon = HeartBeatMonitor(num_trainers=2, timeout_s=0.3)
+    mon.beat(0)
+    mon.beat(1)
+    mon.beat(0, state=HeartBeatMonitor.COMPLETED)
+    # trainer 1 goes silent while RUNNING
+    time.sleep(0.8)
+    assert 1 in mon.lost and 0 not in mon.lost
+    mon.stop()
+
+
+def test_async_communicator_merges():
+    from paddle_tpu.ps import ParameterServer, PSClient
+    from paddle_tpu.ps.client import AsyncCommunicator
+
+    (port,) = _free_ports(1)
+    server = ParameterServer(f"127.0.0.1:{port}", num_trainers=1,
+                             mode="async")
+    server.start_background()
+    client = PSClient([f"127.0.0.1:{port}"])
+    client.init_var("w", np.zeros(4, np.float32), opt_descs=[{
+        "type": "sgd",
+        "inputs": {"Param": ["w"], "Grad": ["w@GRAD"],
+                   "LearningRate": ["lr"]},
+        "outputs": {"ParamOut": ["w"]}, "attrs": {}}])
+    client.init_aux("lr", np.array([1.0], np.float32), owner="w")
+    # max_merge=1: every grad pushed individually → exactly 8 SGD steps
+    comm = AsyncCommunicator(client, max_merge_var_num=1)
+    comm.start()
+    for _ in range(8):
+        comm.push("w", np.ones(4, np.float32))
+    time.sleep(0.8)
+    comm.stop()
+    w = client.pull("w")
+    np.testing.assert_allclose(w, -8.0 * np.ones(4), rtol=1e-5)
+
+    # with merging, k grads collapse into fewer averaged sends (reference
+    # semantics: merged gradient applied once) → between 1 and 8 steps more
+    comm2 = AsyncCommunicator(client, max_merge_var_num=8)
+    comm2.start()
+    for _ in range(8):
+        comm2.push("w", np.ones(4, np.float32))
+    time.sleep(0.8)
+    comm2.stop()
+    w2 = client.pull("w")
+    assert (w2 <= w - 1.0 + 1e-5).all() and (w2 >= w - 8.0 - 1e-5).all()
+    client.shutdown_servers()
+
+
+def test_geo_delta_sync_inproc():
+    """GEO-SGD: trainers train locally and push parameter deltas that the
+    server sums (reference: GeoSgdCommunicator, communicator.h:323)."""
+    from paddle_tpu.ps import ParameterServer, PSClient
+
+    (port,) = _free_ports(1)
+    server = ParameterServer(f"127.0.0.1:{port}", num_trainers=2, mode="geo")
+    server.start_background()
+    c0 = PSClient([f"127.0.0.1:{port}"], trainer_id=0)
+    c1 = PSClient([f"127.0.0.1:{port}"], trainer_id=1)
+    w0 = np.zeros(3, np.float32)
+    c0.init_var("w", w0)
+    # both trainers trained locally and push their deltas
+    c0.push_delta("w", np.array([1.0, 0.0, 0.0], np.float32))
+    c1.push_delta("w", np.array([0.0, 2.0, 0.0], np.float32))
+    np.testing.assert_allclose(c0.pull("w"), [1.0, 2.0, 0.0])
+    server.stop()
+
+
+def test_transpiler_ships_decayed_lr():
+    """LR schedulers stay on the trainer; the transpiled program must
+    refresh the decayed value server-side every step (ps_send_aux)."""
+    import paddle_tpu as pt
+    from paddle_tpu.ps import DistributeTranspiler
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        loss = pt.layers.mean(pt.layers.fc(input=x, size=1))
+        lr = pt.layers.exponential_decay(0.1, decay_steps=1, decay_rate=0.5)
+        pt.optimizer.SGD(learning_rate=lr).minimize(loss)
+    t = DistributeTranspiler()
+    t.transpile(0, program=main, pservers="127.0.0.1:1,127.0.0.1:2",
+                trainers=2)
+    types = [op.type for op in t.get_trainer_program().global_block().ops]
+    assert "ps_send_aux" in types      # decayed lr refreshes per step
+    assert "sgd" not in types          # optimize ops moved to the server
+    assert types.count("ps_send") == 2  # w and b grads

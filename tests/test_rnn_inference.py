@@ -37,7 +37,7 @@ def test_lstm_matches_numpy(rng):
     h = np.zeros((N, H)); c = np.zeros((N, H))
     for t in range(T):
         g = X[:, t] @ w_ih + b + h @ w_hh
-        i, f, gg, o = np.split(g, 4, -1)
+        gg, i, f, o = np.split(g, 4, -1)   # reference order: c-tilde,i,f,o
         c = sigmoid(f) * c + sigmoid(i) * np.tanh(gg)
         h = sigmoid(o) * np.tanh(c)
         np.testing.assert_allclose(hid[:, t], h, rtol=1e-4, atol=1e-5)
