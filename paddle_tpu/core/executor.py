@@ -209,7 +209,6 @@ class Executor:
             a = ops0[0].attrs
             server = ParameterServer(a["endpoint"], int(a["num_trainers"]),
                                      mode=a.get("mode", "sync"))
-            scope.set_var("__pserver__", server)
             server.serve_forever()  # blocks until shutdown request
             return []
 

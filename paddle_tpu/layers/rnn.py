@@ -21,8 +21,9 @@ def lstm(input, hidden_size, num_layers=1, is_reverse=False,
          param_attr=None, bias_attr=None, h0=None, c0=None, name=None):
     """LSTM over [N, T, D] padded input → (hidden [N, T, H], last_h, last_c).
 
-    Gate layout follows the reference lstm_op: i, f, c̃, o with combined
-    input-and-recurrent weight [D + H, 4H].
+    Gate layout follows the reference lstm_op memory order: c̃, i, f, o
+    (math/detail/lstm_cpu_kernel.h) with combined input-and-recurrent
+    weight [D + H, 4H] — converged reference weights transfer.
     """
     helper = LayerHelper("lstm", name=name)
     out = input

@@ -553,3 +553,9 @@ def eye(ins, attrs, ctx):
     n = int(attrs["num_rows"])
     m = int(attrs.get("num_columns", n))
     return {"Out": jnp.eye(n, m, dtype=_dt(attrs))}
+
+
+@register_op("diag")
+def diag(ins, attrs, ctx):
+    """reference: operators/diag_op.cc — vector -> diagonal matrix."""
+    return {"Out": jnp.diag(ins["Diagonal"][0])}

@@ -11,12 +11,11 @@ from __future__ import annotations
 import queue
 import socket
 import threading
-import zlib
 from typing import Dict, List, Optional
 
 import numpy as np
 
-from .protocol import recv_msg, send_msg
+from .protocol import place_endpoint, recv_msg, send_msg
 
 
 class _Conn:
@@ -49,8 +48,7 @@ class PSClient:
     def place(self, name: str) -> str:
         ep = self.placement.get(name)
         if ep is None:
-            # HashName dispatcher (transpiler/ps_dispatcher.py:46)
-            ep = self.endpoints[zlib.crc32(name.encode()) % len(self.endpoints)]
+            ep = place_endpoint(self.endpoints, name)
             self.placement[name] = ep
         return ep
 
@@ -62,10 +60,12 @@ class PSClient:
 
     # -- var lifecycle ------------------------------------------------------
 
-    def init_var(self, name: str, value: np.ndarray, opt_descs=None):
+    def init_var(self, name: str, value: np.ndarray, opt_descs=None,
+                 grad_name=None):
         self._call(name, {"op": "init_var", "name": name,
                           "value": np.asarray(value),
-                          "opt_descs": opt_descs or []})
+                          "opt_descs": opt_descs or [],
+                          "grad_name": grad_name})
 
     def init_aux(self, name: str, value: np.ndarray, owner: str):
         """Optimizer accumulator co-located with its param `owner`."""
@@ -196,7 +196,14 @@ class AsyncCommunicator:
 
     def stop(self):
         self._stop.set()
-        # drain remaining
+        for t in self._threads:
+            t.join(timeout=5)
+        # drain anything the senders left behind (non-blocking: the sender
+        # may have raced us to the last item)
         for name, q in self._queues.items():
-            while not q.empty():
-                self.client.push_grad(name, q.get())
+            while True:
+                try:
+                    g = q.get_nowait()
+                except queue.Empty:
+                    break
+                self.client.push_grad(name, g)

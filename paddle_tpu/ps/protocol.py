@@ -59,3 +59,12 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
         chunks.append(b)
         got += len(b)
     return b"".join(chunks)
+
+
+def place_endpoint(endpoints, name: str) -> str:
+    """Deterministic var->server placement shared by client and transpiler
+    (HashName dispatcher, ps_dispatcher.py:46). crc32, NOT hash(): python
+    string hashing is process-randomized."""
+    import zlib
+
+    return endpoints[zlib.crc32(name.encode()) % len(endpoints)]

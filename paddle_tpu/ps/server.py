@@ -66,13 +66,17 @@ class HeartBeatMonitor:
 
 
 class _VarState:
-    __slots__ = ("value", "grad_sum", "grad_count", "opt_descs", "lock")
+    __slots__ = ("value", "grad_sum", "grad_count", "opt_descs", "grad_name",
+                 "lock")
 
-    def __init__(self, value, opt_descs):
+    def __init__(self, value, opt_descs, grad_name=None):
         self.value = value
         self.grad_sum = None
         self.grad_count = 0
         self.opt_descs = opt_descs  # [OpDesc dicts] from the transpiler
+        # actual grad var name the descs reference (clipping and other
+        # grad-rewriting passes rename it away from <param>@GRAD)
+        self.grad_name = grad_name or None
         self.lock = threading.Lock()
 
 
@@ -104,6 +108,8 @@ class ParameterServer:
         from ..core.registry import KernelCtx
 
         env: Dict[str, Any] = {name: vs.value, name + "@GRAD": grad}
+        if vs.grad_name:
+            env[vs.grad_name] = grad
         env.update(self.aux)
         for od in vs.opt_descs:
             op = OpDesc.from_dict(od)
@@ -136,7 +142,8 @@ class ParameterServer:
         if op == "init_var":
             name = msg["name"]
             self.vars[name] = _VarState(np.asarray(msg["value"]),
-                                        msg.get("opt_descs", []))
+                                        msg.get("opt_descs", []),
+                                        msg.get("grad_name"))
             return {"ok": True}
         if op == "init_aux":
             self.aux[msg["name"]] = np.asarray(msg["value"])
@@ -204,7 +211,8 @@ class ParameterServer:
             if vs is None:
                 return {"error": f"unknown var {msg['name']}"}
             ids = np.asarray(msg["ids"]).reshape(-1)
-            return {"rows": vs.value[ids]}
+            with vs.lock:  # torn reads vs concurrent push_sparse_grad
+                return {"rows": vs.value[ids].copy()}
         if op == "push_sparse_grad":
             vs = self.vars.get(msg["name"])
             if vs is None:

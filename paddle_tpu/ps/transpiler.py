@@ -15,13 +15,11 @@ graph static; sync is generation-counted instead of barrier-op counted.
 from __future__ import annotations
 
 import dataclasses
-import zlib
 from typing import Dict, List, Optional
-
-import numpy as np
 
 from ..core.framework import OpRole, Program, default_startup_program
 from ..core.ir import OpDesc
+from .protocol import place_endpoint
 
 
 @dataclasses.dataclass
@@ -41,7 +39,6 @@ class DistributeTranspiler:
         self.config = config or DistributeTranspilerConfig()
         self._trainer_program: Optional[Program] = None
         self._param_opt_descs: Dict[str, List[dict]] = {}
-        self._param_grads: List = []
         self._endpoints: List[str] = []
         self._trainers = 1
         self._trainer_id = 0
@@ -66,13 +63,11 @@ class DistributeTranspiler:
         for op in block.ops:
             if int(op.attrs.get(OpRole.AttrName, 0)) & OpRole.Optimize:
                 opt_ops.append(op)
-        param_of_op = {}
         for op in opt_ops:
             pnames = [n for n in op.desc.inputs.get("Param", []) if n]
             if pnames:
                 self._param_opt_descs.setdefault(pnames[0], []).append(
                     op.desc.to_dict())
-                param_of_op[id(op)] = pnames[0]
 
         # grads produced for those params
         self._grad_of = {}
@@ -153,7 +148,7 @@ class DistributeTranspiler:
     # -- runtime helpers (called by the trainer process) --------------------
 
     def _place(self, name: str) -> str:
-        return self._endpoints[zlib.crc32(name.encode()) % len(self._endpoints)]
+        return place_endpoint(self._endpoints, name)
 
     def publish_params(self, scope, client):
         """Push initial params + their optimize descs and accumulators to
@@ -162,7 +157,8 @@ class DistributeTranspiler:
 
         for pname, descs in self._param_opt_descs.items():
             client.placement[pname] = self._place(pname)
-            client.init_var(pname, np.asarray(scope.find_var(pname)), descs)
+            client.init_var(pname, np.asarray(scope.find_var(pname)), descs,
+                            grad_name=self._grad_of.get(pname))
             # ship every aux var the optimize descs reference (moments, lr)
             aux_names = set()
             for od in descs:

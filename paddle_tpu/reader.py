@@ -108,6 +108,10 @@ class GeneratorLoader:
                 break
             yield item
 
+    # reference idiom: `for data in loader():`
+    def __call__(self):
+        return iter(self)
+
     # non-iterable (start/reset) mode used with graph readers in the
     # reference; provided for API parity
     def start(self):

@@ -1,0 +1,176 @@
+"""EMA / ModelAverage / Lookahead / DGC optimizer extras + data pipeline
+glue (reference analogues: test_ema.py, test_lookahead.py, test_dgc_op.py,
+test_dataset.py, test_py_reader_*)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _linreg(opt_factory):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(input=x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred, label=y))
+        extra = opt_factory(loss)
+    return main, startup, loss, extra
+
+
+def test_ema_shadow_follows_params(rng):
+    def build(loss):
+        pt.optimizer.SGD(0.1).minimize(loss)
+        ema = pt.optimizer.ExponentialMovingAverage(decay=0.5)
+        ema.update()
+        return ema
+
+    main, startup, loss, ema = _linreg(build)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(8, 4).astype("float32")
+    Y = (X @ rng.rand(4, 1)).astype("float32")
+    for _ in range(10):
+        exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    scope = pt.global_scope()
+    pname = [v.name for v in main.list_vars()
+             if isinstance(v, pt.Parameter)][0]
+    w = np.array(scope.get(pname))
+    with ema.apply():
+        w_ema = np.array(scope.get(pname))
+        assert not np.allclose(w, w_ema)      # shadow differs mid-training
+    np.testing.assert_array_equal(np.array(scope.get(pname)), w)  # restored
+
+
+def test_lookahead_slow_weights_sync(rng):
+    def build(loss):
+        sgd = pt.optimizer.SGD(0.2)
+        look = pt.optimizer.LookaheadOptimizer(sgd, alpha=0.5, k=3)
+        look.minimize(loss)
+        return look
+
+    main, startup, loss, _ = _linreg(build)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(8, 4).astype("float32")
+    Y = (X @ rng.rand(4, 1)).astype("float32")
+    losses = [float(np.asarray(exe.run(main, feed={"x": X, "y": Y},
+                                       fetch_list=[loss])[0]).reshape(()))
+              for _ in range(12)]
+    assert losses[-1] < losses[0]
+
+
+def test_model_average_runs(rng):
+    def build(loss):
+        pt.optimizer.SGD(0.1).minimize(loss)
+        return pt.optimizer.ModelAverage(0.15, min_average_window=2,
+                                         max_average_window=6)
+
+    main, startup, loss, ma = _linreg(build)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(8, 4).astype("float32")
+    Y = (X @ rng.rand(4, 1)).astype("float32")
+    for _ in range(8):
+        exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    with ma.apply(exe):
+        l_avg = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])[0]
+    assert np.isfinite(np.asarray(l_avg)).all()
+
+
+def test_dgc_momentum_converges(rng):
+    def build(loss):
+        opt = pt.optimizer.DGCMomentumOptimizer(
+            learning_rate=0.1, momentum=0.9, rampup_begin_step=2,
+            sparsity=[0.5])
+        opt.minimize(loss)
+        return opt
+
+    main, startup, loss, _ = _linreg(build)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(16, 4).astype("float32")
+    Y = (X @ rng.rand(4, 1)).astype("float32")
+    losses = [float(np.asarray(exe.run(main, feed={"x": X, "y": Y},
+                                       fetch_list=[loss])[0]).reshape(()))
+              for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_train_from_dataset_with_native_pipeline(tmp_path, rng):
+    """executor.train_from_dataset over the C++ datafeed (reference:
+    §3.6 Dataset/Trainer path)."""
+    from paddle_tpu.io_native import NativeDataset
+
+    W = rng.rand(4, 1)
+    files = []
+    for i in range(2):
+        X = rng.rand(30, 4)
+        np.savetxt(tmp_path / f"f{i}.txt", np.hstack([X, X @ W]), fmt="%.5f")
+        files.append(str(tmp_path / f"f{i}.txt"))
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(input=x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred, label=y))
+        pt.optimizer.Adam(0.05).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+
+    class DS:
+        def _iter_batches(self):
+            ds = NativeDataset(slots=[("x", (4,)), ("y", (1,))],
+                               batch_size=10)
+            ds.set_filelist(files)
+            yield from ds
+
+    l0 = None
+    for _ in range(8):
+        exe.train_from_dataset(main, DS(), fetch_list=[loss])
+    l_final = float(np.asarray(exe.run(
+        main, feed={"x": rng.rand(10, 4).astype("float32") * 0 + 0.5,
+                    "y": (np.full((10, 4), 0.5) @ W).astype("float32")},
+        fetch_list=[loss])[0]).reshape(()))
+    assert l_final < 0.05
+
+
+def test_dataloader_from_generator(rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(input=x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred, label=y))
+        pt.optimizer.SGD(0.1).minimize(loss)
+        loader = pt.DataLoader.from_generator(feed_list=[x, y], capacity=8)
+
+    W = rng.rand(4, 1)
+
+    def gen():
+        for _ in range(6):
+            X = rng.rand(8, 4).astype("float32")
+            yield X, (X @ W).astype("float32")
+
+    loader.set_batch_generator(gen)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    losses = []
+    for batch in loader():
+        l = exe.run(main, feed=batch, fetch_list=[loss])[0]
+        losses.append(float(np.asarray(l).reshape(())))
+    assert len(losses) == 6
+    assert np.isfinite(losses).all()
+
+
+def test_mnist_dataset_reader():
+    """Datasets fall back to deterministic synthetic data offline
+    (zero-egress image)."""
+    from paddle_tpu.dataset import mnist
+
+    reader = mnist.train()
+    img, label = next(iter(reader()))
+    assert np.asarray(img).size == 784
+    assert 0 <= int(label) < 10

@@ -228,3 +228,40 @@ def test_transpiler_ships_decayed_lr():
     assert "ps_send_aux" in types      # decayed lr refreshes per step
     assert "sgd" not in types          # optimize ops moved to the server
     assert types.count("ps_send") == 2  # w and b grads
+
+
+def test_sync_ps_with_grad_clip_inproc(rng=np.random.RandomState(11)):
+    """Gradient clipping renames grad vars; the server must bind the shipped
+    desc's actual Grad input name (regression for the grad_name contract)."""
+    import paddle_tpu as pt
+    from paddle_tpu.ops.distributed import bind_client
+    from paddle_tpu.ps import DistributeTranspiler, ParameterServer, PSClient
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(input=x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred, label=y))
+        pt.clip.set_gradient_clip(pt.clip.GradientClipByGlobalNorm(1.0))
+        pt.optimizer.SGD(0.1).minimize(loss)
+
+    (port,) = _free_ports(1)
+    server = ParameterServer(f"127.0.0.1:{port}", num_trainers=1)
+    server.start_background()
+    t = DistributeTranspiler()
+    t.transpile(0, program=main, pservers=f"127.0.0.1:{port}", trainers=1)
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        client = PSClient([f"127.0.0.1:{port}"])
+        bind_client(client)
+        t.publish_params(pt.global_scope(), client)
+        prog = t.get_trainer_program()
+        X = rng.rand(16, 4).astype("float32")
+        Y = (X @ rng.rand(4, 1)).astype("float32")
+        losses = [float(np.asarray(exe.run(prog, feed={"x": X, "y": Y},
+                                           fetch_list=[loss])[0]).reshape(()))
+                  for _ in range(10)]
+    assert losses[-1] < losses[0], losses
+    server.stop()
