@@ -74,6 +74,48 @@ def make_mesh(config: Optional[MeshConfig] = None,
     return Mesh(arr, AXIS_ORDER)
 
 
+def make_hybrid_mesh(config: Optional[MeshConfig] = None, **axis_sizes) -> Mesh:
+    """Multi-host mesh with DCN×ICI factorization: the OUTER axes (pp, dp —
+    AXIS_ORDER) ride the slow inter-host network, inner axes stay on ICI.
+    This is the reference's hierarchical allreduce (nccl_helper.h:246) as a
+    mesh shape instead of hand-built two-level rings."""
+    from jax.experimental import mesh_utils
+
+    config = config or (MeshConfig(**axis_sizes) if axis_sizes else MeshConfig())
+    if jax.process_count() == 1:
+        return make_mesh(config)
+    sizes = config.resolve(jax.device_count())
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+    # split each axis into (dcn, ici) factors greedily from the outside;
+    # one DCN granule per process (process_is_granule)
+    dcn = [1] * len(shape)
+    remaining_hosts = jax.process_count()
+    ici = list(shape)
+    for i, s in enumerate(shape):
+        g = math.gcd(s, remaining_hosts)
+        dcn[i] = g
+        ici[i] = s // g
+        remaining_hosts //= g
+        if remaining_hosts == 1:
+            break
+    if remaining_hosts != 1:
+        raise ValueError(
+            f"cannot factor {jax.process_count()} hosts into mesh {sizes}")
+    # the host factor must be fully absorbed by the OUTER (pp/dp/ep) axes —
+    # a DCN factor on sp/tp would put per-layer collectives on the slow
+    # network, defeating the point of the hierarchy
+    inner_start = AXIS_ORDER.index("sp")
+    if any(d > 1 for d in dcn[inner_start:]):
+        raise ValueError(
+            f"hybrid mesh would place a DCN factor on an inner axis "
+            f"(dcn={dict(zip(AXIS_ORDER, dcn))}); grow pp/dp/ep to cover "
+            f"{jax.process_count()} hosts or use make_mesh()")
+    devices = mesh_utils.create_hybrid_device_mesh(
+        tuple(ici), tuple(dcn), devices=jax.devices(),
+        process_is_granule=True)
+    return Mesh(devices, AXIS_ORDER)
+
+
 def auto_mesh(n_devices: Optional[int] = None, model_parallel: int = 1) -> Mesh:
     """Data-parallel mesh with optional inner tensor-parallel axis —
     the default the reference's ParallelExecutor gives you."""

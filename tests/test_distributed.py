@@ -145,3 +145,13 @@ def test_multiprocess_launch_loss_parity():
             if line.startswith("{")]
     np.testing.assert_allclose(sres[0]["losses"], results[0]["losses"],
                                rtol=1e-3, atol=1e-5)
+
+
+def test_hybrid_mesh_single_host_falls_back():
+    import jax
+
+    from paddle_tpu.parallel.mesh import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(dp=-1, tp=2)
+    assert mesh.shape["tp"] == 2
+    assert mesh.devices.size == len(jax.devices())
