@@ -88,13 +88,18 @@ class Fleet:
     # -- mesh --------------------------------------------------------------
 
     def mesh(self, strategy: Optional[DistributedStrategy] = None):
-        from .mesh import make_mesh
+        from .mesh import make_hybrid_mesh, make_mesh
 
         strategy = strategy or self._strategy or DistributedStrategy()
         cfg = strategy.mesh_config()
         key = tuple(sorted(cfg.resolve(len(jax.devices())).items()))
         if self._mesh is None or self._mesh_key != key:
-            self._mesh = make_mesh(cfg)
+            # multi-host (or the explicit hierarchical knob): DCN×ICI
+            # factorized mesh so dp gradients reduce intra-host first
+            if jax.process_count() > 1 or strategy.use_hierarchical_allreduce:
+                self._mesh = make_hybrid_mesh(cfg)
+            else:
+                self._mesh = make_mesh(cfg)
             self._mesh_key = key
         return self._mesh
 

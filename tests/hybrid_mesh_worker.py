@@ -1,0 +1,33 @@
+"""Worker: build a hybrid DCN×ICI mesh under jax.distributed and run a
+psum over it (exercises make_hybrid_mesh's multi-host branch)."""
+
+import json
+import os
+
+import jax
+
+if os.environ.get("PADDLE_TPU_FORCE_CPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+from paddle_tpu.parallel import PaddleCloudRoleMaker, fleet
+from paddle_tpu.parallel.mesh import make_hybrid_mesh
+
+
+def main():
+    fleet.init(PaddleCloudRoleMaker())
+    mesh = make_hybrid_mesh(dp=-1, tp=2)
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # sum a dp-sharded array — touches every device in the hybrid layout
+    n = mesh.devices.size
+    x = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")),
+        jnp.ones((n // mesh.shape["tp"] // jax.process_count(),)))
+    total = float(jax.jit(lambda v: v.sum(), out_shardings=NamedSharding(mesh, P()))(x))
+    print(json.dumps({"rank": fleet.worker_index(),
+                      "shape": dict(mesh.shape), "sum": total}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""End-to-end "book" model tests (reference: python/paddle/fluid/tests/book/
+— 9 classic models, each train → save → load-inference; SURVEY §4). These
+use the offline-synthetic dataset readers and small configs so the whole
+ladder runs on the CPU mesh in seconds."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.dataset import mnist, uci_housing
+
+
+def test_fit_a_line(tmp_path):
+    """reference: book/test_fit_a_line.py."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[13], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(input=x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred, label=y))
+        pt.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+
+    batch = []
+    losses = []
+    for epoch in range(4):
+        for sample in uci_housing.train()():
+            batch.append(sample)
+            if len(batch) == 32:
+                X = np.stack([b[0] for b in batch]).astype("float32")
+                Y = np.stack([b[1] for b in batch]).reshape(-1, 1).astype("float32")
+                l = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])[0]
+                losses.append(float(np.asarray(l).reshape(())))
+                batch = []
+    assert losses[-1] < losses[0]
+
+    pt.io.save_inference_model(str(tmp_path), ["x"], [pred], exe,
+                               main_program=main)
+    prog, feeds, fetches = pt.io.load_inference_model(str(tmp_path), exe)
+    out = exe.run(prog, feed={feeds[0]: X}, fetch_list=fetches)[0]
+    assert out.shape == (32, 1)
+
+
+def test_recognize_digits_lenet(tmp_path):
+    """reference: book/test_recognize_digits.py (conv variant) — trains the
+    models/lenet.py static-graph builder on synthetic mnist, checks accuracy
+    improves, exports + serves via the Predictor."""
+    from paddle_tpu.models import lenet
+
+    main, startup, feeds, loss, acc = lenet.build_program(pt, lr=0.01)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+
+    reader = mnist.train()
+    batch, accs, losses = [], [], []
+    for sample in reader():
+        batch.append(sample)
+        if len(batch) == 64:
+            img = np.stack([b[0] for b in batch]).reshape(-1, 1, 28, 28)
+            lab = np.array([b[1] for b in batch], "int64").reshape(-1, 1)
+            l, a = exe.run(main, feed={"img": img.astype("float32"),
+                                       "label": lab},
+                           fetch_list=[loss, acc])
+            losses.append(float(np.asarray(l).reshape(())))
+            accs.append(float(np.asarray(a).reshape(())))
+            batch = []
+            if len(losses) >= 30:
+                break
+    assert losses[-1] < losses[0]
+    assert np.mean(accs[-5:]) > np.mean(accs[:5])
+
+    # export the classifier head and serve it
+    infer_prog = main.clone(for_test=True)
+    logits_name = None
+    for op in infer_prog.global_block().ops:
+        if op.type == "softmax":
+            logits_name = op.desc.outputs["Out"][0]
+    pt.io.save_inference_model(str(tmp_path), ["img"],
+                               [infer_prog.global_block().var(logits_name)],
+                               exe, main_program=infer_prog)
+    cfg = pt.AnalysisConfig(str(tmp_path))
+    predictor = pt.create_paddle_predictor(cfg)
+    probs = predictor.predict(img=img.astype("float32"))
+    arr = list(probs.values())[0]
+    assert arr.shape == (64, 10)
+    np.testing.assert_allclose(arr.sum(1), np.ones(64), atol=1e-4)
+
+
+def test_word2vec_style_embedding():
+    """reference: book/test_word2vec.py — skipgram-ish embedding learning on
+    synthetic imikolov-style pairs."""
+    V, E = 100, 16
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        w = pt.layers.data(name="w", shape=[1], dtype="int64")
+        ctx = pt.layers.data(name="ctx", shape=[1], dtype="int64")
+        emb = pt.layers.embedding(input=w, size=[V, E])
+        emb = pt.layers.reshape(emb, shape=[-1, E])
+        logits = pt.layers.fc(input=emb, size=V)
+        loss = pt.layers.mean(pt.layers.softmax_with_cross_entropy(
+            logits=logits, label=ctx))
+        pt.optimizer.Adam(0.02).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    # deterministic co-occurrence: ctx = (w + 1) % V
+    W = rng.randint(0, V, (256, 1)).astype("int64")
+    C = (W + 1) % V
+    losses = [float(np.asarray(exe.run(main, feed={"w": W, "ctx": C},
+                                       fetch_list=[loss])[0]).reshape(()))
+              for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_nets_and_metrics():
+    """nets.simple_img_conv_pool + python-side metrics accumulation
+    (reference: nets.py, metrics.py)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        img = pt.layers.data(name="img", shape=[1, 12, 12], dtype="float32")
+        conv_pool = pt.nets.simple_img_conv_pool(
+            input=img, num_filters=4, filter_size=3, pool_size=2,
+            pool_stride=2, act="relu")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    out = exe.run(main, feed={"img": np.ones((2, 1, 12, 12), "float32")},
+                  fetch_list=[conv_pool])[0]
+    assert out.shape[0] == 2 and out.shape[1] == 4
+
+    m = pt.metrics.Accuracy()
+    m.update(value=0.5, weight=10)
+    m.update(value=1.0, weight=10)
+    assert abs(m.eval() - 0.75) < 1e-6

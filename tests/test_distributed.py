@@ -155,3 +155,25 @@ def test_hybrid_mesh_single_host_falls_back():
     mesh = make_hybrid_mesh(dp=-1, tp=2)
     assert mesh.shape["tp"] == 2
     assert mesh.devices.size == len(jax.devices())
+
+
+@pytest.mark.slow
+def test_hybrid_mesh_multi_process():
+    """Drive make_hybrid_mesh's multi-host branch: 2 processes x 4 CPU
+    devices, dp over DCN (processes), tp inside each process."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--backend", "cpu",
+         "--devices_per_proc", "4",
+         os.path.join(REPO, "tests", "hybrid_mesh_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    results = [json.loads(l) for l in out.stdout.splitlines()
+               if l.startswith("{")]
+    assert len(results) == 2
+    for r in results:
+        assert r["shape"]["tp"] == 2 and r["shape"]["dp"] == 4
+        assert r["sum"] == 4.0  # 8 devices / tp2 / 2 procs = 2 rows per proc x2
