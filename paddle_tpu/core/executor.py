@@ -207,8 +207,10 @@ class Executor:
             from ..ps.server import ParameterServer
 
             a = ops0[0].attrs
-            server = ParameterServer(a["endpoint"], int(a["num_trainers"]),
-                                     mode=a.get("mode", "sync"))
+            server = ParameterServer(
+                a["endpoint"], int(a["num_trainers"]),
+                mode=a.get("mode", "sync"),
+                dc_asgd_lambda=float(a.get("dc_asgd_lambda", 0.0)))
             server.serve_forever()  # blocks until shutdown request
             return []
 

@@ -81,7 +81,8 @@ class PSClient:
 
     def pull(self, name: str) -> np.ndarray:
         out = self._call(name, {"op": "get", "name": name,
-                                "generation": self.generation})
+                                "generation": self.generation,
+                                "trainer_id": self.trainer_id})
         return np.asarray(out["value"])
 
     def send_barrier(self):

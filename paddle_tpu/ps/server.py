@@ -83,11 +83,17 @@ class _VarState:
 class ParameterServer:
     """One endpoint's server. mode: 'sync' | 'async' | 'geo'."""
 
-    def __init__(self, endpoint: str, num_trainers: int, mode: str = "sync"):
+    def __init__(self, endpoint: str, num_trainers: int, mode: str = "sync",
+                 dc_asgd_lambda: float = 0.0):
         self.host, port = endpoint.rsplit(":", 1)
         self.port = int(port)
         self.num_trainers = num_trainers
         self.mode = mode
+        # DC-ASGD (reference: distribute_transpiler.py:2050
+        # _append_dc_asgd_ops): async staleness compensation
+        # g' = g + λ·g⊙g⊙(w_now - w_at_pull); per-trainer pull snapshots
+        self.dc_lambda = float(dc_asgd_lambda)
+        self._pull_snapshots: Dict[tuple, np.ndarray] = {}
         self.vars: Dict[str, _VarState] = {}
         self.aux: Dict[str, np.ndarray] = {}   # optimizer accumulators
         self.monitor = HeartBeatMonitor(num_trainers)
@@ -164,9 +170,13 @@ class ParameterServer:
                             f"{self._generation} < requested {gen} (a peer "
                             f"trainer is likely dead or wedged)"}
             with vs.lock:
+                if self.mode == "async" and self.dc_lambda > 0.0:
+                    self._pull_snapshots[(msg.get("trainer_id", 0),
+                                          msg["name"])] = vs.value.copy()
                 return {"value": vs.value}
         if op == "send_grad":
-            self.monitor.beat(msg.get("trainer_id", 0))
+            tid = msg.get("trainer_id", 0)
+            self.monitor.beat(tid)
             name = msg["name"]
             vs = self.vars.get(name)
             if vs is None:
@@ -174,6 +184,11 @@ class ParameterServer:
             grad = np.asarray(msg["grad"])
             if self.mode == "async":
                 with vs.lock:
+                    if self.dc_lambda > 0.0:
+                        bak = self._pull_snapshots.get((tid, name))
+                        if bak is not None:
+                            grad = grad + self.dc_lambda * grad * grad * \
+                                (vs.value - bak)
                     self._run_opt(vs, name, grad)
             else:  # sync: accumulate until barrier
                 with vs.lock:

@@ -32,6 +32,10 @@ class DistributeTranspilerConfig:
     sync_mode: bool = True
     geo_sgd_mode: bool = False
     geo_sgd_need_push_nums: int = 100
+    # DC-ASGD staleness compensation in async mode (reference: the
+    # enable_dc_asgd trainer flag feeding _append_dc_asgd_ops)
+    enable_dc_asgd: bool = False
+    dc_asgd_lambda: float = 0.04
 
 
 class DistributeTranspiler:
@@ -135,6 +139,8 @@ class DistributeTranspiler:
                    "num_trainers": self._trainers,
                    "mode": ("sync" if self._sync_mode else
                             ("geo" if self.config.geo_sgd_mode else "async")),
+                   "dc_asgd_lambda": (self.config.dc_asgd_lambda
+                                      if self.config.enable_dc_asgd else 0.0),
                    "params": placed}))
         prog._rebuild_from_desc()
         return prog

@@ -265,3 +265,64 @@ def test_sync_ps_with_grad_clip_inproc(rng=np.random.RandomState(11)):
                   for _ in range(10)]
     assert losses[-1] < losses[0], losses
     server.stop()
+
+
+def test_dc_asgd_compensates_staleness():
+    """DC-ASGD (reference: distribute_transpiler.py:2050): with the param
+    having moved since the trainer pulled, the applied gradient gets the
+    lambda*g^2*(w_now - w_pull) correction."""
+    from paddle_tpu.ps import ParameterServer, PSClient
+
+    (port,) = _free_ports(1)
+    server = ParameterServer(f"127.0.0.1:{port}", num_trainers=2,
+                             mode="async", dc_asgd_lambda=0.1)
+    server.start_background()
+    sgd_desc = [{"type": "sgd",
+                 "inputs": {"Param": ["w"], "Grad": ["w@GRAD"],
+                            "LearningRate": ["lr"]},
+                 "outputs": {"ParamOut": ["w"]}, "attrs": {}}]
+    c0 = PSClient([f"127.0.0.1:{port}"], trainer_id=0)
+    c1 = PSClient([f"127.0.0.1:{port}"], trainer_id=1)
+    c0.init_var("w", np.zeros(2, np.float32), sgd_desc)
+    c0.init_aux("lr", np.array([1.0], np.float32), owner="w")
+
+    w0 = c0.pull("w")          # trainer 0 snapshots w = [0, 0]
+    # trainer 1 moves the param first: w -> [ -1, -1 ]
+    c1.pull("w")
+    c1.push_grad("w", np.ones(2, np.float32))
+    # trainer 1 pulls AFTER the move — its snapshot is [-1,-1], distinct
+    # from trainer 0's [0,0] (per-trainer keying regression check)
+    c1.pull("w")
+    # trainer 0 pushes a stale gradient g=[2,2]; compensation adds
+    # lambda*g^2*(w_now - w_pull) = 0.1*4*(-1-0) = -0.4 -> g'=[1.6,1.6]
+    c0.push_grad("w", np.full(2, 2.0, np.float32))
+    w = c0.pull("w")
+    np.testing.assert_allclose(w, np.full(2, -1.0 - 1.6, np.float32),
+                               rtol=1e-5)
+    # trainer 1's fresh snapshot was [-1,-1]: its next grad g=[1,1] gets
+    # compensation 0.1*1*(-2.6-(-1)) = -0.16 -> applied g'=[0.84,0.84]
+    c1.push_grad("w", np.ones(2, np.float32))
+    np.testing.assert_allclose(c1.pull("w"),
+                               np.full(2, -2.6 - 0.84, np.float32), rtol=1e-5)
+    server.stop()
+
+
+def test_dc_asgd_wired_through_transpiler():
+    import paddle_tpu as pt
+    from paddle_tpu.ps import DistributeTranspiler, DistributeTranspilerConfig
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        loss = pt.layers.mean(pt.layers.fc(input=x, size=1))
+        pt.optimizer.SGD(0.1).minimize(loss)
+    cfg = DistributeTranspilerConfig()
+    cfg.sync_mode = False
+    cfg.enable_dc_asgd = True
+    t = DistributeTranspiler(cfg)
+    t.transpile(0, program=main, pservers="127.0.0.1:1", trainers=2,
+                sync_mode=False)
+    prog = t.get_pserver_program("127.0.0.1:1")
+    attrs = prog.global_block().desc.ops[0].attrs
+    assert attrs["mode"] == "async"
+    assert attrs["dc_asgd_lambda"] == 0.04
