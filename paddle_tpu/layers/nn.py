@@ -13,7 +13,8 @@ from ..layer_helper import LayerHelper
 from ..initializer import ConstantInitializer, NormalInitializer, XavierInitializer
 
 __all__ = [
-    "fc", "embedding", "conv2d", "conv3d", "conv2d_transpose",
+    "fc", "embedding", "distributed_embedding", "conv2d", "conv3d",
+    "conv2d_transpose",
     "depthwise_conv2d", "pool2d", "pool3d", "adaptive_pool2d", "batch_norm",
     "layer_norm", "group_norm", "instance_norm", "l2_normalize", "dropout",
     "softmax", "log_softmax", "matmul", "mul", "topk", "one_hot", "reshape",
@@ -59,11 +60,34 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     return helper.append_activation(pre_act, act)
 
 
+def distributed_embedding(input, size, table_name, sparse_lr=0.01,
+                          dtype="float32", name=None):
+    """Embedding whose table lives row-sharded on pservers (reference:
+    distributed_lookup_table_op + parameter_prefetch). Rows prefetch in the
+    forward; sparse SGD gradients push server-side in the backward. The
+    table is created with ps.sparse_table.init_sparse_table; `size` is
+    (vocab, dim). A trainable scalar shadow ties the remote table into the
+    autodiff graph."""
+    helper = LayerHelper("distributed_embedding", name=name)
+    shadow = helper.create_parameter(
+        None, shape=[1], dtype=dtype, is_bias=False,
+        default_initializer=ConstantInitializer(0.0))
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="distributed_lookup_table",
+        inputs={"Ids": input, "Shadow": shadow},
+        outputs={"Out": out},
+        attrs={"table_name": table_name, "emb_dim": int(size[1]),
+               "sparse_lr": float(sparse_lr), "dtype": str(dtype)})
+    return out
+
+
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32"):
     """reference: layers/nn.py `embedding` → lookup_table_op. is_sparse
     selects SelectedRows grads in the reference; on TPU dense scatter-add
-    grads are MXU/HBM-friendly, and the PS path handles truly huge tables."""
+    grads are MXU/HBM-friendly, and the PS path handles truly huge tables
+    (see distributed_embedding)."""
     helper = LayerHelper("embedding", param_attr=param_attr)
     w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype)
     out = helper.create_variable_for_type_inference(dtype)

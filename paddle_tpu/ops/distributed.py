@@ -104,6 +104,63 @@ def ps_recv(ins, attrs, ctx):
     return {"Out": val}
 
 
+def _dlt_grad(ins, attrs, ctx):
+    """Backward of distributed_lookup_table: push the sparse row gradients
+    straight to the owning pservers (the async sparse-SGD update of the
+    reference's table optimize block). The differentiable `Shadow` scalar
+    exists only so the backward pass emits this op (the table itself is
+    remote); its returned gradient is zero."""
+    from ..core.registry import GRAD_PREFIX_IG, GRAD_PREFIX_IN, GRAD_PREFIX_OG
+
+    name = attrs["table_name"]
+    lr = float(attrs.get("sparse_lr", 0.01))
+    ids = ins[GRAD_PREFIX_IN + "Ids"][0]
+    og = ins[GRAD_PREFIX_OG + "Out"][0]
+
+    def _push(ids_v, g_v):
+        from ..ps.sparse_table import push_row_grads
+
+        push_row_grads(get_client(), name, np.asarray(ids_v),
+                       np.asarray(g_v, np.float32), lr)
+        return np.zeros((), np.int32)
+
+    token = jax.experimental.io_callback(
+        _push, jax.ShapeDtypeStruct((), jnp.int32), ids, og, ordered=True)
+    shadow = ins[GRAD_PREFIX_IN + "Shadow"][0]
+    # tie the push token into the returned grad so it can't be pruned
+    return {GRAD_PREFIX_IG + "Shadow": [
+        jnp.zeros_like(shadow) + token.astype(shadow.dtype) * 0]}
+
+
+@register_op("distributed_lookup_table", grad=_dlt_grad,
+             nondiff_inputs=("Ids",))
+def distributed_lookup_table(ins, attrs, ctx):
+    """reference: distributed_ops/distributed_lookup_table_op.cc — prefetch
+    the touched rows of a pserver-sharded embedding (parameter_prefetch.cc).
+    """
+    name = attrs["table_name"]
+    dim = int(attrs["emb_dim"])
+    dtype = np.dtype(attrs.get("dtype", "float32"))
+    ids = ins["Ids"][0]
+
+    def _pull(ids_v):
+        from ..ps.sparse_table import pull_rows
+
+        return pull_rows(get_client(), name, np.asarray(ids_v),
+                         dim=dim).astype(dtype)
+
+    flat_n = 1
+    for s in ids.shape:
+        flat_n *= s
+    rows = jax.experimental.io_callback(
+        _pull, jax.ShapeDtypeStruct((flat_n, dim), dtype), ids,
+        ordered=True)
+    out = rows.reshape(tuple(ids.shape) + (dim,))
+    if ins.get("Shadow") and ins["Shadow"][0] is not None:
+        out = out + ins["Shadow"][0].astype(out.dtype) * 0
+    return {"Out": out}
+
+
 @register_op("listen_and_serv", grad=None)
 def listen_and_serv(ins, attrs, ctx):
     raise RuntimeError(

@@ -226,6 +226,10 @@ class ParameterServer:
             if vs is None:
                 return {"error": f"unknown var {msg['name']}"}
             ids = np.asarray(msg["ids"]).reshape(-1)
+            if ids.size and (ids.min() < 0 or ids.max() >= len(vs.value)):
+                return {"error": f"sparse id out of range for "
+                                 f"{msg['name']}: [{ids.min()}, {ids.max()}] "
+                                 f"vs {len(vs.value)} local rows"}
             with vs.lock:  # torn reads vs concurrent push_sparse_grad
                 return {"rows": vs.value[ids].copy()}
         if op == "push_sparse_grad":
@@ -233,6 +237,10 @@ class ParameterServer:
             if vs is None:
                 return {"error": f"unknown var {msg['name']}"}
             ids = np.asarray(msg["ids"]).reshape(-1)
+            if ids.size and (ids.min() < 0 or ids.max() >= len(vs.value)):
+                return {"error": f"sparse id out of range for "
+                                 f"{msg['name']}: [{ids.min()}, {ids.max()}] "
+                                 f"vs {len(vs.value)} local rows"}
             grads = np.asarray(msg["grads"])
             lr = float(msg.get("lr", 0.01))
             with vs.lock:

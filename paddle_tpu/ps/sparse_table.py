@@ -1,0 +1,89 @@
+"""Distributed lookup table — embeddings sharded across pservers by row.
+
+Reference: operators/distributed_ops/distributed_lookup_table_op.cc +
+distributed/parameter_prefetch.cc (+ split_ids/merge_ids ops): huge
+embedding tables live row-sharded on pservers; trainers prefetch the rows a
+batch touches and push sparse gradients back.
+
+Row placement is mod-sharding: global row r lives on server r % S at local
+index r // S (the reference's round-robin row split). The trainer-side ops
+(ops/distributed.py distributed_lookup_table) call these helpers through
+io_callbacks, so prefetch/push happen at the op's program point under jit.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import List
+
+import numpy as np
+
+from .client import PSClient
+
+
+def init_sparse_table(client: PSClient, name: str, table: np.ndarray):
+    """Split [V, D] rows across all servers (trainer 0 at startup)."""
+    S = len(client.endpoints)
+    for k, ep in enumerate(client.endpoints):
+        shard = np.ascontiguousarray(table[k::S])
+        client._conns[ep].call({"op": "init_var", "name": name,
+                                "value": shard, "opt_descs": [],
+                                "grad_name": None})
+
+
+def pull_rows(client: PSClient, name: str, ids: np.ndarray,
+              dim: int = 0) -> np.ndarray:
+    """Gather rows for flat int ids from their owning servers; the
+    per-server RPCs fan out concurrently (reference: parameter_prefetch
+    issues section RPCs in parallel)."""
+    ids = np.asarray(ids).reshape(-1)
+    S = len(client.endpoints)
+    if ids.size == 0:
+        return np.zeros((0, dim), np.float32)
+
+    def fetch(k_ep):
+        k, ep = k_ep
+        mask = (ids % S) == k
+        if not mask.any():
+            return None
+        resp = client._conns[ep].call(
+            {"op": "pull_sparse", "name": name, "ids": ids[mask] // S})
+        if "error" in resp:
+            raise RuntimeError(f"pserver: {resp['error']}")
+        return mask, np.asarray(resp["rows"])
+
+    out = None
+    with ThreadPoolExecutor(max_workers=S) as pool:
+        for r in pool.map(fetch, enumerate(client.endpoints)):
+            if r is None:
+                continue
+            mask, rows = r
+            if out is None:
+                out = np.empty((ids.size, rows.shape[-1]), rows.dtype)
+            out[mask] = rows
+    return out
+
+
+def push_row_grads(client: PSClient, name: str, ids: np.ndarray,
+                   grads: np.ndarray, lr: float):
+    """Sparse SGD push: rows[ids] -= lr * grads, grouped per owner.
+    Duplicate ids accumulate (np.subtract.at server-side)."""
+    ids = np.asarray(ids).reshape(-1)
+    if ids.size == 0:
+        return
+    grads = np.asarray(grads).reshape(ids.size, -1)
+    S = len(client.endpoints)
+
+    def push(k_ep):
+        k, ep = k_ep
+        mask = (ids % S) == k
+        if not mask.any():
+            return
+        resp = client._conns[ep].call(
+            {"op": "push_sparse_grad", "name": name,
+             "ids": ids[mask] // S, "grads": grads[mask], "lr": lr})
+        if "error" in resp:
+            raise RuntimeError(f"pserver: {resp['error']}")
+
+    with ThreadPoolExecutor(max_workers=S) as pool:
+        list(pool.map(push, enumerate(client.endpoints)))

@@ -326,3 +326,56 @@ def test_dc_asgd_wired_through_transpiler():
     attrs = prog.global_block().desc.ops[0].attrs
     assert attrs["mode"] == "async"
     assert attrs["dc_asgd_lambda"] == 0.04
+
+
+def test_distributed_embedding_end_to_end():
+    """Distributed lookup table (reference: distributed_lookup_table_op +
+    parameter_prefetch): table row-sharded over TWO servers, prefetched in
+    the forward, sparse-SGD updated server-side by the backward."""
+    import paddle_tpu as pt
+    from paddle_tpu.ops.distributed import bind_client
+    from paddle_tpu.ps import ParameterServer, PSClient
+    from paddle_tpu.ps.sparse_table import init_sparse_table, pull_rows
+
+    p1, p2 = _free_ports(2)
+    eps = [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"]
+    servers = [ParameterServer(ep, num_trainers=1, mode="async")
+               for ep in eps]
+    for s in servers:
+        s.start_background()
+    client = PSClient(eps)
+    bind_client(client)
+    rng = np.random.RandomState(0)
+    V, D = 20, 8
+    table = rng.rand(V, D).astype("float32") * 0.1
+    init_sparse_table(client, "emb_table", table)
+
+    # mod-sharded pull reassembles exactly
+    ids = np.array([0, 1, 5, 13, 19])
+    np.testing.assert_allclose(pull_rows(client, "emb_table", ids),
+                               table[ids], rtol=1e-6)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        w = pt.layers.data(name="w", shape=[1], dtype="int64")
+        label = pt.layers.data(name="label", shape=[1], dtype="float32")
+        emb = pt.layers.distributed_embedding(w, (V, D), "emb_table",
+                                              sparse_lr=0.5)
+        emb = pt.layers.reshape(emb, shape=[-1, D])
+        pred = pt.layers.fc(input=emb, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred,
+                                                          label=label))
+        pt.optimizer.SGD(0.1).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    W = rng.randint(0, V, (16, 1)).astype("int64")
+    Y = (W % 2).astype("float32")
+    losses = [float(np.asarray(exe.run(main, feed={"w": W, "label": Y},
+                                       fetch_list=[loss])[0]).reshape(()))
+              for _ in range(20)]
+    assert losses[-1] < losses[0], losses[:3] + losses[-3:]
+    # table rows actually moved server-side
+    after = pull_rows(client, "emb_table", np.unique(W))
+    assert not np.allclose(after, table[np.unique(W.reshape(-1))])
+    for s in servers:
+        s.stop()
