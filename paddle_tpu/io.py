@@ -86,7 +86,14 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
             if v.name in data:
                 scope.set_var(v.name, data[v.name])
         return
+    # weight-only-quantized models store <w>@INT8/<w>@SCALE pairs
+    from .slim.quantization import load_quantized_vars
+
+    quantized = load_quantized_vars(dirname, names=[v.name for v in vars])
     for v in vars:
+        if v.name in quantized:
+            scope.set_var(v.name, quantized[v.name])
+            continue
         path = os.path.join(dirname, v.name.replace("/", "%2F") + ".npy")
         if os.path.exists(path):
             scope.set_var(v.name, np.load(path))

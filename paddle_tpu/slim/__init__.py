@@ -1,0 +1,7 @@
+"""Model compression (reference: python/paddle/fluid/contrib/slim — the
+quantization/pruning/NAS/distillation toolkit, SURVEY §2.4). Round-1 scope:
+post-training quantization for inference."""
+
+from .quantization import (  # noqa: F401
+    quantize_inference_model, PostTrainingQuantization,
+)

@@ -1,0 +1,98 @@
+"""Post-training quantization tests (reference: contrib/slim/tests —
+INT8 post-training quantization of saved inference models)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.slim import quantize_inference_model
+
+
+def test_weight_only_int8_roundtrip(tmp_path, rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[1, 12, 12], dtype="float32")
+        c = pt.layers.conv2d(input=x, num_filters=6, filter_size=3, act="relu")
+        pred = pt.layers.fc(input=c, size=4, act="softmax")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(3, 1, 12, 12).astype("float32")
+    ref = exe.run(main, feed={"x": X}, fetch_list=[pred])[0]
+    d = str(tmp_path / "model")
+    pt.io.save_inference_model(d, ["x"], [pred], exe, main_program=main)
+
+    ratios = quantize_inference_model(d)
+    assert ratios, "no weights quantized"
+    assert all(r > 2.5 for r in ratios.values()), ratios  # ~4x at scale
+    # the original float weights are gone, int8+scale remain
+    files = os.listdir(d)
+    assert any(f.endswith("@INT8.npy") for f in files)
+    assert not any(f == n + ".npy" for n in ratios for f in files)
+
+    # quantized model loads transparently and stays close to the original
+    with pt.scope_guard(pt.Scope()):
+        prog, feeds, fetches = pt.io.load_inference_model(d, exe)
+        out = exe.run(prog, feed={feeds[0]: X}, fetch_list=fetches)[0]
+    np.testing.assert_allclose(out, ref, atol=0.03)  # int8 weight error
+    # and through the Predictor API
+    predictor = pt.create_paddle_predictor(pt.AnalysisConfig(d))
+    out2 = list(predictor.predict(x=X).values())[0]
+    np.testing.assert_allclose(out2, out, atol=1e-5)
+
+
+def test_quantize_to_new_dir(tmp_path, rng):
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[8], dtype="float32")
+        pred = pt.layers.fc(input=x, size=2)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    src = str(tmp_path / "fp32")
+    dst = str(tmp_path / "int8")
+    pt.io.save_inference_model(src, ["x"], [pred], exe, main_program=main)
+    quantize_inference_model(src, dst)
+    # source untouched, destination quantized
+    assert any(f.endswith("@INT8.npy") for f in os.listdir(dst))
+    assert not any(f.endswith("@INT8.npy") for f in os.listdir(src))
+
+
+def test_requantize_keeps_model_loadable(tmp_path, rng):
+    """Re-quantizing must not clobber __quant_meta__ (regression)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[6], dtype="float32")
+        pred = pt.layers.fc(input=x, size=3)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(2, 6).astype("float32")
+    ref = exe.run(main, feed={"x": X}, fetch_list=[pred])[0]
+    d = str(tmp_path / "m")
+    pt.io.save_inference_model(d, ["x"], [pred], exe, main_program=main)
+    assert quantize_inference_model(d)
+    assert quantize_inference_model(d) == {}  # idempotent
+    with pt.scope_guard(pt.Scope()):
+        prog, feeds, fetches = pt.io.load_inference_model(d, exe)
+        out = exe.run(prog, feed={feeds[0]: X}, fetch_list=fetches)[0]
+    np.testing.assert_allclose(out, ref, atol=0.03)
+
+
+def test_quantize_slash_named_weights(tmp_path, rng):
+    """save_vars mangles '/' to %2F; quantization must follow (regression)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[6], dtype="float32")
+        pred = pt.layers.fc(input=x, size=3,
+                            param_attr=pt.ParamAttr(name="scope/w"))
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    d = str(tmp_path / "m")
+    pt.io.save_inference_model(d, ["x"], [pred], exe, main_program=main)
+    ratios = quantize_inference_model(d)
+    assert "scope/w" in ratios
+    X = rng.rand(2, 6).astype("float32")
+    with pt.scope_guard(pt.Scope()):
+        prog, feeds, fetches = pt.io.load_inference_model(d, exe)
+        out = exe.run(prog, feed={feeds[0]: X}, fetch_list=fetches)[0]
+    assert np.isfinite(out).all()
