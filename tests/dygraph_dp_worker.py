@@ -1,0 +1,51 @@
+"""Dygraph DataParallel worker (reference pattern:
+parallel_dygraph_mnist.py run under test_dist_base): each process trains an
+eager Linear on its shard with grad allreduce; prints final weights."""
+
+import json
+import os
+
+import numpy as np
+
+import jax
+
+if os.environ.get("PADDLE_TPU_FORCE_CPU"):
+    jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as pt
+from paddle_tpu.parallel import PaddleCloudRoleMaker, fleet
+
+
+def main():
+    fleet.init(PaddleCloudRoleMaker())  # jax.distributed bootstrap
+    rank = fleet.worker_index()
+    n = fleet.worker_num()
+
+    rng = np.random.RandomState(9)
+    X = rng.rand(32, 6).astype("float32")
+    Y = (X @ rng.rand(6, 1)).astype("float32")
+    lo = rank * (32 // n)
+    Xs, Ys = X[lo:lo + 32 // n], Y[lo:lo + 32 // n]
+
+    with pt.dygraph.guard():
+        linear = pt.dygraph.nn.Linear(6, 1)
+        linear.weight.set_value(np.full((6, 1), 0.1, "float32"))
+        linear.bias.set_value(np.zeros(1, "float32"))
+        model = pt.dygraph.DataParallel(linear)
+        opt = pt.optimizer.SGD(learning_rate=0.1)
+        for _ in range(10):
+            pred = model(pt.dygraph.to_variable(Xs))
+            loss = pt.layers.mean(pt.layers.square_error_cost(
+                input=pred, label=pt.dygraph.to_variable(Ys)))
+            loss = model.scale_loss(loss)
+            loss.backward()
+            model.apply_collective_grads()
+            opt.minimize(loss, parameter_list=model.parameters())
+            linear.clear_gradients()
+    print(json.dumps({"rank": rank,
+                      "w": np.asarray(linear.weight.numpy()).ravel().tolist(),
+                      "b": np.asarray(linear.bias.numpy()).ravel().tolist()}))
+
+
+if __name__ == "__main__":
+    main()

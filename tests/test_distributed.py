@@ -177,3 +177,35 @@ def test_hybrid_mesh_multi_process():
     for r in results:
         assert r["shape"]["tp"] == 2 and r["shape"]["dp"] == 4
         assert r["sum"] == 4.0  # 8 devices / tp2 / 2 procs = 2 rows per proc x2
+
+
+@pytest.mark.slow
+def test_dygraph_data_parallel_matches_single():
+    """reference: test_dist_base with parallel_dygraph_* — 2-process eager
+    DataParallel must match single-process full-batch training."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--backend", "cpu",
+         "--devices_per_proc", "1",
+         os.path.join(REPO, "tests", "dygraph_dp_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    res = [json.loads(l) for l in out.stdout.splitlines() if l.startswith("{")]
+    assert len(res) == 2
+    np.testing.assert_allclose(res[0]["w"], res[1]["w"], rtol=1e-5)
+
+    env1 = dict(env)
+    env1.update({"JAX_PLATFORMS": "cpu", "PADDLE_TPU_FORCE_CPU": "1",
+                 "PADDLE_TRAINER_ID": "0", "PADDLE_TRAINERS_NUM": "1",
+                 "PADDLE_TRAINER_ENDPOINTS": ""})
+    single = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tests", "dygraph_dp_worker.py")],
+        env=env1, capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert single.returncode == 0, single.stdout + single.stderr
+    sres = json.loads([l for l in single.stdout.splitlines()
+                       if l.startswith("{")][-1])
+    np.testing.assert_allclose(sres["w"], res[0]["w"], rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(sres["b"], res[0]["b"], rtol=1e-4, atol=1e-6)
