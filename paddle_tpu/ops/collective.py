@@ -129,3 +129,32 @@ def c_embedding(ins, attrs, ctx):
     safe = jnp.clip(idx, 0, w.shape[0] - 1)
     out = jnp.take(w, safe, axis=0)
     return {"Out": jnp.where(valid[..., None], out, 0.0)}
+
+
+def sparse_allreduce(flat, k: int, axis: str):
+    """Top-k (value,index) allgather + local decode — the reference's
+    sparseAllGReduce (details/sparse_all_reduce_op_handle.cc). Values and
+    bitcast int32 indices pack into ONE [2,k] buffer so a single collective
+    runs per tensor; 2k elements on the wire instead of the dense size."""
+    k = min(int(k), flat.size)  # tiny tensors (biases) carry fewer entries
+    _, idx = jax.lax.top_k(jnp.abs(flat), k)
+    # pack in f32: a narrower dtype would corrupt the bitcast index bits
+    vals = flat[idx].astype(jnp.float32)
+    idx_bits = jax.lax.bitcast_convert_type(idx.astype(jnp.int32),
+                                            jnp.float32)
+    packed = jnp.stack([vals, idx_bits])                  # [2, k]
+    gathered = jax.lax.all_gather(packed, axis)           # [nranks, 2, k]
+    all_vals = gathered[:, 0].reshape(-1).astype(flat.dtype)
+    all_idx = jax.lax.bitcast_convert_type(
+        gathered[:, 1], jnp.int32).reshape(-1)
+    return jnp.zeros_like(flat).at[all_idx].add(all_vals)
+
+
+@register_op("c_dgc_allreduce", grad=None, infer_shape=_same_shape_infer)
+def c_dgc_allreduce(ins, attrs, ctx):
+    """Standalone DGC sparse-allreduce collective over a sparsified tensor
+    (see sparse_allreduce); grad=None like the other nonlinear collectives."""
+    x = ins["X"][0]
+    flat = x.reshape(-1)
+    k = int(attrs.get("k", max(1, flat.size // 1000)))
+    return {"Out": sparse_allreduce(flat, k, _axis(attrs)).reshape(x.shape)}

@@ -212,7 +212,26 @@ def dpsgd(ins, attrs, ctx):
 # details/sparse_all_reduce_op_handle.cc:44; paper arxiv 1712.01887) --------
 
 
-@register_op("dgc_momentum", grad=None)
+def _dgc_infer(op, input_descs):
+    """Static: every output mirrors Param's shape/dtype (eval_shape would
+    trace the sparse allreduce outside shard_map and hit the unbound axis)."""
+    import jax
+    import numpy as np
+
+    from ..core.ir import normalize_dtype
+
+    p = input_descs[op.inputs["Param"][0]]
+    sds = jax.ShapeDtypeStruct(tuple(p.shape or ()),
+                               np.dtype(normalize_dtype(p.dtype)))
+    out = {}
+    for slot in ("ParamOut", "UOut", "VOut", "GradOut"):
+        for n in op.outputs.get(slot, []):
+            if n:
+                out[n] = sds
+    return out
+
+
+@register_op("dgc_momentum", grad=None, infer_shape=_dgc_infer)
 def dgc_momentum(ins, attrs, ctx):
     """Top-k sparsified momentum step. On TPU the sparse allgather of the
     reference (sparseAllGReduce) is replaced by dense psum of the sparsified
@@ -233,5 +252,13 @@ def dgc_momentum(ins, attrs, ctx):
     sparse_grad = jnp.where(mask, v_new, 0.0)
     u_out = jnp.where(mask, 0.0, u_new)
     v_out = jnp.where(mask, 0.0, v_new)
+    axis = attrs.get("axis_name")
+    if axis:
+        # under shard_map (SPMDRunner): sparse-allgather the compressed
+        # grads BEFORE the update so all ranks apply the reduced gradient
+        from .collective import sparse_allreduce
+
+        sparse_grad = sparse_allreduce(
+            sparse_grad.reshape(-1), k, axis).reshape(sparse_grad.shape)
     return {"ParamOut": p - lr * sparse_grad, "UOut": u_out, "VOut": v_out,
             "GradOut": sparse_grad}

@@ -607,10 +607,14 @@ class DGCMomentumOptimizer(MomentumOptimizer):
 
     def __init__(self, learning_rate, momentum, rampup_begin_step=0,
                  rampup_step=1, sparsity=(0.999,), use_nesterov=False,
-                 local_grad_clip_norm=None, num_trainers=None, **kw):
+                 local_grad_clip_norm=None, num_trainers=None,
+                 axis_name=None, **kw):
         super().__init__(learning_rate, momentum, use_nesterov, **kw)
         self._sparsity = list(sparsity)
         self._rampup_begin_step = rampup_begin_step
+        # mesh axis for the sparse allreduce when the program runs under
+        # SPMDRunner (None = single-device/GSPMD: compression only)
+        self._axis_name = axis_name
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -630,7 +634,8 @@ class DGCMomentumOptimizer(MomentumOptimizer):
                     "LearningRate": self._create_param_lr(param_and_grad)},
             outputs={"ParamOut": p, "UOut": u, "VOut": v, "GradOut": sparse_out},
             attrs={"mu": self._momentum,
-                   "sparsity_ratio": 1.0 - self._sparsity[-1]})
+                   "sparsity_ratio": 1.0 - self._sparsity[-1],
+                   "axis_name": self._axis_name})
 
 
 # ---------------------------------------------------------------------------
