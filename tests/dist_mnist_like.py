@@ -52,7 +52,10 @@ def main():
         l = exe.run(prog, feed={"x": X[lo:hi], "y": Y[lo:hi]},
                     fetch_list=[loss])[0]
         losses.append(float(np.asarray(l).reshape(())))
-    print(json.dumps({"rank": rank, "losses": losses}))
+    # single atomic write: launch workers share the parent's stdout pipe and
+    # print() emits text and newline separately, which can interleave
+    sys.stdout.write(json.dumps({"rank": rank, "losses": losses}) + "\n")
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

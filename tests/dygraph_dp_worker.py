@@ -4,6 +4,7 @@ eager Linear on its shard with grad allreduce; prints final weights."""
 
 import json
 import os
+import sys
 
 import numpy as np
 
@@ -42,9 +43,12 @@ def main():
             model.apply_collective_grads()
             opt.minimize(loss, parameter_list=model.parameters())
             linear.clear_gradients()
-    print(json.dumps({"rank": rank,
-                      "w": np.asarray(linear.weight.numpy()).ravel().tolist(),
-                      "b": np.asarray(linear.bias.numpy()).ravel().tolist()}))
+    # single atomic write so concurrent workers' lines never interleave
+    sys.stdout.write(json.dumps(
+        {"rank": rank,
+         "w": np.asarray(linear.weight.numpy()).ravel().tolist(),
+         "b": np.asarray(linear.bias.numpy()).ravel().tolist()}) + "\n")
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

@@ -3,6 +3,7 @@ psum over it (exercises make_hybrid_mesh's multi-host branch)."""
 
 import json
 import os
+import sys
 
 import jax
 
@@ -25,8 +26,12 @@ def main():
         NamedSharding(mesh, P("dp")),
         jnp.ones((n // mesh.shape["tp"] // jax.process_count(),)))
     total = float(jax.jit(lambda v: v.sum(), out_shardings=NamedSharding(mesh, P()))(x))
-    print(json.dumps({"rank": fleet.worker_index(),
-                      "shape": dict(mesh.shape), "sum": total}))
+    # single atomic write: launch workers share the parent's stdout pipe and
+    # print() emits text and newline separately, which can interleave
+    sys.stdout.write(json.dumps({"rank": fleet.worker_index(),
+                                 "shape": dict(mesh.shape),
+                                 "sum": total}) + "\n")
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

@@ -7,6 +7,7 @@ Trainers print JSON losses on the last line."""
 
 import json
 import os
+import sys
 
 import numpy as np
 
@@ -86,8 +87,10 @@ def main():
         # shut down only after every trainer reported COMPLETED
         assert client.wait_all_completed(timeout=120)
         client.shutdown_servers()
-    print(json.dumps({"rank": trainer_id, "losses": losses,
-                      "params": params}))
+    # single atomic write so concurrent workers' lines never interleave
+    sys.stdout.write(json.dumps({"rank": trainer_id, "losses": losses,
+                                 "params": params}) + "\n")
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

@@ -21,6 +21,19 @@ from paddle_tpu.parallel.role_maker import Role, UserDefinedRoleMaker
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def _json_objs(text):
+    """Parse every JSON object in worker stdout, tolerating two workers'
+    objects landing on one line (they share the parent's stdout pipe)."""
+    dec, objs = json.JSONDecoder(), []
+    for line in text.splitlines():
+        line = line.strip()
+        while line.startswith("{"):
+            obj, end = dec.raw_decode(line)
+            objs.append(obj)
+            line = line[end:].lstrip()
+    return objs
+
+
 def _build(seed=5):
     main, startup = pt.Program(), pt.Program()
     main.random_seed = startup.random_seed = seed
@@ -123,8 +136,7 @@ def test_multiprocess_launch_loss_parity():
          os.path.join(REPO, "tests", "dist_mnist_like.py")],
         env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
     assert out.returncode == 0, out.stdout + out.stderr
-    results = [json.loads(line) for line in out.stdout.splitlines()
-               if line.startswith("{")]
+    results = _json_objs(out.stdout)
     assert len(results) == 2, out.stdout
     # both workers observe identical (replicated) losses
     np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
@@ -141,8 +153,7 @@ def test_multiprocess_launch_loss_parity():
         [sys.executable, os.path.join(REPO, "tests", "dist_mnist_like.py")],
         env=env1, capture_output=True, text=True, timeout=600, cwd=REPO)
     assert single.returncode == 0, single.stdout + single.stderr
-    sres = [json.loads(line) for line in single.stdout.splitlines()
-            if line.startswith("{")]
+    sres = _json_objs(single.stdout)
     np.testing.assert_allclose(sres[0]["losses"], results[0]["losses"],
                                rtol=1e-3, atol=1e-5)
 
@@ -171,8 +182,7 @@ def test_hybrid_mesh_multi_process():
          os.path.join(REPO, "tests", "hybrid_mesh_worker.py")],
         env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
     assert out.returncode == 0, out.stdout + out.stderr
-    results = [json.loads(l) for l in out.stdout.splitlines()
-               if l.startswith("{")]
+    results = _json_objs(out.stdout)
     assert len(results) == 2
     for r in results:
         assert r["shape"]["tp"] == 2 and r["shape"]["dp"] == 4
@@ -193,7 +203,7 @@ def test_dygraph_data_parallel_matches_single():
          os.path.join(REPO, "tests", "dygraph_dp_worker.py")],
         env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
     assert out.returncode == 0, out.stdout + out.stderr
-    res = [json.loads(l) for l in out.stdout.splitlines() if l.startswith("{")]
+    res = _json_objs(out.stdout)
     assert len(res) == 2
     np.testing.assert_allclose(res[0]["w"], res[1]["w"], rtol=1e-5)
 
@@ -205,8 +215,7 @@ def test_dygraph_data_parallel_matches_single():
         [sys.executable, os.path.join(REPO, "tests", "dygraph_dp_worker.py")],
         env=env1, capture_output=True, text=True, timeout=600, cwd=REPO)
     assert single.returncode == 0, single.stdout + single.stderr
-    sres = json.loads([l for l in single.stdout.splitlines()
-                       if l.startswith("{")][-1])
+    sres = _json_objs(single.stdout)[-1]
     np.testing.assert_allclose(sres["w"], res[0]["w"], rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(sres["b"], res[0]["b"], rtol=1e-4, atol=1e-6)
 
