@@ -26,7 +26,7 @@ __all__ = [
     "relu", "image_resize", "resize_bilinear", "resize_nearest",
     "label_smooth", "pixel_shuffle", "grid_sampler", "shape", "where",
     "cond_output_shape_hint", "unique", "shard_index", "temporal_shift",
-    "squared_l2_norm",
+    "squared_l2_norm", "linear_chain_crf", "crf_decoding", "chunk_eval",
 ]
 
 
@@ -744,6 +744,72 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
     helper.append_op(type="temporal_shift", inputs={"X": x}, outputs={"Out": out},
                      attrs={"seg_num": seg_num, "shift_ratio": shift_ratio})
     return out
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """CRF negative log-likelihood (reference: layers/nn.py:1500 →
+    linear_chain_crf_op). input [N,T,D] emissions, label [N,T]; returns the
+    per-sequence cost [N,1]. The [D+2,D] transition parameter is created
+    here; name it via param_attr to share with crf_decoding."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    d = int(input.shape[-1])
+    transition = helper.create_parameter(param_attr, shape=[d + 2, d],
+                                         dtype=input.dtype)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    alpha = helper.create_variable_for_type_inference(input.dtype)
+    eexp = helper.create_variable_for_type_inference(input.dtype)
+    texp = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Emission": input, "Transition": transition, "Label": label}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(type="linear_chain_crf", inputs=inputs,
+                     outputs={"LogLikelihood": ll, "Alpha": alpha,
+                              "EmissionExps": eexp, "TransitionExps": texp})
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """Viterbi decode with the transition parameter trained by
+    linear_chain_crf (reference: layers/nn.py:1620). With label, returns a
+    0/1 correctness mask instead of the path."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    d = int(input.shape[-1])
+    transition = helper.create_parameter(param_attr, shape=[d + 2, d],
+                                         dtype=input.dtype)
+    path = helper.create_variable_for_type_inference("int64")
+    inputs = {"Emission": input, "Transition": transition}
+    if label is not None:
+        inputs["Label"] = label
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": path})
+    return path
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """Chunk precision/recall/F1 (reference: layers/nn.py:1999 →
+    chunk_eval_op). Returns (precision, recall, f1, num_infer, num_label,
+    num_correct) for the batch."""
+    helper = LayerHelper("chunk_eval")
+    outs = {k: helper.create_variable_for_type_inference(dt)
+            for k, dt in [("Precision", "float32"), ("Recall", "float32"),
+                          ("F1-Score", "float32"),
+                          ("NumInferChunks", "int64"),
+                          ("NumLabelChunks", "int64"),
+                          ("NumCorrectChunks", "int64")]}
+    inputs = {"Inference": input, "Label": label}
+    if seq_length is not None:
+        inputs["SeqLength"] = seq_length
+    helper.append_op(type="chunk_eval", inputs=inputs, outputs=outs,
+                     attrs={"num_chunk_types": num_chunk_types,
+                            "chunk_scheme": chunk_scheme,
+                            "excluded_chunk_types":
+                                list(excluded_chunk_types or [])})
+    return (outs["Precision"], outs["Recall"], outs["F1-Score"],
+            outs["NumInferChunks"], outs["NumLabelChunks"],
+            outs["NumCorrectChunks"])
 
 
 def cond_output_shape_hint(*a, **k):  # placeholder referenced in __all__

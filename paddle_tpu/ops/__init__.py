@@ -19,3 +19,4 @@ from . import rnn
 from . import distributed
 from . import detection
 from . import collective
+from . import crf

@@ -1,0 +1,257 @@
+"""CRF op tests — brute-force enumeration as the oracle.
+
+Reference test pattern: unittests/test_linear_chain_crf_op.py /
+test_crf_decoding_op.py / test_chunk_eval_op.py (numpy references;
+SURVEY §4 OpTest ladder)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_op
+
+
+def _brute_force(emission, transition, label, length):
+    """Enumerate all tag paths for one sequence: returns (nll, viterbi)."""
+    T, D = emission.shape
+    L = int(length)
+    w_start, w_end, w_trans = transition[0], transition[1], transition[2:]
+
+    def score(path):
+        s = w_start[path[0]] + emission[0, path[0]] + w_end[path[L - 1]]
+        for k in range(1, L):
+            s += emission[k, path[k]] + w_trans[path[k - 1], path[k]]
+        return s
+
+    paths = list(itertools.product(range(D), repeat=L))
+    scores = np.array([score(p) for p in paths])
+    m = scores.max()
+    logz = m + np.log(np.exp(scores - m).sum())
+    gold = score(label[:L])
+    best = paths[int(np.argmax(scores))]
+    return logz - gold, np.array(best)
+
+
+def test_linear_chain_crf_matches_brute_force():
+    rng = np.random.RandomState(0)
+    N, T, D = 4, 5, 3
+    emission = rng.randn(N, T, D).astype("float32")
+    transition = rng.randn(D + 2, D).astype("float32") * 0.5
+    label = rng.randint(0, D, (N, T)).astype("int64")
+    length = np.array([5, 3, 4, 1], "int64")
+
+    out = run_op("linear_chain_crf",
+                 {"Emission": emission, "Transition": transition,
+                  "Label": label, "Length": length},
+                 outputs=("LogLikelihood",))
+    nll = out["LogLikelihood"][0].reshape(-1)
+    for i in range(N):
+        want, _ = _brute_force(emission[i], transition, label[i], length[i])
+        np.testing.assert_allclose(nll[i], want, rtol=1e-4, atol=1e-4)
+
+
+def test_linear_chain_crf_grad():
+    rng = np.random.RandomState(1)
+    N, T, D = 2, 4, 3
+    emission = rng.randn(N, T, D).astype("float64")
+    transition = (rng.randn(D + 2, D) * 0.5).astype("float64")
+    label = rng.randint(0, D, (N, T)).astype("int64")
+    length = np.array([4, 2], "int64")
+    check_grad("linear_chain_crf",
+               {"Emission": emission, "Transition": transition,
+                "Label": label, "Length": length},
+               {}, inputs_to_check=["Emission", "Transition"],
+               output_name="LogLikelihood", max_relative_error=1e-4)
+
+
+def test_crf_decoding_matches_brute_force():
+    rng = np.random.RandomState(2)
+    N, T, D = 4, 4, 3
+    emission = rng.randn(N, T, D).astype("float32")
+    transition = (rng.randn(D + 2, D)).astype("float32")
+    length = np.array([4, 2, 3, 4], "int64")
+    out = run_op("crf_decoding",
+                 {"Emission": emission, "Transition": transition,
+                  "Length": length}, outputs=("ViterbiPath",))
+    path = out["ViterbiPath"][0]
+    for i in range(N):
+        _, best = _brute_force(emission[i], transition,
+                               np.zeros(T, "int64"), length[i])
+        L = int(length[i])
+        np.testing.assert_array_equal(path[i, :L], best)
+        assert (path[i, L:] == 0).all()
+
+
+def test_crf_decoding_with_label_is_correctness_mask():
+    rng = np.random.RandomState(3)
+    N, T, D = 2, 4, 3
+    emission = rng.randn(N, T, D).astype("float32")
+    transition = rng.randn(D + 2, D).astype("float32")
+    length = np.array([4, 3], "int64")
+    dec = run_op("crf_decoding",
+                 {"Emission": emission, "Transition": transition,
+                  "Length": length}, outputs=("ViterbiPath",))["ViterbiPath"][0]
+    label = dec.copy()
+    label[0, 1] = (label[0, 1] + 1) % D  # flip one tag
+    out = run_op("crf_decoding",
+                 {"Emission": emission, "Transition": transition,
+                  "Label": label, "Length": length},
+                 outputs=("ViterbiPath",))["ViterbiPath"][0]
+    want = (dec == label).astype("int64")
+    want[0, :] *= (np.arange(T) < 4).astype("int64")
+    want[1, :] *= (np.arange(T) < 3).astype("int64")
+    np.testing.assert_array_equal(out, want)
+
+
+def test_chunk_eval_iob():
+    """Reference doc example semantics (chunk_eval_op.cc AddComment): IOB
+    with 3 chunk types; tag = type*2 + {0:B,1:I}, O = 6."""
+    # infer:  B-0 I-0 O  B-1 I-1 |  B-2 O
+    inf = np.array([[0, 1, 6, 2, 3], [4, 6, 6, 6, 6]], "int64")
+    # label:  B-0 I-0 O  B-1 B-1 |  B-2 I-2
+    lab = np.array([[0, 1, 6, 2, 2], [4, 5, 6, 6, 6]], "int64")
+    length = np.array([5, 2], "int64")
+    out = run_op("chunk_eval", {"Inference": inf, "Label": lab,
+                                "SeqLength": length},
+                 {"num_chunk_types": 3, "chunk_scheme": "IOB"},
+                 outputs=("Precision", "Recall", "F1-Score",
+                          "NumInferChunks", "NumLabelChunks",
+                          "NumCorrectChunks"))
+    # infer chunks: [0-1,t0], [3-4,t1], [0-0,t2] -> 3
+    # label chunks: [0-1,t0], [3-3,t1], [4-4,t1], [0-1,t2] -> 4
+    # correct: [0-1,t0] -> 1
+    assert int(out["NumInferChunks"][0][0]) == 3
+    assert int(out["NumLabelChunks"][0][0]) == 4
+    assert int(out["NumCorrectChunks"][0][0]) == 1
+    np.testing.assert_allclose(out["Precision"][0][0], 1 / 3, rtol=1e-6)
+    np.testing.assert_allclose(out["Recall"][0][0], 1 / 4, rtol=1e-6)
+
+
+def _segments_oracle(seq, num_chunk_types, scheme):
+    """Sequential reimplementation of the reference ChunkBegin/ChunkEnd
+    state machine (chunk_eval_op.h:40-108) — the oracle for the vectorized
+    in-graph op."""
+    schemes = {"IOB": (2, 0, 1, -1, -1), "IOE": (2, -1, 0, 1, -1),
+               "IOBES": (4, 0, 1, 2, 3), "plain": (1, -1, -1, -1, -1)}
+    ntag, t_beg, t_in, t_end, t_sng = schemes[scheme]
+    other = num_chunk_types
+    segs = []
+    in_chunk, start, tag, typ = False, 0, -1, other
+
+    def chunk_end(ptag, ptyp, tag, typ):
+        if ptyp == other:
+            return False
+        if typ == other or typ != ptyp:
+            return True
+        if ptag == t_beg or ptag == t_in:
+            return tag == t_beg or tag == t_sng
+        return ptag == t_end or ptag == t_sng
+
+    def chunk_begin(ptag, ptyp, tag, typ):
+        if ptyp == other:
+            return typ != other
+        if typ == other:
+            return False
+        if typ != ptyp:
+            return True
+        if tag == t_beg or tag == t_sng:
+            return True
+        if tag == t_in or tag == t_end:
+            return ptag == t_end or ptag == t_sng
+        return False
+
+    for i, lab in enumerate(seq):
+        ptag, ptyp = tag, typ
+        tag, typ = int(lab) % ntag, int(lab) // ntag
+        if in_chunk and chunk_end(ptag, ptyp, tag, typ):
+            segs.append((start, i - 1, ptyp))
+            in_chunk = False
+        if chunk_begin(ptag, ptyp, tag, typ):
+            start, in_chunk = i, True
+    if in_chunk:
+        segs.append((start, len(seq) - 1, typ))
+    return segs
+
+
+@pytest.mark.parametrize("scheme,ntag", [("IOB", 2), ("IOE", 2),
+                                         ("IOBES", 4), ("plain", 1)])
+def test_chunk_eval_random_vs_state_machine(scheme, ntag):
+    """Vectorized chunk_eval must agree with the sequential reference state
+    machine on random tag sequences, for every scheme."""
+    rng = np.random.RandomState(11)
+    nct = 3
+    n_labels = nct * ntag + 1  # incl. Other
+    for trial in range(5):
+        N, T = 6, 12
+        inf = rng.randint(0, n_labels, (N, T)).astype("int64")
+        lab = rng.randint(0, n_labels, (N, T)).astype("int64")
+        length = rng.randint(1, T + 1, (N,)).astype("int64")
+        out = run_op("chunk_eval", {"Inference": inf, "Label": lab,
+                                    "SeqLength": length},
+                     {"num_chunk_types": nct, "chunk_scheme": scheme},
+                     outputs=("NumInferChunks", "NumLabelChunks",
+                              "NumCorrectChunks"))
+        ni = nl = nc = 0
+        for i in range(N):
+            L = int(length[i])
+            si = set(_segments_oracle(inf[i, :L], nct, scheme))
+            sy = set(_segments_oracle(lab[i, :L], nct, scheme))
+            ni += len(si)
+            nl += len(sy)
+            nc += len(si & sy)
+        assert int(out["NumInferChunks"][0][0]) == ni, (trial, scheme)
+        assert int(out["NumLabelChunks"][0][0]) == nl
+        assert int(out["NumCorrectChunks"][0][0]) == nc
+
+
+def test_srl_style_crf_training_converges():
+    """Mini label_semantic_roles (reference: book/test_label_semantic_roles.py)
+    — embedding + GRU emission + CRF cost; NLL must fall and decode must
+    recover the synthetic tag rule."""
+    import paddle_tpu as pt
+
+    rng = np.random.RandomState(7)
+    V, D_TAG, T, N = 20, 3, 8, 16
+    # synthetic rule: tag = word % 3
+    words = rng.randint(0, V, (N, T)).astype("int64")
+    tags = (words % D_TAG).astype("int64")
+    length = np.full((N,), T, "int64")
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        w = pt.layers.data(name="w", shape=[T], dtype="int64")
+        t = pt.layers.data(name="t", shape=[T], dtype="int64")
+        ln = pt.layers.data(name="ln", shape=[], dtype="int64")
+        emb = pt.layers.embedding(w, size=[V, 16])
+        emission = pt.layers.fc(emb, size=D_TAG, num_flatten_dims=2)
+        crf_cost = pt.layers.linear_chain_crf(
+            emission, t, param_attr=pt.ParamAttr(name="crfw"), length=ln)
+        loss = pt.layers.mean(crf_cost)
+        pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+
+    infer = pt.Program()
+    # rebuild under unique_name.guard so parameters share names with `main`
+    # (the reference book tests' pattern)
+    with pt.framework.unique_name.guard(), \
+            pt.program_guard(infer, pt.Program()):
+        w2 = pt.layers.data(name="w", shape=[T], dtype="int64")
+        ln2 = pt.layers.data(name="ln", shape=[], dtype="int64")
+        emb2 = pt.layers.embedding(w2, size=[V, 16])
+        emission2 = pt.layers.fc(emb2, size=D_TAG, num_flatten_dims=2)
+        decode = pt.layers.crf_decoding(
+            emission2, param_attr=pt.ParamAttr(name="crfw"), length=ln2)
+
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(60):
+            l = exe.run(main, feed={"w": words, "t": tags, "ln": length},
+                        fetch_list=[loss])[0]
+            losses.append(float(np.asarray(l).reshape(())))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+        path = exe.run(infer, feed={"w": words, "ln": length},
+                       fetch_list=[decode])[0]
+        acc = (np.asarray(path) == tags).mean()
+        assert acc > 0.95, acc
