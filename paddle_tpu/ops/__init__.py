@@ -20,3 +20,4 @@ from . import distributed
 from . import detection
 from . import collective
 from . import crf
+from . import classify

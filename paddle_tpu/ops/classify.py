@@ -1,0 +1,203 @@
+"""Large-vocabulary classification ops: NCE, hierarchical sigmoid,
+sampled softmax, cosine similarity.
+
+Reference behavior: operators/nce_op.h (per-sample cost -log(o/(o+b)) for
+true classes and -log(b/(o+b)) for negatives, where o = sigmoid(logit) and
+b = P(class) * num_neg_samples), operators/hierarchical_sigmoid_op.h +
+math/matrix_bit_code.h (SimpleCode over label+num_classes: node index
+(c>>(d+1))-1, bit (c>>d)&1; cost = sum_d softplus(pre_d) - bit_d*pre_d with
+pre clipped to [-40,40]), operators/sample_logits_op.cc +
+layers/nn.py:7916 sampled_softmax_with_cross_entropy, operators/cos_sim_op.h.
+
+TPU-native: everything is batched gathers + one [N, S, D] x [N, D] einsum
+(MXU-friendly); negative sampling uses the executor-threaded RNG
+(ctx.rng()); no SelectedRows — weight gradients are dense scatter-adds,
+which XLA fuses.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _log_uniform_prob(classes, range_max):
+    """P(c) of the log-uniform sampler (reference: math/sampler.cc
+    LogUniformSampler): log((c+2)/(c+1)) / log(range_max+1)."""
+    c = classes.astype(jnp.float32)
+    return jnp.log((c + 2.0) / (c + 1.0)) / np.log(range_max + 1.0)
+
+
+def _sample_classes(rng, shape, num_classes, sampler, custom_probs=None):
+    if sampler == "custom":
+        if custom_probs is None:
+            raise ValueError("sampler='custom' requires CustomDistProbs")
+        logits = jnp.log(jnp.maximum(custom_probs, 1e-30))
+        return jax.random.categorical(rng, logits, shape=shape).astype(
+            jnp.int64)
+    if sampler == "log_uniform":
+        # inverse-CDF of the log-uniform distribution
+        u = jax.random.uniform(rng, shape)
+        s = jnp.exp(u * np.log(num_classes + 1.0)) - 1.0
+        return jnp.clip(s.astype(jnp.int64), 0, num_classes - 1)
+    return jax.random.randint(rng, shape, 0, num_classes, dtype=jnp.int64)
+
+
+@register_op("nce", is_random=True,
+             nondiff_inputs=("Label", "SampleWeight", "CustomDistProbs",
+                             "CustomDistAlias", "CustomDistAliasProbs"),
+             intermediate_outputs=("SampleLogits", "SampleLabels"))
+def nce(ins, attrs, ctx):
+    """Noise-contrastive estimation loss (reference: nce_op.h:241-266)."""
+    x = ins["Input"][0]                      # [N, D]
+    label = ins["Label"][0]                  # [N, num_true]
+    w = ins["Weight"][0]                     # [C, D]
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
+        else None
+    if label.ndim == 1:
+        label = label[:, None]
+    n, num_true = label.shape
+    num_neg = int(attrs.get("num_neg_samples", 10))
+    num_classes = int(attrs["num_total_classes"])
+    sampler = {0: "uniform", 1: "log_uniform", 2: "custom"}.get(
+        attrs.get("sampler", 0), "uniform") if isinstance(
+            attrs.get("sampler", 0), int) else attrs.get("sampler", "uniform")
+    custom_probs = None
+    if ins.get("CustomDistProbs") and ins["CustomDistProbs"][0] is not None:
+        custom_probs = ins["CustomDistProbs"][0].reshape(-1)
+
+    neg = _sample_classes(ctx.rng(), (n, num_neg), num_classes, sampler,
+                          custom_probs)
+    samples = jnp.concatenate([label.astype(jnp.int64), neg], axis=1)  # [N,S]
+
+    w_rows = w[samples]                                   # [N, S, D]
+    logits = jnp.einsum("nsd,nd->ns", w_rows, x)
+    if bias is not None:
+        logits = logits + bias[samples]
+    o = jax.nn.sigmoid(logits)
+
+    if sampler == "custom":
+        p = custom_probs[samples].astype(logits.dtype)
+    elif sampler == "log_uniform":
+        p = _log_uniform_prob(samples, num_classes).astype(logits.dtype)
+    else:
+        p = jnp.full(samples.shape, 1.0 / num_classes, logits.dtype)
+    b = p * num_neg
+
+    eps = 1e-12
+    cost_true = -jnp.log(o[:, :num_true] / (o[:, :num_true] +
+                                            b[:, :num_true] + eps) + eps)
+    cost_neg = -jnp.log(b[:, num_true:] / (o[:, num_true:] +
+                                           b[:, num_true:] + eps) + eps)
+    if ins.get("SampleWeight") and ins["SampleWeight"][0] is not None:
+        sw = ins["SampleWeight"][0].reshape(-1, 1)
+        cost_true = cost_true * sw
+        cost_neg = cost_neg * sw
+    cost = cost_true.sum(1, keepdims=True) + cost_neg.sum(1, keepdims=True)
+    return {"Cost": cost, "SampleLogits": logits,
+            "SampleLabels": samples}
+
+
+def _simple_code(label, num_classes):
+    """Default complete-binary-tree path for class `label` (reference:
+    matrix_bit_code.h SimpleCode). Returns (indices [N,L], bits [N,L],
+    mask [N,L]) with L = static max code length."""
+    c = label.astype(jnp.int64) + num_classes
+    max_len = int(2 * num_classes - 1).bit_length() - 1
+    d = jnp.arange(max_len)
+    # length(c) = bit_length(c) - 1 = #bits d>=1 with c >> d > 0... computed
+    # positionally: position d is valid iff c >> (d+1) > 0
+    valid = (c[:, None] >> (d[None, :] + 1)) > 0
+    idx = jnp.maximum((c[:, None] >> (d[None, :] + 1)) - 1, 0)
+    bits = (c[:, None] >> d[None, :]) & 1
+    return idx, bits, valid
+
+
+@register_op("hierarchical_sigmoid", nondiff_inputs=("Label", "PathTable",
+                                                     "PathCode"),
+             intermediate_outputs=("PreOut",))
+def hierarchical_sigmoid(ins, attrs, ctx):
+    """Hierarchical sigmoid cost (reference: hierarchical_sigmoid_op.h:
+    pre = clip(W_path·x + b_path, ±40); cost = Σ softplus(pre) − bit·pre)."""
+    x = ins["X"][0]                        # [N, D]
+    w = ins["W"][0]                        # [num_nodes, D]
+    label = ins["Label"][0].reshape(-1)    # [N]
+    bias = ins["Bias"][0] if ins.get("Bias") and ins["Bias"][0] is not None \
+        else None
+    num_classes = int(attrs.get("num_classes", 2))
+    if ins.get("PathTable") and ins["PathTable"][0] is not None:
+        idx = ins["PathTable"][0].astype(jnp.int64)       # [N, L]
+        bits = ins["PathCode"][0]
+        valid = idx >= 0
+        idx = jnp.maximum(idx, 0)
+    else:
+        idx, bits, valid = _simple_code(label, num_classes)
+    w_rows = w[idx]                                       # [N, L, D]
+    pre = jnp.einsum("nld,nd->nl", w_rows, x)
+    if bias is not None:
+        pre = pre + bias.reshape(-1)[idx]
+    pre = jnp.clip(pre, -40.0, 40.0)
+    mask = valid.astype(pre.dtype)
+    cost = jnp.sum((jax.nn.softplus(pre) -
+                    bits.astype(pre.dtype) * pre) * mask, axis=1,
+                   keepdims=True)
+    return {"Out": cost, "PreOut": pre}
+
+
+@register_op("sampled_softmax_with_cross_entropy", is_random=True,
+             nondiff_inputs=("Label", "CustomizedSamples",
+                             "CustomizedProbabilities"),
+             intermediate_outputs=("Samples", "SampledLogits"))
+def sampled_softmax_with_cross_entropy(ins, attrs, ctx):
+    """Softmax CE over {true class} ∪ {S negatives} with expected-count
+    logit correction (reference: sample_logits_op.cc + layers/nn.py:7916).
+    Negatives are log-uniform draws, or caller-provided via
+    CustomizedSamples/CustomizedProbabilities [N, 1+S] when
+    use_customized_samples."""
+    logits = ins["Logits"][0]              # [N, C]
+    label = ins["Label"][0]
+    if label.ndim == 1:
+        label = label[:, None]
+    n, c = logits.shape
+    s = int(attrs.get("num_samples", 5))
+    nt = label.shape[1]
+    use_custom = bool(attrs.get("use_customized_samples", False))
+    remove_hits = bool(attrs.get("remove_accidental_hits", True))
+
+    if use_custom:
+        samples = ins["CustomizedSamples"][0].astype(jnp.int64)
+        probs = ins["CustomizedProbabilities"][0]
+        sub = jnp.take_along_axis(logits, samples, axis=1)
+        sub = sub - jnp.log(probs.astype(sub.dtype) + 1e-12)
+    else:
+        neg = _sample_classes(ctx.rng(), (n, s), c, "log_uniform")
+        samples = jnp.concatenate([label.astype(jnp.int64), neg], axis=1)
+        sub = jnp.take_along_axis(logits, samples, axis=1)    # [N, nt+S]
+        sub = sub - jnp.log(_log_uniform_prob(samples, c).astype(sub.dtype)
+                            * s + 1e-12)
+    if remove_hits:
+        # a negative equal to ANY true class gets -inf
+        hit = (samples[:, None, nt:] ==
+               label.astype(jnp.int64)[:, :, None]).any(axis=1)
+        sub = sub.at[:, nt:].add(jnp.where(hit, -1e20, 0.0).astype(sub.dtype))
+    logp = jax.nn.log_softmax(sub, axis=-1)
+    # soft uniform target over the nt true columns (num_true > 1 support)
+    loss = -jnp.mean(logp[:, :nt], axis=1, keepdims=True)
+    return {"Loss": loss, "Samples": samples, "SampledLogits": sub}
+
+
+@register_op("cos_sim", intermediate_outputs=("XNorm", "YNorm"))
+def cos_sim(ins, attrs, ctx):
+    """Row-wise cosine similarity; Y broadcasts when it has one row
+    (reference: cos_sim_op.h)."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(y * y, axis=-1, keepdims=True))
+    dot = jnp.sum(x * y, axis=-1, keepdims=True)
+    eps = jnp.asarray(1e-12, x.dtype)
+    return {"Out": dot / jnp.maximum(xn * yn, eps), "XNorm": xn, "YNorm": yn}

@@ -151,13 +151,11 @@ def crf_decoding(ins, attrs, ctx):
                                        reverse=True)
     path = jnp.concatenate([first_tag[:, None],
                             jnp.moveaxis(rev_path, 0, 1)], axis=1)  # [N, T]
-    # positions k>=length hold -1 markers from the reverse scan (except the
-    # path head); rebuild: valid positions get decoded tag, rest 0
+    # the reverse scan emits -1 only at invalid (k >= length) positions,
+    # which this mask zeroes anyway
     pos = jnp.arange(t)[None, :]
     valid = pos < lengths[:, None]
-    # fix interior -1s: where k < length but marker says -1 (can't happen for
-    # k<length since keep was true there), so just mask
-    path = jnp.where(valid, jnp.where(path < 0, 0, path), 0)
+    path = jnp.where(valid, path, 0)
     if ins.get("Label") and ins["Label"][0] is not None:
         lbl = ins["Label"][0]
         if lbl.ndim == 3:

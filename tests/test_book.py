@@ -132,3 +132,52 @@ def test_nets_and_metrics():
     m.update(value=0.5, weight=10)
     m.update(value=1.0, weight=10)
     assert abs(m.eval() - 0.75) < 1e-6
+
+
+def test_recommender_system_cos_sim(tmp_path):
+    """reference: book/test_recommender_system.py — user/movie embedding
+    towers joined by cos_sim, scaled to the rating range, trained with
+    square error; infer path exported and reloaded."""
+    rng = np.random.RandomState(13)
+    N_USR, N_MOV, N = 30, 40, 128
+    usr = rng.randint(0, N_USR, (N, 1)).astype("int64")
+    mov = rng.randint(0, N_MOV, (N, 1)).astype("int64")
+    # synthetic preference structure: rating from hidden factors
+    uf = rng.randn(N_USR, 4)
+    mf = rng.randn(N_MOV, 4)
+    score = (uf[usr[:, 0]] * mf[mov[:, 0]]).sum(1)
+    rating = (2.5 + 2.5 * np.tanh(score)).astype("float32")[:, None]
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        u = pt.layers.data(name="u", shape=[1], dtype="int64")
+        m = pt.layers.data(name="m", shape=[1], dtype="int64")
+        r = pt.layers.data(name="r", shape=[1], dtype="float32")
+        uemb = pt.layers.reshape(pt.layers.embedding(u, size=[N_USR, 16]),
+                                 [-1, 16])
+        memb = pt.layers.reshape(pt.layers.embedding(m, size=[N_MOV, 16]),
+                                 [-1, 16])
+        utower = pt.layers.fc(uemb, size=16, act="tanh")
+        mtower = pt.layers.fc(memb, size=16, act="tanh")
+        sim = pt.layers.cos_sim(utower, mtower)
+        pred = pt.layers.scale(sim, scale=5.0)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred,
+                                                          label=r))
+        pt.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(
+            exe.run(main, feed={"u": usr, "m": mov, "r": rating},
+                    fetch_list=[loss])[0]).reshape(()))
+            for _ in range(60)]
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+        pt.io.save_inference_model(str(tmp_path), ["u", "m"], [pred], exe,
+                                   main_program=main)
+        prog, feeds, fetches = pt.io.load_inference_model(str(tmp_path), exe)
+        out = exe.run(prog, feed={feeds[0]: usr, feeds[1]: mov},
+                      fetch_list=fetches)[0]
+        assert out.shape == (N, 1)
+        assert np.abs(np.asarray(out)).max() <= 5.0 + 1e-5

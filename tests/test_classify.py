@@ -1,0 +1,233 @@
+"""Tests for large-vocab classification ops (nce, hierarchical_sigmoid,
+sampled_softmax_with_cross_entropy, cos_sim).
+
+Reference pattern: unittests/test_nce.py, test_hsigmoid_op.py,
+test_sample_logits.py, test_cos_sim_op.py — numpy references."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_op
+
+
+def test_cos_sim_matches_numpy_and_grad():
+    rng = np.random.RandomState(0)
+    x = rng.randn(6, 8).astype("float64")
+    y = rng.randn(6, 8).astype("float64")
+    out = run_op("cos_sim", {"X": x, "Y": y})["Out"][0]
+    want = (x * y).sum(1) / (np.linalg.norm(x, axis=1) *
+                             np.linalg.norm(y, axis=1))
+    np.testing.assert_allclose(out.reshape(-1), want, rtol=1e-6)
+    check_grad("cos_sim", {"X": x, "Y": y}, {}, inputs_to_check=["X", "Y"])
+
+
+def test_cos_sim_broadcasts_single_row_y():
+    rng = np.random.RandomState(1)
+    x = rng.randn(5, 4).astype("float32")
+    y = rng.randn(1, 4).astype("float32")
+    out = run_op("cos_sim", {"X": x, "Y": y})["Out"][0]
+    want = (x * y).sum(1) / (np.linalg.norm(x, axis=1) * np.linalg.norm(y))
+    np.testing.assert_allclose(out.reshape(-1), want, rtol=1e-5)
+
+
+def _hsigmoid_ref(x, w, bias, label, num_classes):
+    """Sequential SimpleCode reference (matrix_bit_code.h semantics)."""
+    n = x.shape[0]
+    cost = np.zeros((n, 1))
+    for i in range(n):
+        c = int(label[i]) + num_classes
+        length = c.bit_length() - 1
+        for d in range(length):
+            idx = (c >> (d + 1)) - 1
+            bit = (c >> d) & 1
+            pre = np.dot(w[idx], x[i]) + (bias[idx] if bias is not None else 0)
+            pre = np.clip(pre, -40, 40)
+            cost[i, 0] += np.log1p(np.exp(pre)) - bit * pre
+    return cost
+
+
+@pytest.mark.parametrize("num_classes", [2, 5, 8, 13])
+def test_hierarchical_sigmoid_matches_sequential_reference(num_classes):
+    rng = np.random.RandomState(2)
+    n, d = 7, 6
+    x = rng.randn(n, d).astype("float64")
+    w = rng.randn(num_classes - 1, d).astype("float64") * 0.5
+    b = rng.randn(num_classes - 1).astype("float64") * 0.1
+    label = rng.randint(0, num_classes, (n,)).astype("int64")
+    out = run_op("hierarchical_sigmoid",
+                 {"X": x, "W": w, "Bias": b, "Label": label},
+                 {"num_classes": num_classes})["Out"][0]
+    want = _hsigmoid_ref(x, w, b, label, num_classes)
+    np.testing.assert_allclose(out, want, rtol=1e-6, atol=1e-8)
+
+
+def test_hierarchical_sigmoid_grad():
+    rng = np.random.RandomState(3)
+    num_classes, n, d = 6, 4, 5
+    x = rng.randn(n, d).astype("float64")
+    w = rng.randn(num_classes - 1, d).astype("float64") * 0.5
+    b = rng.randn(num_classes - 1).astype("float64") * 0.1
+    label = rng.randint(0, num_classes, (n,)).astype("int64")
+    check_grad("hierarchical_sigmoid",
+               {"X": x, "W": w, "Bias": b, "Label": label},
+               {"num_classes": num_classes},
+               inputs_to_check=["X", "W", "Bias"],
+               max_relative_error=1e-4)
+
+
+def test_hierarchical_sigmoid_probabilities_normalize():
+    """Σ_c P(c) = 1 under the binary-tree factorization: exp(-cost) summed
+    over forced labels 0..C-1 must be 1."""
+    rng = np.random.RandomState(4)
+    num_classes, d = 7, 4
+    x = rng.randn(1, d)
+    w = rng.randn(num_classes - 1, d) * 0.7
+    b = rng.randn(num_classes - 1) * 0.2
+    total = 0.0
+    for c in range(num_classes):
+        out = run_op("hierarchical_sigmoid",
+                     {"X": x, "W": w, "Bias": b,
+                      "Label": np.array([c], "int64")},
+                     {"num_classes": num_classes})["Out"][0]
+        total += np.exp(-float(out[0, 0]))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-6)
+
+
+def test_nce_cost_matches_formula():
+    """Recompute the NCE cost from the op's own SampleLabels/SampleLogits
+    (nce_op.h:264-266: -log(o/(o+b)) true, -log(b/(o+b)) negative)."""
+    rng = np.random.RandomState(5)
+    n, d, c, k = 6, 8, 20, 5
+    x = rng.randn(n, d).astype("float32")
+    w = rng.randn(c, d).astype("float32") * 0.3
+    b = rng.randn(c).astype("float32") * 0.1
+    label = rng.randint(0, c, (n, 1)).astype("int64")
+    out = run_op("nce", {"Input": x, "Label": label, "Weight": w, "Bias": b},
+                 {"num_total_classes": c, "num_neg_samples": k,
+                  "sampler": "uniform"},
+                 outputs=("Cost", "SampleLogits", "SampleLabels"),
+                 rng_seed=7)
+    samples = out["SampleLabels"][0]
+    assert samples.shape == (n, 1 + k)
+    np.testing.assert_array_equal(samples[:, 0], label[:, 0])
+    logits = np.einsum("nsd,nd->ns", w[samples], x) + b[samples]
+    o = 1 / (1 + np.exp(-logits))
+    bq = np.full_like(o, k / c)
+    want = (-np.log(o[:, :1] / (o[:, :1] + bq[:, :1] + 1e-12) + 1e-12) +
+            (-np.log(bq[:, 1:] / (o[:, 1:] + bq[:, 1:] + 1e-12) + 1e-12))
+            .sum(1, keepdims=True))
+    np.testing.assert_allclose(out["Cost"][0], want, rtol=1e-4, atol=1e-5)
+
+
+def test_nce_custom_sampler_uses_custom_probs():
+    """sampler='custom': negatives drawn from CustomDistProbs and scored
+    with those probabilities (mass on classes 0/1 only)."""
+    rng = np.random.RandomState(6)
+    n, d, c, k = 4, 5, 10, 8
+    probs = np.zeros(c, "float32")
+    probs[0], probs[1] = 0.5, 0.5
+    out = run_op("nce", {"Input": rng.randn(n, d).astype("float32"),
+                         "Label": rng.randint(2, c, (n, 1)).astype("int64"),
+                         "Weight": rng.randn(c, d).astype("float32"),
+                         "CustomDistProbs": probs},
+                 {"num_total_classes": c, "num_neg_samples": k,
+                  "sampler": "custom"},
+                 outputs=("Cost", "SampleLabels"), rng_seed=8)
+    neg = out["SampleLabels"][0][:, 1:]
+    assert set(np.unique(neg)) <= {0, 1}
+
+
+def test_nce_training_learns_unigram_structure():
+    """Word2vec-style: with nce loss, the score of the true next word must
+    come to dominate (reference: book/test_word2vec.py trains embeddings
+    with a sampled loss)."""
+    import paddle_tpu as pt
+
+    rng = np.random.RandomState(9)
+    V, D, N = 12, 8, 64
+    ctx_words = rng.randint(0, V, (N, 1)).astype("int64")
+    next_word = ((ctx_words[:, 0] * 3 + 1) % V).astype("int64")[:, None]
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        wv = pt.layers.data(name="w", shape=[1], dtype="int64")
+        y = pt.layers.data(name="y", shape=[1], dtype="int64")
+        emb = pt.layers.embedding(wv, size=[V, D])
+        emb = pt.layers.reshape(emb, [-1, D])
+        cost = pt.layers.nce(input=emb, label=y, num_total_classes=V,
+                             num_neg_samples=4,
+                             param_attr=pt.ParamAttr(name="nce_w"),
+                             bias_attr=pt.ParamAttr(name="nce_b"))
+        loss = pt.layers.mean(cost)
+        pt.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(
+            exe.run(main, feed={"w": ctx_words, "y": next_word},
+                    fetch_list=[loss])[0]).reshape(()))
+            for _ in range(120)]
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_sampled_softmax_customized_samples_exact():
+    """use_customized_samples=True: loss is exactly softmax-CE over the
+    provided columns with -log(prob) correction."""
+    rng = np.random.RandomState(10)
+    n, c, s = 5, 12, 3
+    logits = rng.randn(n, c).astype("float32")
+    label = rng.randint(0, c, (n, 1)).astype("int64")
+    negs = np.stack([rng.choice([x for x in range(c) if x != label[i, 0]],
+                                s, replace=False) for i in range(n)])
+    samples = np.concatenate([label, negs], 1).astype("int64")
+    probs = np.full((n, 1 + s), 0.25, "float32")
+    out = run_op("sampled_softmax_with_cross_entropy",
+                 {"Logits": logits, "Label": label,
+                  "CustomizedSamples": samples,
+                  "CustomizedProbabilities": probs},
+                 {"num_samples": s, "use_customized_samples": True,
+                  "remove_accidental_hits": False},
+                 outputs=("Loss",))["Loss"][0]
+    sub = np.take_along_axis(logits, samples, axis=1) - np.log(0.25 + 1e-12)
+    lse = np.log(np.exp(sub - sub.max(1, keepdims=True)).sum(1)) + \
+        sub.max(1)
+    want = (lse - sub[:, 0])[:, None]
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_sampled_softmax_num_true_2():
+    """num_true=2: loss is the mean NLL of both true columns; accidental-hit
+    masking covers both labels."""
+    rng = np.random.RandomState(12)
+    n, c, s = 4, 10, 6
+    logits = rng.randn(n, c).astype("float32")
+    label = np.stack([rng.choice(c, 2, replace=False)
+                      for _ in range(n)]).astype("int64")
+    out = run_op("sampled_softmax_with_cross_entropy",
+                 {"Logits": logits, "Label": label},
+                 {"num_samples": s, "num_true": 2},
+                 outputs=("Loss", "Samples", "SampledLogits"), rng_seed=4)
+    samples = out["Samples"][0]
+    np.testing.assert_array_equal(samples[:, :2], label)
+    # no sampled-negative column may retain a finite logit equal to a true
+    # class (accidental hits masked)
+    slog = out["SampledLogits"][0]
+    for i in range(n):
+        for j in range(2, samples.shape[1]):
+            if samples[i, j] in label[i]:
+                assert slog[i, j] < -1e19
+    assert out["Loss"][0].shape == (n, 1)
+
+
+def test_sampled_softmax_trains_to_match_full_softmax_ranking():
+    rng = np.random.RandomState(11)
+    n, c = 8, 50
+    logits = rng.randn(n, c).astype("float32") * 0.1
+    label = rng.randint(0, c, (n, 1)).astype("int64")
+    out = run_op("sampled_softmax_with_cross_entropy",
+                 {"Logits": logits, "Label": label},
+                 {"num_samples": 10}, outputs=("Loss", "Samples"),
+                 rng_seed=3)
+    assert out["Loss"][0].shape == (n, 1)
+    assert (out["Loss"][0] > 0).all()
+    np.testing.assert_array_equal(out["Samples"][0][:, 0], label[:, 0])
