@@ -183,9 +183,9 @@ def hsigmoid(input, label, num_classes=None, param_attr=None, bias_attr=None,
             raise ValueError("is_custom requires num_classes (number of "
                              "non-leaf nodes, sizes the W table)")
         num_nodes = num_classes
-    w = helper.create_parameter(param_attr, shape=[max(num_nodes, 1), dim],
+    w = helper.create_parameter(param_attr, shape=[num_nodes, dim],
                                 dtype=input.dtype)
-    b = helper.create_parameter(bias_attr, shape=[max(num_nodes, 1)],
+    b = helper.create_parameter(bias_attr, shape=[num_nodes],
                                 dtype=input.dtype, is_bias=True)
     out = helper.create_variable_for_type_inference(input.dtype)
     pre = helper.create_variable_for_type_inference(input.dtype)
@@ -197,7 +197,7 @@ def hsigmoid(input, label, num_classes=None, param_attr=None, bias_attr=None,
         inputs["PathCode"] = path_code
     helper.append_op(type="hierarchical_sigmoid", inputs=inputs,
                      outputs={"Out": out, "PreOut": pre},
-                     attrs={"num_classes": int(num_classes or 2),
+                     attrs={"num_classes": int(num_classes),
                             "is_sparse": is_sparse})
     return out
 

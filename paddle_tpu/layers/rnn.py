@@ -14,7 +14,8 @@ from typing import Optional, Tuple
 from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
 
-__all__ = ["lstm", "dynamic_lstm", "gru", "dynamic_gru"]
+__all__ = ["lstm", "dynamic_lstm", "gru", "dynamic_gru",
+           "beam_search", "beam_search_decode", "gather_tree"]
 
 
 def lstm(input, hidden_size, num_layers=1, is_reverse=False,
@@ -129,3 +130,57 @@ def dynamic_gru(input, size, param_attr=None, bias_attr=None,
         outputs={"Hidden": hidden, "LastH": lh},
         attrs={"hidden_size": size, "is_reverse": is_reverse})
     return hidden, lh
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """One beam-search step (reference: layers/nn.py:5554 → beam_search_op).
+    pre_ids/pre_scores [B,K]; scores [B,K,W] candidate scores (accumulated
+    unless is_accumulated=False); ids optional candidate ids. Returns
+    (selected_ids, selected_scores[, parent_idx])."""
+    helper = LayerHelper("beam_search", name=name)
+    sel_ids = helper.create_variable_for_type_inference("int64")
+    sel_scores = helper.create_variable_for_type_inference(scores.dtype)
+    parent = helper.create_variable_for_type_inference("int64")
+    inputs = {"pre_ids": pre_ids, "pre_scores": pre_scores, "scores": scores}
+    if ids is not None:
+        inputs["ids"] = ids
+    helper.append_op(type="beam_search", inputs=inputs,
+                     outputs={"selected_ids": sel_ids,
+                              "selected_scores": sel_scores,
+                              "parent_idx": parent},
+                     attrs={"beam_size": int(beam_size), "end_id": int(end_id),
+                            "level": int(level),
+                            "is_accumulated": bool(is_accumulated)})
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent
+    return sel_ids, sel_scores
+
+
+def beam_search_decode(ids, scores, parent_idx, beam_size, end_id, name=None):
+    """Assemble final translations from stacked per-step beam outputs
+    (reference: layers/nn.py:5697 → beam_search_decode_op; the reference
+    reads LoDTensorArrays, here the steps are stacked [T,B,K] tensors).
+    Returns (sentence_ids [B,K,T] best-first, sentence_scores [B,K])."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent_ids = helper.create_variable_for_type_inference("int64")
+    sent_scores = helper.create_variable_for_type_inference(scores.dtype)
+    helper.append_op(type="beam_search_decode",
+                     inputs={"Ids": ids, "ParentIdx": parent_idx,
+                             "Scores": scores},
+                     outputs={"SentenceIds": sent_ids,
+                              "SentenceScores": sent_scores},
+                     attrs={"beam_size": int(beam_size),
+                            "end_id": int(end_id)})
+    return sent_ids, sent_scores
+
+
+def gather_tree(ids, parents):
+    """Backtrack beams through parent pointers ([T,B,K] → [T,B,K])."""
+    helper = LayerHelper("gather_tree")
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="gather_tree", inputs={"Ids": ids,
+                                                 "Parents": parents},
+                     outputs={"Out": out})
+    return out

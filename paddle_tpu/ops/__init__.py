@@ -21,3 +21,4 @@ from . import detection
 from . import collective
 from . import crf
 from . import classify
+from . import beam

@@ -1,0 +1,142 @@
+"""Beam-search ops — step selection, trellis decode, tree backtrack.
+
+Reference behavior: operators/beam_search_op.{cc,h} (one step: per source
+sentence, expand every live prefix with its candidates, keep the global
+top-beam_size; finished prefixes — pre_id == end_id — contribute exactly
+one candidate, themselves, with unchanged score; is_accumulated=False means
+incoming scores are raw probabilities to be log-accumulated onto
+pre_scores) and operators/beam_search_decode_op.{cc,h} (walk the recorded
+steps backwards through parent pointers to emit full sentences + scores).
+
+TPU-native design: the reference tracks beams in 2-level LoD with dynamic
+shrinking; XLA needs static shapes, so beams are a fixed [B, K] lane and
+ended beams are frozen in place via -inf masking (same selection results).
+Selection is one flat top_k over [B, K*W] — a single XLA sort per step.
+The decode is a reverse lax.scan over parent pointers (the reference's
+sentence walk), emitting end_id-padded [B, K, T] sentences.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+_NEG_INF = -1e9
+
+
+@register_op("beam_search", grad=None,
+             nondiff_inputs=("pre_ids", "pre_scores", "ids", "scores"))
+def beam_search(ins, attrs, ctx):
+    """One beam-search step.
+
+    Inputs: pre_ids [B,K] int, pre_scores [B,K], scores [B,K,W] candidate
+    scores, optional ids [B,K,W] candidate ids (defaults to the class axis
+    0..W-1). Outputs selected_ids/selected_scores [B,K] and parent_idx
+    [B,K] (which incoming beam each selected beam extends).
+    """
+    pre_ids = ins["pre_ids"][0]
+    pre_scores = ins["pre_scores"][0]
+    scores = ins["scores"][0]
+    if pre_ids.ndim == 1:
+        pre_ids = pre_ids[None]
+        pre_scores = pre_scores[None]
+    if scores.ndim == 2:  # [K, W] single-sentence convention
+        scores = scores[None]
+    b, k, w = scores.shape
+    beam_size = int(attrs.get("beam_size", k))
+    end_id = int(attrs["end_id"])
+    is_accumulated = bool(attrs.get("is_accumulated", True))
+
+    if ins.get("ids") and ins["ids"][0] is not None:
+        cand_ids = ins["ids"][0].reshape(b, k, w).astype(jnp.int32)
+    else:
+        cand_ids = jnp.broadcast_to(jnp.arange(w, dtype=jnp.int32),
+                                    (b, k, w))
+
+    if not is_accumulated:
+        scores = pre_scores[:, :, None] + \
+            jnp.log(jnp.maximum(scores, 1e-20))
+
+    finished = pre_ids.astype(jnp.int32) == end_id            # [B, K]
+    # a finished beam offers exactly one candidate: itself at slot 0
+    keep_self = jnp.concatenate(
+        [jnp.ones((b, k, 1), bool), jnp.zeros((b, k, w - 1), bool)], axis=2)
+    scores = jnp.where(finished[:, :, None],
+                       jnp.where(keep_self, pre_scores[:, :, None],
+                                 _NEG_INF),
+                       scores)
+    cand_ids = jnp.where(finished[:, :, None], end_id, cand_ids)
+
+    flat_scores = scores.reshape(b, k * w)
+    top_scores, top_idx = jax.lax.top_k(flat_scores, beam_size)   # [B, Kout]
+    parent = (top_idx // w).astype(jnp.int64)
+    sel_ids = jnp.take_along_axis(cand_ids.reshape(b, k * w), top_idx,
+                                  axis=1).astype(jnp.int64)
+    return {"selected_ids": sel_ids, "selected_scores": top_scores,
+            "parent_idx": parent}
+
+
+def _backtrack(step_ids, parents):
+    """Reverse scan through parent pointers. step_ids/parents [T,B,K] →
+    sequences [T,B,K] where lane j at every t holds the token of the final
+    beam j's path."""
+    t = step_ids.shape[0]
+
+    def step(carry, xs):
+        beam = carry                      # [B, K] lane -> beam index at t+1
+        ids_t, par_t = xs
+        tok = jnp.take_along_axis(ids_t, beam, axis=1)
+        prev_beam = jnp.take_along_axis(par_t, beam, axis=1)
+        return prev_beam, tok
+
+    k = step_ids.shape[2]
+    lane0 = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32),
+                             step_ids.shape[1:])
+    _, toks = jax.lax.scan(step, lane0,
+                           (step_ids, parents.astype(jnp.int32)),
+                           reverse=True)
+    return toks
+
+
+@register_op("gather_tree", grad=None, nondiff_inputs=("Ids", "Parents"))
+def gather_tree(ins, attrs, ctx):
+    """Backtrack full beams from per-step ids/parents (the beam_search_decode
+    walk exposed as its own op; matches the later-paddle gather_tree
+    contract: inputs and output are [T, B, K])."""
+    ids = ins["Ids"][0].astype(jnp.int32)
+    parents = ins["Parents"][0]
+    return {"Out": _backtrack(ids, parents).astype(jnp.int64)}
+
+
+@register_op("beam_search_decode", grad=None,
+             nondiff_inputs=("Ids", "ParentIdx", "Scores"))
+def beam_search_decode(ins, attrs, ctx):
+    """Assemble final sentences from recorded steps (reference:
+    beam_search_decode_op.h walks each prefix back through the LoD trellis).
+
+    Inputs: Ids [T,B,K], ParentIdx [T,B,K], Scores [T,B,K] (accumulated).
+    Outputs SentenceIds [B,K,T] (tokens after each beam's first end_id are
+    end_id) and SentenceScores [B,K] (the accumulated score at each beam's
+    final step), both ordered best-first per sentence.
+    """
+    ids = ins["Ids"][0].astype(jnp.int32)
+    parents = ins["ParentIdx"][0]
+    scores = ins["Scores"][0]
+    end_id = int(attrs["end_id"])
+    t, b, k = ids.shape
+
+    toks = _backtrack(ids, parents)                  # [T, B, K]
+    toks = jnp.moveaxis(toks, 0, 2)                  # [B, K, T]
+    # freeze everything after the first end_id to end_id
+    ended = jnp.cumsum((toks == end_id).astype(jnp.int32), axis=2) > 0
+    shifted = jnp.concatenate(
+        [jnp.zeros_like(ended[:, :, :1]), ended[:, :, :-1]], axis=2)
+    toks = jnp.where(shifted, end_id, toks)
+    final_scores = scores[-1]                        # [B, K]
+    order = jnp.argsort(-final_scores, axis=1)
+    toks = jnp.take_along_axis(toks, order[:, :, None], axis=1)
+    final_scores = jnp.take_along_axis(final_scores, order, axis=1)
+    return {"SentenceIds": toks.astype(jnp.int64),
+            "SentenceScores": final_scores}

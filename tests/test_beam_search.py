@@ -1,0 +1,211 @@
+"""Beam-search op tests.
+
+Reference pattern: unittests/test_beam_search_op.py /
+test_beam_search_decode_op.py (hand-built trellises) + the book
+machine-translation demo driving beam_search per decode step."""
+
+import numpy as np
+import pytest
+
+from op_test import run_op
+
+
+def test_beam_search_step_picks_global_topk():
+    # B=1, K=2 beams, V=4 vocab; accumulated candidate scores
+    pre_ids = np.array([[1, 2]], "int64")
+    pre_scores = np.array([[0.0, 0.0]], "float32")
+    scores = np.array([[[0.1, 0.9, 0.3, 0.2],
+                        [0.8, 0.05, 0.7, 0.1]]], "float32")
+    out = run_op("beam_search",
+                 {"pre_ids": pre_ids, "pre_scores": pre_scores,
+                  "scores": scores},
+                 {"beam_size": 2, "end_id": 0},
+                 outputs=("selected_ids", "selected_scores", "parent_idx"))
+    # global top2 of {0.9(b0,v1), 0.8(b1,v0), 0.7(b1,v2), ...}
+    np.testing.assert_array_equal(out["selected_ids"][0], [[1, 0]])
+    np.testing.assert_allclose(out["selected_scores"][0], [[0.9, 0.8]])
+    np.testing.assert_array_equal(out["parent_idx"][0], [[0, 1]])
+
+
+def test_beam_search_finished_beam_frozen():
+    """A beam whose pre_id == end_id contributes exactly itself with its
+    old score (beam_search_op.h ended-prefix rule)."""
+    end = 0
+    pre_ids = np.array([[end, 3]], "int64")        # beam0 finished
+    pre_scores = np.array([[5.0, 1.0]], "float32")
+    scores = np.full((1, 2, 4), 2.0, "float32")    # all candidates score 2
+    out = run_op("beam_search",
+                 {"pre_ids": pre_ids, "pre_scores": pre_scores,
+                  "scores": scores},
+                 {"beam_size": 2, "end_id": end},
+                 outputs=("selected_ids", "selected_scores", "parent_idx"))
+    # best = frozen beam0 (5.0), then any live candidate (2.0)
+    assert out["selected_ids"][0][0, 0] == end
+    np.testing.assert_allclose(out["selected_scores"][0][0],
+                               [5.0, 2.0])
+    assert out["parent_idx"][0][0, 0] == 0
+
+
+def test_beam_search_not_accumulated_takes_log():
+    pre_ids = np.array([[1, 2]], "int64")
+    pre_scores = np.array([[-1.0, -2.0]], "float32")
+    probs = np.array([[[0.5, 0.5], [0.9, 0.1]]], "float32")
+    out = run_op("beam_search",
+                 {"pre_ids": pre_ids, "pre_scores": pre_scores,
+                  "scores": probs},
+                 {"beam_size": 2, "end_id": 0, "is_accumulated": False},
+                 outputs=("selected_scores",))
+    acc = pre_scores[:, :, None] + np.log(probs)
+    want = np.sort(acc.reshape(1, -1))[:, ::-1][:, :2]
+    np.testing.assert_allclose(out["selected_scores"][0], want, rtol=1e-6)
+
+
+def test_gather_tree_backtracks():
+    """Hand trellis: T=3, B=1, K=2."""
+    ids = np.array([[[2, 2]], [[3, 4]], [[5, 6]]], "int64")       # [T,1,K]
+    parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], "int64")
+    out = run_op("gather_tree", {"Ids": ids, "Parents": parents})["Out"][0]
+    # final lane 0 path: t2 id 5 parent 1 -> t1 id 4 parent 0 -> t0 id 2
+    np.testing.assert_array_equal(out[:, 0, 0], [2, 4, 5])
+    # final lane 1 path: t2 id 6 parent 0 -> t1 id 3 parent 0 -> t0 id 2
+    np.testing.assert_array_equal(out[:, 0, 1], [2, 3, 6])
+
+
+def test_beam_search_decode_orders_and_pads():
+    end = 9
+    ids = np.array([[[2, 3]], [[end, 4]], [[end, end]]], "int64")
+    parents = np.array([[[0, 0]], [[0, 1]], [[0, 1]]], "int64")
+    scores = np.array([[[0.5, 0.4]], [[1.5, 0.9]], [[1.5, 2.5]]], "float32")
+    out = run_op("beam_search_decode",
+                 {"Ids": ids, "ParentIdx": parents, "Scores": scores},
+                 {"beam_size": 2, "end_id": end},
+                 outputs=("SentenceIds", "SentenceScores"))
+    sids, sscores = out["SentenceIds"][0], out["SentenceScores"][0]
+    # best-first: lane with final score 2.5 first
+    np.testing.assert_allclose(sscores[0], [2.5, 1.5])
+    # best path: t2 lane1 id=end parent 1 -> t1 id 4 parent 0 -> t0 id 3?
+    # backtrack: lane1@t2 (end, par 1) -> lane1@t1 (4, par 0)... wait
+    # lane1@t1 parent is parents[1,0,1]=1 -> t0 lane1 id 3
+    np.testing.assert_array_equal(sids[0, 0], [3, 4, end])
+    # runner-up: lane0@t2 end, parent 0 -> t1 end (parent 0) -> t0 id 2;
+    # tokens after the first end are padded to end
+    np.testing.assert_array_equal(sids[0, 1], [2, end, end])
+
+
+def test_machine_translation_style_decode_loop():
+    """Mini book/test_machine_translation.py: train a 1-layer GRU seq2seq
+    on a copy task, then decode step-by-step with the beam_search op and
+    assemble with beam_search_decode."""
+    import paddle_tpu as pt
+
+    rng = np.random.RandomState(5)
+    V, T, N, H = 12, 5, 64, 32
+    END = 0
+    src = rng.randint(2, V, (N, T)).astype("int64")
+    # target = source shifted (a copy task with <end> termination)
+    tgt_in = np.concatenate([np.full((N, 1), 1, "int64"), src[:, :-1]], 1)
+    tgt_out = src.copy()
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        s = pt.layers.data(name="s", shape=[T], dtype="int64")
+        ti = pt.layers.data(name="ti", shape=[T], dtype="int64")
+        to = pt.layers.data(name="to", shape=[T], dtype="int64")
+        semb = pt.layers.embedding(s, size=[V, H], param_attr=pt.ParamAttr(name="semb"))
+        _, enc_last = pt.layers.gru(semb, H, param_attr=pt.ParamAttr(name="encg"),
+                                      bias_attr=pt.ParamAttr(name="encb"))
+        temb = pt.layers.embedding(ti, size=[V, H], param_attr=pt.ParamAttr(name="temb"))
+        dec, _ = pt.layers.gru(temb, H, h0=enc_last,
+                               param_attr=pt.ParamAttr(name="decg"),
+                               bias_attr=pt.ParamAttr(name="decb"))
+        logits = pt.layers.fc(dec, size=V, num_flatten_dims=2,
+                              param_attr=pt.ParamAttr(name="proj_w"),
+                              bias_attr=pt.ParamAttr(name="proj_b"))
+        loss = pt.layers.mean(pt.layers.softmax_with_cross_entropy(
+            logits, pt.layers.unsqueeze(to, axes=[2])))
+        pt.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(
+            main, feed={"s": src, "ti": tgt_in, "to": tgt_out},
+            fetch_list=[loss])[0]).reshape(()))
+            for _ in range(150)]
+        assert losses[-1] < 0.3, (losses[0], losses[-1])
+
+        # ---- step-by-step beam decode program ----
+        K = 3
+        step_prog = pt.Program()
+        with pt.framework.unique_name.guard(), \
+                pt.program_guard(step_prog, pt.Program()):
+            s2 = pt.layers.data(name="s", shape=[T], dtype="int64")
+            h_in = pt.layers.data(name="h", shape=[K, H], dtype="float32")
+            pid = pt.layers.data(name="pid", shape=[K], dtype="int64")
+            psc = pt.layers.data(name="psc", shape=[K], dtype="float32")
+            semb2 = pt.layers.embedding(s2, size=[V, H],
+                                        param_attr=pt.ParamAttr(name="semb"))
+            _, enc2 = pt.layers.gru(semb2, H, param_attr=pt.ParamAttr(name="encg"),
+                                         bias_attr=pt.ParamAttr(name="encb"))
+            # decoder one step for each beam: input pid [B,K]
+            pemb = pt.layers.embedding(pt.layers.unsqueeze(pid, axes=[2]),
+                                       size=[V, H],
+                                       param_attr=pt.ParamAttr(name="temb"))
+            pemb = pt.layers.reshape(pemb, [-1, 1, H])     # [B*K, 1, H]
+            hr = pt.layers.reshape(h_in, [-1, H])
+            dec2, h_out = pt.layers.gru(pemb, H, h0=hr,
+                                        param_attr=pt.ParamAttr(name="decg"),
+                                        bias_attr=pt.ParamAttr(name="decb"))
+            logits2 = pt.layers.fc(pt.layers.reshape(dec2, [-1, H]), size=V,
+                                   param_attr=pt.ParamAttr(name="proj_w"),
+                                   bias_attr=pt.ParamAttr(name="proj_b"))
+            probs = pt.layers.softmax(logits2)             # [B*K, V]
+            probs = pt.layers.reshape(probs, [-1, K, V])
+            sel, sc, par = pt.layers.beam_search(
+                pid, psc, None, probs, beam_size=K, end_id=END,
+                is_accumulated=False, return_parent_idx=True)
+            h_new = pt.layers.reshape(h_out, [-1, K, H])
+        # encoder program: the decode loop starts from the encoder state
+        enc_prog = pt.Program()
+        with pt.framework.unique_name.guard(), \
+                pt.program_guard(enc_prog, pt.Program()):
+            s3 = pt.layers.data(name="s", shape=[T], dtype="int64")
+            semb3 = pt.layers.embedding(s3, size=[V, H],
+                                        param_attr=pt.ParamAttr(name="semb"))
+            _, enc3 = pt.layers.gru(semb3, H,
+                                    param_attr=pt.ParamAttr(name="encg"),
+                                    bias_attr=pt.ParamAttr(name="encb"))
+
+        B = 4
+        srcb = src[:B]
+        enc_state = np.asarray(exe.run(enc_prog, feed={"s": srcb},
+                                       fetch_list=[enc3])[0])
+        pre_ids = np.full((B, K), 1, "int64")
+        pre_sc = np.full((B, K), 0.0, "float32")
+        pre_sc[:, 1:] = -1e9                     # only beam 0 live at t0
+        h = np.tile(enc_state[:, None, :], (1, K, 1)).astype("float32")
+        step_ids, step_par, step_sc = [], [], []
+        for t in range(T):
+            sel_v, sc_v, par_v, h_v = exe.run(
+                step_prog,
+                feed={"s": srcb, "h": h, "pid": pre_ids, "psc": pre_sc},
+                fetch_list=[sel, sc, par, h_new])
+            sel_v = np.asarray(sel_v)
+            par_v = np.asarray(par_v)
+            sc_v = np.asarray(sc_v)
+            h_v = np.asarray(h_v)
+            # regroup decoder state by parent beam
+            h = np.take_along_axis(h_v, par_v[:, :, None].astype(int), 1)
+            pre_ids, pre_sc = sel_v, sc_v
+            step_ids.append(sel_v)
+            step_par.append(par_v)
+            step_sc.append(sc_v)
+        out = run_op("beam_search_decode",
+                     {"Ids": np.stack(step_ids),
+                      "ParentIdx": np.stack(step_par),
+                      "Scores": np.stack(step_sc)},
+                     {"beam_size": K, "end_id": END},
+                     outputs=("SentenceIds", "SentenceScores"))
+        best = out["SentenceIds"][0][:, 0, :]     # [B, T]
+        acc = (best == srcb).mean()
+        assert acc > 0.8, (acc, best[:2], srcb[:2])

@@ -219,15 +219,55 @@ def test_sampled_softmax_num_true_2():
     assert out["Loss"][0].shape == (n, 1)
 
 
-def test_sampled_softmax_trains_to_match_full_softmax_ranking():
+def test_sampled_softmax_basic_contract_and_correction():
     rng = np.random.RandomState(11)
-    n, c = 8, 50
+    n, c, s = 8, 50, 10
     logits = rng.randn(n, c).astype("float32") * 0.1
     label = rng.randint(0, c, (n, 1)).astype("int64")
     out = run_op("sampled_softmax_with_cross_entropy",
                  {"Logits": logits, "Label": label},
-                 {"num_samples": 10}, outputs=("Loss", "Samples"),
-                 rng_seed=3)
+                 {"num_samples": s},
+                 outputs=("Loss", "Samples", "SampledLogits"), rng_seed=3)
     assert out["Loss"][0].shape == (n, 1)
     assert (out["Loss"][0] > 0).all()
-    np.testing.assert_array_equal(out["Samples"][0][:, 0], label[:, 0])
+    samples = out["Samples"][0]
+    np.testing.assert_array_equal(samples[:, 0], label[:, 0])
+    # the log-uniform expected-count correction must be applied exactly:
+    # sub = logits[samples] - log(P(samples) * S) wherever not hit-masked
+    p = np.log((samples + 2.0) / (samples + 1.0)) / np.log(c + 1.0)
+    want = np.take_along_axis(logits, samples, 1) - np.log(p * s + 1e-12)
+    slog = out["SampledLogits"][0]
+    unmasked = slog > -1e19
+    np.testing.assert_allclose(slog[unmasked],
+                               want.astype("float32")[unmasked], rtol=1e-5)
+
+
+def test_sampled_softmax_training_matches_full_softmax_argmax():
+    """Train a linear classifier with the sampled loss; its argmax
+    predictions must recover the labels (agreeing with what full softmax
+    training would learn on this separable toy problem)."""
+    import paddle_tpu as pt
+
+    rng = np.random.RandomState(13)
+    n, d, c = 64, 16, 24
+    x_np = rng.randn(n, d).astype("float32")
+    y_np = rng.randint(0, c, (n, 1)).astype("int64")
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[d], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="int64")
+        logits = pt.layers.fc(x, size=c)
+        cost = pt.layers.sampled_softmax_with_cross_entropy(
+            logits, y, num_samples=8)
+        loss = pt.layers.mean(cost)
+        pt.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for _ in range(150):
+            exe.run(main, feed={"x": x_np, "y": y_np}, fetch_list=[loss])
+        lg = exe.run(main, feed={"x": x_np, "y": y_np},
+                     fetch_list=[logits])[0]
+        acc = (np.asarray(lg).argmax(1) == y_np[:, 0]).mean()
+        assert acc > 0.9, acc
