@@ -5,7 +5,13 @@ from __future__ import annotations
 from ..layer_helper import LayerHelper
 
 __all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box", "roi_align",
-           "box_clip"]
+           "box_clip", "anchor_generator", "density_prior_box",
+           "bipartite_match", "target_assign", "mine_hard_examples",
+           "sigmoid_focal_loss", "multiclass_nms", "generate_proposals",
+           "roi_pool", "psroi_pool", "polygon_box_transform",
+           "box_decoder_and_assign", "collect_fpn_proposals",
+           "distribute_fpn_proposals", "rpn_target_assign",
+           "retinanet_detection_output", "yolov3_loss"]
 
 
 def iou_similarity(x, y, name=None):
@@ -81,3 +87,269 @@ def box_clip(input, im_info, name=None):
     helper.append_op(type="box_clip", inputs={"Input": input, "ImInfo": im_info},
                      outputs={"Output": out})
     return out
+
+
+def anchor_generator(input, anchor_sizes, aspect_ratios, variance=(0.1, 0.1, 0.2, 0.2),
+                     stride=None, offset=0.5, name=None):
+    helper = LayerHelper("anchor_generator", name=name)
+    anchors = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="anchor_generator", inputs={"Input": input},
+                     outputs={"Anchors": anchors, "Variances": var},
+                     attrs={"anchor_sizes": list(anchor_sizes),
+                            "aspect_ratios": list(aspect_ratios),
+                            "variances": list(variance),
+                            "stride": list(stride or [16.0, 16.0]),
+                            "offset": offset})
+    return anchors, var
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, name=None):
+    helper = LayerHelper("density_prior_box", name=name)
+    boxes = helper.create_variable_for_type_inference(input.dtype)
+    var = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="density_prior_box",
+                     inputs={"Input": input, "Image": image},
+                     outputs={"Boxes": boxes, "Variances": var},
+                     attrs={"densities": list(densities),
+                            "fixed_sizes": list(fixed_sizes),
+                            "fixed_ratios": list(fixed_ratios),
+                            "variances": list(variance), "clip": clip,
+                            "step_w": steps[0], "step_h": steps[1],
+                            "offset": offset})
+    return boxes, var
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    helper = LayerHelper("bipartite_match", name=name)
+    idx = helper.create_variable_for_type_inference("int32")
+    dist = helper.create_variable_for_type_inference(dist_matrix.dtype)
+    helper.append_op(type="bipartite_match", inputs={"DistMat": dist_matrix},
+                     outputs={"ColToRowMatchIndices": idx,
+                              "ColToRowMatchDist": dist},
+                     attrs={"match_type": match_type,
+                            "dist_threshold": dist_threshold})
+    return idx, dist
+
+
+def target_assign(input, matched_indices, negative_flag=None,
+                  mismatch_value=0, name=None):
+    helper = LayerHelper("target_assign", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    wt = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": input, "MatchIndices": matched_indices}
+    if negative_flag is not None:
+        inputs["NegFlag"] = negative_flag
+    helper.append_op(type="target_assign", inputs=inputs,
+                     outputs={"Out": out, "OutWeight": wt},
+                     attrs={"mismatch_value": mismatch_value})
+    return out, wt
+
+
+def mine_hard_examples(cls_loss, match_indices, loc_loss=None,
+                       neg_pos_ratio=3.0, neg_overlap=0.5,
+                       mining_type="max_negative", name=None):
+    helper = LayerHelper("mine_hard_examples", name=name)
+    neg = helper.create_variable_for_type_inference("int32")
+    upd = helper.create_variable_for_type_inference("int32")
+    inputs = {"ClsLoss": cls_loss, "MatchIndices": match_indices}
+    if loc_loss is not None:
+        inputs["LocLoss"] = loc_loss
+    helper.append_op(type="mine_hard_examples", inputs=inputs,
+                     outputs={"NegFlag": neg, "UpdatedMatchIndices": upd},
+                     attrs={"neg_pos_ratio": neg_pos_ratio,
+                            "neg_dist_threshold": neg_overlap,
+                            "mining_type": mining_type})
+    return neg, upd
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25, name=None):
+    helper = LayerHelper("sigmoid_focal_loss", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sigmoid_focal_loss",
+                     inputs={"X": x, "Label": label, "FgNum": fg_num},
+                     outputs={"Out": out},
+                     attrs={"gamma": gamma, "alpha": alpha})
+    return out
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, background_label=0,
+                   name=None):
+    helper = LayerHelper("multiclass_nms", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="multiclass_nms",
+                     inputs={"BBoxes": bboxes, "Scores": scores},
+                     outputs={"Out": out, "NmsRoisNum": num},
+                     attrs={"score_threshold": score_threshold,
+                            "nms_top_k": nms_top_k,
+                            "keep_top_k": keep_top_k,
+                            "nms_threshold": nms_threshold,
+                            "normalized": normalized,
+                            "background_label": background_label})
+    return out, num
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, name=None):
+    helper = LayerHelper("generate_proposals", name=name)
+    rois = helper.create_variable_for_type_inference(scores.dtype)
+    probs = helper.create_variable_for_type_inference(scores.dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="generate_proposals",
+                     inputs={"Scores": scores, "BboxDeltas": bbox_deltas,
+                             "ImInfo": im_info, "Anchors": anchors,
+                             "Variances": variances},
+                     outputs={"RpnRois": rois, "RpnRoiProbs": probs,
+                              "RpnRoisNum": num},
+                     attrs={"pre_nms_topN": pre_nms_top_n,
+                            "post_nms_topN": post_nms_top_n,
+                            "nms_thresh": nms_thresh, "min_size": min_size})
+    return rois, probs, num
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
+             name=None):
+    helper = LayerHelper("roi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="roi_pool", inputs={"X": input, "ROIs": rois},
+                     outputs={"Out": out},
+                     attrs={"pooled_height": pooled_height,
+                            "pooled_width": pooled_width,
+                            "spatial_scale": spatial_scale})
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    helper = LayerHelper("psroi_pool", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="psroi_pool", inputs={"X": input, "ROIs": rois},
+                     outputs={"Out": out},
+                     attrs={"output_channels": output_channels,
+                            "spatial_scale": spatial_scale,
+                            "pooled_height": pooled_height,
+                            "pooled_width": pooled_width})
+    return out
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="polygon_box_transform", inputs={"Input": input},
+                     outputs={"Output": out})
+    return out
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=None, name=None):
+    helper = LayerHelper("box_decoder_and_assign", name=name)
+    decode = helper.create_variable_for_type_inference(target_box.dtype)
+    assign = helper.create_variable_for_type_inference(target_box.dtype)
+    helper.append_op(type="box_decoder_and_assign",
+                     inputs={"PriorBox": prior_box,
+                             "PriorBoxVar": prior_box_var,
+                             "TargetBox": target_box, "BoxScore": box_score},
+                     outputs={"DecodeBox": decode,
+                              "OutputAssignBox": assign})
+    return decode, assign
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    helper = LayerHelper("collect_fpn_proposals", name=name)
+    out = helper.create_variable_for_type_inference(multi_rois[0].dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="collect_fpn_proposals",
+                     inputs={"MultiLevelRois": multi_rois,
+                             "MultiLevelScores": multi_scores},
+                     outputs={"FpnRois": out, "RoisNum": num},
+                     attrs={"post_nms_topN": post_nms_top_n})
+    return out
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    helper = LayerHelper("distribute_fpn_proposals", name=name)
+    n_lvl = max_level - min_level + 1
+    rois = [helper.create_variable_for_type_inference(fpn_rois.dtype)
+            for _ in range(n_lvl)]
+    masks = [helper.create_variable_for_type_inference("int32")
+             for _ in range(n_lvl)]
+    restore = helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="distribute_fpn_proposals",
+                     inputs={"FpnRois": fpn_rois},
+                     outputs={"MultiFpnRois": rois,
+                              "MultiLevelMask": masks,
+                              "RestoreIndex": restore},
+                     attrs={"min_level": min_level, "max_level": max_level,
+                            "refer_level": refer_level,
+                            "refer_scale": refer_scale})
+    return rois, restore
+
+
+def rpn_target_assign(anchor, gt_boxes, rpn_batch_size_per_im=256,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True, name=None):
+    helper = LayerHelper("rpn_target_assign", name=name)
+    loc = helper.create_variable_for_type_inference("int32")
+    score = helper.create_variable_for_type_inference("int32")
+    tbox = helper.create_variable_for_type_inference(anchor.dtype)
+    tlabel = helper.create_variable_for_type_inference("int32")
+    bw = helper.create_variable_for_type_inference(anchor.dtype)
+    helper.append_op(type="rpn_target_assign",
+                     inputs={"Anchor": anchor, "GtBoxes": gt_boxes},
+                     outputs={"LocationIndex": loc, "ScoreIndex": score,
+                              "TargetBBox": tbox, "TargetLabel": tlabel,
+                              "BBoxInsideWeight": bw},
+                     attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+                            "rpn_fg_fraction": rpn_fg_fraction,
+                            "rpn_positive_overlap": rpn_positive_overlap,
+                            "rpn_negative_overlap": rpn_negative_overlap,
+                            "use_random": use_random})
+    return loc, score, tbox, tlabel, bw
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0, name=None):
+    helper = LayerHelper("retinanet_detection_output", name=name)
+    out = helper.create_variable_for_type_inference(bboxes[0].dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="retinanet_detection_output",
+                     inputs={"BBoxes": bboxes, "Scores": scores,
+                             "Anchors": anchors, "ImInfo": im_info},
+                     outputs={"Out": out, "NmsRoisNum": num},
+                     attrs={"score_threshold": score_threshold,
+                            "nms_top_k": nms_top_k,
+                            "keep_top_k": keep_top_k,
+                            "nms_threshold": nms_threshold})
+    return out, num
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    helper = LayerHelper("yolov3_loss", name=name)
+    loss = helper.create_variable_for_type_inference(x.dtype)
+    objm = helper.create_variable_for_type_inference(x.dtype)
+    gtm = helper.create_variable_for_type_inference("int32")
+    inputs = {"X": x, "GTBox": gt_box, "GTLabel": gt_label}
+    if gt_score is not None:
+        inputs["GTScore"] = gt_score
+    helper.append_op(type="yolov3_loss", inputs=inputs,
+                     outputs={"Loss": loss, "ObjectnessMask": objm,
+                              "GTMatchMask": gtm},
+                     attrs={"anchors": list(anchors),
+                            "anchor_mask": list(anchor_mask),
+                            "class_num": class_num,
+                            "ignore_thresh": ignore_thresh,
+                            "downsample_ratio": downsample_ratio,
+                            "use_label_smooth": use_label_smooth})
+    return loss

@@ -1,12 +1,23 @@
 """Detection ops (reference: paddle/fluid/operators/detection/ — 28 ops).
 
-Round-1 coverage: the geometry ops (box_coder, prior_box, iou_similarity,
-yolo_box); NMS-family ops need sorted dynamic shapes and follow in a later
-round as masked fixed-size variants.
+Coverage: geometry (box_coder, prior_box, density_prior_box,
+anchor_generator, iou_similarity, box_clip, polygon_box_transform,
+box_decoder_and_assign), matching/assignment (bipartite_match,
+target_assign, mine_hard_examples, rpn_target_assign), losses
+(sigmoid_focal_loss, yolov3_loss), ROI pooling (roi_align, roi_pool,
+psroi_pool), and the NMS family (multiclass_nms, generate_proposals,
+retinanet_detection_output, collect/distribute_fpn_proposals, yolo_box).
+
+TPU-native design note: the reference's NMS/proposal ops emit LoD tensors
+with per-image dynamic counts; XLA needs static shapes, so these ops emit
+fixed-capacity outputs padded with -1 labels / zero rows plus an explicit
+count (NmsRoisNum/RoisNum), and NMS itself is a fixed-length argmax-and-
+suppress scan (_nms_static) — identical selection order to NMSFast.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -175,11 +186,19 @@ def roi_align(ins, attrs, ctx):
         x1i = jnp.clip(x0 + 1, 0, w - 1)
         wy = ys - jnp.floor(ys)
         wx = xs - jnp.floor(xs)
-        # feat: [C, ph*ratio, pw*ratio] bilinear
-        f = (x[0, :, y0][:, :, x0] * ((1 - wy)[None, :, None] * (1 - wx)[None, None, :])
-             + x[0, :, y1i][:, :, x0] * (wy[None, :, None] * (1 - wx)[None, None, :])
-             + x[0, :, y0][:, :, x1i] * ((1 - wy)[None, :, None] * wx[None, None, :])
-             + x[0, :, y1i][:, :, x1i] * (wy[None, :, None] * wx[None, None, :]))
+        # feat: [C, ph*ratio, pw*ratio] bilinear. Index in two steps —
+        # x[0, :, y0] would put the advanced-index axis FIRST (scalar and
+        # array indices separated by a slice), silently mis-broadcasting
+        # for C > 1.
+        xc = x[0]                                     # [C, H, W]
+        f00 = xc[:, y0][:, :, x0]
+        f10 = xc[:, y1i][:, :, x0]
+        f01 = xc[:, y0][:, :, x1i]
+        f11 = xc[:, y1i][:, :, x1i]
+        f = (f00 * ((1 - wy)[None, :, None] * (1 - wx)[None, None, :])
+             + f10 * (wy[None, :, None] * (1 - wx)[None, None, :])
+             + f01 * ((1 - wy)[None, :, None] * wx[None, None, :])
+             + f11 * (wy[None, :, None] * wx[None, None, :]))
         return jnp.mean(f.reshape(c, ph, ratio, pw, ratio), axis=(2, 4))
 
     out = jax.vmap(one_roi)(rois)
@@ -194,3 +213,808 @@ def box_clip(ins, attrs, ctx):
     return {"Output": jnp.stack([
         jnp.clip(boxes[..., 0], 0, w), jnp.clip(boxes[..., 1], 0, h),
         jnp.clip(boxes[..., 2], 0, w), jnp.clip(boxes[..., 3], 0, h)], axis=-1)}
+
+
+# ---------------------------------------------------------------------------
+# Shared geometry helpers
+# ---------------------------------------------------------------------------
+
+
+def _box_area(b, normalized=True):
+    one = 0.0 if normalized else 1.0
+    return (b[..., 2] - b[..., 0] + one) * (b[..., 3] - b[..., 1] + one)
+
+
+def _pairwise_iou(a, b, normalized=True):
+    """IoU matrix [.., M, N] of boxes a [.., M, 4] and b [.., N, 4]."""
+    lt = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    rb = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    one = 0.0 if normalized else 1.0
+    wh = jnp.maximum(rb - lt + one, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = (_box_area(a, normalized)[..., :, None] +
+             _box_area(b, normalized)[..., None, :] - inter)
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def _nms_static(boxes, scores, iou_threshold, max_out, normalized=True,
+                score_threshold=None):
+    """Static-shape greedy NMS: returns (indices [max_out] int32, padded
+    with -1, and selected scores). The reference's NMSFast prunes a
+    dynamically sized list; here a fixed-length scan picks argmax and
+    suppresses per step — identical selection order, XLA-compilable."""
+    if score_threshold is not None:
+        scores = jnp.where(scores > score_threshold, scores, -jnp.inf)
+
+    def step(masked_scores, _):
+        i = jnp.argmax(masked_scores)
+        valid = masked_scores[i] > -jnp.inf
+        iou = _pairwise_iou(boxes[i][None], boxes, normalized)[0]
+        suppress = (iou > iou_threshold) | \
+            (jnp.arange(boxes.shape[0]) == i)
+        new_scores = jnp.where(suppress, -jnp.inf, masked_scores)
+        return new_scores, (jnp.where(valid, i, -1).astype(jnp.int32),
+                            jnp.where(valid, masked_scores[i], -jnp.inf))
+
+    _, (idx, sel_scores) = jax.lax.scan(step, scores, None, length=max_out)
+    return idx, sel_scores
+
+
+# ---------------------------------------------------------------------------
+# Losses / assignment / anchors
+# ---------------------------------------------------------------------------
+
+
+@register_op("sigmoid_focal_loss", nondiff_inputs=("Label", "FgNum"))
+def sigmoid_focal_loss(ins, attrs, ctx):
+    """reference: detection/sigmoid_focal_loss_op.cc — per-element focal
+    loss; Label holds the 1-based foreground class (0 = background), class
+    j of X corresponds to label j+1; normalized by FgNum."""
+    x = ins["X"][0]                       # [N, C]
+    label = ins["Label"][0].reshape(-1)   # [N]
+    fg = ins["FgNum"][0].reshape(()).astype(x.dtype)
+    gamma = float(attrs.get("gamma", 2.0))
+    alpha = float(attrs.get("alpha", 0.25))
+    n, c = x.shape
+    t = (label[:, None] == jnp.arange(1, c + 1)[None, :]).astype(x.dtype)
+    p = jax.nn.sigmoid(x)
+    # stable log σ / log(1-σ)
+    logp = jax.nn.log_sigmoid(x)
+    log1mp = jax.nn.log_sigmoid(-x)
+    loss = -(t * alpha * (1 - p) ** gamma * logp +
+             (1 - t) * (1 - alpha) * p ** gamma * log1mp)
+    return {"Out": loss / jnp.maximum(fg, 1.0)}
+
+
+@register_op("anchor_generator", grad=None)
+def anchor_generator(ins, attrs, ctx):
+    """reference: detection/anchor_generator_op.h:55-85 (exact rounding
+    behavior of base_w/base_h preserved)."""
+    x = ins["Input"][0]                  # [N, C, H, W]
+    sizes = [float(s) for s in attrs["anchor_sizes"]]
+    ratios = [float(r) for r in attrs["aspect_ratios"]]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(s) for s in attrs["stride"]]
+    offset = float(attrs.get("offset", 0.5))
+    h, w = x.shape[2], x.shape[3]
+    sw, sh = stride[0], stride[1]
+
+    anchors = []
+    for ar in ratios:
+        for size in sizes:
+            area = sw * sh
+            base_w = np.round(np.sqrt(area / ar))
+            base_h = np.round(base_w * ar)
+            anchor_w = (size / sw) * base_w
+            anchor_h = (size / sh) * base_h
+            anchors.append((anchor_w, anchor_h))
+    aw = jnp.asarray([a[0] for a in anchors])
+    ah = jnp.asarray([a[1] for a in anchors])
+    x_ctr = jnp.arange(w) * sw + offset * (sw - 1)
+    y_ctr = jnp.arange(h) * sh + offset * (sh - 1)
+    xc = x_ctr[None, :, None]
+    yc = y_ctr[:, None, None]
+    out = jnp.stack(
+        jnp.broadcast_arrays(xc - 0.5 * (aw - 1), yc - 0.5 * (ah - 1),
+                             xc + 0.5 * (aw - 1), yc + 0.5 * (ah - 1)),
+        axis=-1)                          # [H, W, A, 4]
+    var = jnp.broadcast_to(jnp.asarray(variances), out.shape)
+    return {"Anchors": out, "Variances": var}
+
+
+@register_op("density_prior_box", grad=None)
+def density_prior_box(ins, attrs, ctx):
+    """reference: detection/density_prior_box_op.cc — dense anchor grid
+    per (fixed_size, density) with uniform sub-cell shifts."""
+    x = ins["Input"][0]
+    image = ins["Image"][0]
+    fixed_sizes = [float(s) for s in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(r) for r in attrs.get("fixed_ratios", [1.0])]
+    densities = [int(d) for d in attrs.get("densities", [1])]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    offset = float(attrs.get("offset", 0.5))
+    clip = bool(attrs.get("clip", False))
+    h, w = x.shape[2], x.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    step_w = attrs.get("step_w", 0.0) or img_w / w
+    step_h = attrs.get("step_h", 0.0) or img_h / h
+
+    boxes = []
+    for k, (size, density) in enumerate(zip(fixed_sizes, densities)):
+        for ratio in fixed_ratios:
+            bw = size * np.sqrt(ratio)
+            bh = size / np.sqrt(ratio)
+            shift = size / density
+            for di in range(density):
+                for dj in range(density):
+                    cx_off = -size / 2.0 + shift / 2.0 + dj * shift
+                    cy_off = -size / 2.0 + shift / 2.0 + di * shift
+                    boxes.append((cx_off, cy_off, bw, bh))
+    cx = (jnp.arange(w) + offset) * step_w
+    cy = (jnp.arange(h) + offset) * step_h
+    cxg = cx[None, :, None]
+    cyg = cy[:, None, None]
+    offs = jnp.asarray(boxes)             # [A, 4]
+    ax = cxg + offs[:, 0]
+    ay = cyg + offs[:, 1]
+    bw = offs[:, 2]
+    bh = offs[:, 3]
+    out = jnp.stack(jnp.broadcast_arrays(
+        (ax - bw / 2.0) / img_w, (ay - bh / 2.0) / img_h,
+        (ax + bw / 2.0) / img_w, (ay + bh / 2.0) / img_h), axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), out.shape)
+    return {"Boxes": out, "Variances": var}
+
+
+@register_op("bipartite_match", grad=None)
+def bipartite_match(ins, attrs, ctx):
+    """reference: detection/bipartite_match_op.cc — greedy global-max
+    matching (columns→rows), then optional per_prediction argmax fill for
+    unmatched columns above dist_threshold. DistMat [N, R, C] batched
+    (replaces the LoD convention)."""
+    dist = ins["DistMat"][0]
+    if dist.ndim == 2:
+        dist = dist[None]
+    b, r, c = dist.shape
+    match_type = attrs.get("match_type", "bipartite")
+    thresh = float(attrs.get("dist_threshold", 0.5))
+
+    def one(d):
+        def step(carry, _):
+            dm, midx, mdist, row_used = carry
+            flat = jnp.argmax(dm)
+            i, j = flat // c, flat % c
+            ok = dm[i, j] > 0
+            midx = jnp.where(ok, midx.at[j].set(i.astype(jnp.int32)), midx)
+            mdist = jnp.where(ok, mdist.at[j].set(dm[i, j]), mdist)
+            row_used = jnp.where(ok, row_used.at[i].set(True), row_used)
+            dm = jnp.where(ok, dm.at[i, :].set(-1.0).at[:, j].set(-1.0), dm)
+            return (dm, midx, mdist, row_used), None
+
+        init = (d, jnp.full((c,), -1, jnp.int32), jnp.zeros((c,), d.dtype),
+                jnp.zeros((r,), bool))
+        (dm, midx, mdist, row_used), _ = jax.lax.scan(
+            step, init, None, length=min(r, c))
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(d, axis=0).astype(jnp.int32)
+            best = jnp.max(d, axis=0)
+            fill = (midx < 0) & (best > thresh)
+            midx = jnp.where(fill, best_row, midx)
+            mdist = jnp.where(fill, best, mdist)
+        return midx, mdist
+
+    midx, mdist = jax.vmap(one)(dist)
+    return {"ColToRowMatchIndices": midx, "ColToRowMatchDist": mdist}
+
+
+@register_op("target_assign", grad=None)
+def target_assign(ins, attrs, ctx):
+    """reference: detection/target_assign_op.cc — out[i,j] =
+    X[i, match[i,j]] where matched, else mismatch_value; weight 1 on
+    matched (and negative-flagged) columns. X is [N, M, K] batched;
+    NegFlag [N, P] replaces the reference's LoD NegIndices."""
+    x = ins["X"][0]
+    match = ins["MatchIndices"][0]
+    mismatch = attrs.get("mismatch_value", 0)
+    if x.ndim == 2:
+        x = x[None]
+    idx = jnp.maximum(match, 0)
+    out = jnp.take_along_axis(x, idx[:, :, None].astype(jnp.int32), axis=1)
+    matched = (match >= 0)[:, :, None]
+    out = jnp.where(matched, out, jnp.asarray(mismatch, x.dtype))
+    wt = matched.astype(x.dtype)
+    if ins.get("NegFlag") and ins["NegFlag"][0] is not None:
+        wt = jnp.maximum(wt, ins["NegFlag"][0][:, :, None].astype(x.dtype))
+    return {"Out": out, "OutWeight": wt}
+
+
+@register_op("mine_hard_examples", grad=None)
+def mine_hard_examples(ins, attrs, ctx):
+    """reference: detection/mine_hard_examples_op.cc — online hard negative
+    mining: among unmatched priors, flag the neg_pos_ratio*num_pos highest-
+    loss ones as negatives. Outputs NegFlag [N, P] (static stand-in for the
+    LoD NegIndices) + UpdatedMatchIndices."""
+    cls_loss = ins["ClsLoss"][0]
+    match = ins["MatchIndices"][0]
+    loss = cls_loss.reshape(match.shape)
+    if ins.get("LocLoss") and ins["LocLoss"][0] is not None and \
+            attrs.get("mining_type", "max_negative") == "hard_example":
+        loss = loss + ins["LocLoss"][0].reshape(match.shape)
+    neg_pos_ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    neg_overlap = float(attrs.get("neg_dist_threshold", 0.5))
+    del neg_overlap  # overlap filtering happens upstream via MatchDist
+    n, p = match.shape
+    is_neg_cand = match < 0
+    num_pos = jnp.sum(match >= 0, axis=1)
+    num_neg = jnp.minimum((num_pos * neg_pos_ratio).astype(jnp.int32),
+                          jnp.sum(is_neg_cand, axis=1))
+    cand_loss = jnp.where(is_neg_cand, loss, -jnp.inf)
+    order = jnp.argsort(-cand_loss, axis=1)
+    rank = jnp.argsort(order, axis=1)      # rank of each column by loss
+    neg_flag = (rank < num_neg[:, None]) & is_neg_cand
+    return {"NegFlag": neg_flag.astype(jnp.int32),
+            "UpdatedMatchIndices": match}
+
+
+# ---------------------------------------------------------------------------
+# Pooling / geometry transforms
+# ---------------------------------------------------------------------------
+
+
+@register_op("roi_pool")
+def roi_pool(ins, attrs, ctx):
+    """reference: roi_pool_op.cc — max pooling over quantized ROI bins."""
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+
+    def one_roi(roi):
+        x1 = jnp.round(roi[0] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        # bin edges per pooled cell (quantized, reference semantics)
+        py = jnp.arange(ph)
+        px = jnp.arange(pw)
+        hs = y1 + jnp.floor(py * rh / ph).astype(jnp.int32)
+        he = y1 + jnp.ceil((py + 1) * rh / ph).astype(jnp.int32)
+        ws = x1 + jnp.floor(px * rw / pw).astype(jnp.int32)
+        we = x1 + jnp.ceil((px + 1) * rw / pw).astype(jnp.int32)
+        yy = jnp.arange(h)[None, :]
+        xx = jnp.arange(w)[None, :]
+        ymask = (yy >= hs[:, None]) & (yy < he[:, None]) & \
+            (yy >= 0) & (yy < h)                       # [ph, H]
+        xmask = (xx >= ws[:, None]) & (xx < we[:, None]) & \
+            (xx >= 0) & (xx < w)                       # [pw, W]
+        m = ymask[:, None, :, None] & xmask[None, :, None, :]  # [ph,pw,H,W]
+        neg = jnp.asarray(-jnp.inf, x.dtype)
+        vals = jnp.where(m[None], x[0][:, None, None, :, :], neg)
+        out = jnp.max(vals, axis=(-2, -1))             # [C, ph, pw]
+        empty = ~jnp.any(m, axis=(-2, -1))
+        return jnp.where(empty[None], 0.0, out)
+
+    return {"Out": jax.vmap(one_roi)(rois)}
+
+
+@register_op("psroi_pool")
+def psroi_pool(ins, attrs, ctx):
+    """reference: detection/psroi_pool_op.cc — position-sensitive average
+    ROI pooling: output channel d at bin (i,j) averages input channel
+    d*ph*pw + i*pw + j over that bin."""
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    out_c = int(attrs["output_channels"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+
+    def one_roi(roi):
+        x1 = jnp.round(roi[0]) * scale
+        y1 = jnp.round(roi[1]) * scale
+        x2 = jnp.round(roi[2] + 1.0) * scale
+        y2 = jnp.round(roi[3] + 1.0) * scale
+        rh = jnp.maximum(y2 - y1, 0.1)
+        rw = jnp.maximum(x2 - x1, 0.1)
+        bh, bw_ = rh / ph, rw / pw
+        py = jnp.arange(ph)
+        px = jnp.arange(pw)
+        hs = jnp.floor(y1 + py * bh).astype(jnp.int32)
+        he = jnp.ceil(y1 + (py + 1) * bh).astype(jnp.int32)
+        ws = jnp.floor(x1 + px * bw_).astype(jnp.int32)
+        we = jnp.ceil(x1 + (px + 1) * bw_).astype(jnp.int32)
+        yy = jnp.arange(h)[None, :]
+        xx = jnp.arange(w)[None, :]
+        ymask = (yy >= jnp.clip(hs, 0, h)[:, None]) & \
+            (yy < jnp.clip(he, 0, h)[:, None])
+        xmask = (xx >= jnp.clip(ws, 0, w)[:, None]) & \
+            (xx < jnp.clip(we, 0, w)[:, None])
+        m = (ymask[:, None, :, None] & xmask[None, :, None, :]).astype(
+            x.dtype)                                     # [ph,pw,H,W]
+        # channel layout: input channel for (d, i, j) = d*ph*pw + i*pw + j
+        xc = x[0].reshape(out_c, ph * pw, h, w)
+        grid = xc.reshape(out_c, ph, pw, h, w)
+        s = jnp.sum(grid * m[None], axis=(-2, -1))
+        cnt = jnp.sum(m, axis=(-2, -1))
+        return s / jnp.maximum(cnt, 1.0)[None]
+
+    return {"Out": jax.vmap(one_roi)(rois)}
+
+
+@register_op("polygon_box_transform", grad=None)
+def polygon_box_transform(ins, attrs, ctx):
+    """reference: detection/polygon_box_transform_op.cc — for OCR EAST:
+    output(id_plane, h, w) = 4*w_coord ± input offset: even planes are x
+    offsets (x = 4*w - in), odd are y (y = 4*h - in)."""
+    x = ins["Input"][0]                  # [N, geo_channels, H, W]
+    n, c, h, w = x.shape
+    wg = jnp.arange(w, dtype=x.dtype)[None, :]
+    hg = jnp.arange(h, dtype=x.dtype)[:, None]
+    even = jnp.arange(c) % 2 == 0
+    base = jnp.where(even[:, None, None], 4 * wg[None], 4 * hg[None])
+    return {"Output": base[None] - x}
+
+
+@register_op("box_decoder_and_assign", grad=None)
+def box_decoder_and_assign(ins, attrs, ctx):
+    """reference: detection/box_decoder_and_assign_op.cc — decode per-class
+    deltas against prior boxes, then pick each ROI's best-scoring class
+    box."""
+    prior = ins["PriorBox"][0]            # [R, 4]
+    pv = ins["PriorBoxVar"][0]            # [R, 4] or attr-less
+    deltas = ins["TargetBox"][0]          # [R, 4*C]
+    scores = ins["BoxScore"][0]           # [R, C]
+    r, c4 = deltas.shape
+    ncls = c4 // 4
+    d = deltas.reshape(r, ncls, 4) * pv[:, None, :]
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    ocx = d[..., 0] * pw[:, None] + pcx[:, None]
+    ocy = d[..., 1] * ph[:, None] + pcy[:, None]
+    ow = jnp.exp(jnp.minimum(d[..., 2], 10.0)) * pw[:, None]
+    oh = jnp.exp(jnp.minimum(d[..., 3], 10.0)) * ph[:, None]
+    decoded = jnp.stack([ocx - ow / 2, ocy - oh / 2,
+                         ocx + ow / 2 - 1.0, ocy + oh / 2 - 1.0], axis=-1)
+    best = jnp.argmax(scores, axis=1)
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    return {"DecodeBox": decoded.reshape(r, c4),
+            "OutputAssignBox": assigned}
+
+
+# ---------------------------------------------------------------------------
+# NMS family / proposals
+# ---------------------------------------------------------------------------
+
+
+@register_op("multiclass_nms", grad=None)
+def multiclass_nms(ins, attrs, ctx):
+    """reference: detection/multiclass_nms_op.cc. Static-shape output:
+    the reference emits a LoD tensor of per-image variable detection
+    counts; here Out is [N, keep_top_k, 6] ([label, score, x1,y1,x2,y2],
+    padded entries label=-1) plus NmsRoisNum [N]."""
+    bboxes = ins["BBoxes"][0]             # [N, M, 4]
+    scores = ins["Scores"][0]             # [N, C, M]
+    bg = int(attrs.get("background_label", 0))
+    score_thr = float(attrs.get("score_threshold", 0.0))
+    nms_top_k = int(attrs.get("nms_top_k", -1))
+    nms_thr = float(attrs.get("nms_threshold", 0.3))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    normalized = bool(attrs.get("normalized", True))
+    n, c, m = scores.shape
+    per_class = min(m, nms_top_k) if nms_top_k > 0 else m
+    # clamp to the flat candidate pool (reference keeps at most that many)
+    n_fg_cls = c - (1 if 0 <= bg < c else 0)
+    pool = n_fg_cls * per_class
+    if keep_top_k <= 0:
+        keep_top_k = min(pool, 128)
+    keep_top_k = min(keep_top_k, pool)
+
+    def one_image(boxes, sc):
+        def one_class(cls_scores):
+            s = cls_scores
+            if nms_top_k > 0 and nms_top_k < m:
+                top_s, top_i = jax.lax.top_k(s, nms_top_k)
+                cb = boxes[top_i]
+                idx, ss = _nms_static(cb, top_s, nms_thr, per_class,
+                                      normalized, score_thr)
+                idx = jnp.where(idx >= 0, top_i[jnp.maximum(idx, 0)], -1)
+            else:
+                idx, ss = _nms_static(boxes, s, nms_thr, per_class,
+                                      normalized, score_thr)
+            return idx, ss
+
+        cls_ids = jnp.asarray([cc for cc in range(c) if cc != bg],
+                              jnp.int32)
+        idxs, sss = jax.vmap(one_class)(sc[cls_ids])  # [C', K], [C', K]
+        labels = jnp.broadcast_to(cls_ids[:, None], idxs.shape)
+        flat_s = sss.reshape(-1)
+        flat_i = idxs.reshape(-1)
+        flat_l = labels.reshape(-1)
+        top_s, order = jax.lax.top_k(flat_s, keep_top_k)
+        sel_i = flat_i[order]
+        sel_l = flat_l[order]
+        valid = (top_s > -jnp.inf) & (sel_i >= 0)
+        sel_boxes = boxes[jnp.maximum(sel_i, 0)]
+        out = jnp.concatenate([
+            jnp.where(valid, sel_l, -1).astype(boxes.dtype)[:, None],
+            jnp.where(valid, top_s, 0.0)[:, None],
+            jnp.where(valid[:, None], sel_boxes, 0.0)], axis=1)
+        return out, jnp.sum(valid.astype(jnp.int32))
+
+    out, num = jax.vmap(one_image)(bboxes, scores)
+    return {"Out": out, "NmsRoisNum": num, "Index": None}
+
+
+@register_op("generate_proposals", grad=None)
+def generate_proposals(ins, attrs, ctx):
+    """reference: detection/generate_proposals_op.cc — RPN: decode anchor
+    deltas, clip to image, filter small boxes, NMS. Static shapes: outputs
+    RpnRois [N, post_nms_topN, 4], RpnRoiProbs [N, post_nms_topN, 1],
+    RpnRoisNum [N] (invalid rows zeroed)."""
+    scores = ins["Scores"][0]             # [N, A, H, W]
+    deltas = ins["BboxDeltas"][0]         # [N, 4A, H, W]
+    im_info = ins["ImInfo"][0]            # [N, 3] (h, w, scale)
+    anchors = ins["Anchors"][0].reshape(-1, 4)     # [H*W*A, 4]
+    variances = ins["Variances"][0].reshape(-1, 4)
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thr = float(attrs.get("nms_thresh", 0.7))
+    min_size = float(attrs.get("min_size", 0.1))
+    n, a, h, w = scores.shape
+    total = a * h * w
+    pre_n = min(pre_n, total)
+
+    # [N, A, H, W] -> [N, H*W*A] matching anchors' [H, W, A] layout
+    sc = scores.transpose(0, 2, 3, 1).reshape(n, -1)
+    dl = deltas.reshape(n, a, 4, h, w).transpose(0, 3, 4, 1, 2).reshape(
+        n, -1, 4)
+
+    def one(s, d, info):
+        top_s, top_i = jax.lax.top_k(s, pre_n)
+        anc = anchors[top_i]
+        var = variances[top_i]
+        dd = d[top_i] * var
+        pw = anc[:, 2] - anc[:, 0] + 1.0
+        ph = anc[:, 3] - anc[:, 1] + 1.0
+        pcx = anc[:, 0] + pw * 0.5
+        pcy = anc[:, 1] + ph * 0.5
+        ocx = dd[:, 0] * pw + pcx
+        ocy = dd[:, 1] * ph + pcy
+        ow = jnp.exp(jnp.minimum(dd[:, 2], 10.0)) * pw
+        oh = jnp.exp(jnp.minimum(dd[:, 3], 10.0)) * ph
+        boxes = jnp.stack([ocx - ow / 2, ocy - oh / 2,
+                           ocx + ow / 2 - 1.0, ocy + oh / 2 - 1.0], -1)
+        ih, iw = info[0], info[1]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, iw - 1),
+                           jnp.clip(boxes[:, 1], 0, ih - 1),
+                           jnp.clip(boxes[:, 2], 0, iw - 1),
+                           jnp.clip(boxes[:, 3], 0, ih - 1)], -1)
+        ms = min_size * info[2]
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1.0) >= ms) & \
+               ((boxes[:, 3] - boxes[:, 1] + 1.0) >= ms)
+        s_kept = jnp.where(keep, top_s, -jnp.inf)
+        idx, ss = _nms_static(boxes, s_kept, nms_thr, post_n,
+                              normalized=False)
+        valid = idx >= 0
+        rois = jnp.where(valid[:, None], boxes[jnp.maximum(idx, 0)], 0.0)
+        probs = jnp.where(valid, ss, 0.0)[:, None]
+        return rois, probs, jnp.sum(valid.astype(jnp.int32))
+
+    rois, probs, num = jax.vmap(one)(sc, dl, im_info)
+    return {"RpnRois": rois, "RpnRoiProbs": probs, "RpnRoisNum": num}
+
+
+@register_op("collect_fpn_proposals", grad=None)
+def collect_fpn_proposals(ins, attrs, ctx):
+    """reference: detection/collect_fpn_proposals_op.cc — concat per-level
+    RoIs, keep global top post_nms_topN by score."""
+    rois = jnp.concatenate([r.reshape(-1, 4) for r in ins["MultiLevelRois"]
+                            if r is not None], axis=0)
+    scores = jnp.concatenate([s.reshape(-1) for s in
+                              ins["MultiLevelScores"] if s is not None],
+                             axis=0)
+    post_n = min(int(attrs.get("post_nms_topN", 100)), scores.shape[0])
+    top_s, top_i = jax.lax.top_k(scores, post_n)
+    return {"FpnRois": rois[top_i], "RoisNum": jnp.asarray([post_n])}
+
+
+@register_op("distribute_fpn_proposals", grad=None)
+def distribute_fpn_proposals(ins, attrs, ctx):
+    """reference: detection/distribute_fpn_proposals_op.cc — route each RoI
+    to FPN level floor(log2(sqrt(area)/refer_scale)) + refer_level,
+    clipped to [min_level, max_level]. Static shapes: each level output is
+    [R, 4] with a LevelMask instead of variable-size splits; RestoreIndex
+    maps sorted-by-level order back."""
+    rois = ins["FpnRois"][0].reshape(-1, 4)
+    min_l = int(attrs.get("min_level", 2))
+    max_l = int(attrs.get("max_level", 5))
+    refer_l = int(attrs.get("refer_level", 4))
+    refer_s = float(attrs.get("refer_scale", 224.0))
+    r = rois.shape[0]
+    scale = jnp.sqrt(_box_area(rois, normalized=False))
+    lvl = jnp.floor(jnp.log2(scale / refer_s + 1e-6)) + refer_l
+    lvl = jnp.clip(lvl, min_l, max_l).astype(jnp.int32)
+    outs = {"MultiFpnRois": [], "MultiLevelMask": []}
+    for L in range(min_l, max_l + 1):
+        m = (lvl == L)
+        outs["MultiFpnRois"].append(jnp.where(m[:, None], rois, 0.0))
+        outs["MultiLevelMask"].append(m.astype(jnp.int32))
+    order = jnp.argsort(lvl, stable=True)
+    restore = jnp.argsort(order, stable=True).astype(jnp.int32)
+    outs["RestoreIndex"] = restore[:, None]
+    return outs
+
+
+@register_op("rpn_target_assign", is_random=True, grad=None)
+def rpn_target_assign(ins, attrs, ctx):
+    """reference: detection/rpn_target_assign_op.cc — label anchors fg/bg
+    by IoU against gt boxes and subsample a fixed batch. Static shapes:
+    LocationIndex/ScoreIndex are fixed-capacity with -1 padding;
+    TargetLabel aligns with ScoreIndex (1 fg / 0 bg)."""
+    anchors = ins["Anchor"][0].reshape(-1, 4)      # [A, 4]
+    gt = ins["GtBoxes"][0].reshape(-1, 4)          # [G, 4]
+    batch = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_frac = float(attrs.get("rpn_fg_fraction", 0.5))
+    pos_thr = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_thr = float(attrs.get("rpn_negative_overlap", 0.3))
+    use_random = bool(attrs.get("use_random", True))
+    a = anchors.shape[0]
+    iou = _pairwise_iou(anchors, gt, normalized=False)     # [A, G]
+    best_iou = jnp.max(iou, axis=1)
+    # fg: IoU >= pos_thr, plus the best anchor for each gt
+    fg_mask = best_iou >= pos_thr
+    best_anchor_per_gt = jnp.argmax(iou, axis=0)
+    fg_mask = fg_mask.at[best_anchor_per_gt].set(True)
+    bg_mask = (best_iou < neg_thr) & ~fg_mask
+
+    # quotas can't exceed the anchor count (top_k requires k <= size)
+    n_fg = min(int(batch * fg_frac), a)
+    n_bg = min(batch - n_fg, a)
+    key = ctx.rng() if use_random else None
+
+    def sample(mask, k, n_out):
+        noise = jax.random.uniform(k, (a,)) if k is not None else \
+            -jnp.arange(a, dtype=jnp.float32)
+        score = jnp.where(mask, noise, -jnp.inf)
+        top_s, top_i = jax.lax.top_k(score, n_out)
+        return jnp.where(top_s > -jnp.inf, top_i, -1).astype(jnp.int32)
+
+    if key is not None:
+        kf, kb = jax.random.split(key)
+    else:
+        kf = kb = None
+    fg_idx = sample(fg_mask, kf, n_fg)
+    bg_idx = sample(bg_mask, kb, n_bg)
+    score_idx = jnp.concatenate([fg_idx, bg_idx])
+    labels = jnp.concatenate([(fg_idx >= 0).astype(jnp.int32),
+                              jnp.zeros((n_bg,), jnp.int32)])
+    # regression targets for fg anchors: encode their best gt
+    best_gt = jnp.argmax(iou, axis=1)
+    anc = anchors[jnp.maximum(fg_idx, 0)]
+    g = gt[best_gt[jnp.maximum(fg_idx, 0)]]
+    pw = anc[:, 2] - anc[:, 0] + 1.0
+    ph = anc[:, 3] - anc[:, 1] + 1.0
+    pcx = anc[:, 0] + pw * 0.5
+    pcy = anc[:, 1] + ph * 0.5
+    gw = g[:, 2] - g[:, 0] + 1.0
+    gh = g[:, 3] - g[:, 1] + 1.0
+    gcx = g[:, 0] + gw * 0.5
+    gcy = g[:, 1] + gh * 0.5
+    tgt = jnp.stack([(gcx - pcx) / pw, (gcy - pcy) / ph,
+                     jnp.log(gw / pw), jnp.log(gh / ph)], axis=-1)
+    tgt = jnp.where((fg_idx >= 0)[:, None], tgt, 0.0)
+    return {"LocationIndex": fg_idx, "ScoreIndex": score_idx,
+            "TargetBBox": tgt,
+            "TargetLabel": labels[:, None],
+            "BBoxInsideWeight": (fg_idx >= 0)[:, None]
+            .astype(anchors.dtype) * jnp.ones((1, 4), anchors.dtype)}
+
+
+@register_op("retinanet_detection_output", grad=None)
+def retinanet_detection_output(ins, attrs, ctx):
+    """reference: detection/retinanet_detection_output_op.cc — decode each
+    FPN level's top candidates against its anchors, merge levels, then
+    class-wise NMS (reuses the multiclass machinery, static shapes)."""
+    bboxes = ins["BBoxes"]                 # list of [N, Ai, 4] deltas
+    scores = ins["Scores"]                 # list of [N, Ai, C]
+    anchors = ins["Anchors"]               # list of [Ai, 4]
+    im_info = ins["ImInfo"][0]
+    score_thr = float(attrs.get("score_threshold", 0.05))
+    nms_top_k = int(attrs.get("nms_top_k", 1000))
+    nms_thr = float(attrs.get("nms_threshold", 0.3))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+
+    all_boxes, all_scores = [], []
+    for delta, sc, anc in zip(bboxes, scores, anchors):
+        if delta is None:
+            continue
+        anc = anc.reshape(-1, 4)
+        pw = anc[:, 2] - anc[:, 0] + 1.0
+        ph = anc[:, 3] - anc[:, 1] + 1.0
+        pcx = anc[:, 0] + pw * 0.5
+        pcy = anc[:, 1] + ph * 0.5
+        d = delta
+        ocx = d[..., 0] * pw + pcx
+        ocy = d[..., 1] * ph + pcy
+        ow = jnp.exp(jnp.minimum(d[..., 2], 10.0)) * pw
+        oh = jnp.exp(jnp.minimum(d[..., 3], 10.0)) * ph
+        box = jnp.stack([ocx - ow / 2, ocy - oh / 2,
+                         ocx + ow / 2 - 1.0, ocy + oh / 2 - 1.0], -1)
+        all_boxes.append(box)
+        all_scores.append(sc)
+    boxes = jnp.concatenate(all_boxes, axis=1)       # [N, A, 4]
+    sc = jnp.concatenate(all_scores, axis=1)         # [N, A, C]
+    n, a, c = sc.shape
+    cap = min(nms_top_k, a)
+    sel_k = min(cap, keep_top_k)
+    keep_k = min(keep_top_k, c * sel_k)   # can't keep more than the pool
+
+    def one_image(bx, s, info):
+        # clip to THIS image's extent
+        ih, iw = info[0], info[1]
+        bx = jnp.stack([jnp.clip(bx[..., 0], 0, iw - 1),
+                        jnp.clip(bx[..., 1], 0, ih - 1),
+                        jnp.clip(bx[..., 2], 0, iw - 1),
+                        jnp.clip(bx[..., 3], 0, ih - 1)], -1)
+
+        def one_class(cls_scores):
+            top_s, top_i = jax.lax.top_k(cls_scores, cap)
+            cb = bx[top_i]
+            idx, ss = _nms_static(cb, top_s, nms_thr, sel_k,
+                                  normalized=False,
+                                  score_threshold=score_thr)
+            sel = jnp.where(idx >= 0, top_i[jnp.maximum(idx, 0)], -1)
+            return sel, ss
+
+        idxs, sss = jax.vmap(one_class)(s.T)          # [C, K]
+        labels = jnp.broadcast_to(jnp.arange(c)[:, None], idxs.shape)
+        flat_s, flat_i = sss.reshape(-1), idxs.reshape(-1)
+        flat_l = labels.reshape(-1)
+        top_s, order = jax.lax.top_k(flat_s, keep_k)
+        sel_i = flat_i[order]
+        valid = (top_s > -jnp.inf) & (sel_i >= 0)
+        out = jnp.concatenate([
+            jnp.where(valid, flat_l[order], -1).astype(bx.dtype)[:, None],
+            jnp.where(valid, top_s, 0.0)[:, None],
+            jnp.where(valid[:, None], bx[jnp.maximum(sel_i, 0)], 0.0)],
+            axis=1)
+        return out, jnp.sum(valid.astype(jnp.int32))
+
+    out, num = jax.vmap(one_image)(boxes, sc, im_info)
+    return {"Out": out, "NmsRoisNum": num}
+
+
+@register_op("yolov3_loss", nondiff_inputs=("GTBox", "GTLabel", "GTScore"))
+def yolov3_loss(ins, attrs, ctx):
+    """reference: detection/yolov3_loss_op.cc — per-cell YOLOv3 training
+    loss: sigmoid x/y + w/h regression for the responsible anchor of each
+    gt, objectness BCE with an ignore band, and per-class BCE."""
+    x = ins["X"][0]                        # [N, A*(5+C), H, W]
+    gtbox = ins["GTBox"][0]                # [N, B, 4] (cx, cy, w, h) / img
+    gtlabel = ins["GTLabel"][0]            # [N, B]
+    anchors = [float(v) for v in attrs["anchors"]]
+    mask = [int(v) for v in attrs.get("anchor_mask",
+                                      list(range(len(anchors) // 2)))]
+    class_num = int(attrs["class_num"])
+    ignore_thresh = float(attrs.get("ignore_thresh", 0.7))
+    downsample = int(attrs.get("downsample_ratio", 32))
+    use_label_smooth = bool(attrs.get("use_label_smooth", True))
+    n, _, h, w = x.shape
+    am = len(mask)
+    x = x.reshape(n, am, 5 + class_num, h, w)
+    input_size = downsample * h
+    aw_all = jnp.asarray(anchors[0::2])
+    ah_all = jnp.asarray(anchors[1::2])
+    aw = aw_all[jnp.asarray(mask)]         # masked anchors on this scale
+    ah = ah_all[jnp.asarray(mask)]
+
+    tx = jax.nn.sigmoid(x[:, :, 0])        # [N, A, H, W]
+    ty = jax.nn.sigmoid(x[:, :, 1])
+    tw = x[:, :, 2]
+    th = x[:, :, 3]
+    tobj = x[:, :, 4]
+    tcls = x[:, :, 5:]                     # [N, A, C, H, W]
+
+    b = gtbox.shape[1]
+    gx, gy = gtbox[..., 0], gtbox[..., 1]  # normalized centers
+    gw, gh = gtbox[..., 2], gtbox[..., 3]
+    valid_gt = (gw > 0) & (gh > 0)
+    gi = jnp.clip((gx * w).astype(jnp.int32), 0, w - 1)    # [N, B]
+    gj = jnp.clip((gy * h).astype(jnp.int32), 0, h - 1)
+
+    # responsible anchor: best wh-IoU among ALL anchors; loss only if in mask
+    gwp = gw * input_size
+    ghp = gh * input_size
+    inter = jnp.minimum(gwp[..., None], aw_all) * \
+        jnp.minimum(ghp[..., None], ah_all)
+    union = gwp[..., None] * ghp[..., None] + aw_all * ah_all - inter
+    best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)  # [N,B]
+    mask_arr = jnp.asarray(mask)
+    in_mask = (best_anchor[..., None] == mask_arr).any(-1)
+    slot = jnp.argmax((best_anchor[..., None] == mask_arr), -1)      # [N,B]
+    resp = valid_gt & in_mask
+
+    # gather predictions at (slot, gj, gi) per gt
+    def at(v):  # v [N, A, H, W] -> [N, B]
+        return v[jnp.arange(n)[:, None], slot, gj, gi]
+
+    # per-gt confidence weight (mixup): reference scales every gt's loss
+    # terms by GTScore; defaults to 1
+    if ins.get("GTScore") and ins["GTScore"][0] is not None:
+        gscore = ins["GTScore"][0].reshape(gw.shape).astype(x.dtype)
+    else:
+        gscore = jnp.ones_like(gw)
+
+    scale = (2.0 - gw * gh) * gscore      # box-size weighting (reference)
+    loss_x = scale * _bce(at(tx), gx * w - gi.astype(gx.dtype))
+    loss_y = scale * _bce(at(ty), gy * h - gj.astype(gy.dtype))
+    loss_w = 0.5 * scale * (at(tw) - jnp.log(jnp.maximum(
+        gwp / aw[slot], 1e-9))) ** 2
+    loss_h = 0.5 * scale * (at(th) - jnp.log(jnp.maximum(
+        ghp / ah[slot], 1e-9))) ** 2
+    loc = jnp.sum(jnp.where(resp, loss_x + loss_y + loss_w + loss_h, 0.0),
+                  axis=1)
+
+    # objectness: target 1 at responsible cells; ignore preds whose box IoU
+    # with any gt exceeds ignore_thresh; all else target 0
+    pbx = (tx + jnp.arange(w)) / w                           # [N,A,H,W]
+    pby = (ty + jnp.arange(h)[:, None]) / h
+    pbw = jnp.exp(jnp.clip(tw, -10, 10)) * aw[None, :, None, None] / \
+        input_size
+    pbh = jnp.exp(jnp.clip(th, -10, 10)) * ah[None, :, None, None] / \
+        input_size
+    px1, py1 = pbx - pbw / 2, pby - pbh / 2
+    px2, py2 = pbx + pbw / 2, pby + pbh / 2
+    gx1, gy1 = gx - gw / 2, gy - gh / 2
+    gx2, gy2 = gx + gw / 2, gy + gh / 2
+    ix1 = jnp.maximum(px1[..., None], gx1[:, None, None, None, :])
+    iy1 = jnp.maximum(py1[..., None], gy1[:, None, None, None, :])
+    ix2 = jnp.minimum(px2[..., None], gx2[:, None, None, None, :])
+    iy2 = jnp.minimum(py2[..., None], gy2[:, None, None, None, :])
+    iw_ = jnp.maximum(ix2 - ix1, 0.0)
+    ih_ = jnp.maximum(iy2 - iy1, 0.0)
+    inter_o = iw_ * ih_
+    area_p = pbw * pbh
+    area_g = (gw * gh)[:, None, None, None, :]
+    iou_o = inter_o / jnp.maximum(area_p[..., None] + area_g - inter_o,
+                                  1e-10)
+    iou_o = jnp.where(valid_gt[:, None, None, None, :], iou_o, 0.0)
+    ignore = jnp.max(iou_o, axis=-1) > ignore_thresh         # [N,A,H,W]
+    obj_target = jnp.zeros_like(tobj)
+    obj_target = obj_target.at[jnp.arange(n)[:, None], slot, gj, gi].max(
+        jnp.where(resp, 1.0, 0.0))
+    # positive cells carry their gt's mixup score as the BCE weight
+    obj_score = jnp.ones_like(tobj).at[
+        jnp.arange(n)[:, None], slot, gj, gi].max(
+        jnp.where(resp, gscore, 1.0))
+    obj_w = jnp.where((obj_target > 0) | ~ignore, 1.0, 0.0) * \
+        jnp.where(obj_target > 0, obj_score, 1.0)
+    obj = jnp.sum(_bce(jax.nn.sigmoid(tobj), obj_target) * obj_w,
+                  axis=(1, 2, 3))
+
+    # classification at responsible cells
+    delta = 1.0 / class_num if use_label_smooth else 0.0
+    cls_t = (gtlabel[..., None] == jnp.arange(class_num)).astype(x.dtype)
+    cls_t = cls_t * (1.0 - delta) + delta * (1.0 / class_num)
+    pcls = jax.nn.sigmoid(
+        tcls[jnp.arange(n)[:, None], slot, :, gj, gi])       # [N, B, C]
+    cls = jnp.sum(jnp.where(resp[..., None],
+                            _bce(pcls, cls_t) * gscore[..., None], 0.0),
+                  axis=(1, 2))
+    return {"Loss": loc + obj + cls,
+            "ObjectnessMask": obj_w, "GTMatchMask": resp.astype(jnp.int32)}
+
+
+def _bce(p, t):
+    p = jnp.clip(p, 1e-7, 1.0 - 1e-7)
+    return -(t * jnp.log(p) + (1.0 - t) * jnp.log(1.0 - p))

@@ -1,0 +1,453 @@
+"""Detection op tests vs numpy references.
+
+Reference pattern: unittests/test_multiclass_nms_op.py,
+test_bipartite_match_op.py, test_anchor_generator_op.py, etc."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_op
+
+
+def _iou(a, b, normalized=True):
+    one = 0.0 if normalized else 1.0
+    ax1, ay1, ax2, ay2 = a
+    bx1, by1, bx2, by2 = b
+    iw = max(min(ax2, bx2) - max(ax1, bx1) + one, 0.0)
+    ih = max(min(ay2, by2) - max(ay1, by1) + one, 0.0)
+    inter = iw * ih
+    ua = (ax2 - ax1 + one) * (ay2 - ay1 + one) + \
+        (bx2 - bx1 + one) * (by2 - by1 + one) - inter
+    return inter / max(ua, 1e-10)
+
+
+def _nms_ref(boxes, scores, thr, max_out, score_thr=None):
+    order = np.argsort(-scores)
+    keep = []
+    for i in order:
+        if score_thr is not None and scores[i] <= score_thr:
+            continue
+        if all(_iou(boxes[i], boxes[j]) <= thr for j in keep):
+            keep.append(i)
+        if len(keep) == max_out:
+            break
+    return keep
+
+
+def test_sigmoid_focal_loss_matches_numpy_and_grad():
+    rng = np.random.RandomState(0)
+    n, c = 8, 5
+    x = rng.randn(n, c).astype("float64")
+    label = rng.randint(0, c + 1, (n, 1)).astype("int64")  # 0 = bg
+    fg = np.array([max((label > 0).sum(), 1)], "int64")
+    out = run_op("sigmoid_focal_loss",
+                 {"X": x, "Label": label, "FgNum": fg},
+                 {"gamma": 2.0, "alpha": 0.25})["Out"][0]
+    p = 1 / (1 + np.exp(-x))
+    t = (label == np.arange(1, c + 1)[None, :]).astype("float64")
+    want = -(t * 0.25 * (1 - p) ** 2 * np.log(p) +
+             (1 - t) * 0.75 * p ** 2 * np.log(1 - p)) / fg[0]
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+    check_grad("sigmoid_focal_loss",
+               {"X": x, "Label": label, "FgNum": fg},
+               {"gamma": 2.0, "alpha": 0.25}, inputs_to_check=["X"])
+
+
+def test_anchor_generator_matches_reference_math():
+    """Sequential reimplementation of anchor_generator_op.h:55-85."""
+    x = np.zeros((1, 8, 3, 4), "float32")
+    sizes, ratios = [32.0, 64.0], [0.5, 1.0]
+    stride = [16.0, 16.0]
+    offset = 0.5
+    out = run_op("anchor_generator", {"Input": x},
+                 {"anchor_sizes": sizes, "aspect_ratios": ratios,
+                  "stride": stride, "offset": offset},
+                 outputs=("Anchors", "Variances"))
+    anchors = out["Anchors"][0]
+    assert anchors.shape == (3, 4, 4, 4)
+    for hi in range(3):
+        for wi in range(4):
+            xc = wi * 16 + 0.5 * 15
+            yc = hi * 16 + 0.5 * 15
+            idx = 0
+            for ar in ratios:
+                for s in sizes:
+                    base_w = np.round(np.sqrt(16 * 16 / ar))
+                    base_h = np.round(base_w * ar)
+                    awd = s / 16 * base_w
+                    ahd = s / 16 * base_h
+                    np.testing.assert_allclose(
+                        anchors[hi, wi, idx],
+                        [xc - 0.5 * (awd - 1), yc - 0.5 * (ahd - 1),
+                         xc + 0.5 * (awd - 1), yc + 0.5 * (ahd - 1)],
+                        rtol=1e-5)
+                    idx += 1
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([[[0.1, 0.9, 0.3],
+                      [0.8, 0.2, 0.7]]], "float32")   # [1, R=2, C=3]
+    out = run_op("bipartite_match", {"DistMat": dist},
+                 outputs=("ColToRowMatchIndices", "ColToRowMatchDist"))
+    # greedy: max 0.9 -> col1=row0; then max 0.8 -> col0=row1; col2 unmatched
+    np.testing.assert_array_equal(out["ColToRowMatchIndices"][0][0],
+                                  [1, 0, -1])
+    np.testing.assert_allclose(out["ColToRowMatchDist"][0][0],
+                               [0.8, 0.9, 0.0])
+
+
+def test_bipartite_match_per_prediction_fill():
+    dist = np.array([[[0.1, 0.9, 0.6],
+                      [0.8, 0.2, 0.7]]], "float32")
+    out = run_op("bipartite_match", {"DistMat": dist},
+                 {"match_type": "per_prediction", "dist_threshold": 0.5},
+                 outputs=("ColToRowMatchIndices", "ColToRowMatchDist"))
+    # col2's best row is row1 (0.7 > 0.5) even though bipartite left it out
+    np.testing.assert_array_equal(out["ColToRowMatchIndices"][0][0],
+                                  [1, 0, 1])
+
+
+def test_target_assign_gathers_and_weights():
+    x = np.arange(12, dtype="float32").reshape(1, 3, 4)   # [N, M, K]
+    match = np.array([[2, -1, 0, 1]], "int32")
+    out = run_op("target_assign", {"X": x, "MatchIndices": match},
+                 {"mismatch_value": 7.0},
+                 outputs=("Out", "OutWeight"))
+    np.testing.assert_allclose(out["Out"][0][0, 0], x[0, 2])
+    np.testing.assert_allclose(out["Out"][0][0, 1], [7.0] * 4)
+    np.testing.assert_allclose(out["OutWeight"][0][0, :, 0],
+                               [1, 0, 1, 1])
+
+
+def test_mine_hard_examples_flags_top_losses():
+    match = np.array([[0, -1, -1, -1, 1, -1]], "int32")   # 2 positives
+    loss = np.array([[0.1, 0.9, 0.2, 0.8, 0.1, 0.5]], "float32")
+    out = run_op("mine_hard_examples",
+                 {"ClsLoss": loss, "MatchIndices": match},
+                 {"neg_pos_ratio": 1.0},
+                 outputs=("NegFlag", "UpdatedMatchIndices"))
+    # 2 pos * ratio 1.0 = 2 negatives: highest-loss unmatched cols 1, 3
+    np.testing.assert_array_equal(out["NegFlag"][0][0],
+                                  [0, 1, 0, 1, 0, 0])
+
+
+def test_multiclass_nms_matches_reference_selection():
+    rng = np.random.RandomState(3)
+    m, c = 12, 3
+    boxes = rng.rand(1, m, 4).astype("float32")
+    boxes[..., 2:] = boxes[..., :2] + rng.rand(1, m, 2) * 0.5 + 0.05
+    scores = rng.rand(1, c, m).astype("float32")
+    attrs = {"background_label": 0, "score_threshold": 0.2,
+             "nms_top_k": -1, "nms_threshold": 0.4, "keep_top_k": 6,
+             "normalized": True}
+    out = run_op("multiclass_nms", {"BBoxes": boxes, "Scores": scores},
+                 attrs, outputs=("Out", "NmsRoisNum"))
+    got = out["Out"][0][0]
+    # numpy reference
+    dets = []
+    for cls in range(1, c):
+        keep = _nms_ref(boxes[0], scores[0, cls], 0.4, m, score_thr=0.2)
+        dets += [(cls, scores[0, cls, i], *boxes[0, i]) for i in keep]
+    dets.sort(key=lambda d: -d[1])
+    dets = dets[:6]
+    nvalid = int(out["NmsRoisNum"][0][0])
+    assert nvalid == len(dets)
+    for k in range(nvalid):
+        assert int(got[k, 0]) == dets[k][0]
+        np.testing.assert_allclose(got[k, 1], dets[k][1], rtol=1e-5)
+        np.testing.assert_allclose(got[k, 2:], dets[k][2:], rtol=1e-5)
+    assert (got[nvalid:, 0] == -1).all()
+
+
+def test_roi_pool_known_values():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], "float32")
+    out = run_op("roi_pool", {"X": x, "ROIs": rois},
+                 {"pooled_height": 2, "pooled_width": 2,
+                  "spatial_scale": 1.0})["Out"][0]
+    np.testing.assert_allclose(out[0, 0], [[5.0, 7.0], [13.0, 15.0]])
+
+
+def test_psroi_pool_position_sensitive():
+    # C = out_c * ph * pw = 1*2*2; each input channel constant k
+    ph = pw = 2
+    x = np.stack([np.full((6, 6), k, "float32") for k in range(4)])[None]
+    rois = np.array([[0.0, 0.0, 5.0, 5.0]], "float32")
+    out = run_op("psroi_pool", {"X": x, "ROIs": rois},
+                 {"pooled_height": ph, "pooled_width": pw,
+                  "output_channels": 1, "spatial_scale": 1.0})["Out"][0]
+    # bin (i,j) reads channel i*pw+j -> value i*pw+j
+    np.testing.assert_allclose(out[0, 0], [[0, 1], [2, 3]], atol=1e-5)
+
+
+def test_polygon_box_transform():
+    x = np.ones((1, 4, 2, 3), "float32")
+    out = run_op("polygon_box_transform", {"Input": x},
+                 outputs=("Output",))["Output"][0]
+    for ci in range(4):
+        for hi in range(2):
+            for wi in range(3):
+                base = 4 * wi if ci % 2 == 0 else 4 * hi
+                assert out[0, ci, hi, wi] == base - 1.0
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0.0, 0.0, 9.0, 9.0]], "float32")
+    pv = np.array([[1.0, 1.0, 1.0, 1.0]], "float32")
+    deltas = np.zeros((1, 8), "float32")     # 2 classes, zero deltas
+    scores = np.array([[0.2, 0.8]], "float32")
+    out = run_op("box_decoder_and_assign",
+                 {"PriorBox": prior, "PriorBoxVar": pv,
+                  "TargetBox": deltas, "BoxScore": scores},
+                 outputs=("DecodeBox", "OutputAssignBox"))
+    # zero deltas decode back to the prior box
+    np.testing.assert_allclose(out["OutputAssignBox"][0][0],
+                               [0, 0, 9, 9], atol=1e-4)
+
+
+def test_generate_proposals_properties():
+    rng = np.random.RandomState(4)
+    n, a, h, w = 1, 3, 4, 4
+    scores = rng.rand(n, a, h, w).astype("float32")
+    deltas = (rng.randn(n, 4 * a, h, w) * 0.1).astype("float32")
+    im_info = np.array([[64.0, 64.0, 1.0]], "float32")
+    anchors = run_op("anchor_generator", {"Input": scores},
+                     {"anchor_sizes": [16.0, 32.0, 48.0],
+                      "aspect_ratios": [1.0], "stride": [16.0, 16.0]},
+                     outputs=("Anchors", "Variances"))
+    out = run_op("generate_proposals",
+                 {"Scores": scores, "BboxDeltas": deltas,
+                  "ImInfo": im_info,
+                  "Anchors": anchors["Anchors"][0],
+                  "Variances": anchors["Variances"][0]},
+                 {"pre_nms_topN": 24, "post_nms_topN": 8,
+                  "nms_thresh": 0.7, "min_size": 2.0},
+                 outputs=("RpnRois", "RpnRoiProbs", "RpnRoisNum"))
+    rois = out["RpnRois"][0][0]
+    num = int(out["RpnRoisNum"][0][0])
+    assert 0 < num <= 8
+    valid = rois[:num]
+    # all inside the image and min-size respected
+    assert (valid[:, 0] >= 0).all() and (valid[:, 2] <= 63).all()
+    assert ((valid[:, 2] - valid[:, 0] + 1) >= 2.0).all()
+    # probs sorted descending
+    probs = out["RpnRoiProbs"][0][0][:num, 0]
+    assert (np.diff(probs) <= 1e-6).all()
+
+
+def test_distribute_and_collect_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10],      # small -> low level
+                     [0, 0, 500, 500],    # large -> high level
+                     [0, 0, 220, 220]], "float32")
+    out = run_op("distribute_fpn_proposals", {"FpnRois": rois},
+                 {"min_level": 2, "max_level": 5, "refer_level": 4,
+                  "refer_scale": 224.0},
+                 outputs=("MultiFpnRois", "MultiLevelMask", "RestoreIndex"))
+    masks = np.stack([m for m in out["MultiLevelMask"]])
+    assert masks.sum() == 3
+    assert masks[0, 0] == 1          # small roi at min level
+    assert masks[-1, 1] == 1         # large roi at max level
+    # collect: top-2 by score across levels
+    scores = [np.array([0.9], "float32"), np.array([0.5], "float32")]
+    lv = [rois[:1], rois[1:2]]
+    out2 = run_op("collect_fpn_proposals",
+                  {"MultiLevelRois": lv, "MultiLevelScores": scores},
+                  {"post_nms_topN": 1}, outputs=("FpnRois",))
+    np.testing.assert_allclose(out2["FpnRois"][0][0], rois[0])
+
+
+def test_rpn_target_assign_samples():
+    rng = np.random.RandomState(5)
+    anchors = np.stack([
+        np.array([x, y, x + 15, y + 15], "float32")
+        for x in range(0, 64, 16) for y in range(0, 64, 16)])
+    gt = np.array([[0, 0, 15, 15], [32, 32, 47, 47]], "float32")
+    out = run_op("rpn_target_assign",
+                 {"Anchor": anchors, "GtBoxes": gt},
+                 {"rpn_batch_size_per_im": 8, "rpn_fg_fraction": 0.25,
+                  "rpn_positive_overlap": 0.7,
+                  "rpn_negative_overlap": 0.3},
+                 outputs=("LocationIndex", "ScoreIndex", "TargetBBox",
+                          "TargetLabel"), rng_seed=0)
+    loc = out["LocationIndex"][0]
+    lbl = out["TargetLabel"][0][:, 0]
+    # the two exact-match anchors are fg
+    fg = loc[loc >= 0]
+    assert set(fg.tolist()) <= set(range(16))
+    assert len(fg) >= 2
+    # targets for exact matches are ~0
+    tb = out["TargetBBox"][0]
+    np.testing.assert_allclose(tb[:len(fg)], 0.0, atol=1e-5)
+    assert lbl.sum() == len(fg)
+
+
+def test_yolov3_loss_perfect_prediction_is_small():
+    """A prediction placing the responsible anchor box exactly on the gt
+    must have (near-)minimal loc loss; a shifted prediction scores higher."""
+    n, h, w, c = 1, 4, 4, 3
+    anchors = [16, 16, 32, 32]
+    mask = [0, 1]
+    gtbox = np.array([[[0.5, 0.5, 0.25, 0.25]]], "float32")  # center cell
+    gtlabel = np.array([[1]], "int64")
+    downsample = 32
+    input_size = downsample * h
+    # responsible anchor: wh 32x32 (anchor 1)
+    x = np.zeros((n, len(mask) * (5 + c), h, w), "float32")
+    xr = x.reshape(n, len(mask), 5 + c, h, w)
+    gi = int(0.5 * w)
+    gj = int(0.5 * h)
+    # gt w*input = 0.25*128 = 32 -> log(32/32) = 0 => tw=0 is perfect
+    xr[0, 1, 0, gj, gi] = 0.0        # sigmoid(0)=0.5 = gx*w - gi ✓
+    xr[0, 1, 4, gj, gi] = 10.0       # high objectness
+    xr[0, 1, 5 + 1, gj, gi] = 10.0   # class 1
+    good = run_op("yolov3_loss",
+                  {"X": x, "GTBox": gtbox, "GTLabel": gtlabel},
+                  {"anchors": anchors, "anchor_mask": mask, "class_num": c,
+                   "ignore_thresh": 0.7, "downsample_ratio": downsample,
+                   "use_label_smooth": False},
+                  outputs=("Loss",))["Loss"][0][0]
+    x2 = x.copy()
+    x2.reshape(n, len(mask), 5 + c, h, w)[0, 1, 2, gj, gi] = 2.0  # wrong w
+    bad = run_op("yolov3_loss",
+                 {"X": x2, "GTBox": gtbox, "GTLabel": gtlabel},
+                 {"anchors": anchors, "anchor_mask": mask, "class_num": c,
+                  "ignore_thresh": 0.7, "downsample_ratio": downsample,
+                  "use_label_smooth": False},
+                 outputs=("Loss",))["Loss"][0][0]
+    assert bad > good
+
+
+def test_retinanet_detection_output_smoke():
+    rng = np.random.RandomState(6)
+    n, c = 1, 4
+    deltas = [np.zeros((n, 8, 4), "float32"),
+              np.zeros((n, 4, 4), "float32")]
+    scores = [rng.rand(n, 8, c).astype("float32") * 0.5,
+              rng.rand(n, 4, c).astype("float32") * 0.5]
+    anchors = [np.tile(np.array([[0, 0, 31, 31]], "float32"), (8, 1)) +
+               np.arange(8)[:, None] * 8,
+               np.tile(np.array([[0, 0, 63, 63]], "float32"), (4, 1)) +
+               np.arange(4)[:, None] * 16]
+    im_info = np.array([[128.0, 128.0, 1.0]], "float32")
+    out = run_op("retinanet_detection_output",
+                 {"BBoxes": deltas, "Scores": scores, "Anchors": anchors,
+                  "ImInfo": im_info},
+                 {"score_threshold": 0.05, "nms_top_k": 10,
+                  "nms_threshold": 0.3, "keep_top_k": 5},
+                 outputs=("Out", "NmsRoisNum"))
+    det = out["Out"][0][0]
+    nvalid = int(out["NmsRoisNum"][0][0])
+    assert det.shape == (5, 6)
+    assert 0 < nvalid <= 5
+    assert (det[:nvalid, 1] > 0.05).all()
+
+
+def test_detection_layers_in_program():
+    """Drive the new wrappers through a Program + Executor (the public
+    path): anchor_generator → generate_proposals → roi_align."""
+    import paddle_tpu as pt
+
+    rng = np.random.RandomState(7)
+    n, a, h, w = 1, 2, 4, 4
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        feat = pt.layers.data(name="feat", shape=[8, h, w], dtype="float32")
+        scores = pt.layers.data(name="sc", shape=[a, h, w], dtype="float32")
+        deltas = pt.layers.data(name="dl", shape=[4 * a, h, w],
+                                dtype="float32")
+        im_info = pt.layers.data(name="ii", shape=[3], dtype="float32")
+        anchors, variances = pt.layers.anchor_generator(
+            feat, anchor_sizes=[16.0, 32.0], aspect_ratios=[1.0],
+            stride=[16.0, 16.0])
+        rois, probs, num = pt.layers.generate_proposals(
+            scores, deltas, im_info, anchors, variances,
+            pre_nms_top_n=16, post_nms_top_n=4, nms_thresh=0.7,
+            min_size=2.0)
+        pooled = pt.layers.roi_align(feat, pt.layers.reshape(rois, [-1, 4]),
+                                     pooled_height=2, pooled_width=2,
+                                     spatial_scale=1.0 / 16.0)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    out = exe.run(main,
+                  feed={"feat": rng.rand(n, 8, h, w).astype("float32"),
+                        "sc": rng.rand(n, a, h, w).astype("float32"),
+                        "dl": (rng.randn(n, 4 * a, h, w) * 0.1)
+                        .astype("float32"),
+                        "ii": np.array([[64.0, 64.0, 1.0]], "float32")},
+                  fetch_list=[pooled, num])
+    assert np.asarray(out[0]).shape == (4, 8, 2, 2)
+    assert 0 < int(np.asarray(out[1]).reshape(-1)[0]) <= 4
+
+
+def test_roi_align_multichannel_regression():
+    """Regression: roi_align must keep channels independent (the advanced-
+    indexing axis-ordering bug put gathered axes first for C > 1)."""
+    x = np.stack([np.full((4, 4), k, "float32") for k in range(3)])[None]
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], "float32")
+    out = run_op("roi_align", {"X": x, "ROIs": rois},
+                 {"pooled_height": 2, "pooled_width": 2,
+                  "spatial_scale": 1.0})["Out"][0]
+    for k in range(3):
+        np.testing.assert_allclose(out[0, k], k, atol=1e-5)
+
+
+def test_multiclass_nms_keep_top_k_exceeds_pool():
+    """Regression: keep_top_k larger than the candidate pool must clamp,
+    not crash (top_k requires k <= size)."""
+    rng = np.random.RandomState(8)
+    boxes = rng.rand(1, 6, 4).astype("float32")
+    boxes[..., 2:] = boxes[..., :2] + 0.2
+    scores = rng.rand(1, 2, 6).astype("float32")   # one fg class
+    out = run_op("multiclass_nms", {"BBoxes": boxes, "Scores": scores},
+                 {"background_label": 0, "score_threshold": 0.0,
+                  "nms_top_k": -1, "nms_threshold": 0.9, "keep_top_k": 100},
+                 outputs=("Out", "NmsRoisNum"))
+    assert out["Out"][0].shape[1] <= 6
+
+
+def test_rpn_target_assign_quota_exceeds_anchors():
+    anchors = np.array([[0, 0, 15, 15], [16, 16, 31, 31]], "float32")
+    gt = np.array([[0, 0, 15, 15]], "float32")
+    out = run_op("rpn_target_assign", {"Anchor": anchors, "GtBoxes": gt},
+                 {"rpn_batch_size_per_im": 256, "rpn_fg_fraction": 0.5},
+                 outputs=("LocationIndex", "ScoreIndex"), rng_seed=1)
+    assert out["LocationIndex"][0].shape[0] <= 2
+
+
+def test_retinanet_per_image_clipping():
+    """Regression: each image clips to its own im_info."""
+    deltas = [np.zeros((2, 4, 4), "float32")]
+    scores = [np.full((2, 4, 1), 0.9, "float32")]
+    anchors = [np.tile(np.array([[0, 0, 299, 299]], "float32"), (4, 1))]
+    im_info = np.array([[400.0, 400.0, 1.0], [100.0, 100.0, 1.0]],
+                       "float32")
+    out = run_op("retinanet_detection_output",
+                 {"BBoxes": deltas, "Scores": scores, "Anchors": anchors,
+                  "ImInfo": im_info},
+                 {"score_threshold": 0.05, "nms_top_k": 4,
+                  "nms_threshold": 0.3, "keep_top_k": 4},
+                 outputs=("Out",))["Out"][0]
+    # image 0 keeps the 300-box; image 1 clips to 99
+    assert out[0, 0, 4] > 250
+    assert out[1, 0, 4] <= 99.0 + 1e-5
+
+
+def test_yolov3_loss_gt_score_scales_loss():
+    n, h, w, c = 1, 4, 4, 2
+    anchors, mask = [32, 32], [0]
+    gtbox = np.array([[[0.5, 0.5, 0.25, 0.25]]], "float32")
+    gtlabel = np.array([[1]], "int64")
+    x = (np.random.RandomState(0).randn(n, 1 * (5 + c), h, w) * 0.5
+         ).astype("float32")
+    attrs = {"anchors": anchors, "anchor_mask": mask, "class_num": c,
+             "ignore_thresh": 0.7, "downsample_ratio": 32,
+             "use_label_smooth": False}
+    full = run_op("yolov3_loss",
+                  {"X": x, "GTBox": gtbox, "GTLabel": gtlabel,
+                   "GTScore": np.ones((1, 1), "float32")},
+                  attrs, outputs=("Loss",))["Loss"][0][0]
+    half = run_op("yolov3_loss",
+                  {"X": x, "GTBox": gtbox, "GTLabel": gtlabel,
+                   "GTScore": np.full((1, 1), 0.5, "float32")},
+                  attrs, outputs=("Loss",))["Loss"][0][0]
+    assert half < full
