@@ -14,7 +14,10 @@ from ..layer_helper import LayerHelper
 __all__ = [
     "sequence_mask", "sequence_pool", "sequence_softmax", "sequence_reverse",
     "sequence_expand", "sequence_concat", "sequence_slice", "im2sequence",
-    "sequence_first_step", "sequence_last_step",
+    "sequence_first_step", "sequence_last_step", "sequence_pad",
+    "sequence_unpad", "sequence_conv", "sequence_enumerate",
+    "sequence_erase", "sequence_expand_as", "sequence_reshape",
+    "sequence_scatter", "sequence_topk_avg_pooling",
 ]
 
 
@@ -123,4 +126,118 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None
                      outputs={"Out": out},
                      attrs={"kernels": list(ks), "strides": list(st),
                             "paddings": list(pd)})
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, length=None, name=None):
+    helper = LayerHelper("sequence_pad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ln = helper.create_variable_for_type_inference("int64")
+    inputs = {"X": x, "PadValue": pad_value}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(type="sequence_pad", inputs=inputs,
+                     outputs={"Out": out, "Length": ln},
+                     attrs={"padded_length": -1 if maxlen is None
+                            else int(maxlen)})
+    return out, ln
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    ln = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="sequence_unpad",
+                     inputs={"X": x, "Length": length},
+                     outputs={"Out": out, "Length": ln})
+    return out
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, length=None, name=None):
+    helper = LayerHelper("sequence_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    d = int(input.shape[-1])
+    filt = helper.create_parameter(param_attr,
+                                   shape=[filter_size * d, num_filters],
+                                   dtype=input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": input, "Filter": filt}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(type="sequence_conv", inputs=inputs,
+                     outputs={"Out": out},
+                     attrs={"contextLength": filter_size,
+                            "contextStart": padding_start
+                            if padding_start is not None
+                            else -(filter_size - 1) // 2,
+                            "contextStride": filter_stride})
+    pre_act = helper.append_bias_op(out, dim_start=2, bias_attr=bias_attr)
+    return helper.append_activation(pre_act, act)
+
+
+def sequence_enumerate(input, win_size, pad_value=0, length=None, name=None):
+    helper = LayerHelper("sequence_enumerate", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": input}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(type="sequence_enumerate", inputs=inputs,
+                     outputs={"Out": out},
+                     attrs={"win_size": win_size, "pad_value": pad_value})
+    return out
+
+
+def sequence_erase(input, tokens, length=None, name=None):
+    helper = LayerHelper("sequence_erase", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    ln = helper.create_variable_for_type_inference("int64")
+    inputs = {"X": input}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(type="sequence_erase", inputs=inputs,
+                     outputs={"Out": out, "Length": ln},
+                     attrs={"tokens": list(tokens)})
+    return out, ln
+
+
+def sequence_expand_as(x, y, name=None):
+    helper = LayerHelper("sequence_expand_as", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_expand_as", inputs={"X": x, "Y": y},
+                     outputs={"Out": out})
+    return out
+
+
+def sequence_reshape(input, new_dim, name=None):
+    helper = LayerHelper("sequence_reshape", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="sequence_reshape", inputs={"X": input},
+                     outputs={"Out": out}, attrs={"new_dim": new_dim})
+    return out
+
+
+def sequence_scatter(input, index, updates, length=None, name=None):
+    helper = LayerHelper("sequence_scatter", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": input, "Ids": index, "Updates": updates}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op(type="sequence_scatter", inputs=inputs,
+                     outputs={"Out": out})
+    return out
+
+
+def sequence_topk_avg_pooling(input, topks, channel_num=None, row=None,
+                              col=None, name=None):
+    helper = LayerHelper("sequence_topk_avg_pooling", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": input}
+    if row is not None:
+        inputs["ROW"] = row
+    if col is not None:
+        inputs["COLUMN"] = col
+    helper.append_op(type="sequence_topk_avg_pooling", inputs=inputs,
+                     outputs={"Out": out}, attrs={"topks": list(topks)})
     return out

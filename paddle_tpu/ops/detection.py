@@ -385,19 +385,17 @@ def bipartite_match(ins, attrs, ctx):
 
     def one(d):
         def step(carry, _):
-            dm, midx, mdist, row_used = carry
+            dm, midx, mdist = carry
             flat = jnp.argmax(dm)
             i, j = flat // c, flat % c
             ok = dm[i, j] > 0
             midx = jnp.where(ok, midx.at[j].set(i.astype(jnp.int32)), midx)
             mdist = jnp.where(ok, mdist.at[j].set(dm[i, j]), mdist)
-            row_used = jnp.where(ok, row_used.at[i].set(True), row_used)
             dm = jnp.where(ok, dm.at[i, :].set(-1.0).at[:, j].set(-1.0), dm)
-            return (dm, midx, mdist, row_used), None
+            return (dm, midx, mdist), None
 
-        init = (d, jnp.full((c,), -1, jnp.int32), jnp.zeros((c,), d.dtype),
-                jnp.zeros((r,), bool))
-        (dm, midx, mdist, row_used), _ = jax.lax.scan(
+        init = (d, jnp.full((c,), -1, jnp.int32), jnp.zeros((c,), d.dtype))
+        (dm, midx, mdist), _ = jax.lax.scan(
             step, init, None, length=min(r, c))
         if match_type == "per_prediction":
             best_row = jnp.argmax(d, axis=0).astype(jnp.int32)
@@ -571,6 +569,7 @@ def box_decoder_and_assign(ins, attrs, ctx):
     pv = ins["PriorBoxVar"][0]            # [R, 4] or attr-less
     deltas = ins["TargetBox"][0]          # [R, 4*C]
     scores = ins["BoxScore"][0]           # [R, C]
+    box_clip = float(attrs.get("box_clip", 4.135))
     r, c4 = deltas.shape
     ncls = c4 // 4
     d = deltas.reshape(r, ncls, 4) * pv[:, None, :]
@@ -580,8 +579,8 @@ def box_decoder_and_assign(ins, attrs, ctx):
     pcy = prior[:, 1] + ph * 0.5
     ocx = d[..., 0] * pw[:, None] + pcx[:, None]
     ocy = d[..., 1] * ph[:, None] + pcy[:, None]
-    ow = jnp.exp(jnp.minimum(d[..., 2], 10.0)) * pw[:, None]
-    oh = jnp.exp(jnp.minimum(d[..., 3], 10.0)) * ph[:, None]
+    ow = jnp.exp(jnp.minimum(d[..., 2], box_clip)) * pw[:, None]
+    oh = jnp.exp(jnp.minimum(d[..., 3], box_clip)) * ph[:, None]
     decoded = jnp.stack([ocx - ow / 2, ocy - oh / 2,
                          ocx + ow / 2 - 1.0, ocy + oh / 2 - 1.0], axis=-1)
     best = jnp.argmax(scores, axis=1)
@@ -615,9 +614,8 @@ def multiclass_nms(ins, attrs, ctx):
     # clamp to the flat candidate pool (reference keeps at most that many)
     n_fg_cls = c - (1 if 0 <= bg < c else 0)
     pool = n_fg_cls * per_class
-    if keep_top_k <= 0:
-        keep_top_k = min(pool, 128)
-    keep_top_k = min(keep_top_k, pool)
+    # keep_top_k=-1 means "keep everything" (the pool is the static bound)
+    keep_top_k = pool if keep_top_k <= 0 else min(keep_top_k, pool)
 
     def one_image(boxes, sc):
         def one_class(cls_scores):
@@ -718,14 +716,28 @@ def generate_proposals(ins, attrs, ctx):
 def collect_fpn_proposals(ins, attrs, ctx):
     """reference: detection/collect_fpn_proposals_op.cc — concat per-level
     RoIs, keep global top post_nms_topN by score."""
-    rois = jnp.concatenate([r.reshape(-1, 4) for r in ins["MultiLevelRois"]
-                            if r is not None], axis=0)
-    scores = jnp.concatenate([s.reshape(-1) for s in
-                              ins["MultiLevelScores"] if s is not None],
-                             axis=0)
-    post_n = min(int(attrs.get("post_nms_topN", 100)), scores.shape[0])
-    top_s, top_i = jax.lax.top_k(scores, post_n)
-    return {"FpnRois": rois[top_i], "RoisNum": jnp.asarray([post_n])}
+    rois_in = [r for r in ins["MultiLevelRois"] if r is not None]
+    scores_in = [s for s in ins["MultiLevelScores"] if s is not None]
+    # accept [R,4] (single image) or [N,R,4] (batched); top-k per image
+    if rois_in[0].ndim == 2:
+        rois_in = [r[None] for r in rois_in]
+        scores_in = [s.reshape(1, -1) for s in scores_in]
+        squeeze = True
+    else:
+        squeeze = False
+    rois = jnp.concatenate([r.reshape(r.shape[0], -1, 4)
+                            for r in rois_in], axis=1)      # [N, R, 4]
+    scores = jnp.concatenate([s.reshape(s.shape[0], -1)
+                              for s in scores_in], axis=1)  # [N, R]
+    post_n = min(int(attrs.get("post_nms_topN", 100)), scores.shape[1])
+
+    def one(ro, sc):
+        top_s, top_i = jax.lax.top_k(sc, post_n)
+        return ro[top_i]
+
+    out = jax.vmap(one)(rois, scores)
+    num = jnp.full((rois.shape[0],), post_n, jnp.int32)
+    return {"FpnRois": out[0] if squeeze else out, "RoisNum": num}
 
 
 @register_op("distribute_fpn_proposals", grad=None)
@@ -958,10 +970,11 @@ def yolov3_loss(ins, attrs, ctx):
     scale = (2.0 - gw * gh) * gscore      # box-size weighting (reference)
     loss_x = scale * _bce(at(tx), gx * w - gi.astype(gx.dtype))
     loss_y = scale * _bce(at(ty), gy * h - gj.astype(gy.dtype))
-    loss_w = 0.5 * scale * (at(tw) - jnp.log(jnp.maximum(
-        gwp / aw[slot], 1e-9))) ** 2
-    loss_h = 0.5 * scale * (at(th) - jnp.log(jnp.maximum(
-        ghp / ah[slot], 1e-9))) ** 2
+    # w/h use L1 loss (yolov3_loss_op.h:133-134)
+    loss_w = scale * jnp.abs(at(tw) - jnp.log(jnp.maximum(
+        gwp / aw[slot], 1e-9)))
+    loss_h = scale * jnp.abs(at(th) - jnp.log(jnp.maximum(
+        ghp / ah[slot], 1e-9)))
     loc = jnp.sum(jnp.where(resp, loss_x + loss_y + loss_w + loss_h, 0.0),
                   axis=1)
 
@@ -994,18 +1007,20 @@ def yolov3_loss(ins, attrs, ctx):
     obj_target = obj_target.at[jnp.arange(n)[:, None], slot, gj, gi].max(
         jnp.where(resp, 1.0, 0.0))
     # positive cells carry their gt's mixup score as the BCE weight
-    obj_score = jnp.ones_like(tobj).at[
+    # (scatter-max into zeros — a ones base would absorb scores < 1)
+    pos_score = jnp.zeros_like(tobj).at[
         jnp.arange(n)[:, None], slot, gj, gi].max(
-        jnp.where(resp, gscore, 1.0))
+        jnp.where(resp, gscore, 0.0))
     obj_w = jnp.where((obj_target > 0) | ~ignore, 1.0, 0.0) * \
-        jnp.where(obj_target > 0, obj_score, 1.0)
+        jnp.where(obj_target > 0, pos_score, 1.0)
     obj = jnp.sum(_bce(jax.nn.sigmoid(tobj), obj_target) * obj_w,
                   axis=(1, 2, 3))
 
-    # classification at responsible cells
-    delta = 1.0 / class_num if use_label_smooth else 0.0
+    # classification at responsible cells; label smoothing per
+    # yolov3_loss_op.h:282-287: pos = 1 - w, neg = w, w = min(1/C, 1/40)
+    delta = min(1.0 / class_num, 1.0 / 40.0) if use_label_smooth else 0.0
     cls_t = (gtlabel[..., None] == jnp.arange(class_num)).astype(x.dtype)
-    cls_t = cls_t * (1.0 - delta) + delta * (1.0 / class_num)
+    cls_t = cls_t * (1.0 - 2.0 * delta) + delta
     pcls = jax.nn.sigmoid(
         tcls[jnp.arange(n)[:, None], slot, :, gj, gi])       # [N, B, C]
     cls = jnp.sum(jnp.where(resp[..., None],

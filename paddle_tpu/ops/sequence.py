@@ -133,3 +133,179 @@ def im2sequence(ins, attrs, ctx):
         x, (kh, kw), (sh, sw), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"))
     # patches: [N, C*kh*kw, oh, ow] -> [N, oh*ow, C*kh*kw]
     return {"Out": patches.reshape(n, c * kh * kw, oh * ow).transpose(0, 2, 1)}
+
+
+@register_op("sequence_pad", nondiff_inputs=("PadValue", "Length"))
+def sequence_pad(ins, attrs, ctx):
+    """reference: sequence_ops/sequence_pad_op.cc — LoD → padded batch.
+    Here the batch is already [N, T, ...]: re-pad to padded_length with
+    PadValue beyond each row's Length (truncating or extending T)."""
+    x = ins["X"][0]
+    pad_value = ins["PadValue"][0].reshape(()) if ins.get("PadValue") and \
+        ins["PadValue"][0] is not None else jnp.asarray(0.0, x.dtype)
+    n, t = x.shape[0], x.shape[1]
+    plen = int(attrs.get("padded_length", -1))
+    if plen < 0:
+        plen = t
+    if plen > t:
+        pad_width = [(0, 0), (0, plen - t)] + [(0, 0)] * (x.ndim - 2)
+        x = jnp.pad(x, pad_width, constant_values=0)
+    elif plen < t:
+        x = x[:, :plen]
+    if ins.get("Length") and ins["Length"][0] is not None:
+        lengths = jnp.minimum(ins["Length"][0].reshape(-1), plen)
+    else:
+        lengths = jnp.full((n,), min(t, plen), jnp.int32)
+    m = _mask(lengths, plen, jnp.bool_)
+    m = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    out = jnp.where(m, x, pad_value.astype(x.dtype))
+    return {"Out": out, "Length": lengths.astype(jnp.int64)}
+
+
+@register_op("sequence_unpad", nondiff_inputs=("Length",))
+def sequence_unpad(ins, attrs, ctx):
+    """reference: sequence_ops/sequence_unpad_op.cc — strips padding back
+    to LoD; statically: zero positions past Length (consumers read Length)."""
+    x = ins["X"][0]
+    lengths = ins["Length"][0].reshape(-1)
+    m = _mask(lengths, x.shape[1], jnp.bool_)
+    m = m.reshape(m.shape + (1,) * (x.ndim - 2))
+    return {"Out": jnp.where(m, x, jnp.asarray(0, x.dtype)),
+            "Length": lengths.astype(jnp.int64)}
+
+
+@register_op("sequence_conv", nondiff_inputs=("Length",))
+def sequence_conv(ins, attrs, ctx):
+    """reference: sequence_ops/sequence_conv_op.cc — 1-D convolution over
+    time with a [context_length * D, out] filter; frames outside
+    [0, length) contribute zeros (the reference's context padding)."""
+    x = ins["X"][0]                        # [N, T, D]
+    filt = ins["Filter"][0]                # [ctx_len * D, out]
+    ctx_len = int(attrs.get("contextLength", 3))
+    ctx_start = int(attrs.get("contextStart", -(ctx_len - 1) // 2))
+    n, t, d = x.shape
+    if ins.get("Length") and ins["Length"][0] is not None:
+        m = _mask(ins["Length"][0].reshape(-1), t, x.dtype)[..., None]
+        x = x * m
+    cols = []
+    for k in range(ctx_len):
+        off = ctx_start + k
+        shifted = jnp.roll(x, -off, axis=1)
+        pos = jnp.arange(t) + off
+        ok = ((pos >= 0) & (pos < t))[None, :, None]
+        cols.append(jnp.where(ok, shifted, 0.0))
+    im2col = jnp.concatenate(cols, axis=-1)        # [N, T, ctx_len*D]
+    return {"Out": jnp.einsum("ntc,co->nto", im2col, filt)}
+
+
+@register_op("sequence_enumerate", grad=None, nondiff_inputs=("X", "Length"))
+def sequence_enumerate(ins, attrs, ctx):
+    """reference: sequence_ops/sequence_enumerate_op.cc — sliding win_size
+    windows of ids; positions past the row end hold pad_value."""
+    x = ins["X"][0]                        # [N, T] int
+    win = int(attrs["win_size"])
+    pad = int(attrs.get("pad_value", 0))
+    n, t = x.shape[0], x.shape[1]
+    if ins.get("Length") and ins["Length"][0] is not None:
+        lengths = ins["Length"][0].reshape(-1)
+    else:
+        lengths = jnp.full((n,), t, jnp.int32)
+    pos = jnp.arange(t)[None, :, None] + jnp.arange(win)[None, None, :]
+    idx = jnp.minimum(pos, t - 1)
+    gathered = jnp.take_along_axis(
+        jnp.broadcast_to(x[:, :, None], (n, t, win)),
+        jnp.broadcast_to(idx, (n, t, win)), axis=1)
+    ok = pos < lengths[:, None, None]
+    return {"Out": jnp.where(ok, gathered, pad).astype(x.dtype)}
+
+
+@register_op("sequence_erase", grad=None, nondiff_inputs=("X", "Length"))
+def sequence_erase(ins, attrs, ctx):
+    """reference: sequence_ops/sequence_erase_op.cc — drop listed tokens
+    and compact left; freed tail positions hold 0 and Out_length shrinks
+    (a stable sort on the erase flag replaces the reference's compaction)."""
+    x = ins["X"][0]                        # [N, T] int
+    tokens = [int(v) for v in attrs.get("tokens", [])]
+    n, t = x.shape
+    if ins.get("Length") and ins["Length"][0] is not None:
+        lengths = ins["Length"][0].reshape(-1)
+    else:
+        lengths = jnp.full((n,), t, jnp.int32)
+    valid = _mask(lengths, t, jnp.bool_)
+    erase = jnp.zeros_like(valid)
+    for tok in tokens:
+        erase |= x == tok
+    keep = valid & ~erase
+    # stable order: kept first, original order preserved
+    order = jnp.argsort(jnp.where(keep, 0, 1), axis=1, stable=True)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    new_len = jnp.sum(keep, axis=1)
+    out = jnp.where(_mask(new_len, t, jnp.bool_), compacted, 0)
+    return {"Out": out.astype(x.dtype), "Length": new_len.astype(jnp.int64)}
+
+
+@register_op("sequence_expand_as", nondiff_inputs=("Y",))
+def sequence_expand_as(ins, attrs, ctx):
+    """reference: sequence_ops/sequence_expand_as_op.cc — broadcast each
+    row of X along Y's time axis ([N, D] → [N, T, D])."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    t = y.shape[1]
+    if x.ndim == 2:
+        return {"Out": jnp.broadcast_to(x[:, None, :],
+                                        (x.shape[0], t, x.shape[1]))}
+    return {"Out": jnp.broadcast_to(x, (x.shape[0], t) + x.shape[2:])}
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(ins, attrs, ctx):
+    """reference: sequence_ops/sequence_reshape_op.cc — trade time steps
+    for feature width: [N, T, D] → [N, T*D/new_dim, new_dim]."""
+    x = ins["X"][0]
+    new_dim = int(attrs["new_dim"])
+    n, t, d = x.shape
+    return {"Out": x.reshape(n, t * d // new_dim, new_dim)}
+
+
+@register_op("sequence_scatter", nondiff_inputs=("Ids", "Length"))
+def sequence_scatter(ins, attrs, ctx):
+    """reference: sequence_ops/sequence_scatter_op.cc — per row i, add
+    Updates[i, j] into X[i, Ids[i, j]] for j < Length[i]."""
+    x = ins["X"][0]                        # [N, D]
+    ids = ins["Ids"][0].astype(jnp.int32)  # [N, T]
+    upd = ins["Updates"][0]                # [N, T]
+    if ins.get("Length") and ins["Length"][0] is not None:
+        m = _mask(ins["Length"][0].reshape(-1), ids.shape[1], upd.dtype)
+        upd = upd * m
+    def one(row, i_row, u_row):
+        return row.at[i_row].add(u_row)
+    return {"Out": jax.vmap(one)(x, ids, upd)}
+
+
+@register_op("sequence_topk_avg_pooling",
+             nondiff_inputs=("ROW", "COLUMN"))
+def sequence_topk_avg_pooling(ins, attrs, ctx):
+    """reference: sequence_ops/sequence_topk_avg_pooling_op.cc — for each
+    (row position, channel), average the top-k values across the column
+    axis, for every k in `topks`. Static layout: X [N, C, H, W] (+optional
+    ROW/COLUMN lengths) → Out [N, H, C * len(topks)]."""
+    x = ins["X"][0]
+    topks = [int(k) for k in attrs["topks"]]
+    n, c, h, w = x.shape
+    if ins.get("COLUMN") and ins["COLUMN"][0] is not None:
+        col_len = ins["COLUMN"][0].reshape(-1)
+        cm = _mask(col_len, w, x.dtype)            # [N, W]
+        x = jnp.where(cm[:, None, None, :] > 0, x, -jnp.inf)
+    kmax = min(max(topks), w)
+    top = jax.lax.top_k(x, kmax)[0]                # [N, C, H, kmax]
+    top = jnp.where(jnp.isfinite(top), top, 0.0)
+    outs = []
+    for k in topks:
+        k_eff = min(k, kmax)
+        outs.append(jnp.sum(top[..., :k_eff], axis=-1) / float(k))
+    out = jnp.stack(outs, axis=-1)                 # [N, C, H, K]
+    out = out.transpose(0, 2, 1, 3).reshape(n, h, c * len(topks))
+    if ins.get("ROW") and ins["ROW"][0] is not None:
+        rm = _mask(ins["ROW"][0].reshape(-1), h, out.dtype)
+        out = out * rm[:, :, None]
+    return {"Out": out, "pos": None}

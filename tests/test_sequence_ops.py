@@ -1,0 +1,147 @@
+"""Sequence op tests (padded-batch semantics) vs numpy references.
+
+Reference pattern: unittests/test_sequence_pad_op.py, test_sequence_conv.py,
+test_sequence_enumerate_op.py, test_sequence_erase_op.py, etc."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_op
+
+
+def test_sequence_pad_extends_and_fills():
+    x = np.arange(12, dtype="float32").reshape(2, 3, 2)
+    length = np.array([2, 3], "int64")
+    out = run_op("sequence_pad",
+                 {"X": x, "PadValue": np.array([9.0], "float32"),
+                  "Length": length},
+                 {"padded_length": 5}, outputs=("Out", "Length"))
+    o = out["Out"][0]
+    assert o.shape == (2, 5, 2)
+    np.testing.assert_allclose(o[0, :2], x[0, :2])
+    assert (o[0, 2:] == 9.0).all()
+    assert (o[1, 3:] == 9.0).all()
+    np.testing.assert_array_equal(out["Length"][0], [2, 3])
+
+
+def test_sequence_unpad_masks_past_length():
+    x = np.ones((2, 4, 3), "float32")
+    out = run_op("sequence_unpad",
+                 {"X": x, "Length": np.array([1, 4], "int64")},
+                 outputs=("Out",))["Out"][0]
+    assert (out[0, 1:] == 0).all()
+    assert (out[1] == 1).all()
+
+
+def test_sequence_conv_matches_explicit_im2col():
+    rng = np.random.RandomState(0)
+    n, t, d, o = 2, 5, 3, 4
+    ctx_len, ctx_start = 3, -1
+    x = rng.randn(n, t, d).astype("float64")
+    filt = rng.randn(ctx_len * d, o).astype("float64")
+    length = np.array([5, 3], "int64")
+    out = run_op("sequence_conv",
+                 {"X": x, "Filter": filt, "Length": length},
+                 {"contextLength": ctx_len, "contextStart": ctx_start})
+    xm = x.copy()
+    xm[1, 3:] = 0.0
+    want = np.zeros((n, t, o))
+    for i in range(n):
+        for j in range(t):
+            col = np.zeros((ctx_len, d))
+            for k in range(ctx_len):
+                p = j + ctx_start + k
+                if 0 <= p < t:
+                    col[k] = xm[i, p]
+            want[i, j] = col.reshape(-1) @ filt
+    np.testing.assert_allclose(out["Out"][0], want, rtol=1e-6)
+    check_grad("sequence_conv", {"X": x, "Filter": filt, "Length": length},
+               {"contextLength": ctx_len, "contextStart": ctx_start},
+               inputs_to_check=["X", "Filter"])
+
+
+def test_sequence_enumerate_windows():
+    x = np.array([[1, 2, 3, 4]], "int64")
+    out = run_op("sequence_enumerate",
+                 {"X": x, "Length": np.array([3], "int64")},
+                 {"win_size": 2, "pad_value": 0})["Out"][0]
+    np.testing.assert_array_equal(out[0], [[1, 2], [2, 3], [3, 0], [0, 0]])
+
+
+def test_sequence_erase_compacts():
+    x = np.array([[2, 5, 2, 7, 9, 0]], "int64")
+    out = run_op("sequence_erase",
+                 {"X": x, "Length": np.array([5], "int64")},
+                 {"tokens": [2, 9]}, outputs=("Out", "Length"))
+    np.testing.assert_array_equal(out["Out"][0][0], [5, 7, 0, 0, 0, 0])
+    assert int(out["Length"][0][0]) == 2
+
+
+def test_sequence_expand_as_broadcasts_rows():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+    y = np.zeros((2, 3, 5), "float32")
+    out = run_op("sequence_expand_as", {"X": x, "Y": y})["Out"][0]
+    assert out.shape == (2, 3, 2)
+    np.testing.assert_allclose(out[0], [[1, 2]] * 3)
+
+
+def test_sequence_reshape_ratio():
+    x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+    out = run_op("sequence_reshape", {"X": x}, {"new_dim": 6})["Out"][0]
+    assert out.shape == (2, 2, 6)
+    np.testing.assert_allclose(out.reshape(2, -1), x.reshape(2, -1))
+
+
+def test_sequence_scatter_adds_at_ids():
+    x = np.zeros((2, 5), "float32")
+    ids = np.array([[0, 2, 2], [4, 1, 0]], "int64")
+    upd = np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]], "float32")
+    out = run_op("sequence_scatter",
+                 {"X": x, "Ids": ids, "Updates": upd,
+                  "Length": np.array([3, 2], "int64")})["Out"][0]
+    np.testing.assert_allclose(out[0], [1, 0, 5, 0, 0])
+    np.testing.assert_allclose(out[1], [0, 5, 0, 0, 4])
+
+
+def test_sequence_topk_avg_pooling():
+    x = np.zeros((1, 2, 2, 4), "float32")
+    x[0, 0, 0] = [4, 1, 3, 2]
+    x[0, 1, 0] = [10, 20, 30, 40]
+    out = run_op("sequence_topk_avg_pooling", {"X": x},
+                 {"topks": [1, 2]})["Out"][0]
+    assert out.shape == (1, 2, 4)     # [N, H, C*K]
+    # h=0: c0 top1=4, top2 avg=(4+3)/2; c1 top1=40, top2=(40+30)/2
+    np.testing.assert_allclose(out[0, 0], [4.0, 3.5, 40.0, 35.0])
+
+
+def test_sequence_layers_in_program():
+    """Text-CNN style: embedding → sequence_conv → sequence_pool trains
+    (reference pattern: understand_sentiment conv model)."""
+    import paddle_tpu as pt
+
+    rng = np.random.RandomState(1)
+    V, T, N = 20, 8, 32
+    words = rng.randint(0, V, (N, T)).astype("int64")
+    labels = (words.sum(1) % 2).astype("int64")[:, None]
+    lens = np.full((N,), T, "int64")
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        w = pt.layers.data(name="w", shape=[T], dtype="int64")
+        ln = pt.layers.data(name="ln", shape=[], dtype="int64")
+        y = pt.layers.data(name="y", shape=[1], dtype="int64")
+        emb = pt.layers.embedding(w, size=[V, 16])
+        conv = pt.layers.sequence_conv(emb, num_filters=16, filter_size=3,
+                                       act="relu", length=ln)
+        pooled = pt.layers.sequence_pool(conv, "max", length=ln)
+        logits = pt.layers.fc(pooled, size=2)
+        loss = pt.layers.mean(pt.layers.softmax_with_cross_entropy(logits, y))
+        pt.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        ls = [float(np.asarray(exe.run(
+            main, feed={"w": words, "ln": lens, "y": labels},
+            fetch_list=[loss])[0]).reshape(()))
+            for _ in range(60)]
+        assert ls[-1] < ls[0] * 0.6, (ls[0], ls[-1])
