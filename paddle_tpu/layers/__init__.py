@@ -11,6 +11,7 @@ from .rnn import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .collective import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from . import math_op_patch

@@ -22,3 +22,4 @@ from . import collective
 from . import crf
 from . import classify
 from . import beam
+from . import misc

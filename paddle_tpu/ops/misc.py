@@ -1,0 +1,495 @@
+"""Miscellaneous NN / loss / metric ops rounding out the reference zoo.
+
+Reference behaviors (all paddle/fluid/operators/): affine_channel_op.cc,
+affine_grid_op.cc, lrn_op.cc, data_norm_op.cc, spectral_norm_op.cc,
+row_conv_op.cc, shuffle_channel_op.cc, space_to_depth_op.cc, unfold_op.cc,
+crop_op.cc + crop_tensor_op.cc, random_crop_op.cc, sampling_id_op.cc,
+add_position_encoding_op.cc, rank_loss_op.cc, log_loss_op.cc,
+bpr_loss_op.cc (-mean_j log σ(x_y - x_j)), npair_loss (layers/nn.py),
+center_loss_op.cc, teacher_student_sigmoid_loss_op.h:43-63 (piecewise on
+the label code), modified_huber_loss_op.h:40-49, edit_distance_op.cc
+(Levenshtein DP), ctc_align_op.cc (merge repeats, drop blanks), and
+warpctc_op.cc (CTC loss — computed with optax.ctc_loss, the same
+log-space forward algorithm the external warp-ctc library implements).
+
+TPU-native: everything static-shape; DP recursions are lax.scan; the CTC
+"compaction" ops use the stable-sort trick instead of LoD shrinking.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .sequence import _compact_left, _lengths
+
+
+@register_op("affine_channel")
+def affine_channel(ins, attrs, ctx):
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(-1)
+    bias = ins["Bias"][0].reshape(-1)
+    layout = attrs.get("data_layout", "NCHW")
+    if layout == "NCHW":
+        shp = (1, -1) + (1,) * (x.ndim - 2)
+    else:
+        shp = (1,) * (x.ndim - 1) + (-1,)
+    return {"Out": x * scale.reshape(shp) + bias.reshape(shp)}
+
+
+@register_op("affine_grid", nondiff_inputs=("OutputShape",))
+def affine_grid(ins, attrs, ctx):
+    """theta [N,2,3] → normalized sampling grid [N,H,W,2]."""
+    theta = ins["Theta"][0]
+    if ins.get("OutputShape") and ins["OutputShape"][0] is not None:
+        shape = [int(v) for v in np.asarray(ins["OutputShape"][0])]
+    else:
+        shape = [int(v) for v in attrs["output_shape"]]
+    n, _, h, w = shape
+    ys = jnp.linspace(-1.0, 1.0, h)
+    xs = jnp.linspace(-1.0, 1.0, w)
+    gx, gy = jnp.meshgrid(xs, ys)                     # [H, W]
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)         # [H, W, 3]
+    out = jnp.einsum("hwk,nck->nhwc", base, theta)    # [N, H, W, 2]
+    return {"Output": out}
+
+
+@register_op("lrn", intermediate_outputs=("MidOut",))
+def lrn(ins, attrs, ctx):
+    """reference: lrn_op.cc — mid = k + alpha * Σ_window x², out = x·mid^-β."""
+    x = ins["X"][0]                                   # [N, C, H, W]
+    n_size = int(attrs.get("n", 5))
+    k = float(attrs.get("k", 2.0))
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    half = n_size // 2
+    sq = x * x
+    pad = [(0, 0), (half, half)] + [(0, 0)] * (x.ndim - 2)
+    sq = jnp.pad(sq, pad)
+    acc = sum(sq[:, i:i + x.shape[1]] for i in range(n_size))
+    mid = k + alpha * acc
+    return {"Out": x * mid ** (-beta), "MidOut": mid}
+
+
+@register_op("data_norm", nondiff_inputs=("BatchSize", "BatchSum",
+                                          "BatchSquareSum"),
+             intermediate_outputs=("Means", "Scales"))
+def data_norm(ins, attrs, ctx):
+    """reference: data_norm_op.cc — normalize by running accumulators
+    (CTR models): mean = Σx/n, scale = sqrt(n/Σx²)·... per feature."""
+    x = ins["X"][0]
+    bsize = ins["BatchSize"][0].reshape(-1)
+    bsum = ins["BatchSum"][0].reshape(-1)
+    bsqs = ins["BatchSquareSum"][0].reshape(-1)
+    means = bsum / bsize
+    scales = jnp.sqrt(bsize / bsqs)
+    return {"Y": (x - means[None, :]) * scales[None, :],
+            "Means": means, "Scales": scales}
+
+
+@register_op("spectral_norm", nondiff_inputs=("U", "V"))
+def spectral_norm(ins, attrs, ctx):
+    """reference: spectral_norm_op.cc — normalize Weight by its largest
+    singular value, estimated by power_iters rounds from U/V."""
+    w = ins["Weight"][0]
+    u = ins["U"][0].reshape(-1)
+    v = ins["V"][0].reshape(-1)
+    dim = int(attrs.get("dim", 0))
+    power_iters = int(attrs.get("power_iters", 1))
+    eps = float(attrs.get("eps", 1e-12))
+    perm = (dim,) + tuple(i for i in range(w.ndim) if i != dim)
+    wm = jnp.transpose(w, perm).reshape(w.shape[dim], -1)   # [H, W']
+
+    def it(carry, _):
+        u_, v_ = carry
+        v_ = wm.T @ u_
+        v_ = v_ / (jnp.linalg.norm(v_) + eps)
+        u_ = wm @ v_
+        u_ = u_ / (jnp.linalg.norm(u_) + eps)
+        return (u_, v_), None
+
+    (u, v), _ = jax.lax.scan(it, (u, v), None, length=max(power_iters, 1))
+    sigma = u @ wm @ v
+    return {"Out": w / sigma}
+
+
+@register_op("row_conv", nondiff_inputs=())
+def row_conv(ins, attrs, ctx):
+    """reference: row_conv_op.cc — lookahead conv (Deep Speech): out[t] =
+    Σ_{k<K} w[k] ⊙ x[t+k], per feature dim."""
+    x = ins["X"][0]                        # [N, T, D]
+    filt = ins["Filter"][0]                # [K, D]
+    k, d = filt.shape
+    t = x.shape[1]
+    out = jnp.zeros_like(x)
+    for i in range(k):
+        shifted = jnp.roll(x, -i, axis=1)
+        ok = (jnp.arange(t) + i < t)[None, :, None]
+        out = out + jnp.where(ok, shifted, 0.0) * filt[i][None, None, :]
+    return {"Out": out}
+
+
+@register_op("shuffle_channel")
+def shuffle_channel(ins, attrs, ctx):
+    x = ins["X"][0]
+    g = int(attrs.get("group", 1))
+    n, c, h, w = x.shape
+    return {"Out": x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4)
+            .reshape(n, c, h, w)}
+
+
+@register_op("space_to_depth")
+def space_to_depth(ins, attrs, ctx):
+    x = ins["X"][0]
+    bs = int(attrs["blocksize"])
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    return {"Out": x.transpose(0, 3, 5, 1, 2, 4)
+            .reshape(n, c * bs * bs, h // bs, w // bs)}
+
+
+@register_op("unfold")
+def unfold(ins, attrs, ctx):
+    """reference: unfold_op.cc (im2col): [N,C,H,W] → [N, C·kh·kw, L]."""
+    x = ins["X"][0]
+    kh, kw = [int(v) for v in attrs["kernel_sizes"]]
+    sh, sw = [int(v) for v in attrs.get("strides", [1, 1])]
+    pads = [int(v) for v in attrs.get("paddings", [0, 0, 0, 0])]
+    dh, dw = [int(v) for v in attrs.get("dilations", [1, 1])]
+    patches = jax.lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw),
+        [(pads[0], pads[2]), (pads[1], pads[3])],
+        rhs_dilation=(dh, dw),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    n, ckk, oh, ow = patches.shape
+    return {"Y": patches.reshape(n, ckk, oh * ow)}
+
+
+def _crop(x, offsets, shape):
+    slices = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[slices]
+
+
+@register_op("crop", nondiff_inputs=("Y", "Offsets"))
+def crop(ins, attrs, ctx):
+    """reference: crop_op.cc — crop X to Y's shape (or attr shape)."""
+    x = ins["X"][0]
+    if ins.get("Y") and ins["Y"][0] is not None:
+        shape = ins["Y"][0].shape
+    else:
+        shape = [int(v) for v in attrs["shape"]]
+    if ins.get("Offsets") and ins["Offsets"][0] is not None:
+        off = ins["Offsets"][0].reshape(-1).astype(jnp.int32)
+        return {"Out": jax.lax.dynamic_slice(
+            x, tuple(off[i] for i in range(x.ndim)), shape)}
+    offsets = [int(v) for v in attrs.get("offsets", [0] * x.ndim)]
+    return {"Out": _crop(x, offsets, shape)}
+
+
+@register_op("crop_tensor", nondiff_inputs=("Shape", "Offsets"))
+def crop_tensor(ins, attrs, ctx):
+    x = ins["X"][0]
+    if ins.get("Shape") and ins["Shape"][0] is not None:
+        shape = [int(v) for v in np.asarray(ins["Shape"][0])]
+    else:
+        shape = [int(v) for v in attrs["shape"]]
+    shape = [x.shape[i] if s == -1 else s for i, s in enumerate(shape)]
+    if ins.get("Offsets") and ins["Offsets"][0] is not None:
+        off = ins["Offsets"][0].reshape(-1).astype(jnp.int32)
+        return {"Out": jax.lax.dynamic_slice(x, tuple(off[i] for i in
+                                                      range(x.ndim)),
+                                             shape)}
+    offsets = [int(v) for v in attrs.get("offsets", [0] * x.ndim)]
+    return {"Out": _crop(x, offsets, shape)}
+
+
+@register_op("random_crop", is_random=True, grad=None)
+def random_crop(ins, attrs, ctx):
+    """reference: random_crop_op.cc — crop `shape` at a uniform offset
+    (trailing dims)."""
+    x = ins["X"][0]
+    shape = [int(v) for v in attrs["shape"]]
+    lead = x.ndim - len(shape)
+    key = ctx.rng()
+    starts = []
+    for i, s in enumerate(shape):
+        key, sub = jax.random.split(key)
+        hi = x.shape[lead + i] - s
+        starts.append(jax.random.randint(sub, (), 0, hi + 1))
+    off = tuple([0] * lead) + tuple(starts)
+    return {"Out": jax.lax.dynamic_slice(x, off,
+                                         tuple(x.shape[:lead]) +
+                                         tuple(shape))}
+
+
+@register_op("sampling_id", is_random=True, grad=None)
+def sampling_id(ins, attrs, ctx):
+    """reference: sampling_id_op.cc — sample a class index per row of a
+    probability matrix."""
+    x = ins["X"][0]
+    logits = jnp.log(jnp.maximum(x, 1e-20))
+    return {"Out": jax.random.categorical(ctx.rng(), logits,
+                                          axis=-1).astype(jnp.int64)}
+
+
+@register_op("add_position_encoding")
+def add_position_encoding(ins, attrs, ctx):
+    """reference: add_position_encoding_op.cc — out = α·x + β·PE(pos)."""
+    x = ins["X"][0]                        # [N, T, D]
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    n, t, d = x.shape
+    pos = jnp.arange(t, dtype=x.dtype)[:, None]
+    half = d // 2
+    div = jnp.exp(jnp.arange(half, dtype=x.dtype) *
+                  (-np.log(10000.0) / max(half - 1, 1)))
+    pe = jnp.concatenate([jnp.sin(pos * div), jnp.cos(pos * div)], axis=1)
+    if pe.shape[1] < d:
+        pe = jnp.pad(pe, [(0, 0), (0, d - pe.shape[1])])
+    return {"Out": alpha * x + beta * pe[None, :, :]}
+
+
+@register_op("rank_loss")
+def rank_loss(ins, attrs, ctx):
+    """reference: rank_loss_op.cc — o = left-right; C = log(1+e^o) - o·label."""
+    label = ins["Label"][0]
+    left = ins["Left"][0]
+    right = ins["Right"][0]
+    o = left - right
+    return {"Out": jax.nn.softplus(o) - o * label}
+
+
+@register_op("log_loss")
+def log_loss(ins, attrs, ctx):
+    p = ins["Predicted"][0]
+    y = ins["Labels"][0]
+    eps = float(attrs.get("epsilon", 1e-4))
+    return {"Loss": -y * jnp.log(p + eps) - (1 - y) * jnp.log(1 - p + eps)}
+
+
+@register_op("bpr_loss", nondiff_inputs=("Label",))
+def bpr_loss(ins, attrs, ctx):
+    """reference: bpr_loss_op.cc:127 — Y[i] = -mean_j log σ(x[i,y_i]-x[i,j])."""
+    x = ins["X"][0]                        # [N, C]
+    label = ins["Label"][0].reshape(-1)
+    n, c = x.shape
+    xy = jnp.take_along_axis(x, label[:, None].astype(jnp.int32), axis=1)
+    diff = xy - x                          # [N, C]
+    logsig = jax.nn.log_sigmoid(diff)
+    notself = jnp.arange(c)[None, :] != label[:, None]
+    return {"Y": (-jnp.sum(jnp.where(notself, logsig, 0.0), axis=1,
+                           keepdims=True) / max(c - 1, 1))}
+
+
+@register_op("npair_loss", nondiff_inputs=("Labels",))
+def npair_loss(ins, attrs, ctx):
+    """reference: layers/nn.py npair_loss — softmax CE over the
+    anchor·positiveᵀ similarity matrix with same-label soft targets, plus
+    l2 regularization of the embeddings."""
+    anchor = ins["Anchor"][0]              # [N, D]
+    positive = ins["Positive"][0]
+    labels = ins["Labels"][0].reshape(-1)
+    l2_reg = float(attrs.get("l2_reg", 0.002))
+    sim = anchor @ positive.T              # [N, N]
+    same = (labels[:, None] == labels[None, :]).astype(sim.dtype)
+    targets = same / jnp.sum(same, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(targets * logp, axis=1))
+    l2 = jnp.mean(jnp.sum(anchor * anchor + positive * positive, axis=1)) \
+        * l2_reg * 0.25
+    return {"Out": ce + l2}
+
+
+@register_op("center_loss", nondiff_inputs=("Label", "Centers",
+                                            "CenterUpdateRate"),
+             intermediate_outputs=("SampleCenterDiff", "CentersOut"))
+def center_loss(ins, attrs, ctx):
+    """reference: center_loss_op.cc — 0.5‖x − c_y‖²; centers drift toward
+    their class means when update_center."""
+    x = ins["X"][0]
+    label = ins["Label"][0].reshape(-1).astype(jnp.int32)
+    centers = ins["Centers"][0]
+    alpha = ins["CenterUpdateRate"][0].reshape(()) if \
+        ins.get("CenterUpdateRate") and ins["CenterUpdateRate"][0] is not \
+        None else jnp.asarray(0.5, x.dtype)
+    diff = x - centers[label]
+    loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+    if attrs.get("update_center", True):
+        counts = jnp.zeros((centers.shape[0],), x.dtype).at[label].add(1.0)
+        upd = jnp.zeros_like(centers).at[label].add(diff)
+        centers_out = centers + alpha * upd / (counts[:, None] + 1.0)
+    else:
+        centers_out = centers
+    return {"Loss": loss, "SampleCenterDiff": diff,
+            "CentersOut": centers_out}
+
+
+@register_op("teacher_student_sigmoid_loss", nondiff_inputs=("Label",))
+def teacher_student_sigmoid_loss(ins, attrs, ctx):
+    """reference: teacher_student_sigmoid_loss_op.h:43-63 — piecewise on
+    the encoded label: <-1 → bce(x,0); <0 → bce(x,1); <1 → bce(x,0) +
+    bce(x, z'); else → bce(x,1) + bce(x, z'-1)."""
+    x = ins["X"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1).astype(x.dtype)
+
+    def bce_with(z):
+        return jnp.maximum(x, 0.0) - x * z + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+    y = jnp.where(
+        label < -1.0, bce_with(0.0),
+        jnp.where(label < 0.0, bce_with(1.0),
+                  jnp.where(label < 1.0, bce_with(0.0) + bce_with(label),
+                            bce_with(1.0) + bce_with(label - 1.0))))
+    return {"Y": y[:, None]}
+
+
+@register_op("modified_huber_loss", nondiff_inputs=("Y",),
+             intermediate_outputs=("IntermediateVal",))
+def modified_huber_loss(ins, attrs, ctx):
+    """reference: modified_huber_loss_op.h:40-49 — on z = x·y (y∈{0,1}
+    mapped to ±1): -4z if z<-1; (1-z)² if z<1; else 0."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    z = x * (2.0 * y - 1.0)
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.where(z < 1.0, (1.0 - z) ** 2, 0.0))
+    return {"Out": loss, "IntermediateVal": z}
+
+
+@register_op("edit_distance", grad=None,
+             nondiff_inputs=("Hyps", "Refs", "HypsLength", "RefsLength"))
+def edit_distance(ins, attrs, ctx):
+    """reference: edit_distance_op.cc — Levenshtein distance per pair;
+    normalized by ref length when `normalized`. The DP rolls over one
+    row at a time under lax.scan (static [T2+1] state)."""
+    hyps = ins["Hyps"][0]
+    refs = ins["Refs"][0]
+    if hyps.ndim == 1:
+        hyps, refs = hyps[None], refs[None]
+    n, t1 = hyps.shape
+    t2 = refs.shape[1]
+    hlen = _lengths(ins, n, t1, slot="HypsLength")
+    rlen = _lengths(ins, n, t2, slot="RefsLength")
+    normalized = bool(attrs.get("normalized", True))
+    ignored = [int(v) for v in attrs.get("ignored_tokens", []) or []]
+    if ignored:
+        vh = jnp.arange(t1)[None, :] < hlen[:, None]
+        vr = jnp.arange(t2)[None, :] < rlen[:, None]
+        eh = jnp.zeros_like(vh)
+        er = jnp.zeros_like(vr)
+        for tok in ignored:
+            eh |= hyps == tok
+            er |= refs == tok
+        hyps, hlen = _compact_left(hyps, vh & ~eh)
+        refs, rlen = _compact_left(refs, vr & ~er)
+        hlen = hlen.astype(jnp.int32)
+        rlen = rlen.astype(jnp.int32)
+
+    def one(h, r, hl, rl):
+        row0 = jnp.arange(t2 + 1, dtype=jnp.float32)
+
+        def step(row, i):
+            # row = dp[i], compute dp[i+1]
+            def inner(carry, j):
+                left = carry              # dp[i+1][j]
+                sub = row[j] + jnp.where(h[i] == r[j], 0.0, 1.0)
+                up = row[j + 1] + 1.0
+                val = jnp.minimum(jnp.minimum(left + 1.0, up), sub)
+                return val, val
+
+            first = row[0] + 1.0
+            _, rest = jax.lax.scan(inner, first, jnp.arange(t2))
+            new_row = jnp.concatenate([first[None], rest])
+            # past hyp length: row stays (distance frozen at hl)
+            return jnp.where(i < hl, new_row, row), None
+
+        final, _ = jax.lax.scan(step, row0, jnp.arange(t1))
+        d = final[rl]
+        return jnp.where(normalized, d / jnp.maximum(rl, 1), d)
+
+    dist = jax.vmap(one)(hyps, refs, hlen, rlen)
+    return {"Out": dist[:, None],
+            "SequenceNum": jnp.asarray([n], jnp.int64)}
+
+
+@register_op("ctc_align", grad=None, nondiff_inputs=("Input", "InputLength"))
+def ctc_align(ins, attrs, ctx):
+    """reference: ctc_align_op.cc — merge repeated tokens then drop
+    blanks; compact left with the stable-sort trick, pad with -1... the
+    reference pads removed tail with 0 and reports OutputLength."""
+    x = ins["Input"][0]                    # [N, T] int
+    blank = int(attrs.get("blank", 0))
+    merge = bool(attrs.get("merge_repeated", True))
+    n, t = x.shape
+    ilen = _lengths(ins, n, t, slot="InputLength")
+    valid = jnp.arange(t)[None, :] < ilen[:, None]
+    prev = jnp.concatenate([jnp.full((n, 1), -1, x.dtype), x[:, :-1]],
+                           axis=1)
+    keep = valid & (x != blank)
+    if merge:
+        keep &= x != prev
+    out, new_len = _compact_left(x, keep)
+    return {"Output": out, "OutputLength": new_len[:, None].astype(jnp.int64)}
+
+
+@register_op("warpctc", nondiff_inputs=("Label", "LogitsLength",
+                                        "LabelLength"),
+             intermediate_outputs=("WarpCTCGrad",))
+def warpctc(ins, attrs, ctx):
+    """reference: warpctc_op.cc — CTC loss. The external warp-ctc library
+    is replaced by the same log-space forward algorithm via optax.ctc_loss
+    (blank handling and padding semantics match)."""
+    import optax
+
+    logits = ins["Logits"][0]              # [N, T, C] (norm_by_times off)
+    label = ins["Label"][0]                # [N, L]
+    blank = int(attrs.get("blank", 0))
+    n, t, c = logits.shape
+    llen = _lengths(ins, n, t, slot="LogitsLength")
+    yl = _lengths(ins, n, label.shape[1], slot="LabelLength")
+    logit_pad = (jnp.arange(t)[None, :] >= llen[:, None]).astype(
+        logits.dtype)
+    label_pad = (jnp.arange(label.shape[1])[None, :] >=
+                 yl[:, None]).astype(logits.dtype)
+    loss = optax.ctc_loss(logits, logit_pad, label.astype(jnp.int32),
+                          label_pad, blank_id=blank)
+    if attrs.get("norm_by_times", False):
+        loss = loss / jnp.maximum(llen.astype(loss.dtype), 1.0)
+    # the reference caches warp-ctc's gradient here; autodiff recomputes
+    # it, so a zero placeholder only satisfies the output contract
+    return {"Loss": loss[:, None], "WarpCTCGrad": jnp.zeros_like(logits)}
+
+
+@register_op("multiplex", nondiff_inputs=("Ids",))
+def multiplex(ins, attrs, ctx):
+    """reference: multiplex_op.cc — out[i] = X[ids[i]][i] (row-wise select
+    among the candidate tensors)."""
+    xs = jnp.stack([x for x in ins["X"] if x is not None])   # [K, N, D]
+    ids = ins["Ids"][0].reshape(-1).astype(jnp.int32)        # [N]
+    rows = jnp.arange(xs.shape[1])
+    return {"Out": xs[ids, rows]}
+
+
+@register_op("conv3d_transpose")
+def conv3d_transpose(ins, attrs, ctx):
+    """reference: conv_transpose_op.cc (3-D branch)."""
+    x, w = ins["Input"][0], ins["Filter"][0]   # w: [C_in, C_out, D, H, W]
+    strides = tuple(int(s) for s in attrs.get("strides", [1, 1, 1]))
+    dilations = tuple(int(d) for d in attrs.get("dilations", [1, 1, 1]))
+    pads = attrs.get("paddings", [0, 0, 0])
+    # see conv2d_transpose: jax pads the underlying conv, so map p ->
+    # (k-1)*d - p for reference transpose-conv output shapes
+    padding = [((w.shape[2 + i] - 1) * dilations[i] - int(p),
+                (w.shape[2 + i] - 1) * dilations[i] - int(p))
+               for i, p in enumerate(pads)]
+    # axis 0 labeled O: see conv2d_transpose — transpose_kernel swaps I/O
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCDHW", "OIDHW", "NCDHW"))
+    out = jax.lax.conv_transpose(
+        x, w, strides=strides, padding=padding,
+        rhs_dilation=dilations, dimension_numbers=dn, transpose_kernel=True)
+    return {"Output": out}

@@ -79,10 +79,21 @@ def conv2d_transpose(ins, attrs, ctx):
     dilations = tuple(int(d) for d in attrs.get("dilations", [1, 1]))
     pads = attrs.get("paddings", [0, 0])
     if len(pads) == 2:
-        padding = [(p, p) for p in pads]
+        pad_pairs = [(int(p), int(p)) for p in pads]
     else:
-        padding = [(pads[0], pads[1]), (pads[2], pads[3])]
-    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "IOHW", "NCHW"))
+        pad_pairs = [(int(pads[0]), int(pads[1])),
+                     (int(pads[2]), int(pads[3]))]
+    # jax's conv_transpose applies `padding` to the underlying dilated
+    # conv; the transpose of a conv padded by p needs (k-1)*d - p so the
+    # output is (in-1)*s - 2p + (k-1)*d + 1 (conv_transpose_op.cc shape)
+    padding = [((w.shape[2 + i] - 1) * dilations[i] - lo,
+                (w.shape[2 + i] - 1) * dilations[i] - hi)
+               for i, (lo, hi) in enumerate(pad_pairs)]
+    # kernel layout is [C_in, C_out, H, W]; with transpose_kernel=True
+    # conv_transpose swaps the I/O labels, so axis 0 must be labeled O for
+    # the effective input-feature axis to be C_in (C_in != C_out broke
+    # under "IOHW")
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
     out = jax.lax.conv_transpose(
         x, w, strides=strides, padding=padding,
         rhs_dilation=dilations, dimension_numbers=dn, transpose_kernel=True)

@@ -262,3 +262,41 @@ def dgc_momentum(ins, attrs, ctx):
             sparse_grad.reshape(-1), k, axis).reshape(sparse_grad.shape)
     return {"ParamOut": p - lr * sparse_grad, "UOut": u_out, "VOut": v_out,
             "GradOut": sparse_grad}
+
+
+@register_op("proximal_gd", grad=None)
+def proximal_gd(ins, attrs, ctx):
+    """reference: optimizers/proximal_gd_op.cc — prox_param = p - lr*g,
+    then soft-threshold by l1 and shrink by l2."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = _lr(ins).astype(p.dtype)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    prox = p - lr * g.astype(p.dtype)
+    if l1 > 0:
+        new_p = (jnp.sign(prox) *
+                 jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) /
+                 (1.0 + lr * l2))
+    else:
+        new_p = prox / (1.0 + lr * l2)
+    return {"ParamOut": new_p}
+
+
+@register_op("proximal_adagrad", grad=None)
+def proximal_adagrad(ins, attrs, ctx):
+    """reference: optimizers/proximal_adagrad_op.cc."""
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = _lr(ins).astype(p.dtype)
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    g = g.astype(p.dtype)
+    m_new = m + g * g
+    eff_lr = lr / jnp.sqrt(m_new)
+    prox = p - eff_lr * g
+    if l1 > 0:
+        new_p = (jnp.sign(prox) *
+                 jnp.maximum(jnp.abs(prox) - eff_lr * l1, 0.0) /
+                 (1.0 + eff_lr * l2))
+    else:
+        new_p = prox / (1.0 + eff_lr * l2)
+    return {"ParamOut": new_p, "MomentOut": m_new}

@@ -20,6 +20,25 @@ def _mask(lengths, maxlen, dtype=jnp.float32):
     return (jnp.arange(maxlen)[None, :] < lengths.reshape(-1, 1)).astype(dtype)
 
 
+def _lengths(ins, n, t, slot="Length"):
+    """Row lengths from the optional Length input, defaulting to full T."""
+    if ins.get(slot) and ins[slot][0] is not None:
+        return ins[slot][0].reshape(-1).astype(jnp.int32)
+    return jnp.full((n,), t, jnp.int32)
+
+
+def _compact_left(x, keep, fill=0):
+    """Stable-compact kept positions to the left along axis 1; freed tail
+    positions hold `fill`. Returns (compacted, new_lengths)."""
+    order = jnp.argsort(jnp.where(keep, 0, 1), axis=1, stable=True)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    new_len = jnp.sum(keep, axis=1)
+    t = x.shape[1]
+    out = jnp.where(jnp.arange(t)[None, :] < new_len[:, None], compacted,
+                    fill)
+    return out, new_len
+
+
 @register_op("sequence_mask", grad=None, nondiff_inputs=("X",))
 def sequence_mask(ins, attrs, ctx):
     """reference: sequence_ops/sequence_mask_op.cc."""
@@ -141,8 +160,13 @@ def sequence_pad(ins, attrs, ctx):
     Here the batch is already [N, T, ...]: re-pad to padded_length with
     PadValue beyond each row's Length (truncating or extending T)."""
     x = ins["X"][0]
-    pad_value = ins["PadValue"][0].reshape(()) if ins.get("PadValue") and \
-        ins["PadValue"][0] is not None else jnp.asarray(0.0, x.dtype)
+    if ins.get("PadValue") and ins["PadValue"][0] is not None:
+        pv = ins["PadValue"][0]
+        # scalar, or shaped like one time step (sequence_pad_op.cc)
+        pad_value = pv.reshape(()) if pv.size == 1 else \
+            pv.reshape(x.shape[2:])
+    else:
+        pad_value = jnp.asarray(0.0, x.dtype)
     n, t = x.shape[0], x.shape[1]
     plen = int(attrs.get("padded_length", -1))
     if plen < 0:
@@ -152,10 +176,7 @@ def sequence_pad(ins, attrs, ctx):
         x = jnp.pad(x, pad_width, constant_values=0)
     elif plen < t:
         x = x[:, :plen]
-    if ins.get("Length") and ins["Length"][0] is not None:
-        lengths = jnp.minimum(ins["Length"][0].reshape(-1), plen)
-    else:
-        lengths = jnp.full((n,), min(t, plen), jnp.int32)
+    lengths = jnp.minimum(_lengths(ins, n, min(t, plen)), plen)
     m = _mask(lengths, plen, jnp.bool_)
     m = m.reshape(m.shape + (1,) * (x.ndim - 2))
     out = jnp.where(m, x, pad_value.astype(x.dtype))
@@ -185,8 +206,7 @@ def sequence_conv(ins, attrs, ctx):
     ctx_start = int(attrs.get("contextStart", -(ctx_len - 1) // 2))
     n, t, d = x.shape
     if ins.get("Length") and ins["Length"][0] is not None:
-        m = _mask(ins["Length"][0].reshape(-1), t, x.dtype)[..., None]
-        x = x * m
+        x = x * _mask(_lengths(ins, n, t), t, x.dtype)[..., None]
     cols = []
     for k in range(ctx_len):
         off = ctx_start + k
@@ -206,10 +226,7 @@ def sequence_enumerate(ins, attrs, ctx):
     win = int(attrs["win_size"])
     pad = int(attrs.get("pad_value", 0))
     n, t = x.shape[0], x.shape[1]
-    if ins.get("Length") and ins["Length"][0] is not None:
-        lengths = ins["Length"][0].reshape(-1)
-    else:
-        lengths = jnp.full((n,), t, jnp.int32)
+    lengths = _lengths(ins, n, t)
     pos = jnp.arange(t)[None, :, None] + jnp.arange(win)[None, None, :]
     idx = jnp.minimum(pos, t - 1)
     gathered = jnp.take_along_axis(
@@ -227,20 +244,12 @@ def sequence_erase(ins, attrs, ctx):
     x = ins["X"][0]                        # [N, T] int
     tokens = [int(v) for v in attrs.get("tokens", [])]
     n, t = x.shape
-    if ins.get("Length") and ins["Length"][0] is not None:
-        lengths = ins["Length"][0].reshape(-1)
-    else:
-        lengths = jnp.full((n,), t, jnp.int32)
+    lengths = _lengths(ins, n, t)
     valid = _mask(lengths, t, jnp.bool_)
     erase = jnp.zeros_like(valid)
     for tok in tokens:
         erase |= x == tok
-    keep = valid & ~erase
-    # stable order: kept first, original order preserved
-    order = jnp.argsort(jnp.where(keep, 0, 1), axis=1, stable=True)
-    compacted = jnp.take_along_axis(x, order, axis=1)
-    new_len = jnp.sum(keep, axis=1)
-    out = jnp.where(_mask(new_len, t, jnp.bool_), compacted, 0)
+    out, new_len = _compact_left(x, valid & ~erase)
     return {"Out": out.astype(x.dtype), "Length": new_len.astype(jnp.int64)}
 
 

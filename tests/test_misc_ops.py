@@ -1,0 +1,415 @@
+"""Tests for the misc NN/loss/metric op batch vs numpy references."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_op
+
+
+def test_affine_channel():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 4, 4).astype("float64")
+    s = rng.randn(3).astype("float64")
+    b = rng.randn(3).astype("float64")
+    out = run_op("affine_channel", {"X": x, "Scale": s, "Bias": b})["Out"][0]
+    np.testing.assert_allclose(
+        out, x * s[None, :, None, None] + b[None, :, None, None])
+    check_grad("affine_channel", {"X": x, "Scale": s, "Bias": b}, {},
+               inputs_to_check=["X", "Scale", "Bias"])
+
+
+def test_affine_grid_identity():
+    theta = np.tile(np.array([[[1.0, 0, 0], [0, 1.0, 0]]], "float32"),
+                    (2, 1, 1))
+    out = run_op("affine_grid", {"Theta": theta},
+                 {"output_shape": [2, 1, 3, 4]},
+                 outputs=("Output",))["Output"][0]
+    assert out.shape == (2, 3, 4, 2)
+    np.testing.assert_allclose(out[0, 0, 0], [-1, -1], atol=1e-6)
+    np.testing.assert_allclose(out[0, -1, -1], [1, 1], atol=1e-6)
+
+
+def test_lrn_matches_numpy():
+    rng = np.random.RandomState(1)
+    x = rng.rand(1, 6, 3, 3).astype("float64")
+    n_sz, k, alpha, beta = 5, 2.0, 1e-4, 0.75
+    out = run_op("lrn", {"X": x},
+                 {"n": n_sz, "k": k, "alpha": alpha, "beta": beta})["Out"][0]
+    want = np.zeros_like(x)
+    for c in range(6):
+        lo, hi = max(0, c - n_sz // 2), min(6, c + n_sz // 2 + 1)
+        sq = (x[:, lo:hi] ** 2).sum(1)
+        want[:, c] = x[:, c] * (k + alpha * sq) ** (-beta)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_data_norm():
+    x = np.array([[1.0, 10.0], [3.0, 30.0]], "float32")
+    bsize = np.array([2.0, 2.0], "float32")
+    bsum = np.array([4.0, 40.0], "float32")
+    bsqs = np.array([10.0, 1000.0], "float32")
+    out = run_op("data_norm", {"X": x, "BatchSize": bsize,
+                               "BatchSum": bsum, "BatchSquareSum": bsqs},
+                 outputs=("Y",))["Y"][0]
+    means = bsum / bsize
+    scales = np.sqrt(bsize / bsqs)
+    np.testing.assert_allclose(out, (x - means) * scales, rtol=1e-6)
+
+
+def test_spectral_norm_reduces_top_singular_value_to_one():
+    rng = np.random.RandomState(2)
+    w = rng.randn(6, 4).astype("float32") * 3
+    u = rng.randn(6).astype("float32")
+    v = rng.randn(4).astype("float32")
+    out = run_op("spectral_norm", {"Weight": w, "U": u, "V": v},
+                 {"dim": 0, "power_iters": 20})["Out"][0]
+    s = np.linalg.svd(np.asarray(out), compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+
+def test_row_conv_lookahead():
+    x = np.arange(8, dtype="float64").reshape(1, 4, 2)
+    filt = np.array([[1.0, 1.0], [0.5, 0.5]], "float64")   # K=2
+    out = run_op("row_conv", {"X": x, "Filter": filt})["Out"][0]
+    want = np.zeros_like(x)
+    for t in range(4):
+        want[0, t] = x[0, t] * filt[0]
+        if t + 1 < 4:
+            want[0, t] += x[0, t + 1] * filt[1]
+    np.testing.assert_allclose(out, want)
+    check_grad("row_conv", {"X": x, "Filter": filt}, {},
+               inputs_to_check=["X", "Filter"])
+
+
+def test_shuffle_channel_roundtrip():
+    x = np.arange(2 * 6 * 2 * 2, dtype="float32").reshape(2, 6, 2, 2)
+    out = run_op("shuffle_channel", {"X": x}, {"group": 3})["Out"][0]
+    # shuffling twice with g and c//g returns the original
+    back = run_op("shuffle_channel", {"X": out}, {"group": 2})["Out"][0]
+    np.testing.assert_allclose(back, x)
+
+
+def test_space_to_depth():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    out = run_op("space_to_depth", {"X": x}, {"blocksize": 2})["Out"][0]
+    assert out.shape == (1, 4, 2, 2)
+    np.testing.assert_allclose(out[0, 0], [[0, 2], [8, 10]])
+
+
+def test_unfold_matches_manual_im2col():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    out = run_op("unfold", {"X": x},
+                 {"kernel_sizes": [2, 2], "strides": [2, 2]},
+                 outputs=("Y",))["Y"][0]
+    assert out.shape == (1, 4, 4)
+    np.testing.assert_allclose(out[0, :, 0], [0, 1, 4, 5])
+
+
+def test_crop_and_crop_tensor():
+    x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+    out = run_op("crop", {"X": x}, {"shape": [1, 2, 2],
+                                    "offsets": [1, 1, 2]})["Out"][0]
+    np.testing.assert_allclose(out, x[1:2, 1:3, 2:4])
+    out2 = run_op("crop_tensor",
+                  {"X": x, "Offsets": np.array([0, 0, 1], "int64")},
+                  {"shape": [2, 2, 2]})["Out"][0]
+    np.testing.assert_allclose(out2, x[:2, :2, 1:3])
+
+
+def test_random_crop_and_sampling_id():
+    x = np.arange(100, dtype="float32").reshape(10, 10)
+    out = run_op("random_crop", {"X": x}, {"shape": [4, 4]},
+                 rng_seed=0)["Out"][0]
+    assert out.shape == (4, 4)
+    # sampled window is contiguous
+    assert out[0, 1] - out[0, 0] == 1
+
+    probs = np.array([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]], "float32")
+    ids = run_op("sampling_id", {"X": probs}, rng_seed=1)["Out"][0]
+    np.testing.assert_array_equal(ids, [1, 0])
+
+
+def test_add_position_encoding():
+    x = np.zeros((1, 4, 8), "float32")
+    out = run_op("add_position_encoding", {"X": x},
+                 {"alpha": 1.0, "beta": 1.0})["Out"][0]
+    # position 0: sin(0)=0 for first half, cos(0)=1 for second half
+    np.testing.assert_allclose(out[0, 0, :4], 0.0, atol=1e-6)
+    np.testing.assert_allclose(out[0, 0, 4:], 1.0, atol=1e-6)
+
+
+def test_rank_loss_and_log_loss():
+    rng = np.random.RandomState(3)
+    left = rng.rand(5, 1).astype("float64")
+    right = rng.rand(5, 1).astype("float64")
+    label = (rng.rand(5, 1) > 0.5).astype("float64")
+    out = run_op("rank_loss", {"Left": left, "Right": right,
+                               "Label": label})["Out"][0]
+    o = left - right
+    np.testing.assert_allclose(out, np.log1p(np.exp(o)) - o * label,
+                               rtol=1e-6)
+    p = rng.rand(5, 1).astype("float64") * 0.8 + 0.1
+    y = (rng.rand(5, 1) > 0.5).astype("float64")
+    out2 = run_op("log_loss", {"Predicted": p, "Labels": y},
+                  {"epsilon": 1e-4}, outputs=("Loss",))["Loss"][0]
+    np.testing.assert_allclose(
+        out2, -y * np.log(p + 1e-4) - (1 - y) * np.log(1 - p + 1e-4),
+        rtol=1e-6)
+
+
+def test_bpr_loss_formula():
+    x = np.array([[1.0, 2.0, 0.5]], "float64")
+    label = np.array([[1]], "int64")
+    out = run_op("bpr_loss", {"X": x, "Label": label},
+                 outputs=("Y",))["Y"][0]
+    want = -(np.log(1 / (1 + np.exp(-(2.0 - 1.0)))) +
+             np.log(1 / (1 + np.exp(-(2.0 - 0.5))))) / 2
+    np.testing.assert_allclose(out[0, 0], want, rtol=1e-6)
+
+
+def test_npair_loss_decreases_for_aligned_embeddings():
+    rng = np.random.RandomState(4)
+    labels = np.array([0, 1, 2, 3], "int64")
+    anchor = np.eye(4, 8).astype("float64")
+    out_aligned = run_op("npair_loss",
+                         {"Anchor": anchor * 3, "Positive": anchor * 3,
+                          "Labels": labels}, {"l2_reg": 0.0})["Out"][0]
+    pos_bad = np.roll(anchor, 1, axis=0) * 3
+    out_bad = run_op("npair_loss",
+                     {"Anchor": anchor * 3, "Positive": pos_bad,
+                      "Labels": labels}, {"l2_reg": 0.0})["Out"][0]
+    assert float(out_aligned) < float(out_bad)
+
+
+def test_center_loss_and_update():
+    x = np.array([[1.0, 1.0], [3.0, 3.0]], "float32")
+    label = np.array([0, 0], "int64")
+    centers = np.zeros((3, 2), "float32")
+    out = run_op("center_loss",
+                 {"X": x, "Label": label, "Centers": centers,
+                  "CenterUpdateRate": np.array([0.5], "float32")},
+                 {"update_center": True},
+                 outputs=("Loss", "CentersOut"))
+    np.testing.assert_allclose(out["Loss"][0][:, 0], [1.0, 9.0])
+    # center 0 moves toward mean of diffs: 0.5 * (1+3, 1+3)/(2+1)
+    np.testing.assert_allclose(out["CentersOut"][0][0],
+                               [0.5 * 4 / 3, 0.5 * 4 / 3], rtol=1e-6)
+
+
+def test_teacher_student_sigmoid_loss_piecewise():
+    x = np.array([0.3, -0.2, 0.8, 1.2], "float32")
+    label = np.array([-2.0, -1.0, 0.7, 1.4], "float32")
+
+    def bce(xv, z):
+        return max(xv, 0) - xv * z + np.log1p(np.exp(-abs(xv)))
+
+    want = [bce(0.3, 0), bce(-0.2, 1),
+            bce(0.8, 0) + bce(0.8, 0.7),
+            bce(1.2, 1) + bce(1.2, 0.4)]
+    out = run_op("teacher_student_sigmoid_loss",
+                 {"X": x[:, None], "Label": label[:, None]},
+                 outputs=("Y",))["Y"][0]
+    np.testing.assert_allclose(out[:, 0], want, rtol=1e-5)
+
+
+def test_modified_huber_loss_piecewise():
+    x = np.array([-3.0, 0.5, 2.0], "float64")
+    y = np.array([1.0, 1.0, 1.0], "float64")
+    out = run_op("modified_huber_loss", {"X": x, "Y": y})["Out"][0]
+    np.testing.assert_allclose(out, [12.0, 0.25, 0.0])
+
+
+def test_edit_distance_known_cases():
+    hyps = np.array([[1, 2, 3, 0], [1, 1, 1, 1]], "int64")
+    refs = np.array([[1, 3, 3, 0], [2, 2, 2, 2]], "int64")
+    out = run_op("edit_distance",
+                 {"Hyps": hyps, "Refs": refs,
+                  "HypsLength": np.array([3, 4], "int64"),
+                  "RefsLength": np.array([3, 4], "int64")},
+                 {"normalized": False},
+                 outputs=("Out", "SequenceNum"))
+    np.testing.assert_allclose(out["Out"][0][:, 0], [1.0, 4.0])
+    assert int(out["SequenceNum"][0][0]) == 2
+
+
+def test_ctc_align_merges_and_drops_blanks():
+    x = np.array([[0, 1, 1, 0, 2, 2, 3, 0]], "int64")
+    out = run_op("ctc_align", {"Input": x},
+                 {"blank": 0, "merge_repeated": True},
+                 outputs=("Output", "OutputLength"))
+    np.testing.assert_array_equal(out["Output"][0][0, :3], [1, 2, 3])
+    assert int(out["OutputLength"][0][0, 0]) == 3
+
+
+def test_warpctc_loss_and_grad():
+    rng = np.random.RandomState(5)
+    n, t, c, l = 2, 6, 5, 3
+    logits = rng.randn(n, t, c).astype("float64")
+    label = rng.randint(1, c, (n, l)).astype("int64")
+    out = run_op("warpctc",
+                 {"Logits": logits, "Label": label,
+                  "LogitsLength": np.array([6, 5], "int64"),
+                  "LabelLength": np.array([3, 2], "int64")},
+                 {"blank": 0}, outputs=("Loss",))["Loss"][0]
+    assert out.shape == (n, 1)
+    assert (out > 0).all()
+    check_grad("warpctc",
+               {"Logits": logits, "Label": label,
+                "LogitsLength": np.array([6, 5], "int64"),
+                "LabelLength": np.array([3, 2], "int64")},
+               {"blank": 0}, inputs_to_check=["Logits"],
+               output_name="Loss", max_relative_error=1e-4)
+
+
+def test_proximal_optimizers():
+    p = np.array([1.0, -2.0, 0.01], "float64")
+    g = np.array([0.5, 0.5, 0.5], "float64")
+    lr = np.array([0.1], "float64")
+    out = run_op("proximal_gd",
+                 {"Param": p, "Grad": g, "LearningRate": lr},
+                 {"l1": 0.5, "l2": 0.1}, outputs=("ParamOut",))["ParamOut"][0]
+    prox = p - 0.1 * g
+    want = np.sign(prox) * np.maximum(np.abs(prox) - 0.1 * 0.5, 0) / \
+        (1 + 0.1 * 0.1)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+    m = np.full(3, 0.1, "float64")
+    out2 = run_op("proximal_adagrad",
+                  {"Param": p, "Grad": g, "Moment": m, "LearningRate": lr},
+                  {"l1": 0.5, "l2": 0.1},
+                  outputs=("ParamOut", "MomentOut"))
+    m_new = m + g * g
+    np.testing.assert_allclose(out2["MomentOut"][0], m_new)
+
+
+def test_multiplex():
+    x1 = np.arange(6, dtype="float32").reshape(3, 2)
+    x2 = x1 + 100
+    ids = np.array([[1], [0], [1]], "int64")
+    out = run_op("multiplex", {"X": [x1, x2], "Ids": ids})["Out"][0]
+    np.testing.assert_allclose(out, [[100, 101], [2, 3], [104, 105]])
+
+
+def test_conv_transpose_matches_torch():
+    """conv2d/3d_transpose vs the torch oracle across stride/pad/dilation
+    (regression: the old kernel mislabeled I/O and mapped padding pairs
+    straight through, so C_in != C_out crashed and shapes were wrong)."""
+    import torch
+
+    rng = np.random.RandomState(0)
+    for (s_, p, d) in [(1, 0, 1), (2, 1, 1), (2, 0, 1), (1, 1, 2)]:
+        x = rng.randn(2, 3, 6, 6).astype("float64")
+        w = rng.randn(3, 4, 3, 3).astype("float64")
+        want = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w), stride=s_, padding=p,
+            dilation=d).numpy()
+        out = run_op("conv2d_transpose", {"Input": x, "Filter": w},
+                     {"strides": [s_, s_], "paddings": [p, p],
+                      "dilations": [d, d]}, outputs=("Output",))["Output"][0]
+        assert out.shape == want.shape
+        np.testing.assert_allclose(out, want, rtol=1e-8, atol=1e-10)
+
+    x = rng.randn(1, 3, 4, 4, 4).astype("float64")
+    w = rng.randn(3, 2, 2, 2, 2).astype("float64")
+    want = torch.nn.functional.conv_transpose3d(
+        torch.tensor(x), torch.tensor(w), stride=2).numpy()
+    out = run_op("conv3d_transpose", {"Input": x, "Filter": w},
+                 {"strides": [2, 2, 2]}, outputs=("Output",))["Output"][0]
+    assert out.shape == want.shape
+    np.testing.assert_allclose(out, want, rtol=1e-8, atol=1e-10)
+
+
+def test_ctc_pipeline_trains_and_decodes():
+    """OCR-style ladder: train a linear frame classifier with warpctc,
+    decode with ctc_greedy_decoder, score with edit_distance (reference:
+    CRNN-style models; warpctc + ctc_align + edit_distance ops)."""
+    import paddle_tpu as pt
+
+    rng = np.random.RandomState(0)
+    N, T, C, L = 16, 8, 5, 3   # C classes incl. blank 0
+    # frames: one-hot-ish features of the target label sequence stretched
+    labels = rng.randint(1, C, (N, L)).astype("int64")
+    feats = np.zeros((N, T, C), "float32")
+    for i in range(N):
+        for t in range(T):
+            feats[i, t, labels[i, min(t * L // T, L - 1)]] = 1.0
+    feats += rng.randn(N, T, C).astype("float32") * 0.1
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[T, C], dtype="float32")
+        y = pt.layers.data(name="y", shape=[L], dtype="int64")
+        logits = pt.layers.fc(x, size=C, num_flatten_dims=2)
+        loss = pt.layers.mean(pt.layers.warpctc(logits, y, blank=0))
+        pt.optimizer.Adam(learning_rate=0.05).minimize(loss)
+
+    infer = pt.Program()
+    with pt.framework.unique_name.guard(), \
+            pt.program_guard(infer, pt.Program()):
+        x2 = pt.layers.data(name="x", shape=[T, C], dtype="float32")
+        y2 = pt.layers.data(name="y", shape=[L], dtype="int64")
+        logits2 = pt.layers.fc(x2, size=C, num_flatten_dims=2)
+        dec, dec_len = pt.layers.ctc_greedy_decoder(
+            pt.layers.softmax(logits2), blank=0)
+        # dec is end-padded to T; its true per-row length is dec_len
+        dist, _ = pt.layers.edit_distance(dec, y2, normalized=False,
+                                          input_length=dec_len)
+
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        ls = [float(np.asarray(exe.run(main, feed={"x": feats, "y": labels},
+                                       fetch_list=[loss])[0]).reshape(()))
+              for _ in range(100)]
+        assert ls[-1] < ls[0] * 0.5, (ls[0], ls[-1])
+        d = exe.run(infer, feed={"x": feats, "y": labels},
+                    fetch_list=[dist])[0]
+        assert float(np.asarray(d).mean()) < 1.0, np.asarray(d).ravel()
+
+
+def test_center_loss_centers_persist_across_steps():
+    """Regression: CentersOut must write back into the centers parameter
+    (a fresh temp discarded the update every step)."""
+    import paddle_tpu as pt
+
+    x_np = np.array([[2.0, 2.0]], "float32")
+    y_np = np.array([[0]], "int64")
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[2], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="int64")
+        loss = pt.layers.mean(pt.layers.center_loss(
+            x, y, num_classes=3, alpha=0.5, update_center=True))
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        l0 = float(np.asarray(exe.run(main, feed={"x": x_np, "y": y_np},
+                                      fetch_list=[loss])[0]).reshape(()))
+        for _ in range(20):
+            l1 = float(np.asarray(exe.run(main, feed={"x": x_np, "y": y_np},
+                                          fetch_list=[loss])[0]).reshape(()))
+        # centers drift toward x, so the loss must shrink without any
+        # optimizer running
+        assert l1 < l0 * 0.2, (l0, l1)
+
+
+def test_edit_distance_ignored_tokens():
+    hyps = np.array([[1, 0, 2, 0]], "int64")
+    refs = np.array([[1, 2, 0, 0]], "int64")
+    out = run_op("edit_distance", {"Hyps": hyps, "Refs": refs},
+                 {"normalized": False, "ignored_tokens": [0]},
+                 outputs=("Out",))["Out"][0]
+    # after erasing 0s both are [1, 2] -> distance 0
+    assert float(out[0, 0]) == 0.0
+
+
+def test_warpctc_norm_by_times():
+    rng = np.random.RandomState(6)
+    logits = rng.randn(1, 4, 3).astype("float32")
+    label = np.array([[1, 2]], "int64")
+    plain = run_op("warpctc", {"Logits": logits, "Label": label},
+                   {"blank": 0}, outputs=("Loss",))["Loss"][0]
+    normed = run_op("warpctc", {"Logits": logits, "Label": label},
+                    {"blank": 0, "norm_by_times": True},
+                    outputs=("Loss",))["Loss"][0]
+    np.testing.assert_allclose(normed, plain / 4.0, rtol=1e-6)
