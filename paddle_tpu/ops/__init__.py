@@ -23,3 +23,4 @@ from . import crf
 from . import classify
 from . import beam
 from . import misc
+from . import quant

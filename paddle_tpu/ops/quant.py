@@ -1,0 +1,88 @@
+"""Fake-quantization ops for quantization-aware training.
+
+Reference: operators/fake_quantize_op.cc / fake_dequantize_op.cc and the
+op list in contrib/slim/quantization/quantization_pass.py:32-37
+(fake_quantize_abs_max, fake_quantize_moving_average_abs_max,
+fake_channel_wise_quantize_abs_max, fake_dequantize_max_abs).
+
+TPU-native: each op quantize→dequantizes in one kernel with a
+straight-through estimator — out = x + stop_gradient(q(x) − x) — so the
+generic vjp yields the identity gradient inside the clip range and the
+whole QAT graph stays one differentiable XLA computation (the reference
+splits quant and dequant into separate int8 tensors; on TPU the simulated
+int grid in fp32/bf16 is the idiomatic QAT form).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+def _ste(x, quantized):
+    """Straight-through estimator: forward = quantized, grad = identity."""
+    return x + jax.lax.stop_gradient(quantized - x)
+
+
+def _quant_dequant(x, scale, bits):
+    bnt = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * bnt), -bnt, bnt) * s / bnt
+    return q
+
+
+@register_op("fake_quantize_dequantize_abs_max",
+             intermediate_outputs=("OutScale",))
+def fake_quantize_dequantize_abs_max(ins, attrs, ctx):
+    """Per-tensor abs-max quant-dequant (weights)."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": _ste(x, _quant_dequant(x, scale, bits)),
+            "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max",
+             intermediate_outputs=("OutScale",))
+def fake_channel_wise_quantize_dequantize_abs_max(ins, attrs, ctx):
+    """Per-output-channel abs-max quant-dequant (conv/fc weights; channel
+    axis 0 for conv [O,I,H,W], last axis for fc [In, Out] via attr)."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    axis = int(attrs.get("quant_axis", 0))
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    return {"Out": _ste(x, _quant_dequant(x, scale, bits)),
+            "OutScale": scale.reshape(-1)}
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             nondiff_inputs=("InScale", "InState", "InAccum"),
+             intermediate_outputs=("OutScale", "OutState", "OutAccum"))
+def fake_quantize_dequantize_moving_average_abs_max(ins, attrs, ctx):
+    """Activation quant-dequant with a moving-average abs-max scale
+    (reference: fake_quantize_op.cc moving_average variant): in training,
+    accum = accum*rate + absmax, state = state*rate + 1, scale =
+    accum/state; at inference the stored scale is used as-is."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    rate = float(attrs.get("moving_rate", 0.9))
+    is_test = bool(attrs.get("is_test", False)) or ctx.is_test
+    in_scale = ins["InScale"][0].reshape(())
+    state = ins["InState"][0].reshape(()) if ins.get("InState") and \
+        ins["InState"][0] is not None else jnp.asarray(1.0, x.dtype)
+    accum = ins["InAccum"][0].reshape(()) if ins.get("InAccum") and \
+        ins["InAccum"][0] is not None else in_scale
+    cur = jnp.max(jnp.abs(x))
+    if is_test:
+        scale, new_state, new_accum = in_scale, state, accum
+    else:
+        new_state = rate * state + 1.0
+        new_accum = rate * accum + cur
+        scale = new_accum / new_state
+    return {"Out": _ste(x, _quant_dequant(x, scale, bits)),
+            "OutScale": scale.reshape(1),
+            "OutState": new_state.reshape(1),
+            "OutAccum": new_accum.reshape(1)}

@@ -1,7 +1,16 @@
 """Model compression (reference: python/paddle/fluid/contrib/slim — the
-quantization/pruning/NAS/distillation toolkit, SURVEY §2.4). Round-1 scope:
-post-training quantization for inference."""
+quantization/pruning/NAS/distillation toolkit, SURVEY §2.4): post-training
+INT8 quantization, quantization-aware training (QAT transform + freeze
+passes), magnitude pruning with sensitivity analysis, knowledge
+distillation (soft-label/L2/FSP), and simulated-annealing NAS with a TCP
+controller server."""
 
 from .quantization import (  # noqa: F401
     quantize_inference_model, PostTrainingQuantization,
 )
+from .qat import (  # noqa: F401
+    QuantizationFreezePass, QuantizationTransformPass,
+)
+from .prune import Pruner, SensitivePruneStrategy  # noqa: F401
+from . import distillation  # noqa: F401
+from .nas import ControllerServer, SAController, SearchAgent  # noqa: F401

@@ -96,3 +96,282 @@ def test_quantize_slash_named_weights(tmp_path, rng):
         prog, feeds, fetches = pt.io.load_inference_model(d, exe)
         out = exe.run(prog, feed={feeds[0]: X}, fetch_list=fetches)[0]
     assert np.isfinite(out).all()
+
+
+# ---------------------------------------------------------------------------
+# QAT (reference: slim/tests/test_quantization_pass.py)
+# ---------------------------------------------------------------------------
+
+
+def _build_mlp(seed=3):
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = seed
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[8], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="int64")
+        h = pt.layers.fc(x, size=16, act="relu")
+        logits = pt.layers.fc(h, size=4)
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, y))
+    return main, startup, loss, logits
+
+
+def _mlp_data():
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype("float32")
+    Y = (np.abs(X[:, :4]).argmax(1) % 4).astype("int64")[:, None]
+    return X, Y
+
+
+def test_qat_transform_inserts_fake_quant_and_trains():
+    from paddle_tpu.slim import QuantizationTransformPass
+
+    main, startup, loss, _ = _build_mlp()
+    with pt.program_guard(main, startup):
+        pt.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    n_before = len(main.global_block().ops)
+    QuantizationTransformPass().apply(main, startup)
+    types = [op.type for op in main.global_block().ops]
+    assert "fake_channel_wise_quantize_dequantize_abs_max" in types
+    assert "fake_quantize_dequantize_moving_average_abs_max" in types
+    assert len(types) > n_before
+
+    X, Y = _mlp_data()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        ls = [float(np.asarray(exe.run(main, feed={"x": X, "y": Y},
+                                       fetch_list=[loss])[0]).reshape(()))
+              for _ in range(60)]
+        assert ls[-1] < ls[0] * 0.7, (ls[0], ls[-1])
+
+
+def test_qat_freeze_matches_qat_inference():
+    """After freezing, the fp32 program with int8-grid weights must match
+    the QAT program's outputs closely (the QAT sim already rounded)."""
+    from paddle_tpu.slim import (QuantizationFreezePass,
+                                 QuantizationTransformPass)
+
+    main, startup, loss, logits = _build_mlp()
+    # inference program: same params (unique_name.guard), no loss ops
+    infer = pt.Program()
+    with pt.framework.unique_name.guard(), \
+            pt.program_guard(infer, pt.Program()):
+        xv = pt.layers.data(name="x", shape=[8], dtype="float32")
+        hv = pt.layers.fc(xv, size=16, act="relu")
+        logits_i = pt.layers.fc(hv, size=4)
+    with pt.program_guard(main, startup):
+        pt.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    QuantizationTransformPass().apply(main, startup)
+    qat_infer = QuantizationTransformPass().apply(infer)
+
+    X, Y = _mlp_data()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for _ in range(40):
+            exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        qat_test = qat_infer.clone(for_test=True)
+        qat_out = np.asarray(exe.run(qat_test, feed={"x": X},
+                                     fetch_list=[logits_i.name])[0])
+        scope = pt.global_scope()
+        frozen = QuantizationFreezePass().apply(qat_infer, scope)
+        types = [op.type for op in frozen.global_block().ops]
+        assert not any(t.startswith("fake_") for t in types)
+        frozen_out = np.asarray(exe.run(frozen, feed={"x": X},
+                                        fetch_list=[logits_i.name])[0])
+    # weight quantization identical; activation fake-quant removed — close
+    np.testing.assert_allclose(frozen_out, qat_out, rtol=0.1, atol=0.1)
+
+
+# ---------------------------------------------------------------------------
+# Pruning (reference: slim/tests/test_prune_strategy.py)
+# ---------------------------------------------------------------------------
+
+
+def test_pruner_ratio_and_masks_persist():
+    from paddle_tpu.slim import Pruner
+
+    main, startup, loss, _ = _build_mlp()
+    with pt.program_guard(main, startup):
+        pt.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    X, Y = _mlp_data()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for _ in range(20):
+            exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        scope = pt.global_scope()
+        params = [p.name for p in main.global_block().all_parameters()
+                  if p.name.endswith(".w_0")]
+        pruner = Pruner()
+        masks = pruner.prune(scope, params, {"*": 0.5})
+        for name in params:
+            w = np.asarray(scope.find_var(name))
+            frac = (w == 0).mean()
+            assert 0.45 <= frac <= 0.55, (name, frac)
+        pruner.apply_masks(main, scope, masks)
+        # continue training: pruned entries must STAY zero
+        for _ in range(10):
+            exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        for name in params:
+            w = np.asarray(scope.find_var(name))
+            assert ((w == 0) >= (masks[name] == 0)).all()
+
+
+def test_sensitivity_analysis():
+    from paddle_tpu.slim import Pruner, SensitivePruneStrategy
+
+    main, startup, loss, _ = _build_mlp()
+    train = main.clone()
+    with pt.program_guard(train, startup):
+        pt.optimizer.Adam(learning_rate=0.02).minimize(
+            train.global_block().var(loss.name))
+    X, Y = _mlp_data()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for _ in range(80):
+            exe.run(train, feed={"x": X, "y": Y}, fetch_list=[loss.name])
+        scope = pt.global_scope()
+        params = [p.name for p in main.global_block().all_parameters()
+                  if p.name.endswith(".w_0")]
+
+        def eval_fn():
+            l = exe.run(main, feed={"x": X, "y": Y},
+                        fetch_list=[loss.name])[0]
+            return -float(np.asarray(l).reshape(()))   # higher = better
+
+        strat = SensitivePruneStrategy(ratios=(0.3, 0.9))
+        sens = strat.sensitivity(scope, params, eval_fn)
+        assert set(sens) == set(params)
+        # wiping 90% of a trained layer must hurt the trained model
+        for curve in sens.values():
+            assert curve[0.9] > 0, curve
+        ratios = strat.pick_ratios(sens, max_drop=1e9)
+        assert all(r == 0.9 for r in ratios.values())
+
+
+# ---------------------------------------------------------------------------
+# Distillation (reference: slim/tests/test_distillation_strategy.py)
+# ---------------------------------------------------------------------------
+
+
+def test_distillation_merge_and_soft_label():
+    from paddle_tpu.slim import distillation
+
+    # teacher: bigger MLP, trained a bit
+    teacher, t_start = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), \
+            pt.program_guard(teacher, t_start):
+        x = pt.layers.data(name="x", shape=[8], dtype="float32")
+        th = pt.layers.fc(x, size=32, act="relu",
+                          param_attr=pt.ParamAttr(name="tw1"),
+                          bias_attr=pt.ParamAttr(name="tb1"))
+        t_logits = pt.layers.fc(th, size=4,
+                                param_attr=pt.ParamAttr(name="tw2"),
+                                bias_attr=pt.ParamAttr(name="tb2"))
+
+    student, s_start = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), \
+            pt.program_guard(student, s_start):
+        x = pt.layers.data(name="x", shape=[8], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="int64")
+        sh = pt.layers.fc(x, size=8, act="relu",
+                          param_attr=pt.ParamAttr(name="sw1"),
+                          bias_attr=pt.ParamAttr(name="sb1"))
+        s_logits = pt.layers.fc(sh, size=4,
+                                param_attr=pt.ParamAttr(name="sw2"),
+                                bias_attr=pt.ParamAttr(name="sb2"))
+
+    rename = distillation.merge(teacher, student, data_names=["x"])
+    t_logits_name = rename[t_logits.name]
+    with pt.program_guard(student, s_start):
+        t_var = student.global_block().var(t_logits_name)
+        kd = distillation.soft_label_loss(t_var, s_logits,
+                                          teacher_temperature=2.0,
+                                          student_temperature=2.0)
+        ce = pt.layers.mean(pt.layers.softmax_with_cross_entropy(
+            s_logits, y))
+        total = pt.layers.elementwise_add(kd, ce)
+        pt.optimizer.Adam(learning_rate=0.02).minimize(total)
+
+    X, Y = _mlp_data()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(s_start)
+        exe.run(t_start)
+        distillation.init_teacher_scope(pt.global_scope(), rename)
+        ls = [float(np.asarray(exe.run(
+            student, feed={"x": X, "y": Y}, fetch_list=[total])[0])
+            .reshape(())) for _ in range(60)]
+        assert ls[-1] < ls[0], (ls[0], ls[-1])
+        # teacher params unchanged by student training
+        tw = np.asarray(pt.global_scope().find_var("teacher_tw1"))
+        exe.run(student, feed={"x": X, "y": Y}, fetch_list=[total])
+        tw2 = np.asarray(pt.global_scope().find_var("teacher_tw1"))
+        np.testing.assert_array_equal(tw, tw2)
+
+
+# ---------------------------------------------------------------------------
+# NAS (reference: slim/tests/test_light_nas.py — controller over TCP)
+# ---------------------------------------------------------------------------
+
+
+def test_nas_controller_server_finds_good_tokens():
+    from paddle_tpu.slim import ControllerServer, SAController, SearchAgent
+
+    ctrl = SAController(range_table=[8] * 5, init_temperature=100.0,
+                        reduce_rate=0.7, seed=0)
+    server = ControllerServer(ctrl)
+    server.start()
+    agent = SearchAgent("127.0.0.1", server.port)
+    # toy reward: maximize sum of tokens (max 35)
+    for _ in range(60):
+        toks = agent.next_tokens()
+        agent.update(toks, float(sum(toks)))
+    best_toks, best_reward = agent.best()
+    agent.close_server()
+    assert best_reward >= 25, (best_toks, best_reward)
+
+
+def test_filter_l1_prunes_output_axis():
+    """Regression: structured pruning targets the OUTPUT axis — columns
+    for fc [In, Out], filters for conv [O, I, H, W]."""
+    from paddle_tpu.slim import Pruner
+
+    scope = pt.Scope()
+    w = np.ones((6, 4), "float32")
+    w[:, 0] = 0.01        # weakest output column
+    w[:, 2] = 0.02
+    scope.set_var("fcw", w)
+    Pruner(mode="filter_l1").prune(scope, ["fcw"], {"*": 0.5})
+    out = np.asarray(scope.find_var("fcw"))
+    assert (out[:, 0] == 0).all() and (out[:, 2] == 0).all()
+    assert (out[:, 1] != 0).all() and (out[:, 3] != 0).all()
+
+    conv = np.ones((4, 2, 3, 3), "float32")
+    conv[1] = 0.01
+    scope.set_var("convw", conv)
+    Pruner(mode="filter_l1").prune(scope, ["convw"], {"*": 0.25})
+    out = np.asarray(scope.find_var("convw"))
+    assert (out[1] == 0).all() and (out[0] != 0).all()
+
+
+def test_nas_server_survives_malformed_request():
+    import socket as _socket
+
+    from paddle_tpu.slim import ControllerServer, SAController, SearchAgent
+
+    srv = ControllerServer(SAController(range_table=[4, 4], seed=2))
+    srv.start()
+    # garbage request must not kill the accept loop
+    with _socket.create_connection(("127.0.0.1", srv.port)) as s:
+        s.sendall(b"update\tnot,numbers")
+        s.shutdown(_socket.SHUT_WR)
+        resp = s.recv(65536).decode()
+    assert resp.startswith("error")
+    agent = SearchAgent("127.0.0.1", srv.port)
+    toks = agent.next_tokens()
+    assert len(toks) == 2
+    agent.close_server()
