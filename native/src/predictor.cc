@@ -1,0 +1,1038 @@
+// Native inference predictor: interprets a saved inference Program
+// (__model__ JSON + .npy parameters) with C++ CPU kernels behind a C API.
+//
+// Reference: paddle/fluid/inference/api/ (PaddlePredictor ABI,
+// paddle_api.h:204; NaiveExecutor flat op loop,
+// framework/naive_executor.cc) and the C API in
+// paddle/fluid/inference/capi/c_api.h. The reference's predictor loads a
+// protobuf ProgramDesc and dispatches to the full kernel registry; this
+// one parses the JSON Program IR this framework serializes
+// (core/ir.py to_dict) and implements the inference op subset natively —
+// the deployment path that must not depend on Python or JAX.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 predictor.cc -o libptpred.so
+
+#include <algorithm>
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// Minimal JSON parser (objects/arrays/strings/numbers/bool/null)
+// ---------------------------------------------------------------------------
+
+namespace pj {
+
+struct Value;
+using Object = std::map<std::string, Value>;
+using Array = std::vector<Value>;
+
+struct Value {
+  enum Kind { kNull, kBool, kNum, kStr, kArr, kObj } kind = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::shared_ptr<Array> arr;
+  std::shared_ptr<Object> obj;
+
+  bool is_null() const { return kind == kNull; }
+  const Value& at(const std::string& k) const { return obj->at(k); }
+  bool has(const std::string& k) const {
+    return kind == kObj && obj->count(k);
+  }
+  const Array& items() const { return *arr; }
+  int64_t as_int() const { return static_cast<int64_t>(num); }
+};
+
+class Parser {
+ public:
+  explicit Parser(const std::string& s) : s_(s) {}
+
+  Value parse() {
+    Value v = value();
+    ws();
+    return v;
+  }
+
+ private:
+  const std::string& s_;
+  size_t i_ = 0;
+
+  void ws() {
+    while (i_ < s_.size() && std::isspace(static_cast<unsigned char>(s_[i_])))
+      ++i_;
+  }
+  char peek() {
+    ws();
+    if (i_ >= s_.size()) throw std::runtime_error("json: eof");
+    return s_[i_];
+  }
+  void expect(char c) {
+    if (peek() != c)
+      throw std::runtime_error(std::string("json: expected ") + c);
+    ++i_;
+  }
+
+  Value value() {
+    char c = peek();
+    if (c == '{') return object();
+    if (c == '[') return array();
+    if (c == '"') {
+      Value v;
+      v.kind = Value::kStr;
+      v.str = string();
+      return v;
+    }
+    if (c == 't' || c == 'f') return boolean();
+    if (c == 'n') {
+      i_ += 4;
+      return Value{};
+    }
+    return number();
+  }
+
+  Value object() {
+    Value v;
+    v.kind = Value::kObj;
+    v.obj = std::make_shared<Object>();
+    expect('{');
+    if (peek() == '}') {
+      ++i_;
+      return v;
+    }
+    while (true) {
+      std::string k = string();
+      expect(':');
+      (*v.obj)[k] = value();
+      char c = peek();
+      ++i_;
+      if (c == '}') break;
+      if (c != ',') throw std::runtime_error("json: bad object");
+    }
+    return v;
+  }
+
+  Value array() {
+    Value v;
+    v.kind = Value::kArr;
+    v.arr = std::make_shared<Array>();
+    expect('[');
+    if (peek() == ']') {
+      ++i_;
+      return v;
+    }
+    while (true) {
+      v.arr->push_back(value());
+      char c = peek();
+      ++i_;
+      if (c == ']') break;
+      if (c != ',') throw std::runtime_error("json: bad array");
+    }
+    return v;
+  }
+
+  std::string string() {
+    expect('"');
+    std::string out;
+    while (i_ < s_.size()) {
+      char c = s_[i_++];
+      if (c == '"') return out;
+      if (c == '\\') {
+        char e = s_[i_++];
+        switch (e) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case 'u': {
+            unsigned code = std::stoul(s_.substr(i_, 4), nullptr, 16);
+            i_ += 4;
+            if (code < 0x80) {
+              out += static_cast<char>(code);
+            } else if (code < 0x800) {
+              out += static_cast<char>(0xC0 | (code >> 6));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            } else {
+              out += static_cast<char>(0xE0 | (code >> 12));
+              out += static_cast<char>(0x80 | ((code >> 6) & 0x3F));
+              out += static_cast<char>(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: out += e;
+        }
+      } else {
+        out += c;
+      }
+    }
+    throw std::runtime_error("json: unterminated string");
+  }
+
+  Value boolean() {
+    Value v;
+    v.kind = Value::kBool;
+    if (s_.compare(i_, 4, "true") == 0) {
+      v.b = true;
+      i_ += 4;
+    } else {
+      v.b = false;
+      i_ += 5;
+    }
+    return v;
+  }
+
+  Value number() {
+    size_t start = i_;
+    while (i_ < s_.size() &&
+           (std::isdigit(static_cast<unsigned char>(s_[i_])) ||
+            strchr("+-.eE", s_[i_])))
+      ++i_;
+    Value v;
+    v.kind = Value::kNum;
+    v.num = std::stod(s_.substr(start, i_ - start));
+    return v;
+  }
+};
+
+}  // namespace pj
+
+// ---------------------------------------------------------------------------
+// Tensor + npy
+// ---------------------------------------------------------------------------
+
+enum class DType { f32, i64, i32 };
+
+struct Tensor {
+  DType dtype = DType::f32;
+  std::vector<int64_t> shape;
+  std::vector<float> f;
+  std::vector<int64_t> i;
+
+  int64_t numel() const {
+    int64_t n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+  void resize_f(std::vector<int64_t> s) {
+    shape = std::move(s);
+    dtype = DType::f32;
+    f.assign(static_cast<size_t>(numel()), 0.f);
+  }
+  void resize_i(std::vector<int64_t> s) {
+    shape = std::move(s);
+    dtype = DType::i64;
+    i.assign(static_cast<size_t>(numel()), 0);
+  }
+};
+
+static Tensor load_npy(const std::string& path) {
+  std::ifstream in(path, std::ios::binary);
+  if (!in) throw std::runtime_error("cannot open " + path);
+  char magic[6];
+  in.read(magic, 6);
+  if (std::memcmp(magic, "\x93NUMPY", 6) != 0)
+    throw std::runtime_error("bad npy magic: " + path);
+  unsigned char ver[2];
+  in.read(reinterpret_cast<char*>(ver), 2);
+  uint32_t hlen = 0;
+  if (ver[0] == 1) {
+    uint16_t h;
+    in.read(reinterpret_cast<char*>(&h), 2);
+    hlen = h;
+  } else {
+    in.read(reinterpret_cast<char*>(&hlen), 4);
+  }
+  std::string header(hlen, '\0');
+  in.read(header.data(), hlen);
+
+  auto find_val = [&](const std::string& key) {
+    size_t p = header.find(key);
+    if (p == std::string::npos)
+      throw std::runtime_error("npy header missing " + key);
+    return p + key.size();
+  };
+  size_t dp = find_val("'descr':");
+  while (header[dp] == ' ' || header[dp] == '\'') ++dp;
+  std::string descr;
+  while (header[dp] != '\'') descr += header[dp++];
+
+  size_t fp = find_val("'fortran_order':");
+  while (header[fp] == ' ') ++fp;
+  bool fortran = header.compare(fp, 4, "True") == 0;
+
+  size_t sp = find_val("'shape':");
+  while (header[sp] != '(') ++sp;
+  ++sp;
+  std::vector<int64_t> shape;
+  while (header[sp] != ')') {
+    if (std::isdigit(static_cast<unsigned char>(header[sp]))) {
+      int64_t v = 0;
+      while (std::isdigit(static_cast<unsigned char>(header[sp])))
+        v = v * 10 + (header[sp++] - '0');
+      shape.push_back(v);
+    } else {
+      ++sp;
+    }
+  }
+
+  Tensor t;
+  t.shape = shape.empty() ? std::vector<int64_t>{1} : shape;
+  int64_t n = t.numel();
+  if (descr == "<f4" || descr == "|f4") {
+    t.dtype = DType::f32;
+    t.f.resize(n);
+    in.read(reinterpret_cast<char*>(t.f.data()), n * 4);
+  } else if (descr == "<f8") {
+    t.dtype = DType::f32;
+    std::vector<double> tmp(n);
+    in.read(reinterpret_cast<char*>(tmp.data()), n * 8);
+    t.f.assign(tmp.begin(), tmp.end());
+  } else if (descr == "<i8") {
+    t.dtype = DType::i64;
+    t.i.resize(n);
+    in.read(reinterpret_cast<char*>(t.i.data()), n * 8);
+  } else if (descr == "<i4") {
+    t.dtype = DType::i64;
+    std::vector<int32_t> tmp(n);
+    in.read(reinterpret_cast<char*>(tmp.data()), n * 4);
+    t.i.assign(tmp.begin(), tmp.end());
+  } else {
+    throw std::runtime_error("npy dtype unsupported: " + descr);
+  }
+  if (fortran && t.shape.size() > 1) {
+    // convert column-major file order to the row-major layout used here
+    size_t nd = t.shape.size();
+    std::vector<int64_t> cstr(nd, 1), fstr(nd, 1);
+    for (int64_t k = static_cast<int64_t>(nd) - 2; k >= 0; --k)
+      cstr[k] = cstr[k + 1] * t.shape[k + 1];
+    for (size_t k = 1; k < nd; ++k)
+      fstr[k] = fstr[k - 1] * t.shape[k - 1];
+    auto permute = [&](auto& buf) {
+      auto src = buf;
+      for (int64_t l = 0; l < n; ++l) {
+        int64_t rem = l, foff = 0;
+        for (size_t k = 0; k < nd; ++k) {
+          int64_t idx = rem / cstr[k];
+          rem %= cstr[k];
+          foff += idx * fstr[k];
+        }
+        buf[l] = src[foff];
+      }
+    };
+    if (t.dtype == DType::f32) permute(t.f); else permute(t.i);
+  }
+  return t;
+}
+
+// ---------------------------------------------------------------------------
+// Program model
+// ---------------------------------------------------------------------------
+
+struct OpDesc {
+  std::string type;
+  std::map<std::string, std::vector<std::string>> inputs, outputs;
+  pj::Value attrs;
+
+  const std::string& in(const std::string& slot) const {
+    static const std::string empty;
+    auto it = inputs.find(slot);
+    if (it == inputs.end() || it->second.empty()) return empty;
+    return it->second[0];
+  }
+  const std::string& out(const std::string& slot) const {
+    static const std::string empty;
+    auto it = outputs.find(slot);
+    if (it == outputs.end() || it->second.empty()) return empty;
+    return it->second[0];
+  }
+  bool has_attr(const std::string& k) const { return attrs.has(k); }
+  double attr_num(const std::string& k, double dflt) const {
+    if (!attrs.has(k)) return dflt;
+    const auto& v = attrs.at(k);
+    if (v.kind == pj::Value::kBool) return v.b ? 1 : 0;
+    return v.num;
+  }
+  std::string attr_str(const std::string& k, const std::string& dflt) const {
+    if (!attrs.has(k)) return dflt;
+    return attrs.at(k).str;
+  }
+  std::vector<int64_t> attr_ints(const std::string& k) const {
+    std::vector<int64_t> out;
+    if (!attrs.has(k)) return out;
+    for (const auto& v : attrs.at(k).items())
+      out.push_back(static_cast<int64_t>(v.num));
+    return out;
+  }
+};
+
+struct Predictor {
+  std::vector<OpDesc> ops;
+  std::map<std::string, Tensor> scope;   // persistables + intermediates
+  std::vector<std::string> feed_names, fetch_names;
+  std::vector<Tensor> outputs;
+  std::string error;
+};
+
+// ---------------------------------------------------------------------------
+// Kernels
+// ---------------------------------------------------------------------------
+
+static void gemm(const float* a, const float* b, float* c, int64_t m,
+                 int64_t k, int64_t n) {
+  // c[m,n] = a[m,k] @ b[k,n]
+  for (int64_t i = 0; i < m; ++i) {
+    for (int64_t j = 0; j < n; ++j) c[i * n + j] = 0.f;
+    for (int64_t p = 0; p < k; ++p) {
+      float av = a[i * k + p];
+      if (av == 0.f) continue;
+      const float* brow = b + p * n;
+      float* crow = c + i * n;
+      for (int64_t j = 0; j < n; ++j) crow[j] += av * brow[j];
+    }
+  }
+}
+
+static int64_t prod(const std::vector<int64_t>& v, size_t from, size_t to) {
+  int64_t p = 1;
+  for (size_t i = from; i < to && i < v.size(); ++i) p *= v[i];
+  return p;
+}
+
+using Kernel = void (*)(Predictor&, const OpDesc&);
+
+static Tensor& var(Predictor& P, const std::string& name) {
+  auto it = P.scope.find(name);
+  if (it == P.scope.end())
+    throw std::runtime_error("var not found: " + name);
+  return it->second;
+}
+
+static void k_mul(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  const Tensor& y = var(P, op.in("Y"));
+  int64_t xd = static_cast<int64_t>(op.attr_num("x_num_col_dims", 1));
+  int64_t m = prod(x.shape, 0, xd);
+  int64_t k = prod(x.shape, xd, x.shape.size());
+  int64_t n = prod(y.shape, 1, y.shape.size());
+  Tensor& o = P.scope[op.out("Out")];
+  std::vector<int64_t> oshape(x.shape.begin(), x.shape.begin() + xd);
+  oshape.push_back(n);
+  o.resize_f(oshape);
+  gemm(x.f.data(), y.f.data(), o.f.data(), m, k, n);
+}
+
+static void k_matmul(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  const Tensor& y = var(P, op.in("Y"));
+  bool tx = op.attr_num("transpose_X", 0) != 0;
+  bool ty = op.attr_num("transpose_Y", 0) != 0;
+  if (x.shape.size() != 2 || y.shape.size() != 2 || tx)
+    throw std::runtime_error("native matmul supports 2-D, no transpose_X");
+  int64_t m = x.shape[0], k = x.shape[1];
+  int64_t n = ty ? y.shape[0] : y.shape[1];
+  Tensor& o = P.scope[op.out("Out")];
+  o.resize_f({m, n});
+  if (!ty) {
+    gemm(x.f.data(), y.f.data(), o.f.data(), m, k, n);
+  } else {
+    for (int64_t i = 0; i < m; ++i)
+      for (int64_t j = 0; j < n; ++j) {
+        float acc = 0;
+        for (int64_t p = 0; p < k; ++p)
+          acc += x.f[i * k + p] * y.f[j * k + p];
+        o.f[i * n + j] = acc;
+      }
+  }
+  float alpha = static_cast<float>(op.attr_num("alpha", 1.0));
+  if (alpha != 1.f)
+    for (auto& v : o.f) v *= alpha;
+}
+
+template <typename F>
+static void ewise_binary(Predictor& P, const OpDesc& op, F fn) {
+  const Tensor& x = var(P, op.in("X"));
+  const Tensor& y = var(P, op.in("Y"));
+  Tensor& o = P.scope[op.out("Out")];
+  o.resize_f(x.shape);
+  if (x.numel() == y.numel()) {
+    for (int64_t i = 0; i < x.numel(); ++i) o.f[i] = fn(x.f[i], y.f[i]);
+    return;
+  }
+  // axis broadcast (reference elementwise semantics): y's dims align to
+  // x's starting at `axis`
+  int64_t axis = static_cast<int64_t>(op.attr_num("axis", -1));
+  if (axis < 0) axis = static_cast<int64_t>(x.shape.size() - y.shape.size());
+  int64_t pre = prod(x.shape, 0, axis);
+  int64_t mid = y.numel();
+  int64_t post = x.numel() / (pre * mid);
+  for (int64_t p = 0; p < pre; ++p)
+    for (int64_t m_ = 0; m_ < mid; ++m_)
+      for (int64_t q = 0; q < post; ++q) {
+        int64_t idx = (p * mid + m_) * post + q;
+        o.f[idx] = fn(x.f[idx], y.f[m_]);
+      }
+}
+
+static void k_relu(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  Tensor& o = P.scope[op.out("Out")];
+  o.resize_f(x.shape);
+  for (int64_t i = 0; i < x.numel(); ++i) o.f[i] = std::max(0.f, x.f[i]);
+}
+
+template <typename F>
+static void ewise_unary(Predictor& P, const OpDesc& op, F fn) {
+  const Tensor& x = var(P, op.in("X"));
+  Tensor& o = P.scope[op.out("Out")];
+  o.resize_f(x.shape);
+  for (int64_t i = 0; i < x.numel(); ++i) o.f[i] = fn(x.f[i]);
+}
+
+static void k_softmax(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  Tensor& o = P.scope[op.out("Out")];
+  o.resize_f(x.shape);
+  int64_t d = x.shape.back();
+  int64_t rows = x.numel() / d;
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xi = x.f.data() + r * d;
+    float* oi = o.f.data() + r * d;
+    float mx = xi[0];
+    for (int64_t j = 1; j < d; ++j) mx = std::max(mx, xi[j]);
+    float sum = 0;
+    for (int64_t j = 0; j < d; ++j) {
+      oi[j] = std::exp(xi[j] - mx);
+      sum += oi[j];
+    }
+    for (int64_t j = 0; j < d; ++j) oi[j] /= sum;
+  }
+}
+
+static void k_scale(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  float s = static_cast<float>(op.attr_num("scale", 1.0));
+  float b = static_cast<float>(op.attr_num("bias", 0.0));
+  bool after = op.attr_num("bias_after_scale", 1) != 0;
+  Tensor& o = P.scope[op.out("Out")];
+  if (x.dtype == DType::i64) {
+    o.resize_i(x.shape);
+    for (int64_t i = 0; i < x.numel(); ++i)
+      o.i[i] = after ? static_cast<int64_t>(x.i[i] * s + b)
+                     : static_cast<int64_t>((x.i[i] + b) * s);
+    return;
+  }
+  o.resize_f(x.shape);
+  for (int64_t i = 0; i < x.numel(); ++i)
+    o.f[i] = after ? x.f[i] * s + b : (x.f[i] + b) * s;
+}
+
+static void reshape_like(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  std::vector<int64_t> shape = op.attr_ints("shape");
+  int64_t known = 1, infer = -1;
+  for (size_t i = 0; i < shape.size(); ++i) {
+    if (shape[i] == -1) {
+      infer = static_cast<int64_t>(i);
+    } else if (shape[i] == 0) {
+      shape[i] = x.shape[i];
+      known *= shape[i];
+    } else {
+      known *= shape[i];
+    }
+  }
+  if (infer >= 0) shape[infer] = x.numel() / known;
+  Tensor& o = P.scope[op.out("Out")];
+  o = x;
+  o.shape = shape;
+}
+
+static void k_transpose2(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  std::vector<int64_t> perm = op.attr_ints("axis");
+  if (perm.empty()) perm = op.attr_ints("perm");
+  size_t nd = x.shape.size();
+  std::vector<int64_t> oshape(nd);
+  for (size_t i = 0; i < nd; ++i) oshape[i] = x.shape[perm[i]];
+  Tensor& o = P.scope[op.out("Out")];
+  o.resize_f(oshape);
+  std::vector<int64_t> xstr(nd, 1), ostr(nd, 1);
+  for (int64_t i = static_cast<int64_t>(nd) - 2; i >= 0; --i) {
+    xstr[i] = xstr[i + 1] * x.shape[i + 1];
+    ostr[i] = ostr[i + 1] * oshape[i + 1];
+  }
+  std::vector<int64_t> idx(nd, 0);
+  for (int64_t l = 0; l < x.numel(); ++l) {
+    int64_t rem = l, xoff = 0;
+    for (size_t i = 0; i < nd; ++i) {
+      idx[i] = rem / ostr[i];
+      rem %= ostr[i];
+      xoff += idx[i] * xstr[perm[i]];
+    }
+    o.f[l] = x.f[xoff];
+  }
+}
+
+static void k_conv2d(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("Input"));
+  const Tensor& w = var(P, op.in("Filter"));
+  auto strides = op.attr_ints("strides");
+  auto pads = op.attr_ints("paddings");
+  auto dil = op.attr_ints("dilations");
+  int64_t g = static_cast<int64_t>(op.attr_num("groups", 1));
+  if (strides.empty()) strides = {1, 1};
+  if (pads.empty()) pads = {0, 0};
+  if (dil.empty()) dil = {1, 1};
+  int64_t N = x.shape[0], C = x.shape[1], H = x.shape[2], W = x.shape[3];
+  int64_t O = w.shape[0], KC = w.shape[1], KH = w.shape[2], KW = w.shape[3];
+  if (op.type == "depthwise_conv2d") g = C;
+  int64_t HO = (H + 2 * pads[0] - (dil[0] * (KH - 1) + 1)) / strides[0] + 1;
+  int64_t WO = (W + 2 * pads[1] - (dil[1] * (KW - 1) + 1)) / strides[1] + 1;
+  Tensor& o = P.scope[op.out("Output")];
+  o.resize_f({N, O, HO, WO});
+  int64_t cg = C / g;   // channels per group (== KC)
+  int64_t og = O / g;
+  (void)KC;
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t oc = 0; oc < O; ++oc) {
+      int64_t grp = oc / og;
+      for (int64_t oh = 0; oh < HO; ++oh)
+        for (int64_t ow = 0; ow < WO; ++ow) {
+          float acc = 0;
+          for (int64_t ic = 0; ic < cg; ++ic) {
+            int64_t c = grp * cg + ic;
+            for (int64_t kh = 0; kh < KH; ++kh) {
+              int64_t ih = oh * strides[0] - pads[0] + kh * dil[0];
+              if (ih < 0 || ih >= H) continue;
+              for (int64_t kw = 0; kw < KW; ++kw) {
+                int64_t iw = ow * strides[1] - pads[1] + kw * dil[1];
+                if (iw < 0 || iw >= W) continue;
+                acc += x.f[((n * C + c) * H + ih) * W + iw] *
+                       w.f[((oc * cg + ic) * KH + kh) * KW + kw];
+              }
+            }
+          }
+          o.f[((n * O + oc) * HO + oh) * WO + ow] = acc;
+        }
+    }
+}
+
+static void k_pool2d(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  std::string ptype = op.attr_str("pooling_type", "max");
+  auto ksize = op.attr_ints("ksize");
+  auto strides = op.attr_ints("strides");
+  auto pads = op.attr_ints("paddings");
+  bool global = op.attr_num("global_pooling", 0) != 0;
+  if (strides.empty()) strides = ksize;
+  if (pads.empty()) pads = {0, 0};
+  int64_t N = x.shape[0], C = x.shape[1], H = x.shape[2], W = x.shape[3];
+  if (global) {
+    ksize = {H, W};
+    strides = {H, W};
+    pads = {0, 0};
+  }
+  int64_t HO = (H + 2 * pads[0] - ksize[0]) / strides[0] + 1;
+  int64_t WO = (W + 2 * pads[1] - ksize[1]) / strides[1] + 1;
+  Tensor& o = P.scope[op.out("Out")];
+  o.resize_f({N, C, HO, WO});
+  bool exclusive = op.attr_num("exclusive", 1) != 0;
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t c = 0; c < C; ++c)
+      for (int64_t oh = 0; oh < HO; ++oh)
+        for (int64_t ow = 0; ow < WO; ++ow) {
+          float best = -3.4e38f, sum = 0;
+          int64_t cnt = 0;
+          for (int64_t kh = 0; kh < ksize[0]; ++kh)
+            for (int64_t kw = 0; kw < ksize[1]; ++kw) {
+              int64_t ih = oh * strides[0] - pads[0] + kh;
+              int64_t iw = ow * strides[1] - pads[1] + kw;
+              if (ih < 0 || ih >= H || iw < 0 || iw >= W) continue;
+              float v = x.f[((n * C + c) * H + ih) * W + iw];
+              best = std::max(best, v);
+              sum += v;
+              ++cnt;
+            }
+          float out;
+          if (ptype == "max") {
+            out = best;
+          } else {
+            int64_t denom = exclusive ? cnt : ksize[0] * ksize[1];
+            out = sum / static_cast<float>(denom ? denom : 1);
+          }
+          o.f[((n * C + c) * HO + oh) * WO + ow] = out;
+        }
+}
+
+static void k_batch_norm(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  const Tensor& scale = var(P, op.in("Scale"));
+  const Tensor& bias = var(P, op.in("Bias"));
+  const Tensor& mean = var(P, op.in("Mean"));
+  const Tensor& variance = var(P, op.in("Variance"));
+  float eps = static_cast<float>(op.attr_num("epsilon", 1e-5));
+  int64_t N = x.shape[0], C = x.shape[1];
+  int64_t sp = x.numel() / (N * C);
+  Tensor& o = P.scope[op.out("Y")];
+  o.resize_f(x.shape);
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t c = 0; c < C; ++c) {
+      float inv = 1.f / std::sqrt(variance.f[c] + eps);
+      float a = scale.f[c] * inv;
+      float b = bias.f[c] - mean.f[c] * a;
+      const float* xi = x.f.data() + (n * C + c) * sp;
+      float* oi = o.f.data() + (n * C + c) * sp;
+      for (int64_t s = 0; s < sp; ++s) oi[s] = xi[s] * a + b;
+    }
+}
+
+static void k_layer_norm(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  const Tensor* scale =
+      op.in("Scale").empty() ? nullptr : &var(P, op.in("Scale"));
+  const Tensor* bias =
+      op.in("Bias").empty() ? nullptr : &var(P, op.in("Bias"));
+  int64_t axis = static_cast<int64_t>(op.attr_num("begin_norm_axis", 1));
+  float eps = static_cast<float>(op.attr_num("epsilon", 1e-5));
+  int64_t rows = prod(x.shape, 0, axis);
+  int64_t d = x.numel() / rows;
+  Tensor& o = P.scope[op.out("Y")];
+  o.resize_f(x.shape);
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xi = x.f.data() + r * d;
+    float* oi = o.f.data() + r * d;
+    float mu = 0;
+    for (int64_t j = 0; j < d; ++j) mu += xi[j];
+    mu /= d;
+    float var_ = 0;
+    for (int64_t j = 0; j < d; ++j) var_ += (xi[j] - mu) * (xi[j] - mu);
+    var_ /= d;
+    float inv = 1.f / std::sqrt(var_ + eps);
+    for (int64_t j = 0; j < d; ++j) {
+      float v = (xi[j] - mu) * inv;
+      if (scale) v *= scale->f[j];
+      if (bias) v += bias->f[j];
+      oi[j] = v;
+    }
+  }
+}
+
+static void k_lookup_table(Predictor& P, const OpDesc& op) {
+  const Tensor& w = var(P, op.in("W"));
+  const Tensor& ids = var(P, op.in("Ids"));
+  int64_t dim = w.shape[1];
+  std::vector<int64_t> oshape = ids.shape;
+  // a trailing [,1] ids axis widens to dim (reference lookup semantics)
+  if (!oshape.empty() && oshape.back() == 1) oshape.pop_back();
+  oshape.push_back(dim);
+  Tensor& o = P.scope[op.out("Out")];
+  o.resize_f(oshape);
+  int64_t n = ids.numel();
+  for (int64_t r = 0; r < n; ++r) {
+    int64_t id = ids.i[r];
+    std::memcpy(o.f.data() + r * dim, w.f.data() + id * dim, dim * 4);
+  }
+}
+
+static void k_dropout(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  Tensor& o = P.scope[op.out("Out")];
+  o = x;
+  std::string impl =
+      op.attr_str("dropout_implementation", "downgrade_in_infer");
+  if (impl == "downgrade_in_infer") {
+    float p = static_cast<float>(op.attr_num("dropout_prob", 0.5));
+    for (auto& v : o.f) v *= (1.f - p);
+  }
+}
+
+static void k_concat(Predictor& P, const OpDesc& op) {
+  auto it = op.inputs.find("X");
+  std::vector<const Tensor*> xs;
+  for (const auto& n : it->second)
+    if (!n.empty()) xs.push_back(&var(P, n));
+  int64_t axis = static_cast<int64_t>(op.attr_num("axis", 0));
+  if (axis < 0) axis += static_cast<int64_t>(xs[0]->shape.size());
+  std::vector<int64_t> oshape = xs[0]->shape;
+  int64_t total = 0;
+  for (auto* x : xs) total += x->shape[axis];
+  oshape[axis] = total;
+  Tensor& o = P.scope[op.out("Out")];
+  o.resize_f(oshape);
+  int64_t pre = prod(oshape, 0, axis);
+  int64_t post = prod(oshape, axis + 1, oshape.size());
+  int64_t off = 0;
+  for (auto* x : xs) {
+    int64_t mid = x->shape[axis];
+    for (int64_t p = 0; p < pre; ++p)
+      std::memcpy(o.f.data() + (p * total + off) * post,
+                  x->f.data() + p * mid * post, mid * post * 4);
+    off += mid;
+  }
+}
+
+static void k_reduce_mean(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  // inference use: mean over all (keep simple: reduce_all or last axis)
+  bool reduce_all = op.attr_num("reduce_all", 0) != 0;
+  Tensor& o = P.scope[op.out("Out")];
+  if (reduce_all || op.attr_ints("dim").empty()) {
+    o.resize_f({1});
+    float s = 0;
+    for (auto v : x.f) s += v;
+    o.f[0] = s / static_cast<float>(x.numel());
+    return;
+  }
+  auto dims = op.attr_ints("dim");
+  if (dims.size() != 1)
+    throw std::runtime_error("native reduce_mean: one axis only");
+  int64_t axis = dims[0] < 0
+                     ? dims[0] + static_cast<int64_t>(x.shape.size())
+                     : dims[0];
+  int64_t pre = prod(x.shape, 0, axis);
+  int64_t d = x.shape[axis];
+  int64_t post = prod(x.shape, axis + 1, x.shape.size());
+  std::vector<int64_t> oshape;
+  for (size_t i = 0; i < x.shape.size(); ++i)
+    if (static_cast<int64_t>(i) != axis) oshape.push_back(x.shape[i]);
+  if (oshape.empty()) oshape = {1};
+  o.resize_f(oshape);
+  for (int64_t p = 0; p < pre; ++p)
+    for (int64_t q = 0; q < post; ++q) {
+      float s = 0;
+      for (int64_t j = 0; j < d; ++j)
+        s += x.f[(p * d + j) * post + q];
+      o.f[p * post + q] = s / static_cast<float>(d);
+    }
+}
+
+static void k_arg_max(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  int64_t d = x.shape.back();
+  int64_t rows = x.numel() / d;
+  std::vector<int64_t> oshape(x.shape.begin(), x.shape.end() - 1);
+  if (oshape.empty()) oshape = {1};
+  Tensor& o = P.scope[op.out("Out")];
+  o.resize_i(oshape);
+  for (int64_t r = 0; r < rows; ++r) {
+    int64_t best = 0;
+    for (int64_t j = 1; j < d; ++j)
+      if (x.f[r * d + j] > x.f[r * d + best]) best = j;
+    o.i[r] = best;
+  }
+}
+
+static void run_op(Predictor& P, const OpDesc& op) {
+  const std::string& t = op.type;
+  if (t == "mul") return k_mul(P, op);
+  if (t == "matmul" || t == "matmul_v2") return k_matmul(P, op);
+  if (t == "elementwise_add")
+    return ewise_binary(P, op, [](float a, float b) { return a + b; });
+  if (t == "elementwise_sub")
+    return ewise_binary(P, op, [](float a, float b) { return a - b; });
+  if (t == "elementwise_mul")
+    return ewise_binary(P, op, [](float a, float b) { return a * b; });
+  if (t == "elementwise_div")
+    return ewise_binary(P, op, [](float a, float b) { return a / b; });
+  if (t == "relu") return k_relu(P, op);
+  if (t == "sigmoid")
+    return ewise_unary(P, op,
+                       [](float v) { return 1.f / (1.f + std::exp(-v)); });
+  if (t == "tanh") return ewise_unary(P, op, [](float v) {
+        return std::tanh(v);
+      });
+  if (t == "gelu") return ewise_unary(P, op, [](float v) {
+        return 0.5f * v * (1.f + std::erf(v * 0.70710678f));
+      });
+  if (t == "exp") return ewise_unary(P, op, [](float v) {
+        return std::exp(v);
+      });
+  if (t == "sqrt") return ewise_unary(P, op, [](float v) {
+        return std::sqrt(v);
+      });
+  if (t == "softmax") return k_softmax(P, op);
+  if (t == "scale") return k_scale(P, op);
+  if (t == "reshape" || t == "reshape2" || t == "flatten" ||
+      t == "flatten2" || t == "squeeze" || t == "squeeze2" ||
+      t == "unsqueeze" || t == "unsqueeze2") {
+    if (t.rfind("reshape", 0) == 0) return reshape_like(P, op);
+    // flatten/squeeze/unsqueeze: recompute from output var desc is not
+    // stored; derive: flatten2 keeps axis attr
+    const Tensor& x = var(P, op.in("X"));
+    Tensor& o = P.scope[op.out("Out")];
+    o = x;
+    if (t.rfind("flatten", 0) == 0) {
+      int64_t axis = static_cast<int64_t>(op.attr_num("axis", 1));
+      o.shape = {prod(x.shape, 0, axis),
+                 prod(x.shape, axis, x.shape.size())};
+    } else if (t.rfind("unsqueeze", 0) == 0) {
+      auto axes = op.attr_ints("axes");
+      std::vector<int64_t> s = x.shape;
+      for (auto a : axes) {
+        if (a < 0) a += static_cast<int64_t>(s.size()) + 1;
+        s.insert(s.begin() + a, 1);
+      }
+      o.shape = s;
+    } else {  // squeeze
+      auto axes = op.attr_ints("axes");
+      std::vector<int64_t> s;
+      for (size_t i = 0; i < x.shape.size(); ++i) {
+        bool drop = false;
+        for (auto a : axes) {
+          int64_t ax = a < 0 ? a + static_cast<int64_t>(x.shape.size()) : a;
+          if (static_cast<int64_t>(i) == ax && x.shape[i] == 1) drop = true;
+        }
+        if (axes.empty() && x.shape[i] == 1) drop = true;
+        if (!drop) s.push_back(x.shape[i]);
+      }
+      o.shape = s;
+    }
+    return;
+  }
+  if (t == "transpose" || t == "transpose2") return k_transpose2(P, op);
+  if (t == "conv2d" || t == "depthwise_conv2d") return k_conv2d(P, op);
+  if (t == "pool2d") return k_pool2d(P, op);
+  if (t == "batch_norm" || t == "sync_batch_norm")
+    return k_batch_norm(P, op);
+  if (t == "layer_norm") return k_layer_norm(P, op);
+  if (t == "lookup_table" || t == "lookup_table_v2")
+    return k_lookup_table(P, op);
+  if (t == "dropout") return k_dropout(P, op);
+  if (t == "concat") return k_concat(P, op);
+  if (t == "reduce_mean") return k_reduce_mean(P, op);
+  if (t == "arg_max") return k_arg_max(P, op);
+  if (t == "assign") {
+    P.scope[op.out("Out")] = var(P, op.in("X"));
+    return;
+  }
+  throw std::runtime_error("native predictor: unsupported op '" + t + "'");
+}
+
+// ---------------------------------------------------------------------------
+// C API (reference: inference/capi/c_api.h PD_* surface)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* PD_NewPredictor(const char* model_dir) {
+  auto* P = new Predictor();
+  try {
+    std::string dir(model_dir);
+    std::ifstream in(dir + "/__model__");
+    if (!in) throw std::runtime_error("missing __model__ in " + dir);
+    std::stringstream ss;
+    ss << in.rdbuf();
+    pj::Value payload = pj::Parser(ss.str()).parse();
+    for (const auto& v : payload.at("feed_names").items())
+      P->feed_names.push_back(v.str);
+    for (const auto& v : payload.at("fetch_names").items())
+      P->fetch_names.push_back(v.str);
+    const pj::Value& block = payload.at("program").at("blocks").items()[0];
+    for (const auto& vd : block.at("vars").items()) {
+      if (vd.has("persistable") && vd.at("persistable").b) {
+        std::string name = vd.at("name").str;
+        std::string fname = name;
+        size_t pos;
+        while ((pos = fname.find('/')) != std::string::npos)
+          fname.replace(pos, 1, "%2F");
+        P->scope[name] = load_npy(dir + "/" + fname + ".npy");
+      }
+    }
+    for (const auto& od : block.at("ops").items()) {
+      OpDesc op;
+      op.type = od.at("type").str;
+      if (op.type == "feed" || op.type == "fetch") continue;
+      for (const auto& [slot, names] : *od.at("inputs").obj) {
+        for (const auto& n : names.items())
+          op.inputs[slot].push_back(n.str);
+      }
+      for (const auto& [slot, names] : *od.at("outputs").obj) {
+        for (const auto& n : names.items())
+          op.outputs[slot].push_back(n.str);
+      }
+      op.attrs = od.at("attrs");
+      P->ops.push_back(std::move(op));
+    }
+  } catch (const std::exception& e) {
+    P->error = e.what();
+  }
+  return P;
+}
+
+void PD_DeletePredictor(void* h) { delete static_cast<Predictor*>(h); }
+
+const char* PD_GetError(void* h) {
+  return static_cast<Predictor*>(h)->error.c_str();
+}
+
+int PD_GetInputNum(void* h) {
+  return static_cast<int>(static_cast<Predictor*>(h)->feed_names.size());
+}
+int PD_GetOutputNum(void* h) {
+  return static_cast<int>(static_cast<Predictor*>(h)->fetch_names.size());
+}
+const char* PD_GetInputName(void* h, int i) {
+  return static_cast<Predictor*>(h)->feed_names[i].c_str();
+}
+const char* PD_GetOutputName(void* h, int i) {
+  return static_cast<Predictor*>(h)->fetch_names[i].c_str();
+}
+
+// inputs: per feed, float32 or int64 buffers; dtype 0=f32, 1=i64
+int PD_PredictorRun(void* h, const char** names, const void** datas,
+                    const int64_t** shapes, const int* ndims,
+                    const int* dtypes, int n_inputs) {
+  auto* P = static_cast<Predictor*>(h);
+  if (!P->error.empty()) return -1;
+  try {
+    // clear previous non-persistable vars? keep: overwritten per run
+    for (int k = 0; k < n_inputs; ++k) {
+      Tensor t;
+      std::vector<int64_t> shape(shapes[k], shapes[k] + ndims[k]);
+      if (dtypes[k] == 0) {
+        t.resize_f(shape);
+        std::memcpy(t.f.data(), datas[k], t.numel() * 4);
+      } else {
+        t.resize_i(shape);
+        std::memcpy(t.i.data(), datas[k], t.numel() * 8);
+      }
+      P->scope[names[k]] = std::move(t);
+    }
+    for (const auto& op : P->ops) run_op(*P, op);
+    P->outputs.clear();
+    for (const auto& n : P->fetch_names) P->outputs.push_back(var(*P, n));
+    return 0;
+  } catch (const std::exception& e) {
+    P->error = e.what();
+    return -1;
+  }
+}
+
+int PD_GetOutputNdim(void* h, int i) {
+  return static_cast<int>(
+      static_cast<Predictor*>(h)->outputs[i].shape.size());
+}
+void PD_GetOutputShape(void* h, int i, int64_t* out) {
+  const auto& s = static_cast<Predictor*>(h)->outputs[i].shape;
+  std::copy(s.begin(), s.end(), out);
+}
+int PD_GetOutputDtype(void* h, int i) {
+  return static_cast<Predictor*>(h)->outputs[i].dtype == DType::f32 ? 0 : 1;
+}
+void PD_GetOutputData(void* h, int i, void* out) {
+  const auto& t = static_cast<Predictor*>(h)->outputs[i];
+  if (t.dtype == DType::f32)
+    std::memcpy(out, t.f.data(), t.numel() * 4);
+  else
+    std::memcpy(out, t.i.data(), t.numel() * 8);
+}
+
+}  // extern "C"

@@ -1,0 +1,144 @@
+"""ctypes bindings for the native C++ inference predictor
+(native/src/predictor.cc).
+
+Reference: paddle/fluid/inference/capi/c_api.h — the C deployment ABI
+over the C++ predictor. Same role here: `NativePredictor` loads a saved
+inference model (io.save_inference_model output) and runs it with the
+native interpreter, no Python/JAX in the serving path beyond this thin
+ctypes veneer (a pure-C client calls the PD_* symbols directly).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_REPO, "native", "src", "predictor.cc")
+_LIB_DIR = os.path.join(_REPO, "native", "build")
+_LIB = os.path.join(_LIB_DIR, "libptpred.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build_lib():
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC,
+           "-o", _LIB]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def get_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB) or (
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            _build_lib()
+        lib = ctypes.CDLL(_LIB)
+        lib.PD_NewPredictor.restype = ctypes.c_void_p
+        lib.PD_NewPredictor.argtypes = [ctypes.c_char_p]
+        lib.PD_DeletePredictor.argtypes = [ctypes.c_void_p]
+        lib.PD_GetError.restype = ctypes.c_char_p
+        lib.PD_GetError.argtypes = [ctypes.c_void_p]
+        lib.PD_GetInputNum.argtypes = [ctypes.c_void_p]
+        lib.PD_GetOutputNum.argtypes = [ctypes.c_void_p]
+        lib.PD_GetInputName.restype = ctypes.c_char_p
+        lib.PD_GetInputName.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.PD_GetOutputName.restype = ctypes.c_char_p
+        lib.PD_GetOutputName.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.PD_PredictorRun.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+            ctypes.c_int]
+        lib.PD_GetOutputNdim.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.PD_GetOutputShape.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64)]
+        lib.PD_GetOutputDtype.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.PD_GetOutputData.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                         ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class NativePredictor:
+    """C++-interpreted inference over a saved model directory."""
+
+    def __init__(self, model_dir: str):
+        self._lib = get_lib()
+        self._h = self._lib.PD_NewPredictor(model_dir.encode())
+        err = self._lib.PD_GetError(self._h)
+        if err:
+            msg = err.decode()
+            self._lib.PD_DeletePredictor(self._h)
+            self._h = None
+            raise RuntimeError(f"NativePredictor: {msg}")
+
+    @property
+    def input_names(self) -> List[str]:
+        return [self._lib.PD_GetInputName(self._h, i).decode()
+                for i in range(self._lib.PD_GetInputNum(self._h))]
+
+    @property
+    def output_names(self) -> List[str]:
+        return [self._lib.PD_GetOutputName(self._h, i).decode()
+                for i in range(self._lib.PD_GetOutputNum(self._h))]
+
+    def run(self, feed: Dict[str, np.ndarray]) -> List[np.ndarray]:
+        names = list(feed)
+        arrays = []
+        for n in names:
+            a = np.ascontiguousarray(feed[n])
+            if a.dtype == np.float64:
+                a = a.astype(np.float32)
+            if a.dtype in (np.int32, np.int16):
+                a = a.astype(np.int64)
+            if a.dtype not in (np.float32, np.int64):
+                raise TypeError(f"unsupported feed dtype {a.dtype}")
+            arrays.append(a)
+        n = len(names)
+        c_names = (ctypes.c_char_p * n)(*[s.encode() for s in names])
+        c_datas = (ctypes.c_void_p * n)(
+            *[a.ctypes.data_as(ctypes.c_void_p) for a in arrays])
+        shapes = [np.asarray(a.shape, np.int64) for a in arrays]
+        c_shapes = (ctypes.POINTER(ctypes.c_int64) * n)(
+            *[s.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+              for s in shapes])
+        c_ndims = (ctypes.c_int * n)(*[a.ndim for a in arrays])
+        c_dtypes = (ctypes.c_int * n)(
+            *[0 if a.dtype == np.float32 else 1 for a in arrays])
+        rc = self._lib.PD_PredictorRun(self._h, c_names, c_datas, c_shapes,
+                                       c_ndims, c_dtypes, n)
+        if rc != 0:
+            raise RuntimeError(
+                f"native run failed: "
+                f"{self._lib.PD_GetError(self._h).decode()}")
+        outs = []
+        for i in range(self._lib.PD_GetOutputNum(self._h)):
+            nd = self._lib.PD_GetOutputNdim(self._h, i)
+            shape = np.zeros(nd, np.int64)
+            self._lib.PD_GetOutputShape(
+                self._h, i, shape.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_int64)))
+            if self._lib.PD_GetOutputDtype(self._h, i) == 0:
+                buf = np.zeros(tuple(shape), np.float32)
+            else:
+                buf = np.zeros(tuple(shape), np.int64)
+            self._lib.PD_GetOutputData(
+                self._h, i, buf.ctypes.data_as(ctypes.c_void_p))
+            outs.append(buf)
+        return outs
+
+    def __del__(self):
+        if getattr(self, "_h", None) is not None:
+            self._lib.PD_DeletePredictor(self._h)
+            self._h = None

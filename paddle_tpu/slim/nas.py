@@ -46,8 +46,19 @@ class SAController:
         cand[idx] = self._rng.randrange(self.range_table[idx])
         return cand
 
+    @property
+    def is_finished(self) -> bool:
+        return self._iter >= self.max_iter_number
+
     def update(self, tokens: Sequence[int], reward: float) -> bool:
-        """Metropolis accept/reject; returns True if accepted."""
+        """Metropolis accept/reject; returns True if accepted. After
+        max_iter_number updates the search is finished and further
+        rewards are recorded for `best` only."""
+        if self.is_finished:
+            if reward > self.best_reward:
+                self.best_reward = reward
+                self.best_tokens = list(tokens)
+            return False
         self._iter += 1
         temperature = self.init_temperature * \
             self.reduce_rate ** self._iter
@@ -112,10 +123,10 @@ class ControllerServer:
                         if not b:
                             break
                         chunks.append(b)
-                    data = b"".join(chunks).decode("utf-8").strip()
                     try:
+                        data = b"".join(chunks).decode("utf-8").strip()
                         resp = self._handle(data)
-                    except Exception as e:  # malformed request
+                    except Exception as e:  # malformed/non-UTF-8 request
                         resp = f"error {type(e).__name__}: {e}"
                     conn.sendall(resp.encode("utf-8"))
             except OSError:

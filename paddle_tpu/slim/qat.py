@@ -102,11 +102,13 @@ class QuantizationTransformPass:
                 # weights [In, Out] per Out (last axis)
                 axis = 0 if len(shape) == 4 else len(shape) - 1
                 attrs = {"bit_length": bits, "quant_axis": axis}
+                scale_shape = (shape[axis],)
             else:
                 op_type = "fake_quantize_dequantize_abs_max"
                 attrs = {"bit_length": bits}
+                scale_shape = (1,)
             scale = self._mkvar(desc, f"{name}.quant_scale",
-                                (1,), persistable=False)
+                                scale_shape, persistable=False)
             new_ops.append(OpDesc(type=op_type, inputs={"X": [name]},
                                   outputs={"Out": [qname],
                                            "OutScale": [scale]},

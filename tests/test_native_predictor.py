@@ -1,0 +1,124 @@
+"""Native C++ predictor parity tests.
+
+Reference pattern: inference/api/api_impl_tester.cc and
+capi tests — run the same saved model through the Python executor and the
+native C predictor, compare outputs."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.capi import NativePredictor
+
+
+def _train_and_save(tmp_path, build_fn, feeds, steps=30, lr=0.02):
+    main, startup, feed_vars, fetch_var, loss = build_fn()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    for _ in range(steps):
+        exe.run(main, feed=feeds, fetch_list=[loss])
+    pt.io.save_inference_model(str(tmp_path), [v.name for v in feed_vars],
+                               [fetch_var], exe, main_program=main)
+    py_out = exe.run(main, feed=feeds, fetch_list=[fetch_var])[0]
+    return np.asarray(py_out)
+
+
+def test_native_predictor_mlp_parity(tmp_path):
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 8).astype("float32")
+    Y = rng.randint(0, 3, (16, 1)).astype("int64")
+
+    def build():
+        main, startup = pt.Program(), pt.Program()
+        with pt.framework.unique_name.guard(), \
+                pt.program_guard(main, startup):
+            x = pt.layers.data(name="x", shape=[8], dtype="float32")
+            y = pt.layers.data(name="y", shape=[1], dtype="int64")
+            h = pt.layers.fc(x, size=16, act="relu")
+            h = pt.layers.layer_norm(h)
+            logits = pt.layers.fc(h, size=3)
+            prob = pt.layers.softmax(logits)
+            loss = pt.layers.mean(
+                pt.layers.softmax_with_cross_entropy(logits, y))
+            pt.optimizer.Adam(learning_rate=0.02).minimize(loss)
+        return main, startup, [x], prob, loss
+
+    with pt.scope_guard(pt.Scope()):
+        py_out = _train_and_save(tmp_path, build, {"x": X, "y": Y})
+
+    pred = NativePredictor(str(tmp_path))
+    assert pred.input_names == ["x"]
+    out = pred.run({"x": X})[0]
+    assert out.shape == py_out.shape
+    np.testing.assert_allclose(out, py_out, rtol=2e-4, atol=2e-5)
+
+
+def test_native_predictor_lenet_parity(tmp_path):
+    rng = np.random.RandomState(1)
+    X = rng.randn(4, 1, 28, 28).astype("float32")
+    Y = rng.randint(0, 10, (4, 1)).astype("int64")
+
+    def build():
+        main, startup = pt.Program(), pt.Program()
+        with pt.framework.unique_name.guard(), \
+                pt.program_guard(main, startup):
+            x = pt.layers.data(name="img", shape=[1, 28, 28],
+                               dtype="float32")
+            y = pt.layers.data(name="y", shape=[1], dtype="int64")
+            c1 = pt.layers.conv2d(x, num_filters=6, filter_size=5,
+                                  padding=2, act="relu")
+            p1 = pt.layers.pool2d(c1, pool_size=2, pool_stride=2)
+            c2 = pt.layers.conv2d(p1, num_filters=16, filter_size=5,
+                                  act="relu")
+            p2 = pt.layers.pool2d(c2, pool_size=2, pool_stride=2)
+            flat = pt.layers.flatten(p2)
+            fc1 = pt.layers.fc(flat, size=32, act="relu")
+            logits = pt.layers.fc(fc1, size=10)
+            prob = pt.layers.softmax(logits)
+            loss = pt.layers.mean(
+                pt.layers.softmax_with_cross_entropy(logits, y))
+            pt.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        return main, startup, [x], prob, loss
+
+    with pt.scope_guard(pt.Scope()):
+        py_out = _train_and_save(tmp_path, build, {"img": X, "y": Y},
+                                 steps=5)
+
+    pred = NativePredictor(str(tmp_path))
+    out = pred.run({"img": X})[0]
+    np.testing.assert_allclose(out, py_out, rtol=2e-3, atol=2e-4)
+    # same top-1 everywhere
+    np.testing.assert_array_equal(out.argmax(1), py_out.argmax(1))
+
+
+def test_native_predictor_embedding_model(tmp_path):
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, 20, (8, 5)).astype("int64")
+
+    def build():
+        main, startup = pt.Program(), pt.Program()
+        with pt.framework.unique_name.guard(), \
+                pt.program_guard(main, startup):
+            w = pt.layers.data(name="w", shape=[5], dtype="int64")
+            emb = pt.layers.embedding(w, size=[20, 12])
+            pooled = pt.layers.reduce_mean(emb, dim=1)
+            logits = pt.layers.fc(pooled, size=4, act="tanh")
+            loss = pt.layers.mean(logits)
+        return main, startup, [w], logits, loss
+
+    with pt.scope_guard(pt.Scope()):
+        main, startup, feed_vars, fetch_var, loss = build()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        pt.io.save_inference_model(str(tmp_path), ["w"], [fetch_var], exe,
+                                   main_program=main)
+        py_out = np.asarray(exe.run(main, feed={"w": ids},
+                                    fetch_list=[fetch_var])[0])
+    pred = NativePredictor(str(tmp_path))
+    out = pred.run({"w": ids})[0]
+    np.testing.assert_allclose(out, py_out, rtol=2e-4, atol=2e-5)
+
+
+def test_native_predictor_errors():
+    with pytest.raises(RuntimeError, match="__model__"):
+        NativePredictor("/nonexistent/dir")

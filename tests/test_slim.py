@@ -375,3 +375,27 @@ def test_nas_server_survives_malformed_request():
     toks = agent.next_tokens()
     assert len(toks) == 2
     agent.close_server()
+
+
+def test_nas_server_survives_non_utf8_and_iter_limit():
+    import socket as _socket
+
+    from paddle_tpu.slim import ControllerServer, SAController, SearchAgent
+
+    ctrl = SAController(range_table=[4, 4], seed=3, max_iter_number=3)
+    srv = ControllerServer(ctrl)
+    srv.start()
+    with _socket.create_connection(("127.0.0.1", srv.port)) as s:
+        s.sendall(b"\xff\xfe garbage")
+        s.shutdown(_socket.SHUT_WR)
+        resp = s.recv(65536).decode()
+    assert resp.startswith("error")
+    agent = SearchAgent("127.0.0.1", srv.port)
+    for _ in range(5):
+        toks = agent.next_tokens()
+        agent.update(toks, float(sum(toks)))
+    assert ctrl.is_finished
+    # post-limit updates are rejected but best still tracks
+    assert agent.update([3, 3], 100.0) is False
+    assert agent.best()[1] == 100.0
+    agent.close_server()
