@@ -409,6 +409,12 @@ static int64_t prod(const std::vector<int64_t>& v, size_t from, size_t to) {
 
 using Kernel = void (*)(Predictor&, const OpDesc&);
 
+static void require_f32(const Tensor& t, const char* what) {
+  if (t.dtype != DType::f32)
+    throw std::runtime_error(std::string(what) +
+                             ": float32 input required");
+}
+
 static Tensor& var(Predictor& P, const std::string& name) {
   auto it = P.scope.find(name);
   if (it == P.scope.end())
@@ -461,6 +467,8 @@ template <typename F>
 static void ewise_binary(Predictor& P, const OpDesc& op, F fn) {
   const Tensor& x = var(P, op.in("X"));
   const Tensor& y = var(P, op.in("Y"));
+  require_f32(x, "elementwise");
+  require_f32(y, "elementwise");
   Tensor& o = P.scope[op.out("Out")];
   o.resize_f(x.shape);
   if (x.numel() == y.numel()) {
@@ -557,6 +565,7 @@ static void reshape_like(Predictor& P, const OpDesc& op) {
 
 static void k_transpose2(Predictor& P, const OpDesc& op) {
   const Tensor& x = var(P, op.in("X"));
+  require_f32(x, "transpose");
   std::vector<int64_t> perm = op.attr_ints("axis");
   if (perm.empty()) perm = op.attr_ints("perm");
   size_t nd = x.shape.size();
@@ -736,8 +745,13 @@ static void k_lookup_table(Predictor& P, const OpDesc& op) {
   Tensor& o = P.scope[op.out("Out")];
   o.resize_f(oshape);
   int64_t n = ids.numel();
+  int64_t vocab = w.shape[0];
+  int64_t pad = static_cast<int64_t>(op.attr_num("padding_idx", -1));
   for (int64_t r = 0; r < n; ++r) {
     int64_t id = ids.i[r];
+    if (id < 0 || id >= vocab)
+      throw std::runtime_error("lookup_table: id out of range");
+    if (id == pad) continue;  // padding row emits zeros
     std::memcpy(o.f.data() + r * dim, w.f.data() + id * dim, dim * 4);
   }
 }
@@ -758,7 +772,10 @@ static void k_concat(Predictor& P, const OpDesc& op) {
   auto it = op.inputs.find("X");
   std::vector<const Tensor*> xs;
   for (const auto& n : it->second)
-    if (!n.empty()) xs.push_back(&var(P, n));
+    if (!n.empty()) {
+      xs.push_back(&var(P, n));
+      require_f32(*xs.back(), "concat");
+    }
   int64_t axis = static_cast<int64_t>(op.attr_num("axis", 0));
   if (axis < 0) axis += static_cast<int64_t>(xs[0]->shape.size());
   std::vector<int64_t> oshape = xs[0]->shape;
@@ -800,9 +817,14 @@ static void k_reduce_mean(Predictor& P, const OpDesc& op) {
   int64_t pre = prod(x.shape, 0, axis);
   int64_t d = x.shape[axis];
   int64_t post = prod(x.shape, axis + 1, x.shape.size());
+  bool keep = op.attr_num("keep_dim", 0) != 0;
   std::vector<int64_t> oshape;
-  for (size_t i = 0; i < x.shape.size(); ++i)
-    if (static_cast<int64_t>(i) != axis) oshape.push_back(x.shape[i]);
+  for (size_t i = 0; i < x.shape.size(); ++i) {
+    if (static_cast<int64_t>(i) != axis)
+      oshape.push_back(x.shape[i]);
+    else if (keep)
+      oshape.push_back(1);
+  }
   if (oshape.empty()) oshape = {1};
   o.resize_f(oshape);
   for (int64_t p = 0; p < pre; ++p)
@@ -991,7 +1013,8 @@ int PD_PredictorRun(void* h, const char** names, const void** datas,
                     const int64_t** shapes, const int* ndims,
                     const int* dtypes, int n_inputs) {
   auto* P = static_cast<Predictor*>(h);
-  if (!P->error.empty()) return -1;
+  if (P->ops.empty() && !P->error.empty()) return -1;  // load failed
+  P->error.clear();  // run errors are recoverable — retry allowed
   try {
     // clear previous non-persistable vars? keep: overwritten per run
     for (int k = 0; k < n_inputs; ++k) {

@@ -11,3 +11,7 @@ from . import cifar
 from . import uci_housing
 from . import imdb
 from . import imikolov
+from . import movielens
+from . import conll05
+from . import wmt16
+from . import flowers

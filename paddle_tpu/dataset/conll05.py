@@ -1,0 +1,60 @@
+"""CoNLL-2005 SRL (reference: python/paddle/dataset/conll05.py) —
+offline-synthetic fallback. Samples follow the reference layout: 8 input
+sequences (word, ctx_n2/ctx_n1/ctx_0/ctx_p1/ctx_p2 predicate-window
+words, verb, mark) + the IOB label sequence."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["get_dict", "get_embedding", "test", "train"]
+
+_WORD_VOCAB = 1000
+_VERB_VOCAB = 50
+_N_LABELS = 9     # 4 chunk types x {B,I} + O (IOB scheme)
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(_WORD_VOCAB)}
+    verb_dict = {f"v{i}": i for i in range(_VERB_VOCAB)}
+    label_dict = {f"l{i}": i for i in range(_N_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    rng = np.random.RandomState(42)
+    return rng.randn(_WORD_VOCAB, 32).astype("float32")
+
+
+def _creator(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = rng.randint(5, 30)
+            words = rng.randint(0, _WORD_VOCAB, length)
+            pred_pos = rng.randint(0, length)
+            verb = int(words[pred_pos]) % _VERB_VOCAB
+            mark = (np.arange(length) == pred_pos).astype(np.int64)
+
+            def ctx(off):
+                pos = np.clip(pred_pos + off, 0, length - 1)
+                return np.full(length, words[pos], np.int64)
+
+            # synthetic-but-learnable labels: tag depends on word and
+            # distance to the predicate
+            labels = ((words + np.abs(np.arange(length) - pred_pos))
+                      % _N_LABELS).astype(np.int64)
+            yield (words.tolist(), ctx(-2).tolist(), ctx(-1).tolist(),
+                   ctx(0).tolist(), ctx(1).tolist(), ctx(2).tolist(),
+                   np.full(length, verb, np.int64).tolist(),
+                   mark.tolist(), labels.tolist())
+
+    return reader
+
+
+def train():
+    return _creator(1000, seed=0)
+
+
+def test():
+    return _creator(200, seed=1)

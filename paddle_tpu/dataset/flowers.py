@@ -1,0 +1,42 @@
+"""102-flowers (reference: python/paddle/dataset/flowers.py) — offline-
+synthetic fallback: class-conditional colored blob images [3, H, W] in
+[0,1] with 102 labels, so image models have signal to fit."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "valid"]
+
+_N_CLASSES = 102
+_HW = 32
+
+
+def _creator(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        yy, xx = np.mgrid[0:_HW, 0:_HW].astype(np.float32) / _HW
+        for _ in range(n):
+            label = rng.randint(0, _N_CLASSES)
+            # class-dependent color and blob position
+            hue = label / _N_CLASSES
+            cx, cy = 0.2 + 0.6 * ((label * 37) % 10) / 10.0, \
+                0.2 + 0.6 * ((label * 61) % 10) / 10.0
+            blob = np.exp(-(((xx - cx) ** 2 + (yy - cy) ** 2) / 0.02))
+            img = np.stack([blob * hue, blob * (1 - hue), blob * 0.5])
+            img += rng.rand(3, _HW, _HW).astype(np.float32) * 0.1
+            yield np.clip(img, 0, 1).astype(np.float32).ravel(), label
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _creator(2040, seed=0)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _creator(510, seed=1)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _creator(510, seed=2)

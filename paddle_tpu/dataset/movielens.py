@@ -1,0 +1,81 @@
+"""MovieLens-1M (reference: python/paddle/dataset/movielens.py) —
+offline-synthetic fallback with the same sample layout:
+(user_id, gender_id, age_id, job_id, movie_id, category_ids, title_ids,
+rating)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "max_user_id", "max_movie_id", "max_job_id",
+           "age_table", "movie_categories", "MovieInfo", "UserInfo"]
+
+_N_USERS = 600
+_N_MOVIES = 400
+_N_JOBS = 21
+_N_CATEGORIES = 18
+_TITLE_VOCAB = 1000
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = age_table.index(int(age))
+        self.job_id = int(job_id)
+
+
+def max_user_id():
+    return _N_USERS
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def max_job_id():
+    return _N_JOBS - 1
+
+
+def movie_categories():
+    return {f"cat{i}": i for i in range(_N_CATEGORIES)}
+
+
+def _creator(n, seed):
+    def reader():
+        rng = np.random.RandomState(seed)
+        # hidden factors give ratings real structure to learn
+        uf = rng.randn(_N_USERS + 1, 4)
+        mf = rng.randn(_N_MOVIES + 1, 4)
+        for _ in range(n):
+            uid = rng.randint(1, _N_USERS + 1)
+            mid = rng.randint(1, _N_MOVIES + 1)
+            gender = rng.randint(0, 2)
+            age = rng.randint(0, len(age_table))
+            job = rng.randint(0, _N_JOBS)
+            cats = rng.choice(_N_CATEGORIES,
+                              rng.randint(1, 4), replace=False).tolist()
+            title = rng.randint(0, _TITLE_VOCAB,
+                                rng.randint(2, 6)).tolist()
+            score = float((uf[uid] * mf[mid]).sum())
+            rating = float(np.clip(np.round(3.0 + 1.5 * np.tanh(score)),
+                                   1, 5))
+            yield [uid, gender, age, job, mid, cats, title, rating]
+
+    return reader
+
+
+def train():
+    return _creator(4000, seed=0)
+
+
+def test():
+    return _creator(800, seed=1)

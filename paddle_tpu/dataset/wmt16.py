@@ -1,0 +1,57 @@
+"""WMT16 en-de (reference: python/paddle/dataset/wmt16.py) — offline-
+synthetic fallback: an invertible toy translation (target = permuted
+source vocabulary) so seq2seq models have real structure to learn.
+Samples are (src_ids, trg_ids_in, trg_ids_out) like the reference, with
+<s>=0, <e>=1, <unk>=2."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+
+def _vocab_perm(size, seed=7):
+    rng = np.random.RandomState(seed)
+    perm = np.arange(3, size)
+    rng.shuffle(perm)
+    return perm
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {"<s>": 0, "<e>": 1, "<unk>": 2}
+    for i in range(3, dict_size):
+        d[f"{lang}{i}"] = i
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d
+
+
+def _creator(n, seed, src_dict_size, trg_dict_size):
+    if src_dict_size < 5 or trg_dict_size < 5:
+        raise ValueError("dict sizes must be >= 5 (3 specials + tokens)")
+    perm = _vocab_perm(src_dict_size)
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = rng.randint(3, 12)
+            src = rng.randint(3, src_dict_size, length)
+            trg = 3 + (perm[src - 3] - 3) % (trg_dict_size - 3)
+            trg_in = np.concatenate([[0], trg])
+            trg_out = np.concatenate([trg, [1]])
+            yield src.tolist(), trg_in.tolist(), trg_out.tolist()
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return _creator(2000, 0, src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return _creator(200, 1, src_dict_size, trg_dict_size)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return _creator(200, 2, src_dict_size, trg_dict_size)

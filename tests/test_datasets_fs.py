@@ -1,0 +1,119 @@
+"""Tests for the new dataset readers and the filesystem shim."""
+
+import gzip
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import io_fs
+from paddle_tpu.dataset import conll05, flowers, movielens, wmt16
+
+
+def test_movielens_schema_and_determinism():
+    r1 = list(movielens.train()())[:20]
+    r2 = list(movielens.train()())[:20]
+    assert r1 == r2   # deterministic
+    uid, gender, age, job, mid, cats, title, rating = r1[0]
+    assert 1 <= uid <= movielens.max_user_id()
+    assert 1 <= mid <= movielens.max_movie_id()
+    assert 0 <= job <= movielens.max_job_id()
+    assert 1.0 <= rating <= 5.0
+    assert all(isinstance(c, (int, np.integer)) for c in cats)
+
+
+def test_conll05_schema():
+    wd, vd, ld = conll05.get_dict()
+    assert len(ld) == 9
+    sample = next(iter(conll05.train()()))
+    assert len(sample) == 9          # 8 inputs + labels
+    length = len(sample[0])
+    assert all(len(s) == length for s in sample)
+    assert sum(sample[7]) == 1       # exactly one predicate mark
+    emb = conll05.get_embedding()
+    assert emb.shape == (len(wd), 32)
+
+
+def test_wmt16_translation_is_learnable_mapping():
+    reader = wmt16.train(50, 50)
+    src, trg_in, trg_out = next(iter(reader()))
+    assert trg_in[0] == 0            # <s>
+    assert trg_out[-1] == 1          # <e>
+    assert trg_in[1:] == trg_out[:-1]
+    # same source token always maps to the same target token
+    pairs = {}
+    for src, _, trg_out in list(reader())[:200]:
+        for s, t in zip(src, trg_out):
+            assert pairs.setdefault(s, t) == t
+    d = wmt16.get_dict("en", 50)
+    assert d["<s>"] == 0 and len(d) == 50
+
+
+def test_flowers_images():
+    img, label = next(iter(flowers.train()()))
+    assert img.shape == (3 * 32 * 32,)
+    assert 0 <= label < 102
+    assert 0.0 <= img.min() and img.max() <= 1.0
+    labels = [l for _, l in list(flowers.test()())[:100]]
+    assert len(set(labels)) > 20     # diverse classes
+
+
+def test_local_fs_roundtrip(tmp_path):
+    p = str(tmp_path / "a.txt")
+    fs = io_fs.fs_select(p)
+    with fs.open_write(p) as f:
+        f.write("hello\n")
+    assert io_fs.fs_exists(p)
+    with fs.open_read(p) as f:
+        assert f.read() == "hello\n"
+    # gzip transparency (reference converter-pipe behavior)
+    gz = str(tmp_path / "b.txt.gz")
+    with gzip.open(gz, "wt") as f:
+        f.write("zipped\n")
+    with io_fs.fs_open_read(gz) as f:
+        assert f.read() == "zipped\n"
+    sub = str(tmp_path / "d1" / "d2")
+    io_fs.fs_mkdir(sub)
+    assert os.path.isdir(sub)
+    fs.touch(str(tmp_path / "c.txt"))
+    names = io_fs.fs_list(str(tmp_path))
+    assert any(n.endswith("a.txt") for n in names)
+
+
+def test_hdfs_fs_gated():
+    with pytest.raises(RuntimeError, match="not found on PATH"):
+        io_fs.fs_select("hdfs://cluster/path", hadoop_bin="hadoop-missing")
+
+
+def test_image_classification_flowers_book(tmp_path):
+    """Mini book/test_image_classification.py on the flowers reader: a
+    small convnet's accuracy must clear random chance by a wide margin."""
+    import itertools
+
+    samples = list(itertools.islice(flowers.train()(), 256))
+    X = np.stack([s[0] for s in samples]).reshape(-1, 3, 32, 32)
+    # remap the 102 labels into 4 coarse classes to keep the test fast
+    Y = (np.array([s[1] for s in samples]) % 4).astype("int64")[:, None]
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        img = pt.layers.data(name="img", shape=[3, 32, 32],
+                             dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="int64")
+        c = pt.layers.conv2d(img, num_filters=8, filter_size=3, padding=1,
+                             act="relu")
+        p = pt.layers.pool2d(c, pool_size=4, pool_stride=4)
+        logits = pt.layers.fc(pt.layers.flatten(p), size=4)
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, y))
+        pt.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for _ in range(40):
+            exe.run(main, feed={"img": X, "y": Y}, fetch_list=[loss])
+        lg = exe.run(main, feed={"img": X, "y": Y},
+                     fetch_list=[logits])[0]
+        acc = (np.asarray(lg).argmax(1) == Y[:, 0]).mean()
+        assert acc > 0.5, acc        # chance = 0.25

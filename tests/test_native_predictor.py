@@ -122,3 +122,54 @@ def test_native_predictor_embedding_model(tmp_path):
 def test_native_predictor_errors():
     with pytest.raises(RuntimeError, match="__model__"):
         NativePredictor("/nonexistent/dir")
+
+
+def test_native_predictor_recovers_after_bad_feed(tmp_path):
+    """Regression: a failed run must not permanently brick the predictor."""
+    rng = np.random.RandomState(3)
+    X = rng.randn(4, 6).astype("float32")
+
+    def build():
+        main, startup = pt.Program(), pt.Program()
+        with pt.framework.unique_name.guard(), \
+                pt.program_guard(main, startup):
+            x = pt.layers.data(name="x", shape=[6], dtype="float32")
+            out = pt.layers.fc(x, size=2)
+            loss = pt.layers.mean(out)
+        return main, startup, [x], out, loss
+
+    with pt.scope_guard(pt.Scope()):
+        main, startup, feeds, fetch, loss = build()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        pt.io.save_inference_model(str(tmp_path), ["x"], [fetch], exe,
+                                   main_program=main)
+    pred = NativePredictor(str(tmp_path))
+    with pytest.raises(RuntimeError):
+        pred.run({"wrong_name": X})
+    out = pred.run({"x": X})[0]       # must work after the failure
+    assert out.shape == (4, 2)
+
+
+def test_native_predictor_padding_idx(tmp_path):
+    ids = np.array([[0, 3], [3, 0]], "int64")
+
+    def build():
+        main, startup = pt.Program(), pt.Program()
+        with pt.framework.unique_name.guard(), \
+                pt.program_guard(main, startup):
+            w = pt.layers.data(name="w", shape=[2], dtype="int64")
+            emb = pt.layers.embedding(w, size=[10, 4], padding_idx=0)
+        return main, startup, w, emb
+
+    with pt.scope_guard(pt.Scope()):
+        main, startup, w, emb = build()
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        pt.io.save_inference_model(str(tmp_path), ["w"], [emb], exe,
+                                   main_program=main)
+        py_out = np.asarray(exe.run(main, feed={"w": ids},
+                                    fetch_list=[emb])[0])
+    out = NativePredictor(str(tmp_path)).run({"w": ids})[0]
+    assert (out[0, 0] == 0).all() and (out[1, 1] == 0).all()
+    np.testing.assert_allclose(out, py_out, rtol=1e-5, atol=1e-6)
