@@ -375,6 +375,7 @@ struct OpDesc {
 };
 
 struct Predictor {
+  bool load_ok = false;
   std::vector<OpDesc> ops;
   std::map<std::string, Tensor> scope;   // persistables + intermediates
   std::vector<std::string> feed_names, fetch_names;
@@ -801,8 +802,11 @@ static void k_reduce_mean(Predictor& P, const OpDesc& op) {
   // inference use: mean over all (keep simple: reduce_all or last axis)
   bool reduce_all = op.attr_num("reduce_all", 0) != 0;
   Tensor& o = P.scope[op.out("Out")];
+  bool keep_all = op.attr_num("keep_dim", 0) != 0;
   if (reduce_all || op.attr_ints("dim").empty()) {
-    o.resize_f({1});
+    std::vector<int64_t> oshape{1};
+    if (keep_all) oshape.assign(x.shape.size(), 1);
+    o.resize_f(oshape);
     float s = 0;
     for (auto v : x.f) s += v;
     o.f[0] = s / static_cast<float>(x.numel());
@@ -817,7 +821,7 @@ static void k_reduce_mean(Predictor& P, const OpDesc& op) {
   int64_t pre = prod(x.shape, 0, axis);
   int64_t d = x.shape[axis];
   int64_t post = prod(x.shape, axis + 1, x.shape.size());
-  bool keep = op.attr_num("keep_dim", 0) != 0;
+  bool keep = keep_all;
   std::vector<int64_t> oshape;
   for (size_t i = 0; i < x.shape.size(); ++i) {
     if (static_cast<int64_t>(i) != axis)
@@ -983,6 +987,7 @@ void* PD_NewPredictor(const char* model_dir) {
       op.attrs = od.at("attrs");
       P->ops.push_back(std::move(op));
     }
+    P->load_ok = true;
   } catch (const std::exception& e) {
     P->error = e.what();
   }
@@ -1013,7 +1018,7 @@ int PD_PredictorRun(void* h, const char** names, const void** datas,
                     const int64_t** shapes, const int* ndims,
                     const int* dtypes, int n_inputs) {
   auto* P = static_cast<Predictor*>(h);
-  if (P->ops.empty() && !P->error.empty()) return -1;  // load failed
+  if (!P->load_ok) return -1;  // load failed — not recoverable
   P->error.clear();  // run errors are recoverable — retry allowed
   try {
     // clear previous non-persistable vars? keep: overwritten per run

@@ -51,10 +51,12 @@ def movie_categories():
 
 def _creator(n, seed):
     def reader():
+        # hidden factors are FIXED across splits (train and test share the
+        # same rating structure); the split seed only drives sampling
+        frng = np.random.RandomState(7)
+        uf = frng.randn(_N_USERS + 1, 4)
+        mf = frng.randn(_N_MOVIES + 1, 4)
         rng = np.random.RandomState(seed)
-        # hidden factors give ratings real structure to learn
-        uf = rng.randn(_N_USERS + 1, 4)
-        mf = rng.randn(_N_MOVIES + 1, 4)
         for _ in range(n):
             uid = rng.randint(1, _N_USERS + 1)
             mid = rng.randint(1, _N_MOVIES + 1)

@@ -41,3 +41,125 @@ def infer_from_dataset(executor, program=None, dataset=None, scope=None,
                                         ).default_main_program()).clone(for_test=True)
     return train_from_dataset(executor, infer_prog, dataset, scope, thread,
                               debug, fetch_list, fetch_info, print_period)
+
+
+class TrainerDesc:
+    """Trainer configuration (reference: trainer_desc.proto:21 — class
+    names MultiTrainer/DistMultiTrainer + device worker choice and
+    thread_num; here the XLA step is the device worker, so the desc keeps
+    the scheduling knobs only)."""
+
+    def __init__(self, thread_num: int = 1, trainer_class: str = "MultiTrainer",
+                 fetch_list=None, fetch_info=None, print_period: int = 100):
+        self.thread_num = max(1, int(thread_num))
+        self.trainer_class = trainer_class
+        self.fetch_list = fetch_list or []
+        self.fetch_info = fetch_info
+        self.print_period = print_period
+
+
+class HogwildWorker:
+    """One training thread: pull batches from its dataset shard, run the
+    compiled step against the SHARED scope (reference:
+    hogwild_worker.cc:163 TrainFiles). The device step itself is
+    serialized by a shared lock — the XLA step donates parameter buffers
+    for the in-place update, so two in-flight steps would race on freed
+    buffers; threads overlap on the C++ reader pipeline and host-side
+    batch prep instead (one chip executes one step at a time anyway)."""
+
+    def __init__(self, worker_id, executor, program, dataset, scope,
+                 desc: TrainerDesc, step_lock=None):
+        self.worker_id = worker_id
+        self.executor = executor
+        self.program = program
+        self.dataset = dataset
+        self.scope = scope
+        self.desc = desc
+        self.step_lock = step_lock
+        self.steps = 0
+        self.last_fetch = None
+
+    def train(self):
+        import contextlib
+
+        for feed in self.dataset._iter_batches() if hasattr(
+                self.dataset, "_iter_batches") else iter(self.dataset):
+            with self.step_lock if self.step_lock is not None else \
+                    contextlib.nullcontext():
+                vals = self.executor.run(self.program, feed=feed,
+                                         fetch_list=self.desc.fetch_list,
+                                         scope=self.scope)
+            self.steps += 1
+            if self.desc.fetch_list:
+                self.last_fetch = vals
+                if self.steps % self.desc.print_period == 0:
+                    names = self.desc.fetch_info or [
+                        getattr(f, "name", str(f))
+                        for f in self.desc.fetch_list]
+                    print(f"worker {self.worker_id} step {self.steps}: " +
+                          ", ".join(f"{n}={v}" for n, v in
+                                    zip(names, vals)))
+
+
+class MultiTrainer:
+    """Thread-pool trainer (reference: trainer.h:64 MultiTrainer — one
+    DeviceWorker thread per shard, shared root scope, exceptions funneled
+    like details/exception_holder.h)."""
+
+    def __init__(self, desc: TrainerDesc):
+        self.desc = desc
+        self.workers = []
+
+    def train(self, executor, program, datasets, scope=None):
+        """datasets: one per thread (shard with NativeDataset
+        trainer_id/num_trainers or per-thread filelists)."""
+        import threading
+
+        from .core import executor as executor_mod
+
+        scope = scope or executor_mod.global_scope()
+        if len(datasets) != self.desc.thread_num:
+            raise ValueError(
+                f"need {self.desc.thread_num} dataset shards, got "
+                f"{len(datasets)}")
+        step_lock = threading.Lock()
+        self.workers = [
+            HogwildWorker(i, executor, program, ds, scope, self.desc,
+                          step_lock=step_lock)
+            for i, ds in enumerate(datasets)]
+        errors = []
+
+        def run(w):
+            try:
+                w.train()
+            except BaseException as e:  # exception_holder semantics
+                errors.append(e)
+
+        threads = [threading.Thread(target=run, args=(w,), daemon=True)
+                   for w in self.workers]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+        return sum(w.steps for w in self.workers)
+
+
+def train_from_dataset_multithread(executor, program, dataset_factory,
+                                   thread_num: int = 2, fetch_list=None,
+                                   fetch_info=None, print_period=100,
+                                   scope=None):
+    """Thread-pool train_from_dataset (reference: Executor.
+    train_from_dataset with TrainerDesc.thread_num > 1 → MultiTrainer).
+
+    `dataset_factory(worker_id, num_workers)` builds each thread's shard
+    — with NativeDataset, pass trainer_id=worker_id,
+    num_trainers=num_workers so the C++ reader shards the filelist.
+    """
+    desc = TrainerDesc(thread_num=thread_num, fetch_list=fetch_list,
+                       fetch_info=fetch_info, print_period=print_period)
+    datasets = [dataset_factory(i, desc.thread_num)
+                for i in range(desc.thread_num)]
+    return MultiTrainer(desc).train(executor, program, datasets,
+                                    scope=scope)

@@ -117,3 +117,13 @@ def test_image_classification_flowers_book(tmp_path):
                      fetch_list=[logits])[0]
         acc = (np.asarray(lg).argmax(1) == Y[:, 0]).mean()
         assert acc > 0.5, acc        # chance = 0.25
+
+
+def test_movielens_train_test_share_structure():
+    """Regression: the latent rating factors are fixed across splits, so a
+    (uid, mid) pair seen in both splits gets the same rating."""
+    train_r = {(s[0], s[4]): s[7] for s in movielens.train()()}
+    test_r = {(s[0], s[4]): s[7] for s in movielens.test()()}
+    common = set(train_r) & set(test_r)
+    assert len(common) > 5
+    assert all(train_r[k] == test_r[k] for k in common)

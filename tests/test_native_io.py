@@ -96,3 +96,70 @@ def test_malformed_lines_skipped(tmp_path):
     assert sum(b["x"].shape[0] for b in batches) == 2
     rec, skip = ds.stats()
     assert rec == 2 and skip == 2
+
+
+def test_multitrainer_threaded_training(tmp_path):
+    """MultiTrainer: 2 Hogwild threads over sharded native-datafeed files
+    train a shared-scope linear model (reference: trainer.h MultiTrainer +
+    hogwild_worker.cc)."""
+    import paddle_tpu as pt
+    from paddle_tpu.trainer import train_from_dataset_multithread
+
+    rng = np.random.RandomState(0)
+    w_true = rng.rand(6, 1)
+    files = []
+    for i in range(4):
+        X = rng.rand(50, 6).astype("float32")
+        Y = (X @ w_true).astype("float32")
+        path = tmp_path / f"part-{i}.txt"
+        np.savetxt(path, np.hstack([X, Y]), fmt="%.6f")
+        files.append(str(path))
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[6], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred,
+                                                          label=y))
+        pt.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+
+    def make_shard(worker_id, num_workers):
+        ds = NativeDataset(slots=[("x", (6,)), ("y", (1,))], batch_size=20,
+                           trainer_id=worker_id, num_trainers=num_workers)
+        ds.set_filelist(files)
+        return ds
+
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        total_steps = 0
+        for _ in range(20):   # epochs
+            total_steps += train_from_dataset_multithread(
+                exe, main, make_shard, thread_num=2, fetch_list=[loss])
+        # 200 rows / 20 batch = 10 steps per epoch across both workers
+        assert total_steps == 200, total_steps
+        scope = pt.global_scope()
+        w = np.asarray(scope.find_var("fc_0.w_0"))
+        np.testing.assert_allclose(w, w_true, atol=0.15)
+
+
+def test_multitrainer_propagates_worker_errors(tmp_path):
+    import paddle_tpu as pt
+    from paddle_tpu.trainer import MultiTrainer, TrainerDesc
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[3], dtype="float32")
+        pt.layers.fc(x, size=1)
+    exe = pt.Executor(pt.CPUPlace())
+
+    class Boom:
+        def __iter__(self):
+            raise RuntimeError("shard exploded")
+
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        with pytest.raises(RuntimeError, match="shard exploded"):
+            MultiTrainer(TrainerDesc(thread_num=2)).train(
+                exe, main, [Boom(), Boom()])
