@@ -11,7 +11,9 @@ __all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box", "roi_align",
            "roi_pool", "psroi_pool", "polygon_box_transform",
            "box_decoder_and_assign", "collect_fpn_proposals",
            "distribute_fpn_proposals", "rpn_target_assign",
-           "retinanet_detection_output", "yolov3_loss"]
+           "retinanet_detection_output", "yolov3_loss",
+           "generate_proposal_labels", "generate_mask_labels",
+           "roi_perspective_transform"]
 
 
 def iou_similarity(x, y, name=None):
@@ -353,3 +355,62 @@ def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
                             "downsample_ratio": downsample_ratio,
                             "use_label_smooth": use_label_smooth})
     return loss
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info=None, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=True, name=None):
+    helper = LayerHelper("generate_proposal_labels", name=name)
+    rois = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    labels = helper.create_variable_for_type_inference("int32")
+    tgts = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    inw = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    outw = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    helper.append_op(type="generate_proposal_labels",
+                     inputs={"RpnRois": rpn_rois, "GtBoxes": gt_boxes,
+                             "GtClasses": gt_classes},
+                     outputs={"Rois": rois, "LabelsInt32": labels,
+                              "BboxTargets": tgts,
+                              "BboxInsideWeights": inw,
+                              "BboxOutsideWeights": outw},
+                     attrs={"batch_size_per_im": batch_size_per_im,
+                            "fg_fraction": fg_fraction,
+                            "fg_thresh": fg_thresh,
+                            "bg_thresh_hi": bg_thresh_hi,
+                            "bg_thresh_lo": bg_thresh_lo,
+                            "bbox_reg_weights": list(bbox_reg_weights),
+                            "class_nums": class_nums,
+                            "use_random": use_random})
+    return rois, labels, tgts, inw, outw
+
+
+def generate_mask_labels(gt_segms, rois, labels_int32, matched_gts,
+                         resolution=14, name=None):
+    """TPU-native contract: gt_segms are dense [G,H,W] bitmaps (the
+    reference rasterizes COCO polygons on the host first)."""
+    helper = LayerHelper("generate_mask_labels", name=name)
+    mask = helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="generate_mask_labels",
+                     inputs={"GtSegms": gt_segms, "Rois": rois,
+                             "LabelsInt32": labels_int32,
+                             "MatchedGts": matched_gts},
+                     outputs={"MaskInt32": mask},
+                     attrs={"resolution": resolution})
+    return mask
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None):
+    helper = LayerHelper("roi_perspective_transform", name=name)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="roi_perspective_transform",
+                     inputs={"X": input, "ROIs": rois},
+                     outputs={"Out": out},
+                     attrs={"transformed_height": transformed_height,
+                            "transformed_width": transformed_width,
+                            "spatial_scale": spatial_scale})
+    return out

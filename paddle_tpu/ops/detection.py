@@ -1033,3 +1033,177 @@ def yolov3_loss(ins, attrs, ctx):
 def _bce(p, t):
     p = jnp.clip(p, 1e-7, 1.0 - 1e-7)
     return -(t * jnp.log(p) + (1.0 - t) * jnp.log(1.0 - p))
+
+
+@register_op("generate_proposal_labels", is_random=True, grad=None)
+def generate_proposal_labels(ins, attrs, ctx):
+    """reference: detection/generate_proposal_labels_op.cc — sample RoIs
+    for the RCNN head: fg above fg_thresh (capped at fg_fraction·batch),
+    bg in [bg_thresh_lo, bg_thresh_hi), per-class box targets. Static
+    shapes: per image exactly batch_size_per_im rows, label -1 padding.
+    Inputs are batched dense ([N,R,4] rois, [N,G,4] gt, [N,G] classes,
+    gt rows with class 0 = absent)."""
+    rois = ins["RpnRois"][0]            # [N, R, 4]
+    gt_boxes = ins["GtBoxes"][0]        # [N, G, 4]
+    gt_classes = ins["GtClasses"][0]    # [N, G] int (0 = pad)
+    if rois.ndim == 2:
+        rois, gt_boxes, gt_classes = rois[None], gt_boxes[None], \
+            gt_classes[None]
+    batch = int(attrs.get("batch_size_per_im", 256))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_thr = float(attrs.get("fg_thresh", 0.5))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    num_classes = int(attrs.get("class_nums", 81))
+    weights = [float(v) for v in attrs.get("bbox_reg_weights",
+                                           [0.1, 0.1, 0.2, 0.2])]
+    use_random = bool(attrs.get("use_random", True))
+    n, r, _ = rois.shape
+    batch = min(batch, r)
+    n_fg_max = int(batch * fg_frac)
+    key = ctx.rng() if use_random else None
+
+    def one(rois_i, gt_i, cls_i, k):
+        valid_gt = cls_i > 0
+        iou = _pairwise_iou(rois_i, gt_i, normalized=False)
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)   # [R, G]
+        best = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        fg_mask = best >= fg_thr
+        bg_mask = (best < bg_hi) & (best >= bg_lo) & ~fg_mask
+        if k is not None:
+            kf, kb = jax.random.split(k)
+        else:
+            kf = kb = None
+
+        def sample(mask, kk, cap):
+            noise = jax.random.uniform(kk, (r,)) if kk is not None else \
+                -jnp.arange(r, dtype=jnp.float32)
+            score = jnp.where(mask, noise, -jnp.inf)
+            top_s, top_i = jax.lax.top_k(score, cap)
+            return jnp.where(top_s > -jnp.inf, top_i, -1)
+
+        fg_idx = sample(fg_mask, kf, n_fg_max)
+        bg_idx = sample(bg_mask, kb, batch - n_fg_max)
+        idx = jnp.concatenate([fg_idx, bg_idx])
+        ok = idx >= 0
+        gather = jnp.maximum(idx, 0)
+        out_rois = jnp.where(ok[:, None], rois_i[gather], 0.0)
+        is_fg = jnp.concatenate([fg_idx >= 0,
+                                 jnp.zeros((batch - n_fg_max,), bool)])
+        labels = jnp.where(
+            ok,
+            jnp.where(is_fg, cls_i[best_gt[gather]].astype(jnp.int32), 0),
+            -1)
+        # per-class box targets: encode roi -> matched gt in the 4-slot of
+        # its class
+        anc = rois_i[gather]
+        g = gt_i[best_gt[gather]]
+        pw = anc[:, 2] - anc[:, 0] + 1.0
+        ph = anc[:, 3] - anc[:, 1] + 1.0
+        pcx = anc[:, 0] + pw * 0.5
+        pcy = anc[:, 1] + ph * 0.5
+        gw = g[:, 2] - g[:, 0] + 1.0
+        gh = g[:, 3] - g[:, 1] + 1.0
+        gcx = g[:, 0] + gw * 0.5
+        gcy = g[:, 1] + gh * 0.5
+        # BoxToDelta divides by bbox_reg_weights (reference default
+        # 0.1/0.1/0.2/0.2 -> 10x/5x scaling)
+        wvec = jnp.asarray(weights, rois_i.dtype)
+        tgt = jnp.stack([(gcx - pcx) / pw, (gcy - pcy) / ph,
+                         jnp.log(gw / pw), jnp.log(gh / ph)], -1) / wvec
+        tgt = jnp.where(is_fg[:, None], tgt, 0.0)
+        cls_slot = jnp.maximum(labels, 0)
+        onehot = (jnp.arange(num_classes)[None, :] ==
+                  cls_slot[:, None]).astype(rois_i.dtype)  # [B, C]
+        bbox_targets = (onehot[:, :, None] * tgt[:, None, :]).reshape(
+            batch, 4 * num_classes)
+        inside_w = jnp.repeat(onehot, 4, axis=1) * \
+            is_fg[:, None].astype(rois_i.dtype)
+        return out_rois, labels, bbox_targets, inside_w
+
+    keys = jax.random.split(key, n) if key is not None else [None] * n
+    if key is not None:
+        out_rois, labels, tgts, inw = jax.vmap(one)(rois, gt_boxes,
+                                                    gt_classes, keys)
+    else:
+        outs = [one(rois[i], gt_boxes[i], gt_classes[i], None)
+                for i in range(n)]
+        out_rois, labels, tgts, inw = (jnp.stack(v) for v in zip(*outs))
+    return {"Rois": out_rois, "LabelsInt32": labels,
+            "BboxTargets": tgts, "BboxInsideWeights": inw,
+            "BboxOutsideWeights": inw}
+
+
+@register_op("generate_mask_labels", grad=None)
+def generate_mask_labels(ins, attrs, ctx):
+    """reference: detection/generate_mask_labels_op.cc — per fg RoI, crop
+    its matched instance mask and resize to resolution². TPU-native: gt
+    masks arrive as dense bitmaps GtSegms [G, H, W] (the reference takes
+    polygons and rasterizes on the host; bitmaps keep it in-graph), RoIs
+    [R, 4] with LabelsInt32 [R] (-1/0 rows skipped), MatchedGts [R]."""
+    masks = ins["GtSegms"][0]           # [G, H, W]
+    rois = ins["Rois"][0]               # [R, 4]
+    labels = ins["LabelsInt32"][0].reshape(-1)
+    matched = ins["MatchedGts"][0].reshape(-1).astype(jnp.int32)
+    res = int(attrs.get("resolution", 14))
+    g, h, w = masks.shape
+
+    def one(roi, gt_idx, lab):
+        m = masks[jnp.maximum(gt_idx, 0)].astype(jnp.float32)
+        x1, y1, x2, y2 = roi
+        ys = y1 + (jnp.arange(res) + 0.5) / res * jnp.maximum(y2 - y1, 1.0)
+        xs = x1 + (jnp.arange(res) + 0.5) / res * jnp.maximum(x2 - x1, 1.0)
+        yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+        crop_m = m[yi][:, xi]
+        valid = lab > 0
+        return jnp.where(valid, (crop_m > 0.5).astype(jnp.int32), -1)
+
+    out = jax.vmap(one)(rois, matched, labels)
+    return {"MaskInt32": out}
+
+
+@register_op("roi_perspective_transform", grad=None)
+def roi_perspective_transform(ins, attrs, ctx):
+    """reference: detection/roi_perspective_transform_op.cc — warp each
+    quadrilateral ROI (8 coords: 4 corners clockwise) to a fixed
+    [H_out, W_out] patch by bilinear sampling along the bilinear
+    interpolation of the quad edges."""
+    x = ins["X"][0]                     # [1, C, H, W]
+    rois = ins["ROIs"][0]               # [R, 8]
+    oh = int(attrs.get("transformed_height", 8))
+    ow = int(attrs.get("transformed_width", 8))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    n, c, h, w = x.shape
+    if n != 1:
+        raise ValueError("roi_perspective_transform: single-image input "
+                         "expected (all ROIs sample image 0)")
+
+    def one(quad):
+        q = quad.reshape(4, 2) * scale   # tl, tr, br, bl
+        u = (jnp.arange(ow) + 0.5) / ow
+        v = (jnp.arange(oh) + 0.5) / oh
+        uu, vv = jnp.meshgrid(u, v)      # [oh, ow]
+        top = q[0][None, None] * (1 - uu)[..., None] + \
+            q[1][None, None] * uu[..., None]
+        bot = q[3][None, None] * (1 - uu)[..., None] + \
+            q[2][None, None] * uu[..., None]
+        pts = top * (1 - vv)[..., None] + bot * vv[..., None]  # [oh,ow,2]
+        px, py = pts[..., 0], pts[..., 1]
+        x0 = jnp.clip(jnp.floor(px).astype(jnp.int32), 0, w - 1)
+        y0 = jnp.clip(jnp.floor(py).astype(jnp.int32), 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        wx = px - jnp.floor(px)
+        wy = py - jnp.floor(py)
+        img = x[0]                       # [C, H, W]
+        f = (img[:, y0, x0] * ((1 - wy) * (1 - wx))[None] +
+             img[:, y1, x0] * (wy * (1 - wx))[None] +
+             img[:, y0, x1] * ((1 - wy) * wx)[None] +
+             img[:, y1, x1] * (wy * wx)[None])
+        inside = (px >= 0) & (px <= w - 1) & (py >= 0) & (py <= h - 1)
+        return jnp.where(inside[None], f, 0.0)   # [C, oh, ow]
+
+    return {"Out": jax.vmap(one)(rois), "Out2InIdx": None,
+            "Out2InWeights": None, "Mask": None, "TransformMatrix": None}

@@ -53,6 +53,10 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
 
 def reset_profiler():
     _host_events.clear()
+    _host_spans.clear()
+
+
+_host_spans = []
 
 
 def _print_host_events(sorted_key=None):
@@ -87,7 +91,9 @@ class RecordEvent:
 
     def __exit__(self, *a):
         self._ann.__exit__(*a)
-        _host_events[self.name].append(time.perf_counter() - self._t0)
+        dur = time.perf_counter() - self._t0
+        _host_events[self.name].append(dur)
+        _host_spans.append((self.name, self._t0, dur))
         return False
 
 
@@ -99,3 +105,20 @@ def cuda_profiler(output_file=None, output_mode=None, config=None):
 
 
 npu_profiler = cuda_profiler
+
+
+def export_chrome_tracing(path, events=None):
+    """Write the host RecordEvent table as a chrome://tracing JSON file
+    (reference: tools/timeline.py:131 converts profiler.proto to chrome
+    trace; device timelines come from jax.profiler's perfetto output)."""
+    import json
+
+    evs = events if events is not None else list(_host_spans)
+    trace = {"traceEvents": [], "displayTimeUnit": "ms"}
+    for name, start, dur in evs:
+        trace["traceEvents"].append({
+            "name": name, "ph": "X", "pid": 0, "tid": 0,
+            "ts": start * 1e6, "dur": dur * 1e6, "cat": "host"})
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path

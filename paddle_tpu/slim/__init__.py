@@ -14,3 +14,4 @@ from .qat import (  # noqa: F401
 from .prune import Pruner, SensitivePruneStrategy  # noqa: F401
 from . import distillation  # noqa: F401
 from .nas import ControllerServer, SAController, SearchAgent  # noqa: F401
+from .float16 import float16_transpile  # noqa: F401

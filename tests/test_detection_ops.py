@@ -451,3 +451,77 @@ def test_yolov3_loss_gt_score_scales_loss():
                    "GTScore": np.full((1, 1), 0.5, "float32")},
                   attrs, outputs=("Loss",))["Loss"][0][0]
     assert half < full
+
+
+def test_generate_proposal_labels_sampling():
+    rois = np.array([[[0, 0, 15, 15], [0, 0, 14, 14], [40, 40, 55, 55],
+                      [80, 80, 95, 95], [10, 40, 30, 60]]], "float32")
+    gt = np.array([[[0, 0, 15, 15], [40, 40, 55, 55]]], "float32")
+    cls = np.array([[3, 7]], "int64")
+    out = run_op("generate_proposal_labels",
+                 {"RpnRois": rois, "GtBoxes": gt, "GtClasses": cls},
+                 {"batch_size_per_im": 4, "fg_fraction": 0.5,
+                  "fg_thresh": 0.5, "bg_thresh_hi": 0.5,
+                  "bg_thresh_lo": 0.0, "class_nums": 10},
+                 outputs=("Rois", "LabelsInt32", "BboxTargets",
+                          "BboxInsideWeights"), rng_seed=0)
+    labels = out["LabelsInt32"][0][0]
+    # fg rois carry their gt class; exact matches exist for classes 3, 7
+    fg = labels[labels > 0]
+    assert set(fg.tolist()) <= {3, 7} and len(fg) >= 1
+    # bbox_reg_weights applied: exact-match fg rois have ~zero targets,
+    # and deterministic sampling reproduces
+    det = run_op("generate_proposal_labels",
+                 {"RpnRois": rois, "GtBoxes": gt, "GtClasses": cls},
+                 {"batch_size_per_im": 4, "fg_fraction": 0.5,
+                  "fg_thresh": 0.5, "bg_thresh_hi": 0.5,
+                  "bg_thresh_lo": 0.0, "class_nums": 10,
+                  "use_random": False},
+                 outputs=("LabelsInt32",), rng_seed=1)
+    det2 = run_op("generate_proposal_labels",
+                  {"RpnRois": rois, "GtBoxes": gt, "GtClasses": cls},
+                  {"batch_size_per_im": 4, "fg_fraction": 0.5,
+                   "fg_thresh": 0.5, "bg_thresh_hi": 0.5,
+                   "bg_thresh_lo": 0.0, "class_nums": 10,
+                   "use_random": False},
+                  outputs=("LabelsInt32",), rng_seed=2)
+    np.testing.assert_array_equal(det["LabelsInt32"][0],
+                                  det2["LabelsInt32"][0])
+    # fg rows: inside weights are 1 exactly on their class's 4-slot
+    inw = out["BboxInsideWeights"][0][0]
+    for i, lab in enumerate(labels):
+        if lab > 0:
+            sl = inw[i].reshape(10, 4)
+            assert sl[lab].sum() == 4 and sl.sum() == 4
+        else:
+            assert inw[i].sum() == 0
+
+
+def test_generate_mask_labels_crops_gt():
+    masks = np.zeros((2, 16, 16), "float32")
+    masks[0, :8, :8] = 1.0           # instance 0: top-left square
+    rois = np.array([[0, 0, 7, 7], [8, 8, 15, 15]], "float32")
+    labels = np.array([1, -1], "int64")
+    matched = np.array([0, 0], "int64")
+    out = run_op("generate_mask_labels",
+                 {"GtSegms": masks, "Rois": rois,
+                  "LabelsInt32": labels, "MatchedGts": matched},
+                 {"resolution": 4}, outputs=("MaskInt32",))["MaskInt32"][0]
+    assert (out[0] == 1).all()       # roi covers the filled square
+    assert (out[1] == -1).all()      # non-fg row padded
+
+
+def test_roi_perspective_transform_axis_aligned_matches_crop():
+    x = np.arange(64, dtype="float32").reshape(1, 1, 8, 8)
+    # axis-aligned quad == plain crop of rows 2..5, cols 2..5
+    quad = np.array([[2, 2, 6, 2, 6, 6, 2, 6]], "float32")
+    out = run_op("roi_perspective_transform",
+                 {"X": x, "ROIs": quad},
+                 {"transformed_height": 4, "transformed_width": 4,
+                  "spatial_scale": 1.0}, outputs=("Out",))["Out"][0]
+    assert out.shape == (1, 1, 4, 4)
+    # sampled grid is monotone in both axes within the crop
+    assert (np.diff(out[0, 0], axis=1) > 0).all()
+    assert (np.diff(out[0, 0], axis=0) > 0).all()
+    assert out[0, 0].min() >= x[0, 0, 2, 2] - 1
+    assert out[0, 0].max() <= x[0, 0, 6, 6] + 1

@@ -104,3 +104,53 @@ def test_dygraph_matches_static(rng):
         lin.bias.set_value(b)
         dy = np.asarray(lin(pt.dygraph.to_variable(X)).numpy())
     np.testing.assert_allclose(dy, X @ W + b, rtol=1e-5)
+
+
+def test_float16_transpile_inference_parity(tmp_path):
+    """reference: contrib/float16/float16_transpiler.py — half-precision
+    inference matches fp32 within half tolerance and weights are halved."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.slim.float16 import float16_transpile
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(8, 10).astype("float32")
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[10], dtype="float32")
+        h = pt.layers.fc(x, size=32, act="relu")
+        out = pt.layers.softmax(pt.layers.fc(h, size=5))
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        pt.io.save_inference_model(str(tmp_path), ["x"], [out], exe,
+                                   main_program=main)
+        prog, feeds, fetches = pt.io.load_inference_model(str(tmp_path),
+                                                          exe)
+        ref = np.asarray(exe.run(prog, feed={"x": X},
+                                 fetch_list=fetches)[0])
+        float16_transpile(prog, pt.global_scope())
+        # weights really are bf16 now
+        w = pt.global_scope().find_var("fc_0.w_0")
+        assert jnp.asarray(w).dtype == jnp.bfloat16
+        half_out = np.asarray(exe.run(prog, feed={"x": X},
+                                      fetch_list=fetches)[0])
+        assert half_out.dtype == np.float32   # cast back at the boundary
+        np.testing.assert_allclose(half_out, ref, rtol=2e-2, atol=2e-2)
+
+
+def test_profiler_chrome_trace_export(tmp_path):
+    import json
+
+    from paddle_tpu import profiler
+
+    profiler.reset_profiler()
+    with profiler.RecordEvent("op_run"):
+        pass
+    with profiler.RecordEvent("fetch"):
+        pass
+    p = profiler.export_chrome_tracing(str(tmp_path / "trace.json"))
+    trace = json.load(open(p))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert {"op_run", "fetch"} <= names
+    assert all(e["ph"] == "X" for e in trace["traceEvents"])
