@@ -204,3 +204,271 @@ class GRUUnit(Layer):
         value = gates.value
         u = VarBase(jnp.tanh(value[:, 2 * d:]))
         return u, u
+
+
+class Conv3D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) else \
+            [filter_size] * 3
+        self._attrs = {
+            "strides": [stride] * 3 if isinstance(stride, int)
+            else list(stride),
+            "paddings": [padding] * 3 if isinstance(padding, int)
+            else list(padding),
+            "dilations": [dilation] * 3 if isinstance(dilation, int)
+            else list(dilation), "groups": groups}
+        self.weight = self.create_parameter(
+            [num_filters, num_channels // groups] + list(fs),
+            attr=param_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_filters], attr=bias_attr, is_bias=True)
+        self._act = act
+
+    def forward(self, x):
+        out = _op("conv3d", {"Input": [x], "Filter": [self.weight]},
+                  {"Output": [None]}, self._attrs)["Output"][0]
+        if self.bias is not None:
+            out = _op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                      {"Out": [None]}, {"axis": 1})["Out"][0]
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": [None]})["Out"][0]
+        return out
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) else \
+            [filter_size] * 2
+        self._attrs = {
+            "strides": [stride] * 2 if isinstance(stride, int)
+            else list(stride),
+            "paddings": [padding] * 2 if isinstance(padding, int)
+            else list(padding),
+            "dilations": [dilation] * 2 if isinstance(dilation, int)
+            else list(dilation)}
+        self.weight = self.create_parameter(
+            [num_channels, num_filters] + list(fs), attr=param_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_filters], attr=bias_attr, is_bias=True)
+        self._act = act
+
+    def forward(self, x):
+        out = _op("conv2d_transpose",
+                  {"Input": [x], "Filter": [self.weight]},
+                  {"Output": [None]}, self._attrs)["Output"][0]
+        if self.bias is not None:
+            out = _op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                      {"Out": [None]}, {"axis": 1})["Out"][0]
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": [None]})["Out"][0]
+        return out
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        fs = filter_size if isinstance(filter_size, (list, tuple)) else \
+            [filter_size] * 3
+        self._attrs = {
+            "strides": [stride] * 3 if isinstance(stride, int)
+            else list(stride),
+            "paddings": [padding] * 3 if isinstance(padding, int)
+            else list(padding)}
+        self.weight = self.create_parameter(
+            [num_channels, num_filters] + list(fs), attr=param_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_filters], attr=bias_attr, is_bias=True)
+        self._act = act
+
+    def forward(self, x):
+        out = _op("conv3d_transpose",
+                  {"Input": [x], "Filter": [self.weight]},
+                  {"Output": [None]}, self._attrs)["Output"][0]
+        if self.bias is not None:
+            out = _op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                      {"Out": [None]}, {"axis": 1})["Out"][0]
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": [None]})["Out"][0]
+        return out
+
+
+class NCE(Layer):
+    """reference: dygraph/nn.py:1780 — NCE loss module holding the
+    [num_total_classes, dim] weight/bias tables."""
+
+    def __init__(self, num_total_classes, dim, param_attr=None,
+                 bias_attr=None, num_neg_samples=10,
+                 sampler="uniform", dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter([num_total_classes, dim],
+                                            attr=param_attr)
+        self.bias = self.create_parameter([num_total_classes],
+                                          attr=bias_attr, is_bias=True)
+        self._attrs = {"num_total_classes": num_total_classes,
+                       "num_neg_samples": num_neg_samples,
+                       "sampler": sampler}
+
+    def forward(self, input, label, sample_weight=None):
+        ins = {"Input": [input], "Label": [label],
+               "Weight": [self.weight], "Bias": [self.bias]}
+        if sample_weight is not None:
+            ins["SampleWeight"] = [sample_weight]
+        return _op("nce", ins,
+                   {"Cost": [None], "SampleLogits": [None],
+                    "SampleLabels": [None]}, self._attrs)["Cost"][0]
+
+
+class PRelu(Layer):
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._mode = mode
+        if mode == "all":
+            shape = [1]
+        elif mode == "channel":
+            shape = [channel]
+        else:
+            shape = list(input_shape)
+        self.weight = self.create_parameter(
+            shape, attr=param_attr,
+            default_initializer=ConstantInitializer(0.25))
+
+    def forward(self, x):
+        return _op("prelu", {"X": [x], "Alpha": [self.weight]},
+                   {"Out": [None]}, {"mode": self._mode})["Out"][0]
+
+
+class BilinearTensorProduct(Layer):
+    """out_i = x W_i y^T + b_i (reference: dygraph/nn.py:2111)."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [output_dim, input1_dim, input2_dim], attr=param_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [output_dim], attr=bias_attr, is_bias=True)
+        self._act = act
+
+    def forward(self, x, y):
+        # x W_o y^T via traced ops so the tape sees every step:
+        # W [O,D1,D2] -> [D1, O*D2]; t = x @ W' -> [N,O,D2]; sum(t*y)
+        o, d1, d2 = [int(v) for v in self.weight.shape]
+        wt = _op("transpose2", {"X": [self.weight]},
+                 {"Out": [None], "XShape": [None]},
+                 {"axis": [1, 0, 2]})["Out"][0]
+        wt = _op("reshape2", {"X": [wt]}, {"Out": [None], "XShape": [None]},
+                 {"shape": [d1, o * d2]})["Out"][0]
+        t = _op("mul", {"X": [x], "Y": [wt]}, {"Out": [None]},
+                {"x_num_col_dims": 1, "y_num_col_dims": 1})["Out"][0]
+        t = _op("reshape2", {"X": [t]}, {"Out": [None], "XShape": [None]},
+                {"shape": [-1, o, d2]})["Out"][0]
+        yu = _op("unsqueeze2", {"X": [y]},
+                 {"Out": [None], "XShape": [None]}, {"axes": [1]})["Out"][0]
+        prod = _op("elementwise_mul", {"X": [t], "Y": [yu]},
+                   {"Out": [None]}, {"axis": -1})["Out"][0]
+        out = _op("reduce_sum", {"X": [prod]}, {"Out": [None]},
+                  {"dim": [-1], "keep_dim": False})["Out"][0]
+        if self.bias is not None:
+            out = _op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                      {"Out": [None]}, {"axis": 1})["Out"][0]
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": [None]})["Out"][0]
+        return out
+
+
+class SequenceConv(Layer):
+    def __init__(self, input_dim, num_filters, filter_size=3,
+                 padding_start=None, param_attr=None, bias_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [filter_size * input_dim, num_filters], attr=param_attr)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_filters], attr=bias_attr, is_bias=True)
+        self._attrs = {"contextLength": filter_size,
+                       "contextStart": padding_start
+                       if padding_start is not None
+                       else -(filter_size - 1) // 2}
+        self._act = act
+
+    def forward(self, x, length=None):
+        ins = {"X": [x], "Filter": [self.weight]}
+        if length is not None:
+            ins["Length"] = [length]
+        out = _op("sequence_conv", ins, {"Out": [None]},
+                  self._attrs)["Out"][0]
+        if self.bias is not None:
+            out = _op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                      {"Out": [None]}, {"axis": 2})["Out"][0]
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": [None]})["Out"][0]
+        return out
+
+
+class RowConv(Layer):
+    def __init__(self, input_dim, future_context_size, param_attr=None,
+                 act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [future_context_size + 1, input_dim], attr=param_attr)
+        self._act = act
+
+    def forward(self, x):
+        out = _op("row_conv", {"X": [x], "Filter": [self.weight]},
+                  {"Out": [None]})["Out"][0]
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": [None]})["Out"][0]
+        return out
+
+
+class GroupNorm(Layer):
+    def __init__(self, channels, groups, epsilon=1e-5, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self.weight = self.create_parameter(
+            [channels], attr=param_attr,
+            default_initializer=ConstantInitializer(1.0))
+        self.bias = self.create_parameter([channels], attr=bias_attr,
+                                          is_bias=True)
+        self._attrs = {"groups": groups, "epsilon": epsilon}
+        self._act = act
+
+    def forward(self, x):
+        out = _op("group_norm", {"X": [x], "Scale": [self.weight],
+                                 "Bias": [self.bias]},
+                  {"Y": [None], "Mean": [None], "Variance": [None]},
+                  self._attrs)["Y"][0]
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": [None]})["Out"][0]
+        return out
+
+
+class SpectralNorm(Layer):
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        h = int(weight_shape[dim])
+        total = 1
+        for s in weight_shape:
+            total *= int(s)
+        self.weight_u = self.create_parameter(
+            [h], attr=None, default_initializer=NormalInitializer(0.0, 1.0))
+        self.weight_v = self.create_parameter(
+            [total // h], attr=None,
+            default_initializer=NormalInitializer(0.0, 1.0))
+        self._attrs = {"dim": dim, "power_iters": power_iters, "eps": eps}
+
+    def forward(self, weight):
+        return _op("spectral_norm",
+                   {"Weight": [weight], "U": [self.weight_u],
+                    "V": [self.weight_v]}, {"Out": [None]},
+                   self._attrs)["Out"][0]

@@ -175,6 +175,9 @@ def load_inference_model(dirname, executor, model_filename=None,
     program.desc = ProgramDesc.from_dict(payload["program"])
     program._rebuild_from_desc()
     program._is_test = True
+    # restore the feed/fetch metadata transpilers rely on (float16, ...)
+    program._attrs["feed_names"] = list(payload.get("feed_names", []))
+    program._attrs["fetch_names"] = list(payload.get("fetch_names", []))
     load_persistables(executor, dirname, main_program=program,
                       filename=params_filename)
     fetch_vars = [program.global_block().var(n) for n in payload["fetch_names"]]

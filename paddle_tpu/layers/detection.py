@@ -369,9 +369,12 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
     tgts = helper.create_variable_for_type_inference(rpn_rois.dtype)
     inw = helper.create_variable_for_type_inference(rpn_rois.dtype)
     outw = helper.create_variable_for_type_inference(rpn_rois.dtype)
+    inputs = {"RpnRois": rpn_rois, "GtBoxes": gt_boxes,
+              "GtClasses": gt_classes}
+    if is_crowd is not None:
+        inputs["IsCrowd"] = is_crowd
     helper.append_op(type="generate_proposal_labels",
-                     inputs={"RpnRois": rpn_rois, "GtBoxes": gt_boxes,
-                             "GtClasses": gt_classes},
+                     inputs=inputs,
                      outputs={"Rois": rois, "LabelsInt32": labels,
                               "BboxTargets": tgts,
                               "BboxInsideWeights": inw,

@@ -157,3 +157,111 @@ class CompositeMetric(MetricBase):
 
     def eval(self):
         return [m.eval() for m in self._metrics]
+
+
+class DetectionMAP(MetricBase):
+    """Mean average precision for detection (reference: metrics.py:695
+    DetectionMAP + operators/detection_map_op; 11-point or integral AP).
+
+    update() takes per-image detections [[label, score, x1,y1,x2,y2], ...]
+    (the multiclass_nms output rows) and ground truth
+    [[label, x1,y1,x2,y2], ...]."""
+
+    def __init__(self, name=None, overlap_threshold=0.5,
+                 ap_version="integral", evaluate_difficult=False):
+        super().__init__(name)
+        assert ap_version in ("integral", "11point")
+        self.overlap_threshold = overlap_threshold
+        self.ap_version = ap_version
+        self.evaluate_difficult = evaluate_difficult
+        self.reset()
+
+    def reset(self, executor=None, program=None):
+        self._dets = []       # (img_id, label, score, box)
+        self._gts = []        # (img_id, label, box)
+        self._img = 0
+
+    @staticmethod
+    def _iou(a, b):
+        ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+        ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+        iw, ih = max(ix2 - ix1, 0.0), max(iy2 - iy1, 0.0)
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1]) +
+              (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, detections, gts):
+        """gt rows: [label, x1,y1,x2,y2] or [label, x1,y1,x2,y2,
+        difficult]."""
+        for d in detections:
+            if d[0] < 0:
+                continue
+            self._dets.append((self._img, int(d[0]), float(d[1]),
+                               tuple(float(v) for v in d[2:6])))
+        for g in gts:
+            difficult = bool(g[5]) if len(g) > 5 else False
+            self._gts.append((self._img, int(g[0]),
+                              tuple(float(v) for v in g[1:5]), difficult))
+        self._img += 1
+
+    def eval(self, executor=None, program=None):
+        import collections
+
+        labels = {g[1] for g in self._gts}
+        aps = []
+        for lab in sorted(labels):
+            gts = collections.defaultdict(list)
+            npos = 0
+            for img, gl, box, difficult in self._gts:
+                if gl == lab:
+                    hard = difficult and not self.evaluate_difficult
+                    gts[img].append([box, False, hard])
+                    if not hard:
+                        npos += 1
+            dets = sorted((d for d in self._dets if d[1] == lab),
+                          key=lambda d: -d[2])
+            tp, fp = [], []
+            for img, _, score, box in dets:
+                best, best_g = 0.0, None
+                for g in gts.get(img, []):
+                    i = self._iou(box, g[0])
+                    if i > best:
+                        best, best_g = i, g
+                if best >= self.overlap_threshold and \
+                        best_g is not None:
+                    if best_g[2]:
+                        continue  # difficult gt: neither tp nor fp (VOC)
+                    if not best_g[1]:
+                        best_g[1] = True
+                        tp.append(1.0)
+                        fp.append(0.0)
+                    else:
+                        tp.append(0.0)
+                        fp.append(1.0)
+                else:
+                    tp.append(0.0)
+                    fp.append(1.0)
+            if npos == 0:
+                continue
+            tp = np.cumsum(tp) if tp else np.zeros(0)
+            fp = np.cumsum(fp) if fp else np.zeros(0)
+            rec = tp / npos if len(tp) else np.zeros(0)
+            prec = tp / np.maximum(tp + fp, 1e-9) if len(tp) else \
+                np.zeros(0)
+            if self.ap_version == "11point":
+                ap = 0.0
+                for t in np.linspace(0, 1, 11):
+                    p = prec[rec >= t].max() if (rec >= t).any() else 0.0
+                    ap += p / 11.0
+            else:
+                ap = 0.0
+                mrec = np.concatenate([[0.0], rec, [1.0]])
+                mpre = np.concatenate([[0.0], prec, [0.0]])
+                for i in range(len(mpre) - 2, -1, -1):
+                    mpre[i] = max(mpre[i], mpre[i + 1])
+                idx = np.where(mrec[1:] != mrec[:-1])[0]
+                ap = float(np.sum((mrec[idx + 1] - mrec[idx]) *
+                                  mpre[idx + 1]))
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0

@@ -1046,9 +1046,14 @@ def generate_proposal_labels(ins, attrs, ctx):
     rois = ins["RpnRois"][0]            # [N, R, 4]
     gt_boxes = ins["GtBoxes"][0]        # [N, G, 4]
     gt_classes = ins["GtClasses"][0]    # [N, G] int (0 = pad)
+    if ins.get("IsCrowd") and ins["IsCrowd"][0] is not None:
+        is_crowd = ins["IsCrowd"][0].astype(jnp.bool_)
+    else:
+        is_crowd = jnp.zeros(gt_classes.shape, jnp.bool_)
     if rois.ndim == 2:
         rois, gt_boxes, gt_classes = rois[None], gt_boxes[None], \
             gt_classes[None]
+        is_crowd = is_crowd.reshape(gt_classes.shape)
     batch = int(attrs.get("batch_size_per_im", 256))
     fg_frac = float(attrs.get("fg_fraction", 0.25))
     fg_thr = float(attrs.get("fg_thresh", 0.5))
@@ -1063,8 +1068,10 @@ def generate_proposal_labels(ins, attrs, ctx):
     n_fg_max = int(batch * fg_frac)
     key = ctx.rng() if use_random else None
 
-    def one(rois_i, gt_i, cls_i, k):
-        valid_gt = cls_i > 0
+    def one(rois_i, gt_i, cls_i, crowd_i, k):
+        # crowd gt regions are excluded from matching entirely
+        # (reference: generate_proposal_labels filters IsCrowd rows)
+        valid_gt = (cls_i > 0) & ~crowd_i
         iou = _pairwise_iou(rois_i, gt_i, normalized=False)
         iou = jnp.where(valid_gt[None, :], iou, 0.0)   # [R, G]
         best = jnp.max(iou, axis=1)
@@ -1124,11 +1131,11 @@ def generate_proposal_labels(ins, attrs, ctx):
 
     keys = jax.random.split(key, n) if key is not None else [None] * n
     if key is not None:
-        out_rois, labels, tgts, inw = jax.vmap(one)(rois, gt_boxes,
-                                                    gt_classes, keys)
+        out_rois, labels, tgts, inw = jax.vmap(one)(
+            rois, gt_boxes, gt_classes, is_crowd, keys)
     else:
-        outs = [one(rois[i], gt_boxes[i], gt_classes[i], None)
-                for i in range(n)]
+        outs = [one(rois[i], gt_boxes[i], gt_classes[i], is_crowd[i],
+                    None) for i in range(n)]
         out_rois, labels, tgts, inw = (jnp.stack(v) for v in zip(*outs))
     return {"Rois": out_rois, "LabelsInt32": labels,
             "BboxTargets": tgts, "BboxInsideWeights": inw,

@@ -525,3 +525,51 @@ def test_roi_perspective_transform_axis_aligned_matches_crop():
     assert (np.diff(out[0, 0], axis=0) > 0).all()
     assert out[0, 0].min() >= x[0, 0, 2, 2] - 1
     assert out[0, 0].max() <= x[0, 0, 6, 6] + 1
+
+
+def test_detection_map_metric():
+    from paddle_tpu.metrics import DetectionMAP
+
+    m = DetectionMAP(overlap_threshold=0.5)
+    # image 0: one gt of class 1, one perfect det + one false positive
+    m.update([[1, 0.9, 0, 0, 10, 10], [1, 0.8, 50, 50, 60, 60]],
+             [[1, 0, 0, 10, 10]])
+    # image 1: gt missed entirely
+    m.update([], [[1, 20, 20, 30, 30]])
+    ap = m.eval()
+    # precision after first det = 1, recall 0.5; integral AP = 0.5
+    np.testing.assert_allclose(ap, 0.5, atol=1e-6)
+
+    perfect = DetectionMAP()
+    perfect.update([[2, 0.9, 0, 0, 4, 4]], [[2, 0, 0, 4, 4]])
+    np.testing.assert_allclose(perfect.eval(), 1.0, atol=1e-6)
+
+
+def test_generate_proposal_labels_excludes_crowd():
+    rois = np.array([[[0, 0, 15, 15], [40, 40, 55, 55]]], "float32")
+    gt = np.array([[[0, 0, 15, 15], [40, 40, 55, 55]]], "float32")
+    cls = np.array([[3, 7]], "int64")
+    crowd = np.array([[0, 1]], "int64")     # gt 1 is a crowd region
+    out = run_op("generate_proposal_labels",
+                 {"RpnRois": rois, "GtBoxes": gt, "GtClasses": cls,
+                  "IsCrowd": crowd},
+                 {"batch_size_per_im": 2, "fg_fraction": 0.5,
+                  "fg_thresh": 0.5, "class_nums": 10,
+                  "use_random": False},
+                 outputs=("LabelsInt32",), rng_seed=0)
+    labels = out["LabelsInt32"][0][0]
+    assert 7 not in labels.tolist()         # crowd gt never labels a roi
+
+
+def test_detection_map_difficult_gt():
+    from paddle_tpu.metrics import DetectionMAP
+
+    m = DetectionMAP(evaluate_difficult=False)
+    # det matches a difficult gt: neither tp nor fp; the easy gt missed
+    m.update([[1, 0.9, 0, 0, 10, 10]],
+             [[1, 0, 0, 10, 10, 1], [1, 30, 30, 40, 40, 0]])
+    assert m.eval() == 0.0
+    m2 = DetectionMAP(evaluate_difficult=True)
+    m2.update([[1, 0.9, 0, 0, 10, 10]],
+              [[1, 0, 0, 10, 10, 1], [1, 30, 30, 40, 40, 0]])
+    assert m2.eval() == 0.5

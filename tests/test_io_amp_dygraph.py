@@ -129,7 +129,12 @@ def test_float16_transpile_inference_parity(tmp_path):
                                                           exe)
         ref = np.asarray(exe.run(prog, feed={"x": X},
                                  fetch_list=fetches)[0])
+        n_ops = len(prog.global_block().ops)
         float16_transpile(prog, pt.global_scope())
+        # boundary casts really were inserted (loaded programs carry
+        # feed/fetch metadata)
+        types = [op.type for op in prog.global_block().ops]
+        assert types.count("cast") >= 2 and len(types) > n_ops
         # weights really are bf16 now
         w = pt.global_scope().find_var("fc_0.w_0")
         assert jnp.asarray(w).dtype == jnp.bfloat16
@@ -154,3 +159,55 @@ def test_profiler_chrome_trace_export(tmp_path):
     names = {e["name"] for e in trace["traceEvents"]}
     assert {"op_run", "fetch"} <= names
     assert all(e["ph"] == "X" for e in trace["traceEvents"])
+
+
+def test_dygraph_extended_layer_zoo():
+    """New dygraph modules run forward + backward under the tracer
+    (reference: dygraph/nn.py Conv2DTranspose/NCE/PRelu/
+    BilinearTensorProduct/SequenceConv/RowConv/GroupNorm/SpectralNorm)."""
+    import paddle_tpu as ptl
+    from paddle_tpu.dygraph import nn as dnn
+
+    rng = np.random.RandomState(0)
+    with ptl.dygraph.guard():
+        x = ptl.dygraph.to_variable(rng.randn(2, 3, 8, 8).astype("float32"))
+        ct = dnn.Conv2DTranspose(3, 5, 3)
+        out = ct(x)
+        assert tuple(out.shape) == (2, 5, 10, 10)
+        gn = dnn.GroupNorm(5, groups=5)
+        out2 = gn(out)
+        loss = out2.mean() if hasattr(out2, "mean") else None
+        # PRelu
+        pr = dnn.PRelu(mode="all")
+        out3 = pr(out2)
+        assert tuple(out3.shape) == (2, 5, 10, 10)
+
+        a = ptl.dygraph.to_variable(rng.randn(4, 6).astype("float32"))
+        b = ptl.dygraph.to_variable(rng.randn(4, 7).astype("float32"))
+        blt = dnn.BilinearTensorProduct(6, 7, 3)
+        out4 = blt(a, b)
+        assert tuple(out4.shape) == (4, 3)
+        # numeric check vs einsum
+        want = np.einsum("nd,ode,ne->no", a.numpy(), blt.weight.numpy(),
+                         b.numpy()) + blt.bias.numpy()
+        np.testing.assert_allclose(out4.numpy(), want, rtol=1e-4,
+                                   atol=1e-5)
+
+        seq = ptl.dygraph.to_variable(rng.randn(2, 6, 4).astype("float32"))
+        sc = dnn.SequenceConv(4, 8, filter_size=3)
+        assert tuple(sc(seq).shape) == (2, 6, 8)
+        rc = dnn.RowConv(4, future_context_size=2)
+        assert tuple(rc(seq).shape) == (2, 6, 4)
+
+        w = ptl.dygraph.to_variable(rng.randn(6, 4).astype("float32"))
+        sn = dnn.SpectralNorm([6, 4], power_iters=5)
+        wn = sn(w)
+        s = np.linalg.svd(wn.numpy(), compute_uv=False)
+        assert s[0] < 1.5
+
+        ids = ptl.dygraph.to_variable(
+            rng.randint(0, 10, (4, 1)).astype("int64"))
+        feats = ptl.dygraph.to_variable(rng.randn(4, 6).astype("float32"))
+        nce = dnn.NCE(10, 6, num_neg_samples=3)
+        cost = nce(feats, ids)
+        assert tuple(cost.shape) == (4, 1)
