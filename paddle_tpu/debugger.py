@@ -1,0 +1,64 @@
+"""Program visualization (reference: python/paddle/fluid/debugger.py
+draw_block_graphviz + ir/graph_viz_pass.cc — emit a DOT graph of ops and
+variables for debugging)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def _esc(s: str) -> str:
+    return s.replace('"', '\\"')
+
+
+def block_to_dot(block, skip_vars: Sequence[str] = (),
+                 highlight: Sequence[str] = ()) -> str:
+    """DOT source for one block: op nodes (boxes) wired through var nodes
+    (ellipses); parameters shaded."""
+    lines = ["digraph program {", "  rankdir=TB;",
+             '  node [fontsize=10];']
+    skip = set(skip_vars)
+    hi = set(highlight)
+    vars_seen = set()
+
+    def var_node(name):
+        if name in vars_seen or name in skip:
+            return
+        vars_seen.add(name)
+        v = block.desc.vars.get(name)
+        shape = list(v.shape) if v is not None and v.shape else "?"
+        style = 'style=filled, fillcolor="#e0e0ff"' \
+            if v is not None and v.is_parameter else ""
+        if name in hi:
+            style = 'style=filled, fillcolor="#ffd0d0"'
+        lines.append(f'  "v_{_esc(name)}" [label="{_esc(name)}\\n{shape}", '
+                     f'shape=ellipse, {style}];')
+
+    for i, op in enumerate(block.desc.ops):
+        lines.append(f'  "op_{i}" [label="{_esc(op.type)}", shape=box, '
+                     f'style=filled, fillcolor="#d0ffd0"];')
+        for names in op.inputs.values():
+            for n in names:
+                if n and n not in skip:
+                    var_node(n)
+                    lines.append(f'  "v_{_esc(n)}" -> "op_{i}";')
+        for names in op.outputs.values():
+            for n in names:
+                if n and n not in skip:
+                    var_node(n)
+                    lines.append(f'  "op_{i}" -> "v_{_esc(n)}";')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def draw_block_graphviz(block, highlights: Optional[Sequence[str]] = None,
+                        path: str = "/tmp/temp.dot"):
+    """reference: debugger.py draw_block_graphviz — write DOT to `path`
+    (render with `dot -Tpng`)."""
+    with open(path, "w") as f:
+        f.write(block_to_dot(block, highlight=highlights or ()))
+    return path
+
+
+def draw_program(program, path: str = "/tmp/program.dot"):
+    return draw_block_graphviz(program.global_block(), path=path)

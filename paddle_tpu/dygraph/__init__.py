@@ -13,8 +13,10 @@ from .tracer import Tracer
 from .varbase import VarBase
 from .layers import Layer
 from . import nn
-from .nn import (Conv2D, Linear, FC, BatchNorm, Embedding, LayerNorm, GRUUnit,
-                 Pool2D, Dropout)
+from .nn import (Conv2D, Conv3D, Conv2DTranspose, Conv3DTranspose, Linear,
+                 FC, BatchNorm, Embedding, LayerNorm, GRUUnit, Pool2D,
+                 Dropout, NCE, PRelu, BilinearTensorProduct, SequenceConv,
+                 RowConv, GroupNorm, SpectralNorm)
 from .parallel import DataParallel, ParallelEnv, prepare_context
 from .checkpoint import save_dygraph, load_dygraph
 from .learning_rate_scheduler import (NoamDecay, PiecewiseDecay,

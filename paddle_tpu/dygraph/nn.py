@@ -437,14 +437,16 @@ class GroupNorm(Layer):
         self.weight = self.create_parameter(
             [channels], attr=param_attr,
             default_initializer=ConstantInitializer(1.0))
-        self.bias = self.create_parameter([channels], attr=bias_attr,
-                                          is_bias=True)
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [channels], attr=bias_attr, is_bias=True)
         self._attrs = {"groups": groups, "epsilon": epsilon}
         self._act = act
 
     def forward(self, x):
-        out = _op("group_norm", {"X": [x], "Scale": [self.weight],
-                                 "Bias": [self.bias]},
+        ins = {"X": [x], "Scale": [self.weight]}
+        if self.bias is not None:
+            ins["Bias"] = [self.bias]
+        out = _op("group_norm", ins,
                   {"Y": [None], "Mean": [None], "Variance": [None]},
                   self._attrs)["Y"][0]
         if self._act:

@@ -1,0 +1,76 @@
+"""CTR data generators (reference: incubate/data_generator/__init__.py:21
+— DataGenerator/MultiSlotDataGenerator turn raw log lines into the
+slot-formatted text records the Dataset pipeline consumes).
+
+The native datafeed (native/src/datafeed.cc) reads whitespace-separated
+float records; `run_from_stdin` makes a generator usable directly as a
+Dataset `pipe_command` (the reference's deployment pattern:
+`pipe_command="python my_generator.py"`)."""
+
+from __future__ import annotations
+
+import sys
+from typing import Iterable, Iterator, List, Tuple
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator"]
+
+
+class DataGenerator:
+    """Subclass and implement generate_sample(line) returning an iterator
+    of (slot_name, values) lists; optionally generate_batch(samples)."""
+
+    def __init__(self):
+        self._line_limit = 0
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size: int):
+        self.batch_size_ = batch_size
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "implement generate_sample(self, line) -> iterator of "
+            "[(slot_name, [values]), ...]")
+
+    def generate_batch(self, samples):
+        def local_iter():
+            for s in samples:
+                yield s
+
+        return local_iter
+
+    def _gen_str(self, userdefined: List[Tuple[str, List]]) -> str:
+        raise NotImplementedError
+
+    def _emit(self, out, it):
+        batch_samples = []
+        for user_iter in it:
+            for sample in user_iter():
+                batch_samples.append(sample)
+                if len(batch_samples) == self.batch_size_:
+                    for s in self.generate_batch(batch_samples)():
+                        out.write(self._gen_str(s))
+                    batch_samples = []
+        if batch_samples:
+            for s in self.generate_batch(batch_samples)():
+                out.write(self._gen_str(s))
+
+    def run_from_stdin(self):
+        """stdin lines → formatted records on stdout (pipe_command mode)."""
+        self._emit(sys.stdout,
+                   (self.generate_sample(line) for line in sys.stdin))
+
+    def run_from_memory(self, lines: Iterable[str], out=None):
+        out = out or sys.stdout
+        self._emit(out, (self.generate_sample(line) for line in lines))
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Formats samples as flat whitespace-separated values in slot order
+    (the native datafeed's record format; the reference's protobuf-text
+    MultiSlot format carries the same values per slot)."""
+
+    def _gen_str(self, userdefined):
+        vals: List[str] = []
+        for _, values in userdefined:
+            vals.extend(str(float(v)) for v in values)
+        return " ".join(vals) + "\n"

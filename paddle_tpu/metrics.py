@@ -5,7 +5,8 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["MetricBase", "Accuracy", "ChunkEvaluator", "EditDistance",
-           "Auc", "Precision", "Recall", "CompositeMetric"]
+           "Auc", "Precision", "Recall", "CompositeMetric",
+           "DetectionMAP"]
 
 
 class MetricBase:

@@ -23,7 +23,9 @@ from .core.framework import Variable
 __all__ = ["DataLoader", "PyReader", "GeneratorLoader"]
 
 # reuse the reference's decorator library semantics
-from .reader_decorators import batch, shuffle, buffered, cache, chain, compose, map_readers, firstn  # noqa: F401,E402
+from .reader_decorators import (  # noqa: F401,E402
+    batch, buffered, cache, chain, compose, firstn, map_readers,
+    multiprocess_reader, shuffle, xmap_readers)
 
 
 class GeneratorLoader:

@@ -112,3 +112,111 @@ def batch(reader, batch_size, drop_last=False):
             yield buf
 
     return batched
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader with worker threads (reference:
+    reader/decorator.py xmap_readers; threads instead of processes — the
+    mappers here are numpy transforms that release the GIL)."""
+    import queue as _queue
+    import threading
+
+    end = object()
+
+    class _Err:
+        def __init__(self, exc):
+            self.exc = exc
+
+    def data_reader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+
+        def feed():
+            # sentinel in finally: a dying producer must never leave the
+            # consumer blocked (the buffered() pattern above)
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            except BaseException as e:
+                out_q.put(_Err(e))
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end:
+                        return
+                    i, sample = item
+                    out_q.put((i, mapper(sample)))
+            except BaseException as e:
+                out_q.put(_Err(e))
+            finally:
+                out_q.put(end)
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        pending = {}
+        next_i = 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            if isinstance(item, _Err):
+                raise item.exc
+            if order:
+                i, mapped = item
+                pending[i] = mapped
+                while next_i in pending:
+                    yield pending.pop(next_i)
+                    next_i += 1
+            else:
+                yield item[1]
+
+    return data_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave multiple readers on threads (reference:
+    decorator.py multiprocess_reader; thread-backed here — the use case is
+    overlapping IO-bound readers)."""
+    import queue as _queue
+    import threading
+
+    end = object()
+
+    class _Err:
+        def __init__(self, exc):
+            self.exc = exc
+
+    def data_reader():
+        q = _queue.Queue(queue_size)
+
+        def pump(r):
+            try:
+                for sample in r():
+                    q.put(sample)
+            except BaseException as e:
+                q.put(_Err(e))
+            finally:
+                q.put(end)
+
+        for r in readers:
+            threading.Thread(target=pump, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            item = q.get()
+            if item is end:
+                finished += 1
+                continue
+            if isinstance(item, _Err):
+                raise item.exc
+            yield item
+
+    return data_reader

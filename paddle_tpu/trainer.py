@@ -22,7 +22,9 @@ def train_from_dataset(executor, program=None, dataset=None, scope=None,
         raise ValueError("dataset is required")
     fetch_list = fetch_list or []
     step = 0
-    for feed in dataset._iter_batches():
+    batches = dataset._iter_batches() if hasattr(dataset, "_iter_batches") \
+        else iter(dataset)
+    for feed in batches:
         vals = executor.run(program, feed=feed, fetch_list=fetch_list,
                             scope=scope)
         if debug and fetch_list and step % print_period == 0:

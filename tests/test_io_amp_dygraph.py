@@ -78,7 +78,9 @@ def test_dygraph_layer_training(rng):
         X = rng.rand(16, 4).astype("float32")
         Y = (X @ rng.rand(4, 1)).astype("float32")
         losses = []
-        for _ in range(30):
+        # 60 steps: enough margin that the assertion is insensitive to the
+        # (globally-sequenced) weight init draw
+        for _ in range(60):
             xv = pt.dygraph.to_variable(X)
             yv = pt.dygraph.to_variable(Y)
             pred = linear(xv)

@@ -55,3 +55,20 @@ def test_program_test_clone_stops_dropout():
         pt.layers.mean(h)
     infer = main.clone(for_test=True)
     assert infer._is_test
+
+
+def test_debugger_draws_program_dot(tmp_path):
+    """reference: debugger.py draw_block_graphviz."""
+    import paddle_tpu as pt
+    from paddle_tpu import debugger
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        h = pt.layers.fc(x, size=3, act="relu")
+    p = debugger.draw_program(main, str(tmp_path / "g.dot"))
+    dot = open(p).read()
+    assert dot.startswith("digraph")
+    assert '"op_0"' in dot and "mul" in dot and "relu" in dot
+    # parameters shaded
+    assert "#e0e0ff" in dot

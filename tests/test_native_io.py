@@ -163,3 +163,56 @@ def test_multitrainer_propagates_worker_errors(tmp_path):
         with pytest.raises(RuntimeError, match="shard exploded"):
             MultiTrainer(TrainerDesc(thread_num=2)).train(
                 exe, main, [Boom(), Boom()])
+
+
+def test_multislot_data_generator_feeds_native_dataset(tmp_path):
+    """DataGenerator output is directly consumable by NativeDataset
+    (reference pattern: pipe_command='python my_generator.py')."""
+    import io as _io
+
+    from paddle_tpu.incubate.data_generator import MultiSlotDataGenerator
+
+    class MyGen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def local_iter():
+                parts = [float(v) for v in line.split(",")]
+                yield [("x", parts[:3]), ("y", parts[3:4])]
+
+            return local_iter
+
+    gen = MyGen()
+    buf = _io.StringIO()
+    lines = [f"{i},{i+1},{i+2},{i%2}" for i in range(10)]
+    gen.run_from_memory(lines, out=buf)
+    path = tmp_path / "gen.txt"
+    path.write_text(buf.getvalue())
+
+    ds = NativeDataset(slots=[("x", (3,)), ("y", (1,))], batch_size=5)
+    ds.set_filelist([str(path)])
+    batches = list(ds)
+    assert len(batches) == 2
+    np.testing.assert_allclose(batches[0]["x"][0], [0, 1, 2])
+
+
+def test_xmap_and_multiprocess_readers_propagate_errors():
+    """Regression: a raising mapper/reader must surface, not deadlock."""
+    from paddle_tpu.reader_decorators import (multiprocess_reader,
+                                              xmap_readers)
+
+    def ok():
+        yield from range(5)
+
+    def bad_mapper(v):
+        if v == 3:
+            raise ValueError("boom-map")
+        return v
+
+    with pytest.raises(ValueError, match="boom-map"):
+        list(xmap_readers(bad_mapper, lambda: ok(), 2, 4)())
+
+    def bad_reader():
+        yield 1
+        raise ValueError("boom-read")
+
+    with pytest.raises(ValueError, match="boom-read"):
+        list(multiprocess_reader([lambda: ok(), lambda: bad_reader()])())

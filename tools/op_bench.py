@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Single-op microbenchmark harness (reference:
+operators/benchmark/op_tester.cc + operators/jit/benchmark.cc — time one
+registered op from a config).
+
+Usage:
+    python tools/op_bench.py --op matmul --inputs X=256x768,Y=768x768 \
+        [--attrs '{"transpose_Y": false}'] [--dtype float32] [--repeat 50]
+Prints one JSON line with per-call latency.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def parse_inputs(spec: str):
+    out = {}
+    for part in spec.split(","):
+        name, shape = part.split("=")
+        out[name] = tuple(int(d) for d in shape.split("x"))
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser("op_bench")
+    ap.add_argument("--op", required=True)
+    ap.add_argument("--inputs", required=True,
+                    help="slot=AxBxC,slot2=...")
+    ap.add_argument("--attrs", default="{}")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--repeat", type=int, default=50)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import jax
+    import paddle_tpu  # registers ops  # noqa: F401
+    from paddle_tpu.core import registry
+    from paddle_tpu.core.ir import OpDesc
+    from paddle_tpu.core.registry import KernelCtx
+
+    rng = np.random.RandomState(args.seed)
+    shapes = parse_inputs(args.inputs)
+    attrs = json.loads(args.attrs)
+    if "int" in args.dtype:
+        ins = {k: [jax.numpy.asarray(rng.randint(0, 10, s))]
+               for k, s in shapes.items()}
+    else:
+        ins = {k: [jax.numpy.asarray(rng.randn(*s).astype(args.dtype))]
+               for k, s in shapes.items()}
+    opdef = registry.get_op_def(args.op)
+    op = OpDesc(type=args.op,
+                inputs={k: [k] for k in ins},
+                outputs={}, attrs=attrs)
+
+    def f(ins):
+        ctx = KernelCtx(op, rng_key=jax.random.key(args.seed))
+        return opdef.call(ins, attrs, ctx)
+
+    jf = jax.jit(f)
+    out = jf(ins)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(args.repeat):
+        out = jf(ins)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / args.repeat
+    print(json.dumps({"op": args.op, "inputs": args.inputs,
+                      "platform": jax.devices()[0].platform,
+                      "latency_us": round(dt * 1e6, 2),
+                      "repeat": args.repeat}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
