@@ -38,6 +38,7 @@ class AnalysisConfig:
         self._ir_optim = True           # XLA fusion
         self._enable_profile = False
         self._aot = False               # ahead-of-time compile at load
+        self._native_engine = False     # C++ interpreter (capi) backend
 
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
         self._use_tpu = True  # accelerator = TPU in this framework
@@ -57,6 +58,13 @@ class AnalysisConfig:
 
     def enable_aot(self):
         self._aot = True
+
+    def enable_native_engine(self):
+        """Serve through the C++ interpreter (native/src/predictor.cc) —
+        the reference's analogous switch is picking the Native vs Analysis
+        predictor (api/api_impl.h); here it swaps the XLA engine for the
+        dependency-free CPU one."""
+        self._native_engine = True
 
 
 class PaddleTensor:
@@ -78,6 +86,14 @@ class Predictor:
 
     def __init__(self, config: AnalysisConfig):
         self.config = config
+        if config._native_engine:
+            from .capi import NativePredictor
+
+            self._native = NativePredictor(config.model_dir)
+            self._feed_names = self._native.input_names
+            self._fetch_names = self._native.output_names
+            return
+        self._native = None
         place = TPUPlace(config._device_id) if config._use_tpu else CPUPlace()
         self._exe = Executor(place)
         self._scope = Scope()
@@ -125,6 +141,12 @@ class Predictor:
         return step
 
     def run(self, inputs: Sequence[PaddleTensor]) -> List[PaddleTensor]:
+        if self._native is not None:
+            feed = {t.name or self._feed_names[i]: t.data
+                    for i, t in enumerate(inputs)}
+            outs = self._native.run(feed)
+            return [PaddleTensor(o, name=n)
+                    for n, o in zip(self._fetch_names, outs)]
         feeds = {}
         for i, t in enumerate(inputs):
             name = t.name or self._feed_names[i]

@@ -24,3 +24,4 @@ from . import classify
 from . import beam
 from . import misc
 from . import quant
+from . import text_match

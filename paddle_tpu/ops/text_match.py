@@ -1,0 +1,207 @@
+"""Text-matching / CTR ops rounding out the pyramid family and misc
+leftovers.
+
+Reference behaviors: operators/pad_constant_like_op.cc,
+squared_l2_distance_op.h, bilinear_tensor_product_op.h, conv_shift_op.cc
+(circular correlation), cvm_op.h:26-40 (log show/click transform),
+hash_op.h:60-63 (per-seed hash of the id window mod mod_by — XXH64 in the
+reference; a splitmix-style integer hash here, same contract:
+deterministic per (input, seed)), match_matrix_tensor_op.cc
+(x_i^T W_t y_j similarity cube), var_conv_2d_op.cc (conv over per-row
+variable-sized grids → masked dense conv here), tree_conv_op.cc (TBCNN —
+continuous window over parent/children with position-interpolated
+left/right weights).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("pad_constant_like", nondiff_inputs=("X",))
+def pad_constant_like(ins, attrs, ctx):
+    """Out = Y padded up to X's shape with pad_value (grad flows to Y)."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    pad_value = float(attrs.get("pad_value", 0.0))
+    pads = [(0, int(xs - ys)) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": jnp.pad(y, pads, constant_values=pad_value)}
+
+
+@register_op("squared_l2_distance",
+             intermediate_outputs=("sub_result",))
+def squared_l2_distance(ins, attrs, ctx):
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    sub = x - y                     # y broadcasts when it has one row
+    return {"Out": jnp.sum(sub * sub, axis=-1, keepdims=True),
+            "sub_result": sub}
+
+
+@register_op("bilinear_tensor_product")
+def bilinear_tensor_product(ins, attrs, ctx):
+    """out[n,o] = x_n W_o y_n^T (+ bias)."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    w = ins["Weight"][0]            # [O, D1, D2]
+    out = jnp.einsum("nd,ode,ne->no", x, w, y)
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        out = out + ins["Bias"][0].reshape(1, -1)
+    return {"Out": out}
+
+
+@register_op("conv_shift")
+def conv_shift(ins, attrs, ctx):
+    """Circular correlation (reference: conv_shift_op.cc): out[b, i] =
+    Σ_j x[b, (i + j - M/2) mod N] · y[b, j], M odd, M <= N."""
+    x = ins["X"][0]                 # [B, N]
+    y = ins["Y"][0]                 # [B, M]
+    b, n = x.shape
+    m = y.shape[1]
+    half = m // 2
+    out = jnp.zeros_like(x)
+    for j in range(m):
+        out = out + jnp.roll(x, half - j, axis=1) * y[:, j:j + 1]
+    return {"Out": out}
+
+
+@register_op("cvm", nondiff_inputs=("CVM",))
+def cvm(ins, attrs, ctx):
+    """reference: cvm_op.h:26-40 — X rows are [show, click, emb...]; with
+    use_cvm the two counters become [log(show+1), log(click+1)-log(show+1)];
+    otherwise they are stripped."""
+    x = ins["X"][0]
+    use_cvm = bool(attrs.get("use_cvm", True))
+    if use_cvm:
+        show = jnp.log(x[:, 0:1] + 1.0)
+        click = jnp.log(x[:, 1:2] + 1.0) - show
+        return {"Y": jnp.concatenate([show, click, x[:, 2:]], axis=1)}
+    return {"Y": x[:, 2:]}
+
+
+def _int_hash(vals, seed):
+    """splitmix64-style avalanche over the id window (uint32 lanes on TPU —
+    jax has no uint64 math without x64); deterministic per (window, seed)."""
+    h = jnp.uint32(0x9E3779B9) * jnp.uint32(seed + 1)
+    for i in range(vals.shape[-1]):
+        v = vals[..., i].astype(jnp.uint32)
+        h = h ^ (v + jnp.uint32(0x85EBCA6B) + (h << 6) + (h >> 2))
+        h = h * jnp.uint32(0xC2B2AE35)
+        h = h ^ (h >> 16)
+    return h
+
+
+@register_op("hash", grad=None, nondiff_inputs=("X",))
+def hash_op(ins, attrs, ctx):
+    """reference: hash_op.h:60-63 — out[idx, k] = hash_k(id window) %
+    mod_by for k < num_hash. X [N, W] int → Out [N, num_hash] int64."""
+    x = ins["X"][0]
+    mod_by = int(attrs.get("mod_by", 100000))
+    num_hash = int(attrs.get("num_hash", 1))
+    outs = [(_int_hash(x, k) % jnp.uint32(mod_by)).astype(jnp.int64)
+            for k in range(num_hash)]
+    return {"Out": jnp.stack(outs, axis=-1)}
+
+
+@register_op("match_matrix_tensor",
+             intermediate_outputs=("Tmp",))
+def match_matrix_tensor(ins, attrs, ctx):
+    """reference: match_matrix_tensor_op.cc — similarity cube
+    out[n, t, i, j] = x_i^T W_t y_j over [N,Tx,D] x [N,Ty,D] with
+    W [D, dim_t, D]."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    w = ins["W"][0]                 # [D, dim_t, D]
+    tmp = jnp.einsum("nid,dte->nite", x, w)      # [N, Tx, dim_t, D]
+    out = jnp.einsum("nite,nje->ntij", tmp, y)   # [N, dim_t, Tx, Ty]
+    return {"Out": out, "Tmp": tmp}
+
+
+@register_op("var_conv_2d", nondiff_inputs=("ROW", "COLUMN"))
+def var_conv_2d(ins, attrs, ctx):
+    """reference: var_conv_2d_op.cc — per-row variable-sized 2-D conv;
+    statically: mask the padded [N, C, H, W] input past each row/col
+    length, run a dense conv2d."""
+    x = ins["X"][0]
+    w = ins["W"][0]                 # [out_ch, in_ch * kh * kw] or 4-D
+    kh = int(attrs.get("kernel_h", 3))
+    kw = int(attrs.get("kernel_w", 3))
+    sh = int(attrs.get("stride_h", 1))
+    sw = int(attrs.get("stride_w", 1))
+    n, c, h, w_dim = x.shape
+    if w.ndim == 2:
+        w = w.reshape(w.shape[0], c, kh, kw)
+    if ins.get("ROW") and ins["ROW"][0] is not None:
+        rl = ins["ROW"][0].reshape(-1).astype(jnp.int32)
+        x = x * (jnp.arange(h)[None, None, :, None] < rl[:, None, None,
+                                                        None])
+    if ins.get("COLUMN") and ins["COLUMN"][0] is not None:
+        cl = ins["COLUMN"][0].reshape(-1).astype(jnp.int32)
+        x = x * (jnp.arange(w_dim)[None, None, None, :] < cl[:, None, None,
+                                                             None])
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+    pad_h, pad_w = (kh - 1) // 2, (kw - 1) // 2
+    out = jax.lax.conv_general_dilated(
+        x, w, (sh, sw), [(pad_h, pad_h), (pad_w, pad_w)],
+        dimension_numbers=dn)
+    # mask outputs past each row's valid extent too — SAME-padded windows
+    # just outside it still see valid cells (the reference computes only
+    # over the valid grid)
+    oh, ow = out.shape[2], out.shape[3]
+    if ins.get("ROW") and ins["ROW"][0] is not None:
+        orl = (rl + sh - 1) // sh
+        out = out * (jnp.arange(oh)[None, None, :, None] <
+                     orl[:, None, None, None])
+    if ins.get("COLUMN") and ins["COLUMN"][0] is not None:
+        ocl = (cl + sw - 1) // sw
+        out = out * (jnp.arange(ow)[None, None, None, :] <
+                     ocl[:, None, None, None])
+    return {"Out": out}
+
+
+@register_op("tree_conv", nondiff_inputs=("EdgeSet",))
+def tree_conv(ins, attrs, ctx):
+    """reference: tree_conv_op.cc + math/tree2col (TBCNN): each node's
+    receptive field is itself + its children; the filter has three weight
+    planes (top/left/right) mixed by continuous position coefficients —
+    eta_t = 1 for the node, children interpolate left↔right by sibling
+    position. NodesVector [N, M, F], EdgeSet [N, E, 2] (parent, child;
+    0,0 rows = padding, node ids 1-based like the reference), Filter
+    [F, 3, C] → Out [N, M, C]."""
+    nodes = ins["NodesVector"][0]
+    edges = ins["EdgeSet"][0].astype(jnp.int32)
+    filt = ins["Filter"][0]         # [F, 3, C]
+    n, m, f = nodes.shape
+    e = edges.shape[1]
+
+    def one(feat, edge):
+        parent = edge[:, 0] - 1     # -1 = padding
+        child = edge[:, 1] - 1
+        valid = (edge[:, 0] > 0) & (edge[:, 1] > 0)
+        # sibling position: rank of each edge among edges sharing a parent
+        same = (parent[None, :] == parent[:, None]) & valid[None, :] & \
+            valid[:, None]
+        before = jnp.tril(jnp.ones((e, e), bool), k=-1)
+        rank = jnp.sum(same & before, axis=1)
+        count = jnp.maximum(jnp.sum(same, axis=1), 1)
+        # eta_r grows with sibling position, eta_l = 1 - eta_r (TBCNN)
+        eta_r = jnp.where(count > 1, rank / jnp.maximum(count - 1, 1),
+                          0.5).astype(feat.dtype)
+        eta_l = 1.0 - eta_r
+        wt, wl, wr = filt[:, 0], filt[:, 1], filt[:, 2]   # [F, C]
+        out = feat @ wt                                    # self (top)
+        child_feat = feat[jnp.maximum(child, 0)]           # [E, F]
+        contrib = child_feat @ wl * eta_l[:, None] + \
+            child_feat @ wr * eta_r[:, None]
+        contrib = jnp.where(valid[:, None], contrib, 0.0)
+        out = out.at[jnp.maximum(parent, 0)].add(contrib)
+        return out
+
+    out = jax.vmap(one)(nodes, edges)
+    return {"Out": jnp.tanh(out)}

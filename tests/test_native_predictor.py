@@ -173,3 +173,33 @@ def test_native_predictor_padding_idx(tmp_path):
     out = NativePredictor(str(tmp_path)).run({"w": ids})[0]
     assert (out[0, 0] == 0).all() and (out[1, 1] == 0).all()
     np.testing.assert_allclose(out, py_out, rtol=1e-5, atol=1e-6)
+
+
+def test_analysis_config_native_engine(tmp_path):
+    """AnalysisConfig.enable_native_engine routes Predictor.run through
+    the C++ interpreter; outputs match the XLA engine."""
+    from paddle_tpu.inference import AnalysisConfig, PaddleTensor, Predictor
+
+    rng = np.random.RandomState(5)
+    X = rng.randn(6, 8).astype("float32")
+    with pt.scope_guard(pt.Scope()):
+        main, startup = pt.Program(), pt.Program()
+        with pt.framework.unique_name.guard(), \
+                pt.program_guard(main, startup):
+            x = pt.layers.data(name="x", shape=[8], dtype="float32")
+            out = pt.layers.softmax(pt.layers.fc(x, size=4))
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        pt.io.save_inference_model(str(tmp_path), ["x"], [out], exe,
+                                   main_program=main)
+
+    cfg = AnalysisConfig(str(tmp_path))
+    xla_pred = Predictor(cfg)
+    ref = xla_pred.run([PaddleTensor(X, name="x")])[0].data
+
+    ncfg = AnalysisConfig(str(tmp_path))
+    ncfg.enable_native_engine()
+    npred = Predictor(ncfg)
+    got = npred.run([PaddleTensor(X, name="x")])[0].data
+    assert npred.get_input_names() == ["x"]
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-5)

@@ -1,0 +1,118 @@
+"""Tests for the text-matching / CTR op batch vs numpy references."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_op
+
+
+def test_pad_constant_like():
+    x = np.zeros((4, 5), "float32")
+    y = np.ones((2, 3), "float32")
+    out = run_op("pad_constant_like", {"X": x, "Y": y},
+                 {"pad_value": 7.0})["Out"][0]
+    assert out.shape == (4, 5)
+    assert (out[:2, :3] == 1).all() and (out[2:, :] == 7).all()
+
+
+def test_squared_l2_distance_and_grad():
+    rng = np.random.RandomState(0)
+    x = rng.randn(5, 4).astype("float64")
+    y = rng.randn(5, 4).astype("float64")
+    out = run_op("squared_l2_distance", {"X": x, "Y": y})["Out"][0]
+    np.testing.assert_allclose(out[:, 0], ((x - y) ** 2).sum(1))
+    check_grad("squared_l2_distance", {"X": x, "Y": y}, {},
+               inputs_to_check=["X", "Y"])
+
+
+def test_bilinear_tensor_product():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 4).astype("float64")
+    y = rng.randn(3, 5).astype("float64")
+    w = rng.randn(2, 4, 5).astype("float64")
+    b = rng.randn(2).astype("float64")
+    out = run_op("bilinear_tensor_product",
+                 {"X": x, "Y": y, "Weight": w, "Bias": b})["Out"][0]
+    want = np.einsum("nd,ode,ne->no", x, w, y) + b
+    np.testing.assert_allclose(out, want, rtol=1e-8)
+    check_grad("bilinear_tensor_product",
+               {"X": x, "Y": y, "Weight": w, "Bias": b}, {},
+               inputs_to_check=["X", "Y", "Weight"])
+
+
+def test_conv_shift_matches_reference_formula():
+    rng = np.random.RandomState(2)
+    B, N, M = 2, 7, 3
+    x = rng.randn(B, N).astype("float64")
+    y = rng.randn(B, M).astype("float64")
+    out = run_op("conv_shift", {"X": x, "Y": y})["Out"][0]
+    want = np.zeros_like(x)
+    half = M // 2
+    for b in range(B):
+        for i in range(N):
+            for j in range(M):
+                want[b, i] += x[b, (i + j - half) % N] * y[b, j]
+    np.testing.assert_allclose(out, want, rtol=1e-8)
+
+
+def test_cvm_modes():
+    x = np.array([[3.0, 1.0, 0.5, 0.6]], "float32")
+    out = run_op("cvm", {"X": x}, {"use_cvm": True}, outputs=("Y",))["Y"][0]
+    np.testing.assert_allclose(
+        out[0, :2], [np.log(4.0), np.log(2.0) - np.log(4.0)], rtol=1e-6)
+    np.testing.assert_allclose(out[0, 2:], x[0, 2:])
+    out2 = run_op("cvm", {"X": x}, {"use_cvm": False},
+                  outputs=("Y",))["Y"][0]
+    np.testing.assert_allclose(out2, x[:, 2:])
+
+
+def test_hash_deterministic_and_in_range():
+    x = np.array([[1, 2], [1, 2], [3, 4]], "int64")
+    out = run_op("hash", {"X": x}, {"mod_by": 1000, "num_hash": 3})["Out"][0]
+    assert out.shape == (3, 3)
+    np.testing.assert_array_equal(out[0], out[1])   # same window, same hash
+    assert (out != out[:, [1, 2, 0]]).any()         # seeds differ
+    assert (0 <= out).all() and (out < 1000).all()
+
+
+def test_match_matrix_tensor():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 4).astype("float64")
+    y = rng.randn(2, 5, 4).astype("float64")
+    w = rng.randn(4, 2, 4).astype("float64")
+    out = run_op("match_matrix_tensor", {"X": x, "Y": y, "W": w})["Out"][0]
+    want = np.einsum("nid,dte,nje->ntij", x, w, y)
+    np.testing.assert_allclose(out, want, rtol=1e-8)
+
+
+def test_var_conv_2d_masks_variable_extent():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 1, 6, 6).astype("float32")
+    w = rng.randn(3, 1 * 3 * 3).astype("float32")
+    out = run_op("var_conv_2d",
+                 {"X": x, "W": w, "ROW": np.array([6, 3], "int64"),
+                  "COLUMN": np.array([6, 3], "int64")},
+                 {"kernel_h": 3, "kernel_w": 3})["Out"][0]
+    assert out.shape == (2, 3, 6, 6)
+    # the ENTIRE region past the valid 3x3 extent is zero (output masking;
+    # a SAME-padded window just outside still sees valid inputs)
+    np.testing.assert_allclose(out[1, :, 3:, :], 0.0, atol=1e-6)
+    np.testing.assert_allclose(out[1, :, :, 3:], 0.0, atol=1e-6)
+    assert np.abs(out[1, :, :3, :3]).max() > 0
+
+
+def test_tree_conv_aggregates_children():
+    # tree: node1 -> children 2,3 (1-based ids)
+    feats = np.zeros((1, 3, 4), "float32")
+    feats[0, 0] = 1.0    # root
+    feats[0, 1] = 2.0
+    feats[0, 2] = 3.0
+    edges = np.array([[[1, 2], [1, 3], [0, 0]]], "int64")
+    filt = np.ones((4, 3, 2), "float32")
+    out = run_op("tree_conv", {"NodesVector": feats, "EdgeSet": edges,
+                               "Filter": filt})["Out"][0]
+    assert out.shape == (1, 3, 2)
+    # root aggregates both children (tanh saturates; just monotone check)
+    assert out[0, 0, 0] > out[0, 1, 0] * 0 + 0.9
+    # leaves only see themselves
+    np.testing.assert_allclose(out[0, 1], np.tanh(2.0 * 4), rtol=1e-5)
