@@ -55,6 +55,12 @@ from .param_attr import ParamAttr, WeightNormParamAttr
 from . import nets
 from . import backward as backward_module
 from . import dataset
+from . import debugger
+from . import io_fs
+from . import incubate
+from . import metrics
+from . import trainer
+from . import slim
 from .version import __version__
 
 # `paddle_tpu.fluid`-style alias so reference code reads naturally.

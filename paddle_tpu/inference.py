@@ -92,6 +92,17 @@ class Predictor:
             self._native = NativePredictor(config.model_dir)
             self._feed_names = self._native.input_names
             self._fetch_names = self._native.output_names
+            # declared feed dtypes: the native engine gets the same
+            # feed-dtype normalization the XLA path performs
+            import json as _json
+            import os as _os
+
+            with open(_os.path.join(config.model_dir, "__model__")) as f:
+                payload = _json.load(f)
+            self._feed_dtypes = {
+                v["name"]: v.get("dtype", "float32")
+                for v in payload["program"]["blocks"][0]["vars"]
+                if v["name"] in set(self._feed_names)}
             return
         self._native = None
         place = TPUPlace(config._device_id) if config._use_tpu else CPUPlace()
@@ -142,8 +153,11 @@ class Predictor:
 
     def run(self, inputs: Sequence[PaddleTensor]) -> List[PaddleTensor]:
         if self._native is not None:
-            feed = {t.name or self._feed_names[i]: t.data
-                    for i, t in enumerate(inputs)}
+            feed = {}
+            for i, t in enumerate(inputs):
+                name = t.name or self._feed_names[i]
+                dt = self._feed_dtypes.get(name, "float32")
+                feed[name] = np.asarray(t.data).astype(dt)
             outs = self._native.run(feed)
             return [PaddleTensor(o, name=n)
                     for n, o in zip(self._fetch_names, outs)]

@@ -39,8 +39,10 @@ def squared_l2_distance(ins, attrs, ctx):
     x = ins["X"][0]
     y = ins["Y"][0]
     sub = x - y                     # y broadcasts when it has one row
-    return {"Out": jnp.sum(sub * sub, axis=-1, keepdims=True),
-            "sub_result": sub}
+    # the reference flattens all non-batch dims before summing
+    flat = sub.reshape(sub.shape[0], -1)
+    return {"Out": jnp.sum(flat * flat, axis=-1, keepdims=True),
+            "sub_result": flat}
 
 
 @register_op("bilinear_tensor_product")
@@ -93,7 +95,9 @@ def _int_hash(vals, seed):
         h = h ^ (v + jnp.uint32(0x85EBCA6B) + (h << 6) + (h >> 2))
         h = h * jnp.uint32(0xC2B2AE35)
         h = h ^ (h >> 16)
-    return h
+    # 31-bit result: stays non-negative through the int cast even when
+    # int64 canonicalizes to int32 (x64 disabled)
+    return h & jnp.uint32(0x7FFFFFFF)
 
 
 @register_op("hash", grad=None, nondiff_inputs=("X",))
@@ -103,8 +107,13 @@ def hash_op(ins, attrs, ctx):
     x = ins["X"][0]
     mod_by = int(attrs.get("mod_by", 100000))
     num_hash = int(attrs.get("num_hash", 1))
-    outs = [(_int_hash(x, k) % jnp.uint32(mod_by)).astype(jnp.int64)
-            for k in range(num_hash)]
+    if mod_by >= 2 ** 31:
+        # the 31-bit hash is already < mod_by — modulus is a no-op, and
+        # materializing mod_by overflows when int64 canonicalizes to int32
+        outs = [_int_hash(x, k).astype(jnp.int64) for k in range(num_hash)]
+    else:
+        outs = [(_int_hash(x, k).astype(jnp.int64) % mod_by)
+                for k in range(num_hash)]
     return {"Out": jnp.stack(outs, axis=-1)}
 
 
@@ -167,40 +176,46 @@ def var_conv_2d(ins, attrs, ctx):
 
 @register_op("tree_conv", nondiff_inputs=("EdgeSet",))
 def tree_conv(ins, attrs, ctx):
-    """reference: tree_conv_op.cc + math/tree2col (TBCNN): each node's
-    receptive field is itself + its children; the filter has three weight
-    planes (top/left/right) mixed by continuous position coefficients —
-    eta_t = 1 for the node, children interpolate left↔right by sibling
-    position. NodesVector [N, M, F], EdgeSet [N, E, 2] (parent, child;
+    """reference: tree_conv_op.cc + math/tree2col (TBCNN): a node's
+    receptive field is its subtree down to attr max_depth (default 1);
+    the filter has three weight planes (top/left/right). Depth-d
+    descendants are reached through boolean adjacency powers; the top
+    coefficient decays with depth, eta_t(d) = (max_depth - d)/max_depth,
+    and left/right interpolate by position among a node's depth-d
+    descendants. NodesVector [N, M, F], EdgeSet [N, E, 2] (parent, child;
     0,0 rows = padding, node ids 1-based like the reference), Filter
     [F, 3, C] → Out [N, M, C]."""
     nodes = ins["NodesVector"][0]
     edges = ins["EdgeSet"][0].astype(jnp.int32)
     filt = ins["Filter"][0]         # [F, 3, C]
     n, m, f = nodes.shape
-    e = edges.shape[1]
+    max_depth = int(attrs.get("max_depth", 1))
 
     def one(feat, edge):
         parent = edge[:, 0] - 1     # -1 = padding
         child = edge[:, 1] - 1
         valid = (edge[:, 0] > 0) & (edge[:, 1] > 0)
-        # sibling position: rank of each edge among edges sharing a parent
-        same = (parent[None, :] == parent[:, None]) & valid[None, :] & \
-            valid[:, None]
-        before = jnp.tril(jnp.ones((e, e), bool), k=-1)
-        rank = jnp.sum(same & before, axis=1)
-        count = jnp.maximum(jnp.sum(same, axis=1), 1)
-        # eta_r grows with sibling position, eta_l = 1 - eta_r (TBCNN)
-        eta_r = jnp.where(count > 1, rank / jnp.maximum(count - 1, 1),
-                          0.5).astype(feat.dtype)
-        eta_l = 1.0 - eta_r
+        adj = jnp.zeros((m, m), feat.dtype).at[
+            jnp.maximum(parent, 0), jnp.maximum(child, 0)].max(
+            valid.astype(feat.dtype))
         wt, wl, wr = filt[:, 0], filt[:, 1], filt[:, 2]   # [F, C]
-        out = feat @ wt                                    # self (top)
-        child_feat = feat[jnp.maximum(child, 0)]           # [E, F]
-        contrib = child_feat @ wl * eta_l[:, None] + \
-            child_feat @ wr * eta_r[:, None]
-        contrib = jnp.where(valid[:, None], contrib, 0.0)
-        out = out.at[jnp.maximum(parent, 0)].add(contrib)
+        out = feat @ wt                                    # self: eta_t=1
+        reach = adj                                        # depth-1 reach
+        for d in range(1, max_depth + 1):
+            # position rank among each ancestor's depth-d descendants
+            csum = jnp.cumsum(reach, axis=1)
+            rank = jnp.where(reach > 0, csum - 1.0, 0.0)
+            count = jnp.sum(reach, axis=1, keepdims=True)
+            eta_r = jnp.where(count > 1,
+                              rank / jnp.maximum(count - 1.0, 1.0), 0.5)
+            eta_l = 1.0 - eta_r
+            eta_t = (max_depth - d) / max_depth
+            out = out + eta_t * (reach @ (feat @ wt))
+            out = out + (1.0 - eta_t) * (
+                (reach * eta_l) @ (feat @ wl) +
+                (reach * eta_r) @ (feat @ wr))
+            if d < max_depth:
+                reach = jnp.minimum(reach @ adj, 1.0)
         return out
 
     out = jax.vmap(one)(nodes, edges)

@@ -379,3 +379,72 @@ def test_distributed_embedding_end_to_end():
     assert not np.allclose(after, table[np.unique(W.reshape(-1))])
     for s in servers:
         s.stop()
+
+
+def test_downpour_style_ctr_training(tmp_path):
+    """Downpour-worker flow (reference: DownpourWorker loop,
+    downpour_worker.cc:611 — DataFeed batch → pull sparse → compute →
+    push sparse): PS-sharded embedding + native datafeed + the trainer
+    loop, end to end."""
+    import paddle_tpu as pt
+    from paddle_tpu.io_native import NativeDataset
+    from paddle_tpu.ops.distributed import bind_client
+    from paddle_tpu.ps import ParameterServer, PSClient
+    from paddle_tpu.ps.sparse_table import init_sparse_table, pull_rows
+    from paddle_tpu.trainer import train_from_dataset
+
+    p1, p2 = _free_ports(2)
+    eps = [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"]
+    servers = [ParameterServer(ep, num_trainers=1, mode="async")
+               for ep in eps]
+    for s in servers:
+        s.start_background()
+    client = PSClient(eps)
+    bind_client(client)
+
+    rng = np.random.RandomState(0)
+    V, D = 30, 8
+    table = (rng.rand(V, D).astype("float32") * 0.1)
+    init_sparse_table(client, "ctr_table", table)
+
+    # CTR logs: slot id + click label; files in the datafeed text format
+    files = []
+    for i in range(3):
+        ids = rng.randint(0, V, (40, 1))
+        clicks = (ids % 3 == 0).astype(np.float32)
+        path = tmp_path / f"ctr-{i}.txt"
+        np.savetxt(path, np.hstack([ids.astype(np.float32), clicks]),
+                   fmt="%.1f")
+        files.append(str(path))
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        w = pt.layers.data(name="wf", shape=[1], dtype="float32")
+        label = pt.layers.data(name="label", shape=[1], dtype="float32")
+        ids64 = pt.layers.cast(w, "int64")
+        emb = pt.layers.distributed_embedding(ids64, (V, D), "ctr_table",
+                                              sparse_lr=0.3)
+        emb = pt.layers.reshape(emb, shape=[-1, D])
+        pred = pt.layers.fc(input=emb, size=1, act="sigmoid")
+        loss = pt.layers.mean(pt.layers.log_loss(pred, label))
+        pt.optimizer.Adam(0.05).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        ds = NativeDataset(slots=[("wf", (1,)), ("label", (1,))],
+                           batch_size=20)
+        ds.set_filelist(files)
+        first = last = None
+        for epoch in range(12):
+            for feed in iter(ds):
+                l = float(np.asarray(exe.run(
+                    main, feed=feed, fetch_list=[loss])[0]).reshape(()))
+                if first is None:
+                    first = l
+                last = l
+        assert last < first * 0.7, (first, last)
+        # sparse rows moved server-side (the push happened)
+        after = pull_rows(client, "ctr_table", np.arange(V))
+        assert not np.allclose(after, table)
+    for s in servers:
+        s.stop()

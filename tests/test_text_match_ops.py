@@ -116,3 +116,35 @@ def test_tree_conv_aggregates_children():
     assert out[0, 0, 0] > out[0, 1, 0] * 0 + 0.9
     # leaves only see themselves
     np.testing.assert_allclose(out[0, 1], np.tanh(2.0 * 4), rtol=1e-5)
+
+
+def test_squared_l2_distance_flattens_non_batch_dims():
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 2, 3).astype("float64")
+    y = rng.randn(4, 2, 3).astype("float64")
+    out = run_op("squared_l2_distance", {"X": x, "Y": y})["Out"][0]
+    assert out.shape == (4, 1)
+    np.testing.assert_allclose(
+        out[:, 0], ((x - y) ** 2).reshape(4, -1).sum(1))
+
+
+def test_hash_large_mod_by():
+    x = np.array([[7, 9]], "int64")
+    big = 10_000_000_000
+    out = run_op("hash", {"X": x}, {"mod_by": big, "num_hash": 1})["Out"][0]
+    assert 0 <= int(out[0, 0]) < big
+
+
+def test_tree_conv_max_depth_widens_receptive_field():
+    # chain 1 -> 2 -> 3: with depth 1 the root ignores node 3; with
+    # depth 2 it sees it
+    feats = np.zeros((1, 3, 2), "float32")
+    feats[0, 2] = 5.0
+    edges = np.array([[[1, 2], [2, 3]]], "int64")
+    filt = np.full((2, 3, 1), 0.1, "float32")
+    d1 = run_op("tree_conv", {"NodesVector": feats, "EdgeSet": edges,
+                              "Filter": filt}, {"max_depth": 1})["Out"][0]
+    d2 = run_op("tree_conv", {"NodesVector": feats, "EdgeSet": edges,
+                              "Filter": filt}, {"max_depth": 2})["Out"][0]
+    # root output changes once depth reaches the grandchild
+    assert abs(float(d2[0, 0, 0]) - float(d1[0, 0, 0])) > 1e-4
