@@ -94,15 +94,15 @@ class Predictor:
             self._fetch_names = self._native.output_names
             # declared feed dtypes: the native engine gets the same
             # feed-dtype normalization the XLA path performs
-            import json as _json
-            import os as _os
+            import json
 
-            with open(_os.path.join(config.model_dir, "__model__")) as f:
-                payload = _json.load(f)
+            with open(os.path.join(config.model_dir, "__model__")) as f:
+                payload = json.load(f)
+            feed_set = set(self._feed_names)
             self._feed_dtypes = {
                 v["name"]: v.get("dtype", "float32")
-                for v in payload["program"]["blocks"][0]["vars"]
-                if v["name"] in set(self._feed_names)}
+                for b in payload["program"]["blocks"]
+                for v in b["vars"] if v["name"] in feed_set}
             return
         self._native = None
         place = TPUPlace(config._device_id) if config._use_tpu else CPUPlace()
@@ -156,8 +156,11 @@ class Predictor:
             feed = {}
             for i, t in enumerate(inputs):
                 name = t.name or self._feed_names[i]
-                dt = self._feed_dtypes.get(name, "float32")
-                feed[name] = np.asarray(t.data).astype(dt)
+                dt = self._feed_dtypes.get(name)
+                # unknown feed names keep their dtype — the engine then
+                # raises its clear unknown-var error, like the XLA path
+                feed[name] = np.asarray(t.data).astype(dt) if dt \
+                    else np.asarray(t.data)
             outs = self._native.run(feed)
             return [PaddleTensor(o, name=n)
                     for n, o in zip(self._fetch_names, outs)]

@@ -191,6 +191,8 @@ def tree_conv(ins, attrs, ctx):
     n, m, f = nodes.shape
     max_depth = int(attrs.get("max_depth", 1))
 
+    e = edges.shape[1]
+
     def one(feat, edge):
         parent = edge[:, 0] - 1     # -1 = padding
         child = edge[:, 1] - 1
@@ -198,22 +200,29 @@ def tree_conv(ins, attrs, ctx):
         adj = jnp.zeros((m, m), feat.dtype).at[
             jnp.maximum(parent, 0), jnp.maximum(child, 0)].max(
             valid.astype(feat.dtype))
+        # per-NODE left/right coefficient from the node's position among
+        # its siblings in EDGE order (tree2col semantics — it travels with
+        # the node, whatever ancestor's window it appears in)
+        same = (parent[None, :] == parent[:, None]) & valid[None, :] & \
+            valid[:, None]
+        before = jnp.tril(jnp.ones((e, e), bool), k=-1)
+        rank = jnp.sum(same & before, axis=1).astype(feat.dtype)
+        count = jnp.maximum(jnp.sum(same, axis=1), 1).astype(feat.dtype)
+        edge_eta_r = jnp.where(count > 1,
+                               rank / jnp.maximum(count - 1.0, 1.0), 0.5)
+        eta_r = jnp.zeros((m,), feat.dtype).at[
+            jnp.maximum(child, 0)].max(
+            jnp.where(valid, edge_eta_r, 0.0))
+        eta_l = 1.0 - eta_r
         wt, wl, wr = filt[:, 0], filt[:, 1], filt[:, 2]   # [F, C]
         out = feat @ wt                                    # self: eta_t=1
         reach = adj                                        # depth-1 reach
         for d in range(1, max_depth + 1):
-            # position rank among each ancestor's depth-d descendants
-            csum = jnp.cumsum(reach, axis=1)
-            rank = jnp.where(reach > 0, csum - 1.0, 0.0)
-            count = jnp.sum(reach, axis=1, keepdims=True)
-            eta_r = jnp.where(count > 1,
-                              rank / jnp.maximum(count - 1.0, 1.0), 0.5)
-            eta_l = 1.0 - eta_r
             eta_t = (max_depth - d) / max_depth
             out = out + eta_t * (reach @ (feat @ wt))
             out = out + (1.0 - eta_t) * (
-                (reach * eta_l) @ (feat @ wl) +
-                (reach * eta_r) @ (feat @ wr))
+                (reach * eta_l[None, :]) @ (feat @ wl) +
+                (reach * eta_r[None, :]) @ (feat @ wr))
             if d < max_depth:
                 reach = jnp.minimum(reach @ adj, 1.0)
         return out

@@ -181,3 +181,37 @@ def test_recommender_system_cos_sim(tmp_path):
                       fetch_list=fetches)[0]
         assert out.shape == (N, 1)
         assert np.abs(np.asarray(out)).max() <= 5.0 + 1e-5
+
+
+def test_word2vec_imikolov_hsigmoid():
+    """reference: book/test_word2vec.py — N-gram model on the imikolov
+    reader; hierarchical sigmoid replaces the full-vocab softmax (the
+    classic word2vec output head)."""
+    import itertools
+
+    from paddle_tpu.dataset import imikolov
+
+    word_dict = imikolov.build_dict()
+    V = len(word_dict)
+    N = 5
+    samples = list(itertools.islice(imikolov.train(word_dict, N)(), 256))
+    ctx = np.array([s[:N - 1] for s in samples], "int64")
+    nxt = np.array([[s[N - 1]] for s in samples], "int64")
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        words = pt.layers.data(name="w", shape=[N - 1], dtype="int64")
+        target = pt.layers.data(name="t", shape=[1], dtype="int64")
+        emb = pt.layers.embedding(words, size=[V, 32])
+        feat = pt.layers.reshape(emb, [-1, (N - 1) * 32])
+        hidden = pt.layers.fc(feat, size=64, act="relu")
+        cost = pt.layers.hsigmoid(hidden, target, num_classes=V)
+        loss = pt.layers.mean(cost)
+        pt.optimizer.Adam(learning_rate=0.02).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        ls = [float(np.asarray(exe.run(
+            main, feed={"w": ctx, "t": nxt}, fetch_list=[loss])[0])
+            .reshape(())) for _ in range(40)]
+        assert ls[-1] < ls[0] * 0.7, (ls[0], ls[-1])

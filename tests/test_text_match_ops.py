@@ -148,3 +148,27 @@ def test_tree_conv_max_depth_widens_receptive_field():
                               "Filter": filt}, {"max_depth": 2})["Out"][0]
     # root output changes once depth reaches the grandchild
     assert abs(float(d2[0, 0, 0]) - float(d1[0, 0, 0])) > 1e-4
+
+
+def test_tree_conv_eta_follows_edge_order():
+    """Regression: left/right coefficients come from a child's position
+    among its siblings in EDGE order — listing children out of node-id
+    order must not swap wl/wr."""
+    feats = np.zeros((1, 3, 1), "float32")
+    feats[0, 1] = 1.0   # node 2
+    feats[0, 2] = 2.0   # node 3
+    wl_only = np.zeros((1, 3, 1), "float32")
+    wl_only[0, 1] = 1.0   # only the LEFT plane is nonzero
+    # children in node order: first-listed child (node 2) is leftmost
+    e1 = np.array([[[1, 2], [1, 3]]], "int64")
+    o1 = run_op("tree_conv", {"NodesVector": feats, "EdgeSet": e1,
+                              "Filter": wl_only},
+                {"max_depth": 1})["Out"][0]
+    # children listed REVERSED: now node 3 is leftmost
+    e2 = np.array([[[1, 3], [1, 2]]], "int64")
+    o2 = run_op("tree_conv", {"NodesVector": feats, "EdgeSet": e2,
+                              "Filter": wl_only},
+                {"max_depth": 1})["Out"][0]
+    # root's left contribution flips from node2's 1.0 to node3's 2.0
+    assert abs(float(o1[0, 0, 0]) - np.tanh(1.0)) < 1e-5
+    assert abs(float(o2[0, 0, 0]) - np.tanh(2.0)) < 1e-5
