@@ -99,10 +99,15 @@ class Predictor:
             with open(os.path.join(config.model_dir, "__model__")) as f:
                 payload = json.load(f)
             feed_set = set(self._feed_names)
-            self._feed_dtypes = {
-                v["name"]: v.get("dtype", "float32")
-                for b in payload["program"]["blocks"]
-                for v in b["vars"] if v["name"] in feed_set}
+            # first match across blocks wins (same rule as the XLA path) —
+            # a sub-block local sharing a feed name must not shadow it
+            self._feed_dtypes = {}
+            for b in payload["program"]["blocks"]:
+                for v in b["vars"]:
+                    if v["name"] in feed_set and \
+                            v["name"] not in self._feed_dtypes:
+                        self._feed_dtypes[v["name"]] = v.get("dtype",
+                                                             "float32")
             return
         self._native = None
         place = TPUPlace(config._device_id) if config._use_tpu else CPUPlace()

@@ -493,3 +493,116 @@ def conv3d_transpose(ins, attrs, ctx):
         x, w, strides=strides, padding=padding,
         rhs_dilation=dilations, dimension_numbers=dn, transpose_kernel=True)
     return {"Output": out}
+
+
+@register_op("minus")
+def minus(ins, attrs, ctx):
+    """reference: minus_op.cc — Out = X - Y."""
+    return {"Out": ins["X"][0] - ins["Y"][0]}
+
+
+@register_op("fsp", nondiff_inputs=())
+def fsp(ins, attrs, ctx):
+    """reference: fsp_op.cc — flow-of-solution-procedure matrix:
+    [N,Cx,H,W] x [N,Cy,H,W] → [N,Cx,Cy] / (H·W) (distillation)."""
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    h, w = x.shape[2], x.shape[3]
+    out = jnp.einsum("nchw,ndhw->ncd", x, y) / float(h * w)
+    return {"Out": out}
+
+
+@register_op("mean_iou", grad=None, nondiff_inputs=("Predictions", "Labels"))
+def mean_iou(ins, attrs, ctx):
+    """reference: mean_iou_op.cc — mean IoU over classes from dense
+    prediction/label maps."""
+    pred = ins["Predictions"][0].reshape(-1).astype(jnp.int32)
+    label = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    c = int(attrs["num_classes"])
+    onehot_p = pred[:, None] == jnp.arange(c)[None, :]
+    onehot_l = label[:, None] == jnp.arange(c)[None, :]
+    inter = jnp.sum(onehot_p & onehot_l, axis=0).astype(jnp.float32)
+    union = jnp.sum(onehot_p | onehot_l, axis=0).astype(jnp.float32)
+    present = union > 0
+    iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+    wrong = jnp.sum(onehot_p & ~onehot_l, axis=0).astype(jnp.int32)
+    correct = inter.astype(jnp.int32)
+    return {"OutMeanIou": miou.reshape(1), "OutWrong": wrong,
+            "OutCorrect": correct}
+
+
+@register_op("similarity_focus", grad=None, nondiff_inputs=("X",))
+def similarity_focus(ins, attrs, ctx):
+    """reference: similarity_focus_op.cc — for each (batch, index) slice
+    T = X[:, idx] ([B, C] per sample after picking `axis`), greedily pick
+    maxima so each row/column is used at most once, and set the focus
+    mask 1 at every channel of the chosen (row, col) positions."""
+    x = ins["X"][0]                 # [N, A, B, C] with axis=1
+    axis = int(attrs.get("axis", 1))
+    indexes = [int(i) for i in attrs["indexes"]]
+    if axis != 1:
+        x = jnp.moveaxis(x, axis, 1)
+    n, a, b, c = x.shape
+    steps = min(b, c)
+
+    def focus_one(t):  # t [B, C] -> mask [B, C]
+        def step(carry, _):
+            scores, mask = carry
+            flat = jnp.argmax(scores)
+            i, j = flat // c, flat % c
+            ok = scores[i, j] > -jnp.inf
+            mask = jnp.where(ok, mask.at[i, :].set(1.0).at[:, j].set(1.0),
+                             mask)
+            scores = jnp.where(
+                ok, scores.at[i, :].set(-jnp.inf).at[:, j].set(-jnp.inf),
+                scores)
+            return (scores, mask), None
+
+        (scores, mask), _ = jax.lax.scan(
+            step, (t, jnp.zeros_like(t)), None, length=steps)
+        return mask
+
+    out = jnp.zeros_like(x)
+    for idx in indexes:
+        m = jax.vmap(focus_one)(x[:, idx])        # [N, B, C]
+        out = jnp.maximum(out, m[:, None, :, :])
+    if axis != 1:
+        out = jnp.moveaxis(out, 1, axis)
+    return {"Out": out}
+
+
+@register_op("uniform_random_batch_size_like", is_random=True, grad=None,
+             nondiff_inputs=("Input",))
+def uniform_random_batch_size_like(ins, attrs, ctx):
+    from ..core.ir import normalize_dtype
+
+    x = ins["Input"][0]
+    shape = [int(v) for v in attrs["shape"]]
+    # batch dim: output_dim_idx receives Input's input_dim_idx size
+    # (BatchSizeLikeOp base semantics, same as fill_constant_batch_size_like)
+    shape[int(attrs.get("output_dim_idx", 0))] = \
+        x.shape[int(attrs.get("input_dim_idx", 0))]
+    lo = float(attrs.get("min", -1.0))
+    hi = float(attrs.get("max", 1.0))
+    dt = normalize_dtype(attrs.get("dtype", "float32"))
+    return {"Out": jax.random.uniform(ctx.rng(), tuple(shape),
+                                      minval=lo, maxval=hi).astype(dt)}
+
+
+@register_op("gaussian_random_batch_size_like", is_random=True, grad=None,
+             nondiff_inputs=("Input",))
+def gaussian_random_batch_size_like(ins, attrs, ctx):
+    from ..core.ir import normalize_dtype
+
+    x = ins["Input"][0]
+    shape = [int(v) for v in attrs["shape"]]
+    # batch dim: output_dim_idx receives Input's input_dim_idx size
+    # (BatchSizeLikeOp base semantics, same as fill_constant_batch_size_like)
+    shape[int(attrs.get("output_dim_idx", 0))] = \
+        x.shape[int(attrs.get("input_dim_idx", 0))]
+    mean = float(attrs.get("mean", 0.0))
+    std = float(attrs.get("std", 1.0))
+    dt = normalize_dtype(attrs.get("dtype", "float32"))
+    return {"Out": (jax.random.normal(ctx.rng(), tuple(shape)) * std +
+                    mean).astype(dt)}

@@ -181,8 +181,9 @@ def tree_conv(ins, attrs, ctx):
     the filter has three weight planes (top/left/right). Depth-d
     descendants are reached through boolean adjacency powers; the top
     coefficient decays with depth, eta_t(d) = (max_depth - d)/max_depth,
-    and left/right interpolate by position among a node's depth-d
-    descendants. NodesVector [N, M, F], EdgeSet [N, E, 2] (parent, child;
+    and each node's left/right coefficient is fixed by its position among
+    its OWN siblings in edge order (it travels with the node into every
+    ancestor's window). NodesVector [N, M, F], EdgeSet [N, E, 2] (parent, child;
     0,0 rows = padding, node ids 1-based like the reference), Filter
     [F, 3, C] → Out [N, M, C]."""
     nodes = ins["NodesVector"][0]

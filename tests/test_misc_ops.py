@@ -413,3 +413,66 @@ def test_warpctc_norm_by_times():
                     {"blank": 0, "norm_by_times": True},
                     outputs=("Loss",))["Loss"][0]
     np.testing.assert_allclose(normed, plain / 4.0, rtol=1e-6)
+
+
+def test_minus_and_fsp():
+    rng = np.random.RandomState(7)
+    a = rng.randn(3, 4).astype("float64")
+    b = rng.randn(3, 4).astype("float64")
+    np.testing.assert_allclose(run_op("minus", {"X": a, "Y": b})["Out"][0],
+                               a - b)
+    x = rng.randn(2, 3, 4, 5).astype("float64")
+    y = rng.randn(2, 6, 4, 5).astype("float64")
+    out = run_op("fsp", {"X": x, "Y": y})["Out"][0]
+    want = np.einsum("nchw,ndhw->ncd", x, y) / 20.0
+    np.testing.assert_allclose(out, want, rtol=1e-8)
+    check_grad("fsp", {"X": x, "Y": y}, {}, inputs_to_check=["X", "Y"])
+
+
+def test_mean_iou():
+    pred = np.array([0, 0, 1, 1, 2], "int64")
+    lab = np.array([0, 1, 1, 1, 2], "int64")
+    out = run_op("mean_iou", {"Predictions": pred, "Labels": lab},
+                 {"num_classes": 4},
+                 outputs=("OutMeanIou", "OutWrong", "OutCorrect"))
+    # class0: i=1,u=2 -> .5; class1: i=2,u=3 -> 2/3; class2: 1/1; cls3 absent
+    want = (0.5 + 2 / 3 + 1.0) / 3
+    np.testing.assert_allclose(out["OutMeanIou"][0][0], want, rtol=1e-6)
+    np.testing.assert_array_equal(out["OutCorrect"][0], [1, 2, 1, 0])
+
+
+def test_similarity_focus_row_col_exclusive():
+    x = np.zeros((1, 2, 3, 3), "float32")
+    x[0, 0] = [[9, 1, 1], [1, 8, 1], [1, 1, 7]]
+    out = run_op("similarity_focus", {"X": x},
+                 {"axis": 1, "indexes": [0]})["Out"][0]
+    # diagonal maxima selected -> every row/col covered -> full mask
+    assert (out[0, 0] == 1).all() and (out[0, 1] == 1).all()
+    x2 = np.zeros((1, 2, 2, 3), "float32")
+    x2[0, 0] = [[5, 4, 0], [3, 9, 0]]
+    out2 = run_op("similarity_focus", {"X": x2},
+                  {"axis": 1, "indexes": [0]})["Out"][0]
+    # picks (1,1)=9 then (0,0)=5; col 2 never chosen but rows cover it
+    assert out2[0, 0, 0, 0] == 1 and out2[0, 0, 1, 1] == 1
+
+
+def test_batch_size_like_randoms():
+    x = np.zeros((7, 3), "float32")
+    out = run_op("uniform_random_batch_size_like", {"Input": x},
+                 {"shape": [-1, 5], "min": 0.0, "max": 1.0},
+                 rng_seed=0)["Out"][0]
+    assert out.shape == (7, 5)
+    assert (0 <= out).all() and (out <= 1).all()
+    out2 = run_op("gaussian_random_batch_size_like", {"Input": x},
+                  {"shape": [-1, 50], "mean": 2.0, "std": 0.1},
+                  rng_seed=1)["Out"][0]
+    assert abs(out2.mean() - 2.0) < 0.05
+
+
+def test_batch_size_like_output_dim_idx():
+    x = np.zeros((7, 3), "float32")
+    out = run_op("uniform_random_batch_size_like", {"Input": x},
+                 {"shape": [4, -1], "input_dim_idx": 0,
+                  "output_dim_idx": 1, "min": 0.0, "max": 1.0},
+                 rng_seed=2)["Out"][0]
+    assert out.shape == (4, 7)
