@@ -1,0 +1,107 @@
+"""Parameter-server cluster launcher.
+
+Reference: python/paddle/distributed/launch_ps.py — spawn N pserver
+processes and M trainer processes on localhost (or this node's share of a
+multi-node cluster), exporting the PS env contract:
+TRAINING_ROLE=PSERVER|TRAINER, PADDLE_PSERVERS_IP_PORT_LIST,
+PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PS_CURRENT_ENDPOINT (and
+PS_SYNC_MODE for this framework's sync toggle).
+
+Usage:
+    python -m paddle_tpu.distributed.launch_ps \
+        --worker_num 2 --server_num 2 train.py ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from .launch import _free_ports
+
+
+def launch_ps_main(argv=None):
+    parser = argparse.ArgumentParser("paddle_tpu.distributed.launch_ps")
+    parser.add_argument("--worker_num", type=int, default=2)
+    parser.add_argument("--server_num", type=int, default=2)
+    parser.add_argument("--servers", type=str, default="",
+                        help="comma-separated ip:port list (default: "
+                             "localhost free ports)")
+    parser.add_argument("--sync_mode", type=int, default=1)
+    parser.add_argument("--log_dir", type=str, default="")
+    parser.add_argument("--backend", type=str, default="cpu",
+                        help="cpu forces JAX_PLATFORMS=cpu in every proc "
+                             "(pservers are host-side either way)")
+    parser.add_argument("training_script")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    if args.servers:
+        endpoints = args.servers.split(",")
+    else:
+        endpoints = [f"127.0.0.1:{p}"
+                     for p in _free_ports(args.server_num)]
+    ep_list = ",".join(endpoints)
+
+    def spawn(role, idx, endpoint=""):
+        env = dict(os.environ)
+        env.update({
+            "TRAINING_ROLE": role,
+            "PADDLE_PSERVERS_IP_PORT_LIST": ep_list,
+            "PADDLE_TRAINERS_NUM": str(args.worker_num),
+            "PADDLE_TRAINER_ID": str(idx),
+            "PS_SYNC_MODE": str(args.sync_mode),
+            "PS_CURRENT_ENDPOINT": endpoint,
+        })
+        if args.backend == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            env["PADDLE_TPU_FORCE_CPU"] = "1"
+        out = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            tag = f"{role.lower()}.{endpoint or idx}".replace(":", "_")
+            out = open(os.path.join(args.log_dir, tag + ".log"), "w")
+        cmd = [sys.executable, "-u", args.training_script] + \
+            args.training_script_args
+        return subprocess.Popen(cmd, env=env, stdout=out, stderr=out), out
+
+    procs = []
+    for ep in endpoints:
+        procs.append(spawn("PSERVER", 0, endpoint=ep))
+    for i in range(args.worker_num):
+        procs.append(spawn("TRAINER", i))
+
+    # supervise: trainers finishing is success; a nonzero exit anywhere
+    # tears the cluster down (reference launch_ps waits on workers, then
+    # kills servers)
+    trainer_procs = procs[len(endpoints):]
+    server_procs = procs[:len(endpoints)]
+    code = 0
+    try:
+        for p, _ in trainer_procs:
+            rc = p.wait()
+            code = code or rc
+    except KeyboardInterrupt:
+        code = 1
+    finally:
+        for p, _ in server_procs + trainer_procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p, _ in server_procs + trainer_procs:
+            while p.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            if p.poll() is None:
+                p.kill()
+        for _, out in procs:
+            if out:
+                out.close()
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(launch_ps_main())

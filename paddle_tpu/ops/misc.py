@@ -521,13 +521,26 @@ def mean_iou(ins, attrs, ctx):
     c = int(attrs["num_classes"])
     onehot_p = pred[:, None] == jnp.arange(c)[None, :]
     onehot_l = label[:, None] == jnp.arange(c)[None, :]
-    inter = jnp.sum(onehot_p & onehot_l, axis=0).astype(jnp.float32)
-    union = jnp.sum(onehot_p | onehot_l, axis=0).astype(jnp.float32)
-    present = union > 0
-    iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
-    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
     wrong = jnp.sum(onehot_p & ~onehot_l, axis=0).astype(jnp.int32)
-    correct = inter.astype(jnp.int32)
+    correct = jnp.sum(onehot_p & onehot_l, axis=0).astype(jnp.int32)
+    # streaming accumulation (reference mean_iou_op.cc sums the optional
+    # InWrongs/InCorrects lists into the outputs)
+    for w_in in ins.get("InWrongs", []) or []:
+        if w_in is not None:
+            wrong = wrong + w_in.astype(jnp.int32)
+    for c_in in ins.get("InCorrects", []) or []:
+        if c_in is not None:
+            correct = correct + c_in.astype(jnp.int32)
+    # union per class = fp (wrong) + fn + tp (correct)
+    fn = jnp.sum(~onehot_p & onehot_l, axis=0).astype(jnp.int32)
+    union = (wrong + fn + correct).astype(jnp.float32)
+    present = union > 0
+    iou = jnp.where(present, correct.astype(jnp.float32) /
+                    jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+    for m_in in ins.get("InMeanIou", []) or []:
+        if m_in is not None:
+            miou = miou + m_in.reshape(())
     return {"OutMeanIou": miou.reshape(1), "OutWrong": wrong,
             "OutCorrect": correct}
 
@@ -552,8 +565,9 @@ def similarity_focus(ins, attrs, ctx):
             flat = jnp.argmax(scores)
             i, j = flat // c, flat % c
             ok = scores[i, j] > -jnp.inf
-            mask = jnp.where(ok, mask.at[i, :].set(1.0).at[:, j].set(1.0),
-                             mask)
+            # only the selected cell is marked; its row/col are merely
+            # excluded from later picks (similarity_focus_op.cc)
+            mask = jnp.where(ok, mask.at[i, j].set(1.0), mask)
             scores = jnp.where(
                 ok, scores.at[i, :].set(-jnp.inf).at[:, j].set(-jnp.inf),
                 scores)
@@ -575,34 +589,23 @@ def similarity_focus(ins, attrs, ctx):
 @register_op("uniform_random_batch_size_like", is_random=True, grad=None,
              nondiff_inputs=("Input",))
 def uniform_random_batch_size_like(ins, attrs, ctx):
-    from ..core.ir import normalize_dtype
+    from .tensor import _dt, batch_size_like_shape
 
-    x = ins["Input"][0]
-    shape = [int(v) for v in attrs["shape"]]
-    # batch dim: output_dim_idx receives Input's input_dim_idx size
-    # (BatchSizeLikeOp base semantics, same as fill_constant_batch_size_like)
-    shape[int(attrs.get("output_dim_idx", 0))] = \
-        x.shape[int(attrs.get("input_dim_idx", 0))]
+    shape = batch_size_like_shape(ins, attrs)
     lo = float(attrs.get("min", -1.0))
     hi = float(attrs.get("max", 1.0))
-    dt = normalize_dtype(attrs.get("dtype", "float32"))
     return {"Out": jax.random.uniform(ctx.rng(), tuple(shape),
-                                      minval=lo, maxval=hi).astype(dt)}
+                                      minval=lo,
+                                      maxval=hi).astype(_dt(attrs))}
 
 
 @register_op("gaussian_random_batch_size_like", is_random=True, grad=None,
              nondiff_inputs=("Input",))
 def gaussian_random_batch_size_like(ins, attrs, ctx):
-    from ..core.ir import normalize_dtype
+    from .tensor import _dt, batch_size_like_shape
 
-    x = ins["Input"][0]
-    shape = [int(v) for v in attrs["shape"]]
-    # batch dim: output_dim_idx receives Input's input_dim_idx size
-    # (BatchSizeLikeOp base semantics, same as fill_constant_batch_size_like)
-    shape[int(attrs.get("output_dim_idx", 0))] = \
-        x.shape[int(attrs.get("input_dim_idx", 0))]
+    shape = batch_size_like_shape(ins, attrs)
     mean = float(attrs.get("mean", 0.0))
     std = float(attrs.get("std", 1.0))
-    dt = normalize_dtype(attrs.get("dtype", "float32"))
     return {"Out": (jax.random.normal(ctx.rng(), tuple(shape)) * std +
-                    mean).astype(dt)}
+                    mean).astype(_dt(attrs))}

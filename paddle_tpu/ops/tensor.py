@@ -37,12 +37,19 @@ def fill_constant(ins, attrs, ctx):
 
 @register_op("fill_constant_batch_size_like", grad=None, nondiff_inputs=("Input",))
 def fill_constant_batch_size_like(ins, attrs, ctx):
+    shape = batch_size_like_shape(ins, attrs)
+    return {"Out": jnp.full(shape, attrs.get("value", 0.0), dtype=_dt(attrs))}
+
+
+def batch_size_like_shape(ins, attrs):
+    """Shared BatchSizeLikeOp shape rule: shape[output_dim_idx] =
+    Input.shape[input_dim_idx]."""
     ref = ins["Input"][0]
     shape = [int(s) for s in attrs["shape"]]
     in_idx = int(attrs.get("input_dim_idx", 0))
     out_idx = int(attrs.get("output_dim_idx", 0))
     shape[out_idx] = ref.shape[in_idx]
-    return {"Out": jnp.full(shape, attrs.get("value", 0.0), dtype=_dt(attrs))}
+    return shape
 
 
 @register_op("fill_zeros_like", grad=None, nondiff_inputs=("X",))

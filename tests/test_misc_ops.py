@@ -439,21 +439,34 @@ def test_mean_iou():
     want = (0.5 + 2 / 3 + 1.0) / 3
     np.testing.assert_allclose(out["OutMeanIou"][0][0], want, rtol=1e-6)
     np.testing.assert_array_equal(out["OutCorrect"][0], [1, 2, 1, 0])
+    # streaming accumulation: counters fold in
+    out2 = run_op("mean_iou",
+                  {"Predictions": pred, "Labels": lab,
+                   "InWrongs": [out["OutWrong"][0]],
+                   "InCorrects": [out["OutCorrect"][0]]},
+                  {"num_classes": 4},
+                  outputs=("OutWrong", "OutCorrect"))
+    np.testing.assert_array_equal(out2["OutCorrect"][0],
+                                  2 * out["OutCorrect"][0])
 
 
 def test_similarity_focus_row_col_exclusive():
-    x = np.zeros((1, 2, 3, 3), "float32")
-    x[0, 0] = [[9, 1, 1], [1, 8, 1], [1, 1, 7]]
+    """Paddle doc example semantics: ONLY the greedily selected
+    (row, col) cells are 1, shared across the axis dim."""
+    x = np.zeros((1, 2, 2, 2), "float32")
+    x[0, 0] = [[0.8, 0.1], [0.4, 0.5]]
     out = run_op("similarity_focus", {"X": x},
                  {"axis": 1, "indexes": [0]})["Out"][0]
-    # diagonal maxima selected -> every row/col covered -> full mask
-    assert (out[0, 0] == 1).all() and (out[0, 1] == 1).all()
+    want = np.array([[1, 0], [0, 1]], "float32")
+    np.testing.assert_allclose(out[0, 0], want)
+    np.testing.assert_allclose(out[0, 1], want)
     x2 = np.zeros((1, 2, 2, 3), "float32")
     x2[0, 0] = [[5, 4, 0], [3, 9, 0]]
     out2 = run_op("similarity_focus", {"X": x2},
                   {"axis": 1, "indexes": [0]})["Out"][0]
-    # picks (1,1)=9 then (0,0)=5; col 2 never chosen but rows cover it
-    assert out2[0, 0, 0, 0] == 1 and out2[0, 0, 1, 1] == 1
+    # picks (1,1)=9 then (0,0)=5; nothing else marked
+    want2 = np.array([[1, 0, 0], [0, 1, 0]], "float32")
+    np.testing.assert_allclose(out2[0, 0], want2)
 
 
 def test_batch_size_like_randoms():

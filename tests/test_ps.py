@@ -448,3 +448,35 @@ def test_downpour_style_ctr_training(tmp_path):
         assert not np.allclose(after, table)
     for s in servers:
         s.stop()
+
+
+@pytest.mark.slow
+def test_launch_ps_cli_runs_cluster():
+    """reference: launch_ps.py — one CLI spawns pservers + trainers; the
+    trainers' losses must track the local baseline (same oracle as the
+    manual-spawn test)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch_ps",
+         "--worker_num", "2", "--server_num", "2", "--sync_mode", "1",
+         os.path.join(REPO, "tests", "ps_worker.py")],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    dec = json.JSONDecoder()
+    results = []
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        while line.startswith("{"):
+            obj, end = dec.raw_decode(line)
+            results.append(obj)
+            line = line[end:].lstrip()
+    assert len(results) == 2, out.stdout
+    # same oracle as the manual-spawn test: per-shard losses fall and the
+    # synced params match local full-batch training
+    for r in results:
+        assert r["losses"][-1] < r["losses"][0]
+    _, base_params = _local_baseline()
+    for n, v in base_params.items():
+        np.testing.assert_allclose(results[0]["params"][n], v,
+                                   rtol=1e-4, atol=1e-5)
