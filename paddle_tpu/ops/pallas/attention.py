@@ -36,7 +36,15 @@ def _use_pallas(q) -> bool:
     if dev:
         platform = next(iter(dev)).platform
     else:
-        platform = jax.default_backend()
+        # Tracers carry no devices; the active mesh (if any) says where the
+        # computation will actually run — it may be a CPU mesh even when
+        # the default backend is TPU (dryrun_multichip's in-process mode).
+        from paddle_tpu.parallel.mesh import current_mesh
+        m = current_mesh()
+        if m is not None:
+            platform = m.devices.flat[0].platform
+        else:
+            platform = jax.default_backend()
     # flash pays off once the T×T score tile stops fitting comfortably in
     # VMEM; at short T the unfused XLA softmax path is ~2x faster (measured
     # T=128 BERT-base on v5e)

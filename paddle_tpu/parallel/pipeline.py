@@ -54,7 +54,7 @@ def pipeline_apply(
     # manual regions ("Invalid binary instruction opcode copy"); stream f32
     # there. TPU keeps the native dtype (half the ppermute ICI traffic).
     stream_dtype = x.dtype
-    cpu_bf16_bug = (jax.default_backend() == "cpu"
+    cpu_bf16_bug = (mesh.devices.flat[0].platform == "cpu"
                     and x.dtype == jnp.bfloat16)
     if cpu_bf16_bug:
         x = x.astype(jnp.float32)
