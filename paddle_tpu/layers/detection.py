@@ -263,16 +263,24 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
 
 
 def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
-                          post_nms_top_n, name=None):
+                          post_nms_top_n, name=None,
+                          rois_num_per_level=None):
+    """When per-level inputs are zero-padded (the static-shape
+    generate_proposals convention), pass rois_num_per_level (each [N]
+    int32) so padded rows are excluded; returns (fpn_rois, rois_num)
+    in that case, else fpn_rois alone (reference 1.6 signature)."""
     helper = LayerHelper("collect_fpn_proposals", name=name)
     out = helper.create_variable_for_type_inference(multi_rois[0].dtype)
     num = helper.create_variable_for_type_inference("int32")
+    inputs = {"MultiLevelRois": multi_rois,
+              "MultiLevelScores": multi_scores}
+    if rois_num_per_level:
+        inputs["MultiLevelRoisNum"] = rois_num_per_level
     helper.append_op(type="collect_fpn_proposals",
-                     inputs={"MultiLevelRois": multi_rois,
-                             "MultiLevelScores": multi_scores},
+                     inputs=inputs,
                      outputs={"FpnRois": out, "RoisNum": num},
                      attrs={"post_nms_topN": post_nms_top_n})
-    return out
+    return (out, num) if rois_num_per_level else out
 
 
 def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,

@@ -25,8 +25,9 @@ __all__ = [
     "reduce_any", "mean", "scale", "clip", "clip_by_norm", "maxout", "prelu",
     "relu", "image_resize", "resize_bilinear", "resize_nearest",
     "label_smooth", "pixel_shuffle", "grid_sampler", "shape", "where",
-    "cond_output_shape_hint", "unique", "shard_index", "temporal_shift",
+    "unique", "shard_index", "temporal_shift",
     "squared_l2_norm", "linear_chain_crf", "crf_decoding", "chunk_eval",
+    "mean_iou",
 ]
 
 
@@ -812,5 +813,18 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types,
             outs["NumCorrectChunks"])
 
 
-def cond_output_shape_hint(*a, **k):  # placeholder referenced in __all__
-    raise NotImplementedError
+def mean_iou(input, label, num_classes):
+    """Mean IoU over classes (reference: layers/nn.py `mean_iou` →
+    mean_iou_op.cc). Returns (mean_iou, out_wrong, out_correct); the
+    counter outputs can be fed back via InWrongs/InCorrects for
+    streaming accumulation."""
+    helper = LayerHelper("mean_iou")
+    iou = helper.create_variable_for_type_inference("float32")
+    wrong = helper.create_variable_for_type_inference("int32")
+    correct = helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="mean_iou",
+                     inputs={"Predictions": input, "Labels": label},
+                     outputs={"OutMeanIou": iou, "OutWrong": wrong,
+                              "OutCorrect": correct},
+                     attrs={"num_classes": num_classes})
+    return iou, wrong, correct

@@ -715,9 +715,17 @@ def generate_proposals(ins, attrs, ctx):
 @register_op("collect_fpn_proposals", grad=None)
 def collect_fpn_proposals(ins, attrs, ctx):
     """reference: detection/collect_fpn_proposals_op.cc — concat per-level
-    RoIs, keep global top post_nms_topN by score."""
+    RoIs, keep global top post_nms_topN by score.
+
+    Static-shape convention: per-level inputs may be zero-padded (the
+    generate_proposals output style); the optional MultiLevelRoisNum input
+    ([N] valid-count per image per level) masks padded rows to -inf score
+    so they are never selected, and RoisNum reports the true number of
+    valid collected proposals."""
     rois_in = [r for r in ins["MultiLevelRois"] if r is not None]
     scores_in = [s for s in ins["MultiLevelScores"] if s is not None]
+    counts_in = [c for c in (ins.get("MultiLevelRoisNum") or [])
+                 if c is not None]
     # accept [R,4] (single image) or [N,R,4] (batched); top-k per image
     if rois_in[0].ndim == 2:
         rois_in = [r[None] for r in rois_in]
@@ -729,14 +737,26 @@ def collect_fpn_proposals(ins, attrs, ctx):
                             for r in rois_in], axis=1)      # [N, R, 4]
     scores = jnp.concatenate([s.reshape(s.shape[0], -1)
                               for s in scores_in], axis=1)  # [N, R]
+    if counts_in:
+        assert len(counts_in) == len(scores_in), (
+            f"MultiLevelRoisNum must supply one count per level: got "
+            f"{len(counts_in)} counts for {len(scores_in)} score levels")
+        level_masks = []
+        for c, s in zip(counts_in, scores_in):
+            r = s.reshape(s.shape[0], -1).shape[1]
+            c = jnp.asarray(c).reshape(-1).astype(jnp.int32)
+            level_masks.append(jnp.arange(r)[None, :] < c[:, None])
+        valid = jnp.concatenate(level_masks, axis=1)        # [N, R]
+        scores = jnp.where(valid, scores, -jnp.inf)
     post_n = min(int(attrs.get("post_nms_topN", 100)), scores.shape[1])
 
     def one(ro, sc):
         top_s, top_i = jax.lax.top_k(sc, post_n)
-        return ro[top_i]
+        ok = top_s > -jnp.inf
+        return jnp.where(ok[:, None], ro[top_i], 0.0), \
+            jnp.sum(ok.astype(jnp.int32))
 
-    out = jax.vmap(one)(rois, scores)
-    num = jnp.full((rois.shape[0],), post_n, jnp.int32)
+    out, num = jax.vmap(one)(rois, scores)
     return {"FpnRois": out[0] if squeeze else out, "RoisNum": num}
 
 

@@ -455,13 +455,19 @@ def warpctc(ins, attrs, ctx):
         logits.dtype)
     label_pad = (jnp.arange(label.shape[1])[None, :] >=
                  yl[:, None]).astype(logits.dtype)
-    loss = optax.ctc_loss(logits, logit_pad, label.astype(jnp.int32),
-                          label_pad, blank_id=blank)
+
+    def raw_loss(lg):
+        per_sample = optax.ctc_loss(lg, logit_pad, label.astype(jnp.int32),
+                                    label_pad, blank_id=blank)
+        return jnp.sum(per_sample), per_sample
+
+    # the reference caches warp-ctc's gradient of the (unnormalized)
+    # per-sample loss w.r.t. the logits in WarpCTCGrad; value_and_grad
+    # shares the forward, and XLA DCE drops the grad when unfetched
+    (_, loss), ctc_grad = jax.value_and_grad(raw_loss, has_aux=True)(logits)
     if attrs.get("norm_by_times", False):
         loss = loss / jnp.maximum(llen.astype(loss.dtype), 1.0)
-    # the reference caches warp-ctc's gradient here; autodiff recomputes
-    # it, so a zero placeholder only satisfies the output contract
-    return {"Loss": loss[:, None], "WarpCTCGrad": jnp.zeros_like(logits)}
+    return {"Loss": loss[:, None], "WarpCTCGrad": ctc_grad}
 
 
 @register_op("multiplex", nondiff_inputs=("Ids",))
@@ -521,7 +527,11 @@ def mean_iou(ins, attrs, ctx):
     c = int(attrs["num_classes"])
     onehot_p = pred[:, None] == jnp.arange(c)[None, :]
     onehot_l = label[:, None] == jnp.arange(c)[None, :]
-    wrong = jnp.sum(onehot_p & ~onehot_l, axis=0).astype(jnp.int32)
+    # reference mean_iou_op.h increments out_wrong at BOTH the pred and the
+    # label class of every mismatch, so OutWrong[c] = FP[c] + FN[c]
+    fp = jnp.sum(onehot_p & ~onehot_l, axis=0).astype(jnp.int32)
+    fn = jnp.sum(~onehot_p & onehot_l, axis=0).astype(jnp.int32)
+    wrong = fp + fn
     correct = jnp.sum(onehot_p & onehot_l, axis=0).astype(jnp.int32)
     # streaming accumulation (reference mean_iou_op.cc sums the optional
     # InWrongs/InCorrects lists into the outputs)
@@ -531,9 +541,9 @@ def mean_iou(ins, attrs, ctx):
     for c_in in ins.get("InCorrects", []) or []:
         if c_in is not None:
             correct = correct + c_in.astype(jnp.int32)
-    # union per class = fp (wrong) + fn + tp (correct)
-    fn = jnp.sum(~onehot_p & onehot_l, axis=0).astype(jnp.int32)
-    union = (wrong + fn + correct).astype(jnp.float32)
+    # per-class union = accumulated wrong (fp+fn) + correct (tp), matching
+    # the reference denominator out_wrong + out_correct
+    union = (wrong + correct).astype(jnp.float32)
     present = union > 0
     iou = jnp.where(present, correct.astype(jnp.float32) /
                     jnp.maximum(union, 1.0), 0.0)

@@ -72,7 +72,25 @@ def conv_shift(ins, attrs, ctx):
     return {"Out": out}
 
 
-@register_op("cvm", nondiff_inputs=("CVM",))
+def _cvm_grad(ins, attrs, ctx):
+    """reference: cvm_op.h CvmGradComputeKernel — dX[:, 0:2] is OVERWRITTEN
+    with the CVM input's per-sample [show, click] values (not the autodiff
+    of the log transform), so in the Downpour CTR flow the embedding's
+    counter slots train through the injected CVM values; the tail gradient
+    passes straight through (dY[:, 2:] with use_cvm, full dY without)."""
+    from ..core.registry import GRAD_PREFIX_IG, GRAD_PREFIX_IN, GRAD_PREFIX_OG
+
+    x = ins[GRAD_PREFIX_IN + "X"][0]
+    cvm_in = ins[GRAD_PREFIX_IN + "CVM"][0]
+    dy = ins[GRAD_PREFIX_OG + "Y"][0]
+    use_cvm = bool(attrs.get("use_cvm", True))
+    head = jnp.broadcast_to(cvm_in[:, :2],
+                            (x.shape[0], 2)).astype(x.dtype)
+    tail = dy[:, 2:] if use_cvm else dy
+    return {GRAD_PREFIX_IG + "X": [jnp.concatenate([head, tail], axis=1)]}
+
+
+@register_op("cvm", grad=_cvm_grad, nondiff_inputs=("CVM",))
 def cvm(ins, attrs, ctx):
     """reference: cvm_op.h:26-40 — X rows are [show, click, emb...]; with
     use_cvm the two counters become [log(show+1), log(click+1)-log(show+1)];

@@ -256,6 +256,28 @@ def test_distribute_and_collect_fpn_proposals():
     np.testing.assert_allclose(out2["FpnRois"][0][0], rois[0])
 
 
+def test_collect_fpn_proposals_masks_padded_rows():
+    """Zero-padded per-level inputs (generate_proposals static-shape
+    convention) + MultiLevelRoisNum: padded rows must never be selected
+    even when their (zero) score beats a real negative score, and RoisNum
+    reports the true valid count."""
+    lv0 = np.array([[0, 0, 10, 10], [0, 0, 0, 0], [0, 0, 0, 0]], "float32")
+    sc0 = np.array([-0.5, 0.0, 0.0], "float32")   # pad score 0 > real -0.5
+    lv1 = np.array([[5, 5, 50, 50], [0, 0, 0, 0]], "float32")
+    sc1 = np.array([-0.9, 0.0], "float32")
+    counts = [np.array([1], "int32"), np.array([1], "int32")]
+    out = run_op("collect_fpn_proposals",
+                 {"MultiLevelRois": [lv0, lv1],
+                  "MultiLevelScores": [sc0, sc1],
+                  "MultiLevelRoisNum": counts},
+                 {"post_nms_topN": 4}, outputs=("FpnRois", "RoisNum"))
+    fpn = out["FpnRois"][0]
+    assert out["RoisNum"][0][0] == 2
+    np.testing.assert_allclose(fpn[0], lv0[0])    # -0.5 beats -0.9
+    np.testing.assert_allclose(fpn[1], lv1[0])
+    np.testing.assert_allclose(fpn[2:], 0.0)      # padding zeroed
+
+
 def test_rpn_target_assign_samples():
     rng = np.random.RandomState(5)
     anchors = np.stack([

@@ -259,6 +259,18 @@ def test_warpctc_loss_and_grad():
                 "LabelLength": np.array([3, 2], "int64")},
                {"blank": 0}, inputs_to_check=["Logits"],
                output_name="Loss", max_relative_error=1e-4)
+    # WarpCTCGrad output parity: the reference caches warp-ctc's gradient
+    # of the per-sample loss w.r.t. the logits; ours must be the true
+    # gradient (fd-checked), not a zero placeholder
+    from op_test import numeric_grads
+    ins = {"Logits": logits, "Label": label,
+           "LogitsLength": np.array([6, 5], "int64"),
+           "LabelLength": np.array([3, 2], "int64")}
+    got = run_op("warpctc", ins, {"blank": 0},
+                 outputs=("Loss", "WarpCTCGrad"))["WarpCTCGrad"][0]
+    fd = numeric_grads("warpctc", ins, {"blank": 0}, "Logits", "Loss",
+                       {"Loss": [np.ones((n, 1))]}, delta=1e-5)[0]
+    np.testing.assert_allclose(got, fd, rtol=1e-4, atol=1e-6)
 
 
 def test_proximal_optimizers():
@@ -439,15 +451,22 @@ def test_mean_iou():
     want = (0.5 + 2 / 3 + 1.0) / 3
     np.testing.assert_allclose(out["OutMeanIou"][0][0], want, rtol=1e-6)
     np.testing.assert_array_equal(out["OutCorrect"][0], [1, 2, 1, 0])
-    # streaming accumulation: counters fold in
+    # reference mean_iou_op.h counts each mismatch at BOTH the pred and the
+    # label class: the single (pred=0, label=1) miss gives wrong=[1,1,0,0]
+    np.testing.assert_array_equal(out["OutWrong"][0], [1, 1, 0, 0])
+    # streaming accumulation: counters fold in, and the accumulated
+    # denominator (wrong + correct) keeps the same per-class IoU
     out2 = run_op("mean_iou",
                   {"Predictions": pred, "Labels": lab,
                    "InWrongs": [out["OutWrong"][0]],
                    "InCorrects": [out["OutCorrect"][0]]},
                   {"num_classes": 4},
-                  outputs=("OutWrong", "OutCorrect"))
+                  outputs=("OutMeanIou", "OutWrong", "OutCorrect"))
     np.testing.assert_array_equal(out2["OutCorrect"][0],
                                   2 * out["OutCorrect"][0])
+    np.testing.assert_array_equal(out2["OutWrong"][0],
+                                  2 * out["OutWrong"][0])
+    np.testing.assert_allclose(out2["OutMeanIou"][0][0], want, rtol=1e-6)
 
 
 def test_similarity_focus_row_col_exclusive():

@@ -66,6 +66,29 @@ def test_cvm_modes():
     np.testing.assert_allclose(out2, x[:, 2:])
 
 
+def test_cvm_grad_matches_reference_kernel():
+    """reference cvm_op.h CvmGradComputeKernel: dX[:, 0:2] is overwritten
+    with the CVM input values (NOT the log-transform autodiff) and the tail
+    gradient passes through."""
+    from op_test import analytic_grads
+
+    x = np.array([[3.0, 1.0, 0.5, 0.6],
+                  [7.0, 2.0, -0.3, 0.2]], "float32")
+    cvm_vals = np.array([[0.9, 0.1], [0.8, 0.2]], "float32")
+    dy = np.array([[10.0, 20.0, 30.0, 40.0],
+                   [50.0, 60.0, 70.0, 80.0]], "float32")
+    g = analytic_grads("cvm", {"X": x, "CVM": cvm_vals}, {"use_cvm": True},
+                       ["X"], "Y", {"Y": [dy]})["X"][0]
+    want = np.concatenate([cvm_vals, dy[:, 2:]], axis=1)
+    np.testing.assert_allclose(g, want, rtol=1e-6)
+    # use_cvm=False: Y has item_width-2 cols; full dY passes into dX[:, 2:]
+    dy2 = dy[:, :2]
+    g2 = analytic_grads("cvm", {"X": x, "CVM": cvm_vals}, {"use_cvm": False},
+                        ["X"], "Y", {"Y": [dy2]})["X"][0]
+    want2 = np.concatenate([cvm_vals, dy2], axis=1)
+    np.testing.assert_allclose(g2, want2, rtol=1e-6)
+
+
 def test_hash_deterministic_and_in_range():
     x = np.array([[1, 2], [1, 2], [3, 4]], "int64")
     out = run_op("hash", {"X": x}, {"mod_by": 1000, "num_hash": 3})["Out"][0]
