@@ -52,6 +52,25 @@ class TransformerConfig:
     def head_dim(self):
         return self.hidden // self.heads
 
+    def train_flops_per_seq(self, src_T: int, tgt_T: int) -> float:
+        """Training FLOPs per (src, tgt) pair: 3x forward; forward = 2*T*
+        matmul params + attention quadratic terms + logits projection
+        (same accounting as BertConfig.train_flops_per_seq)."""
+        H, M = self.hidden, self.mlp_dim
+        enc_params = self.enc_layers * (4 * H * H + 2 * H * M)
+        # decoder: self-attn qkvo (4H^2) + cross-attn q/out (2H^2) + mlp run
+        # over tgt_T tokens; cross-attn k/v (2H^2) run over the src_T
+        # encoder outputs
+        dec_tgt_params = self.dec_layers * (6 * H * H + 2 * H * M)
+        dec_src_params = self.dec_layers * (2 * H * H)
+        fwd = (2 * src_T * enc_params
+               + self.enc_layers * 4 * src_T * src_T * H
+               + 2 * tgt_T * dec_tgt_params
+               + 2 * src_T * dec_src_params
+               + self.dec_layers * 4 * (tgt_T * tgt_T + tgt_T * src_T) * H
+               + 2 * tgt_T * H * self.tgt_vocab)
+        return 3 * fwd
+
 
 def init(rng: jax.Array, cfg: TransformerConfig) -> Tuple[Params, Dict]:
     s = ParamStore(rng, jnp.float32)
