@@ -51,13 +51,21 @@ def _emit(metric, sps_chip, mfu, detail):
 
 
 def _run_ladder(metric, batch_sizes, build, flops_per_sample, n_steps,
-                n_chips, platform, extra_detail):
-    """build(bs) -> (step, state, batch); try batch sizes until one fits."""
+                n_chips, platform, extra_detail, mesh=None):
+    """build(bs) -> (step, state, batch); try batch sizes until one fits.
+    Tracing/timing runs under mesh_guard so model-level shard() activation
+    constraints see the mesh."""
+    from paddle_tpu.parallel import mesh_guard
+    import contextlib
+
     last_err = None
     for bs in batch_sizes:
         try:
-            step, state, batch = build(bs)
-            dt, final_loss = _measure(step, state, batch, n_steps)
+            guard = mesh_guard(mesh) if mesh is not None \
+                else contextlib.nullcontext()
+            with guard:
+                step, state, batch = build(bs)
+                dt, final_loss = _measure(step, state, batch, n_steps)
             sps = bs * n_steps / dt
             mfu = sps * flops_per_sample / (
                 n_chips * PEAK_FLOPS.get(platform, 1e12))
@@ -81,7 +89,6 @@ def bench_resnet50(mesh, n_chips, platform, on_tpu):
     import optax
 
     from paddle_tpu.models import resnet
-    from paddle_tpu.parallel import mesh_guard
     from paddle_tpu.parallel.train import TrainStrategy, make_train_step
 
     cfg = resnet.ResNetConfig.resnet50() if on_tpu \
@@ -95,12 +102,11 @@ def bench_resnet50(mesh, n_chips, platform, on_tpu):
         def loss_fn(p, b, r):
             return resnet.loss_fn(p, cfg, b, r)
 
-        with mesh_guard(mesh):
-            init_state, step = make_train_step(
-                loss_fn, optax.sgd(0.1, momentum=0.9), mesh, axes,
-                strategy=TrainStrategy(shard_optimizer_states=False),
-                has_aux=True)
-            state = init_state(params)
+        init_state, step = make_train_step(
+            loss_fn, optax.sgd(0.1, momentum=0.9), mesh, axes,
+            strategy=TrainStrategy(shard_optimizer_states=False),
+            has_aux=True)
+        state = init_state(params)
         batch = resnet.make_batch(jax.random.key(1), cfg, bs, hw=hw)
         return step, state, batch
 
@@ -108,14 +114,14 @@ def bench_resnet50(mesh, n_chips, platform, on_tpu):
         "resnet50_train_samples_per_sec_per_chip" if on_tpu
         else "resnet_tiny_cpu_samples_per_sec",
         batch_sizes, build, cfg.flops_per_image(hw),
-        20 if on_tpu else 3, n_chips, platform, {"image_hw": hw})
+        20 if on_tpu else 3, n_chips, platform, {"image_hw": hw},
+        mesh=mesh)
 
 
 def bench_transformer_big(mesh, n_chips, platform, on_tpu):
     import optax
 
     from paddle_tpu.models import transformer
-    from paddle_tpu.parallel import mesh_guard
     from paddle_tpu.parallel.train import TrainStrategy, make_train_step
 
     cfg = transformer.TransformerConfig.big() if on_tpu \
@@ -129,11 +135,10 @@ def bench_transformer_big(mesh, n_chips, platform, on_tpu):
         def loss_fn(p, b, r):
             return transformer.nmt_loss(p, cfg, b, rng=r)
 
-        with mesh_guard(mesh):
-            init_state, step = make_train_step(
-                loss_fn, optax.adam(1e-4), mesh, axes,
-                strategy=TrainStrategy(shard_optimizer_states=True))
-            state = init_state(params)
+        init_state, step = make_train_step(
+            loss_fn, optax.adam(1e-4), mesh, axes,
+            strategy=TrainStrategy(shard_optimizer_states=True))
+        state = init_state(params)
         batch = transformer.make_batch(jax.random.key(1), cfg, bs,
                                        src_T=src_T, tgt_T=tgt_T)
         return step, state, batch
@@ -144,14 +149,13 @@ def bench_transformer_big(mesh, n_chips, platform, on_tpu):
         batch_sizes, build, cfg.train_flops_per_seq(src_T, tgt_T),
         20 if on_tpu else 3, n_chips, platform,
         {"src_len": src_T, "tgt_len": tgt_T,
-         "tokens_per_sample": src_T + tgt_T})
+         "tokens_per_sample": src_T + tgt_T}, mesh=mesh)
 
 
 def bench_bert(mesh, n_chips, platform, on_tpu):
     import optax
 
     from paddle_tpu.models import bert
-    from paddle_tpu.parallel import mesh_guard
     from paddle_tpu.parallel.train import TrainStrategy, make_train_step
 
     cfg = bert.BertConfig.base() if on_tpu else bert.BertConfig.tiny()
@@ -164,11 +168,10 @@ def bench_bert(mesh, n_chips, platform, on_tpu):
         def loss_fn(p, b, r):
             return bert.pretrain_loss(p, cfg, b, rng=r, deterministic=False)
 
-        with mesh_guard(mesh):
-            init_state, step = make_train_step(
-                loss_fn, optax.adamw(1e-4), mesh, axes,
-                strategy=TrainStrategy(shard_optimizer_states=True))
-            state = init_state(params)
+        init_state, step = make_train_step(
+            loss_fn, optax.adamw(1e-4), mesh, axes,
+            strategy=TrainStrategy(shard_optimizer_states=True))
+        state = init_state(params)
         batch = bert.make_batch(jax.random.key(1), cfg, batch_size=bs,
                                 seq_len=seq_len)
         return step, state, batch
@@ -182,7 +185,8 @@ def bench_bert(mesh, n_chips, platform, on_tpu):
         "bert_base_train_samples_per_sec_per_chip" if on_tpu
         else "bert_tiny_cpu_samples_per_sec",
         batch_sizes, build, cfg.train_flops_per_seq(seq_len, n_masked),
-        20 if on_tpu else 3, n_chips, platform, {"seq_len": seq_len})
+        20 if on_tpu else 3, n_chips, platform, {"seq_len": seq_len},
+        mesh=mesh)
 
 
 def main():

@@ -18,6 +18,7 @@ __all__ = [
     "rank_loss", "log_loss", "bpr_loss", "npair_loss", "center_loss",
     "teacher_student_sigmoid_loss", "edit_distance", "ctc_greedy_decoder",
     "warpctc", "multiplex", "conv3d_transpose", "modified_huber_loss",
+    "py_func",
 ]
 
 
@@ -311,3 +312,28 @@ def conv3d_transpose(input, num_filters, filter_size, padding=0, stride=1,
                             "dilations": _triple(dilation)})
     pre_act = helper.append_bias_op(out, dim_start=1, bias_attr=bias_attr)
     return helper.append_activation(pre_act, act)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference: layers/nn.py:14986 `py_func` → py_func op
+    (py_func_op.cc). `out` vars must be pre-created with correct shapes
+    and dtypes (create_variable + shape, as in the reference); `func`
+    receives numpy arrays and returns numpy arrays. backward_func
+    receives (forward inputs, forward outputs, output grads) minus
+    skip_vars_in_backward_input, and returns per-input grads."""
+    from ..ops.misc import register_py_func
+
+    helper = LayerHelper("py_func")
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = list(out) if isinstance(out, (list, tuple)) else ([out] if out is not None else [])
+    fid = register_py_func(func)
+    bid = register_py_func(backward_func) if backward_func is not None else -1
+    skip = [v.name if hasattr(v, "name") else str(v)
+            for v in (skip_vars_in_backward_input or [])]
+    helper.append_op(
+        type="py_func", inputs={"X": xs}, outputs={"Out": outs},
+        attrs={"forward_callable_id": fid, "backward_callable_id": bid,
+               "backward_skip_vars": skip,
+               "out_shapes": [list(o.shape) for o in outs],
+               "out_dtypes": [str(o.dtype) for o in outs]})
+    return out

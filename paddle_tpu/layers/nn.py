@@ -15,7 +15,7 @@ from ..initializer import ConstantInitializer, NormalInitializer, XavierInitiali
 __all__ = [
     "fc", "embedding", "distributed_embedding", "conv2d", "conv3d",
     "conv2d_transpose",
-    "depthwise_conv2d", "pool2d", "pool3d", "adaptive_pool2d", "batch_norm",
+    "depthwise_conv2d", "deformable_conv", "pool2d", "pool3d", "adaptive_pool2d", "batch_norm",
     "layer_norm", "group_norm", "instance_norm", "l2_normalize", "dropout",
     "softmax", "log_softmax", "matmul", "mul", "topk", "one_hot", "reshape",
     "transpose", "squeeze", "unsqueeze", "flatten", "split", "stack",
@@ -24,6 +24,7 @@ __all__ = [
     "reduce_mean", "reduce_max", "reduce_min", "reduce_prod", "reduce_all",
     "reduce_any", "mean", "scale", "clip", "clip_by_norm", "maxout", "prelu",
     "relu", "image_resize", "resize_bilinear", "resize_nearest",
+    "resize_trilinear",
     "label_smooth", "pixel_shuffle", "grid_sampler", "shape", "where",
     "unique", "shard_index", "temporal_shift",
     "squared_l2_norm", "linear_chain_crf", "crf_decoding", "chunk_eval",
@@ -135,6 +136,41 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
 
 def depthwise_conv2d(input, num_filters, filter_size, **kw):
     return conv2d(input, num_filters, filter_size, groups=input.shape[1], **kw)
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size, stride=1,
+                    padding=0, dilation=1, groups=None,
+                    deformable_groups=None, im2col_step=None,
+                    param_attr=None, bias_attr=None, modulated=True,
+                    name=None):
+    """reference: layers/nn.py:15763 `deformable_conv` → deformable_conv
+    (v2, modulated) or deformable_conv_v1 op. im2col_step is accepted and
+    ignored (the XLA lowering gathers all taps in one fused computation)."""
+    helper = LayerHelper("deformable_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    groups = groups or 1
+    deformable_groups = deformable_groups or 1
+    num_channels = input.shape[1]
+    fsize = _pair(filter_size)
+    filter_shape = [num_filters, num_channels // groups] + fsize
+    fan_in = (num_channels // groups) * fsize[0] * fsize[1]
+    std = (2.0 / fan_in) ** 0.5
+    w = helper.create_parameter(param_attr, shape=filter_shape,
+                                dtype=input.dtype,
+                                default_initializer=NormalInitializer(0.0, std))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Input": input, "Offset": offset, "Filter": w}
+    op_type = "deformable_conv" if modulated else "deformable_conv_v1"
+    if modulated:
+        inputs["Mask"] = mask
+    helper.append_op(type=op_type, inputs=inputs,
+                     outputs={"Output": out},
+                     attrs={"strides": _pair(stride),
+                            "paddings": _pair(padding),
+                            "dilations": _pair(dilation), "groups": groups,
+                            "deformable_groups": deformable_groups,
+                            "im2col_step": im2col_step or 64})
+    return helper.append_bias_op(out, dim_start=1, bias_attr=bias_attr)
 
 
 def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
@@ -649,11 +685,14 @@ def relu(x, name=None):
 def image_resize(input, out_shape=None, scale=None, name=None,
                  resample="BILINEAR", align_corners=True, align_mode=1):
     helper = LayerHelper("interp", name=name)
-    op_type = "bilinear_interp" if resample.upper() == "BILINEAR" else "nearest_interp"
+    op_type = {"BILINEAR": "bilinear_interp", "NEAREST": "nearest_interp",
+               "TRILINEAR": "trilinear_interp"}[resample.upper()]
     out = helper.create_variable_for_type_inference(input.dtype)
     attrs = {"align_corners": align_corners, "align_mode": align_mode}
     if out_shape is not None:
-        attrs["out_h"], attrs["out_w"] = int(out_shape[0]), int(out_shape[1])
+        keys = ("out_d", "out_h", "out_w")[-len(out_shape):]
+        for k, v in zip(keys, out_shape):
+            attrs[k] = int(v)
     else:
         attrs["scale"] = float(scale)
     helper.append_op(type=op_type, inputs={"X": input}, outputs={"Out": out},
@@ -662,11 +701,17 @@ def image_resize(input, out_shape=None, scale=None, name=None,
 
 
 def resize_bilinear(input, out_shape=None, scale=None, name=None, **kw):
-    return image_resize(input, out_shape, scale, name, "BILINEAR")
+    return image_resize(input, out_shape, scale, name, "BILINEAR", **kw)
 
 
 def resize_nearest(input, out_shape=None, scale=None, name=None, **kw):
-    return image_resize(input, out_shape, scale, name, "NEAREST")
+    return image_resize(input, out_shape, scale, name, "NEAREST", **kw)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None, **kw):
+    """reference: layers/nn.py:9716 `resize_trilinear` → trilinear_interp
+    op on NCDHW input."""
+    return image_resize(input, out_shape, scale, name, "TRILINEAR", **kw)
 
 
 def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32", name=None):

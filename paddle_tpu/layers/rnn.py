@@ -14,8 +14,94 @@ from typing import Optional, Tuple
 from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
 
-__all__ = ["lstm", "dynamic_lstm", "gru", "dynamic_gru",
+__all__ = ["lstm", "dynamic_lstm", "gru", "dynamic_gru", "dynamic_lstmp",
+           "lstm_unit", "gru_unit",
            "beam_search", "beam_search_decode", "gather_tree"]
+
+
+def dynamic_lstmp(input, size, proj_size, h_0=None, c_0=None,
+                  param_attr=None, bias_attr=None, use_peepholes=False,
+                  is_reverse=False, gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  proj_activation="tanh", cell_clip=None, proj_clip=None,
+                  dtype="float32", name=None):
+    """reference: layers/nn.py `dynamic_lstmp` → lstmp op (lstmp_op.cc):
+    projection LSTM over pre-projected [N, T, 4H] input; returns
+    (projection [N, T, P], cell [N, T, H])."""
+    helper = LayerHelper("dynamic_lstmp", name=name)
+    hidden_size = size // 4
+    w = helper.create_parameter(
+        param_attr, shape=[proj_size, 4 * hidden_size], dtype=dtype)
+    pw = helper.create_parameter(
+        param_attr, shape=[hidden_size, proj_size], dtype=dtype)
+    b = helper.create_parameter(
+        bias_attr, shape=[4 * hidden_size], dtype=dtype, is_bias=True)
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": input, "Weight": w, "ProjWeight": pw, "Bias": b}
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    if c_0 is not None:
+        inputs["C0"] = c_0
+    helper.append_op(
+        type="lstmp_v2", inputs=inputs,
+        outputs={"Projection": proj, "Cell": cell},
+        attrs={"use_peepholes": use_peepholes, "is_reverse": is_reverse,
+               "gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation,
+               "proj_activation": proj_activation,
+               "cell_clip": float(cell_clip or 0.0),
+               "proj_clip": float(proj_clip or 0.0)})
+    return proj, cell
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """reference: layers/nn.py `lstm_unit` — fc([x_t, h_prev]) -> 4D gates
+    then one lstm_unit op step; returns (hidden, cell)."""
+    from .nn import fc
+    from .tensor import concat
+
+    helper = LayerHelper("lstm_unit", name=name)
+    size = cell_t_prev.shape[1]
+    concat_in = concat([x_t, hidden_t_prev], axis=1)
+    fc_out = fc(concat_in, size=4 * size, param_attr=param_attr,
+                bias_attr=bias_attr)
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(type="lstm_unit",
+                     inputs={"X": fc_out, "C_prev": cell_t_prev},
+                     outputs={"C": c, "H": h},
+                     attrs={"forget_bias": float(forget_bias)})
+    return h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False, name=None):
+    """reference: layers/nn.py `gru_unit` → gru_unit op; returns
+    (hidden, reset_hidden_prev, gate)."""
+    helper = LayerHelper("gru_unit", name=name)
+    acts = {"identity": 0, "sigmoid": 1, "tanh": 2, "relu": 3}
+    hidden_size = size // 3
+    w = helper.create_parameter(param_attr,
+                                shape=[hidden_size, 3 * hidden_size],
+                                dtype=input.dtype)
+    b = helper.create_parameter(bias_attr, shape=[1, 3 * hidden_size],
+                                dtype=input.dtype, is_bias=True)
+    gate = helper.create_variable_for_type_inference(input.dtype)
+    rhp = helper.create_variable_for_type_inference(input.dtype)
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="gru_unit",
+        inputs={"Input": input, "HiddenPrev": hidden, "Weight": w,
+                "Bias": b},
+        outputs={"Gate": gate, "ResetHiddenPrev": rhp, "Hidden": out},
+        attrs={"activation": acts[activation],
+               "gate_activation": acts[gate_activation],
+               "origin_mode": origin_mode})
+    return out, rhp, gate
 
 
 def lstm(input, hidden_size, num_layers=1, is_reverse=False,

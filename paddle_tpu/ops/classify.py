@@ -190,6 +190,49 @@ def sampled_softmax_with_cross_entropy(ins, attrs, ctx):
     return {"Loss": loss, "Samples": samples, "SampledLogits": sub}
 
 
+@register_op("sample_logits", is_random=True,
+             nondiff_inputs=("Labels", "CustomizedSamples",
+                             "CustomizedProbabilities"),
+             intermediate_outputs=("Samples", "Probabilities",
+                                   "SampledLabels", "LogitsDim",
+                                   "LabelsDim"))
+def sample_logits(ins, attrs, ctx):
+    """reference: sample_logits_op.h — the building block under sampled
+    softmax: Samples = [labels | S log-uniform negatives];
+    SampledLogits[i,j] = logits[i, samples[i,j]] - log(q(samples[i,j]));
+    accidental hits (negative == any true label of the row) get -1e20;
+    SampledLabels[i,j] = j (position of the true columns)."""
+    logits = ins["Logits"][0]              # [N, C]
+    label = ins["Labels"][0]
+    if label.ndim == 1:
+        label = label[:, None]
+    n, c = logits.shape
+    s = int(attrs.get("num_samples", 5))
+    nt = label.shape[1]
+    use_custom = bool(attrs.get("use_customized_samples", False))
+    remove_hits = bool(attrs.get("remove_accidental_hits", True))
+    uniq = bool(attrs.get("uniq", True))   # accepted; sampling is i.i.d.
+
+    if use_custom:
+        samples = ins["CustomizedSamples"][0].astype(jnp.int64)
+        probs = ins["CustomizedProbabilities"][0].astype(logits.dtype)
+    else:
+        neg = _sample_classes(ctx.rng(), (n, s), c, "log_uniform")
+        samples = jnp.concatenate([label.astype(jnp.int64), neg], axis=1)
+        probs = (_log_uniform_prob(samples, c) * s).astype(logits.dtype)
+    sub = jnp.take_along_axis(logits, samples, axis=1)    # [N, nt+S]
+    if remove_hits:
+        hit = (samples[:, None, nt:] ==
+               label.astype(jnp.int64)[:, :, None]).any(axis=1)
+        sub = sub.at[:, nt:].add(jnp.where(hit, -1e20, 0.0).astype(sub.dtype))
+    sub = sub - jnp.log(probs + 1e-12).astype(sub.dtype)
+    sampled_labels = jnp.tile(jnp.arange(nt, dtype=jnp.int64)[None], (n, 1))
+    return {"Samples": samples, "Probabilities": probs,
+            "SampledLogits": sub, "SampledLabels": sampled_labels,
+            "LogitsDim": jnp.array(logits.shape, jnp.int64),
+            "LabelsDim": jnp.array(label.shape, jnp.int64)}
+
+
 @register_op("cos_sim", intermediate_outputs=("XNorm", "YNorm"))
 def cos_sim(ins, attrs, ctx):
     """Row-wise cosine similarity; Y broadcasts when it has one row

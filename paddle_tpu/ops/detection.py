@@ -205,6 +205,139 @@ def roi_align(ins, attrs, ctx):
     return {"Out": out}
 
 
+def _tent_integral(lo, hi, centers):
+    """∫_{lo}^{hi} max(0, 1-|y-c|) dy for each pixel center c — the exact
+    integral of the bilinear-interpolation basis over a window (PrRoI
+    pooling's closed form; reference prroi_pool_op.h
+    PrRoIPoolingMatCalculation accumulates the same cell-wise integrals)."""
+    def G(u):  # antiderivative of the tent evaluated at offset u
+        return jnp.where(
+            u <= -1.0, 0.0,
+            jnp.where(u < 0.0, (u + 1.0) ** 2 / 2.0,
+                      jnp.where(u < 1.0, 1.0 - (1.0 - u) ** 2 / 2.0, 1.0)))
+    return G(hi[:, None] - centers[None, :]) - G(lo[:, None] - centers[None, :])
+
+
+@register_op("prroi_pool", nondiff_inputs=("ROIs",))
+def prroi_pool(ins, attrs, ctx):
+    """reference: prroi_pool_op.cc — precise (integral) position-sensitive
+    RoI pooling: out[r,c,i,j] = ∫∫_bin x[(c*ph+i)*pw+j] / bin_area, the
+    integral taken over the bilinearly-interpolated feature surface.
+    Computed exactly as two separable tent-integral weight matrices
+    contracted on the MXU (no sampling-point approximation)."""
+    x, rois = ins["X"][0], ins["ROIs"][0]      # x: [N,C,H,W], rois: [R,4]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    oc = int(attrs.get("output_channels", x.shape[1] // (ph * pw)))
+    n, c, h, w = x.shape
+    assert c == oc * ph * pw, (
+        f"prroi_pool input channels {c} != output_channels*ph*pw "
+        f"{oc * ph * pw}")
+    xr = x[0].reshape(oc, ph, pw, h, w)
+    hs = jnp.arange(h, dtype=x.dtype)
+    ws = jnp.arange(w, dtype=x.dtype)
+
+    def one(roi):
+        x1, y1, x2, y2 = roi * scale
+        rh = jnp.maximum(y2 - y1, 0.0)
+        rw = jnp.maximum(x2 - x1, 0.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        ylo = y1 + jnp.arange(ph, dtype=x.dtype) * bin_h
+        xlo = x1 + jnp.arange(pw, dtype=x.dtype) * bin_w
+        wh = _tent_integral(ylo, ylo + bin_h, hs)     # [ph, H]
+        ww = _tent_integral(xlo, xlo + bin_w, ws)     # [pw, W]
+        win = bin_h * bin_w
+        out = jnp.einsum("cijhw,ih,jw->cij", xr, wh, ww)
+        return jnp.where(win > 0.0, out / jnp.maximum(win, 1e-12), 0.0)
+
+    return {"Out": jax.vmap(one)(rois)}
+
+
+@register_op("deformable_psroi_pooling", nondiff_inputs=("ROIs",))
+def deformable_psroi_pooling(ins, attrs, ctx):
+    """reference: deformable_psroi_pooling_op.h
+    DeformablePSROIPoolForwardCPUKernel — position-sensitive RoI pooling
+    whose bin starts are shifted by learned per-part offsets (Trans),
+    averaged over a sample_per_part^2 grid of bilinear taps; samples
+    falling outside [-0.5, size-0.5] are excluded from the mean."""
+    x, rois = ins["Input"][0], ins["ROIs"][0]
+    trans = (ins.get("Trans") or [None])[0]
+    no_trans = bool(attrs.get("no_trans", trans is None))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    out_dim = int(attrs["output_dim"])
+    gh_, gw_ = [int(v) for v in attrs.get("group_size", [1, 1])]
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    part = attrs.get("part_size", [ph, pw]) or [ph, pw]
+    part_h, part_w = int(part[0]), int(part[1])
+    spp = int(attrs.get("sample_per_part", 4))
+    tstd = float(attrs.get("trans_std", 0.1))
+    n, c, H, W = x.shape
+    n_classes = 1 if (no_trans or trans is None) else trans.shape[1] // 2
+    ceach = out_dim // n_classes
+    x0 = x[0]
+    fdt = x.dtype
+
+    iy = jnp.arange(ph)
+    jx = jnp.arange(pw)
+    part_hi = jnp.floor(iy.astype(fdt) / ph * part_h).astype(jnp.int32)
+    part_wi = jnp.floor(jx.astype(fdt) / pw * part_w).astype(jnp.int32)
+    ghi = jnp.clip(jnp.floor(iy.astype(fdt) * gh_ / ph).astype(jnp.int32),
+                   0, gh_ - 1)
+    gwi = jnp.clip(jnp.floor(jx.astype(fdt) * gw_ / pw).astype(jnp.int32),
+                   0, gw_ - 1)
+    ctop = jnp.arange(out_dim)
+    class_id = ctop // ceach
+    # channel map per output cell: (ctop*gh + gh_i)*gw + gw_i
+    cidx = ((ctop[:, None, None] * gh_ + ghi[None, :, None]) * gw_
+            + gwi[None, None, :])                        # [od, ph, pw]
+
+    def one(roi, tr):
+        rsw = jnp.round(roi[0]) * scale - 0.5
+        rsh = jnp.round(roi[1]) * scale - 0.5
+        rew = (jnp.round(roi[2]) + 1.0) * scale - 0.5
+        reh = (jnp.round(roi[3]) + 1.0) * scale - 0.5
+        rw = jnp.maximum(rew - rsw, 0.1)
+        rh = jnp.maximum(reh - rsh, 0.1)
+        bh, bw = rh / ph, rw / pw
+        if no_trans or tr is None:
+            tx = ty = jnp.zeros((out_dim, ph, pw), fdt)
+        else:
+            tx = tr[class_id * 2][:, part_hi][:, :, part_wi] * tstd
+            ty = tr[class_id * 2 + 1][:, part_hi][:, :, part_wi] * tstd
+        hstart = iy.astype(fdt)[None, :, None] * bh + rsh + ty * rh
+        wstart = jx.astype(fdt)[None, None, :] * bw + rsw + tx * rw
+        # sample grid [od, ph, pw, spp, spp]
+        sh = hstart[..., None, None] + \
+            jnp.arange(spp, dtype=fdt)[None, None, None, :, None] * (bh / spp)
+        sw = wstart[..., None, None] + \
+            jnp.arange(spp, dtype=fdt)[None, None, None, None, :] * (bw / spp)
+        sh, sw = jnp.broadcast_to(sh, sh.shape[:3] + (spp, spp)), \
+            jnp.broadcast_to(sw, sw.shape[:3] + (spp, spp))
+        valid = ((sw >= -0.5) & (sw <= W - 0.5)
+                 & (sh >= -0.5) & (sh <= H - 0.5))
+        shc = jnp.clip(sh, 0.0, H - 1.0)
+        swc = jnp.clip(sw, 0.0, W - 1.0)
+
+        from .nn import _bilinear_sample_chw
+        maps = x0[cidx.reshape(-1)]                      # [M, H, W]
+        vals = jax.vmap(
+            lambda m, yy, xx: _bilinear_sample_chw(m[None], yy, xx)[0])(
+                maps, shc.reshape(-1, spp, spp), swc.reshape(-1, spp, spp))
+        vals = vals.reshape(out_dim, ph, pw, spp, spp)
+        cnt = valid.sum((-1, -2))
+        s = (vals * valid.astype(fdt)).sum((-1, -2))
+        out = jnp.where(cnt > 0, s / jnp.maximum(cnt, 1).astype(fdt), 0.0)
+        return out, cnt.astype(fdt)
+
+    if trans is None:
+        out, count = jax.vmap(lambda r: one(r, None))(rois)
+    else:
+        out, count = jax.vmap(one)(rois, trans)
+    return {"Output": out, "TopCount": count}
+
+
 @register_op("box_clip", grad=None)
 def box_clip(ins, attrs, ctx):
     boxes, im_info = ins["Input"][0], ins["ImInfo"][0]
@@ -1234,3 +1367,170 @@ def roi_perspective_transform(ins, attrs, ctx):
 
     return {"Out": jax.vmap(one)(rois), "Out2InIdx": None,
             "Out2InWeights": None, "Mask": None, "TransformMatrix": None}
+
+
+# ---------------------------------------------------------------------------
+# detection_map — in-graph streaming mAP (reference: detection_map_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _np_detection_map_update(dets, gts, pos_count, tps, fps,
+                             overlap_threshold, evaluate_difficult,
+                             ap_type, class_num, cap):
+    """Host kernel: reference detection_map_op.h semantics on padded
+    numpy buffers. dets [B,M,6] (label<0 = pad), gts [B,G,6]
+    (label,x1,y1,x2,y2,difficult; label<0 = pad). State buffers:
+    pos_count [C,1], tps/fps [C,cap,2] with score<0 marking free slots."""
+    import numpy as np
+
+    def iou(a, b):
+        ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+        ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+        iw, ih = max(ix2 - ix1, 0.0), max(iy2 - iy1, 0.0)
+        inter = iw * ih
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    pos_count = pos_count.copy()
+    lists = {c: ([list(p) for p in tps[c] if p[0] >= 0],
+                 [list(p) for p in fps[c] if p[0] >= 0])
+             for c in range(class_num)}
+
+    for b in range(dets.shape[0]):
+        # rows with label < 0 are padding; labels >= class_num are invalid
+        # and dropped (a crash inside pure_callback would surface as an
+        # opaque XlaRuntimeError)
+        img_gts = [g for g in gts[b] if 0 <= g[0] < class_num]
+        img_dets = [d for d in dets[b] if 0 <= d[0] < class_num]
+        # per-class gt count (difficult excluded unless evaluate_difficult)
+        for g in img_gts:
+            c = int(g[0])
+            difficult = bool(g[5]) if g.shape[0] > 5 else False
+            if evaluate_difficult or not difficult:
+                pos_count[c, 0] += 1
+        by_class = {}
+        for d in img_dets:
+            by_class.setdefault(int(d[0]), []).append(d)
+        for c, ds in by_class.items():
+            cgts = [[tuple(g[1:5]),
+                     bool(g[5]) if g.shape[0] > 5 else False, False]
+                    for g in img_gts if int(g[0]) == c]
+            tp_l, fp_l = lists.setdefault(c, ([], []))
+            for d in sorted(ds, key=lambda r: -r[1]):
+                score, box = float(d[1]), tuple(d[2:6])
+                best, best_g = 0.0, None
+                for g in cgts:
+                    i = iou(box, g[0])
+                    if i > best:
+                        best, best_g = i, g
+                if best >= overlap_threshold and best_g is not None:
+                    if not evaluate_difficult and best_g[1]:
+                        continue           # difficult gt: ignored
+                    if not best_g[2]:
+                        best_g[2] = True
+                        tp_l.append([score, 1.0])
+                        fp_l.append([score, 0.0])
+                    else:
+                        tp_l.append([score, 0.0])
+                        fp_l.append([score, 1.0])
+                else:
+                    tp_l.append([score, 0.0])
+                    fp_l.append([score, 1.0])
+
+    # mAP over classes with positives
+    aps = []
+    for c in range(class_num):
+        npos = pos_count[c, 0]
+        tp_l, fp_l = lists.get(c, ([], []))
+        if npos == 0:
+            continue
+        if not tp_l:
+            aps.append(0.0)
+            continue
+        order = np.argsort([-p[0] for p in tp_l], kind="stable")
+        tp = np.cumsum([tp_l[i][1] for i in order])
+        fp = np.cumsum([fp_l[i][1] for i in order])
+        rec = tp / npos
+        prec = tp / np.maximum(tp + fp, 1e-9)
+        if ap_type == "11point":
+            ap = sum((prec[rec >= t].max() if (rec >= t).any() else 0.0)
+                     for t in np.linspace(0, 1, 11)) / 11.0
+        else:
+            ap, prev_rec = 0.0, 0.0
+            for i in range(len(rec)):
+                ap += prec[i] * (rec[i] - prev_rec)
+                prev_rec = rec[i]
+        aps.append(ap)
+    m_ap = float(np.mean(aps)) if aps else 0.0
+
+    def pack(ls):
+        out = np.full((class_num, cap, 2), -1.0, np.float32)
+        for c in range(class_num):
+            rows = lists.get(c, ([], []))[ls][:cap]
+            for i, r in enumerate(rows):
+                out[c, i] = r
+        return out
+
+    return (np.array([m_ap], np.float32), pos_count.astype(np.int32),
+            pack(0), pack(1))
+
+
+@register_op("detection_map", grad=None,
+             nondiff_inputs=("DetectRes", "Label", "HasState", "PosCount",
+                             "TruePos", "FalsePos"))
+def detection_map(ins, attrs, ctx):
+    """reference: detection_map_op.cc — in-graph streaming mAP.
+    Static-shape redesign: DetectRes [B,M,6]/[M,6] and Label
+    [B,G,6]/[G,6] are zero-padded with label=-1 rows; the accumulator
+    state is fixed-capacity (attr `max_dets`, score<0 = free slot)
+    instead of the reference's LoD-grown lists. The matching/AP math runs
+    host-side through jax.pure_callback (the reference computes on CPU
+    too)."""
+    dets = ins["DetectRes"][0]
+    gts = ins["Label"][0]
+    if dets.ndim == 2:
+        dets = dets[None]
+    if gts.ndim == 2:
+        gts = gts[None]
+    class_num = int(attrs["class_num"])
+    cap = int(attrs.get("max_dets", 256))
+    thr = float(attrs.get("overlap_threshold", 0.5))
+    ed = bool(attrs.get("evaluate_difficult", True))
+    ap_type = str(attrs.get("ap_type", "integral"))
+
+    pc_in = (ins.get("PosCount") or [None])[0]
+    tp_in = (ins.get("TruePos") or [None])[0]
+    fp_in = (ins.get("FalsePos") or [None])[0]
+    has_state = (ins.get("HasState") or [None])[0]
+    if pc_in is None:
+        pc_in = jnp.zeros((class_num, 1), jnp.int32)
+    if tp_in is None:
+        tp_in = jnp.full((class_num, cap, 2), -1.0, jnp.float32)
+    if fp_in is None:
+        fp_in = jnp.full((class_num, cap, 2), -1.0, jnp.float32)
+    if has_state is not None:
+        # HasState==0 resets the accumulators (reference out_states init)
+        keep = (has_state.reshape(()) != 0)
+        pc_in = jnp.where(keep, pc_in, jnp.zeros_like(pc_in))
+        tp_in = jnp.where(keep, tp_in, jnp.full_like(tp_in, -1.0))
+        fp_in = jnp.where(keep, fp_in, jnp.full_like(fp_in, -1.0))
+
+    result_shapes = (
+        jax.ShapeDtypeStruct((1,), jnp.float32),
+        jax.ShapeDtypeStruct((class_num, 1), jnp.int32),
+        jax.ShapeDtypeStruct((class_num, cap, 2), jnp.float32),
+        jax.ShapeDtypeStruct((class_num, cap, 2), jnp.float32),
+    )
+
+    def host(d, g, pc, tp, fp):
+        import numpy as np
+        return _np_detection_map_update(
+            np.asarray(d, np.float64), np.asarray(g, np.float64),
+            np.asarray(pc, np.int64), np.asarray(tp), np.asarray(fp),
+            thr, ed, ap_type, class_num, cap)
+
+    m_ap, pc, tp, fp = jax.pure_callback(
+        host, result_shapes, dets, gts, pc_in, tp_in, fp_in)
+    return {"MAP": m_ap, "AccumPosCount": pc, "AccumTruePos": tp,
+            "AccumFalsePos": fp}

@@ -67,3 +67,50 @@ def precision_recall(ins, attrs, ctx):
     macro = jnp.stack([jnp.mean(precision), jnp.mean(recall), jnp.mean(f1)])
     return {"BatchMetrics": macro, "AccumMetrics": macro,
             "AccumStatesInfo": jnp.stack([tp, fp, fn], axis=1)}
+
+
+@register_op("positive_negative_pair", grad=None)
+def positive_negative_pair(ins, attrs, ctx):
+    """reference: positive_negative_pair_op.h — per-query pair ranking
+    statistic. For every same-query pair with different labels (weight
+    (w_i+w_j)/2): equal scores add to NeutralPair AND NegativePair (the
+    reference's branch structure), correctly-ordered pairs to
+    PositivePair, else NegativePair; optional Accumulate* inputs chain
+    batches."""
+    score = ins["Score"][0]
+    label = ins["Label"][0].reshape(-1)
+    query = ins["QueryID"][0].reshape(-1)
+    w_in = (ins.get("Weight") or [None])[0]
+    col = int(attrs.get("column", -1))
+    if score.ndim == 1:
+        score = score[:, None]
+    s = score[:, col]
+    n = s.shape[0]
+    w = jnp.ones((n,), s.dtype) if w_in is None else \
+        w_in.reshape(-1).astype(s.dtype)
+
+    upper = jnp.triu(jnp.ones((n, n), bool), k=1)
+    same_q = query[:, None] == query[None, :]
+    diff_l = label[:, None] != label[None, :]
+    mask = (upper & same_q & diff_l).astype(s.dtype)
+    pw = (w[:, None] + w[None, :]) * 0.5
+    ds = s[:, None] - s[None, :]
+    dl = (label[:, None] - label[None, :]).astype(s.dtype)
+    eq = (ds == 0).astype(s.dtype)
+    pos_m = (ds * dl > 0).astype(s.dtype)
+    pos = jnp.sum(mask * pw * pos_m)
+    neg = jnp.sum(mask * pw * (1.0 - pos_m))
+    neu = jnp.sum(mask * pw * eq)
+    for slot, acc in (("AccumulatePositivePair", "pos"),
+                      ("AccumulateNegativePair", "neg"),
+                      ("AccumulateNeutralPair", "neu")):
+        v = (ins.get(slot) or [None])[0]
+        if v is not None:
+            if acc == "pos":
+                pos = pos + v.reshape(())
+            elif acc == "neg":
+                neg = neg + v.reshape(())
+            else:
+                neu = neu + v.reshape(())
+    return {"PositivePair": pos.reshape(1), "NegativePair": neg.reshape(1),
+            "NeutralPair": neu.reshape(1)}

@@ -619,3 +619,110 @@ def gaussian_random_batch_size_like(ins, attrs, ctx):
     std = float(attrs.get("std", 1.0))
     return {"Out": (jax.random.normal(ctx.rng(), tuple(shape)) * std +
                     mean).astype(_dt(attrs))}
+
+
+# ---------------------------------------------------------------------------
+# py_func — user-extensible host callback op
+# ---------------------------------------------------------------------------
+
+# callables registered by layers.py_func (reference: py_func_op.cc keeps a
+# global vector of py::objects indexed by the callable-id attrs)
+PY_FUNC_REGISTRY: list = []
+
+
+def register_py_func(fn) -> int:
+    PY_FUNC_REGISTRY.append(fn)
+    return len(PY_FUNC_REGISTRY) - 1
+
+
+def _py_func_grad(ins, attrs, ctx):
+    """reference: py_func_op.cc backward — calls the registered backward
+    callable with (forward inputs, forward outputs, output grads), minus
+    any names in skip_vars_in_backward_input; it returns grads for the
+    forward inputs in order (None → zeros)."""
+    from ..core.registry import (GRAD_PREFIX_IG, GRAD_PREFIX_IN,
+                                 GRAD_PREFIX_OG, GRAD_PREFIX_OUT)
+
+    xs = ins.get(GRAD_PREFIX_IN + "X", [])
+    outs = ins.get(GRAD_PREFIX_OUT + "Out", [])
+    ogs = ins.get(GRAD_PREFIX_OG + "Out", [])
+    bid = int(attrs.get("backward_callable_id", -1))
+    if bid < 0:
+        return {GRAD_PREFIX_IG + "X": [
+            None if x is None else jnp.zeros(jnp.shape(x),
+                                             jnp.result_type(x))
+            for x in xs]}
+    fn = PY_FUNC_REGISTRY[bid]
+    skip = set(attrs.get("backward_skip_vars", []) or [])
+    x_names = ctx.op.inputs.get(GRAD_PREFIX_IN + "X", [])
+    out_names = ctx.op.inputs.get(GRAD_PREFIX_OUT + "Out", [])
+    arg_vals, shapes = [], []
+    for name, v in list(zip(x_names, xs)) + list(zip(out_names, outs)):
+        if name not in skip and v is not None:
+            arg_vals.append(v)
+    for i, o in enumerate(outs):
+        g = ogs[i] if i < len(ogs) and ogs[i] is not None else \
+            jnp.zeros(jnp.shape(o), jnp.result_type(o))
+        arg_vals.append(g)
+    result_shapes = [jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x))
+                     for x in xs]
+
+    def host(*arrays):
+        res = fn(*arrays)
+        if res is None:
+            res = ()
+        if not isinstance(res, (tuple, list)):
+            res = (res,)
+        padded = []
+        for i, x in enumerate(xs):
+            r = res[i] if i < len(res) else None
+            if r is None:
+                r = np.zeros(np.shape(x), np.asarray(x).dtype)
+            padded.append(np.asarray(r).astype(result_shapes[i].dtype)
+                          .reshape(result_shapes[i].shape))
+        return tuple(padded)
+
+    gx = jax.pure_callback(host, tuple(result_shapes), *arg_vals)
+    return {GRAD_PREFIX_IG + "X": list(gx)}
+
+
+@register_op("py_func", grad=_py_func_grad)
+def py_func(ins, attrs, ctx):
+    """reference: py_func_op.cc — run a user-registered Python callable on
+    host as an op. TPU-native lowering: jax.pure_callback (jit/grad-safe
+    host escape); output shapes/dtypes come from the out vars the caller
+    declared (recorded by layers.py_func in out_shapes/out_dtypes)."""
+    fid = int(attrs["forward_callable_id"])
+    fn = PY_FUNC_REGISTRY[fid]
+    xs = [x for x in ins.get("X", []) if x is not None]
+    shapes = attrs.get("out_shapes", []) or []
+    dtypes = attrs.get("out_dtypes", []) or []
+    if not shapes:
+        # output-less debug hook: io_callback keeps the side effect alive
+        from jax.experimental import io_callback
+
+        io_callback(lambda *a: fn(*a), None, *xs, ordered=True)
+        return {}
+    def resolve(s):
+        s = [int(v) for v in s]
+        for i, v in enumerate(s):
+            if v < 0:
+                assert i == 0 and xs, (
+                    "py_func: only a -1 batch dim is resolvable; declare "
+                    "concrete trailing dims on the out var")
+                s[i] = xs[0].shape[0]
+        return tuple(s)
+
+    result_shapes = tuple(
+        jax.ShapeDtypeStruct(resolve(s), np.dtype(d))
+        for s, d in zip(shapes, dtypes))
+
+    def host(*arrays):
+        res = fn(*arrays)
+        if not isinstance(res, (tuple, list)):
+            res = (res,)
+        return tuple(np.asarray(r).astype(rs.dtype).reshape(rs.shape)
+                     for r, rs in zip(res, result_shapes))
+
+    outs = jax.pure_callback(host, result_shapes, *xs)
+    return {"Out": list(outs)}

@@ -163,6 +163,203 @@ def pool3d(ins, attrs, ctx):
     return {"Out": s / float(np.prod(ksize))}
 
 
+def _bilinear_sample_chw(x, ys, xs):
+    """Bilinear sample x [C,H,W] at float coords (ys, xs) of any shape;
+    out-of-range corners contribute 0 (the reference deformable kernels'
+    zero-padding semantics). Returns [C, *ys.shape]."""
+    h, w = x.shape[1:]
+    y0 = jnp.floor(ys)
+    x0 = jnp.floor(xs)
+    wy = (ys - y0).astype(x.dtype)
+    wx = (xs - x0).astype(x.dtype)
+
+    def gather(yy, xx):
+        inb = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+        yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+        return x[:, yc, xc] * inb.astype(x.dtype)
+
+    return (gather(y0, x0) * (1 - wy) * (1 - wx)
+            + gather(y0, x0 + 1) * (1 - wy) * wx
+            + gather(y0 + 1, x0) * wy * (1 - wx)
+            + gather(y0 + 1, x0 + 1) * wy * wx)
+
+
+def _deformable_conv(ins, attrs, modulated):
+    """reference: deformable_conv_op.h (v2, modulated) /
+    deformable_conv_v1_op.h — y(p) = sum_k w_k * x(p + p_k + dp_k) * dm_k.
+    TPU-native: bilinear gather of the K sampled taps into an im2col
+    column tensor, then one grouped einsum on the MXU (replaces the
+    reference's ModulatedDeformableIm2col + GEMM per image)."""
+    x = ins["Input"][0]                       # [N, C, H, W]
+    off = ins["Offset"][0]                    # [N, dg*K*2, OH, OW]
+    w = ins["Filter"][0]                      # [Cout, C/groups, kh, kw]
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    pads = [int(p) for p in attrs.get("paddings", [0, 0])]
+    dils = [int(d) for d in attrs.get("dilations", [1, 1])]
+    groups = int(attrs.get("groups", 1))
+    dg = int(attrs.get("deformable_groups", 1))
+    n, c, h_in, w_in = x.shape
+    cout, cg, kh, kw = w.shape
+    K = kh * kw
+    oh, ow = off.shape[2], off.shape[3]
+    cpg = c // dg
+
+    # base sampling grid: h = oh*stride - pad + ki*dilation (+ offset)
+    ki = (jnp.arange(K) // kw).astype(x.dtype)
+    kj = (jnp.arange(K) % kw).astype(x.dtype)
+    base_y = (jnp.arange(oh, dtype=x.dtype) * strides[0] - pads[0])
+    base_x = (jnp.arange(ow, dtype=x.dtype) * strides[1] - pads[1])
+    grid_y = base_y[None, :, None] + ki[:, None, None] * dils[0]  # [K,OH,1]
+    grid_x = base_x[None, None, :] + kj[:, None, None] * dils[1]  # [K,1,OW]
+
+    off = off.reshape(n, dg, K, 2, oh, ow)
+    if modulated:
+        mask = ins["Mask"][0].reshape(n, dg, K, oh, ow)
+    else:
+        mask = None
+
+    def per_image(xi, offi, maski):
+        def per_group(xg, og, mg):
+            ys = grid_y + og[:, 0]            # [K, OH, OW]
+            xs = grid_x + og[:, 1]
+            v = _bilinear_sample_chw(xg, ys, xs)   # [cpg, K, OH, OW]
+            return v if mg is None else v * mg[None].astype(v.dtype)
+        xg = xi.reshape(dg, cpg, h_in, w_in)
+        if maski is None:
+            cols = jax.vmap(lambda a, b: per_group(a, b, None))(xg, offi)
+        else:
+            cols = jax.vmap(per_group)(xg, offi, maski)
+        return cols.reshape(c, K, oh, ow)
+
+    if mask is None:
+        cols = jax.vmap(lambda a, b: per_image(a, b, None))(x, off)
+    else:
+        cols = jax.vmap(per_image)(x, off, mask)
+
+    cols_g = cols.reshape(n, groups, cg, K, oh, ow)
+    w_g = w.reshape(groups, cout // groups, cg, K).astype(cols.dtype)
+    out = jnp.einsum("ngckhw,gock->ngohw", cols_g, w_g)
+    return {"Output": out.reshape(n, cout, oh, ow)}
+
+
+@register_op("deformable_conv")
+def deformable_conv(ins, attrs, ctx):
+    return _deformable_conv(ins, attrs, modulated=True)
+
+
+@register_op("deformable_conv_v1")
+def deformable_conv_v1(ins, attrs, ctx):
+    return _deformable_conv(ins, attrs, modulated=False)
+
+
+def _max_pool_with_index(x, attrs, nd):
+    """Shared kernel for max_pool{2,3}d_with_index (reference:
+    pool_with_index_op.cc, math/pooling.cc MaxPool*WithIdxFunctor).
+    Mask = row-major flat index of the argmax within each channel's input
+    volume; argmax keeps the FIRST maximum in scan order, like the
+    reference's strict `<` comparison."""
+    spatial = x.shape[2:]
+    ksize = [int(k) for k in attrs.get("ksize", [2] * nd)]
+    if attrs.get("global_pooling", False):
+        ksize = list(spatial)
+    strides = [int(s) for s in attrs.get("strides", ksize)]
+    pads = [int(p) for p in attrs.get("paddings", [0] * nd)]
+    if attrs.get("global_pooling", False):
+        pads = [0] * nd
+    if attrs.get("adaptive", False):
+        # divisible adaptive bins (same convention as _pool2d)
+        out_sz = ksize
+        assert all(s % o == 0 for s, o in zip(spatial, out_sz)), \
+            "adaptive pool needs divisible dims"
+        ksize = [s // o for s, o in zip(spatial, out_sz)]
+        strides = ksize
+        pads = [0] * nd
+
+    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    xp = jnp.pad(x, [(0, 0), (0, 0)] + [(p, p) for p in pads],
+                 constant_values=neg)
+    # patches: [N, C*prod(k), *out_spatial], feature dim ordered (C, k...)
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, tuple(ksize), tuple(strides), "VALID")
+    n, c = x.shape[:2]
+    out_sp = patches.shape[2:]
+    K = int(np.prod(ksize))
+    patches = patches.reshape((n, c, K) + out_sp)
+    k_local = jnp.argmax(patches, axis=2)                    # [N, C, *out]
+    out = jnp.take_along_axis(patches, k_local[:, :, None], axis=2)[:, :, 0]
+
+    # local k -> global row-major input index (padding never wins: its
+    # value is dtype-min and every window overlaps >=1 real cell)
+    idx = jnp.zeros(k_local.shape, jnp.int32)
+    rem = k_local
+    for d in range(nd):
+        tail = int(np.prod(ksize[d + 1:]))
+        kd = rem // tail
+        rem = rem % tail
+        coord = jnp.arange(out_sp[d]) * strides[d] - pads[d]
+        shape = [1] * (2 + nd)
+        shape[2 + d] = out_sp[d]
+        g = coord.reshape(shape) + kd
+        idx = idx * spatial[d] + g.astype(jnp.int32)
+    return out, idx
+
+
+@register_op("max_pool2d_with_index", intermediate_outputs=())
+def max_pool2d_with_index(ins, attrs, ctx):
+    out, mask = _max_pool_with_index(ins["X"][0], attrs, 2)
+    return {"Out": out, "Mask": mask}
+
+
+@register_op("max_pool3d_with_index")
+def max_pool3d_with_index(ins, attrs, ctx):
+    out, mask = _max_pool_with_index(ins["X"][0], attrs, 3)
+    return {"Out": out, "Mask": mask}
+
+
+@register_op("unpool", nondiff_inputs=("Indices",))
+def unpool(ins, attrs, ctx):
+    """reference: unpool_op.cc ('max' unpooling) — scatter X into a zero
+    output at the row-major positions recorded by max_pool2d_with_index;
+    out_size = (in-1)*stride - 2*pad + ksize."""
+    x, idx = ins["X"][0], ins["Indices"][0]
+    n, c, h, w = x.shape
+    ksize = [int(k) for k in attrs.get("ksize", [2, 2])]
+    strides = [int(s) for s in attrs.get("strides", ksize)]
+    pads = [int(p) for p in attrs.get("paddings", [0, 0])]
+    oh = (h - 1) * strides[0] - 2 * pads[0] + ksize[0]
+    ow = (w - 1) * strides[1] - 2 * pads[1] + ksize[1]
+    flat = x.reshape(n * c, h * w)
+    idxf = idx.reshape(n * c, h * w).astype(jnp.int32)
+    rows = jnp.arange(n * c)[:, None]
+    out = jnp.zeros((n * c, oh * ow), x.dtype).at[rows, idxf].set(flat)
+    return {"Out": out.reshape(n, c, oh, ow)}
+
+
+@register_op("spp")
+def spp(ins, attrs, ctx):
+    """reference: spp_op.h — spatial pyramid pooling: levels p=0..H-1 pool
+    into 2^p x 2^p bins (kernel=ceil(dim/bins), pad=(k*bins-dim+1)/2),
+    flattened and concatenated along channels."""
+    x = ins["X"][0]
+    n, c, h, w = x.shape
+    height = int(attrs.get("pyramid_height", 1))
+    ptype = attrs.get("pooling_type", "max")
+    outs = []
+    for p in range(height):
+        bins = 2 ** p
+        kh = -(-h // bins)
+        kw = -(-w // bins)
+        ph_ = (kh * bins - h + 1) // 2
+        pw_ = (kw * bins - w + 1) // 2
+        lvl = _pool2d(x, {"pooling_type": ptype, "ksize": [kh, kw],
+                          "strides": [kh, kw], "paddings": [ph_, pw_],
+                          "exclusive": True})
+        outs.append(lvl.reshape(n, c * bins * bins))
+    return {"Out": jnp.concatenate(outs, axis=1)}
+
+
 # ---------------------------------------------------------------------------
 # Normalization
 # ---------------------------------------------------------------------------
@@ -451,15 +648,58 @@ def hinge_loss(ins, attrs, ctx):
 # ---------------------------------------------------------------------------
 
 
+def _linear_resize_weights(s, o, align_corners, align_mode, dtype):
+    """[o, s] interpolation-weight matrix for one axis (two taps per row).
+    Source positions follow interpolate_op.h: align_corners →
+    i*(s-1)/(o-1); align_mode 0 → (i+0.5)*s/o - 0.5; align_mode 1 →
+    i*s/o."""
+    if o == 1 or s == 1:
+        pos = jnp.zeros((o,), dtype)
+    elif align_corners:
+        pos = jnp.arange(o, dtype=dtype) * (s - 1) / (o - 1)
+    elif int(align_mode) == 0:
+        pos = (jnp.arange(o, dtype=dtype) + 0.5) * s / o - 0.5
+    else:
+        pos = jnp.arange(o, dtype=dtype) * s / o
+    pos = jnp.clip(pos, 0.0, s - 1)
+    lo = jnp.floor(pos).astype(jnp.int32)
+    hi = jnp.minimum(lo + 1, s - 1)
+    frac = (pos - lo).astype(dtype)
+    rows = jnp.arange(o)
+    return jnp.zeros((o, s), dtype).at[rows, lo].add(1.0 - frac) \
+        .at[rows, hi].add(frac)
+
+
 def _interp(ins, attrs, method):
-    x = ins["X"][0]  # NCHW
-    n, c, h, w = x.shape
-    if attrs.get("out_h", -1) > 0:
-        oh, ow = int(attrs["out_h"]), int(attrs["out_w"])
+    """reference: interpolate_op.h — separable linear resize honoring
+    align_corners/align_mode (each axis is one [O,S] weight matmul; XLA
+    fuses the chain onto the MXU). nearest keeps jax.image.resize."""
+    x = ins["X"][0]  # NC + spatial
+    n, c = x.shape[:2]
+    spatial = x.shape[2:]
+    nd = len(spatial)
+    keys = ("out_d", "out_h", "out_w")[-nd:]
+    given = [k for k in keys if attrs.get(k, -1) > 0]
+    if given:
+        assert len(given) == nd, (
+            f"interp on {nd}-D spatial input needs all of {keys}, "
+            f"got only {given}")
+        out_sp = tuple(int(attrs[k]) for k in keys)
     else:
         scale = attrs.get("scale", 1.0)
-        oh, ow = int(h * scale), int(w * scale)
-    out = jax.image.resize(x, (n, c, oh, ow), method=method)
+        out_sp = tuple(int(s * scale) for s in spatial)
+    if method == "nearest":
+        out = jax.image.resize(x, (n, c) + out_sp, method="nearest")
+        return {"Out": out.astype(x.dtype)}
+    ac = bool(attrs.get("align_corners", True))
+    am = int(attrs.get("align_mode", 1))
+    wdt = jnp.float32 if x.dtype == jnp.bfloat16 else x.dtype
+    out = x.astype(wdt)
+    for d in range(nd):
+        wm = _linear_resize_weights(spatial[d], out_sp[d], ac, am, wdt)
+        out = jnp.moveaxis(
+            jnp.tensordot(wm, jnp.moveaxis(out, 2 + d, 0), axes=([1], [0])),
+            0, 2 + d)
     return {"Out": out.astype(x.dtype)}
 
 
@@ -471,6 +711,13 @@ def bilinear_interp(ins, attrs, ctx):
 @register_op("nearest_interp")
 def nearest_interp(ins, attrs, ctx):
     return _interp(ins, attrs, "nearest")
+
+
+@register_op("trilinear_interp")
+def trilinear_interp(ins, attrs, ctx):
+    """reference: interpolate_op.cc trilinear branch — 5-D NCDHW linear
+    resize (resize_trilinear layer, nn.py:9716)."""
+    return _interp(ins, attrs, "trilinear")
 
 
 @register_op("grid_sampler")

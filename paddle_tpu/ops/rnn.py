@@ -124,6 +124,181 @@ def gru_v2(ins, attrs, ctx):
     return {"Hidden": hidden, "LastH": h_last}
 
 
+_ACTS = {
+    "identity": lambda x: x,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+}
+
+# gru_unit integer activation codes (gru_unit_op.h enum)
+_ACT_CODES = {0: "identity", 1: "sigmoid", 2: "tanh", 3: "relu"}
+
+
+@register_op("lstm_unit", nondiff_inputs=())
+def lstm_unit(ins, attrs, ctx):
+    """reference: lstm_unit_op.h:63-71 — single LSTM step on pre-projected
+    gates X [B, 4D] in (i, f, o, j) order:
+    C = C_prev*sigm(f+forget_bias) + sigm(i)*tanh(j); H = sigm(o)*tanh(C).
+    """
+    x = ins["X"][0]
+    c_prev = ins["C_prev"][0]
+    fb = float(attrs.get("forget_bias", 0.0))
+    i, f, o, j = jnp.split(x, 4, axis=-1)
+    c = c_prev * jax.nn.sigmoid(f + fb) + jax.nn.sigmoid(i) * jnp.tanh(j)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {"C": c, "H": h}
+
+
+@register_op("gru_unit", nondiff_inputs=())
+def gru_unit(ins, attrs, ctx):
+    """reference: gru_unit_op.h — single GRU step. Input [B,3D] is the
+    pre-projected x; Weight [D,3D] = [W_update|W_reset | W_candidate];
+    Gate output holds the activated (u, r, c) triple."""
+    x = ins["Input"][0]
+    h_p = ins["HiddenPrev"][0]
+    w = ins["Weight"][0]
+    b = (ins.get("Bias") or [None])[0]
+    D = h_p.shape[1]
+    act = _ACTS[_ACT_CODES[int(attrs.get("activation", 2))]]
+    gate_act = _ACTS[_ACT_CODES[int(attrs.get("gate_activation", 1))]]
+    g = x if b is None else x + b.reshape(1, -1)
+    g_ur = g[:, :2 * D] + h_p @ w[:, :2 * D]
+    u = gate_act(g_ur[:, :D])
+    r = gate_act(g_ur[:, D:])
+    r_h_p = r * h_p
+    c = act(g[:, 2 * D:] + r_h_p @ w[:, 2 * D:])
+    if bool(attrs.get("origin_mode", False)):
+        h = c + u * (h_p - c)          # (1-u)*c + u*h_p
+    else:
+        h = u * (c - h_p) + h_p        # u*c + (1-u)*h_p
+    return {"Gate": jnp.concatenate([u, r, c], axis=1),
+            "ResetHiddenPrev": r_h_p, "Hidden": h}
+
+
+@register_op("lstmp_v2", nondiff_inputs=())
+def lstmp_v2(ins, attrs, ctx):
+    """reference: lstmp_op.h — LSTM with recurrent projection (LSTMP,
+    Sak et al.): gates = x_t + r_{t-1} @ Weight[P,4D]; standard cell;
+    r_t = proj_act(h_t @ ProjWeight[D,P]) with optional cell/proj clip.
+    Padded-batch: Input [N,T,4D] pre-projected (the dynamic_lstm input
+    contract); gate slice order c̃,i,f,o as in _lstm_scan. use_peepholes
+    is not supported (documented refusal: peephole weights are a
+    cuDNN-era micro-optimisation with no TPU benefit)."""
+    x = ins["Input"][0]                        # [N, T, 4D]
+    w = ins["Weight"][0]                       # [P, 4D]
+    pw = ins["ProjWeight"][0]                  # [D, P]
+    b = (ins.get("Bias") or [None])[0]
+    assert not bool(attrs.get("use_peepholes", False)), \
+        "lstmp_v2: use_peepholes not supported"
+    D = pw.shape[0]
+    P = pw.shape[1]
+    N = x.shape[0]
+    cell_clip = float(attrs.get("cell_clip", 0.0))
+    proj_clip = float(attrs.get("proj_clip", 0.0))
+    gate_act = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACTS[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACTS[attrs.get("candidate_activation", "tanh")]
+    proj_act = _ACTS[attrs.get("proj_activation", "tanh")]
+    if bool(attrs.get("is_reverse", False)):
+        x = jnp.flip(x, axis=1)
+    if b is not None:
+        x = x + b.reshape(1, 1, -1)
+    h0 = (ins.get("H0") or [None])[0]
+    c0 = (ins.get("C0") or [None])[0]
+    r0 = jnp.zeros((N, P), x.dtype) if h0 is None else \
+        proj_act(h0 @ pw)
+    c0 = jnp.zeros((N, D), x.dtype) if c0 is None else c0
+
+    def step(carry, xt):
+        r, c = carry
+        gates = xt + r @ w
+        g, i, f, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        c = f * c + i * cand_act(g)
+        if cell_clip > 0:
+            c = jnp.clip(c, -cell_clip, cell_clip)
+        h = o * cell_act(c)
+        r = proj_act(h @ pw)
+        if proj_clip > 0:
+            r = jnp.clip(r, -proj_clip, proj_clip)
+        return (r, c), (r, c)
+
+    xs = jnp.swapaxes(x, 0, 1)
+    _, (rs, cs) = jax.lax.scan(step, (r0, c0), xs)
+    proj = jnp.swapaxes(rs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    if bool(attrs.get("is_reverse", False)):
+        proj = jnp.flip(proj, axis=1)
+        cell = jnp.flip(cell, axis=1)
+    return {"Projection": proj, "Cell": cell}
+
+
+@register_op("attention_lstm", nondiff_inputs=(),
+             intermediate_outputs=("AttentionedX", "AttentionFCOut",
+                                   "LSTMX", "LSTMOUT"))
+def attention_lstm(ins, attrs, ctx):
+    """reference: attention_lstm_op.cc — fused attention LSTM: at each
+    output step, scores = relu(x@Wa[:M] + dot(c_prev, Wa[M:]) (+scalar
+    stage)), softmaxed over the sequence, pool x with them into lstm_x,
+    then one LSTM step whose weight layout is rows [0:D]=hidden,
+    [D:D+M]=x and gate order (f, i, o, c̃). Padded-batch: X [N,T,M] with
+    optional SeqLen [N]; one lax.scan emits hidden/cell per step."""
+    x = ins["X"][0]                            # [N, T, M]
+    c0 = ins["C0"][0]
+    h0 = (ins.get("H0") or [None])[0]
+    wa = ins["AttentionWeight"][0].reshape(-1)  # [M+D]
+    ba = (ins.get("AttentionBias") or [None])[0]
+    sc = (ins.get("AttentionScalar") or [None])[0]
+    scb = (ins.get("AttentionScalarBias") or [None])[0]
+    lw = ins["LSTMWeight"][0]                  # [D+M, 4D]
+    lb = ins["LSTMBias"][0].reshape(-1)        # [4D]
+    seq_len = (ins.get("SeqLen") or [None])[0]
+    n, t, m = x.shape
+    d = c0.shape[1]
+    gate_act = _ACTS[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACTS[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACTS[attrs.get("candidate_activation", "tanh")]
+
+    atted_x = jnp.einsum("ntm,m->nt", x, wa[:m])
+    if ba is not None:
+        atted_x = atted_x + ba.reshape(())
+    valid = jnp.ones((n, t), bool) if seq_len is None else \
+        jnp.arange(t)[None, :] < seq_len.reshape(-1, 1)
+    h_prev = jnp.zeros((n, d), x.dtype) if h0 is None else h0
+
+    def step(carry, _):
+        h, c = carry
+        score = jax.nn.relu(atted_x + (c @ wa[m:])[:, None])   # [N, T]
+        if sc is not None:
+            score = score * sc.reshape(())
+            if scb is not None:
+                score = score + scb.reshape(())
+            score = jax.nn.relu(score)
+        # finite mask value: an all-padded row (SeqLen 0) softmaxes to a
+        # uniform distribution instead of NaN
+        score = jnp.where(valid, score, -1e30)
+        att = jax.nn.softmax(score, axis=1)
+        lstm_x = jnp.einsum("nt,ntm->nm", att, x)
+        gates = lstm_x @ lw[d:] + h @ lw[:d] + lb
+        f, i, o = (gate_act(gates[:, :d]), gate_act(gates[:, d:2 * d]),
+                   gate_act(gates[:, 2 * d:3 * d]))
+        cand = cand_act(gates[:, 3 * d:])
+        c = f * c + i * cand
+        h = cell_act(c) * o
+        return (h, c), (h, c, att, lstm_x)
+
+    (_, _), (hs, cs, atts, lxs) = jax.lax.scan(
+        step, (h_prev, c0), None, length=t)
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    return {"Hidden": hidden, "Cell": cell,
+            "AttentionedX": atted_x[..., None],
+            "AttentionFCOut": jnp.swapaxes(atts, 0, 1)[..., None],
+            "LSTMX": jnp.swapaxes(lxs, 0, 1),
+            "LSTMOUT": jnp.concatenate([hidden, cell], axis=-1)}
+
+
 @register_op("dynamic_gru_v2", nondiff_inputs=())
 def dynamic_gru_v2(ins, attrs, ctx):
     x = ins["Input"][0]                      # [N, T, 3H]

@@ -460,11 +460,45 @@ def argsort(ins, attrs, ctx):
     return {"Out": out, "Indices": idx.astype(jnp.int64)}
 
 
+def _unique_static(x):
+    """jit-safe unique in FIRST-OCCURRENCE order (the reference
+    unique_op.h appends values on first sight). Static shapes: all
+    outputs are length N; slots past the true unique count carry value 0
+    and count 0 (count > 0 marks valid slots — every real unique value
+    occurs at least once)."""
+    n = x.shape[0]
+    vals, inv, counts = jnp.unique(
+        x, size=n, return_inverse=True, return_counts=True, fill_value=0)
+    inv = inv.reshape(-1)
+    # first original position of each sorted-unique slot; padded slots n
+    first_occ = jnp.full((n,), n, jnp.int32).at[inv].min(
+        jnp.arange(n, dtype=jnp.int32))
+    order = jnp.argsort(first_occ)         # occurrence order, pads last
+    out = vals[order]
+    counts_o = counts[order]
+    remap = jnp.argsort(order)
+    index = remap[inv]
+    return out, index, counts_o
+
+
 @register_op("unique", grad=None, nondiff_inputs=("X",))
 def unique(ins, attrs, ctx):
-    x = _x(ins)
-    out, idx = np.unique(np.asarray(x), return_inverse=True)
-    return {"Out": jnp.asarray(out), "Index": jnp.asarray(idx.astype(np.int64))}
+    """reference: unique_op.h — 1-D unique + per-element index into the
+    unique list. Static-shape convention: see _unique_static."""
+    x = _x(ins).reshape(-1)
+    out, index, _ = _unique_static(x)
+    return {"Out": out, "Index": index.astype(jnp.int64)}
+
+
+@register_op("unique_with_counts", grad=None, nondiff_inputs=("X",))
+def unique_with_counts(ins, attrs, ctx):
+    """reference: unique_with_counts_op.cc — unique + Index + per-unique
+    Count. Same static-shape convention as `unique` (Count==0 marks
+    padding slots)."""
+    x = _x(ins).reshape(-1)
+    out, index, counts = _unique_static(x)
+    return {"Out": out, "Index": index.astype(jnp.int64),
+            "Count": counts.astype(jnp.int64)}
 
 
 # ---------------------------------------------------------------------------

@@ -248,3 +248,30 @@ def tree_conv(ins, attrs, ctx):
 
     out = jax.vmap(one)(nodes, edges)
     return {"Out": jnp.tanh(out)}
+
+
+@register_op("filter_by_instag", nondiff_inputs=("Ins_tag", "Filter_tag"))
+def filter_by_instag(ins, attrs, ctx):
+    """reference: filter_by_instag_op.h — keep instances whose tag list
+    intersects Filter_tag. Static shapes: kept rows compact to the top
+    (zero-padded below), LossWeight marks kept rows 1.0/0.0, IndexMap
+    row i holds [i, original_row] for kept rows (-1 padding). Ins_tag is
+    the padded [N, T] tag matrix (LoD→padded, SURVEY §5); pad with any
+    value not in Filter_tag (e.g. -1)."""
+    x = ins["Ins"][0]                    # [N, D]
+    tags = ins["Ins_tag"][0]             # [N, T] padded
+    filt = ins["Filter_tag"][0].reshape(-1)
+    if tags.ndim == 1:
+        tags = tags[:, None]
+    n = x.shape[0]
+    hit = (tags[:, :, None] == filt[None, None, :]).any((1, 2))   # [N]
+    order = jnp.argsort(jnp.where(hit, 0, 1), stable=True)
+    kept_rows = jnp.where(hit[order][:, None], x[order], 0.0)
+    n_kept = jnp.sum(hit.astype(jnp.int32))
+    valid = jnp.arange(n) < n_kept
+    index_map = jnp.where(
+        valid[:, None],
+        jnp.stack([jnp.arange(n), order], axis=1), -1).astype(jnp.int64)
+    loss_weight = valid.astype(x.dtype)[:, None]
+    return {"Out": kept_rows, "LossWeight": loss_weight,
+            "IndexMap": index_map}

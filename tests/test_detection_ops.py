@@ -595,3 +595,149 @@ def test_detection_map_difficult_gt():
     m2.update([[1, 0.9, 0, 0, 10, 10]],
               [[1, 0, 0, 10, 10, 1], [1, 30, 30, 40, 40, 0]])
     assert m2.eval() == 0.5
+
+
+def test_prroi_pool_matches_dense_integration():
+    """prroi_pool's closed-form tent integral vs brute-force numerical
+    integration of the bilinear surface (reference: prroi_pool_op.h)."""
+    rng = np.random.RandomState(12)
+    oc, ph, pw = 2, 2, 2
+    H = W = 6
+    x = rng.randn(1, oc * ph * pw, H, W).astype("float64")
+    rois = np.array([[0.7, 0.9, 4.3, 5.1], [1.0, 1.0, 3.0, 3.0]], "float64")
+    out = run_op("prroi_pool", {"X": x, "ROIs": rois},
+                 {"pooled_height": ph, "pooled_width": pw,
+                  "spatial_scale": 1.0, "output_channels": oc})["Out"][0]
+
+    def bilinear(c_map, y, xx):
+        y0, x0 = int(np.floor(y)), int(np.floor(xx))
+        val = 0.0
+        for dy in (0, 1):
+            for dx in (0, 1):
+                hy, wx = y0 + dy, x0 + dx
+                wgt = (1 - abs(y - hy)) * (1 - abs(xx - wx))
+                if 0 <= hy < H and 0 <= wx < W and wgt > 0:
+                    val += wgt * c_map[hy, wx]
+        return val
+
+    S = 50
+    for r, roi in enumerate(rois):
+        x1, y1, x2, y2 = roi
+        bh, bw = (y2 - y1) / ph, (x2 - x1) / pw
+        for c in range(oc):
+            for i in range(ph):
+                for j in range(pw):
+                    cmap = x[0, (c * ph + i) * pw + j]
+                    ys = y1 + i * bh + (np.arange(S) + 0.5) * bh / S
+                    xs = x1 + j * bw + (np.arange(S) + 0.5) * bw / S
+                    acc = np.mean([bilinear(cmap, yy, xx)
+                                   for yy in ys for xx in xs])
+                    np.testing.assert_allclose(out[r, c, i, j], acc,
+                                               rtol=2e-3, atol=2e-3)
+    check_grad("prroi_pool", {"X": x, "ROIs": rois},
+               {"pooled_height": ph, "pooled_width": pw,
+                "spatial_scale": 1.0, "output_channels": oc},
+               inputs_to_check=["X"])
+
+
+def _np_deformable_psroi(x, rois, trans, attrs):
+    """Sequential port of DeformablePSROIPoolForwardCPUKernel semantics."""
+    scale = attrs["spatial_scale"]
+    od = attrs["output_dim"]
+    gh_, gw_ = attrs["group_size"]
+    ph, pw = attrs["pooled_height"], attrs["pooled_width"]
+    part_h, part_w = attrs["part_size"]
+    spp = attrs["sample_per_part"]
+    tstd = attrs["trans_std"]
+    no_trans = attrs.get("no_trans", trans is None)
+    H, W = x.shape[2], x.shape[3]
+    n_classes = 1 if no_trans else trans.shape[1] // 2
+    ceach = od // n_classes
+    R = rois.shape[0]
+    out = np.zeros((R, od, ph, pw))
+    cnt = np.zeros((R, od, ph, pw))
+
+    def bil(m, y, xx):
+        y0, x0 = int(np.floor(y)), int(np.floor(xx))
+        v = 0.0
+        for dy in (0, 1):
+            for dx in (0, 1):
+                yy, xw = y0 + dy, x0 + dx
+                wgt = (1 - abs(y - yy)) * (1 - abs(xx - xw))
+                if 0 <= yy < H and 0 <= xw < W and wgt > 0:
+                    v += wgt * m[yy, xw]
+        return v
+
+    for r in range(R):
+        rsw = round(rois[r, 0]) * scale - 0.5
+        rsh = round(rois[r, 1]) * scale - 0.5
+        rew = (round(rois[r, 2]) + 1.0) * scale - 0.5
+        reh = (round(rois[r, 3]) + 1.0) * scale - 0.5
+        rw, rh = max(rew - rsw, 0.1), max(reh - rsh, 0.1)
+        bh, bw = rh / ph, rw / pw
+        for c in range(od):
+            cls = c // ceach
+            for i in range(ph):
+                for j in range(pw):
+                    pi = int(np.floor(float(i) / ph * part_h))
+                    pj = int(np.floor(float(j) / pw * part_w))
+                    tx = 0.0 if no_trans else trans[r, cls * 2, pi, pj] * tstd
+                    ty = 0.0 if no_trans else \
+                        trans[r, cls * 2 + 1, pi, pj] * tstd
+                    hs = i * bh + rsh + ty * rh
+                    ws = j * bw + rsw + tx * rw
+                    gh = min(max(int(np.floor(i * gh_ / ph)), 0), gh_ - 1)
+                    gw = min(max(int(np.floor(j * gw_ / pw)), 0), gw_ - 1)
+                    m = x[0, (c * gh_ + gh) * gw_ + gw]
+                    s, n_ok = 0.0, 0
+                    for ih in range(spp):
+                        for iw in range(spp):
+                            y = hs + ih * bh / spp
+                            xx = ws + iw * bw / spp
+                            if (y < -0.5 or y > H - 0.5 or xx < -0.5
+                                    or xx > W - 0.5):
+                                continue
+                            y = min(max(y, 0.0), H - 1.0)
+                            xx = min(max(xx, 0.0), W - 1.0)
+                            s += bil(m, y, xx)
+                            n_ok += 1
+                    out[r, c, i, j] = 0.0 if n_ok == 0 else s / n_ok
+                    cnt[r, c, i, j] = n_ok
+    return out, cnt
+
+
+def test_deformable_psroi_pooling_matches_numpy():
+    rng = np.random.RandomState(13)
+    od, gh_, gw_, ph, pw = 2, 2, 2, 2, 2
+    H = W = 8
+    x = rng.randn(1, od * gh_ * gw_, H, W).astype("float64")
+    rois = np.array([[1.0, 1.0, 6.0, 6.0], [0.0, 2.0, 5.0, 7.0]], "float64")
+    trans = (rng.rand(2, 2, ph, pw) * 0.6 - 0.3).astype("float64")
+    attrs = {"spatial_scale": 1.0, "output_dim": od,
+             "group_size": [gh_, gw_], "pooled_height": ph,
+             "pooled_width": pw, "part_size": [ph, pw],
+             "sample_per_part": 3, "trans_std": 0.1, "no_trans": False}
+    got = run_op("deformable_psroi_pooling",
+                 {"Input": x, "ROIs": rois, "Trans": trans}, attrs,
+                 outputs=("Output", "TopCount"))
+    want, want_cnt = _np_deformable_psroi(x, rois, trans, attrs)
+    np.testing.assert_allclose(got["Output"][0], want, rtol=1e-8, atol=1e-10)
+    np.testing.assert_allclose(got["TopCount"][0], want_cnt)
+    check_grad("deformable_psroi_pooling",
+               {"Input": x, "ROIs": rois, "Trans": trans}, attrs,
+               inputs_to_check=["Input", "Trans"], output_name="Output",
+               max_relative_error=2e-2)
+
+
+def test_deformable_psroi_pooling_no_trans():
+    rng = np.random.RandomState(14)
+    x = rng.randn(1, 4, 6, 6).astype("float64")
+    rois = np.array([[0.0, 0.0, 5.0, 5.0]], "float64")
+    attrs = {"spatial_scale": 1.0, "output_dim": 4, "group_size": [1, 1],
+             "pooled_height": 2, "pooled_width": 2, "part_size": [2, 2],
+             "sample_per_part": 4, "trans_std": 0.1, "no_trans": True}
+    got = run_op("deformable_psroi_pooling",
+                 {"Input": x, "ROIs": rois}, attrs,
+                 outputs=("Output", "TopCount"))
+    want, _ = _np_deformable_psroi(x, rois, None, attrs)
+    np.testing.assert_allclose(got["Output"][0], want, rtol=1e-8, atol=1e-10)

@@ -130,3 +130,22 @@ def test_dropout_is_test_flag(rng):
     infer_out = exe.run(infer, feed={"x": X}, fetch_list=[out])[0]
     assert (np.asarray(train_out) == 0).any()
     np.testing.assert_allclose(infer_out, X)
+
+
+def test_deformable_conv_layer(rng):
+    import paddle_tpu as pt
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("dx", shape=[4, 6, 6], dtype="float32")
+        off = pt.layers.data("doff", shape=[18, 6, 6], dtype="float32")
+        msk = pt.layers.data("dmsk", shape=[9, 6, 6], dtype="float32")
+        y = pt.layers.deformable_conv(x, off, msk, num_filters=5,
+                                      filter_size=3, padding=1)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    out = exe.run(main, feed={
+        "dx": rng.rand(2, 4, 6, 6).astype("float32"),
+        "doff": np.zeros((2, 18, 6, 6), "float32"),
+        "dmsk": np.ones((2, 9, 6, 6), "float32")},
+        fetch_list=[y.name])[0]
+    assert out.shape == (2, 5, 6, 6)

@@ -192,3 +192,230 @@ def test_one_hot():
     want = np.zeros((3, 4), "float32")
     want[np.arange(3), ids[:, 0]] = 1
     np.testing.assert_allclose(got.reshape(3, 4), want)
+
+
+# ---------------------------------------------------------------------------
+# pool_with_index / unpool / spp / trilinear_interp (round-2 op families)
+# ---------------------------------------------------------------------------
+
+
+def _np_max_pool2d_with_index(x, ksize, strides, pads):
+    n, c, h, w = x.shape
+    kh, kw = ksize
+    sh, sw = strides
+    ph, pw = pads
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    out = np.full((n, c, oh, ow), -np.inf, x.dtype)
+    mask = np.zeros((n, c, oh, ow), np.int32)
+    for ni in range(n):
+        for ci in range(c):
+            for i in range(oh):
+                for j in range(ow):
+                    best, besti = -np.inf, 0
+                    for a in range(kh):
+                        for b in range(kw):
+                            hh = i * sh - ph + a
+                            ww = j * sw - pw + b
+                            if 0 <= hh < h and 0 <= ww < w:
+                                v = x[ni, ci, hh, ww]
+                                if v > best:
+                                    best, besti = v, hh * w + ww
+                    out[ni, ci, i, j] = best
+                    mask[ni, ci, i, j] = besti
+    return out, mask
+
+
+def test_max_pool2d_with_index_matches_numpy():
+    rng = np.random.RandomState(7)
+    # well-separated values: finite differences across an argmax are only
+    # valid when no two window entries are within the probe delta
+    x = rng.permutation(2 * 3 * 7 * 6).astype("float64").reshape(2, 3, 7, 6)
+    x = x / 10.0
+    attrs = {"ksize": [3, 2], "strides": [2, 2], "paddings": [1, 0]}
+    got = run_op("max_pool2d_with_index", {"X": x}, attrs,
+                 outputs=("Out", "Mask"))
+    want_out, want_mask = _np_max_pool2d_with_index(
+        x, [3, 2], [2, 2], [1, 0])
+    np.testing.assert_allclose(got["Out"][0], want_out)
+    np.testing.assert_array_equal(got["Mask"][0], want_mask)
+    check_grad("max_pool2d_with_index", {"X": x}, attrs,
+               inputs_to_check=["X"])
+
+
+def test_max_pool3d_with_index_shapes_and_mask():
+    rng = np.random.RandomState(8)
+    x = rng.randn(1, 2, 4, 4, 4).astype("float64")
+    got = run_op("max_pool3d_with_index", {"X": x},
+                 {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                  "paddings": [0, 0, 0]}, outputs=("Out", "Mask"))
+    out, mask = got["Out"][0], got["Mask"][0]
+    assert out.shape == (1, 2, 2, 2, 2)
+    # each mask entry must address the max within its own 2x2x2 window
+    flatx = x.reshape(1, 2, -1)
+    np.testing.assert_allclose(
+        np.take_along_axis(flatx, mask.reshape(1, 2, -1), axis=2),
+        out.reshape(1, 2, -1))
+    np.testing.assert_allclose(out[0, 0, 0, 0, 0], x[0, 0, :2, :2, :2].max())
+
+
+def test_unpool_roundtrip():
+    rng = np.random.RandomState(9)
+    x = rng.randn(2, 3, 8, 8).astype("float64")
+    attrs = {"ksize": [2, 2], "strides": [2, 2], "paddings": [0, 0]}
+    pooled = run_op("max_pool2d_with_index", {"X": x}, attrs,
+                    outputs=("Out", "Mask"))
+    up = run_op("unpool", {"X": pooled["Out"][0],
+                           "Indices": pooled["Mask"][0]}, attrs)["Out"][0]
+    assert up.shape == x.shape
+    # unpooled values land exactly at their argmax positions
+    nz = up != 0
+    np.testing.assert_allclose(up[nz], x[nz])
+    assert nz.sum() == pooled["Out"][0].size
+    check_grad("unpool", {"X": pooled["Out"][0],
+                          "Indices": pooled["Mask"][0]}, attrs,
+               inputs_to_check=["X"])
+
+
+def test_spp_levels_and_values():
+    rng = np.random.RandomState(10)
+    x = rng.randn(2, 3, 8, 8).astype("float64")
+    out = run_op("spp", {"X": x}, {"pyramid_height": 2,
+                                   "pooling_type": "max"})["Out"][0]
+    assert out.shape == (2, 3 * (1 + 4))
+    np.testing.assert_allclose(out[:, :3], x.max(axis=(2, 3)))
+    # level 1: 2x2 bins of the 8x8 map
+    np.testing.assert_allclose(out[0, 3], x[0, 0, :4, :4].max())
+    check_grad("spp", {"X": x}, {"pyramid_height": 2,
+                                 "pooling_type": "avg"},
+               inputs_to_check=["X"])
+
+
+def test_trilinear_interp():
+    rng = np.random.RandomState(11)
+    x = rng.rand(1, 2, 2, 2, 2).astype("float64")
+    out = run_op("trilinear_interp", {"X": x},
+                 {"out_d": 3, "out_h": 3, "out_w": 3,
+                  "align_corners": True})["Out"][0]
+    assert out.shape == (1, 2, 3, 3, 3)
+    # align_corners=True maps input corners to output corners exactly
+    np.testing.assert_allclose(out[:, :, ::2, ::2, ::2], x, rtol=1e-12)
+    # the center is the mean of all 8 corners
+    np.testing.assert_allclose(out[0, 0, 1, 1, 1], x[0, 0].mean(), rtol=1e-12)
+    assert out.min() >= x.min() - 1e-9 and out.max() <= x.max() + 1e-9
+    check_grad("trilinear_interp", {"X": x},
+               {"out_d": 3, "out_h": 3, "out_w": 3}, inputs_to_check=["X"])
+
+
+def test_bilinear_interp_align_modes():
+    """interpolate_op.h source-position conventions: align_corners=True
+    maps corners to corners; align_mode=0 is half-pixel."""
+    x = np.arange(4, dtype="float64").reshape(1, 1, 2, 2)
+    got = run_op("bilinear_interp", {"X": x},
+                 {"out_h": 4, "out_w": 4, "align_corners": True})["Out"][0]
+    np.testing.assert_allclose(got[0, 0, ::3, ::3], x[0, 0], rtol=1e-12)
+    np.testing.assert_allclose(got[0, 0, 0],
+                               [0.0, 1 / 3, 2 / 3, 1.0], rtol=1e-10)
+    got0 = run_op("bilinear_interp", {"X": x},
+                  {"out_h": 4, "out_w": 4, "align_corners": False,
+                   "align_mode": 0})["Out"][0]
+    # half-pixel: src = (i+0.5)/2 - 0.5 -> [0, .25, .75, 1] clipped
+    np.testing.assert_allclose(got0[0, 0, 0],
+                               [0.0, 0.25, 0.75, 1.0], rtol=1e-10)
+
+
+# ---------------------------------------------------------------------------
+# deformable conv family
+# ---------------------------------------------------------------------------
+
+
+def test_deformable_conv_zero_offset_equals_conv2d():
+    """With zero offsets and unit mask, deformable conv reduces exactly to
+    standard convolution (reference deformable_conv_op.h comment)."""
+    rng = np.random.RandomState(20)
+    n, c, h, w = 2, 4, 7, 7
+    cout, kh, kw = 6, 3, 3
+    x = rng.randn(n, c, h, w).astype("float64")
+    wgt = rng.randn(cout, c, kh, kw).astype("float64")
+    attrs = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 1, "deformable_groups": 1}
+    off = np.zeros((n, 2 * kh * kw, h, w), "float64")
+    mask = np.ones((n, kh * kw, h, w), "float64")
+    got = run_op("deformable_conv",
+                 {"Input": x, "Offset": off, "Mask": mask, "Filter": wgt},
+                 attrs, outputs=("Output",))["Output"][0]
+    want = run_op("conv2d", {"Input": x, "Filter": wgt},
+                  attrs, outputs=("Output",))["Output"][0]
+    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-10)
+    # v1 (no mask) identical
+    got1 = run_op("deformable_conv_v1",
+                  {"Input": x, "Offset": off, "Filter": wgt},
+                  attrs, outputs=("Output",))["Output"][0]
+    np.testing.assert_allclose(got1, want, rtol=1e-10, atol=1e-10)
+
+
+def test_deformable_conv_integer_offset_shifts_sampling():
+    """Constant integer offset (dy=0, dx=1) samples the input shifted left
+    by one column (zeros flowing in at the right edge)."""
+    rng = np.random.RandomState(21)
+    n, c, h, w = 1, 2, 5, 5
+    x = rng.randn(n, c, h, w).astype("float64")
+    wgt = rng.randn(3, c, 1, 1).astype("float64")
+    attrs = {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+             "groups": 1, "deformable_groups": 1}
+    off = np.zeros((n, 2, h, w), "float64")
+    off[:, 1] = 1.0                               # w-offset channel
+    mask = np.ones((n, 1, h, w), "float64")
+    got = run_op("deformable_conv",
+                 {"Input": x, "Offset": off, "Mask": mask, "Filter": wgt},
+                 attrs, outputs=("Output",))["Output"][0]
+    x_shift = np.concatenate([x[..., 1:], np.zeros_like(x[..., :1])], -1)
+    want = np.einsum("nchw,oc->nohw", x_shift, wgt[:, :, 0, 0])
+    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-10)
+    # mask scales multiplicatively
+    got_half = run_op("deformable_conv",
+                      {"Input": x, "Offset": off, "Mask": 0.5 * mask,
+                       "Filter": wgt}, attrs,
+                      outputs=("Output",))["Output"][0]
+    np.testing.assert_allclose(got_half, 0.5 * want, rtol=1e-10)
+
+
+def test_deformable_conv_grads():
+    rng = np.random.RandomState(22)
+    n, c, h, w = 1, 2, 5, 5
+    x = rng.randn(n, c, h, w).astype("float64")
+    wgt = rng.randn(2, c, 3, 3).astype("float64")
+    # fractional offsets keep fd away from the bilinear floor kinks
+    off = (rng.rand(n, 2 * 9, h, w) * 0.4 + 0.13).astype("float64")
+    mask = (rng.rand(n, 9, h, w) * 0.5 + 0.25).astype("float64")
+    attrs = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": 1, "deformable_groups": 1}
+    check_grad("deformable_conv",
+               {"Input": x, "Offset": off, "Mask": mask, "Filter": wgt},
+               attrs, inputs_to_check=["Input", "Offset", "Mask", "Filter"],
+               output_name="Output", max_relative_error=2e-2)
+    check_grad("deformable_conv_v1",
+               {"Input": x, "Offset": off, "Filter": wgt},
+               attrs, inputs_to_check=["Input", "Offset", "Filter"],
+               output_name="Output", max_relative_error=2e-2)
+
+
+def test_deformable_conv_groups_and_deformable_groups():
+    rng = np.random.RandomState(23)
+    n, c, h, w = 1, 4, 6, 6
+    dg, groups = 2, 2
+    kh = kw = 3
+    x = rng.randn(n, c, h, w).astype("float64")
+    wgt = rng.randn(4, c // groups, kh, kw).astype("float64")
+    off = np.zeros((n, dg * 2 * kh * kw, h, w), "float64")
+    mask = np.ones((n, dg * kh * kw, h, w), "float64")
+    attrs = {"strides": [1, 1], "paddings": [1, 1], "dilations": [1, 1],
+             "groups": groups, "deformable_groups": dg}
+    got = run_op("deformable_conv",
+                 {"Input": x, "Offset": off, "Mask": mask, "Filter": wgt},
+                 attrs, outputs=("Output",))["Output"][0]
+    want = run_op("conv2d", {"Input": x, "Filter": wgt},
+                  {"strides": [1, 1], "paddings": [1, 1],
+                   "dilations": [1, 1], "groups": groups},
+                  outputs=("Output",))["Output"][0]
+    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-10)

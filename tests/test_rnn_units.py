@@ -1,0 +1,184 @@
+"""RNN unit / lstmp / attention_lstm op tests vs numpy references
+(reference: unittests/test_lstm_unit_op.py, test_gru_unit_op.py,
+test_lstmp_op.py, test_attention_lstm_op.py)."""
+
+import numpy as np
+
+from op_test import check_grad, run_op
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_lstm_unit_matches_formula():
+    rng = np.random.RandomState(0)
+    b, d = 4, 5
+    x = rng.randn(b, 4 * d).astype("float64")
+    c_prev = rng.randn(b, d).astype("float64")
+    out = run_op("lstm_unit", {"X": x, "C_prev": c_prev},
+                 {"forget_bias": 0.5}, outputs=("C", "H"))
+    i, f, o, j = np.split(x, 4, axis=1)
+    c = c_prev * _sig(f + 0.5) + _sig(i) * np.tanh(j)
+    h = _sig(o) * np.tanh(c)
+    np.testing.assert_allclose(out["C"][0], c, rtol=1e-10)
+    np.testing.assert_allclose(out["H"][0], h, rtol=1e-10)
+    check_grad("lstm_unit", {"X": x, "C_prev": c_prev},
+               {"forget_bias": 0.5}, inputs_to_check=["X", "C_prev"],
+               output_name="H", output_names=["H", "C"])
+
+
+def _np_gru_unit(x, h_p, w, b, origin_mode):
+    d = h_p.shape[1]
+    g = x + b.reshape(1, -1)
+    g[:, :2 * d] += h_p @ w[:, :2 * d]
+    u = _sig(g[:, :d])
+    r = _sig(g[:, d:2 * d])
+    rhp = r * h_p
+    c = np.tanh(g[:, 2 * d:] + rhp @ w[:, 2 * d:])
+    h = c + u * (h_p - c) if origin_mode else u * (c - h_p) + h_p
+    return u, r, rhp, c, h
+
+
+def test_gru_unit_matches_formula():
+    rng = np.random.RandomState(1)
+    b, d = 3, 4
+    x = rng.randn(b, 3 * d).astype("float64")
+    h_p = rng.randn(b, d).astype("float64")
+    w = rng.randn(d, 3 * d).astype("float64")
+    bias = rng.randn(1, 3 * d).astype("float64")
+    for origin in (False, True):
+        out = run_op("gru_unit",
+                     {"Input": x, "HiddenPrev": h_p, "Weight": w,
+                      "Bias": bias},
+                     {"activation": 2, "gate_activation": 1,
+                      "origin_mode": origin},
+                     outputs=("Gate", "ResetHiddenPrev", "Hidden"))
+        u, r, rhp, c, h = _np_gru_unit(x.copy(), h_p, w, bias, origin)
+        np.testing.assert_allclose(out["Hidden"][0], h, rtol=1e-10)
+        np.testing.assert_allclose(out["ResetHiddenPrev"][0], rhp,
+                                   rtol=1e-10)
+        np.testing.assert_allclose(out["Gate"][0],
+                                   np.concatenate([u, r, c], 1), rtol=1e-10)
+    check_grad("gru_unit",
+               {"Input": x, "HiddenPrev": h_p, "Weight": w, "Bias": bias},
+               {"activation": 2, "gate_activation": 1},
+               inputs_to_check=["Input", "HiddenPrev", "Weight"],
+               output_name="Hidden")
+
+
+def _np_lstmp(x, w, pw, b, h0, c0, cell_clip=0.0, proj_clip=0.0):
+    n, t, _ = x.shape
+    d, p = pw.shape
+    r = np.tanh(h0 @ pw) if h0 is not None else np.zeros((n, p))
+    c = c0 if c0 is not None else np.zeros((n, d))
+    projs, cells = [], []
+    for step in range(t):
+        gates = x[:, step] + b.reshape(1, -1) + r @ w
+        g, i, f, o = np.split(gates, 4, axis=1)
+        i, f, o = _sig(i), _sig(f), _sig(o)
+        c = f * c + i * np.tanh(g)
+        if cell_clip > 0:
+            c = np.clip(c, -cell_clip, cell_clip)
+        h = o * np.tanh(c)
+        r = np.tanh(h @ pw)
+        if proj_clip > 0:
+            r = np.clip(r, -proj_clip, proj_clip)
+        projs.append(r)
+        cells.append(c)
+    return np.stack(projs, 1), np.stack(cells, 1)
+
+
+def test_lstmp_matches_numpy_scan():
+    rng = np.random.RandomState(2)
+    n, t, d, p = 2, 5, 4, 3
+    x = rng.randn(n, t, 4 * d).astype("float64")
+    w = rng.randn(p, 4 * d).astype("float64")
+    pw = rng.randn(d, p).astype("float64")
+    b = rng.randn(4 * d).astype("float64")
+    out = run_op("lstmp_v2",
+                 {"Input": x, "Weight": w, "ProjWeight": pw, "Bias": b},
+                 {}, outputs=("Projection", "Cell"))
+    want_p, want_c = _np_lstmp(x, w, pw, b, None, None)
+    np.testing.assert_allclose(out["Projection"][0], want_p, rtol=1e-9)
+    np.testing.assert_allclose(out["Cell"][0], want_c, rtol=1e-9)
+    # clipping paths
+    out2 = run_op("lstmp_v2",
+                  {"Input": x, "Weight": w, "ProjWeight": pw, "Bias": b},
+                  {"cell_clip": 0.4, "proj_clip": 0.3},
+                  outputs=("Projection",))
+    want_p2, _ = _np_lstmp(x, w, pw, b, None, None, 0.4, 0.3)
+    np.testing.assert_allclose(out2["Projection"][0], want_p2, rtol=1e-9)
+    check_grad("lstmp_v2",
+               {"Input": x, "Weight": w, "ProjWeight": pw, "Bias": b}, {},
+               inputs_to_check=["Input", "Weight", "ProjWeight"],
+               output_name="Projection", max_relative_error=1e-2)
+
+
+def _np_attention_lstm(x, c0, h0, wa, ba, sc, scb, lw, lb, lens):
+    n, t, m = x.shape
+    d = c0.shape[1]
+    hids = np.zeros((n, t, d))
+    cells = np.zeros((n, t, d))
+    for bi in range(n):
+        L = lens[bi] if lens is not None else t
+        xb = x[bi, :L]
+        atted = xb @ wa[:m] + (ba if ba is not None else 0.0)
+        h = h0[bi] if h0 is not None else np.zeros(d)
+        c = c0[bi]
+        for step in range(L):
+            score = np.maximum(atted + c @ wa[m:], 0.0)
+            if sc is not None:
+                score = np.maximum(score * sc + (scb or 0.0), 0.0)
+            e = np.exp(score - score.max())
+            att = e / e.sum()
+            lstm_x = att @ xb
+            gates = lstm_x @ lw[d:] + h @ lw[:d] + lb
+            f, i, o, cand = (gates[:d], gates[d:2 * d], gates[2 * d:3 * d],
+                             gates[3 * d:])
+            c = _sig(f) * c + _sig(i) * np.tanh(cand)
+            h = np.tanh(c) * _sig(o)
+            hids[bi, step] = h
+            cells[bi, step] = c
+    return hids, cells
+
+
+def test_attention_lstm_matches_numpy():
+    rng = np.random.RandomState(3)
+    n, t, m, d = 2, 4, 3, 2
+    x = rng.randn(n, t, m).astype("float64")
+    c0 = rng.randn(n, d).astype("float64")
+    h0 = rng.randn(n, d).astype("float64")
+    wa = rng.randn(m + d, 1).astype("float64")
+    lw = rng.randn(d + m, 4 * d).astype("float64")
+    lb = rng.randn(1, 4 * d).astype("float64")
+    lens = np.array([4, 3], "int64")
+    out = run_op("attention_lstm",
+                 {"X": x, "C0": c0, "H0": h0, "AttentionWeight": wa,
+                  "LSTMWeight": lw, "LSTMBias": lb, "SeqLen": lens},
+                 {}, outputs=("Hidden", "Cell"))
+    want_h, want_c = _np_attention_lstm(
+        x, c0, h0, wa.reshape(-1), None, None, None, lw,
+        lb.reshape(-1), lens)
+    # padded steps beyond each row's length are unchecked
+    for bi, L in enumerate(lens):
+        np.testing.assert_allclose(out["Hidden"][0][bi, :L],
+                                   want_h[bi, :L], rtol=1e-9)
+        np.testing.assert_allclose(out["Cell"][0][bi, :L],
+                                   want_c[bi, :L], rtol=1e-9)
+    # scalar stage
+    sc = np.array([[0.7]], "float64")
+    scb = np.array([[0.2]], "float64")
+    out2 = run_op("attention_lstm",
+                  {"X": x, "C0": c0, "H0": h0, "AttentionWeight": wa,
+                   "AttentionScalar": sc, "AttentionScalarBias": scb,
+                   "LSTMWeight": lw, "LSTMBias": lb},
+                  {}, outputs=("Hidden",))
+    want_h2, _ = _np_attention_lstm(
+        x, c0, h0, wa.reshape(-1), None, 0.7, 0.2, lw, lb.reshape(-1), None)
+    np.testing.assert_allclose(out2["Hidden"][0], want_h2, rtol=1e-9)
+    check_grad("attention_lstm",
+               {"X": x, "C0": c0, "H0": h0, "AttentionWeight": wa,
+                "LSTMWeight": lw, "LSTMBias": lb}, {},
+               inputs_to_check=["X", "AttentionWeight", "LSTMWeight"],
+               output_name="Hidden", max_relative_error=1e-2)

@@ -1,0 +1,216 @@
+"""Round-2 op-family tests: unique_with_counts, sample_logits,
+filter_by_instag, positive_negative_pair, detection_map, py_func
+(reference: unittests/test_unique_with_counts.py, test_sample_logits.py,
+test_filter_by_instag_op.py, test_positive_negative_pair_op.py,
+test_detection_map_op.py, test_py_func_op.py)."""
+
+import numpy as np
+
+from op_test import analytic_grads, run_op
+
+
+def test_unique_first_occurrence_order():
+    x = np.array([2, 3, 3, 1, 5, 3], "int64")
+    out = run_op("unique", {"X": x}, {}, outputs=("Out", "Index"))
+    # first-occurrence order (reference unique_op.h appends on first sight)
+    np.testing.assert_array_equal(out["Out"][0][:4], [2, 3, 1, 5])
+    np.testing.assert_array_equal(out["Index"][0], [0, 1, 1, 2, 3, 1])
+
+
+def test_unique_with_counts():
+    x = np.array([2, 3, 3, 1, 5, 3], "int64")
+    out = run_op("unique_with_counts", {"X": x}, {},
+                 outputs=("Out", "Index", "Count"))
+    np.testing.assert_array_equal(out["Out"][0][:4], [2, 3, 1, 5])
+    np.testing.assert_array_equal(out["Index"][0], [0, 1, 1, 2, 3, 1])
+    np.testing.assert_array_equal(out["Count"][0][:4], [1, 3, 1, 1])
+    # padding slots have count 0; count>0 marks the valid prefix
+    assert (out["Count"][0][4:] == 0).all()
+
+
+def test_sample_logits_customized_exact():
+    rng = np.random.RandomState(0)
+    n, c, s, nt = 3, 10, 4, 1
+    logits = rng.randn(n, c).astype("float64")
+    labels = rng.randint(0, c, (n, nt)).astype("int64")
+    samples = np.concatenate(
+        [labels, rng.randint(0, c, (n, s))], 1).astype("int64")
+    probs = rng.rand(n, nt + s).astype("float64") + 0.1
+    out = run_op("sample_logits",
+                 {"Logits": logits, "Labels": labels,
+                  "CustomizedSamples": samples,
+                  "CustomizedProbabilities": probs},
+                 {"use_customized_samples": True, "num_samples": s,
+                  "remove_accidental_hits": False},
+                 outputs=("Samples", "Probabilities", "SampledLogits",
+                          "SampledLabels"))
+    want = np.take_along_axis(logits, samples, 1) - np.log(probs + 1e-12)
+    np.testing.assert_allclose(out["SampledLogits"][0], want, rtol=1e-9)
+    np.testing.assert_array_equal(out["SampledLabels"][0],
+                                  np.zeros((n, nt), "int64"))
+    # remove_accidental_hits: negative col equal to the row's label → -1e20
+    out2 = run_op("sample_logits",
+                  {"Logits": logits, "Labels": labels,
+                   "CustomizedSamples": samples,
+                   "CustomizedProbabilities": probs},
+                  {"use_customized_samples": True, "num_samples": s,
+                   "remove_accidental_hits": True},
+                  outputs=("SampledLogits",))["SampledLogits"][0]
+    hits = samples[:, nt:] == labels
+    assert (out2[:, nt:][hits] < -1e19).all()
+    np.testing.assert_allclose(out2[:, nt:][~hits], want[:, nt:][~hits],
+                               rtol=1e-9)
+
+
+def test_sample_logits_random_shapes():
+    rng = np.random.RandomState(1)
+    logits = rng.randn(4, 50).astype("float32")
+    labels = rng.randint(0, 50, (4, 1)).astype("int64")
+    out = run_op("sample_logits", {"Logits": logits, "Labels": labels},
+                 {"num_samples": 8}, rng_seed=0,
+                 outputs=("Samples", "Probabilities", "SampledLogits"))
+    assert out["Samples"][0].shape == (4, 9)
+    assert (out["Samples"][0][:, 0:1] == labels).all()
+    assert ((out["Samples"][0] >= 0) & (out["Samples"][0] < 50)).all()
+    assert (out["Probabilities"][0] > 0).all()
+
+
+def test_sample_logits_grad_scatters_to_logits():
+    rng = np.random.RandomState(2)
+    n, c, s = 2, 6, 2
+    logits = rng.randn(n, c).astype("float64")
+    labels = rng.randint(0, c, (n, 1)).astype("int64")
+    samples = np.concatenate([labels, rng.randint(0, c, (n, s))],
+                             1).astype("int64")
+    probs = np.full((n, 1 + s), 0.5, "float64")
+    dy = rng.randn(n, 1 + s).astype("float64")
+    g = analytic_grads("sample_logits",
+                       {"Logits": logits, "Labels": labels,
+                        "CustomizedSamples": samples,
+                        "CustomizedProbabilities": probs},
+                       {"use_customized_samples": True, "num_samples": s,
+                        "remove_accidental_hits": False},
+                       ["Logits"], "SampledLogits",
+                       {"SampledLogits": [dy]})["Logits"][0]
+    want = np.zeros_like(logits)
+    for i in range(n):
+        for j in range(1 + s):
+            want[i, samples[i, j]] += dy[i, j]
+    np.testing.assert_allclose(g, want, rtol=1e-9)
+
+
+def test_filter_by_instag():
+    ins = np.arange(12, dtype="float64").reshape(4, 3)
+    tags = np.array([[1, -1], [2, 3], [4, -1], [3, -1]], "int64")
+    filt = np.array([2, 3], "int64")
+    out = run_op("filter_by_instag",
+                 {"Ins": ins, "Ins_tag": tags, "Filter_tag": filt}, {},
+                 outputs=("Out", "LossWeight", "IndexMap"))
+    # rows 1 and 3 kept, compacted to top
+    np.testing.assert_allclose(out["Out"][0][0], ins[1])
+    np.testing.assert_allclose(out["Out"][0][1], ins[3])
+    np.testing.assert_allclose(out["Out"][0][2:], 0.0)
+    np.testing.assert_allclose(out["LossWeight"][0][:, 0], [1, 1, 0, 0])
+    np.testing.assert_array_equal(out["IndexMap"][0][:2],
+                                  [[0, 1], [1, 3]])
+    assert (out["IndexMap"][0][2:] == -1).all()
+
+
+def test_positive_negative_pair():
+    # query 0: rows 0,1,2 (labels 2,1,0; scores 0.9,0.5,0.1 — all ordered
+    # correctly → 3 positive pairs); query 1: rows 3,4 labels 1,0 scores
+    # 0.2,0.8 → 1 negative pair
+    score = np.array([[0.9], [0.5], [0.1], [0.2], [0.8]], "float64")
+    label = np.array([[2.0], [1.0], [0.0], [1.0], [0.0]], "float64")
+    qid = np.array([[0], [0], [0], [1], [1]], "int64")
+    out = run_op("positive_negative_pair",
+                 {"Score": score, "Label": label, "QueryID": qid}, {},
+                 outputs=("PositivePair", "NegativePair", "NeutralPair"))
+    assert float(out["PositivePair"][0][0]) == 3.0
+    assert float(out["NegativePair"][0][0]) == 1.0
+    assert float(out["NeutralPair"][0][0]) == 0.0
+    # accumulate path
+    out2 = run_op("positive_negative_pair",
+                  {"Score": score, "Label": label, "QueryID": qid,
+                   "AccumulatePositivePair": np.array([10.0]),
+                   "AccumulateNegativePair": np.array([1.0]),
+                   "AccumulateNeutralPair": np.array([0.5])}, {},
+                  outputs=("PositivePair", "NegativePair", "NeutralPair"))
+    assert float(out2["PositivePair"][0][0]) == 13.0
+    assert float(out2["NegativePair"][0][0]) == 2.0
+    assert float(out2["NeutralPair"][0][0]) == 0.5
+
+
+def test_detection_map_simple_and_streaming():
+    # one image, one class: 1 gt, 2 dets (one hit, one miss)
+    dets = np.array([[0, 0.9, 0, 0, 10, 10],      # IoU 1.0 with gt -> tp
+                     [0, 0.5, 50, 50, 60, 60],    # no overlap -> fp
+                     [-1, 0, 0, 0, 0, 0]], "float32")
+    gts = np.array([[0, 0, 0, 10, 10, 0],
+                    [-1, 0, 0, 0, 0, 0]], "float32")
+    out = run_op("detection_map", {"DetectRes": dets, "Label": gts},
+                 {"class_num": 2, "overlap_threshold": 0.5,
+                  "ap_type": "integral"},
+                 outputs=("MAP", "AccumPosCount", "AccumTruePos",
+                          "AccumFalsePos"))
+    # AP: det1 tp (prec 1, rec 1), det2 fp -> integral AP = 1.0
+    np.testing.assert_allclose(out["MAP"][0][0], 1.0, rtol=1e-6)
+    assert out["AccumPosCount"][0][0, 0] == 1
+    # streaming: feed state back with a second identical image
+    out2 = run_op("detection_map",
+                  {"DetectRes": dets, "Label": gts,
+                   "HasState": np.array([1], "int32"),
+                   "PosCount": out["AccumPosCount"][0],
+                   "TruePos": out["AccumTruePos"][0],
+                   "FalsePos": out["AccumFalsePos"][0]},
+                  {"class_num": 2, "overlap_threshold": 0.5,
+                   "ap_type": "integral"},
+                  outputs=("MAP", "AccumPosCount"))
+    np.testing.assert_allclose(out2["MAP"][0][0], 1.0, rtol=1e-6)
+    assert out2["AccumPosCount"][0][0, 0] == 2
+
+
+def test_detection_map_11point_and_difficult():
+    dets = np.array([[0, 0.9, 0, 0, 10, 10],
+                     [0, 0.8, 20, 20, 30, 30]], "float32")
+    gts = np.array([[0, 0, 0, 10, 10, 0],
+                    [0, 20, 20, 30, 30, 1]], "float32")  # second difficult
+    out = run_op("detection_map", {"DetectRes": dets, "Label": gts},
+                 {"class_num": 1, "overlap_threshold": 0.5,
+                  "ap_type": "11point", "evaluate_difficult": False},
+                 outputs=("MAP", "AccumPosCount"))
+    # difficult gt excluded: npos=1; det2 matches difficult gt → ignored;
+    # det1 tp → AP = 1.0 at all 11 recall points
+    np.testing.assert_allclose(out["MAP"][0][0], 1.0, rtol=1e-6)
+    assert out["AccumPosCount"][0][0, 0] == 1
+
+
+def test_py_func_forward_and_backward():
+    import paddle_tpu as pt
+
+    def fwd(a, b):
+        return np.asarray(a) * 2.0 + np.asarray(b)
+
+    def bwd(a, b, out, dout):
+        return 2.0 * np.asarray(dout), np.asarray(dout)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("pf_x", shape=[3], dtype="float32")
+        y = pt.layers.data("pf_y", shape=[3], dtype="float32")
+        x.stop_gradient = False
+        xs = pt.layers.scale(x, 1.0)   # trainable path into autodiff
+        xs.stop_gradient = False
+        helper_out = main.current_block().create_var(
+            name="pf_out", shape=[-1, 3], dtype="float32")
+        pt.layers.py_func(fwd, [xs, y], helper_out, backward_func=bwd)
+        loss = pt.layers.mean(helper_out)
+        grads = pt.backward.gradients(loss, [x])
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    xv = np.array([[1.0, 2.0, 3.0]], "float32")
+    yv = np.array([[10.0, 20.0, 30.0]], "float32")
+    out, gx = exe.run(main, feed={"pf_x": xv, "pf_y": yv},
+                      fetch_list=[helper_out.name, grads[0].name])
+    np.testing.assert_allclose(out, xv * 2 + yv, rtol=1e-6)
+    np.testing.assert_allclose(gx, np.full((1, 3), 2.0 / 3.0), rtol=1e-5)
