@@ -1,0 +1,48 @@
+"""Async-PS Communicator facade.
+
+Reference: python/paddle/fluid/communicator.py — `Communicator(program)`
+wraps the C++ AsyncCommunicator: it marks the trainer program's recv ops
+do_not_run (the independent recv thread refreshes params instead) and
+start()/stop() manage the background send/recv threads. Used with
+DistributeTranspilerConfig(sync_mode=False, runtime_split_send_recv=True).
+"""
+
+from __future__ import annotations
+
+from .core.framework import Program
+
+__all__ = ["Communicator"]
+
+
+class Communicator:
+    def __init__(self, program: Program, scope=None):
+        from .core.executor import global_scope
+        from .ops.distributed import bind_communicator, get_client
+        from .ps.client import AsyncCommunicator
+
+        assert isinstance(program, Program)
+        send_vars, recv_params = [], []
+        for op in program.global_block().ops:
+            if op.type == "ps_send":
+                op._set_attr("use_communicator", True)
+                send_vars.append(op.attrs.get("var_name"))
+            elif op.type == "ps_recv":
+                # the recv thread is authoritative; in-graph recv becomes
+                # a pass-through of the scope value (reference sets
+                # do_not_run on recv ops, communicator.py:42)
+                op._set_attr("do_not_run", True)
+                recv_params.append(op.attrs.get("var_name"))
+        self.send_vars = send_vars
+        self.recv_params = recv_params
+        self._comm = AsyncCommunicator(get_client())
+        self._comm.bind_recv(scope or global_scope(), recv_params)
+        bind_communicator(self._comm)
+
+    def start(self):
+        self._comm.start()
+        # one eager pull so the scope holds fresh params before step 1
+        self._comm.recv_all()
+
+    def stop(self):
+        self._comm.stop()
+        self._comm.recv_all()

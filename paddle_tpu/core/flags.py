@@ -24,6 +24,16 @@ _DEFAULTS: Dict[str, Any] = {
     "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
     "FLAGS_allocator_strategy": "auto_growth",
     "FLAGS_eager_delete_tensor_gb": 0.0,
+    # async-PS communicator tuning (reference flags.cc:200-229 +
+    # operators/distributed/communicator.cc:34-46)
+    "FLAGS_communicator_max_merge_var_num": 20,
+    "FLAGS_communicator_send_queue_size": 20,
+    "FLAGS_communicator_independent_recv_thread": True,
+    "FLAGS_communicator_min_send_grad_num_before_recv": 20,
+    "FLAGS_communicator_thread_pool_size": 5,
+    "FLAGS_communicator_send_wait_times": 5,
+    "FLAGS_communicator_fake_rpc": False,
+    "FLAGS_communicator_merge_sparse_grad": True,
 }
 
 _flags: Dict[str, Any] = {}

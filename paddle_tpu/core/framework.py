@@ -226,6 +226,8 @@ class Operator:
     def set_attr(self, name, val):
         self.desc.attrs[name] = val
 
+    _set_attr = set_attr  # reference-compat alias (framework.py Operator)
+
     def input(self, slot):
         return self.desc.inputs.get(slot, [])
 

@@ -1467,7 +1467,16 @@ def _np_detection_map_update(dets, gts, pos_count, tps, fps,
     def pack(ls):
         out = np.full((class_num, cap, 2), -1.0, np.float32)
         for c in range(class_num):
-            rows = lists.get(c, ([], []))[ls][:cap]
+            rows = lists.get(c, ([], []))[ls]
+            if len(rows) > cap:
+                import warnings
+
+                warnings.warn(
+                    f"detection_map: class {c} accumulated {len(rows)} "
+                    f"detections > max_dets={cap}; the streaming state is "
+                    f"truncated and mAP will drift — raise the max_dets "
+                    f"attr", RuntimeWarning)
+                rows = rows[:cap]
             for i, r in enumerate(rows):
                 out[c, i] = r
         return out

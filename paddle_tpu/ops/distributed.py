@@ -19,6 +19,7 @@ import numpy as np
 from ..core.registry import register_op
 
 _CLIENT = None
+_COMMUNICATOR = None
 
 
 def bind_client(client):
@@ -35,13 +36,34 @@ def get_client():
     return _CLIENT
 
 
+def bind_communicator(comm):
+    """Install the AsyncCommunicator ps_send routes through when the op
+    carries use_communicator (reference: Communicator::GetInstance())."""
+    global _COMMUNICATOR
+    _COMMUNICATOR = comm
+
+
+def get_communicator():
+    if _COMMUNICATOR is None:
+        raise RuntimeError(
+            "no AsyncCommunicator bound — construct "
+            "paddle_tpu.communicator.Communicator(trainer_program) and "
+            "start() it before running async-mode steps")
+    return _COMMUNICATOR
+
+
 @register_op("ps_send", grad=None, nondiff_inputs=("X",))
 def ps_send(ins, attrs, ctx):
     name = attrs["var_name"]
     x = ins["X"][0]
+    use_comm = bool(attrs.get("use_communicator", False))
 
     def _send(g):
-        get_client().push_grad(name, np.asarray(g))
+        if use_comm:
+            # enqueue to the background merging sender (communicator.h:276)
+            get_communicator().push(name, np.asarray(g))
+        else:
+            get_client().push_grad(name, np.asarray(g))
         return np.zeros((), np.int32)
 
     token = jax.experimental.io_callback(
@@ -95,8 +117,18 @@ def ps_recv(ins, attrs, ctx):
                 break
     if shape is None:
         raise RuntimeError(f"ps_recv: unknown shape for {name}")
+    do_not_run = bool(attrs.get("do_not_run", False))
 
     def _pull():
+        if do_not_run:
+            # communicator mode: the independent recv thread refreshes a
+            # host-side numpy cache; the in-graph recv just reads it
+            # (reference sets do_not_run on recv ops, communicator.py:42).
+            # NEVER read the scope here — its entries may be device arrays
+            # and converting one inside a host callback deadlocks.
+            v = get_communicator().latest.get(name)
+            if v is not None:
+                return np.asarray(v).astype(dtype)
         return get_client().pull(name).astype(dtype)
 
     val = jax.experimental.io_callback(

@@ -674,10 +674,12 @@ def _py_func_grad(ins, attrs, ctx):
         if not isinstance(res, (tuple, list)):
             res = (res,)
         padded = []
-        for i, x in enumerate(xs):
+        for i in range(len(xs)):
             r = res[i] if i < len(res) else None
             if r is None:
-                r = np.zeros(np.shape(x), np.asarray(x).dtype)
+                # xs[i] is a trace-time tracer here — shapes must come
+                # from the precomputed result_shapes
+                r = np.zeros(result_shapes[i].shape, result_shapes[i].dtype)
             padded.append(np.asarray(r).astype(result_shapes[i].dtype)
                           .reshape(result_shapes[i].shape))
         return tuple(padded)

@@ -22,7 +22,12 @@ def _reduce(fn, differentiable=True):
         x = ins["X"][0]
         axes = _axes(attrs, x.ndim)
         keep = attrs.get("keep_dim", False)
-        return {"Out": fn(x, axis=axes, keepdims=keep)}
+        out = fn(x, axis=axes, keepdims=keep)
+        if out.ndim == 0:
+            # framework convention (reference reduce_op.h full reduction
+            # yields shape [1]); the backward seed is built as [1] too
+            out = out.reshape(1)
+        return {"Out": out}
 
     return kernel
 

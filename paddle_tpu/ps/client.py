@@ -155,30 +155,113 @@ class PSClient:
 
 
 class AsyncCommunicator:
-    """reference: communicator.h:276 — background send threads merge up to
-    max_merge_var_num gradients per var before pushing (async PS mode)."""
+    """reference: communicator.h:276 AsyncCommunicator — per-var BOUNDED
+    blocking queues (FLAGS_communicator_send_queue_size: a full queue
+    back-pressures the trainer), background send threads that merge up to
+    FLAGS_communicator_max_merge_var_num gradients per var before one
+    averaged push, and an optional independent recv thread that pulls
+    fresh params into the bound scope every
+    FLAGS_communicator_min_send_grad_num_before_recv sent gradients
+    (communicator.cc:34-46 flags). Defaults come from those FLAGS_* so
+    env tuning works like the reference's gflags."""
 
-    def __init__(self, client: PSClient, max_merge_var_num: int = 20,
-                 send_wait_times: float = 0.005):
+    def __init__(self, client: PSClient, max_merge_var_num: Optional[int] = None,
+                 send_wait_times: Optional[float] = None,
+                 send_queue_size: Optional[int] = None,
+                 independent_recv_thread: Optional[bool] = None,
+                 min_send_grad_num_before_recv: Optional[int] = None):
+        from ..core.flags import get_flag
+
+        def flag(v, name):
+            return v if v is not None else get_flag(name)
+
         self.client = client
-        self.max_merge = max_merge_var_num
-        self.wait = send_wait_times
+        self.max_merge = int(flag(max_merge_var_num,
+                                  "FLAGS_communicator_max_merge_var_num"))
+        # explicit send_wait_times stays in SECONDS (the class's original
+        # contract); only the reference flag's tick units are converted
+        if send_wait_times is not None:
+            self.wait = float(send_wait_times)
+        else:
+            self.wait = float(
+                get_flag("FLAGS_communicator_send_wait_times")) * 0.001
+        self.queue_size = int(flag(send_queue_size,
+                                   "FLAGS_communicator_send_queue_size"))
+        self.independent_recv = bool(flag(
+            independent_recv_thread,
+            "FLAGS_communicator_independent_recv_thread"))
+        self.recv_after = int(flag(
+            min_send_grad_num_before_recv,
+            "FLAGS_communicator_min_send_grad_num_before_recv"))
         self._queues: Dict[str, queue.Queue] = {}
         self._stop = threading.Event()
-        self._threads: List[threading.Thread] = []
+        self._threads: Dict[str, threading.Thread] = {}
+        self._grad_num = 0              # grads sent since last recv
+        self._grad_lock = threading.Lock()
+        self._recv_scope = None
+        self._recv_params: List[str] = []
+        self._recv_thread: Optional[threading.Thread] = None
+        # host-side numpy copies of the last-received params. ps_recv's
+        # do_not_run callback reads THIS, never the scope: scope entries
+        # may be device arrays, and np.asarray(device_array) inside an XLA
+        # host callback deadlocks against the running computation.
+        self.latest: Dict[str, np.ndarray] = {}
+
+    def bind_recv(self, scope, param_names: List[str]):
+        """Attach the scope the recv thread refreshes (the reference's
+        recv_scope_, communicator.h:314 — the trainer's global scope)."""
+        self._recv_scope = scope
+        self._recv_params = list(param_names)
 
     def start(self):
         self._stop.clear()
+        # respawn senders for queues whose thread died in a prior stop()
+        for name, q in self._queues.items():
+            t = self._threads.get(name)
+            if t is None or not t.is_alive():
+                self._spawn_sender(name, q)
+        if self.independent_recv and self._recv_scope is not None \
+                and self._recv_thread is None:
+            self._recv_thread = threading.Thread(target=self._recver,
+                                                 daemon=True)
+            self._recv_thread.start()
+
+    def _spawn_sender(self, name, q):
+        t = threading.Thread(target=self._sender, args=(name, q),
+                             daemon=True)
+        t.start()
+        self._threads[name] = t
 
     def push(self, name: str, grad: np.ndarray):
+        if self._stop.is_set():
+            raise RuntimeError(
+                "AsyncCommunicator.push after stop() — call start() again "
+                "(a bounded queue with no sender would block forever)")
         q = self._queues.get(name)
         if q is None:
-            q = self._queues[name] = queue.Queue()
-            t = threading.Thread(target=self._sender, args=(name, q),
-                                 daemon=True)
-            t.start()
-            self._threads.append(t)
-        q.put(np.asarray(grad))
+            q = self._queues[name] = queue.Queue(maxsize=self.queue_size)
+            self._spawn_sender(name, q)
+        q.put(np.asarray(grad))        # blocks when full (back-pressure)
+
+    def recv_all(self):
+        """Pull every bound param into the recv scope (RecvAll)."""
+        if self._recv_scope is None:
+            return
+        for pname in self._recv_params:
+            v = self.client.pull(pname)
+            self.latest[pname] = v
+            self._recv_scope.set_var(pname, v)
+
+    def _recver(self):
+        while not self._stop.is_set():
+            with self._grad_lock:
+                due = self._grad_num >= self.recv_after
+                if due:
+                    self._grad_num = 0
+            if due:
+                self.recv_all()
+            else:
+                self._stop.wait(self.wait * 10)
 
     def _sender(self, name: str, q: "queue.Queue"):
         while not self._stop.is_set():
@@ -194,11 +277,25 @@ class AsyncCommunicator:
                 except queue.Empty:
                     break
             self.client.push_grad(name, (merged / count).astype(g.dtype))
+            with self._grad_lock:
+                self._grad_num += count
+                due = (not self.independent_recv
+                       and self._grad_num >= self.recv_after)
+                if due:
+                    self._grad_num = 0
+            if due:
+                # no independent recv thread: recv from the send path
+                # (the reference's fallback when
+                # communicator_independent_recv_thread is false)
+                self.recv_all()
 
     def stop(self):
         self._stop.set()
-        for t in self._threads:
+        for t in self._threads.values():
             t.join(timeout=5)
+        if self._recv_thread is not None:
+            self._recv_thread.join(timeout=5)
+            self._recv_thread = None
         # drain anything the senders left behind (non-blocking: the sender
         # may have raced us to the last item)
         for name, q in self._queues.items():

@@ -36,6 +36,10 @@ class DistributeTranspilerConfig:
     # enable_dc_asgd trainer flag feeding _append_dc_asgd_ops)
     enable_dc_asgd: bool = False
     dc_asgd_lambda: float = 0.04
+    # async-communicator mode (reference: _runtime_split_send_recv,
+    # distribute_transpiler.py:180 — requires sync_mode=False; send ops
+    # route through the background AsyncCommunicator)
+    runtime_split_send_recv: bool = False
 
 
 class DistributeTranspiler:
@@ -88,12 +92,15 @@ class DistributeTranspiler:
         tb = trainer.desc.block(0)
         tb.ops = [od for od in tb.ops
                   if not (int(od.attrs.get(OpRole.AttrName, 0)) & OpRole.Optimize)]
+        use_comm = (self.config.runtime_split_send_recv
+                    and not self._sync_mode)
         for pname, gname in self._grad_of.items():
             if pname not in self._param_opt_descs:
                 continue
             tb.ops.append(OpDesc(
                 type="ps_send", inputs={"X": [gname]}, outputs={},
-                attrs={"var_name": pname, OpRole.AttrName: OpRole.RPC}))
+                attrs={"var_name": pname, "use_communicator": use_comm,
+                       OpRole.AttrName: OpRole.RPC}))
         # aux vars the optimize descs read that the TRAINER still updates
         # (LR schedulers & their counters) must refresh server-side every
         # step — the init-time snapshot would freeze the decay

@@ -2,8 +2,9 @@
 TestDistRunnerBase with run_pserver/run_trainer, test_dist_base.py:61).
 
 Roles via env: TRAINING_ROLE=PSERVER|TRAINER, PADDLE_PSERVERS_IP_PORT_LIST,
-PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PS_SYNC_MODE, PS_CURRENT_ENDPOINT.
-Trainers print JSON losses on the last line."""
+PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PS_SYNC_MODE, PS_CURRENT_ENDPOINT,
+PS_USE_COMMUNICATOR (async-communicator mode: merged background sends +
+independent recv thread). Trainers print JSON losses on the last line."""
 
 import json
 import os
@@ -48,10 +49,12 @@ def main():
     trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
     trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
     sync = os.environ.get("PS_SYNC_MODE", "1") == "1"
+    use_comm = os.environ.get("PS_USE_COMMUNICATOR", "0") == "1"
 
     main_prog, startup, loss = build()
     cfg = DistributeTranspilerConfig()
     cfg.sync_mode = sync
+    cfg.runtime_split_send_recv = use_comm
     t = DistributeTranspiler(cfg)
     t.transpile(trainer_id, program=main_prog, pservers=pservers,
                 trainers=trainers, sync_mode=sync)
@@ -75,11 +78,29 @@ def main():
         for n in pnames:
             assert client.wait_var(n, timeout=120), f"publish timeout: {n}"
     trainer_prog = t.get_trainer_program()
+    comm = None
+    if use_comm:
+        # async-communicator mode (reference: fluid.communicator.Communicator
+        # over a runtime_split_send_recv-transpiled program)
+        from paddle_tpu.communicator import Communicator
+
+        comm = Communicator(trainer_prog)
+        comm.start()
     X, Y, _, _ = data(trainer_id, trainers)
     losses = []
-    for _ in range(10):
+    n_steps = int(os.environ.get("PS_STEPS", "10"))
+    step_sleep = float(os.environ.get("PS_STEP_SLEEP", "0"))
+    for _ in range(n_steps):
         l = exe.run(trainer_prog, feed={"x": X, "y": Y}, fetch_list=[loss])[0]
         losses.append(float(np.asarray(l).reshape(())))
+        if step_sleep:
+            # async mode: give the background send/recv threads air (a
+            # real input pipeline provides this gap between steps)
+            import time as _time
+
+            _time.sleep(step_sleep)
+    if comm is not None:
+        comm.stop()
     # final params live on the pservers — pull for the parity oracle
     params = {n: client.pull(n).tolist() for n in pnames}
     client.heartbeat(state=2)  # COMPLETED

@@ -28,7 +28,8 @@ def _free_ports(n):
     return ports
 
 
-def _spawn(role, pservers, trainers, trainer_id=0, sync=True, endpoint=""):
+def _spawn(role, pservers, trainers, trainer_id=0, sync=True, endpoint="",
+           use_comm=False, extra_env=None):
     env = dict(os.environ)
     env.update({
         "TRAINING_ROLE": role,
@@ -36,10 +37,12 @@ def _spawn(role, pservers, trainers, trainer_id=0, sync=True, endpoint=""):
         "PADDLE_TRAINERS_NUM": str(trainers),
         "PADDLE_TRAINER_ID": str(trainer_id),
         "PS_SYNC_MODE": "1" if sync else "0",
+        "PS_USE_COMMUNICATOR": "1" if use_comm else "0",
         "PS_CURRENT_ENDPOINT": endpoint,
         "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
     })
+    env.update(extra_env or {})
     return subprocess.Popen(
         [sys.executable, os.path.join(REPO, "tests", "ps_worker.py")],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
@@ -480,3 +483,70 @@ def test_launch_ps_cli_runs_cluster():
     for n, v in base_params.items():
         np.testing.assert_allclose(results[0]["params"][n], v,
                                    rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_async_communicator_ps_convergence_matches_per_step_send():
+    """VERDICT item 5: merged-send (AsyncCommunicator over a
+    runtime_split_send_recv-transpiled program) converges like
+    per-step-send async training (reference: communicator.h:166-323 +
+    test_communicator.py)."""
+    results = {}
+    for tag, use_comm, extra in (
+            ("plain", False, {}),
+            ("merged", True,
+             {"FLAGS_communicator_max_merge_var_num": "4",
+              "FLAGS_communicator_send_queue_size": "8",
+              "FLAGS_communicator_min_send_grad_num_before_recv": "2",
+              "PS_STEPS": "30", "PS_STEP_SLEEP": "0.05"})):
+        (p1,) = _free_ports(1)
+        pservers = f"127.0.0.1:{p1}"
+        server = _spawn("PSERVER", pservers, 1, sync=False,
+                        endpoint=f"127.0.0.1:{p1}")
+        time.sleep(1.5)
+        tr = _spawn("TRAINER", pservers, 1, trainer_id=0, sync=False,
+                    use_comm=use_comm, extra_env=extra)
+        so, se = tr.communicate(timeout=240)
+        assert tr.returncode == 0, so + se
+        results[tag] = json.loads(
+            [l for l in so.splitlines() if l.startswith("{")][-1])
+        server.wait(timeout=60)
+    # both modes train; merged-send final loss is in the same ballpark as
+    # per-step send (the reference's convergence-parity criterion)
+    for tag in ("plain", "merged"):
+        assert results[tag]["losses"][-1] < results[tag]["losses"][0], tag
+    assert results["merged"]["losses"][-1] < results["plain"]["losses"][0]
+
+
+def test_async_communicator_flags_and_backpressure():
+    """FLAGS_communicator_* env tuning reaches the communicator (reference
+    gflags, communicator.cc:34-46), and the bounded send queue
+    back-pressures pushes (communicator_send_queue_size)."""
+    from paddle_tpu.core.flags import set_flags, get_flag
+    from paddle_tpu.ps.client import AsyncCommunicator
+
+    old = {k: get_flag(k) for k in
+           ("FLAGS_communicator_max_merge_var_num",
+            "FLAGS_communicator_send_queue_size",
+            "FLAGS_communicator_independent_recv_thread")}
+    try:
+        set_flags({"FLAGS_communicator_max_merge_var_num": 7,
+                   "FLAGS_communicator_send_queue_size": 3,
+                   "FLAGS_communicator_independent_recv_thread": False})
+
+        class _NoopClient:
+            def push_grad(self, name, grad):
+                time.sleep(0.2)
+
+        comm = AsyncCommunicator(_NoopClient())
+        assert comm.max_merge == 7
+        assert comm.queue_size == 3
+        assert comm.independent_recv is False
+        comm.start()
+        t0 = time.time()
+        for _ in range(8):   # queue holds 3; sender sleeps 0.2s per send
+            comm.push("w", np.ones(2, np.float32))
+        assert time.time() - t0 > 0.15, "full queue must block the pusher"
+        comm.stop()
+    finally:
+        set_flags(old)

@@ -214,3 +214,35 @@ def test_py_func_forward_and_backward():
                       fetch_list=[helper_out.name, grads[0].name])
     np.testing.assert_allclose(out, xv * 2 + yv, rtol=1e-6)
     np.testing.assert_allclose(gx, np.full((1, 3), 2.0 / 3.0), rtol=1e-5)
+
+
+def test_py_func_backward_none_grad_becomes_zeros():
+    import paddle_tpu as pt
+
+    def fwd(a, b):
+        return np.asarray(a) + np.asarray(b)
+
+    def bwd(a, b, out, dout):
+        return np.asarray(dout), None        # None -> zeros for b
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("pn_x", shape=[2], dtype="float32")
+        y = pt.layers.data("pn_y", shape=[2], dtype="float32")
+        x.stop_gradient = False
+        y.stop_gradient = False
+        xs = pt.layers.scale(x, 1.0)
+        ys = pt.layers.scale(y, 1.0)
+        xs.stop_gradient = ys.stop_gradient = False
+        out = main.current_block().create_var(
+            name="pn_out", shape=[-1, 2], dtype="float32")
+        pt.layers.py_func(fwd, [xs, ys], out, backward_func=bwd)
+        loss = pt.layers.mean(out)
+        gx, gy = pt.backward.gradients(loss, [x, y])
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    xv = np.ones((1, 2), "float32")
+    got_gx, got_gy = exe.run(main, feed={"pn_x": xv, "pn_y": xv},
+                             fetch_list=[gx.name, gy.name])
+    np.testing.assert_allclose(got_gx, np.full((1, 2), 0.5), rtol=1e-6)
+    np.testing.assert_allclose(got_gy, np.zeros((1, 2)), rtol=1e-6)
