@@ -225,6 +225,11 @@ class Operator:
 
     def set_attr(self, name, val):
         self.desc.attrs[name] = val
+        # attr mutation changes compiled behavior — invalidate the
+        # executor's compiled-step cache like every other mutation
+        prog = getattr(self.block, "program", None)
+        if prog is not None:
+            prog._bump_version()
 
     _set_attr = set_attr  # reference-compat alias (framework.py Operator)
 

@@ -1466,19 +1466,23 @@ def _np_detection_map_update(dets, gts, pos_count, tps, fps,
 
     def pack(ls):
         out = np.full((class_num, cap, 2), -1.0, np.float32)
+        over = []
         for c in range(class_num):
             rows = lists.get(c, ([], []))[ls]
             if len(rows) > cap:
-                import warnings
-
-                warnings.warn(
-                    f"detection_map: class {c} accumulated {len(rows)} "
-                    f"detections > max_dets={cap}; the streaming state is "
-                    f"truncated and mAP will drift — raise the max_dets "
-                    f"attr", RuntimeWarning)
+                over.append((c, len(rows)))
                 rows = rows[:cap]
             for i, r in enumerate(rows):
                 out[c, i] = r
+        if over:
+            import warnings
+
+            warnings.warn(
+                f"detection_map: {len(over)} classes exceeded "
+                f"max_dets={cap} (worst: class {max(over, key=lambda t: t[1])[0]} "
+                f"with {max(o[1] for o in over)} detections); streaming "
+                f"state is truncated and mAP will drift — raise max_dets",
+                RuntimeWarning)
         return out
 
     return (np.array([m_ap], np.float32), pos_count.astype(np.int32),

@@ -17,17 +17,18 @@ def _axes(attrs, ndim):
     return tuple(d % ndim for d in dim)
 
 
+def _shape1(out):
+    """Framework convention: full reductions yield shape [1], never 0-d
+    (reference reduce_op.h; the backward loss seed is built as [1])."""
+    return out.reshape(1) if out.ndim == 0 else out
+
+
 def _reduce(fn, differentiable=True):
     def kernel(ins, attrs, ctx):
         x = ins["X"][0]
         axes = _axes(attrs, x.ndim)
         keep = attrs.get("keep_dim", False)
-        out = fn(x, axis=axes, keepdims=keep)
-        if out.ndim == 0:
-            # framework convention (reference reduce_op.h full reduction
-            # yields shape [1]); the backward seed is built as [1] too
-            out = out.reshape(1)
-        return {"Out": out}
+        return {"Out": _shape1(fn(x, axis=axes, keepdims=keep))}
 
     return kernel
 
@@ -48,7 +49,8 @@ def logsumexp(ins, attrs, ctx):
     x = ins["X"][0]
     axes = _axes(attrs, x.ndim)
     keep = attrs.get("keep_dim", False)
-    return {"Out": jax.scipy.special.logsumexp(x, axis=axes, keepdims=keep)}
+    return {"Out": _shape1(
+        jax.scipy.special.logsumexp(x, axis=axes, keepdims=keep))}
 
 
 @register_op("mean")
@@ -63,4 +65,5 @@ def frobenius_norm(ins, attrs, ctx):
     x = ins["X"][0]
     axes = _axes(attrs, x.ndim)
     keep = attrs.get("keep_dim", False)
-    return {"Out": jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=keep))}
+    return {"Out": _shape1(
+        jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=keep)))}

@@ -241,7 +241,23 @@ class AsyncCommunicator:
         if q is None:
             q = self._queues[name] = queue.Queue(maxsize=self.queue_size)
             self._spawn_sender(name, q)
-        q.put(np.asarray(grad))        # blocks when full (back-pressure)
+        # bounded put with a stop re-check: a push racing stop() must not
+        # block forever on a full queue whose sender just exited
+        while True:
+            try:
+                q.put(np.asarray(grad), timeout=0.05)
+                break
+            except queue.Full:
+                if self._stop.is_set():
+                    raise RuntimeError(
+                        "AsyncCommunicator stopped while push was "
+                        "blocked on a full queue") from None
+        if self._stop.is_set():
+            # raced stop()'s drain: flush what we just enqueued ourselves
+            try:
+                self.client.push_grad(name, q.get_nowait())
+            except queue.Empty:
+                pass
 
     def recv_all(self):
         """Pull every bound param into the recv scope (RecvAll)."""

@@ -1,0 +1,543 @@
+"""Grad-check inventory — CI enforcement that EVERY op in the registry
+with a gradient kernel has a finite-difference check (VERDICT r1 item 6;
+reference: unittests/op_test.py:907 check_grad over ~400 op-test files).
+
+Three coverage sources:
+1. literal check_grad("op", ...) / analytic_grads("op", ...) calls in any
+   test file (scanned from source),
+2. the SPECS table here (one tiny fd check per entry, run by
+   test_spec_grad_checks),
+3. EXCEPTIONS — ops whose gradient cannot be finite-difference checked at
+   the single-op level, each with the reason and a pointer to where the
+   grad path IS exercised.
+
+test_every_grad_op_is_covered fails when a newly registered grad-bearing
+op lands in none of the three.
+"""
+
+import glob
+import os
+import re
+
+import numpy as np
+import pytest
+
+from op_test import check_grad
+
+RNG = np.random.RandomState(7)
+
+
+def _u(*shape):           # smooth-domain generic input
+    return (RNG.rand(*shape) * 1.6 - 0.8).astype("float64")
+
+
+def _pos(*shape):         # strictly positive (log/sqrt/rsqrt domains)
+    return (RNG.rand(*shape) * 0.9 + 0.1).astype("float64")
+
+
+def _away(*shape):        # bounded away from 0 (abs/relu kinks, divisors)
+    x = RNG.rand(*shape) + 0.2
+    return (x * RNG.choice([-1.0, 1.0], size=shape)).astype("float64")
+
+
+def _distinct(*shape):    # well-separated values (max/min/top-k kinks)
+    n = int(np.prod(shape))
+    return (RNG.permutation(n).astype("float64").reshape(shape) / 7.0)
+
+
+def _spd(n):              # symmetric positive definite (cholesky)
+    a = RNG.rand(n, n)
+    return (a @ a.T + n * np.eye(n)).astype("float64")
+
+
+# op -> (inputs, attrs, inputs_to_check, output_name, tolerances-dict)
+SPECS = {
+    # ---- unary elementwise / activations ------------------------------
+    "abs": ({"X": _away(3, 4)}, {}, ["X"], "Out", {}),
+    "acos": ({"X": _u(3, 4) * 0.8}, {}, ["X"], "Out", {}),
+    "asin": ({"X": _u(3, 4) * 0.8}, {}, ["X"], "Out", {}),
+    "atan": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "cos": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "cosh": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "sin": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "sinh": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "tan": ({"X": _u(3, 4) * 0.6}, {}, ["X"], "Out", {}),
+    "erf": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "exp": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "log": ({"X": _pos(3, 4)}, {}, ["X"], "Out", {}),
+    "log2": ({"X": _pos(3, 4)}, {}, ["X"], "Out", {}),
+    "log10": ({"X": _pos(3, 4)}, {}, ["X"], "Out", {}),
+    "log1p": ({"X": _pos(3, 4)}, {}, ["X"], "Out", {}),
+    "reciprocal": ({"X": _away(3, 4)}, {}, ["X"], "Out", {}),
+    "rsqrt": ({"X": _pos(3, 4)}, {}, ["X"], "Out", {}),
+    "sqrt": ({"X": _pos(3, 4)}, {}, ["X"], "Out", {}),
+    "square": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "pow": ({"X": _pos(3, 4)}, {"factor": 2.5}, ["X"], "Out", {}),
+    "scale": ({"X": _u(3, 4)}, {"scale": 1.7, "bias": 0.3}, ["X"], "Out",
+              {}),
+    "assign": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "cast": ({"X": _u(3, 4)}, {"out_dtype": "float64"}, ["X"], "Out", {}),
+    "brelu": ({"X": _away(3, 4) * 5}, {"t_min": 0.5, "t_max": 10.0},
+              ["X"], "Out", {}),
+    "elu": ({"X": _away(3, 4)}, {"alpha": 1.1}, ["X"], "Out", {}),
+    "gelu": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "hard_shrink": ({"X": _away(3, 4)}, {"threshold": 0.1}, ["X"], "Out",
+                    {}),
+    "hard_sigmoid": ({"X": _u(3, 4) * 0.5}, {}, ["X"], "Out", {}),
+    "hard_swish": ({"X": _u(3, 4) + 5.0}, {}, ["X"], "Out", {}),
+    "leaky_relu": ({"X": _away(3, 4)}, {"alpha": 0.1}, ["X"], "Out", {}),
+    "logsigmoid": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "mish": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "relu": ({"X": _away(3, 4)}, {}, ["X"], "Out", {}),
+    "relu6": ({"X": _away(3, 4)}, {}, ["X"], "Out", {}),
+    "selu": ({"X": _away(3, 4)}, {}, ["X"], "Out", {}),
+    "sigmoid": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "silu": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "softplus": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "softshrink": ({"X": _away(3, 4)}, {"lambda": 0.1}, ["X"], "Out", {}),
+    "softsign": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "stanh": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "swish": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "tanh_shrink": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "thresholded_relu": ({"X": _away(3, 4) * 5}, {"threshold": 0.5},
+                         ["X"], "Out", {}),
+    "clip": ({"X": _away(3, 4) * 2}, {"min": -1.5, "max": 1.5}, ["X"],
+             "Out", {}),
+    "clip_by_norm": ({"X": _u(3, 4)}, {"max_norm": 0.7}, ["X"], "Out",
+                     {}),
+    # ---- shape / movement ---------------------------------------------
+    "reshape": ({"X": _u(3, 4)}, {"shape": [2, 6]}, ["X"], "Out", {}),
+    "reshape2": ({"X": _u(3, 4)}, {"shape": [6, 2]}, ["X"], "Out", {}),
+    "flatten": ({"X": _u(2, 3, 2)}, {"axis": 1}, ["X"], "Out", {}),
+    "flatten2": ({"X": _u(2, 3, 2)}, {"axis": 2}, ["X"], "Out", {}),
+    "squeeze": ({"X": _u(3, 1, 4)}, {"axes": [1]}, ["X"], "Out", {}),
+    "squeeze2": ({"X": _u(3, 1, 4)}, {"axes": [1]}, ["X"], "Out", {}),
+    "unsqueeze": ({"X": _u(3, 4)}, {"axes": [1]}, ["X"], "Out", {}),
+    "unsqueeze2": ({"X": _u(3, 4)}, {"axes": [0]}, ["X"], "Out", {}),
+    "transpose": ({"X": _u(2, 3, 4)}, {"axis": [2, 0, 1]}, ["X"], "Out",
+                  {}),
+    "transpose2": ({"X": _u(2, 3, 4)}, {"axis": [1, 0, 2]}, ["X"], "Out",
+                   {}),
+    "reverse": ({"X": _u(3, 4)}, {"axis": [1]}, ["X"], "Out", {}),
+    "tile": ({"X": _u(2, 3)}, {"repeat_times": [2, 2]}, ["X"], "Out", {}),
+    "expand": ({"X": _u(2, 3)}, {"expand_times": [2, 2]}, ["X"], "Out",
+               {}),
+    "expand_as": ({"X": _u(1, 3), "target_tensor": _u(4, 3)},
+                  {}, ["X"], "Out", {}),
+    "slice": ({"Input": _u(4, 5)},
+              {"axes": [0, 1], "starts": [1, 0], "ends": [3, 4]},
+              ["Input"], "Out", {}),
+    "strided_slice": ({"Input": _u(6, 5)},
+                      {"axes": [0], "starts": [0], "ends": [6],
+                       "strides": [2]}, ["Input"], "Out", {}),
+    "crop": ({"X": _u(4, 5)}, {"shape": [2, 3], "offsets": [1, 1]},
+             ["X"], "Out", {}),
+    "crop_tensor": ({"X": _u(4, 5)}, {"shape": [2, 3], "offsets": [0, 2]},
+                    ["X"], "Out", {}),
+    "pad": ({"X": _u(2, 3)}, {"paddings": [1, 1, 0, 2], "pad_value": 0.5},
+            ["X"], "Out", {}),
+    "pad2d": ({"X": _u(1, 2, 3, 3)},
+              {"paddings": [1, 1, 1, 1], "mode": "constant"},
+              ["X"], "Out", {}),
+    "stack": ({"X": [_u(2, 3), _u(2, 3)]}, {"axis": 0}, ["X"], "Y", {}),
+    "unstack": ({"X": _u(3, 2)}, {"axis": 0, "num": 3}, ["X"], "Y", {}),
+    "split": ({"X": _u(4, 6)}, {"num": 2, "axis": 1}, ["X"], "Out", {}),
+    "concat": ({"X": [_u(2, 3), _u(2, 3)]}, {"axis": 0}, ["X"], "Out",
+               {}),
+    "sum": ({"X": [_u(2, 3), _u(2, 3)]}, {}, ["X"], "Out", {}),
+    "where": ({"Condition": RNG.rand(3, 4) > 0.5, "X": _u(3, 4),
+               "Y": _u(3, 4)}, {}, ["X", "Y"], "Out", {}),
+    "gather": ({"X": _u(5, 3), "Index": np.array([0, 2, 2], "int64")},
+               {}, ["X"], "Out", {}),
+    "gather_nd": ({"X": _u(3, 4),
+                   "Index": np.array([[0, 1], [2, 2]], "int64")},
+                  {}, ["X"], "Out", {}),
+    "scatter": ({"X": _u(5, 3), "Ids": np.array([1, 3], "int64"),
+                 "Updates": _u(2, 3)}, {}, ["X", "Updates"], "Out", {}),
+    "scatter_nd_add": ({"X": _u(4, 3),
+                        "Index": np.array([[1], [3]], "int64"),
+                        "Updates": _u(2, 3)},
+                       {}, ["X", "Updates"], "Out", {}),
+    "index_select": ({"X": _u(4, 3),
+                      "Index": np.array([0, 2], "int64")},
+                     {"dim": 0}, ["X"], "Out", {}),
+    "multiplex": ({"X": [_u(3, 4), _u(3, 4)],
+                   "Ids": np.array([[0], [1], [0]], "int64")},
+                  {}, ["X"], "Out", {}),
+    # ---- reductions / linalg ------------------------------------------
+    "reduce_mean": ({"X": _u(3, 4)}, {"dim": [1]}, ["X"], "Out", {}),
+    "reduce_max": ({"X": _distinct(3, 4)}, {"dim": [1]}, ["X"], "Out",
+                   {}),
+    "reduce_min": ({"X": _distinct(3, 4)}, {"dim": [0]}, ["X"], "Out",
+                   {}),
+    "reduce_prod": ({"X": _away(2, 3)}, {"dim": [1]}, ["X"], "Out", {}),
+    "max": ({"X": _distinct(3, 4), "Y": _distinct(3, 4) + 0.03}, {},
+            ["X", "Y"], "Out", {}),
+    "mean": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "logsumexp": ({"X": _u(3, 4)}, {"dim": [1]}, ["X"], "Out", {}),
+    "frobenius_norm": ({"X": _u(3, 4)}, {"dim": [1]}, ["X"], "Out", {}),
+    "norm": ({"X": _away(3, 4)}, {"axis": 1}, ["X"], "Out", {}),
+    "p_norm": ({"X": _away(3, 4)}, {"axis": 1, "porder": 2.0}, ["X"],
+               "Out", {}),
+    "squared_l2_norm": ({"X": _u(3, 4)}, {}, ["X"], "Out", {}),
+    "trace": ({"Input": _u(4, 4)}, {}, ["Input"], "Out", {}),
+    "cumsum": ({"X": _u(3, 4)}, {"axis": 1}, ["X"], "Out", {}),
+    "dot": ({"X": _u(3, 4), "Y": _u(3, 4)}, {}, ["X", "Y"], "Out", {}),
+    "bmm": ({"X": _u(2, 3, 4), "Y": _u(2, 4, 2)}, {}, ["X", "Y"], "Out",
+            {}),
+    "matmul_v2": ({"X": _u(3, 4), "Y": _u(4, 2)}, {}, ["X", "Y"], "Out",
+                  {}),
+    "addmm": ({"Input": _u(3, 2), "X": _u(3, 4), "Y": _u(4, 2)},
+              {"Alpha": 1.0, "Beta": 1.0}, ["Input", "X", "Y"], "Out",
+              {}),
+    "kron": ({"X": _u(2, 2), "Y": _u(3, 2)}, {}, ["X", "Y"], "Out", {}),
+    "cholesky": ({"X": _spd(3)}, {}, ["X"], "Out",
+                 {"max_relative_error": 2e-2}),
+    "inverse": ({"Input": _spd(3)}, {}, ["Input"], "Out",
+                {"max_relative_error": 2e-2}),
+    "diag": ({"Diagonal": _u(4)}, {}, ["Diagonal"], "Out", {}),
+    # ---- binary elementwise -------------------------------------------
+    "elementwise_add": ({"X": _u(3, 4), "Y": _u(4)}, {}, ["X", "Y"],
+                        "Out", {}),
+    "elementwise_sub": ({"X": _u(3, 4), "Y": _u(3, 4)}, {}, ["X", "Y"],
+                        "Out", {}),
+    "elementwise_mul": ({"X": _u(3, 4), "Y": _u(3, 4)}, {}, ["X", "Y"],
+                        "Out", {}),
+    "elementwise_div": ({"X": _u(3, 4), "Y": _away(3, 4)}, {},
+                        ["X", "Y"], "Out", {}),
+    "elementwise_max": ({"X": _distinct(3, 4),
+                         "Y": _distinct(3, 4) + 0.03}, {}, ["X", "Y"],
+                        "Out", {}),
+    "elementwise_min": ({"X": _distinct(3, 4),
+                         "Y": _distinct(3, 4) + 0.03}, {}, ["X", "Y"],
+                        "Out", {}),
+    "elementwise_pow": ({"X": _pos(3, 4) + 0.5, "Y": _u(3, 4)}, {},
+                        ["X", "Y"], "Out", {}),
+    "maximum": ({"X": _distinct(3, 4), "Y": _distinct(3, 4) + 0.03}, {},
+                ["X", "Y"], "Out", {}),
+    "minimum": ({"X": _distinct(3, 4), "Y": _distinct(3, 4) + 0.03}, {},
+                ["X", "Y"], "Out", {}),
+    "minus": ({"X": _u(3, 4), "Y": _u(3, 4)}, {}, ["X", "Y"], "Out", {}),
+    "pad_constant_like": ({"X": np.zeros((4, 5)), "Y": _u(2, 3)},
+                          {"pad_value": 1.0}, ["Y"], "Out", {}),
+    # ---- losses --------------------------------------------------------
+    "bce_loss": ({"X": _pos(3, 4) * 0.8 + 0.05,
+                  "Label": (RNG.rand(3, 4) > 0.5).astype("float64")},
+                 {}, ["X"], "Out", {}),
+    "log_loss": ({"Predicted": _pos(4, 1) * 0.8 + 0.05,
+                  "Labels": (RNG.rand(4, 1) > 0.5).astype("float64")},
+                 {"epsilon": 1e-4}, ["Predicted"], "Loss", {}),
+    "hinge_loss": ({"Logits": _away(4, 1),
+                    "Labels": (RNG.rand(4, 1) > 0.5).astype("float64")},
+                   {}, ["Logits"], "Loss", {}),
+    "rank_loss": ({"Label": (RNG.rand(4, 1) > 0.5).astype("float64"),
+                   "Left": _u(4, 1), "Right": _u(4, 1)},
+                  {}, ["Left", "Right"], "Out", {}),
+    "margin_rank_loss": ({"Label": np.ones((4, 1)),
+                          "X1": _u(4, 1), "X2": _u(4, 1) + 2.0},
+                         {"margin": 0.1}, ["X1", "X2"], "Out", {}),
+    "bpr_loss": ({"X": _u(3, 5),
+                  "Label": RNG.randint(0, 5, (3, 1)).astype("int64")},
+                 {}, ["X"], "Y", {}),
+    "square_error_cost": ({"X": _u(3, 4), "Y": _u(3, 4)}, {},
+                          ["X", "Y"], "Out", {}),
+    "smooth_l1_loss": ({"X": _u(3, 4), "Y": _u(3, 4) + 3.0}, {}, ["X"],
+                       "Out", {}),
+    "huber_loss": ({"X": _u(3, 1), "Y": _u(3, 1) + 3.0},
+                   {"delta": 1.0}, ["X"], "Out", {}),
+    "kldiv_loss": ({"X": _pos(3, 4), "Target": _pos(3, 4)},
+                   {"reduction": "mean"}, ["X"], "Loss", {}),
+    "cross_entropy": ({"X": _pos(3, 4) / 4.0,
+                       "Label": RNG.randint(0, 4, (3, 1)).astype("int64")},
+                      {"soft_label": False}, ["X"], "Y", {}),
+    "softmax_with_cross_entropy": (
+        {"Logits": _u(3, 5),
+         "Label": RNG.randint(0, 5, (3, 1)).astype("int64")},
+        {}, ["Logits"], "Loss", {}),
+    "sigmoid_cross_entropy_with_logits": (
+        {"X": _u(3, 4), "Label": RNG.rand(3, 4).astype("float64")},
+        {}, ["X"], "Out", {}),
+    "log_softmax": ({"X": _u(3, 5)}, {"axis": -1}, ["X"], "Out", {}),
+    "label_smooth": ({"X": _pos(3, 5) / 5.0}, {"epsilon": 0.1}, ["X"],
+                     "Out", {}),
+    "modified_huber_loss": ({"X": _u(4, 1),
+                             "Y": (RNG.rand(4, 1) > 0.5).astype(
+                                 "float64")},
+                            {}, ["X"], "Out", {}),
+    "teacher_student_sigmoid_loss": (
+        {"X": _u(4, 1), "Label": _pos(4, 1) * 0.3}, {}, ["X"], "Y", {}),
+    "npair_loss": ({"Anchor": _u(3, 4), "Positive": _u(3, 4),
+                    "Labels": np.arange(3).astype("int64")},
+                   {"l2_reg": 0.002}, ["Anchor", "Positive"], "Out", {}),
+    "center_loss": ({"X": _u(4, 3),
+                     "Label": RNG.randint(0, 3, (4, 1)).astype("int64"),
+                     "Centers": _u(3, 3),
+                     "CenterUpdateRate": np.array([0.1])},
+                    {"cluster_num": 3, "need_update": False}, ["X"],
+                    "Loss", {}),
+    # ---- structured NN -------------------------------------------------
+    "batch_norm": ({"X": _u(3, 2, 4, 4), "Scale": _pos(2),
+                    "Bias": _u(2), "Mean": np.zeros(2),
+                    "Variance": np.ones(2)},
+                   {"epsilon": 1e-5, "is_test": False},
+                   ["X", "Scale", "Bias"], "Y",
+                   {"max_relative_error": 2e-2}),
+    "group_norm": ({"X": _u(2, 4, 3, 3), "Scale": _pos(4), "Bias": _u(4)},
+                   {"groups": 2, "epsilon": 1e-5},
+                   ["X", "Scale", "Bias"], "Y",
+                   {"max_relative_error": 2e-2}),
+    "instance_norm": ({"X": _u(2, 3, 4, 4), "Scale": _pos(3),
+                       "Bias": _u(3)}, {"epsilon": 1e-5},
+                      ["X", "Scale", "Bias"], "Y",
+                      {"max_relative_error": 2e-2}),
+    "data_norm": ({"X": _u(3, 4), "BatchSize": np.full(4, 10.0),
+                   "BatchSum": _u(4) * 10, "BatchSquareSum": _pos(4) * 50},
+                  {}, ["X"], "Y", {}),
+    "l2_normalize": ({"X": _away(3, 4)}, {"axis": 1}, ["X"], "Out", {}),
+    "lrn": ({"X": _pos(1, 4, 3, 3)}, {"n": 3}, ["X"], "Out", {}),
+    "prelu": ({"X": _away(3, 4), "Alpha": _pos(1)},
+              {"mode": "all"}, ["X", "Alpha"], "Out", {}),
+    "maxout": ({"X": _distinct(1, 4, 3, 3)}, {"groups": 2}, ["X"], "Out",
+               {}),
+    "conv3d": ({"Input": _u(1, 2, 4, 4, 4), "Filter": _u(3, 2, 2, 2, 2)},
+               {"strides": [1, 1, 1], "paddings": [0, 0, 0]},
+               ["Input", "Filter"], "Output",
+               {"max_relative_error": 2e-2}),
+    "conv2d_transpose": ({"Input": _u(1, 3, 4, 4),
+                          "Filter": _u(3, 2, 3, 3)},
+                         {"strides": [2, 2], "paddings": [1, 1]},
+                         ["Input", "Filter"], "Output",
+                         {"max_relative_error": 2e-2}),
+    "conv3d_transpose": ({"Input": _u(1, 2, 3, 3, 3),
+                          "Filter": _u(2, 2, 2, 2, 2)},
+                         {"strides": [1, 1, 1], "paddings": [0, 0, 0]},
+                         ["Input", "Filter"], "Output",
+                         {"max_relative_error": 2e-2}),
+    "depthwise_conv2d": ({"Input": _u(1, 3, 5, 5),
+                          "Filter": _u(3, 1, 3, 3)},
+                         {"strides": [1, 1], "paddings": [1, 1],
+                          "groups": 3}, ["Input", "Filter"], "Output",
+                         {"max_relative_error": 2e-2}),
+    "pool2d": ({"X": _distinct(1, 2, 4, 4)},
+               {"pooling_type": "max", "ksize": [2, 2],
+                "strides": [2, 2]}, ["X"], "Out", {}),
+    "pool3d": ({"X": _distinct(1, 1, 4, 4, 4)},
+               {"pooling_type": "avg", "ksize": [2, 2, 2],
+                "strides": [2, 2, 2]}, ["X"], "Out", {}),
+    "max_pool3d_with_index": ({"X": _distinct(1, 1, 4, 4, 4)},
+                              {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                               "paddings": [0, 0, 0]}, ["X"], "Out", {}),
+    "bilinear_interp": ({"X": _u(1, 2, 3, 3)},
+                        {"out_h": 5, "out_w": 5, "align_corners": True},
+                        ["X"], "Out", {}),
+    "nearest_interp": ({"X": _u(1, 2, 3, 3)}, {"out_h": 6, "out_w": 6},
+                       ["X"], "Out", {}),
+    "grid_sampler": ({"X": _u(1, 2, 4, 4), "Grid": _u(1, 3, 3, 2) * 0.8},
+                     {}, ["X", "Grid"], "Output",
+                     {"max_relative_error": 2e-2}),
+    "affine_grid": ({"Theta": _u(2, 2, 3)},
+                    {"output_shape": [2, 1, 3, 3]}, ["Theta"], "Output",
+                    {}),
+    "spectral_norm": ({"Weight": _u(3, 4), "U": _pos(3), "V": _pos(4)},
+                      {"power_iters": 1}, ["Weight"], "Out",
+                      {"max_relative_error": 2e-2}),
+    "pixel_shuffle": ({"X": _u(1, 4, 2, 2)}, {"upscale_factor": 2},
+                      ["X"], "Out", {}),
+    "shuffle_channel": ({"X": _u(1, 4, 2, 2)}, {"group": 2}, ["X"],
+                        "Out", {}),
+    "space_to_depth": ({"X": _u(1, 2, 4, 4)}, {"blocksize": 2}, ["X"],
+                       "Out", {}),
+    "temporal_shift": ({"X": _u(4, 4, 2, 2)},
+                       {"seg_num": 2, "shift_ratio": 0.25}, ["X"], "Out",
+                       {}),
+    "unfold": ({"X": _u(1, 2, 4, 4)},
+               {"kernel_sizes": [2, 2], "strides": [2, 2]}, ["X"], "Y",
+               {}),
+    "im2sequence": ({"X": _u(1, 1, 4, 4)},
+                    {"kernels": [2, 2], "strides": [2, 2]}, ["X"], "Out",
+                    {}),
+    "add_position_encoding": ({"X": _u(2, 4, 6)},
+                              {"alpha": 1.0, "beta": 1.0}, ["X"], "Out",
+                              {}),
+    "conv_shift": ({"X": _u(2, 7), "Y": _u(2, 3)}, {}, ["X", "Y"], "Out",
+                   {}),
+    "roi_align": ({"X": _u(1, 2, 6, 6),
+                   "ROIs": np.array([[0.5, 0.5, 4.0, 4.0]])},
+                  {"pooled_height": 2, "pooled_width": 2,
+                   "spatial_scale": 1.0}, ["X"], "Out", {}),
+    "roi_pool": ({"X": _distinct(1, 2, 6, 6),
+                  "ROIs": np.array([[0.0, 0.0, 4.0, 4.0]])},
+                 {"pooled_height": 2, "pooled_width": 2,
+                  "spatial_scale": 1.0}, ["X"], "Out", {}),
+    "psroi_pool": ({"X": _u(1, 4, 6, 6),
+                    "ROIs": np.array([[0.0, 0.0, 5.0, 5.0]])},
+                   {"pooled_height": 2, "pooled_width": 2,
+                    "output_channels": 1, "spatial_scale": 1.0},
+                   ["X"], "Out", {}),
+    "top_k": ({"X": _distinct(3, 6)}, {"k": 2}, ["X"], "Out", {}),
+    "top_k_v2": ({"X": _distinct(3, 6)}, {"k": 2}, ["X"], "Out", {}),
+    # ---- embeddings ----------------------------------------------------
+    "lookup_table_v2": ({"W": _u(6, 3),
+                         "Ids": np.array([1, 4, 1], "int64")},
+                        {}, ["W"], "Out", {}),
+    "c_embedding": ({"W": _u(6, 3),
+                     "Ids": np.array([[1], [4]], "int64")},
+                    {"start_index": 0}, ["W"], "Out", {}),
+    "embedding_with_scaled_gradient": (
+        {"W": _u(6, 3), "Ids": np.array([[1], [4], [1]], "int64")},
+        {}, ["W"], "Out", {}),
+    # ---- sequence family (padded-batch + Length convention) ------------
+    "sequence_concat": ({"X": [_u(2, 3, 2), _u(2, 3, 2)],
+                         "Length": [np.array([2, 3], "int64"),
+                                    np.array([3, 1], "int64")]},
+                        {}, ["X"], "Out", {}),
+    "sequence_expand": ({"X": _u(2, 2, 3), "Y": _u(2, 4, 3),
+                         "Length": [np.array([2, 1], "int64"),
+                                    np.array([2, 3], "int64")]},
+                        {"ref_level": 0}, ["X"], "Out", {}),
+    "sequence_expand_as": ({"X": _u(2, 3), "Y": _u(2, 4, 3),
+                            "Length": [np.array([3, 2], "int64")]},
+                           {}, ["X"], "Out", {}),
+    "sequence_pad": ({"X": _u(2, 4, 3), "PadValue": np.zeros(1),
+                      "Length": np.array([3, 2], "int64")},
+                     {"padded_length": 4}, ["X"], "Out", {}),
+    "sequence_unpad": ({"X": _u(2, 4, 3),
+                        "Length": np.array([3, 2], "int64")},
+                       {}, ["X"], "Out", {}),
+    "sequence_pool": ({"X": _u(2, 4, 3),
+                       "Length": np.array([3, 2], "int64")},
+                      {"pooltype": "SUM"}, ["X"], "Out", {}),
+    "sequence_reshape": ({"X": _u(2, 4, 4)}, {"new_dim": 8}, ["X"],
+                         "Out", {}),
+    "sequence_reverse": ({"X": _u(2, 4, 3),
+                          "Length": np.array([3, 2], "int64")},
+                         {}, ["X"], "Y", {}),
+    "sequence_softmax": ({"X": _u(2, 4),
+                          "Length": np.array([3, 2], "int64")},
+                         {}, ["X"], "Out", {}),
+    "sequence_slice": ({"X": _u(2, 5, 2),
+                        "Offset": np.array([1], "int64")},
+                       {"length": 2}, ["X"], "Out", {}),
+    "sequence_scatter": ({"X": _u(2, 6), "Ids": np.array(
+        [[0, 1, 2], [2, 3, 4]], "int64"), "Updates": _u(2, 3),
+        "Length": np.array([3, 3], "int64")},
+        {}, ["X", "Updates"], "Out", {}),
+    "sequence_topk_avg_pooling": (
+        {"X": _distinct(1, 2, 4, 4), "ROW": np.array([4], "int64"),
+         "COLUMN": np.array([4], "int64")},
+        {"topks": [1, 2], "channel_num": 2}, ["X"], "Out", {}),
+    # ---- RNN scans -----------------------------------------------------
+    "lstm_v2": ({"Input": _u(2, 3, 4), "Weight": _u(6, 8)},
+                {"hidden_size": 2}, ["Input", "Weight"], "Hidden",
+                {"max_relative_error": 2e-2}),
+    "dynamic_lstm_v2": ({"Input": _u(2, 3, 8), "Weight": _u(2, 8)},
+                        {"hidden_size": 2}, ["Input", "Weight"],
+                        "Hidden", {"max_relative_error": 2e-2}),
+    "gru_v2": ({"Input": _u(2, 3, 4), "Weight": _u(6, 6)},
+               {"hidden_size": 2}, ["Input", "Weight"], "Hidden",
+               {"max_relative_error": 2e-2}),
+    "dynamic_gru_v2": ({"Input": _u(2, 3, 6), "Weight": _u(2, 6)},
+                       {"hidden_size": 2}, ["Input", "Weight"], "Hidden",
+                       {"max_relative_error": 2e-2}),
+    # ---- text/CTR structured ------------------------------------------
+    "match_matrix_tensor": ({"X": _u(2, 3, 4), "Y": _u(2, 5, 4),
+                             "W": _u(4, 2, 4)}, {"dim_t": 2},
+                            ["X", "Y", "W"], "Out", {}),
+    "var_conv_2d": ({"X": _u(1, 2, 4, 4),
+                     "W": _u(3, 2 * 3 * 3),
+                     "ROW": np.array([4], "int64"),
+                     "COLUMN": np.array([4], "int64")},
+                    {"kernel_h": 3, "kernel_w": 3, "output_channel": 3},
+                    ["X", "W"], "Out", {"max_relative_error": 2e-2}),
+    "tree_conv": ({"NodesVector": _u(1, 4, 3),
+                   "EdgeSet": np.array(
+                       [[[1, 0], [2, 0], [3, 1]]], "int64"),
+                   "Filter": _u(3, 3, 2)},
+                  {"max_depth": 2}, ["NodesVector", "Filter"], "Out",
+                  {"max_relative_error": 2e-2}),
+    "filter_by_instag": ({"Ins": _u(4, 3),
+                          "Ins_tag": np.array([[1], [2], [1], [2]],
+                                              "int64"),
+                          "Filter_tag": np.array([2], "int64")},
+                         {}, ["Ins"], "Out", {}),
+}
+
+# op -> reason it cannot be single-op fd-checked + where its grad path IS
+# exercised instead
+EXCEPTIONS = {
+    "c_allreduce_sum": "collective: needs a mesh/shard_map context "
+                       "(grads exercised in tests/test_distributed.py)",
+    "c_allgather": "collective (tests/test_distributed.py)",
+    "c_broadcast": "collective (tests/test_distributed.py)",
+    "c_reducescatter": "collective (tests/test_distributed.py)",
+    "c_ppermute": "collective (tests/test_pipeline_gpt.py ppermute path)",
+    "sync_batch_norm": "needs a 'dp' mesh axis for the psum "
+                       "(tests/test_models_parallel.py)",
+    "cond": "control flow over sub-blocks; grads exercised in "
+            "tests/test_backward.py cond tests",
+    "scan": "control flow over sub-blocks (tests/test_backward.py)",
+    "select_input": "control-flow plumbing op (tests/test_backward.py)",
+    "dropout": "output depends on the op-uid-folded rng; fd probes would "
+               "need bitwise-identical masks across probe programs — the "
+               "deterministic-mask grad is exercised in "
+               "tests/test_ops_nn.py dropout tests",
+    "nce": "negative samples drawn from op rng; loss surface is not a "
+           "fixed function of the inputs (tests/test_classify.py)",
+    "sampled_softmax_with_cross_entropy":
+        "random sampling path; the customized-samples path IS fd-checked "
+        "via sample_logits (tests/test_round2_ops.py)",
+    "py_func": "gradient defined by a user Python callable "
+               "(tests/test_round2_ops.py end-to-end)",
+    "distributed_lookup_table": "pushes sparse grads to live pservers "
+                                "(tests/test_ps.py end-to-end)",
+    "fake_quantize_dequantize_abs_max":
+        "straight-through estimator: analytic grad intentionally differs "
+        "from the true (a.e. zero) derivative (tests/test_slim.py)",
+    "fake_channel_wise_quantize_dequantize_abs_max":
+        "straight-through estimator (tests/test_slim.py)",
+    "fake_quantize_dequantize_moving_average_abs_max":
+        "straight-through estimator (tests/test_slim.py)",
+    "yolov3_loss": "composite detection loss with in-op target assignment "
+                   "(forward parity in tests/test_detection_ops.py; "
+                   "assignment makes fd probes cross discrete boundaries)",
+}
+
+
+def _literal_checked():
+    """Scan test sources for literal check_grad / analytic_grads names."""
+    names = set()
+    here = os.path.dirname(os.path.abspath(__file__))
+    for f in glob.glob(os.path.join(here, "*.py")):
+        src = open(f).read()
+        names.update(re.findall(r'check_grad\(\s*[\'"](\w+)[\'"]', src))
+        names.update(re.findall(r'analytic_grads\(\s*[\'"](\w+)[\'"]',
+                                src))
+    return names
+
+
+def _grad_ops():
+    import paddle_tpu  # noqa: F401 — registers every op
+    from paddle_tpu.core.registry import _REGISTRY
+
+    return sorted(n for n, d in _REGISTRY.items()
+                  if d.grad is not None and not n.endswith("_grad"))
+
+
+def test_every_grad_op_is_covered():
+    """CI enforcement: a grad-bearing op must be fd-checked somewhere —
+    literally in a test, via SPECS here, or appear in EXCEPTIONS with a
+    documented reason."""
+    covered = _literal_checked() | set(SPECS) | set(EXCEPTIONS)
+    missing = [n for n in _grad_ops() if n not in covered]
+    assert not missing, (
+        f"{len(missing)} grad-bearing ops have no finite-difference "
+        f"check and no documented exception: {missing} — add a SPECS "
+        f"entry (or a justified EXCEPTIONS entry) in "
+        f"tests/test_grad_inventory.py")
+
+
+@pytest.mark.parametrize("op_type", sorted(SPECS))
+def test_spec_grad_checks(op_type):
+    inputs, attrs, to_check, out_name, tol = SPECS[op_type]
+    check_grad(op_type, inputs, attrs, inputs_to_check=to_check,
+               output_name=out_name, **tol)
