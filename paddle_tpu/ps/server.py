@@ -105,6 +105,67 @@ class ParameterServer:
 
     # -- optimize-block execution (shared op registry) ---------------------
 
+    def _np_fast_opt(self, od: dict, env: Dict[str, Any]) -> bool:
+        """Pure-numpy fast path for the common optimize descs (sgd, adam,
+        momentum) — mirrors ops/optimizer_ops.py exactly. The generic
+        per-desc jax-eager path costs ~1.3 ms per push in dispatch
+        overhead alone (tools/ctr_bench.py), which dominates the async
+        server's apply-per-arrival mode; numpy does the same math in the
+        memory-bound ~0.1 ms."""
+        t = od["type"]
+        if t not in ("sgd", "adam", "momentum"):
+            return False
+        ins, outs, attrs = od["inputs"], od["outputs"], od.get("attrs", {})
+
+        def gi(slot):
+            names = ins.get(slot) or []
+            return env.get(names[0]) if names else None
+
+        def so(slot, val):
+            names = outs.get(slot) or []
+            if names and names[0]:
+                env[names[0]] = val
+
+        p = np.asarray(gi("Param"))
+        g = np.asarray(gi("Grad"))
+        lr = float(np.asarray(gi("LearningRate")).reshape(-1)[0])
+        if t == "sgd":
+            so("ParamOut", p - lr * g.astype(p.dtype))
+            return True
+        if t == "momentum":
+            v = np.asarray(gi("Velocity"))
+            mu = float(attrs.get("mu", 0.9))
+            v_new = mu * v + g
+            if attrs.get("use_nesterov", False):
+                p_new = p - (g + mu * v_new) * lr
+            else:
+                p_new = p - lr * v_new
+            so("ParamOut", p_new)
+            so("VelocityOut", v_new)
+            return True
+        # adam
+        m1 = np.asarray(gi("Moment1"))
+        m2 = np.asarray(gi("Moment2"))
+        b1p_arr = np.asarray(gi("Beta1Pow"))
+        b2p_arr = np.asarray(gi("Beta2Pow"))
+        b1p = b1p_arr.reshape(-1)[0]
+        b2p = b2p_arr.reshape(-1)[0]
+        b1 = np.float32(attrs.get("beta1", 0.9))
+        b2 = np.float32(attrs.get("beta2", 0.999))
+        eps = float(attrs.get("epsilon", 1e-8))
+        m1n = b1 * m1 + (1 - b1) * g
+        m2n = b2 * m2 + (1 - b2) * np.square(g)
+        lr_t = np.float32(lr) * np.sqrt(1 - b2p) / (1 - b1p)
+        so("ParamOut", (p - lr_t * m1n / (np.sqrt(m2n) + eps))
+           .astype(p.dtype))
+        so("Moment1Out", m1n)
+        so("Moment2Out", m2n)
+        # accumulator dtype preserved, product in array dtype (parity with
+        # the registry adam kernel's b1p * b1)
+        so("Beta1PowOut", b1p_arr * b1p_arr.dtype.type(b1))
+        so("Beta2PowOut", b2p_arr * b2p_arr.dtype.type(b2))
+        return True
+
     def _run_opt(self, vs: _VarState, name: str, grad: np.ndarray):
         """Run the param's shipped optimize OpDescs eagerly on CPU."""
         import jax
@@ -118,6 +179,8 @@ class ParameterServer:
             env[vs.grad_name] = grad
         env.update(self.aux)
         for od in vs.opt_descs:
+            if self._np_fast_opt(od, env):
+                continue
             op = OpDesc.from_dict(od)
             opdef = registry.get_op_def(op.type)
             ins = {slot: [env.get(n) for n in names]

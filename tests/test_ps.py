@@ -550,3 +550,66 @@ def test_async_communicator_flags_and_backpressure():
         comm.stop()
     finally:
         set_flags(old)
+
+
+def test_server_numpy_fast_opt_matches_registry_kernels():
+    """The server's _np_fast_opt numpy path must produce the SAME updates
+    as the registry optimizer kernels it mirrors (sgd/momentum/adam) —
+    otherwise the async server and the compiled trainer path silently
+    drift."""
+    from paddle_tpu.ps.server import ParameterServer, _VarState
+
+    rng = np.random.RandomState(3)
+    srv = ParameterServer.__new__(ParameterServer)  # no sockets needed
+    srv.aux = {}
+
+    cases = {
+        "sgd": ({"Param": ["w"], "Grad": ["w@GRAD"],
+                 "LearningRate": ["lr"]},
+                {"ParamOut": ["w"]}, {}, {}),
+        "momentum": ({"Param": ["w"], "Grad": ["w@GRAD"],
+                      "LearningRate": ["lr"], "Velocity": ["vel"]},
+                     {"ParamOut": ["w"], "VelocityOut": ["vel"]},
+                     {"mu": 0.9, "use_nesterov": True},
+                     {"vel": rng.rand(6).astype("float32")}),
+        "adam": ({"Param": ["w"], "Grad": ["w@GRAD"],
+                  "LearningRate": ["lr"], "Moment1": ["m1"],
+                  "Moment2": ["m2"], "Beta1Pow": ["b1"],
+                  "Beta2Pow": ["b2"]},
+                 {"ParamOut": ["w"], "Moment1Out": ["m1"],
+                  "Moment2Out": ["m2"], "Beta1PowOut": ["b1"],
+                  "Beta2PowOut": ["b2"]},
+                 {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+                 {"m1": rng.rand(6).astype("float32"),
+                  "m2": rng.rand(6).astype("float32"),
+                  "b1": np.array([0.9], "float32"),
+                  "b2": np.array([0.999], "float32")}),
+    }
+    for t, (ins, outs, attrs, aux) in cases.items():
+        desc = {"type": t, "inputs": ins, "outputs": outs, "attrs": attrs}
+        w0 = rng.rand(6).astype("float32")
+        g = rng.rand(6).astype("float32")
+
+        results = []
+        for use_fast in (True, False):
+            srv.aux = {"lr": np.array([0.1], "float32"),
+                       **{k: v.copy() for k, v in aux.items()}}
+            vs = _VarState(w0.copy(), [desc], "w@GRAD")
+            if not use_fast:
+                # force the generic jax-eager path
+                orig = srv._np_fast_opt
+                srv._np_fast_opt = lambda od, env: False
+                srv._run_opt(vs, "w", g)
+                srv._np_fast_opt = orig
+            else:
+                srv._run_opt(vs, "w", g)
+            results.append((vs.value.copy(),
+                            {k: np.asarray(v).copy()
+                             for k, v in srv.aux.items()}))
+        fast, slow = results
+        np.testing.assert_allclose(fast[0], slow[0], rtol=1e-6, atol=1e-7,
+                                   err_msg=f"{t}: param drift")
+        for k in slow[1]:
+            np.testing.assert_allclose(
+                fast[1][k], slow[1][k], rtol=1e-6, atol=1e-7,
+                err_msg=f"{t}: aux {k} drift")

@@ -1,0 +1,191 @@
+"""Downpour-flow CTR throughput benchmark (VERDICT r1 item 7).
+
+Measures, on one host (CPU — the CTR path is host-side by design):
+1. end-to-end Downpour worker flow samples/s: native datafeed batch →
+   distributed_embedding prefetch (pull_sparse RPC) → compiled step →
+   sparse grad push (reference: DownpourWorker loop downpour_worker.cc:611)
+2. raw PS sparse-table op throughput: pull_sparse rows/s and
+   push_sparse_grad rows/s over the TCP protocol
+3. raw dense push→optimize throughput on the server (adam desc applied
+   per arrival, the async-mode hot path)
+
+Prints one JSON line per metric. Run: python tools/ctr_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def bench_raw_sparse(client, n_iters=50, rows_per_call=512, V=100_000,
+                     D=16):
+    from paddle_tpu.ps.sparse_table import (init_sparse_table,
+                                            push_row_grads, pull_rows)
+
+    rng = np.random.RandomState(0)
+    init_sparse_table(client, "bench_table",
+                      rng.rand(V, D).astype("float32"))
+    ids = rng.randint(0, V, (n_iters, rows_per_call))
+    grads = rng.rand(n_iters, rows_per_call, D).astype("float32")
+
+    t0 = time.perf_counter()
+    for i in range(n_iters):
+        pull_rows(client, "bench_table", ids[i])
+    dt_pull = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for i in range(n_iters):
+        push_row_grads(client, "bench_table", ids[i], grads[i], lr=0.01)
+    dt_push = time.perf_counter() - t0
+    n_rows = n_iters * rows_per_call
+    print(json.dumps({
+        "metric": "ps_sparse_pull_rows_per_sec",
+        "value": round(n_rows / dt_pull, 1), "unit": "rows/s",
+        "detail": {"rows_per_call": rows_per_call, "dim": D,
+                   "servers": len(client.endpoints)}}), flush=True)
+    print(json.dumps({
+        "metric": "ps_sparse_push_rows_per_sec",
+        "value": round(n_rows / dt_push, 1), "unit": "rows/s",
+        "detail": {"rows_per_call": rows_per_call, "dim": D,
+                   "servers": len(client.endpoints)}}), flush=True)
+
+
+def bench_raw_dense(client, n_iters=100, dim=100_000):
+    """Dense push→adam-desc-apply per arrival (async-mode server path)."""
+    rng = np.random.RandomState(1)
+    adam_descs = [{
+        "type": "adam",
+        "inputs": {"Param": ["dw"], "Grad": ["dw@GRAD"],
+                   "LearningRate": ["dlr"], "Moment1": ["dm1"],
+                   "Moment2": ["dm2"], "Beta1Pow": ["db1"],
+                   "Beta2Pow": ["db2"]},
+        "outputs": {"ParamOut": ["dw"], "Moment1Out": ["dm1"],
+                    "Moment2Out": ["dm2"], "Beta1PowOut": ["db1"],
+                    "Beta2PowOut": ["db2"]},
+        "attrs": {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8},
+    }]
+    client.init_var("dw", np.zeros(dim, np.float32), adam_descs,
+                    grad_name="dw@GRAD")
+    client.init_aux("dlr", np.array([0.001], np.float32), owner="dw")
+    for an, v in (("dm1", np.zeros(dim)), ("dm2", np.zeros(dim)),
+                  ("db1", np.array([0.9])), ("db2", np.array([0.999]))):
+        client.init_aux(an, v.astype(np.float32), owner="dw")
+    g = rng.rand(dim).astype("float32")
+    client.push_grad("dw", g)  # warm the kernel caches
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        client.push_grad("dw", g)
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "ps_dense_adam_updates_per_sec",
+        "value": round(n_iters / dt, 1), "unit": "updates/s",
+        "detail": {"param_elems": dim,
+                   "elems_per_sec": round(n_iters * dim / dt, 1)}}),
+        flush=True)
+
+
+def bench_downpour_flow(client, tmpdir, V=100_000, D=16, batch=512,
+                        n_files=4, lines_per_file=4096):
+    import paddle_tpu as pt
+    from paddle_tpu.io_native import NativeDataset
+    from paddle_tpu.ps.sparse_table import init_sparse_table
+
+    rng = np.random.RandomState(2)
+    init_sparse_table(client, "flow_table",
+                      (rng.rand(V, D).astype("float32") * 0.1))
+    files = []
+    for i in range(n_files):
+        ids = rng.randint(0, V, (lines_per_file, 1))
+        clicks = (ids % 3 == 0).astype(np.float32)
+        path = os.path.join(tmpdir, f"ctr-{i}.txt")
+        np.savetxt(path, np.hstack([ids.astype(np.float32), clicks]),
+                   fmt="%.1f")
+        files.append(path)
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        w = pt.layers.data(name="wf", shape=[1], dtype="float32")
+        label = pt.layers.data(name="label", shape=[1], dtype="float32")
+        ids64 = pt.layers.cast(w, "int64")
+        emb = pt.layers.distributed_embedding(ids64, (V, D), "flow_table",
+                                              sparse_lr=0.1)
+        emb = pt.layers.reshape(emb, shape=[-1, D])
+        pred = pt.layers.fc(input=emb, size=1, act="sigmoid")
+        loss = pt.layers.mean(pt.layers.log_loss(pred, label))
+        pt.optimizer.Adam(0.01).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        ds = NativeDataset(slots=[("wf", (1,)), ("label", (1,))],
+                           batch_size=batch)
+        ds.set_filelist(files)
+        # warm epoch compiles the step
+        n_samples = 0
+        for feed in iter(ds):
+            exe.run(main, feed=feed, fetch_list=[loss])
+        t0 = time.perf_counter()
+        for _ in range(2):
+            ds2 = NativeDataset(slots=[("wf", (1,)), ("label", (1,))],
+                                batch_size=batch)
+            ds2.set_filelist(files)
+            for feed in iter(ds2):
+                exe.run(main, feed=feed, fetch_list=[loss])
+                n_samples += feed["wf"].shape[0]
+        dt = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "downpour_ctr_samples_per_sec",
+        "value": round(n_samples / dt, 1), "unit": "samples/s",
+        "detail": {"batch_size": batch, "vocab": V, "emb_dim": D,
+                   "servers": len(client.endpoints),
+                   "pipeline": "native datafeed -> pull_sparse -> "
+                               "step -> push_sparse"}}), flush=True)
+
+
+def main():
+    from paddle_tpu.ops.distributed import bind_client
+    from paddle_tpu.ps import ParameterServer, PSClient
+
+    ports = _free_ports(2)
+    eps = [f"127.0.0.1:{p}" for p in ports]
+    servers = [ParameterServer(ep, num_trainers=1, mode="async")
+               for ep in eps]
+    for s in servers:
+        s.start_background()
+    client = PSClient(eps)
+    bind_client(client)
+    try:
+        bench_raw_sparse(client)
+        bench_raw_dense(client)
+        with tempfile.TemporaryDirectory() as td:
+            bench_downpour_flow(client, td)
+    finally:
+        for s in servers:
+            s.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
