@@ -13,5 +13,6 @@ from . import imdb
 from . import imikolov
 from . import movielens
 from . import conll05
+from . import wmt14
 from . import wmt16
 from . import flowers

@@ -1,0 +1,81 @@
+"""WMT14 fr-en (reference: python/paddle/dataset/wmt14.py) — offline-
+synthetic fallback in the same style as wmt16: an invertible toy
+translation (target vocabulary is a fixed permutation of the source's)
+so seq2seq models have learnable structure. Samples are
+(src_ids, trg_ids, trg_ids_next) with the reference's conventions:
+src = [<s>] + words + [<e>], trg = [<s>] + words,
+trg_next = words + [<e>]; <s>=0, <e>=1, <unk>=2 (wmt14.py:49-52,
+reader_creator :81-110). API parity: train/test/gen take one dict_size
+shared by both sides; get_dict(dict_size, reverse=True) returns
+(src_idx->word, trg_idx->word) dicts like the reference (:155)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "gen", "get_dict", "fetch"]
+
+START = "<s>"
+END = "<e>"
+UNK = "<unk>"
+UNK_IDX = 2
+
+
+def _vocab_perm(size, seed=14):
+    from .wmt16 import _vocab_perm as base
+
+    return base(size, seed=seed)
+
+
+def _word_dict(lang, dict_size):
+    d = {START: 0, END: 1, UNK: 2}
+    for i in range(3, dict_size):
+        d[f"{lang}{i}"] = i
+    return d
+
+
+def get_dict(dict_size, reverse=True):
+    """(src_dict, trg_dict); reverse=True gives idx->word (reference
+    default)."""
+    src = _word_dict("fr", dict_size)
+    trg = _word_dict("en", dict_size)
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg
+
+
+def _creator(n, seed, dict_size):
+    if dict_size < 5:
+        raise ValueError("dict_size must be >= 5 (3 specials + tokens)")
+    perm = _vocab_perm(dict_size)
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            length = rng.randint(3, 12)
+            words = rng.randint(3, dict_size, length)
+            trg = perm[words - 3]    # plain permutation: one dict_size
+            src_ids = np.concatenate([[0], words, [1]])
+            trg_ids = np.concatenate([[0], trg])
+            trg_next = np.concatenate([trg, [1]])
+            yield src_ids.tolist(), trg_ids.tolist(), trg_next.tolist()
+
+    return reader
+
+
+def train(dict_size):
+    return _creator(2000, 0, dict_size)
+
+
+def test(dict_size):
+    return _creator(200, 1, dict_size)
+
+
+def gen(dict_size):
+    return _creator(200, 2, dict_size)
+
+
+def fetch():
+    """Download hook — a no-op for the synthetic fallback (reference
+    wmt14.py:166 downloads the tarballs)."""

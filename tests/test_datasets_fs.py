@@ -127,3 +127,27 @@ def test_movielens_train_test_share_structure():
     common = set(train_r) & set(test_r)
     assert len(common) > 5
     assert all(train_r[k] == test_r[k] for k in common)
+
+
+def test_wmt14_api_parity_and_learnable_mapping():
+    """reference wmt14.py API: train/test/gen(dict_size), get_dict
+    (reverse default True), sample = (src, trg, trg_next) with <s>/<e>
+    framing."""
+    from paddle_tpu.dataset import wmt14
+
+    samples = list(wmt14.train(50)())
+    assert len(samples) == 2000
+    src, trg, trg_next = samples[0]
+    assert src[0] == 0 and src[-1] == 1          # <s> words <e>
+    assert trg[0] == 0 and trg_next[-1] == 1     # shifted pair
+    assert trg[1:] == trg_next[:-1]
+    # deterministic invertible mapping: same source token -> same target
+    mapping = {}
+    for src, trg, _ in samples:
+        for s_tok, t_tok in zip(src[1:-1], trg[1:]):
+            assert mapping.setdefault(s_tok, t_tok) == t_tok
+    sd, td = wmt14.get_dict(50)
+    assert sd[0] == "<s>" and td[2] == "<unk>"
+    sd2, _ = wmt14.get_dict(50, reverse=False)
+    assert sd2["<s>"] == 0
+    wmt14.fetch()   # no-op hook

