@@ -8,6 +8,7 @@ from .nn import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .rnn import *  # noqa: F401,F403
+from . import distributions  # noqa: F401
 from .loss import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403

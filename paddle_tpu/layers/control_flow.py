@@ -19,7 +19,8 @@ from ..layer_helper import LayerHelper
 
 __all__ = ["cond", "cond_state", "While", "while_loop", "StaticRNN",
            "increment", "array_write", "array_read", "array_length",
-           "create_array", "less_than", "Switch", "case", "switch_case"]
+           "create_array", "less_than", "Switch", "case", "switch_case",
+           "DynamicRNN", "IfElse"]
 
 
 def _outer_reads(program, blocks, bound_names=()):
@@ -419,3 +420,231 @@ def switch_case(branch_index, branch_fns, default=None):
         c = _eq(branch_index, fill_constant([1], branch_index.dtype, idx))
         pairs.append((c, fn))
     return case(pairs, default)
+
+
+class DynamicRNN:
+    """reference: layers/control_flow.py `DynamicRNN` — RNN over
+    variable-length sequences. The reference batches LoD sequences by
+    sorted length (LoDRankTable + shrink-memory); TPU-native this is the
+    padded-batch + lengths design (SURVEY §5): step over [N, T, D] padded
+    input, HOLD each row's memory once t >= length, and zero padded
+    output steps. Built on StaticRNN's scan, so it stays one
+    differentiable lax.scan.
+
+    Usage:
+        drnn = DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x, lengths)   # x [N, T, D]
+            h = drnn.memory(shape=[H], value=0.0)
+            h2 = some_layers(x_t, h)
+            drnn.update_memory(h, h2)
+            drnn.output(h2)
+        out = drnn()                            # [N, T, H], padded zeros
+    """
+
+    def __init__(self, name=None):
+        self._rnn = StaticRNN(name=name)
+        self._lengths = None
+        self._t = None          # in-block step index [1]
+        self._batch_ref = None
+
+    def block(self):
+        return self._rnn.step()
+
+    def _outer_block(self):
+        """Context manager: emit ops into the block ENCLOSING the rnn
+        step block (outer vars are built there)."""
+        import contextlib
+
+        program = self._rnn.helper.main_program
+        parent = self._rnn._block.parent_idx
+
+        @contextlib.contextmanager
+        def guard():
+            cur = program._current_block_idx
+            program._current_block_idx = parent
+            try:
+                yield
+            finally:
+                program._current_block_idx = cur
+
+        return guard()
+
+    def _ensure_time_index(self, T):
+        if self._t is not None:
+            return
+        with self._outer_block():
+            helper = LayerHelper("drnn_time")
+            trange = helper.create_variable_for_type_inference("int64")
+            helper.append_op(
+                type="assign_value", inputs={}, outputs={"Out": trange},
+                attrs={"shape": [int(T), 1],
+                       "values": list(range(int(T))),
+                       "dtype": "int64"})
+        self._t = self._rnn.step_input(trange)  # [1] per step
+
+    def step_input(self, x, lengths=None):
+        """x [N, T, D...] batch-major padded; lengths [N] optional."""
+        from .nn import transpose
+
+        # the transpose consumes an OUTER var — emit it in the outer block
+        with self._outer_block():
+            perm = [1, 0] + list(range(2, len(x.shape)))
+            xt = transpose(x, perm=perm)        # [T, N, ...]
+        self._ensure_time_index(x.shape[1])
+        if lengths is not None and self._lengths is None:
+            self._lengths = lengths
+        self._batch_ref = x
+        return self._rnn.step_input(xt)
+
+    def static_input(self, x):
+        return self._rnn.static_input(x) if hasattr(
+            self._rnn, "static_input") else x
+
+    def memory(self, init=None, shape=None, value=0.0, dtype="float32"):
+        if init is not None:
+            return self._rnn.memory(init=init)
+        if self._batch_ref is None:
+            raise ValueError(
+                "DynamicRNN.memory(shape=...) needs the batch size from a "
+                "prior step_input — call drnn.step_input(x) first "
+                "(the reference raises the same way)")
+        # batch dim is dynamic: build the init in the OUTER block with
+        # fill_constant_batch_size_like against the step input
+        with self._outer_block():
+            from .tensor import fill_constant_batch_size_like
+
+            init = fill_constant_batch_size_like(
+                self._batch_ref, [-1] + [int(s) for s in shape], dtype,
+                float(value))
+        return self._rnn.memory(init=init)
+
+    def update_memory(self, ex_mem, new_mem):
+        """Hold the memory for rows whose sequence already ended."""
+        if self._lengths is None:
+            self._rnn.update_memory(ex_mem, new_mem)
+            return
+        from .nn import reshape, where
+
+        helper = self._rnn.helper
+        active = helper.create_variable_for_type_inference("bool")
+        helper.append_op(
+            type="less_than",
+            inputs={"X": self._t, "Y": self._lengths},
+            outputs={"Out": active})
+        active2d = reshape(active, shape=[-1] + [1] * (
+            len(new_mem.shape) - 1))
+        # broadcast the row mask over the feature dims
+        held = where(_broadcast_like(active2d, new_mem), new_mem, ex_mem)
+        self._rnn.update_memory(ex_mem, held)
+
+    def output(self, *outputs):
+        self._rnn.output(*outputs)
+
+    def __call__(self):
+        from .nn import transpose
+
+        res = self._rnn()
+        outs = res if isinstance(res, (list, tuple)) else [res]
+        fixed = []
+        for o in outs:
+            perm = [1, 0] + list(range(2, len(o.shape)))
+            ob = transpose(o, perm=perm)        # [N, T, ...]
+            if self._lengths is not None:
+                ob = _mask_after_length(ob, self._lengths)
+            fixed.append(ob)
+        return fixed[0] if len(fixed) == 1 else fixed
+
+
+def _broadcast_like(cond, ref):
+    """Expand a [N,1,..] bool mask to ref's shape with expand."""
+    from .nn import expand
+
+    times = [1] + [int(s) for s in ref.shape[1:]]
+    return expand(cond, expand_times=times)
+
+
+def _mask_after_length(x, lengths):
+    """Zero x [N, T, ...] rows past each row's length."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("drnn_mask")
+    mask = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(type="sequence_mask",
+                     inputs={"X": lengths}, outputs={"Y": mask},
+                     attrs={"maxlen": int(x.shape[1]),
+                            "out_dtype": str(x.dtype)})
+    m = mask
+    from .nn import reshape
+
+    m = reshape(m, shape=[int(x.shape[0] or -1), int(x.shape[1])] +
+                [1] * (len(x.shape) - 2))
+    helper2 = LayerHelper("drnn_apply_mask")
+    out = helper2.create_variable_for_type_inference(x.dtype)
+    helper2.append_op(type="elementwise_mul", inputs={"X": x, "Y": m},
+                      outputs={"Out": out}, attrs={"axis": -1})
+    return out
+
+
+class IfElse:
+    """reference: layers/control_flow.py `IfElse` — row-wise conditional:
+    rows where cond holds flow through the true branch, the rest through
+    the false branch, outputs merged back in order. The reference
+    physically splits/merges LoD rows (split_lod_tensor/merge_lod_tensor
+    ops); TPU-native both branches run DENSE over the full batch and the
+    merge is a row-select — identical semantics for side-effect-free
+    branches and no dynamic shapes.
+
+    Usage:
+        ie = IfElse(cond)                  # cond [N, 1] bool
+        with ie.true_block():
+            ie.output(f(ie.input(x)))
+        with ie.false_block():
+            ie.output(g(ie.input(x)))
+        merged, = ie()
+    """
+
+    def __init__(self, cond, name=None):
+        self._cond = cond
+        self._outs = {True: [], False: []}
+        self._branch = None
+
+    class _Branch:
+        def __init__(self, ie, val):
+            self.ie, self.val = ie, val
+
+        def __enter__(self):
+            self.ie._branch = self.val
+            return self.ie
+
+        def __exit__(self, *a):
+            self.ie._branch = None
+            return False
+
+    def true_block(self):
+        return IfElse._Branch(self, True)
+
+    def false_block(self):
+        return IfElse._Branch(self, False)
+
+    def input(self, x):
+        assert self._branch is not None, "input() outside a branch block"
+        return x
+
+    def output(self, *outs):
+        assert self._branch is not None, "output() outside a branch block"
+        self._outs[self._branch].extend(outs)
+
+    def __call__(self):
+        from .nn import expand, reshape, where
+
+        t, f = self._outs[True], self._outs[False]
+        assert len(t) == len(f), (
+            f"IfElse branches produced {len(t)} vs {len(f)} outputs")
+        merged = []
+        for tv, fv in zip(t, f):
+            cond = reshape(self._cond,
+                           shape=[-1] + [1] * (len(tv.shape) - 1))
+            times = [1] + [int(s) for s in tv.shape[1:]]
+            merged.append(where(expand(cond, expand_times=times), tv, fv))
+        return merged

@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import numpy as np
+
 from ..layer_helper import LayerHelper
 
 __all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box", "roi_align",
@@ -13,7 +15,10 @@ __all__ = ["iou_similarity", "box_coder", "prior_box", "yolo_box", "roi_align",
            "distribute_fpn_proposals", "rpn_target_assign",
            "retinanet_detection_output", "yolov3_loss",
            "generate_proposal_labels", "generate_mask_labels",
-           "roi_perspective_transform"]
+           "roi_perspective_transform",
+           "multiclass_nms2", "detection_output", "prroi_pool",
+           "deformable_roi_pooling", "ssd_loss", "multi_box_head",
+           "retinanet_target_assign"]
 
 
 def iou_similarity(x, y, name=None):
@@ -425,3 +430,236 @@ def roi_perspective_transform(input, rois, transformed_height,
                             "transformed_width": transformed_width,
                             "spatial_scale": spatial_scale})
     return out
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, background_label=0,
+                    return_index=False, name=None):
+    """reference: detection.py `multiclass_nms2` — multiclass_nms that
+    can also return the selected-box Index ([N, keep, 1], row into the
+    batch-flattened boxes, -1 padding)."""
+    helper = LayerHelper("multiclass_nms2", name=name)
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    index = helper.create_variable_for_type_inference("int32")
+    helper.append_op(type="multiclass_nms",
+                     inputs={"BBoxes": bboxes, "Scores": scores},
+                     outputs={"Out": out, "NmsRoisNum": num,
+                              "Index": index},
+                     attrs={"score_threshold": score_threshold,
+                            "nms_top_k": nms_top_k,
+                            "keep_top_k": keep_top_k,
+                            "nms_threshold": nms_threshold,
+                            "normalized": normalized,
+                            "background_label": background_label})
+    if return_index:
+        return out, index
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_index=False):
+    """reference: detection.py:516 `detection_output` — decode SSD loc
+    predictions against the priors (decode_center_size) then
+    multiclass NMS. loc [N,P,4], scores [N,P,C] (post-softmax),
+    priors [P,4]."""
+    helper = LayerHelper("detection_output")
+    decoded = helper.create_variable_for_type_inference(loc.dtype)
+    helper.append_op(type="box_coder",
+                     inputs={"PriorBox": prior_box,
+                             "PriorBoxVar": prior_box_var,
+                             "TargetBox": loc},
+                     outputs={"OutputBox": decoded},
+                     attrs={"code_type": "decode_center_size",
+                            "axis": 0, "box_normalized": True})
+    from .nn import transpose
+
+    scores_t = transpose(scores, perm=[0, 2, 1])   # [N, C, P]
+    return multiclass_nms2(decoded, scores_t,
+                           score_threshold=score_threshold,
+                           nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                           nms_threshold=nms_threshold,
+                           background_label=background_label,
+                           return_index=return_index)
+
+
+def prroi_pool(input, rois, output_channels=None, spatial_scale=1.0,
+               pooled_height=1, pooled_width=1, name=None):
+    """reference: detection.py `prroi_pool` → prroi_pool op (precise
+    integral RoI pooling)."""
+    helper = LayerHelper("prroi_pool", name=name)
+    oc = output_channels or (
+        input.shape[1] // (pooled_height * pooled_width))
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="prroi_pool",
+                     inputs={"X": input, "ROIs": rois},
+                     outputs={"Out": out},
+                     attrs={"spatial_scale": float(spatial_scale),
+                            "output_channels": int(oc),
+                            "pooled_height": int(pooled_height),
+                            "pooled_width": int(pooled_width)})
+    return out
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1,
+                           part_size=None, sample_per_part=1,
+                           trans_std=0.1, position_sensitive=False,
+                           name=None):
+    """reference: detection.py `deformable_roi_pooling` →
+    deformable_psroi_pooling op."""
+    helper = LayerHelper("deformable_roi_pooling", name=name)
+    part = part_size or (pooled_height, pooled_width)
+    out_dim = input.shape[1] if not position_sensitive else \
+        input.shape[1] // (group_size[0] * group_size[1])
+    out = helper.create_variable_for_type_inference(input.dtype)
+    cnt = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"Input": input, "ROIs": rois}
+    if not no_trans:
+        inputs["Trans"] = trans
+    helper.append_op(type="deformable_psroi_pooling", inputs=inputs,
+                     outputs={"Output": out, "TopCount": cnt},
+                     attrs={"no_trans": no_trans,
+                            "spatial_scale": float(spatial_scale),
+                            "output_dim": int(out_dim),
+                            "group_size": [int(g) for g in group_size],
+                            "pooled_height": int(pooled_height),
+                            "pooled_width": int(pooled_width),
+                            "part_size": [int(v) for v in part],
+                            "sample_per_part": int(sample_per_part),
+                            "trans_std": float(trans_std)})
+    return out
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0,
+             overlap_threshold=0.5, neg_pos_ratio=3.0, neg_overlap=0.5,
+             loc_loss_weight=1.0, conf_loss_weight=1.0,
+             match_type="per_prediction", mining_type="max_negative",
+             normalize=True, sample_size=None):
+    """reference: detection.py:1389 `ssd_loss` → fused ssd_loss op
+    (static shapes: gt_box [N,G,4] zero-padded, gt_label [N,G] with -1
+    pads). Returns the [N, P] per-prior weighted loss."""
+    if mining_type != "max_negative":
+        raise ValueError(
+            "ssd_loss: only mining_type='max_negative' is supported "
+            "(the reference raises for anything else too)")
+    helper = LayerHelper("ssd_loss")
+    loss = helper.create_variable_for_type_inference(location.dtype)
+    inputs = {"Location": location, "Confidence": confidence,
+              "GtBox": gt_box, "GtLabel": gt_label,
+              "PriorBox": prior_box}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = prior_box_var
+    helper.append_op(type="ssd_loss", inputs=inputs,
+                     outputs={"Loss": loss},
+                     attrs={"background_label": background_label,
+                            "overlap_threshold": overlap_threshold,
+                            "neg_pos_ratio": neg_pos_ratio,
+                            "neg_overlap": neg_overlap,
+                            "loc_loss_weight": loc_loss_weight,
+                            "conf_loss_weight": conf_loss_weight,
+                            "match_type": match_type,
+                            "normalize": normalize})
+    return loss
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None):
+    """reference: detection.py:1880 `multi_box_head` — the SSD head: per
+    feature map, conv out loc [N,P_i,4] + conf [N,P_i,C] and prior boxes;
+    concatenated over maps. Returns (mbox_locs, mbox_confs, boxes, vars).
+    """
+    from .nn import conv2d, reshape, transpose
+    from .tensor import concat
+
+    n_layer = len(inputs)
+    if min_sizes is None:
+        # reference ratio schedule (detection.py:2006)
+        min_sizes, max_sizes = [], []
+        step = int((max_ratio - min_ratio) / (n_layer - 2))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.10] + min_sizes
+        max_sizes = [base_size * 0.20] + max_sizes
+
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, feat in enumerate(inputs):
+        mins = min_sizes[i]
+        maxs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i]
+        if steps:
+            steps_i = (steps[i], steps[i])
+        else:
+            steps_i = ((step_w[i] if step_w else 0.0),
+                       (step_h[i] if step_h else 0.0))
+        box, var = prior_box(
+            feat, image,
+            min_sizes=mins if isinstance(mins, (list, tuple)) else [mins],
+            max_sizes=(maxs if isinstance(maxs, (list, tuple))
+                       else ([maxs] if maxs else None)),
+            aspect_ratios=(ar if isinstance(ar, (list, tuple)) else [ar]),
+            variance=list(variance), flip=flip, clip=clip,
+            steps=steps_i, offset=offset)
+        # priors per feature-map cell drive the conv head widths
+        n_per_cell = int(np.prod(box.shape[:-1])) // (
+            int(feat.shape[2]) * int(feat.shape[3]))
+        loc = conv2d(feat, n_per_cell * 4, kernel_size, stride=stride,
+                     padding=pad)
+        conf = conv2d(feat, n_per_cell * num_classes, kernel_size,
+                      stride=stride, padding=pad)
+        loc = reshape(transpose(loc, perm=[0, 2, 3, 1]),
+                      shape=[0, -1, 4])
+        conf = reshape(transpose(conf, perm=[0, 2, 3, 1]),
+                       shape=[0, -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+        boxes_l.append(reshape(box, shape=[-1, 4]))
+        vars_l.append(reshape(var, shape=[-1, 4]))
+    mbox_locs = concat(locs, axis=1)
+    mbox_confs = concat(confs, axis=1)
+    boxes = concat(boxes_l, axis=0)
+    variances = concat(vars_l, axis=0)
+    return mbox_locs, mbox_confs, boxes, variances
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd, im_info,
+                            num_classes=1, positive_overlap=0.5,
+                            negative_overlap=0.4):
+    """reference: detection.py:64 `retinanet_target_assign` →
+    retinanet_target_assign op; returns the gathered
+    (score_pred, loc_pred, score_tgt, loc_tgt, bbox_weight, fg_num)
+    sextuple like the reference."""
+    from .nn import gather, reshape
+
+    helper = LayerHelper("retinanet_target_assign")
+    outs = {k: helper.create_variable_for_type_inference(dt)
+            for k, dt in [("LocationIndex", "int32"),
+                          ("ScoreIndex", "int32"),
+                          ("TargetLabel", "int32"),
+                          ("TargetBBox", anchor_box.dtype),
+                          ("BBoxInsideWeight", anchor_box.dtype),
+                          ("ForegroundNumber", "int32")]}
+    helper.append_op(type="retinanet_target_assign",
+                     inputs={"Anchor": anchor_box, "GtBoxes": gt_boxes,
+                             "GtLabels": gt_labels, "IsCrowd": is_crowd,
+                             "ImInfo": im_info},
+                     outputs=outs,
+                     attrs={"positive_overlap": positive_overlap,
+                            "negative_overlap": negative_overlap})
+    loc_idx = outs["LocationIndex"]
+    score_idx = outs["ScoreIndex"]
+    pred_loc = gather(reshape(bbox_pred, shape=[-1, 4]), loc_idx)
+    pred_score = gather(reshape(cls_logits, shape=[-1, num_classes]),
+                        score_idx)
+    return (pred_score, pred_loc, outs["TargetLabel"],
+            outs["TargetBBox"], outs["BBoxInsideWeight"],
+            outs["ForegroundNumber"])

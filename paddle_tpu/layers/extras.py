@@ -18,7 +18,12 @@ __all__ = [
     "rank_loss", "log_loss", "bpr_loss", "npair_loss", "center_loss",
     "teacher_student_sigmoid_loss", "edit_distance", "ctc_greedy_decoder",
     "warpctc", "multiplex", "conv3d_transpose", "modified_huber_loss",
-    "py_func",
+    "py_func", "bilinear_tensor_product", "continuous_value_model",
+    "filter_by_instag", "fsp_matrix", "hash", "pad_constant_like",
+    "similarity_focus", "unique_with_counts",
+    "uniform_random_batch_size_like", "gaussian_random_batch_size_like",
+    "dice_loss", "soft_relu", "image_resize_short",
+    "autoincreased_step_counter", "Print",
 ]
 
 
@@ -336,4 +341,183 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
                "backward_skip_vars": skip,
                "out_shapes": [list(o.shape) for o in outs],
                "out_dtypes": [str(o.dtype) for o in outs]})
+    return out
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference: layers/nn.py `bilinear_tensor_product` →
+    bilinear_tensor_product op (weight [size, Dx, Dy])."""
+    helper = LayerHelper("bilinear_tensor_product", name=name,
+                         param_attr=param_attr, bias_attr=bias_attr)
+    w = helper.create_parameter(
+        param_attr, shape=[size, x.shape[-1], y.shape[-1]], dtype=x.dtype)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": x, "Y": y, "Weight": w}
+    if bias_attr is not False:
+        b = helper.create_parameter(bias_attr, shape=[1, size],
+                                    dtype=x.dtype, is_bias=True)
+        inputs["Bias"] = b
+    helper.append_op(type="bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": out})
+    return helper.append_activation(out, act)
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """reference: layers/nn.py `continuous_value_model` → cvm op."""
+    helper = LayerHelper("cvm")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="cvm", inputs={"X": input, "CVM": cvm},
+                     outputs={"Y": out}, attrs={"use_cvm": use_cvm})
+    return out
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True):
+    """reference: layers/nn.py `filter_by_instag` → filter_by_instag op
+    (static shapes: kept rows compact to the top; LossWeight marks
+    validity)."""
+    helper = LayerHelper("filter_by_instag")
+    out = helper.create_variable_for_type_inference(ins.dtype)
+    lw = helper.create_variable_for_type_inference(ins.dtype)
+    imap = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="filter_by_instag",
+                     inputs={"Ins": ins, "Ins_tag": ins_tag,
+                             "Filter_tag": filter_tag},
+                     outputs={"Out": out, "LossWeight": lw,
+                              "IndexMap": imap},
+                     attrs={"is_lod": is_lod})
+    return out, lw, imap
+
+
+def fsp_matrix(x, y):
+    """reference: layers/nn.py `fsp_matrix` → fsp op (distillation)."""
+    return _simple("fsp", {"X": x, "Y": y})
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """reference: layers/nn.py `hash` → hash op."""
+    return _simple("hash", {"X": input},
+                     {"mod_by": int(hash_size), "num_hash": int(num_hash)},
+                     dtype="int64")
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """reference: layers/nn.py `pad_constant_like` op."""
+    return _simple("pad_constant_like", {"X": x, "Y": y},
+                     {"pad_value": float(pad_value)}, dtype=y.dtype)
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """reference: layers/nn.py `similarity_focus` op."""
+    return _simple("similarity_focus", {"X": input},
+                     {"axis": int(axis),
+                      "indexes": [int(i) for i in indexes]})
+
+
+def unique_with_counts(x, dtype="int32"):
+    """reference: layers/nn.py `unique_with_counts` op (static shapes:
+    Count==0 marks padding slots)."""
+    helper = LayerHelper("unique_with_counts")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    index = helper.create_variable_for_type_inference("int64")
+    count = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="unique_with_counts", inputs={"X": x},
+                     outputs={"Out": out, "Index": index, "Count": count},
+                     attrs={"dtype": dtype})
+    return out, index, count
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    """reference: layers/ops.py `uniform_random_batch_size_like` op."""
+    return _simple("uniform_random_batch_size_like", {"Input": input},
+                     {"shape": list(shape), "min": float(min),
+                      "max": float(max), "seed": int(seed),
+                      "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx, "dtype": dtype},
+                     dtype=dtype)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    """reference: layers/ops.py `gaussian_random_batch_size_like` op."""
+    return _simple("gaussian_random_batch_size_like", {"Input": input},
+                     {"shape": list(shape), "mean": float(mean),
+                      "std": float(std), "seed": int(seed),
+                      "input_dim_idx": input_dim_idx,
+                      "output_dim_idx": output_dim_idx, "dtype": dtype},
+                     dtype=dtype)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """reference: layers/nn.py `dice_loss` — EXACT reference composite:
+    label one-hots to input's last dim, inse = Σ x·l over non-batch
+    dims, dice = 1 - 2·inse / (Σx + Σl + ε), then mean."""
+    from .nn import mean, one_hot, reduce_sum
+
+    label_oh = one_hot(label, depth=int(input.shape[-1]))
+    label_f = _simple("cast", {"X": label_oh},
+                      {"out_dtype": str(input.dtype)},
+                      dtype=input.dtype)
+    dims = list(range(1, len(input.shape)))
+    inse = reduce_sum(input * label_f, dim=dims)
+    denom = reduce_sum(input, dim=dims) + reduce_sum(label_f, dim=dims)
+    dice = 1.0 - inse * 2.0 / (denom + epsilon)
+    return mean(dice)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    """reference: layers/ops.py `soft_relu` activation op."""
+    return _simple("soft_relu", {"X": x},
+                     {"threshold": float(threshold)})
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """reference: layers/nn.py `image_resize_short` — resize so the
+    SHORT side equals out_short_len, keeping aspect ratio (static
+    shapes: computed from the declared H/W)."""
+    from .nn import image_resize
+
+    h, w = int(input.shape[2]), int(input.shape[3])
+    short, long_ = (h, w) if h < w else (w, h)
+    scale = out_short_len / float(short)
+    out_h, out_w = int(round(h * scale)), int(round(w * scale))
+    return image_resize(input, out_shape=[out_h, out_w],
+                        resample=resample)
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """reference: layers/nn.py `autoincreased_step_counter` — a
+    persistable int64 counter incremented by `step` each run."""
+    from .tensor import create_global_var
+
+    helper = LayerHelper("global_step_counter")
+    counter = create_global_var(
+        shape=[1], value=float(begin - step), dtype="int64",
+        persistable=True,
+        name=counter_name or "@STEP_COUNTER@")
+    helper.append_op(type="increment", inputs={"X": counter},
+                     outputs={"Out": counter},
+                     attrs={"step": float(step)})
+    counter.stop_gradient = True
+    return counter
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    """reference: layers/control_flow.py `Print` → print op (host-side
+    debug dump at the op's program point)."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(type="print", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"first_n": first_n,
+                            "message": message or "",
+                            "summarize": summarize,
+                            "print_tensor_name": print_tensor_name,
+                            "print_phase": print_phase.upper()})
     return out

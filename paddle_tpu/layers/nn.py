@@ -15,7 +15,7 @@ from ..initializer import ConstantInitializer, NormalInitializer, XavierInitiali
 __all__ = [
     "fc", "embedding", "distributed_embedding", "conv2d", "conv3d",
     "conv2d_transpose",
-    "depthwise_conv2d", "deformable_conv", "pool2d", "pool3d", "adaptive_pool2d", "batch_norm",
+    "depthwise_conv2d", "deformable_conv", "pool2d", "pool3d", "adaptive_pool2d", "adaptive_pool3d", "batch_norm",
     "layer_norm", "group_norm", "instance_norm", "l2_normalize", "dropout",
     "softmax", "log_softmax", "matmul", "mul", "topk", "one_hot", "reshape",
     "transpose", "squeeze", "unsqueeze", "flatten", "split", "stack",
@@ -873,3 +873,27 @@ def mean_iou(input, label, num_classes):
                               "OutCorrect": correct},
                      attrs={"num_classes": num_classes})
     return iou, wrong, correct
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """reference: layers/nn.py `adaptive_pool3d` → pool3d with adaptive
+    bins (divisible-bin convention; max_pool3d_with_index when
+    require_index)."""
+    helper = LayerHelper("adaptive_pool3d", name=name)
+    ksize = pool_size if isinstance(pool_size, (list, tuple)) \
+        else [pool_size] * 3
+    ksize = [int(k) for k in ksize]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    if require_index:
+        mask = helper.create_variable_for_type_inference("int32")
+        helper.append_op(type="max_pool3d_with_index",
+                         inputs={"X": input},
+                         outputs={"Out": out, "Mask": mask},
+                         attrs={"ksize": ksize, "adaptive": True})
+        return out, mask
+    helper.append_op(type="pool3d", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"pooling_type": pool_type, "ksize": ksize,
+                            "adaptive": True})
+    return out

@@ -13,6 +13,9 @@ __all__ = [
     "fill_constant_batch_size_like", "ones", "zeros", "ones_like",
     "zeros_like", "reverse", "range", "linspace", "argmax", "argmin",
     "argsort", "has_inf", "has_nan", "isfinite", "diag", "eye",
+    "sum", "rank", "size", "is_empty", "scatter_nd", "uniform_random",
+    "gaussian_random", "load", "get_tensor_from_selected_rows",
+    "merge_selected_rows",
 ]
 
 
@@ -223,3 +226,99 @@ def eye(num_rows, num_columns=None, batch_shape=None, dtype="float32"):
                      attrs={"num_rows": num_rows,
                             "num_columns": num_columns or num_rows, "dtype": dtype})
     return out
+
+
+def sum(x):
+    """reference: layers/tensor.py `sum` → sum op (elementwise sum of a
+    var list)."""
+    helper = LayerHelper("sum")
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    out = helper.create_variable_for_type_inference(xs[0].dtype)
+    helper.append_op(type="sum", inputs={"X": xs}, outputs={"Out": out})
+    return out
+
+
+def rank(input):
+    """reference: layers/nn.py `rank` — the (static) dimensionality as a
+    0-d... shape-[1] int32 constant."""
+    return fill_constant(shape=[1], dtype="int32", value=len(input.shape))
+
+
+def size(input):
+    """reference: layers/nn.py `size` → size op (runtime element count —
+    the static shape may carry a -1 batch dim)."""
+    helper = LayerHelper("size")
+    out = helper.create_variable_for_type_inference("int64")
+    helper.append_op(type="size", inputs={"Input": input},
+                     outputs={"Out": out})
+    return out
+
+
+def is_empty(x, cond=None):
+    """reference: layers/control_flow.py `is_empty` → is_empty op."""
+    helper = LayerHelper("is_empty")
+    out = cond or helper.create_variable_for_type_inference("bool")
+    helper.append_op(type="is_empty", inputs={"X": x},
+                     outputs={"Out": out})
+    return out
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """reference: layers/nn.py `scatter_nd` — scatter_nd_add into a zero
+    tensor of `shape`."""
+    zero = zeros(list(shape), dtype=updates.dtype)
+    helper = LayerHelper("scatter_nd", name=name)
+    out = helper.create_variable_for_type_inference(updates.dtype)
+    helper.append_op(type="scatter_nd_add",
+                     inputs={"X": zero, "Index": index,
+                             "Updates": updates},
+                     outputs={"Out": out})
+    return out
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    """reference: layers/ops.py `uniform_random` op."""
+    helper = LayerHelper("uniform_random")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="uniform_random", inputs={},
+                     outputs={"Out": out},
+                     attrs={"shape": list(shape), "min": float(min),
+                            "max": float(max), "seed": int(seed),
+                            "dtype": dtype})
+    return out
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    """reference: layers/ops.py `gaussian_random` op."""
+    helper = LayerHelper("gaussian_random")
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="gaussian_random", inputs={},
+                     outputs={"Out": out},
+                     attrs={"shape": list(shape), "mean": float(mean),
+                            "std": float(std), "seed": int(seed),
+                            "dtype": dtype})
+    return out
+
+
+def load(out, file_path, load_as_fp16=None):
+    """reference: layers/io `load` → load op: fill `out` from a
+    save_vars-format .npy file at run time."""
+    helper = LayerHelper("load")
+    helper.append_op(type="load", inputs={}, outputs={"Out": out},
+                     attrs={"file_path": file_path})
+    return out
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """reference: get_tensor_from_selected_rows_op.cc. SelectedRows are
+    DENSE in this framework (PARITY.md §2.1: gradients are dense on TPU;
+    only the PS sparse table is truly sparse), so this is the identity."""
+    return x
+
+
+def merge_selected_rows(x, name=None):
+    """reference: merge_selected_rows_op.cc — merges duplicate rows of a
+    SelectedRows. Dense tensors have no duplicate-row encoding, so this
+    is the identity (the scatter-add that produced the dense grad already
+    merged)."""
+    return x

@@ -173,3 +173,10 @@ def maxout(ins, attrs, ctx):
     groups = int(attrs["groups"])
     n, c, h, w = x.shape
     return {"Out": jnp.max(x.reshape(n, c // groups, groups, h, w), axis=2)}
+
+
+@register_op("soft_relu")
+def soft_relu(ins, attrs, ctx):
+    """reference: activation_op.cc SoftRelu — ln(1+exp(clip(x, ±t)))."""
+    t = attrs.get("threshold", 40.0)
+    return {"Out": jnp.log1p(jnp.exp(jnp.clip(ins["X"][0], -t, t)))}

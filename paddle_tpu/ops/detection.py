@@ -780,10 +780,17 @@ def multiclass_nms(ins, attrs, ctx):
             jnp.where(valid, sel_l, -1).astype(boxes.dtype)[:, None],
             jnp.where(valid, top_s, 0.0)[:, None],
             jnp.where(valid[:, None], sel_boxes, 0.0)], axis=1)
-        return out, jnp.sum(valid.astype(jnp.int32))
+        return out, jnp.sum(valid.astype(jnp.int32)), \
+            jnp.where(valid, sel_i, -1)
 
-    out, num = jax.vmap(one_image)(bboxes, scores)
-    return {"Out": out, "NmsRoisNum": num, "Index": None}
+    out, num, sel = jax.vmap(one_image)(bboxes, scores)
+    # Index: selected box row in the batch-flattened [N*M, 4] boxes
+    # (reference multiclass_nms2's Index over the LoD-flattened input);
+    # -1 marks padding rows
+    gidx = jnp.where(sel >= 0,
+                     sel + jnp.arange(n)[:, None] * m, -1)[..., None]
+    return {"Out": out, "NmsRoisNum": num,
+            "Index": gidx.astype(jnp.int32)}
 
 
 @register_op("generate_proposals", grad=None)
@@ -1547,3 +1554,175 @@ def detection_map(ins, attrs, ctx):
         host, result_shapes, dets, gts, pc_in, tp_in, fp_in)
     return {"MAP": m_ap, "AccumPosCount": pc, "AccumTruePos": tp,
             "AccumFalsePos": fp}
+
+
+@register_op("ssd_loss", nondiff_inputs=("GtBox", "GtLabel", "PriorBox",
+                                         "PriorBoxVar"))
+def ssd_loss(ins, attrs, ctx):
+    """reference: layers/detection.py `ssd_loss` (:1389) — fused here as
+    one op (the reference composes iou_similarity → bipartite_match →
+    target_assign → mine_hard_examples → softmax-CE + smooth-L1; XLA
+    fuses the same dataflow without materializing the intermediates).
+    Static shapes: GtBox [N,G,4] zero-padded, GtLabel [N,G] with -1 pad
+    rows. Output Loss [N,P] = conf_w*conf + loc_w*loc per prior,
+    normalized by total positives when `normalize`."""
+    loc = ins["Location"][0]               # [N, P, 4]
+    conf = ins["Confidence"][0]            # [N, P, C]
+    gt_box = ins["GtBox"][0]               # [N, G, 4]
+    gt_label = ins["GtLabel"][0]           # [N, G]
+    prior = ins["PriorBox"][0]             # [P, 4]
+    pvar = (ins.get("PriorBoxVar") or [None])[0]
+    bg = int(attrs.get("background_label", 0))
+    ovt = float(attrs.get("overlap_threshold", 0.5))
+    npr = float(attrs.get("neg_pos_ratio", 3.0))
+    neg_ov = float(attrs.get("neg_overlap", 0.5))
+    loc_w = float(attrs.get("loc_loss_weight", 1.0))
+    conf_w = float(attrs.get("conf_loss_weight", 1.0))
+    normalize = bool(attrs.get("normalize", True))
+    match_type = str(attrs.get("match_type", "per_prediction"))
+    n, p, c = conf.shape
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
+    gt_valid = gt_label >= 0                        # [N, G]
+
+    def one(lb, cb, gb, gl, gv):
+        # iou [G, P]; invalid gts can never win a prior
+        area_g = (gb[:, 2] - gb[:, 0]) * (gb[:, 3] - gb[:, 1])
+        area_p = (prior[:, 2] - prior[:, 0]) * (prior[:, 3] - prior[:, 1])
+        lt = jnp.maximum(gb[:, None, :2], prior[None, :, :2])
+        rb = jnp.minimum(gb[:, None, 2:], prior[None, :, 2:])
+        wh = jnp.maximum(rb - lt, 0.0)
+        inter = wh[..., 0] * wh[..., 1]
+        iou = inter / (area_g[:, None] + area_p[None, :] - inter + 1e-10)
+        iou = jnp.where(gv[:, None], iou, -1.0)
+        # per-prediction match: each prior takes its best gt at >= ovt;
+        # plus each gt's best prior is forced positive (bipartite seed)
+        best_gt = jnp.argmax(iou, axis=0)           # [P]
+        best_iou = jnp.max(iou, axis=0)
+        if match_type == "per_prediction":
+            match = jnp.where(best_iou >= ovt, best_gt, -1)
+        else:
+            # pure bipartite: only each gt's best prior is positive
+            match = jnp.full((p,), -1, best_gt.dtype)
+        best_prior = jnp.argmax(iou, axis=1)        # [G]
+        # padded gts scatter out of range (dropped) so they can never
+        # clobber a real gt's forced-positive prior
+        scatter_at = jnp.where(gv, best_prior, p)
+        forced = jnp.zeros((p,), jnp.int32).at[scatter_at].set(
+            jnp.arange(iou.shape[0], dtype=jnp.int32) + 1, mode="drop") - 1
+        match = jnp.where(forced >= 0, forced, match)
+        pos = match >= 0                            # [P]
+
+        # conf targets + full CE (for mining and the loss)
+        tgt_label = jnp.where(pos, gl[jnp.maximum(match, 0)], bg)
+        logp = jax.nn.log_softmax(cb.astype(jnp.float32), axis=-1)
+        ce = -jnp.take_along_axis(
+            logp, tgt_label[:, None].astype(jnp.int32), 1)[:, 0]  # [P]
+
+        # max_negative mining: top ce among negatives with iou < neg_ov
+        n_pos = jnp.sum(pos.astype(jnp.int32))
+        n_neg_want = (npr * n_pos).astype(jnp.int32)
+        neg_cand = (~pos) & (best_iou < neg_ov)
+        neg_score = jnp.where(neg_cand, ce, -jnp.inf)
+        order = jnp.argsort(-neg_score)
+        rank = jnp.argsort(order)
+        neg_sel = neg_cand & (rank < n_neg_want)
+
+        conf_loss = ce * (pos | neg_sel).astype(ce.dtype)
+
+        # smooth-L1 on encoded offsets, positives only
+        gbm = gb[jnp.maximum(match, 0)]             # matched gt per prior
+        one_ = 0.0
+        pw = prior[:, 2] - prior[:, 0] + one_
+        ph = prior[:, 3] - prior[:, 1] + one_
+        pcx = prior[:, 0] + pw * 0.5
+        pcy = prior[:, 1] + ph * 0.5
+        tw = gbm[:, 2] - gbm[:, 0] + one_
+        th = gbm[:, 3] - gbm[:, 1] + one_
+        tcx = gbm[:, 0] + tw * 0.5
+        tcy = gbm[:, 1] + th * 0.5
+        enc = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                         jnp.log(jnp.maximum(tw / pw, 1e-10)),
+                         jnp.log(jnp.maximum(th / ph, 1e-10))], axis=-1)
+        if pvar is not None:
+            enc = enc / pvar
+        d = lb.astype(jnp.float32) - enc
+        ad = jnp.abs(d)
+        sl1 = jnp.where(ad < 1.0, 0.5 * d * d, ad - 0.5).sum(-1)
+        loc_loss = sl1 * pos.astype(sl1.dtype)
+        return conf_w * conf_loss + loc_w * loc_loss, n_pos
+
+    loss, n_pos = jax.vmap(one)(loc, conf, gt_box, gt_label, gt_valid)
+    if normalize:
+        loss = loss / jnp.maximum(jnp.sum(n_pos), 1).astype(loss.dtype)
+    return {"Loss": loss}
+
+
+@register_op("retinanet_target_assign", is_random=True, grad=None)
+def retinanet_target_assign(ins, attrs, ctx):
+    """reference: detection/rpn_target_assign_op.cc:1030
+    RetinanetTargetAssign — RetinaNet anchor assignment: positives are
+    IoU>=positive_overlap anchors plus each gt's best anchor; negatives
+    IoU<negative_overlap; remaining anchors ignored. Unlike RPN there is
+    no subsampling (focal loss uses all), labels are CLASS ids (1-based,
+    0=background), and ForegroundNumber is emitted for focal-loss
+    normalization. Static shapes: fixed-capacity index outputs padded
+    with -1."""
+    anchors = ins["Anchor"][0].reshape(-1, 4)
+    gt = ins["GtBoxes"][0].reshape(-1, 4)
+    gt_labels = ins["GtLabels"][0].reshape(-1)
+    pos_thr = float(attrs.get("positive_overlap", 0.5))
+    neg_thr = float(attrs.get("negative_overlap", 0.4))
+    a = anchors.shape[0]
+    valid_gt = gt_labels > 0
+    iou = _pairwise_iou(anchors, gt, normalized=False)
+    iou = jnp.where(valid_gt[None, :], iou, -1.0)
+    best_iou = jnp.max(iou, axis=1)
+    best_gt = jnp.argmax(iou, axis=1)
+    fg = best_iou >= pos_thr
+    best_anchor = jnp.argmax(iou, axis=0)
+    fg = fg.at[jnp.where(valid_gt, best_anchor, a)].set(
+        True, mode="drop")
+    bg = (best_iou < neg_thr) & ~fg
+    loc_index = jnp.where(fg, jnp.arange(a), -1)
+    loc_index = jnp.sort(jnp.where(loc_index >= 0, loc_index,
+                                   jnp.iinfo(jnp.int32).max))
+    loc_index = jnp.where(loc_index < a, loc_index, -1).astype(jnp.int32)
+    score_sel = fg | bg
+    score_index = jnp.where(score_sel, jnp.arange(a), -1)
+    score_index = jnp.sort(jnp.where(score_index >= 0, score_index,
+                                     jnp.iinfo(jnp.int32).max))
+    score_index = jnp.where(score_index < a, score_index,
+                            -1).astype(jnp.int32)
+    labels = jnp.where(fg, gt_labels[best_gt], 0)
+    target_label = jnp.where(
+        score_index >= 0,
+        labels[jnp.maximum(score_index, 0)], -1).astype(jnp.int32)
+    tb = _encode_rpn_targets(anchors, gt, best_gt)
+    target_bbox = jnp.where((loc_index >= 0)[:, None],
+                            tb[jnp.maximum(loc_index, 0)], 0.0)
+    fg_num = jnp.sum(fg.astype(jnp.int32)).reshape(1)
+    bbox_inside_weight = (loc_index >= 0).astype(
+        anchors.dtype)[:, None] * jnp.ones((1, 4), anchors.dtype)
+    return {"LocationIndex": loc_index, "ScoreIndex": score_index,
+            "TargetLabel": target_label[:, None],
+            "TargetBBox": target_bbox,
+            "BBoxInsideWeight": bbox_inside_weight,
+            "ForegroundNumber": fg_num}
+
+
+def _encode_rpn_targets(anchors, gt, best_gt):
+    """Center-size encode of each anchor's matched gt (RPN/Retina
+    convention, no variances)."""
+    g = gt[best_gt]
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + aw * 0.5
+    acy = anchors[:, 1] + ah * 0.5
+    gw = g[:, 2] - g[:, 0] + 1.0
+    gh = g[:, 3] - g[:, 1] + 1.0
+    gcx = g[:, 0] + gw * 0.5
+    gcy = g[:, 1] + gh * 0.5
+    return jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
+                      jnp.log(jnp.maximum(gw / aw, 1e-10)),
+                      jnp.log(jnp.maximum(gh / ah, 1e-10))], axis=-1)

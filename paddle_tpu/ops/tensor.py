@@ -115,12 +115,6 @@ def shape_op(ins, attrs, ctx):
     return {"Out": jnp.asarray(x.shape, dtype=jnp.int32)}
 
 
-@register_op("size", grad=None, nondiff_inputs=("Input",))
-def size_op(ins, attrs, ctx):
-    x = ins["Input"][0]
-    return {"Out": jnp.asarray(x.size, dtype=jnp.int64)}
-
-
 # ---------------------------------------------------------------------------
 # Casting / copy
 # ---------------------------------------------------------------------------
@@ -600,3 +594,44 @@ def eye(ins, attrs, ctx):
 def diag(ins, attrs, ctx):
     """reference: operators/diag_op.cc — vector -> diagonal matrix."""
     return {"Out": jnp.diag(ins["Diagonal"][0])}
+
+
+@register_op("size", grad=None, nondiff_inputs=("Input",))
+def size_op(ins, attrs, ctx):
+    """reference: size_op.cc — total element count of the runtime tensor."""
+    return {"Out": jnp.asarray([ins["Input"][0].size], jnp.int64)}
+
+
+@register_op("diag_part", nondiff_inputs=())
+def diag_part(ins, attrs, ctx):
+    """Diagonal of a square matrix (used by MultivariateNormalDiag)."""
+    return {"Out": jnp.diagonal(_x(ins))}
+
+
+@register_op("load", grad=None)
+def load_op(ins, attrs, ctx):
+    """reference: load_op.cc — load a persisted var from file at run
+    time (the save_vars per-var .npy format). Host-side via
+    pure_callback; the declared output var shape/dtype fixes the
+    callback signature."""
+    path = attrs["file_path"]
+    out_names = ctx.op.outputs.get("Out", [])
+    shape = dtype = None
+    if ctx.program is not None and out_names:
+        for b in ctx.program.blocks:
+            if out_names[0] in b.vars:
+                vd = b.vars[out_names[0]]
+                shape = tuple(int(s) for s in vd.shape)
+                dtype = np.dtype(normalize_dtype(vd.dtype))
+                break
+    if shape is None:
+        raise RuntimeError(
+            "load: output var shape unknown — declare the var with a "
+            "concrete shape before layers.load")
+
+    def host():
+        arr = np.load(path if path.endswith(".npy") else path + ".npy")
+        return np.asarray(arr, dtype).reshape(shape)
+
+    return {"Out": jax.pure_callback(
+        host, jax.ShapeDtypeStruct(shape, dtype))}

@@ -42,6 +42,10 @@ class GeneratorLoader:
         self._places = None
         self._batched = False
 
+    @property
+    def feed_list(self):
+        return list(self._feed_list)
+
     # -- configuration (reference API) --------------------------------------
 
     def set_sample_generator(self, reader, batch_size, drop_last=True, places=None):

@@ -196,6 +196,8 @@ SPECS = {
     "inverse": ({"Input": _spd(3)}, {}, ["Input"], "Out",
                 {"max_relative_error": 2e-2}),
     "diag": ({"Diagonal": _u(4)}, {}, ["Diagonal"], "Out", {}),
+    "diag_part": ({"X": _u(4, 4)}, {}, ["X"], "Out", {}),
+    "soft_relu": ({"X": _u(3, 4)}, {"threshold": 5.0}, ["X"], "Out", {}),
     # ---- binary elementwise -------------------------------------------
     "elementwise_add": ({"X": _u(3, 4), "Y": _u(4)}, {}, ["X", "Y"],
                         "Out", {}),
