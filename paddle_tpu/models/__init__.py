@@ -13,4 +13,4 @@ BERT-base (inference/tests/api/analyzer_bert_tester.cc), Transformer NMT
 (test_dist_transformer.py).
 """
 
-from . import bert, lenet, resnet  # noqa: F401
+from . import bert, lenet, resnet, vgg  # noqa: F401

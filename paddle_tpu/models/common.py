@@ -116,3 +116,16 @@ def dropout(rng: Optional[jax.Array], x: jax.Array, rate: float,
         return x
     keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
     return jnp.where(keep, x / (1.0 - rate), 0).astype(x.dtype)
+
+
+def conv2d_nhwc(x, w, stride=1, padding="SAME"):
+    """NHWC conv with HWIO weights — the shared TPU-native conv layout
+    (resnet/lenet carry local variants pending consolidation)."""
+    return jax.lax.conv_general_dilated(
+        x, w, (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def maxpool2x2_nhwc(x):
+    return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                                 (1, 2, 2, 1), (1, 2, 2, 1), "VALID")

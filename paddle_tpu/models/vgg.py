@@ -1,0 +1,79 @@
+"""VGG-16 — the reference's headline float16 inference benchmark model
+(paddle/contrib/float16/float16_benchmark.md: VGG16 ImageNet fp16 mb=1
+3.32 ms, mb=64 60.23 ms on V100; float16_inference_demo.py builds the
+net). TPU-first: NHWC convs in bf16, biases folded into the conv
+epilogue, fc head in bf16 with f32 logits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .common import ParamStore, Params, dense
+
+# channels per conv block (VGG-16: 2-2-3-3-3 convs)
+BLOCKS = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
+
+
+@dataclasses.dataclass
+class VGGConfig:
+    n_classes: int = 1000
+    dtype: str = "bfloat16"
+    width_mult: float = 1.0     # channel scale (tiny testing configs)
+    image_hw: int = 224         # fc1's fan-in is fixed by the input size
+
+    @staticmethod
+    def vgg16():
+        return VGGConfig()
+
+    @staticmethod
+    def tiny():
+        return VGGConfig(n_classes=10, width_mult=0.125, image_hw=32)
+
+    def channels(self, c):
+        return max(8, int(c * self.width_mult))
+
+
+def init(rng: jax.Array, cfg: VGGConfig) -> Tuple[Params, Dict]:
+    s = ParamStore(rng)
+    cin = 3
+    for bi, (n_convs, cout) in enumerate(BLOCKS):
+        cout = cfg.channels(cout)
+        for ci in range(n_convs):
+            s.conv(f"b{bi}.c{ci}", 3, 3, cin, cout)
+            s.add(f"b{bi}.c{ci}.b", jnp.zeros((cout,), jnp.float32),
+                  (None,))
+            cin = cout
+    feat_hw = cfg.image_hw // 32        # 5 stride-2 pools
+    fc_dim = max(64, int(4096 * cfg.width_mult))
+    s.dense("fc1", cin * feat_hw * feat_hw, fc_dim,
+            axes=("embed", "mlp"))
+    s.dense("fc2", fc_dim, fc_dim, axes=("mlp", "mlp"))
+    s.dense("head", fc_dim, cfg.n_classes, axes=("mlp", "vocab"))
+    return s.params, s.axes
+
+
+def apply(params: Params, cfg: VGGConfig, img: jax.Array) -> jax.Array:
+    """img [B, 3, cfg.image_hw, cfg.image_hw] (reference NCHW interface)
+    -> logits [B, C]. The input size is fixed by fc1's fan-in."""
+    from .common import conv2d_nhwc, maxpool2x2_nhwc
+
+    assert img.shape[2] == img.shape[3] == cfg.image_hw, (
+        f"VGG built for {cfg.image_hw}x{cfg.image_hw} inputs, got "
+        f"{img.shape[2]}x{img.shape[3]} (fc1 fan-in is size-bound)")
+    adt = jnp.dtype(cfg.dtype)
+    x = img.transpose(0, 2, 3, 1).astype(adt)     # NHWC
+    for bi, (n_convs, _) in enumerate(BLOCKS):
+        for ci in range(n_convs):
+            x = conv2d_nhwc(x, params[f"b{bi}.c{ci}.w"].astype(adt))
+            x = jax.nn.relu(x + params[f"b{bi}.c{ci}.b"].astype(adt))
+        x = maxpool2x2_nhwc(x)
+    b = x.shape[0]
+    x = x.reshape(b, -1)
+    x = jax.nn.relu(dense(params, "fc1", x))
+    x = jax.nn.relu(dense(params, "fc2", x))
+    return dense(params, "head", x.astype(jnp.float32))

@@ -126,3 +126,24 @@ def test_graft_entry_and_dryrun():
     m = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(m)
     m.dryrun_multichip(8)
+
+
+def test_vgg16_forward_shapes_and_grad():
+    from paddle_tpu.models import vgg
+
+    cfg = vgg.VGGConfig.tiny()
+    params, _ = vgg.init(jax.random.key(0), cfg)
+    img = jax.random.normal(jax.random.key(1), (2, 3, 32, 32),
+                            jnp.float32)
+    logits = jax.jit(lambda p, x: vgg.apply(p, cfg, x))(params, img)
+    assert logits.shape == (2, 10)
+    assert jnp.isfinite(logits.astype(jnp.float32)).all()
+
+    def loss(p):
+        lg = vgg.apply(p, cfg, img).astype(jnp.float32)
+        return -jax.nn.log_softmax(lg)[jnp.arange(2), jnp.arange(2)].mean()
+
+    g = jax.grad(loss)(params)
+    gn = sum(float(jnp.sum(jnp.abs(v.astype(jnp.float32))))
+             for k, v in g.items() if k.endswith(".w"))
+    assert gn > 0
