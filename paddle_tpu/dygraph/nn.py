@@ -474,3 +474,36 @@ class SpectralNorm(Layer):
                    {"Weight": [weight], "U": [self.weight_u],
                     "V": [self.weight_v]}, {"Out": [None]},
                    self._attrs)["Out"][0]
+
+
+class TreeConv(Layer):
+    """reference: dygraph/nn.py `TreeConv` → tree_conv op (TBCNN over
+    NodesVector/EdgeSet). The op's filter is [feature_size, 3,
+    out_channels]; the reference's extra num_filters dim folds into the
+    channel dim (out = output_size * num_filters), matching the op's
+    [N, M, C] output."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+                 name=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._max_depth = max_depth
+        self._act = act
+        c = int(output_size) * int(num_filters)
+        self.weight = self.create_parameter(
+            [feature_size, 3, c], attr=param_attr)
+        self.bias = (self.create_parameter(
+            [c], attr=bias_attr, is_bias=True)
+            if bias_attr is not False else None)
+
+    def forward(self, nodes_vector, edge_set):
+        out = _op("tree_conv",
+                  {"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                   "Filter": [self.weight]}, {"Out": [None]},
+                  {"max_depth": self._max_depth})["Out"][0]
+        if self.bias is not None:
+            out = _op("elementwise_add", {"X": [out], "Y": [self.bias]},
+                      {"Out": [None]}, {"axis": -1})["Out"][0]
+        if self._act:
+            out = _op(self._act, {"X": [out]}, {"Out": [None]})["Out"][0]
+        return out

@@ -13,7 +13,8 @@ from typing import Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-from .common import ParamStore, Params, dense
+from .common import (ParamStore, Params, conv2d_nhwc, dense,
+                     maxpool2x2_nhwc)
 
 # channels per conv block (VGG-16: 2-2-3-3-3 convs)
 BLOCKS = [(2, 64), (2, 128), (3, 256), (3, 512), (3, 512)]
@@ -60,8 +61,6 @@ def init(rng: jax.Array, cfg: VGGConfig) -> Tuple[Params, Dict]:
 def apply(params: Params, cfg: VGGConfig, img: jax.Array) -> jax.Array:
     """img [B, 3, cfg.image_hw, cfg.image_hw] (reference NCHW interface)
     -> logits [B, C]. The input size is fixed by fc1's fan-in."""
-    from .common import conv2d_nhwc, maxpool2x2_nhwc
-
     assert img.shape[2] == img.shape[3] == cfg.image_hw, (
         f"VGG built for {cfg.image_hw}x{cfg.image_hw} inputs, got "
         f"{img.shape[2]}x{img.shape[3]} (fc1 fan-in is size-bound)")

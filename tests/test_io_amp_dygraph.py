@@ -213,3 +213,29 @@ def test_dygraph_extended_layer_zoo():
         nce = dnn.NCE(10, 6, num_neg_samples=3)
         cost = nce(feats, ids)
         assert tuple(cost.shape) == (4, 1)
+
+
+def test_dygraph_tree_conv():
+    import numpy as np
+
+    import paddle_tpu as pt
+
+    with pt.dygraph.guard():
+        tc = pt.dygraph.nn.TreeConv(feature_size=3, output_size=2,
+                                    max_depth=2)
+        nodes = pt.dygraph.to_variable(
+            np.random.RandomState(0).rand(1, 4, 3).astype("float32"))
+        edges = pt.dygraph.to_variable(
+            np.array([[[1, 0], [2, 0], [3, 1]]], "int64"))
+        out = tc(nodes, edges)
+        assert np.asarray(out.numpy()).shape == (1, 4, 2)
+        # trains: loss moves under SGD on the filter
+        opt = pt.optimizer.SGD(0.1)
+        losses = []
+        for _ in range(4):
+            loss = pt.layers.mean(tc(nodes, edges))
+            loss.backward()
+            opt.minimize(loss, parameter_list=tc.parameters())
+            tc.clear_gradients()
+            losses.append(float(np.asarray(loss.numpy()).reshape(())))
+        assert losses[-1] != losses[0]

@@ -9,7 +9,16 @@ numbers the reference ships):
 | ResNet50 ImageNet mb=1      | 6.13 ms  |
 | ResNet50 ImageNet mb=128    | 64.52 ms |
 
-Prints one JSON line per config; vs_baseline = reference_ms / ours_ms
+Measurement: DEVICE latency via an on-device chain — N model calls
+inside one lax.scan, each iteration's input data-dependent on the
+previous iteration's logits, so the device executes them strictly
+serially and per-call host dispatch is excluded. This matches what the
+reference's local harness measures (its host dispatch is ~0.1 ms); the
+environment here tunnels to a remote chip whose HOST round trip is
+~90 ms per call, which would swamp any per-request measurement and is
+reported separately as host_roundtrip_ms for context.
+
+Prints one JSON line per config; vs_baseline = reference_ms / device_ms
 (>1 means this framework on one v5e chip beats the reference's V100
 fp16 number). Run: python tools/infer_bench.py
 """
@@ -34,29 +43,39 @@ REF_MS = {
     ("resnet50", 128): 64.52,
 }
 
+N_CHAIN = 30
 
-def _bench(fn, args, n=30):
-    out = fn(*args)
-    float(jnp.sum(out))          # sync (tunneled backend)
+
+def _device_latency_ms(model_fn, params, img):
+    """Serialized on-device per-call latency: scan N_CHAIN model calls,
+    each input perturbed by (0 x sum(prev logits)) to force a data
+    dependency (no cross-iteration parallelism, no host in the loop)."""
+
+    @jax.jit
+    def chain(p, x0):
+        def step(x, _):
+            logits = model_fn(p, x)
+            dep = (jnp.sum(logits) * 0.0).astype(x.dtype)
+            return x + dep, ()
+
+        xn, _ = jax.lax.scan(step, x0, None, length=N_CHAIN)
+        return jnp.sum(xn)
+
+    float(chain(params, img))           # warmup + compile
     t0 = time.perf_counter()
-    for _ in range(n):
-        out = fn(*args)
-    float(jnp.sum(out))
-    return (time.perf_counter() - t0) / n * 1000
+    float(chain(params, img))
+    total = (time.perf_counter() - t0) * 1000
+    return total / N_CHAIN
 
 
-def _tunnel_floor(n=50):
-    """Per-call dispatch+sync floor of the (possibly tunneled) backend —
-    a scalar add round trip. On the axon tunnel this is ~2 ms, which
-    dominates bs=1 latencies; local-chip latency ≈ value - floor."""
+def _host_roundtrip_ms(n=5):
+    """Serial host->device->host round trip (the tunnel floor here)."""
     tiny = jax.jit(lambda x: x + 1.0)
     z = jnp.zeros(())
-    tiny(z)
     float(tiny(z))
     t0 = time.perf_counter()
     for _ in range(n):
-        out = tiny(z)
-    float(out)
+        float(tiny(z))
     return (time.perf_counter() - t0) / n * 1000
 
 
@@ -64,33 +83,36 @@ def main():
     from paddle_tpu.models import resnet, vgg
 
     platform = jax.devices()[0].platform
-    floor = _tunnel_floor()
-    rng = jax.random.key(0)
+    rtt = _host_roundtrip_ms()
 
     vcfg = vgg.VGGConfig.vgg16()
-    vparams, _ = vgg.init(rng, vcfg)
-    vfn = jax.jit(lambda p, x: vgg.apply(p, vcfg, x))
+    vparams, _ = vgg.init(jax.random.key(0), vcfg)
 
     rcfg = resnet.ResNetConfig.resnet50()
     rparams, _ = resnet.init(jax.random.key(1), rcfg)
-    rfn = jax.jit(lambda p, x: resnet.apply(p, rcfg, x, train=False)[0])
 
-    configs = [("vgg16", vfn, vparams, 1), ("vgg16", vfn, vparams, 64),
-               ("resnet50", rfn, rparams, 1),
-               ("resnet50", rfn, rparams, 128)]
+    def vgg_fn(p, x):
+        return vgg.apply(p, vcfg, x)
+
+    def rn_fn(p, x):
+        return resnet.apply(p, rcfg, x, train=False)[0]
+
+    configs = [("vgg16", vgg_fn, vparams, 1), ("vgg16", vgg_fn, vparams, 64),
+               ("resnet50", rn_fn, rparams, 1),
+               ("resnet50", rn_fn, rparams, 128)]
     for name, fn, params, bs in configs:
         img = jax.random.normal(jax.random.key(2), (bs, 3, 224, 224),
                                 jnp.float32)
-        ms = _bench(fn, (params, img))
+        ms = _device_latency_ms(fn, params, img)
         ref = REF_MS[(name, bs)]
         print(json.dumps({
-            "metric": f"{name}_infer_latency_ms_bs{bs}",
+            "metric": f"{name}_infer_device_latency_ms_bs{bs}",
             "value": round(ms, 3), "unit": "ms",
             "vs_baseline": round(ref / ms, 3),
             "detail": {"batch_size": bs, "platform": platform,
                        "reference_v100_fp16_ms": ref,
-                       "dispatch_floor_ms": round(floor, 3),
-                       "compute_ms_minus_floor": round(ms - floor, 3),
+                       "chained_serial_calls": N_CHAIN,
+                       "host_roundtrip_ms": round(rtt, 3),
                        "source": "contrib/float16/float16_benchmark.md"},
         }), flush=True)
     return 0
