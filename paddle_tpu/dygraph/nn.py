@@ -489,11 +489,15 @@ class TreeConv(Layer):
         super().__init__(dtype=dtype)
         self._max_depth = max_depth
         self._act = act
-        c = int(output_size) * int(num_filters)
+        self._num_filters = int(num_filters)
+        self._output_size = int(output_size)
+        c = self._output_size * self._num_filters
         self.weight = self.create_parameter(
             [feature_size, 3, c], attr=param_attr)
+        # bias stays [num_filters] like the reference (shared across
+        # output_size) so checkpoints transfer; tiled at forward time
         self.bias = (self.create_parameter(
-            [c], attr=bias_attr, is_bias=True)
+            [self._num_filters], attr=bias_attr, is_bias=True)
             if bias_attr is not False else None)
 
     def forward(self, nodes_vector, edge_set):
@@ -502,7 +506,9 @@ class TreeConv(Layer):
                    "Filter": [self.weight]}, {"Out": [None]},
                   {"max_depth": self._max_depth})["Out"][0]
         if self.bias is not None:
-            out = _op("elementwise_add", {"X": [out], "Y": [self.bias]},
+            tiled = _op("tile", {"X": [self.bias]}, {"Out": [None]},
+                        {"repeat_times": [self._output_size]})["Out"][0]
+            out = _op("elementwise_add", {"X": [out], "Y": [tiled]},
                       {"Out": [None]}, {"axis": -1})["Out"][0]
         if self._act:
             out = _op(self._act, {"X": [out]}, {"Out": [None]})["Out"][0]

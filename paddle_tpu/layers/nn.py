@@ -772,15 +772,14 @@ def unique(x, dtype="int64"):
 
 def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
     """reference: operators/shard_index_op.cc (sharded classification)."""
-    from . import ops as _ops
-    from .tensor import cast
-
     helper = LayerHelper("shard_index")
-    shard_size = index_num // nshards
     out = helper.create_variable_for_type_inference(input.dtype)
-    helper.append_op(type="scale", inputs={"X": input}, outputs={"Out": out},
-                     attrs={"scale": 1.0, "bias": float(-shard_id * shard_size),
-                            "bias_after_scale": True})
+    helper.append_op(type="shard_index", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"index_num": int(index_num),
+                            "nshards": int(nshards),
+                            "shard_id": int(shard_id),
+                            "ignore_value": int(ignore_value)})
     return out
 
 

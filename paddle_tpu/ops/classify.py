@@ -244,3 +244,20 @@ def cos_sim(ins, attrs, ctx):
     dot = jnp.sum(x * y, axis=-1, keepdims=True)
     eps = jnp.asarray(1e-12, x.dtype)
     return {"Out": dot / jnp.maximum(xn * yn, eps), "XNorm": xn, "YNorm": yn}
+
+
+@register_op("cross_entropy2", nondiff_inputs=("Label",),
+             intermediate_outputs=("XShape", "MatchX"))
+def cross_entropy2(ins, attrs, ctx):
+    """reference: cross_entropy2_op.cc — hard-label CE that also emits
+    MatchX (the matched probability, reused by its backward)."""
+    x = ins["X"][0]
+    label = ins["Label"][0]
+    if label.ndim == x.ndim:
+        label = label[..., 0]
+    ix = int(attrs.get("ignore_index", -100))
+    lab = jnp.maximum(label, 0).astype(jnp.int32)
+    match = jnp.take_along_axis(x, lab[..., None], axis=-1)[..., 0]
+    valid = label != ix
+    y = jnp.where(valid, -jnp.log(jnp.maximum(match, 1e-20)), 0.0)
+    return {"Y": y[..., None], "MatchX": match[..., None], "XShape": None}

@@ -728,6 +728,10 @@ def box_decoder_and_assign(ins, attrs, ctx):
 # ---------------------------------------------------------------------------
 
 
+def _multiclass_nms_alias(ins, attrs, ctx):
+    return multiclass_nms(ins, attrs, ctx)
+
+
 @register_op("multiclass_nms", grad=None)
 def multiclass_nms(ins, attrs, ctx):
     """reference: detection/multiclass_nms_op.cc. Static-shape output:
@@ -1726,3 +1730,8 @@ def _encode_rpn_targets(anchors, gt, best_gt):
     return jnp.stack([(gcx - acx) / aw, (gcy - acy) / ah,
                       jnp.log(jnp.maximum(gw / aw, 1e-10)),
                       jnp.log(jnp.maximum(gh / ah, 1e-10))], axis=-1)
+
+
+# reference registers multiclass_nms2 as its own op type (same kernel +
+# the Index output, multiclass_nms_op.cc)
+register_op("multiclass_nms2", grad=None)(_multiclass_nms_alias)

@@ -174,3 +174,9 @@ def maximum(ins, attrs, ctx):
 @register_op("minimum")
 def minimum(ins, attrs, ctx):
     return {"Out": jnp.minimum(ins["X"][0], ins["Y"][0])}
+
+
+@register_op("l1_norm")
+def l1_norm(ins, attrs, ctx):
+    """reference: l1_norm_op.cc — sum(|x|) to shape [1]."""
+    return {"Out": jnp.sum(jnp.abs(ins["X"][0])).reshape(1)}

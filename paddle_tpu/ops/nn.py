@@ -794,3 +794,56 @@ def embedding_with_scaled_gradient(ins, attrs, ctx):
     from .tensor import lookup_table_v2
 
     return lookup_table_v2(ins, attrs, ctx)
+
+
+@register_op("fc")
+def fc_op(ins, attrs, ctx):
+    """reference: fc_op.cc (the fused inference fc): Out =
+    act(flatten(X) @ W + b) with in_num_col_dims."""
+    x = ins["Input"][0]
+    w = ins["W"][0]
+    b = (ins.get("Bias") or [None])[0]
+    ncol = int(attrs.get("in_num_col_dims", 1))
+    lead = x.shape[:ncol]
+    x2 = x.reshape((int(np.prod(lead)), -1))
+    out = x2 @ w.astype(x2.dtype)
+    if b is not None:
+        out = out + b.reshape(1, -1).astype(out.dtype)
+    act = attrs.get("activation_type", "")
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act:
+        raise ValueError(f"fc: unsupported activation {act}")
+    return {"Out": out.reshape(tuple(lead) + (w.shape[1],))}
+
+
+@register_op("depthwise_conv2d_transpose")
+def depthwise_conv2d_transpose(ins, attrs, ctx):
+    """reference: conv_transpose_op.cc depthwise registration — grouped
+    transpose conv with groups == channels. ONE batched HLO: vmap over
+    the channel axis (a Python per-channel loop would emit C separate
+    convs)."""
+    x, w = ins["Input"][0], ins["Filter"][0]   # w: [C, 1, kh, kw]
+    strides = tuple(int(s) for s in attrs.get("strides", [1, 1]))
+    dils = tuple(int(d) for d in attrs.get("dilations", [1, 1]))
+    pads = [int(p) for p in attrs.get("paddings", [0, 0])]
+    if len(pads) == 2:
+        pad_pairs = [(pads[0], pads[0]), (pads[1], pads[1])]
+    else:  # [top, bottom, left, right]
+        pad_pairs = [(pads[0], pads[1]), (pads[2], pads[3])]
+    padding = [((w.shape[2 + i] - 1) * dils[i] - lo,
+                (w.shape[2 + i] - 1) * dils[i] - hi)
+               for i, (lo, hi) in enumerate(pad_pairs)]
+
+    def one_channel(xc, wc):
+        # xc [N,1,H,W], wc [1,1,kh,kw]
+        dn = jax.lax.conv_dimension_numbers(xc.shape, wc.shape,
+                                            ("NCHW", "OIHW", "NCHW"))
+        return jax.lax.conv_transpose(
+            xc, wc, strides=strides, padding=padding, rhs_dilation=dils,
+            dimension_numbers=dn, transpose_kernel=True)[:, 0]
+
+    # [C, N, 1, H, W] per-channel inputs; vmap emits one batched conv
+    xc = jnp.moveaxis(x, 1, 0)[:, :, None]
+    out = jax.vmap(one_channel)(xc, w[:, None])   # [C, N, H', W']
+    return {"Output": jnp.moveaxis(out, 0, 1)}

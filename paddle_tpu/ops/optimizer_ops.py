@@ -300,3 +300,46 @@ def proximal_adagrad(ins, attrs, ctx):
     else:
         new_p = prox / (1.0 + eff_lr * l2)
     return {"ParamOut": new_p, "MomentOut": m_new}
+
+
+@register_op("average_accumulates", grad=None,
+             nondiff_inputs=("param", "in_sum_1", "in_sum_2", "in_sum_3",
+                             "in_num_accumulates", "in_old_num_accumulates",
+                             "in_num_updates"))
+def average_accumulates(ins, attrs, ctx):
+    """reference: average_accumulates_op.h — ModelAverage's accumulator
+    update: sum_1 += param each step; every 16384 updates sum_1 rolls
+    into sum_2; when num_accumulates exceeds max(avg_window *
+    num_updates, min_window) (capped by max_window), sum_2 <- sum_1 +
+    sum_2 rolls into sum_3 and the window restarts."""
+    k_max = 16384
+    p = ins["param"][0]
+    s1 = ins["in_sum_1"][0]
+    s2 = ins["in_sum_2"][0]
+    s3 = ins["in_sum_3"][0]
+    na = ins["in_num_accumulates"][0].reshape(()).astype(jnp.int32)
+    ona = ins["in_old_num_accumulates"][0].reshape(()).astype(jnp.int32)
+    nu = ins["in_num_updates"][0].reshape(()).astype(jnp.int32)
+    avg_win = float(attrs.get("average_window", 0.0))
+    max_win = int(attrs.get("max_average_window", 2 ** 31 - 1))
+    min_win = int(attrs.get("min_average_window", 10000))
+
+    nu = nu + 1
+    na = na + 1
+    s1 = s1 + p
+    roll16k = (nu % k_max) == 0
+    s2 = jnp.where(roll16k, s2 + s1, s2)
+    s1 = jnp.where(roll16k, jnp.zeros_like(s1), s1)
+    window = jnp.minimum(
+        jnp.maximum((avg_win * nu.astype(jnp.float32)).astype(jnp.int32),
+                    min_win), max_win)
+    restart = na >= window
+    s3 = jnp.where(restart, s1 + s2, s3)
+    s1 = jnp.where(restart, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(restart, jnp.zeros_like(s2), s2)
+    ona = jnp.where(restart, na, ona)
+    na = jnp.where(restart, jnp.zeros_like(na), na)
+    return {"out_sum_1": s1, "out_sum_2": s2, "out_sum_3": s3,
+            "out_num_accumulates": na.reshape(1),
+            "out_old_num_accumulates": ona.reshape(1),
+            "out_num_updates": nu.reshape(1)}

@@ -86,3 +86,132 @@ def fake_quantize_dequantize_moving_average_abs_max(ins, attrs, ctx):
             "OutScale": scale.reshape(1),
             "OutState": new_state.reshape(1),
             "OutAccum": new_accum.reshape(1)}
+
+
+# ---------------------------------------------------------------------------
+# Quantize-only / dequantize-only export ops (reference: the INT8 export
+# path in quantization_pass.py — quantized values live in float tensors)
+# ---------------------------------------------------------------------------
+
+
+def _quant_only(x, scale, bits):
+    bnt = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    return jnp.clip(jnp.round(x / s * bnt), -bnt, bnt)
+
+
+@register_op("fake_quantize_abs_max", grad=None,
+             intermediate_outputs=("OutScale",))
+def fake_quantize_abs_max(ins, attrs, ctx):
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    scale = jnp.max(jnp.abs(x))
+    return {"Out": _quant_only(x, scale, bits), "OutScale": scale.reshape(1)}
+
+
+@register_op("fake_channel_wise_quantize_abs_max", grad=None,
+             intermediate_outputs=("OutScale",))
+def fake_channel_wise_quantize_abs_max(ins, attrs, ctx):
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    axis = int(attrs.get("quant_axis", 0))
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    return {"Out": _quant_only(x, scale, bits),
+            "OutScale": scale.reshape(-1)}
+
+
+@register_op("fake_quantize_range_abs_max", grad=None,
+             nondiff_inputs=("InScale", "Iter"),
+             intermediate_outputs=("OutScale", "OutScales"))
+def fake_quantize_range_abs_max(ins, attrs, ctx):
+    """reference: fake_quantize_op.cc range_abs_max — training keeps a
+    window of recent abs-maxes; scale = max(window). Static form: scale =
+    max(in_scale, cur) in training (the window max telescopes), in_scale
+    at inference."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    is_test = bool(attrs.get("is_test", False)) or ctx.is_test
+    in_scale = ins["InScale"][0].reshape(())
+    cur = jnp.max(jnp.abs(x))
+    scale = in_scale if is_test else jnp.maximum(in_scale, cur)
+    return {"Out": _quant_only(x, scale, bits),
+            "OutScale": scale.reshape(1), "OutScales": scale.reshape(1)}
+
+
+@register_op("fake_quantize_moving_average_abs_max", grad=None,
+             nondiff_inputs=("InScale", "InState", "InAccum"),
+             intermediate_outputs=("OutScale", "OutState", "OutAccum"))
+def fake_quantize_moving_average_abs_max(ins, attrs, ctx):
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    rate = float(attrs.get("moving_rate", 0.9))
+    is_test = bool(attrs.get("is_test", False)) or ctx.is_test
+    in_scale = ins["InScale"][0].reshape(())
+    state = ins["InState"][0].reshape(()) if ins.get("InState") and \
+        ins["InState"][0] is not None else jnp.asarray(1.0, x.dtype)
+    accum = ins["InAccum"][0].reshape(()) if ins.get("InAccum") and \
+        ins["InAccum"][0] is not None else in_scale
+    cur = jnp.max(jnp.abs(x))
+    if is_test:
+        scale, new_state, new_accum = in_scale, state, accum
+    else:
+        new_state = rate * state + 1.0
+        new_accum = rate * accum + cur
+        scale = new_accum / new_state
+    return {"Out": _quant_only(x, scale, bits),
+            "OutScale": scale.reshape(1), "OutState": new_state.reshape(1),
+            "OutAccum": new_accum.reshape(1)}
+
+
+@register_op("fake_dequantize_max_abs", grad=None,
+             nondiff_inputs=("Scale",))
+def fake_dequantize_max_abs(ins, attrs, ctx):
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(())
+    max_range = float(attrs.get("max_range", 127.0))
+    return {"Out": x * scale / max_range}
+
+
+@register_op("fake_channel_wise_dequantize_max_abs", grad=None,
+             nondiff_inputs=("Scales",))
+def fake_channel_wise_dequantize_max_abs(ins, attrs, ctx):
+    """reference: fake_dequantize_op.cc channel-wise — Scales is a list
+    of 1 or 2 scale tensors (weight-channel scale, then optional
+    activation scale); quant_bits gives the ranges."""
+    x = ins["X"][0]
+    scales = [s for s in ins["Scales"] if s is not None]
+    bits = [int(b) for b in attrs.get("quant_bits", [8])]
+    axis = int(attrs.get("quant_axis", 0))
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    out = x * scales[0].reshape(shape) / float(2 ** (bits[0] - 1) - 1)
+    if len(scales) > 1:
+        out = out * scales[1].reshape(()) / float(2 ** (bits[1] - 1) - 1)
+    return {"Out": out}
+
+
+@register_op("moving_average_abs_max_scale", grad=None,
+             nondiff_inputs=("InState", "InAccum"),
+             intermediate_outputs=("OutScale", "OutState", "OutAccum"))
+def moving_average_abs_max_scale(ins, attrs, ctx):
+    """Scale observer only: Out = X passthrough, scale state updates like
+    the moving-average quantizer (used to record activation ranges)."""
+    x = ins["X"][0]
+    rate = float(attrs.get("moving_rate", 0.9))
+    is_test = bool(attrs.get("is_test", False)) or ctx.is_test
+    state = ins["InState"][0].reshape(()) if ins.get("InState") and \
+        ins["InState"][0] is not None else jnp.asarray(1.0, x.dtype)
+    accum = ins["InAccum"][0].reshape(()) if ins.get("InAccum") and \
+        ins["InAccum"][0] is not None else jnp.asarray(0.0, x.dtype)
+    cur = jnp.max(jnp.abs(x))
+    if is_test:
+        scale, new_state, new_accum = accum / jnp.maximum(state, 1e-9), \
+            state, accum
+    else:
+        new_state = rate * state + 1.0
+        new_accum = rate * accum + cur
+        scale = new_accum / new_state
+    return {"Out": x, "OutScale": scale.reshape(1),
+            "OutState": new_state.reshape(1),
+            "OutAccum": new_accum.reshape(1)}

@@ -635,3 +635,124 @@ def load_op(ins, attrs, ctx):
 
     return {"Out": jax.pure_callback(
         host, jax.ShapeDtypeStruct(shape, dtype))}
+
+
+@register_op("fill", grad=None)
+def fill_op(ins, attrs, ctx):
+    """reference: fill_op.cc — explicit per-element values + shape."""
+    shape = [int(s) for s in attrs["shape"]]
+    vals = attrs.get("value", attrs.get("values"))
+    return {"Out": jnp.asarray(vals, dtype=_dt(attrs)).reshape(shape)}
+
+
+@register_op("fill_any_like", grad=None, nondiff_inputs=("X",))
+def fill_any_like(ins, attrs, ctx):
+    x = _x(ins)
+    dt = _dt(attrs) if attrs.get("dtype") else x.dtype
+    return {"Out": jnp.full(x.shape, attrs.get("value", 0.0), dt)}
+
+
+@register_op("fill_zeros_like2", grad=None, nondiff_inputs=("X",))
+def fill_zeros_like2(ins, attrs, ctx):
+    x = _x(ins)
+    dt = _dt(attrs) if attrs.get("dtype") else x.dtype
+    return {"Out": jnp.zeros(x.shape, dt)}
+
+
+@register_op("one_hot_v2", grad=None, nondiff_inputs=("X",))
+def one_hot_v2(ins, attrs, ctx):
+    """reference: one_hot_v2_op.cc — appends depth to the input shape
+    AS-IS (unlike one_hot, which squeezes a trailing [.,1] dim)."""
+    x = _x(ins)
+    depth = int(attrs["depth"])
+    return {"Out": jax.nn.one_hot(x, depth, dtype=jnp.float32)}
+
+
+@register_op("shard_index", grad=None, nondiff_inputs=("X",))
+def shard_index(ins, attrs, ctx):
+    """reference: shard_index_op.cc — out = in//shard_size == shard_id ?
+    in % shard_size : ignore_value (sharded classification heads)."""
+    x = _x(ins)
+    index_num = int(attrs["index_num"])
+    nshards = int(attrs["nshards"])
+    shard_id = int(attrs["shard_id"])
+    ignore = int(attrs.get("ignore_value", -1))
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return {"Out": jnp.where(in_shard, x % shard_size, ignore)}
+
+
+def _resolve_save_path(path):
+    import os
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    return path
+
+
+@register_op("save", grad=None, nondiff_inputs=("X",))
+def save_op(ins, attrs, ctx):
+    """reference: save_op.cc — persist a var at run time (the save_vars
+    per-var .npy format io.py reads back)."""
+    from jax.experimental import io_callback
+
+    x = _x(ins)
+    path = attrs["file_path"]
+
+    def host(v):
+        np.save(_resolve_save_path(path), np.asarray(v))
+
+    io_callback(host, None, x, ordered=True)
+    return {}
+
+
+@register_op("save_combine", grad=None, nondiff_inputs=("X",))
+def save_combine(ins, attrs, ctx):
+    """reference: save_combine_op.cc — many vars into one file (.npz,
+    matching io.py's save_vars(filename=...) format)."""
+    from jax.experimental import io_callback
+
+    pairs = [(n, x) for n, x in zip(ctx.op.inputs.get("X", []),
+                                    ins["X"]) if n and x is not None]
+    names = [n for n, _ in pairs]
+    xs = [x for _, x in pairs]
+    path = attrs["file_path"]
+
+    def host(*arrays):
+        np.savez(_resolve_save_path(path),
+                 **{n: np.asarray(a) for n, a in zip(names, arrays)})
+
+    io_callback(host, None, *xs, ordered=True)
+    return {}
+
+
+@register_op("load_combine", grad=None)
+def load_combine(ins, attrs, ctx):
+    """reference: load_combine_op.cc — restore many declared vars from a
+    save_combine .npz."""
+    path = attrs["file_path"]
+    out_names = ctx.op.outputs.get("Out", [])
+    shapes = []
+    from ..core.ir import normalize_dtype as _nd
+
+    for n in out_names:
+        vd = None
+        if ctx.program is not None:
+            for b in ctx.program.blocks:
+                if n in b.vars:
+                    vd = b.vars[n]
+                    break
+        if vd is None:
+            raise RuntimeError(f"load_combine: unknown out var {n}")
+        shapes.append(jax.ShapeDtypeStruct(
+            tuple(int(s) for s in vd.shape), np.dtype(_nd(vd.dtype))))
+
+    def host():
+        f = path if path.endswith(".npz") else path + ".npz"
+        data = np.load(f)
+        return tuple(np.asarray(data[n], s.dtype).reshape(s.shape)
+                     for n, s in zip(out_names, shapes))
+
+    outs = jax.pure_callback(host, tuple(shapes))
+    return {"Out": list(outs)}

@@ -310,6 +310,12 @@ SPECS = {
                          {"strides": [2, 2], "paddings": [1, 1]},
                          ["Input", "Filter"], "Output",
                          {"max_relative_error": 2e-2}),
+    "depthwise_conv2d_transpose": ({"Input": _u(1, 3, 4, 4),
+                                    "Filter": _u(3, 1, 2, 2)},
+                                   {"strides": [2, 2],
+                                    "paddings": [0, 0]},
+                                   ["Input", "Filter"], "Output",
+                                   {"max_relative_error": 2e-2}),
     "conv3d_transpose": ({"Input": _u(1, 2, 3, 3, 3),
                           "Filter": _u(2, 2, 2, 2, 2)},
                          {"strides": [1, 1, 1], "paddings": [0, 0, 0]},

@@ -1,0 +1,214 @@
+"""Second round-2 op batch: quant-export family, fc, fill family,
+l1_norm, save/load_combine, average_accumulates, shard_index,
+cross_entropy2, multiclass_nms2 alias (reference: fake_quantize_op.cc,
+fc_op.cc, fill_op.cc, l1_norm_op.cc, save/load_combine_op.cc,
+average_accumulates_op.h, shard_index_op.cc, cross_entropy2_op.cc)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_grad, run_op
+
+
+def test_fake_quantize_abs_max_and_dequant():
+    x = np.array([[0.5, -1.27, 0.635]], "float64")
+    out = run_op("fake_quantize_abs_max", {"X": x}, {"bit_length": 8},
+                 outputs=("Out", "OutScale"))
+    np.testing.assert_allclose(out["OutScale"][0], [1.27])
+    np.testing.assert_allclose(out["Out"][0], [[50, -127, 64]])  # rounded
+    deq = run_op("fake_dequantize_max_abs",
+                 {"X": out["Out"][0], "Scale": out["OutScale"][0]},
+                 {"max_range": 127.0})["Out"][0]
+    np.testing.assert_allclose(deq, [[0.5, -1.27, 0.64]], atol=1e-9)
+
+
+def test_fake_channel_wise_quantize_and_dequant():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 3, 2, 2)
+    out = run_op("fake_channel_wise_quantize_abs_max", {"X": x},
+                 {"bit_length": 8, "quant_axis": 0},
+                 outputs=("Out", "OutScale"))
+    scales = out["OutScale"][0]
+    np.testing.assert_allclose(scales,
+                               np.abs(x).max(axis=(1, 2, 3)), rtol=1e-7)
+    deq = run_op("fake_channel_wise_dequantize_max_abs",
+                 {"X": out["Out"][0], "Scales": [scales]},
+                 {"quant_bits": [8], "quant_axis": 0})["Out"][0]
+    np.testing.assert_allclose(deq, x, atol=np.abs(x).max() / 127 + 1e-9)
+
+
+def test_fake_quantize_range_and_moving_average():
+    x = np.array([[2.0, -1.0]], "float64")
+    out = run_op("fake_quantize_range_abs_max",
+                 {"X": x, "InScale": np.array([3.0]),
+                  "Iter": np.array([1], "int64")},
+                 {"bit_length": 8}, outputs=("Out", "OutScale"))
+    np.testing.assert_allclose(out["OutScale"][0], [3.0])  # window max
+    out2 = run_op("fake_quantize_moving_average_abs_max",
+                  {"X": x, "InScale": np.array([1.0]),
+                   "InState": np.array([1.0]), "InAccum": np.array([1.0])},
+                  {"bit_length": 8, "moving_rate": 0.9},
+                  outputs=("OutScale", "OutState", "OutAccum"))
+    np.testing.assert_allclose(out2["OutState"][0], [1.9])
+    np.testing.assert_allclose(out2["OutAccum"][0], [0.9 * 1 + 2.0])
+    # observer op passes input through untouched
+    obs = run_op("moving_average_abs_max_scale",
+                 {"X": x, "InState": np.array([1.0]),
+                  "InAccum": np.array([0.0])}, {},
+                 outputs=("Out", "OutScale"))
+    np.testing.assert_allclose(obs["Out"][0], x)
+
+
+def test_fc_op():
+    rng = np.random.RandomState(1)
+    x = rng.randn(3, 4).astype("float64")
+    w = rng.randn(4, 5).astype("float64")
+    b = rng.randn(5).astype("float64")
+    out = run_op("fc", {"Input": x, "W": w, "Bias": b},
+                 {"in_num_col_dims": 1})["Out"][0]
+    np.testing.assert_allclose(out, x @ w + b, rtol=1e-9)
+    relu = run_op("fc", {"Input": x, "W": w, "Bias": b},
+                  {"activation_type": "relu"})["Out"][0]
+    np.testing.assert_allclose(relu, np.maximum(x @ w + b, 0), rtol=1e-9)
+    check_grad("fc", {"Input": x, "W": w, "Bias": b}, {},
+               inputs_to_check=["Input", "W", "Bias"])
+
+
+def test_fill_family():
+    out = run_op("fill", {}, {"shape": [2, 2], "dtype": "float32",
+                              "value": [1.0, 2.0, 3.0, 4.0]})["Out"][0]
+    np.testing.assert_allclose(out, [[1, 2], [3, 4]])
+    x = np.zeros((2, 3), "float32")
+    np.testing.assert_allclose(
+        run_op("fill_any_like", {"X": x}, {"value": 7.0})["Out"][0], 7.0)
+    np.testing.assert_allclose(
+        run_op("fill_zeros_like2", {"X": x}, {})["Out"][0], 0.0)
+
+
+def test_l1_norm():
+    x = np.array([[1.0, -2.0], [3.0, -4.0]])
+    np.testing.assert_allclose(run_op("l1_norm", {"X": x}, {})["Out"][0],
+                               [10.0])
+    check_grad("l1_norm", {"X": x + 0.1}, {}, inputs_to_check=["X"])
+
+
+def test_shard_index():
+    x = np.array([[1], [6], [12], [19]], "int64")
+    out = run_op("shard_index", {"X": x},
+                 {"index_num": 20, "nshards": 2, "shard_id": 0,
+                  "ignore_value": -1})["Out"][0]
+    # shard_size=10: ids <10 -> local id, else ignore
+    np.testing.assert_array_equal(out, [[1], [6], [-1], [-1]])
+    out1 = run_op("shard_index", {"X": x},
+                  {"index_num": 20, "nshards": 2, "shard_id": 1,
+                   "ignore_value": -1})["Out"][0]
+    np.testing.assert_array_equal(out1, [[-1], [-1], [2], [9]])
+
+
+def test_cross_entropy2():
+    rng = np.random.RandomState(2)
+    p = rng.rand(3, 4) + 0.1
+    p = p / p.sum(1, keepdims=True)
+    lab = np.array([[1], [3], [0]], "int64")
+    out = run_op("cross_entropy2", {"X": p, "Label": lab}, {},
+                 outputs=("Y", "MatchX"))
+    want = -np.log(p[np.arange(3), lab[:, 0]])
+    np.testing.assert_allclose(out["Y"][0][:, 0], want, rtol=1e-9)
+    np.testing.assert_allclose(out["MatchX"][0][:, 0],
+                               p[np.arange(3), lab[:, 0]], rtol=1e-9)
+    check_grad("cross_entropy2", {"X": p, "Label": lab}, {},
+               inputs_to_check=["X"], output_name="Y")
+
+
+def test_save_load_combine_roundtrip(tmp_path):
+    import paddle_tpu as pt
+
+    a = np.arange(6, dtype="float32").reshape(2, 3)
+    b = np.arange(4, dtype="float32").reshape(4)
+    main, startup = pt.Program(), pt.Program()
+    path = str(tmp_path / "combined")
+    with pt.program_guard(main, startup):
+        va = pt.layers.assign(a)
+        vb = pt.layers.assign(b)
+        main.current_block().append_op(
+            type="save_combine", inputs={"X": [va, vb]}, outputs={},
+            attrs={"file_path": path})
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    exe.run(main, feed={}, fetch_list=[va.name])
+    # restore into declared vars
+    m2, s2 = pt.Program(), pt.Program()
+    with pt.program_guard(m2, s2):
+        ra = m2.current_block().create_var(name=va.name, shape=[2, 3],
+                                           dtype="float32")
+        rb = m2.current_block().create_var(name=vb.name, shape=[4],
+                                           dtype="float32")
+        m2.current_block().append_op(
+            type="load_combine", inputs={}, outputs={"Out": [ra, rb]},
+            attrs={"file_path": path})
+    oa, ob = exe.run(m2, feed={}, fetch_list=[ra.name, rb.name])
+    np.testing.assert_allclose(oa, a)
+    np.testing.assert_allclose(ob, b)
+
+
+def test_average_accumulates():
+    p = np.full(3, 2.0, "float32")
+    zeros = np.zeros(3, "float32")
+    out = run_op("average_accumulates",
+                 {"param": p, "in_sum_1": zeros, "in_sum_2": zeros,
+                  "in_sum_3": zeros,
+                  "in_num_accumulates": np.array([0], "int64"),
+                  "in_old_num_accumulates": np.array([0], "int64"),
+                  "in_num_updates": np.array([0], "int64")},
+                 {"average_window": 0.5, "max_average_window": 100,
+                  "min_average_window": 3},
+                 outputs=("out_sum_1", "out_num_accumulates",
+                          "out_num_updates"))
+    np.testing.assert_allclose(out["out_sum_1"][0], p)
+    assert out["out_num_accumulates"][0][0] == 1
+    assert out["out_num_updates"][0][0] == 1
+
+
+def test_multiclass_nms2_alias():
+    boxes = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], "float32")
+    scores = np.zeros((1, 2, 2), "float32")
+    scores[0, 1] = [0.9, 0.8]
+    out = run_op("multiclass_nms2", {"BBoxes": boxes, "Scores": scores},
+                 {"background_label": 0, "score_threshold": 0.1,
+                  "nms_top_k": -1, "nms_threshold": 0.4, "keep_top_k": 2},
+                 outputs=("Out", "Index", "NmsRoisNum"))
+    assert int(out["NmsRoisNum"][0][0]) == 2
+    assert set(out["Index"][0][0, :2, 0].tolist()) == {0, 1}
+
+
+def test_one_hot_v2_keeps_trailing_dim():
+    """v2 appends depth AS-IS; v1 squeezes a trailing [.,1]."""
+    lab = np.array([[1], [2]], "int64")
+    v1 = run_op("one_hot", {"X": lab}, {"depth": 4})["Out"][0]
+    v2 = run_op("one_hot_v2", {"X": lab}, {"depth": 4})["Out"][0]
+    assert v1.shape == (2, 4)
+    assert v2.shape == (2, 1, 4)
+    np.testing.assert_allclose(v2[:, 0], v1)
+
+
+def test_depthwise_conv2d_transpose():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 4, 4).astype("float64")
+    w = rng.randn(3, 1, 2, 2).astype("float64")
+    out = run_op("depthwise_conv2d_transpose",
+                 {"Input": x, "Filter": w},
+                 {"strides": [2, 2], "paddings": [0, 0]},
+                 outputs=("Output",))["Output"][0]
+    assert out.shape == (2, 3, 8, 8)
+    # per-channel independence: channel c only sees x[:, c] and w[c]
+    ref = run_op("conv2d_transpose",
+                 {"Input": x[:, :1], "Filter": w[:1]},
+                 {"strides": [2, 2], "paddings": [0, 0]},
+                 outputs=("Output",))["Output"][0]
+    np.testing.assert_allclose(out[:, :1], ref, rtol=1e-9)
+    # 4-element paddings form accepted
+    out4 = run_op("depthwise_conv2d_transpose",
+                  {"Input": x, "Filter": w},
+                  {"strides": [2, 2], "paddings": [0, 0, 0, 0]},
+                  outputs=("Output",))["Output"][0]
+    np.testing.assert_allclose(out4, out, rtol=1e-12)
