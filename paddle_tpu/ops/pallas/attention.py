@@ -67,10 +67,14 @@ def _use_pallas(q) -> bool:
             platform = m.devices.flat[0].platform
         else:
             platform = jax.default_backend()
-    # flash pays off once the T×T score tile stops fitting comfortably in
-    # VMEM; at short T the unfused XLA softmax path is ~2x faster (measured
-    # T=128 BERT-base on v5e)
-    return platform == "tpu" and q.ndim == 4 and q.shape[1] >= 512
+    # Measured on v5e (BERT-base fwd+bwd, bf16-scores XLA fallback as the
+    # baseline): flash is 2.5x slower at T=128, 2.1x at 512, 2.3x at
+    # 1024, 2.7x at 2048 — the bf16 score path keeps XLA ahead at every
+    # practical T on this chip/kernel version. Flash's remaining value is
+    # its O(T) memory: at T>=4096 the [B,N,T,T] bf16 score tensors start
+    # crowding HBM (>=400 MB/layer), so the gate switches there for
+    # memory, not speed (PROFILE.md).
+    return platform == "tpu" and q.ndim == 4 and q.shape[1] >= 4096
 
 
 def mha(q: jax.Array, k: jax.Array, v: jax.Array,
