@@ -7,7 +7,7 @@ from __future__ import annotations
 from . import layers
 
 __all__ = ["simple_img_conv_pool", "img_conv_group", "glu",
-           "scaled_dot_product_attention"]
+           "scaled_dot_product_attention", "sequence_conv_pool"]
 
 
 def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
@@ -86,3 +86,16 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
         weights = layers.dropout(weights, dropout_prob=dropout_rate)
     ctx = layers.matmul(weights, v)
     return combine_heads(ctx)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None):
+    """reference: nets.py:251 `sequence_conv_pool` — sequence_conv over
+    the padded [N, T, D] batch followed by sequence_pool."""
+    from .layers.sequence import sequence_conv, sequence_pool
+
+    conv_out = sequence_conv(input, num_filters=num_filters,
+                             filter_size=filter_size,
+                             param_attr=param_attr, bias_attr=bias_attr,
+                             act=act)
+    return sequence_pool(conv_out, pool_type=pool_type)

@@ -276,3 +276,15 @@ def test_dice_loss_matches_reference_formula():
     (o,) = _run(main, startup, {"dlx": xv, "dll": lv}, [dl.name])
     # uniform softmax p=0.25: inse=0.25, denom=1+1 -> 1 - 0.5/2 = 0.75
     np.testing.assert_allclose(o, 0.75, rtol=1e-5)
+
+
+def test_nets_sequence_conv_pool():
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data("scx", shape=[6, 4], dtype="float32")
+        out = pt.nets.sequence_conv_pool(x, num_filters=5, filter_size=3,
+                                         pool_type="max")
+    (o,) = _run(main, startup,
+                {"scx": np.random.RandomState(0).rand(2, 6, 4)
+                 .astype("float32")}, [out.name])
+    assert o.shape == (2, 5)
