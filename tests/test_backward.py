@@ -96,3 +96,56 @@ def test_stop_gradient_blocks_path(rng):
     all_params = {v.name for v in main.list_vars() if isinstance(v, pt.Parameter)}
     assert len(grad_params) == 2
     assert grad_params < all_params
+
+
+def test_gradients_through_cond(rng):
+    """Backward through the cond op (grad-inventory EXCEPTIONS pointer):
+    the selected branch's gradient flows, the other contributes zero."""
+    for xval, want in ((np.array([[3.0]], "float32"), 2.0),
+                      (np.array([[-3.0]], "float32"), -1.0)):
+        main, startup = pt.Program(), pt.Program()
+        with pt.framework.unique_name.guard(), \
+                pt.program_guard(main, startup):
+            x = pt.layers.data(name="cx", shape=[1], dtype="float32")
+            x.stop_gradient = False
+            pred = pt.layers.reduce_sum(x) > 0.0
+            out = pt.layers.cond(pred,
+                                 lambda: pt.layers.scale(x, 2.0),
+                                 lambda: pt.layers.scale(x, -1.0))
+            loss = pt.layers.mean(out)
+            (gx,) = pt.backward.gradients(loss, [x])
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        g = exe.run(main, feed={"cx": xval}, fetch_list=[gx.name])[0]
+        np.testing.assert_allclose(np.asarray(g).reshape(()), want,
+                                   rtol=1e-6)
+
+
+def test_gradients_through_static_rnn_scan(rng):
+    """Backward through the scan op (StaticRNN): d/dx of sum over an
+    accumulating recurrence equals T - t (each step's input feeds all
+    later outputs)."""
+    T = 4
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="sx", shape=[T, 1, 1], dtype="float32",
+                           append_batch_size=False)
+        x.stop_gradient = False
+        xt_all = x
+        rnn = pt.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(xt_all)
+            h = rnn.memory(shape=[1, 1], init_value=0.0)
+            h2 = pt.layers.elementwise_add(h, xt)
+            rnn.update_memory(h, h2)
+            rnn.step_output(h2)
+        outs = rnn()
+        loss = pt.layers.reduce_sum(outs)
+        (gx,) = pt.backward.gradients(loss, [x])
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    g = exe.run(main, feed={"sx": np.ones((T, 1, 1), "float32")},
+                fetch_list=[gx.name])[0]
+    # output_t = sum_{s<=t} x_s -> d loss/d x_s = T - s
+    np.testing.assert_allclose(np.asarray(g).reshape(-1), [4, 3, 2, 1],
+                               rtol=1e-6)

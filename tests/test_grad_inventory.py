@@ -482,13 +482,15 @@ EXCEPTIONS = {
     "sync_batch_norm": "needs a 'dp' mesh axis for the psum "
                        "(tests/test_models_parallel.py)",
     "cond": "control flow over sub-blocks; grads exercised in "
-            "tests/test_backward.py cond tests",
-    "scan": "control flow over sub-blocks (tests/test_backward.py)",
+            "tests/test_backward.py::test_gradients_through_cond",
+    "scan": "control flow over sub-blocks; grads exercised in tests/"
+            "test_backward.py::test_gradients_through_static_rnn_scan",
     "select_input": "control-flow plumbing op (tests/test_backward.py)",
     "dropout": "output depends on the op-uid-folded rng; fd probes would "
-               "need bitwise-identical masks across probe programs — the "
-               "deterministic-mask grad is exercised in "
-               "tests/test_ops_nn.py dropout tests",
+               "need bitwise-identical masks across probe programs — "
+               "forward mask semantics in tests/test_ops_nn.py::"
+               "test_dropout_train_vs_test; the grad path runs in every "
+               "model training test (BERT/GPT dropout layers)",
     "nce": "negative samples drawn from op rng; loss surface is not a "
            "fixed function of the inputs (tests/test_classify.py)",
     "sampled_softmax_with_cross_entropy":
