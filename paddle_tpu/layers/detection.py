@@ -583,7 +583,9 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     if min_sizes is None:
         # reference ratio schedule (detection.py:2006)
         min_sizes, max_sizes = [], []
-        step = int((max_ratio - min_ratio) / (n_layer - 2))
+        # reference divides by (n_layer - 2) — SSD uses >=3 maps;
+        # guard the 2-map case to an even split
+        step = int((max_ratio - min_ratio) / max(n_layer - 2, 1))
         for ratio in range(min_ratio, max_ratio + 1, step):
             min_sizes.append(base_size * ratio / 100.0)
             max_sizes.append(base_size * (ratio + step) / 100.0)

@@ -288,3 +288,33 @@ def test_nets_sequence_conv_pool():
                 {"scx": np.random.RandomState(0).rand(2, 6, 4)
                  .astype("float32")}, [out.name])
     assert o.shape == (2, 5)
+
+
+def test_multi_box_head_multi_feature_maps_ratio_schedule():
+    """Two feature maps through the min_ratio/max_ratio schedule branch
+    (reference detection.py:2006) — priors from both maps concatenate and
+    align with the conv heads."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        f1 = pt.layers.data("mb2_f1", shape=[8, 8, 8], dtype="float32")
+        f2 = pt.layers.data("mb2_f2", shape=[8, 4, 4], dtype="float32")
+        f3 = pt.layers.data("mb2_f3", shape=[8, 2, 2], dtype="float32")
+        img = pt.layers.data("mb2_i", shape=[3, 64, 64], dtype="float32")
+        locs, confs, boxes, vars_ = pt.layers.multi_box_head(
+            inputs=[f1, f2, f3], image=img, base_size=64, num_classes=4,
+            aspect_ratios=[[2.0], [2.0, 3.0], [2.0]],
+            min_ratio=20, max_ratio=90, flip=True, clip=True)
+    rng = np.random.RandomState(1)
+    lv, cv, bv, vv = _run(
+        main, startup,
+        {"mb2_f1": rng.rand(2, 8, 8, 8).astype("float32"),
+         "mb2_f2": rng.rand(2, 8, 4, 4).astype("float32"),
+         "mb2_f3": rng.rand(2, 8, 2, 2).astype("float32"),
+         "mb2_i": np.zeros((2, 3, 64, 64), "float32")},
+        [locs.name, confs.name, boxes.name, vars_.name])
+    assert lv.shape[0] == 2 and lv.shape[2] == 4
+    assert cv.shape[2] == 4                       # num_classes
+    assert lv.shape[1] == bv.shape[0] == vv.shape[0]
+    assert cv.shape[1] == bv.shape[0]
+    # clip=True keeps normalized priors in [0, 1]
+    assert bv.min() >= 0.0 and bv.max() <= 1.0

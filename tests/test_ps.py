@@ -613,3 +613,27 @@ def test_server_numpy_fast_opt_matches_registry_kernels():
             np.testing.assert_allclose(
                 fast[1][k], slow[1][k], rtol=1e-6, atol=1e-7,
                 err_msg=f"{t}: aux {k} drift")
+
+
+@pytest.mark.slow
+def test_async_communicator_two_trainers():
+    """Two trainers in communicator mode against one pserver: both must
+    converge (merged async sends from concurrent workers)."""
+    (p1,) = _free_ports(1)
+    pservers = f"127.0.0.1:{p1}"
+    server = _spawn("PSERVER", pservers, 2, sync=False,
+                    endpoint=f"127.0.0.1:{p1}")
+    time.sleep(1.5)
+    extra = {"FLAGS_communicator_max_merge_var_num": "4",
+             "PS_STEPS": "30", "PS_STEP_SLEEP": "0.05"}
+    trainers = [_spawn("TRAINER", pservers, 2, trainer_id=i, sync=False,
+                       use_comm=True, extra_env=extra) for i in (0, 1)]
+    outs = []
+    for t in trainers:
+        so, se = t.communicate(timeout=240)
+        assert t.returncode == 0, so + se
+        outs.append(json.loads([l for l in so.splitlines()
+                                if l.startswith("{")][-1]))
+    server.wait(timeout=60)
+    for o in outs:
+        assert o["losses"][-1] < o["losses"][0]
