@@ -198,3 +198,20 @@ def listen_and_serv(ins, attrs, ctx):
     raise RuntimeError(
         "listen_and_serv cannot be jit-compiled; Executor.run detects it "
         "and runs the server loop on the host (core/executor.py)")
+
+
+@register_op("checkpoint_notify", grad=None)
+def checkpoint_notify_op(ins, attrs, ctx):
+    """reference: checkpoint_notify_op.cc — in-graph trigger for pserver
+    checkpoints (the trainer-side end of the pserver checkpoint block)."""
+    dirname = attrs["dirname"]
+
+    def _notify():
+        from ..ps.client import checkpoint_notify
+
+        checkpoint_notify(get_client(), dirname)
+        return np.zeros((), np.int32)
+
+    token = jax.experimental.io_callback(
+        _notify, jax.ShapeDtypeStruct((), jnp.int32), ordered=True)
+    return {"Out": token}

@@ -321,3 +321,19 @@ class AsyncCommunicator:
                 except queue.Empty:
                     break
                 self.client.push_grad(name, g)
+
+
+def checkpoint_notify(client: PSClient, dirname: str):
+    """reference: distributed_ops/checkpoint_notify_op.cc — ask every
+    pserver to persist its resident vars (per-server subdirectories keep
+    the shards separate)."""
+    import os
+
+    saved = {}
+    for i, (ep, c) in enumerate(client._conns.items()):
+        out = c.call({"op": "checkpoint_notify",
+                      "dirname": os.path.join(dirname, f"pserver_{i}")})
+        if "error" in out:
+            raise RuntimeError(f"checkpoint_notify: {out['error']}")
+        saved[ep] = out.get("saved", [])
+    return saved

@@ -321,6 +321,25 @@ class ParameterServer:
             return {"ok": done}
         if op == "barrier_ping":
             return {"generation": self._generation}
+        if op == "checkpoint_notify":
+            # reference: checkpoint_notify_op -> pserver checkpoint block
+            # (distribute_transpiler.py:1813): persist every local var
+            # (params + optimizer aux) as save_vars-format .npy files
+            import os
+
+            dirname = msg["dirname"]
+            os.makedirs(dirname, exist_ok=True)
+            saved = []
+            for name, vs in list(self.vars.items()):
+                with vs.lock:
+                    np.save(os.path.join(
+                        dirname, name.replace("/", "%2F")), vs.value)
+                saved.append(name)
+            for name, val in list(self.aux.items()):
+                np.save(os.path.join(
+                    dirname, name.replace("/", "%2F")), np.asarray(val))
+                saved.append(name)
+            return {"ok": True, "saved": saved}
         if op == "shutdown":
             threading.Thread(target=self.stop, daemon=True).start()
             return {"ok": True}

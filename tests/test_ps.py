@@ -637,3 +637,35 @@ def test_async_communicator_two_trainers():
     server.wait(timeout=60)
     for o in outs:
         assert o["losses"][-1] < o["losses"][0]
+
+
+def test_checkpoint_notify_persists_server_vars(tmp_path):
+    """reference: checkpoint_notify_op → pserver checkpoint block
+    (distribute_transpiler.py:1813) — the trainer asks every pserver to
+    persist its resident params + optimizer aux."""
+    from paddle_tpu.ps import ParameterServer, PSClient
+    from paddle_tpu.ps.client import checkpoint_notify
+
+    p1, p2 = _free_ports(2)
+    eps = [f"127.0.0.1:{p}" for p in (p1, p2)]
+    servers = [ParameterServer(ep, num_trainers=1, mode="async")
+               for ep in eps]
+    for s in servers:
+        s.start_background()
+    client = PSClient(eps)
+    w = np.arange(4, dtype="float32")
+    client.init_var("ckpt_w", w, opt_descs=[{
+        "type": "sgd", "inputs": {"Param": ["ckpt_w"],
+                                  "Grad": ["ckpt_w@GRAD"],
+                                  "LearningRate": ["ckpt_lr"]},
+        "outputs": {"ParamOut": ["ckpt_w"]}, "attrs": {}}])
+    client.init_aux("ckpt_lr", np.array([0.5], "float32"), owner="ckpt_w")
+    client.push_grad("ckpt_w", np.ones(4, np.float32))
+    saved = checkpoint_notify(client, str(tmp_path))
+    assert any("ckpt_w" in names for names in saved.values())
+    # the shard holding ckpt_w wrote the post-update value
+    import glob
+    files = glob.glob(str(tmp_path / "pserver_*" / "ckpt_w.npy"))
+    assert len(files) == 1
+    np.testing.assert_allclose(np.load(files[0]), w - 0.5)
+    client.shutdown_servers()
