@@ -38,6 +38,12 @@ def get_program_persistable_vars(program: Program) -> List[Variable]:
     return [v for v in program.list_vars() if _is_persistable(v)]
 
 
+def var_filename(name: str) -> str:
+    """Filesystem-safe var filename stem (the save_vars mangling; shared
+    by the pserver checkpoint and slim export paths)."""
+    return name.replace("/", "%2F")
+
+
 def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
               filename=None):
     """reference: io.py:135."""
@@ -51,7 +57,8 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
             val = scope.find_var(v.name)
             if val is None:
                 continue
-            np.save(os.path.join(dirname, v.name.replace("/", "%2F")), np.asarray(val))
+            np.save(os.path.join(dirname, var_filename(v.name)),
+                    np.asarray(val))
     else:
         data = {}
         for v in vars:
@@ -94,7 +101,7 @@ def load_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         if v.name in quantized:
             scope.set_var(v.name, quantized[v.name])
             continue
-        path = os.path.join(dirname, v.name.replace("/", "%2F") + ".npy")
+        path = os.path.join(dirname, var_filename(v.name) + ".npy")
         if os.path.exists(path):
             scope.set_var(v.name, np.load(path))
 

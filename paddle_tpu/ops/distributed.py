@@ -207,9 +207,7 @@ def checkpoint_notify_op(ins, attrs, ctx):
     dirname = attrs["dirname"]
 
     def _notify():
-        from ..ps.client import checkpoint_notify
-
-        checkpoint_notify(get_client(), dirname)
+        get_client().checkpoint_notify(dirname)
         return np.zeros((), np.int32)
 
     token = jax.experimental.io_callback(

@@ -70,7 +70,8 @@ class PSClient:
     def init_aux(self, name: str, value: np.ndarray, owner: str):
         """Optimizer accumulator co-located with its param `owner`."""
         self._conns[self.place(owner)].call(
-            {"op": "init_aux", "name": name, "value": np.asarray(value)})
+            {"op": "init_aux", "name": name, "value": np.asarray(value),
+             "owner": owner})
 
     # -- dense path ---------------------------------------------------------
 
@@ -145,6 +146,22 @@ class PSClient:
         for c in self._conns.values():
             c.call({"op": "heartbeat", "trainer_id": self.trainer_id,
                     "state": state})
+
+    def checkpoint_notify(self, dirname: str):
+        """reference: distributed_ops/checkpoint_notify_op.cc — ask every
+        pserver to persist its resident vars (per-server subdirectories
+        keep the shards separate)."""
+        import os
+
+        saved = {}
+        for i, (ep, c) in enumerate(self._conns.items()):
+            out = c.call({"op": "checkpoint_notify",
+                          "dirname": os.path.join(dirname,
+                                                  f"pserver_{i}")})
+            if "error" in out:
+                raise RuntimeError(f"pserver: {out['error']}")
+            saved[ep] = out.get("saved", [])
+        return saved
 
     def shutdown_servers(self):
         for c in self._conns.values():
@@ -322,18 +339,3 @@ class AsyncCommunicator:
                     break
                 self.client.push_grad(name, g)
 
-
-def checkpoint_notify(client: PSClient, dirname: str):
-    """reference: distributed_ops/checkpoint_notify_op.cc — ask every
-    pserver to persist its resident vars (per-server subdirectories keep
-    the shards separate)."""
-    import os
-
-    saved = {}
-    for i, (ep, c) in enumerate(client._conns.items()):
-        out = c.call({"op": "checkpoint_notify",
-                      "dirname": os.path.join(dirname, f"pserver_{i}")})
-        if "error" in out:
-            raise RuntimeError(f"checkpoint_notify: {out['error']}")
-        saved[ep] = out.get("saved", [])
-    return saved

@@ -96,6 +96,7 @@ class ParameterServer:
         self._pull_snapshots: Dict[tuple, np.ndarray] = {}
         self.vars: Dict[str, _VarState] = {}
         self.aux: Dict[str, np.ndarray] = {}   # optimizer accumulators
+        self.aux_owner: Dict[str, str] = {}    # aux name -> owning param
         self.monitor = HeartBeatMonitor(num_trainers)
         self._barrier_lock = threading.Lock()
         self._send_barrier = 0
@@ -216,6 +217,8 @@ class ParameterServer:
             return {"ok": True}
         if op == "init_aux":
             self.aux[msg["name"]] = np.asarray(msg["value"])
+            if msg.get("owner"):
+                self.aux_owner[msg["name"]] = msg["owner"]
             return {"ok": True}
         if op == "get":
             vs = self.vars.get(msg["name"])
@@ -324,22 +327,40 @@ class ParameterServer:
         if op == "checkpoint_notify":
             # reference: checkpoint_notify_op -> pserver checkpoint block
             # (distribute_transpiler.py:1813): persist every local var
-            # (params + optimizer aux) as save_vars-format .npy files
+            # (params + optimizer aux) as save_vars-format .npy files.
+            # Aux accumulators save under their owner param's lock so each
+            # shard is step-consistent; disk errors reply as {"error"}
+            # instead of killing the connection.
             import os
 
-            dirname = msg["dirname"]
-            os.makedirs(dirname, exist_ok=True)
-            saved = []
-            for name, vs in list(self.vars.items()):
-                with vs.lock:
-                    np.save(os.path.join(
-                        dirname, name.replace("/", "%2F")), vs.value)
-                saved.append(name)
-            for name, val in list(self.aux.items()):
-                np.save(os.path.join(
-                    dirname, name.replace("/", "%2F")), np.asarray(val))
-                saved.append(name)
-            return {"ok": True, "saved": saved}
+            from ..io import var_filename
+
+            try:
+                dirname = msg["dirname"]
+                os.makedirs(dirname, exist_ok=True)
+                saved = []
+                owned_aux: Dict[str, list] = {}
+                for an, owner in self.aux_owner.items():
+                    owned_aux.setdefault(owner, []).append(an)
+                for name, vs in list(self.vars.items()):
+                    with vs.lock:
+                        np.save(os.path.join(dirname, var_filename(name)),
+                                vs.value)
+                        for an in owned_aux.get(name, []):
+                            if an in self.aux:
+                                np.save(os.path.join(
+                                    dirname, var_filename(an)),
+                                    np.asarray(self.aux[an]))
+                                saved.append(an)
+                    saved.append(name)
+                for an, val in list(self.aux.items()):
+                    if an not in saved:   # ownerless aux: best effort
+                        np.save(os.path.join(dirname, var_filename(an)),
+                                np.asarray(val))
+                        saved.append(an)
+                return {"ok": True, "saved": saved}
+            except OSError as e:
+                return {"error": f"checkpoint failed: {e}"}
         if op == "shutdown":
             threading.Thread(target=self.stop, daemon=True).start()
             return {"ok": True}

@@ -27,7 +27,9 @@ QUANT_OPS = {"mul": "Y", "matmul": "Y", "matmul_v2": "Y",
 
 def _fname(name: str, suffix: str = "") -> str:
     # io.save_vars mangles '/' the same way
-    return name.replace("/", "%2F") + suffix + ".npy"
+    from ..io import var_filename
+
+    return var_filename(name) + suffix + ".npy"
 
 
 def _quantize_array(w: np.ndarray, axis: int = -1):

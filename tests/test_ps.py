@@ -644,7 +644,6 @@ def test_checkpoint_notify_persists_server_vars(tmp_path):
     (distribute_transpiler.py:1813) — the trainer asks every pserver to
     persist its resident params + optimizer aux."""
     from paddle_tpu.ps import ParameterServer, PSClient
-    from paddle_tpu.ps.client import checkpoint_notify
 
     p1, p2 = _free_ports(2)
     eps = [f"127.0.0.1:{p}" for p in (p1, p2)]
@@ -661,7 +660,7 @@ def test_checkpoint_notify_persists_server_vars(tmp_path):
         "outputs": {"ParamOut": ["ckpt_w"]}, "attrs": {}}])
     client.init_aux("ckpt_lr", np.array([0.5], "float32"), owner="ckpt_w")
     client.push_grad("ckpt_w", np.ones(4, np.float32))
-    saved = checkpoint_notify(client, str(tmp_path))
+    saved = client.checkpoint_notify(str(tmp_path))
     assert any("ckpt_w" in names for names in saved.values())
     # the shard holding ckpt_w wrote the post-update value
     import glob
