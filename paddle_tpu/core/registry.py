@@ -51,6 +51,7 @@ class KernelCtx:
         program=None,
         block_idx: int = 0,
         env: Optional[dict] = None,
+        in_shape_inference: bool = False,
     ):
         self.op = op
         self._lower_block_fn = lower_block_fn
@@ -59,6 +60,10 @@ class KernelCtx:
         self.program = program
         self.block_idx = block_idx
         self.env = env  # live name->value environment (control-flow ops)
+        # True only under infer_op_outputs' eval_shape, where -1 dims are
+        # stood in by _DYN_SENTINEL; kernels use this to relax static
+        # batch-size checks that would trip on the sentinel.
+        self.in_shape_inference = in_shape_inference
 
     def rng(self) -> jax.Array:
         """Deterministic per-op PRNG key: fold the per-step key with the op's
@@ -88,6 +93,7 @@ class KernelCtx:
             program=self.program,
             block_idx=self.block_idx,
             env=self.env,
+            in_shape_inference=self.in_shape_inference,
         )
 
 
@@ -313,7 +319,8 @@ def infer_op_outputs(
             vals.append(jax.ShapeDtypeStruct(shape, np.dtype(normalize_dtype(d.dtype))))
         ins[slot] = vals
 
-    ctx = KernelCtx(op, lower_block_fn=lower_block_fn, program=program)
+    ctx = KernelCtx(op, lower_block_fn=lower_block_fn, program=program,
+                    in_shape_inference=True)
 
     def f(ins):
         return opdef.call(ins, op.attrs, ctx)

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.registry import register_op
+from ..core.registry import _DYN_SENTINEL, register_op
 
 
 @register_op("iou_similarity", grad=None)
@@ -159,12 +159,26 @@ def yolo_box(ins, attrs, ctx):
     return {"Boxes": boxes, "Scores": scores}
 
 
+def _require_single_image(op_name, x, ctx):
+    """All roi ops in this repo share the pools-image-0 convention (ROIs
+    carry no batch-index column); reject N>1 loudly instead of silently
+    pooling the wrong image. Under shape inference only, -1 batch dims
+    appear as the registry's _DYN_SENTINEL stand-in and are let through
+    — at execution time the concrete batch is enforced unconditionally."""
+    if ctx.in_shape_inference and x.shape[0] == _DYN_SENTINEL:
+        return
+    assert x.shape[0] == 1, (
+        f"{op_name}: ROIs carry no batch index (the repo-wide roi-op "
+        f"convention pools image 0), so N must be 1; got N={x.shape[0]}")
+
+
 @register_op("roi_align")
 def roi_align(ins, attrs, ctx):
     """reference: detection/roi_align_op.cc — bilinear-sampled ROI pooling."""
     import jax
 
     x, rois = ins["X"][0], ins["ROIs"][0]  # x: [N,C,H,W], rois: [R,4]
+    _require_single_image("roi_align", x, ctx)
     ph = int(attrs.get("pooled_height", 1))
     pw = int(attrs.get("pooled_width", 1))
     scale = attrs.get("spatial_scale", 1.0)
@@ -231,6 +245,7 @@ def prroi_pool(ins, attrs, ctx):
     scale = float(attrs.get("spatial_scale", 1.0))
     oc = int(attrs.get("output_channels", x.shape[1] // (ph * pw)))
     n, c, h, w = x.shape
+    _require_single_image("prroi_pool", x, ctx)
     assert c == oc * ph * pw, (
         f"prroi_pool input channels {c} != output_channels*ph*pw "
         f"{oc * ph * pw}")
@@ -274,6 +289,7 @@ def deformable_psroi_pooling(ins, attrs, ctx):
     spp = int(attrs.get("sample_per_part", 4))
     tstd = float(attrs.get("trans_std", 0.1))
     n, c, H, W = x.shape
+    _require_single_image("deformable_psroi_pooling", x, ctx)
     n_classes = 1 if (no_trans or trans is None) else trans.shape[1] // 2
     ceach = out_dim // n_classes
     x0 = x[0]
@@ -604,6 +620,7 @@ def roi_pool(ins, attrs, ctx):
     pw = int(attrs.get("pooled_width", 1))
     scale = float(attrs.get("spatial_scale", 1.0))
     n, c, h, w = x.shape
+    _require_single_image("roi_pool", x, ctx)
 
     def one_roi(roi):
         x1 = jnp.round(roi[0] * scale).astype(jnp.int32)
@@ -646,6 +663,7 @@ def psroi_pool(ins, attrs, ctx):
     out_c = int(attrs["output_channels"])
     scale = float(attrs.get("spatial_scale", 1.0))
     n, c, h, w = x.shape
+    _require_single_image("psroi_pool", x, ctx)
 
     def one_roi(roi):
         x1 = jnp.round(roi[0]) * scale
@@ -1347,9 +1365,7 @@ def roi_perspective_transform(ins, attrs, ctx):
     ow = int(attrs.get("transformed_width", 8))
     scale = float(attrs.get("spatial_scale", 1.0))
     n, c, h, w = x.shape
-    if n != 1:
-        raise ValueError("roi_perspective_transform: single-image input "
-                         "expected (all ROIs sample image 0)")
+    _require_single_image("roi_perspective_transform", x, ctx)
 
     def one(quad):
         q = quad.reshape(4, 2) * scale   # tl, tr, br, bl

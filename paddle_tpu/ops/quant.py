@@ -122,21 +122,61 @@ def fake_channel_wise_quantize_abs_max(ins, attrs, ctx):
 
 
 @register_op("fake_quantize_range_abs_max", grad=None,
-             nondiff_inputs=("InScale", "Iter"),
+             nondiff_inputs=("InScale", "Iter", "InScales"),
              intermediate_outputs=("OutScale", "OutScales"))
 def fake_quantize_range_abs_max(ins, attrs, ctx):
-    """reference: fake_quantize_op.cc range_abs_max — training keeps a
-    window of recent abs-maxes; scale = max(window). Static form: scale =
-    max(in_scale, cur) in training (the window max telescopes), in_scale
-    at inference."""
+    """reference: fake_quantize_op.cc FindRangeAbsMaxFunctor:119-142 —
+    training keeps a sliding window (size `window_size`) of recent
+    abs-maxes indexed by Iter % window_size; scale = max over the valid
+    window, so the scale CAN decrease once an old maximum slides out.
+    Thread the window buffer in as `InScales` [window_size] (the
+    reference reuses the OutScales var in place; the functional form
+    takes it as an input and returns the updated buffer in OutScales).
+    Without InScales there is no window state, so the op degrades to the
+    monotone scale = max(in_scale, cur) — an upper bound of the windowed
+    scale, documented as a deviation in PARITY.md. Inference: in_scale.
+
+    Note the reference's full-rescan branch uses size = min(it,
+    window_size), excluding the just-written slot at index `it` while
+    filling; we always include the freshly written slot (valid =
+    min(it+1, window_size)), which matches because the `max < cur`
+    short-circuit covers the slot the reference's count misses.
+
+    Deliberate deviation: we recompute the true window max every step.
+    The reference's lazy branch (rescan only when the evicted slot WAS
+    the max) makes a stale InScale sticky — resume from a checkpoint
+    with InScale larger than every window entry and the reference keeps
+    returning that InScale forever even though no window entry supports
+    it. Given self-consistent (InScale, InScales) state the two agree;
+    on inconsistent state we return the scale the window actually
+    justifies."""
     x = ins["X"][0]
     bits = int(attrs.get("bit_length", 8))
     is_test = bool(attrs.get("is_test", False)) or ctx.is_test
     in_scale = ins["InScale"][0].reshape(())
+    window = (ins.get("InScales") or [None])[0]
     cur = jnp.max(jnp.abs(x))
-    scale = in_scale if is_test else jnp.maximum(in_scale, cur)
+    if is_test:
+        scale = in_scale
+        out_scales = scale.reshape(1) if window is None else window
+    elif window is None:
+        scale = jnp.maximum(in_scale, cur)
+        out_scales = scale.reshape(1)
+    else:
+        wsize = window.shape[0]
+        assert wsize == int(attrs.get("window_size", wsize)), (
+            f"fake_quantize_range_abs_max: InScales buffer length {wsize} "
+            f"!= window_size attr {attrs.get('window_size')}")
+        it = ins["Iter"][0].reshape(()).astype(jnp.int32)
+        idx = jnp.mod(it, wsize)
+        window = window.at[idx].set(cur.astype(window.dtype))
+        valid = jnp.minimum(it + 1, wsize)
+        masked = jnp.where(jnp.arange(wsize) < valid, window,
+                           jnp.zeros((), window.dtype))
+        scale = jnp.max(masked).astype(x.dtype)
+        out_scales = window
     return {"Out": _quant_only(x, scale, bits),
-            "OutScale": scale.reshape(1), "OutScales": scale.reshape(1)}
+            "OutScale": scale.reshape(1), "OutScales": out_scales}
 
 
 @register_op("fake_quantize_moving_average_abs_max", grad=None,

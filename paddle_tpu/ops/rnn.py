@@ -206,8 +206,17 @@ def lstmp_v2(ins, attrs, ctx):
         x = x + b.reshape(1, 1, -1)
     h0 = (ins.get("H0") or [None])[0]
     c0 = (ins.get("C0") or [None])[0]
-    r0 = jnp.zeros((N, P), x.dtype) if h0 is None else \
-        proj_act(h0 @ pw)
+    # The reference kernel (lstmp_op.h:211) feeds H0 straight into the
+    # gate matmul against Weight[P,4D], i.e. H0 is the initial *projection*
+    # of shape [N,P] (despite the op doc calling it the [N,D] hidden — the
+    # reference's own doc/kernel shapes disagree; we follow the kernel).
+    if h0 is None:
+        r0 = jnp.zeros((N, P), x.dtype)
+    else:
+        assert h0.shape[-1] == P, (
+            f"lstmp_v2: H0 must be the initial projection of shape [N,{P}] "
+            f"(the reference kernel uses H0 directly as r0), got {h0.shape}")
+        r0 = h0
     c0 = jnp.zeros((N, D), x.dtype) if c0 is None else c0
 
     def step(carry, xt):

@@ -671,13 +671,23 @@ def one_hot_v2(ins, attrs, ctx):
 @register_op("shard_index", grad=None, nondiff_inputs=("X",))
 def shard_index(ins, attrs, ctx):
     """reference: shard_index_op.cc — out = in//shard_size == shard_id ?
-    in % shard_size : ignore_value (sharded classification heads)."""
+    in % shard_size : ignore_value (sharded classification heads).
+    shard_size uses FLOOR division (shard_index_op.h:37 index_num/nshards),
+    so when index_num % nshards != 0 the trailing ids map to shard
+    `nshards` which no shard_id in [0, nshards) owns — the reference's
+    quirk, kept. One deviation: the reference host kernel ENFORCEs
+    0 <= id < index_num per element (shard_index_op.h:44); a
+    data-dependent check cannot raise under jit, so out-of-range ids
+    here land outside every shard and yield ignore_value silently."""
     x = _x(ins)
     index_num = int(attrs["index_num"])
     nshards = int(attrs["nshards"])
     shard_id = int(attrs["shard_id"])
     ignore = int(attrs.get("ignore_value", -1))
-    shard_size = (index_num + nshards - 1) // nshards
+    shard_size = index_num // nshards
+    assert shard_size > 0, (
+        f"shard_index: index_num ({index_num}) // nshards ({nshards}) "
+        f"== 0; nshards must not exceed index_num")
     in_shard = (x // shard_size) == shard_id
     return {"Out": jnp.where(in_shard, x % shard_size, ignore)}
 

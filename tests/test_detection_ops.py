@@ -638,6 +638,11 @@ def test_prroi_pool_matches_dense_integration():
                {"pooled_height": ph, "pooled_width": pw,
                 "spatial_scale": 1.0, "output_channels": oc},
                inputs_to_check=["X"])
+    # multi-image batches fail loudly instead of silently pooling image 0
+    with pytest.raises(AssertionError, match="N must be 1"):
+        run_op("prroi_pool", {"X": np.concatenate([x, x]), "ROIs": rois},
+               {"pooled_height": ph, "pooled_width": pw,
+                "spatial_scale": 1.0, "output_channels": oc})
 
 
 def _np_deformable_psroi(x, rois, trans, attrs):

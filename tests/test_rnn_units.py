@@ -3,6 +3,7 @@
 test_lstmp_op.py, test_attention_lstm_op.py)."""
 
 import numpy as np
+import pytest
 
 from op_test import check_grad, run_op
 
@@ -68,9 +69,11 @@ def test_gru_unit_matches_formula():
 
 
 def _np_lstmp(x, w, pw, b, h0, c0, cell_clip=0.0, proj_clip=0.0):
+    # h0 is the initial PROJECTION [n,p] fed straight to the gate matmul
+    # (reference lstmp_op.h:211 uses ordered H0 directly as proj0)
     n, t, _ = x.shape
     d, p = pw.shape
-    r = np.tanh(h0 @ pw) if h0 is not None else np.zeros((n, p))
+    r = h0 if h0 is not None else np.zeros((n, p))
     c = c0 if c0 is not None else np.zeros((n, d))
     projs, cells = [], []
     for step in range(t):
@@ -113,6 +116,21 @@ def test_lstmp_matches_numpy_scan():
                {"Input": x, "Weight": w, "ProjWeight": pw, "Bias": b}, {},
                inputs_to_check=["Input", "Weight", "ProjWeight"],
                output_name="Projection", max_relative_error=1e-2)
+    # H0 is the initial projection [N,P], used directly as r0
+    # (lstmp_op.h:211); a [N,D] hidden is rejected
+    h0 = rng.randn(n, p).astype("float64")
+    c0 = rng.randn(n, d).astype("float64")
+    out3 = run_op("lstmp_v2",
+                  {"Input": x, "Weight": w, "ProjWeight": pw, "Bias": b,
+                   "H0": h0, "C0": c0}, {}, outputs=("Projection", "Cell"))
+    want_p3, want_c3 = _np_lstmp(x, w, pw, b, h0, c0)
+    np.testing.assert_allclose(out3["Projection"][0], want_p3, rtol=1e-9)
+    np.testing.assert_allclose(out3["Cell"][0], want_c3, rtol=1e-9)
+    with pytest.raises(AssertionError, match="initial projection"):
+        run_op("lstmp_v2",
+               {"Input": x, "Weight": w, "ProjWeight": pw, "Bias": b,
+                "H0": rng.randn(n, d + 1).astype("float64")},
+               {}, outputs=("Projection",))
 
 
 def _np_attention_lstm(x, c0, h0, wa, ba, sc, scb, lw, lb, lens):

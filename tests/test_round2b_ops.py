@@ -59,6 +59,32 @@ def test_fake_quantize_range_and_moving_average():
     np.testing.assert_allclose(obs["Out"][0], x)
 
 
+def test_fake_quantize_range_windowed_scale_can_shrink():
+    """With the InScales window threaded through, the scale drops once
+    an old max slides out of the window (FindRangeAbsMaxFunctor:119-142)
+    — the monotone max(in_scale, cur) fallback can never do this."""
+    wsize = 3
+    window = np.zeros(wsize, "float64")
+    in_scale = np.array([0.0])
+    # abs-max sequence: 5.0 then shrinking activations 1.0, 1.0, 1.0
+    seq, scales = [5.0, 1.0, 1.0, 1.0], []
+    for it, m in enumerate(seq):
+        x = np.array([[m, -m / 2]], "float64")
+        out = run_op("fake_quantize_range_abs_max",
+                     {"X": x, "InScale": in_scale,
+                      "Iter": np.array([it], "int64"),
+                      "InScales": window},
+                     {"bit_length": 8, "window_size": wsize},
+                     outputs=("Out", "OutScale", "OutScales"))
+        in_scale = out["OutScale"][0]
+        window = out["OutScales"][0]
+        scales.append(float(in_scale[0]))
+    # window after it=3 holds [1,1,1]: the 5.0 has slid out
+    np.testing.assert_allclose(scales, [5.0, 5.0, 5.0, 1.0])
+    # partial-fill masking: at it=0 only slot 0 is valid
+    assert window.shape == (wsize,)
+
+
 def test_fc_op():
     rng = np.random.RandomState(1)
     x = rng.randn(3, 4).astype("float64")
@@ -103,6 +129,22 @@ def test_shard_index():
                   {"index_num": 20, "nshards": 2, "shard_id": 1,
                    "ignore_value": -1})["Out"][0]
     np.testing.assert_array_equal(out1, [[-1], [-1], [2], [9]])
+
+
+def test_shard_index_non_divisible_floor_division():
+    """shard_size = index_num // nshards (shard_index_op.h:37 floor
+    division): with index_num=20, nshards=3 -> shard_size=6, and ids
+    18,19 map to phantom shard 3 that no shard_id owns."""
+    x = np.array([[0], [5], [6], [17], [18], [19]], "int64")
+    outs = [run_op("shard_index", {"X": x},
+                   {"index_num": 20, "nshards": 3, "shard_id": s,
+                    "ignore_value": -1})["Out"][0] for s in range(3)]
+    np.testing.assert_array_equal(
+        outs[0], [[0], [5], [-1], [-1], [-1], [-1]])
+    np.testing.assert_array_equal(
+        outs[1], [[-1], [-1], [0], [-1], [-1], [-1]])
+    np.testing.assert_array_equal(
+        outs[2], [[-1], [-1], [-1], [5], [-1], [-1]])
 
 
 def test_cross_entropy2():
