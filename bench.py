@@ -100,14 +100,17 @@ def bench_resnet50(mesh, n_chips, platform, on_tpu):
         params, axes = resnet.init(jax.random.key(0), cfg)
 
         def loss_fn(p, b, r):
-            return resnet.loss_fn(p, cfg, b, r)
+            # NHWC end-to-end: a real TPU input pipeline delivers NHWC;
+            # the NCHW shim exists for reference-API parity only.
+            return resnet.loss_fn(p, cfg, b, r, data_format="NHWC")
 
         init_state, step = make_train_step(
             loss_fn, optax.sgd(0.1, momentum=0.9), mesh, axes,
             strategy=TrainStrategy(shard_optimizer_states=False),
             has_aux=True)
         state = init_state(params)
-        batch = resnet.make_batch(jax.random.key(1), cfg, bs, hw=hw)
+        batch = resnet.make_batch(jax.random.key(1), cfg, bs, hw=hw,
+                                  data_format="NHWC")
         return step, state, batch
 
     return _run_ladder(

@@ -40,8 +40,11 @@ class ResNetConfig:
         return ResNetConfig(depth=50, n_classes=10, width=8)
 
     def flops_per_image(self, hw: int = 224) -> float:
-        # ~4.1 GFLOPs fwd for RN50@224 (scaled by width); x3 for training
-        base = 4.1e9 * (self.width / 64) ** 2 * (hw / 224) ** 2
+        # RN50@224 fwd = 4.089 G multiply-accumulates = 8.18 GFLOPs (the
+        # often-quoted "4.1 GFLOPs" counts MACs; exact conv+head MAC sum
+        # in tools/rn50_roofline.py / PROFILE.md). x3 for training
+        # (fwd + dgrad + wgrad). Width/resolution scale quadratically.
+        base = 8.18e9 * (self.width / 64) ** 2 * (hw / 224) ** 2
         return 3 * base * (1 if self.depth == 50 else self.depth / 50)
 
 
@@ -105,10 +108,21 @@ def _bn(params, state_updates, name, x, cfg, train: bool):
 
 
 def apply(params: Params, cfg: ResNetConfig, img: jax.Array,
-          train: bool = False) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """img [B, 3, H, W] (reference NCHW interface) -> (logits, bn_updates)."""
+          train: bool = False,
+          data_format: str = "NCHW") -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """img -> (logits, bn_updates).
+
+    data_format="NHWC" is the native TPU path; "NCHW" is an API-parity
+    shim for reference-style [B,3,H,W] feeds whose in-graph transpose
+    XLA folds into the stem conv (measured neutral at bs=256 on v5e —
+    PROFILE.md round 3). Benches feed NHWC anyway: it is what a real TPU
+    input pipeline delivers."""
     adt = jnp.dtype(cfg.dtype)
-    x = img.transpose(0, 2, 3, 1).astype(adt)  # NHWC
+    if data_format == "NCHW":
+        x = img.transpose(0, 2, 3, 1).astype(adt)  # NHWC
+    else:
+        assert data_format == "NHWC", data_format
+        x = img.astype(adt)
     x = shard(x, ("batch", None, None, None))
     upd: Dict[str, jax.Array] = {}
     x = _conv(params, "stem", x, stride=2)
@@ -138,17 +152,21 @@ def apply(params: Params, cfg: ResNetConfig, img: jax.Array,
 
 
 def loss_fn(params: Params, cfg: ResNetConfig, batch, rng=None,
-            train: bool = True):
-    logits, upd = apply(params, cfg, batch["img"], train=train)
+            train: bool = True, data_format: str = "NCHW"):
+    logits, upd = apply(params, cfg, batch["img"], train=train,
+                        data_format=data_format)
     labels = batch["label"].reshape(-1)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32))
     loss = -jnp.take_along_axis(logp, labels[:, None], 1).mean()
     return loss, upd
 
 
-def make_batch(rng: jax.Array, cfg: ResNetConfig, batch_size: int, hw: int = 224):
+def make_batch(rng: jax.Array, cfg: ResNetConfig, batch_size: int,
+               hw: int = 224, data_format: str = "NCHW"):
     k1, k2 = jax.random.split(rng)
+    shape = (batch_size, 3, hw, hw) if data_format == "NCHW" \
+        else (batch_size, hw, hw, 3)
     return {
-        "img": jax.random.normal(k1, (batch_size, 3, hw, hw), jnp.float32),
+        "img": jax.random.normal(k1, shape, jnp.float32),
         "label": jax.random.randint(k2, (batch_size,), 0, cfg.n_classes),
     }

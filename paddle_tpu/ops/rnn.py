@@ -216,7 +216,7 @@ def lstmp_v2(ins, attrs, ctx):
         assert h0.shape[-1] == P, (
             f"lstmp_v2: H0 must be the initial projection of shape [N,{P}] "
             f"(the reference kernel uses H0 directly as r0), got {h0.shape}")
-        r0 = h0
+        r0 = h0.astype(x.dtype)
     c0 = jnp.zeros((N, D), x.dtype) if c0 is None else c0
 
     def step(carry, xt):

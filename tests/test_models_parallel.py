@@ -81,6 +81,23 @@ def test_resnet_trains_with_bn_state():
     assert float(jnp.abs(state.params["stem.bn.mean"]).sum()) > 0
 
 
+def test_resnet_nhwc_matches_nchw():
+    """The NHWC-native path (TPU bench path) and the NCHW reference-API
+    shim compute identical logits for the same image content."""
+    cfg = resnet.ResNetConfig.tiny()
+    params, _ = resnet.init(jax.random.key(0), cfg)
+    b_nchw = resnet.make_batch(jax.random.key(1), cfg, 4, hw=32,
+                               data_format="NCHW")
+    img_nhwc = jnp.transpose(b_nchw["img"], (0, 2, 3, 1))
+    lo_a, _ = jax.jit(lambda p, v: resnet.apply(p, cfg, v, train=False))(
+        params, b_nchw["img"])
+    lo_b, _ = jax.jit(lambda p, v: resnet.apply(
+        p, cfg, v, train=False, data_format="NHWC"))(params, img_nhwc)
+    np.testing.assert_allclose(np.asarray(lo_a, np.float32),
+                               np.asarray(lo_b, np.float32),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_lenet_convergence():
     params, _ = lenet.init(jax.random.key(0))
     imgs = jax.random.normal(jax.random.key(1), (64, 1, 28, 28), jnp.float32)

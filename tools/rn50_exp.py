@@ -1,0 +1,55 @@
+"""ResNet-50 perf experiment driver (real chip): A/B layouts & variants.
+
+Usage: python tools/rn50_exp.py [nchw|nhwc] [bs] [steps]
+Prints step time + samples/s + MFU for the chosen variant.
+"""
+
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_default_prng_impl", "unsafe_rbg")
+
+import optax  # noqa: E402
+
+from paddle_tpu.models import resnet  # noqa: E402
+from paddle_tpu.parallel import MeshConfig, make_mesh, mesh_guard  # noqa: E402
+from paddle_tpu.parallel.train import TrainStrategy, make_train_step  # noqa: E402
+
+
+def run(data_format="NHWC", bs=256, n_steps=20, hw=224):
+    cfg = resnet.ResNetConfig.resnet50()
+    mesh = make_mesh(MeshConfig(dp=-1), devices=jax.devices()[:1])
+    with mesh_guard(mesh):
+        params, axes = resnet.init(jax.random.key(0), cfg)
+
+        def loss_fn(p, b, r):
+            return resnet.loss_fn(p, cfg, b, r, data_format=data_format)
+
+        init_state, step = make_train_step(
+            loss_fn, optax.sgd(0.1, momentum=0.9), mesh, axes,
+            strategy=TrainStrategy(shard_optimizer_states=False),
+            has_aux=True)
+        state = init_state(params)
+        batch = resnet.make_batch(jax.random.key(1), cfg, bs, hw=hw,
+                                  data_format=data_format)
+        state, loss = step(state, batch, jax.random.key(2))
+        float(loss)
+        t0 = time.perf_counter()
+        for i in range(n_steps):
+            state, loss = step(state, batch, jax.random.key(3 + i))
+        fl = float(loss)
+        dt = time.perf_counter() - t0
+    sps = bs * n_steps / dt
+    mfu = sps * cfg.flops_per_image(hw) / 197e12
+    print(f"{data_format} bs={bs}: step={1000 * dt / n_steps:.2f} ms  "
+          f"{sps:.0f} img/s  MFU={mfu:.4f}  loss={fl:.3f}", flush=True)
+    return sps
+
+
+if __name__ == "__main__":
+    fmt = (sys.argv[1] if len(sys.argv) > 1 else "nhwc").upper()
+    bs = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    steps = int(sys.argv[3]) if len(sys.argv) > 3 else 20
+    run(fmt, bs, steps)
