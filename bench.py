@@ -192,6 +192,72 @@ def bench_bert(mesh, n_chips, platform, on_tpu):
         mesh=mesh)
 
 
+def bench_bert_long(mesh, n_chips, platform, on_tpu):
+    """Long-sequence config (T=4096): measures the production attention
+    path (auto gate = XLA bf16-scores at every single-chip shape;
+    PROFILE.md round 3) and A/Bs the Pallas flash kernel at the same
+    shape, making the gate decision reproducible from BENCH output."""
+    if not on_tpu:
+        return True  # flash path is TPU-only; CPU ladder covers tiny BERT
+    import optax
+
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel.train import TrainStrategy, make_train_step
+
+    seq_len = 4096
+    cfg = bert.BertConfig(max_len=seq_len, dropout=0.0)
+
+    def build_with(mode):
+        def build(bs):
+            set_flags({"FLAGS_flash_attention": mode})
+            params, axes = bert.init(jax.random.key(0), cfg)
+
+            def loss_fn(p, b, r):
+                return bert.pretrain_loss(p, cfg, b, rng=r,
+                                          deterministic=True)
+
+            init_state, step = make_train_step(
+                loss_fn, optax.adamw(1e-4), mesh, axes,
+                strategy=TrainStrategy(shard_optimizer_states=True))
+            state = init_state(params)
+            batch = bert.make_batch(jax.random.key(1), cfg, batch_size=bs,
+                                    seq_len=seq_len)
+            return step, state, batch
+        return build
+
+    probe = bert.make_batch(jax.random.key(1), cfg, batch_size=2,
+                            seq_len=seq_len)
+    n_masked = probe["masked_positions"].shape[1]
+    flops = cfg.train_flops_per_seq(seq_len, n_masked)
+
+    # A/B the Pallas flash kernel at a fixed shape (bs=2): its per-sample
+    # time vs the production path below keeps the never-flash auto-gate
+    # decision reproducible from BENCH output alone. Guarded like the
+    # ladder (shard() constraints need the mesh) and dropped before the
+    # ladder runs so its params/moments/batch don't hold HBM.
+    from paddle_tpu.parallel import mesh_guard
+
+    flash_detail = "not_measured"
+    try:
+        with mesh_guard(mesh):
+            step, state, batch = build_with("on")(2)
+            dt, _ = _measure(step, state, batch, 5)
+        flash_detail = round(1000 * dt / 5, 2)
+        del step, state, batch
+    except Exception as e:
+        flash_detail = f"fail: {str(e)[:120]}"
+    jax.clear_caches()
+
+    ok = _run_ladder(
+        "bert_long_seq4096_train_samples_per_sec_per_chip",
+        [8, 4, 2, 1], build_with("auto"), flops, 5, n_chips, platform,
+        {"seq_len": seq_len, "attention": "xla_bf16_scores(auto gate)",
+         "pallas_flash_step_ms_bs2": flash_detail}, mesh=mesh)
+    set_flags({"FLAGS_flash_attention": "auto"})
+    return ok
+
+
 def main():
     from paddle_tpu.parallel import MeshConfig, make_mesh
 
@@ -202,7 +268,8 @@ def main():
     n_chips = mesh.devices.size
 
     ok = True
-    for bench in (bench_resnet50, bench_transformer_big, bench_bert):
+    for bench in (bench_resnet50, bench_transformer_big, bench_bert_long,
+                  bench_bert):
         ok = bench(mesh, n_chips, platform, on_tpu) and ok
         jax.clear_caches()  # free compiled executables between configs
     return 0 if ok else 1

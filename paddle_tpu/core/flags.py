@@ -20,6 +20,11 @@ _DEFAULTS: Dict[str, Any] = {
     "FLAGS_use_program_cache": True,
     # profiler
     "FLAGS_profile_dir": "/tmp/paddle_tpu_profile",
+    # attention kernel selection: "auto" (never flash — XLA bf16-scores
+    # measured 2.7-2.8x faster at every single-chip shape up to T=16K,
+    # PROFILE.md round 3), "on" (force the Pallas flash kernel on TPU),
+    # "off" (always the XLA path)
+    "FLAGS_flash_attention": "auto",
     # memory knobs recorded for parity (XLA owns allocation)
     "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
     "FLAGS_allocator_strategy": "auto_growth",

@@ -164,6 +164,7 @@ def loss_fn(params: Params, cfg: ResNetConfig, batch, rng=None,
 def make_batch(rng: jax.Array, cfg: ResNetConfig, batch_size: int,
                hw: int = 224, data_format: str = "NCHW"):
     k1, k2 = jax.random.split(rng)
+    assert data_format in ("NCHW", "NHWC"), data_format
     shape = (batch_size, 3, hw, hw) if data_format == "NCHW" \
         else (batch_size, hw, hw, 3)
     return {

@@ -67,14 +67,34 @@ def _use_pallas(q) -> bool:
             platform = m.devices.flat[0].platform
         else:
             platform = jax.default_backend()
-    # Measured on v5e (BERT-base fwd+bwd, bf16-scores XLA fallback as the
-    # baseline): flash is 2.5x slower at T=128, 2.1x at 512, 2.3x at
-    # 1024, 2.7x at 2048 — the bf16 score path keeps XLA ahead at every
-    # practical T on this chip/kernel version. Flash's remaining value is
-    # its O(T) memory: at T>=4096 the [B,N,T,T] bf16 score tensors start
-    # crowding HBM (>=400 MB/layer), so the gate switches there for
-    # memory, not speed (PROFILE.md).
-    return platform == "tpu" and q.ndim == 4 and q.shape[1] >= 4096
+    if platform != "tpu" or q.ndim != 4:
+        return False
+    return _gate_allows(q.shape[1])
+
+
+def _gate_allows(T: int) -> bool:
+    """Mode dispatch of the flash gate, separated from the platform check
+    so the decision logic is unit-testable off-TPU."""
+    from ...core.flags import get_flag
+
+    mode = str(get_flag("FLAGS_flash_attention")).lower()
+    if mode in ("on", "1", "true"):
+        return True
+    if mode in ("off", "0", "false"):
+        return False
+    # Measured on v5e (BERT-base training steps, bf16-scores XLA path as
+    # the baseline): flash is 2.5x slower at T=128, 2.1x at 512, 2.3x at
+    # 1024, 2.7x at 2048, 2.8x at 4096 (bs=2), 2.7x at 8192 (bs=1), 2.8x
+    # at 16384 (bs=1) — and XLA + rematerialization FITS at every one of
+    # those shapes, so the round-2 hypothesis that score buffers crowd
+    # HBM at T>=4096 is refuted on this chip/kernel version. Auto
+    # therefore never selects the jax-shipped flash kernel; it remains an
+    # explicit opt-in (FLAGS_flash_attention=on) and the long-context
+    # scaling path is exact ring attention over the 'sp' mesh axis
+    # (ops/pallas/ring_attention.py). Full table: PROFILE.md round 3;
+    # re-measured on-chip each round by bench.py's bert_long config.
+    del T
+    return False
 
 
 def mha(q: jax.Array, k: jax.Array, v: jax.Array,

@@ -124,6 +124,22 @@ def test_flash_attention_gate_and_numpy_reference():
     independent numpy softmax-attention (TPU-chip pallas-vs-XLA agreement at
     T=1024 verified on hardware, bf16 max err 0.016)."""
     assert not _use_pallas(jnp.zeros((2, 1024, 8, 64)))  # cpu backend
+    # mode-dispatch logic (platform-independent, _gate_allows): the auto
+    # gate never selects flash at ANY T (PROFILE.md round 3: XLA
+    # bf16-scores measured 2.7-2.8x faster at T=4096..16384 on-chip);
+    # "on"/"off" override
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.ops.pallas.attention import _gate_allows
+    for T in (128, 4096, 16384):
+        assert not _gate_allows(T)
+    try:
+        set_flags({"FLAGS_flash_attention": "on"})
+        assert _gate_allows(128)
+        assert not _use_pallas(jnp.zeros((2, 128, 8, 64)))  # still cpu
+        set_flags({"FLAGS_flash_attention": "off"})
+        assert not _gate_allows(16384)
+    finally:
+        set_flags({"FLAGS_flash_attention": "auto"})
     rng = np.random.RandomState(0)
     B, T, N, H = 1, 16, 2, 8
     q = rng.randn(B, T, N, H).astype(np.float32)
