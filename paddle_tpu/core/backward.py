@@ -39,17 +39,37 @@ def _base_var_of_grad(gname: str) -> str:
 
 
 class _GradEmitter:
-    def __init__(self, block: Block, no_grad_set: Set[str]):
+    def __init__(self, block: Block, no_grad_set: Set[str],
+                 force_grad: Optional[Set[str]] = None):
         self.block = block
         self.no_grad = no_grad_set
+        self.force_grad = force_grad or set()
         # var -> list of pending (unsummed) grad names
         self.pending: Dict[str, List[str]] = defaultdict(list)
         self.finalized: Dict[str, str] = {}
+        # var -> this invocation's canonical grad name. A prior
+        # append_backward/gradients call may already own `var@GRAD` (the
+        # double-backward case: the second pass differentiates THROUGH the
+        # first pass's grad ops); writing it again would alias the
+        # first-order gradient, so each emitter claims fresh names
+        # (var@GRAD@2, @3, ...) when the plain name is taken.
+        self._canonical: Dict[str, str] = {}
 
     # -- var/desc helpers ----------------------------------------------------
 
-    def _ensure_grad_var(self, gname: str):
-        base = _base_var_of_grad(gname)
+    def canonical_grad_name(self, var: str) -> str:
+        if var in self._canonical:
+            return self._canonical[var]
+        name = grad_var_name(var)
+        k = 1
+        while self.block._find_var_recursive(name) is not None:
+            k += 1
+            name = f"{grad_var_name(var)}@{k}"
+        self._canonical[var] = name
+        return name
+
+    def _ensure_grad_var(self, gname: str, base: Optional[str] = None):
+        base = base if base is not None else _base_var_of_grad(gname)
         bvar = self.block._find_var_recursive(base)
         if self.block._find_var_recursive(gname) is None:
             self.block.create_var(
@@ -71,30 +91,39 @@ class _GradEmitter:
     # -- accumulation --------------------------------------------------------
 
     def new_grad_name(self, var: str) -> str:
+        canonical = self.canonical_grad_name(var)
         if not self.pending[var]:
-            g = grad_var_name(var)
+            g = canonical
         else:
-            g = f"{grad_var_name(var)}@RENAME@{len(self.pending[var])}"
+            g = f"{canonical}@RENAME@{len(self.pending[var])}"
         self.pending[var].append(g)
-        self._ensure_grad_var(g)
+        self._ensure_grad_var(g, base=var)
         return g
 
     def finalize(self, var: str) -> Optional[str]:
-        """Sum pending grad contributions into the canonical var@GRAD."""
+        """Sum pending grad contributions into this invocation's canonical
+        grad var (var@GRAD, or var@GRAD@k under double backward)."""
         if var in self.finalized:
             return self.finalized[var]
         names = self.pending.get(var)
         if not names:
             return None
-        canonical = grad_var_name(var)
-        if len(names) > 1:
-            self._ensure_grad_var(canonical)
-            self._append_raw(OpDesc(
-                type="sum",
-                inputs={"X": list(names)},
-                outputs={"Out": [canonical]},
-                attrs={OpRole.AttrName: OpRole.Backward},
-            ))
+        if len(names) == 1:
+            # single contribution keeps its name (for emitter-made names
+            # this IS the canonical; for seeds it is the caller's var)
+            self.finalized[var] = names[0]
+            return names[0]
+        canonical = self.canonical_grad_name(var)
+        # Out may alias X[0] (the canonical usually holds the first
+        # contribution): the functional executor reads all inputs before
+        # binding the output, so the in-place sum is well-defined.
+        self._ensure_grad_var(canonical, base=var)
+        self._append_raw(OpDesc(
+            type="sum",
+            inputs={"X": list(names)},
+            outputs={"Out": [canonical]},
+            attrs={OpRole.AttrName: OpRole.Backward},
+        ))
         self.finalized[var] = canonical
         return canonical
 
@@ -104,6 +133,7 @@ def _find_op_path(
     target_names: Set[str],
     source_names: Optional[Set[str]],
     no_grad_set: Set[str],
+    force_grad: Optional[Set[str]] = None,
 ) -> Tuple[List[bool], Set[str]]:
     """Reverse pass marking ops on the grad path and vars needing grads
     (reference: backward.py:1159 _find_op_path_)."""
@@ -128,7 +158,12 @@ def _find_op_path(
                 if not n or n in no_grad_set:
                     continue
                 v = block._find_var_recursive(n)
-                if v is None or v.desc.stop_gradient or not _is_float_var(v.desc):
+                if v is None or not _is_float_var(v.desc):
+                    continue
+                # explicitly-requested gradient inputs override
+                # stop_gradient (reference calc_gradient semantics:
+                # fluid.gradients(y, x) works for feed/data x)
+                if v.desc.stop_gradient and n not in (force_grad or ()):
                     continue
                 needed.add(n)
     if source_names is not None:
@@ -149,10 +184,11 @@ def _emit_backward(
     needed: Set[str],
     no_grad_set: Set[str],
     seed_grads: Dict[str, str],
+    force_grad: Optional[Set[str]] = None,
 ) -> _GradEmitter:
     """Emit grad ops in reverse program order. seed_grads maps target var ->
     the name of an already-materialized output gradient."""
-    em = _GradEmitter(block, no_grad_set)
+    em = _GradEmitter(block, no_grad_set, force_grad)
     for var, gname in seed_grads.items():
         em.pending[var].append(gname)
 
@@ -187,7 +223,8 @@ def _emit_backward(
                 want = bool(n) and n in needed and n not in no_grad_set
                 if want:
                     v = block._find_var_recursive(n)
-                    want = v is not None and not v.desc.stop_gradient and _is_float_var(v.desc)
+                    want = v is not None and _is_float_var(v.desc) and (
+                        not v.desc.stop_gradient or n in em.force_grad)
                 gl.append(em.new_grad_name(n) if want else "")
                 any_in_grad = any_in_grad or want
             if any(gl):
@@ -281,9 +318,10 @@ def gradients(
     program = block.program
     no_grad = set(no_grad_set or ())
 
+    force = {i.name for i in inputs}
     on_path, needed = _find_op_path(
-        block, {t.name for t in targets}, {i.name for i in inputs}, no_grad)
-    needed.update(i.name for i in inputs)
+        block, {t.name for t in targets}, force, no_grad, force_grad=force)
+    needed.update(force)
 
     from .framework import Operator
 
@@ -291,6 +329,10 @@ def gradients(
     for i, t in enumerate(targets):
         tg = None if target_gradients is None else target_gradients[i]
         gname = grad_var_name(t.name)
+        k = 1
+        while block._find_var_recursive(gname) is not None:
+            k += 1
+            gname = f"{grad_var_name(t.name)}@{k}"
         block.create_var(name=gname, shape=t.shape, dtype=t.dtype)
         if tg is None:
             fill = OpDesc(
@@ -305,7 +347,8 @@ def gradients(
             gname = tg.name if isinstance(tg, Variable) else str(tg)
         seed[t.name] = gname
 
-    em = _emit_backward(block, on_path, needed, no_grad, seed)
+    em = _emit_backward(block, on_path, needed, no_grad, seed,
+                        force_grad=force)
     out = []
     for i in inputs:
         g = em.finalize(i.name)

@@ -178,13 +178,26 @@ def get_op_def(type: str) -> OpDef:
     if type in _REGISTRY:
         return _REGISTRY[type]
     if type.endswith("_grad"):
-        fwd = _REGISTRY.get(type[: -len("_grad")])
+        base = type[: -len("_grad")]
+        fwd = _REGISTRY.get(base)
+        if fwd is None and base.endswith("_grad"):
+            # second (or higher) order: synthesize the lower-order grad op
+            # first — `conv2d_grad_grad` is the vjp of `conv2d_grad`, which
+            # is itself the vjp of `conv2d` (the reference registers
+            # *_grad_grad ops by hand, e.g. conv_op.cc:671; here every
+            # order comes from jax.vjp for free)
+            try:
+                fwd = get_op_def(base)
+            except KeyError:
+                fwd = None
         if fwd is not None and fwd.grad == "generic":
-            gd = OpDef(type, make_generic_grad_kernel(fwd), grad=None)
+            # grad="generic" (not None) keeps the synthesized op itself
+            # differentiable, enabling gradients(gradients(...)).
+            gd = OpDef(type, make_generic_grad_kernel(fwd), grad="generic")
             _REGISTRY[type] = gd
             return gd
         if fwd is not None and callable(fwd.grad):
-            gd = OpDef(type, fwd.grad, grad=None)
+            gd = OpDef(type, fwd.grad, grad="generic")
             _REGISTRY[type] = gd
             return gd
     raise KeyError(f"operator '{type}' is not registered")
@@ -223,12 +236,35 @@ def make_generic_grad_kernel(fwd: OpDef) -> Callable:
     def grad_kernel(ins, attrs, ctx: KernelCtx):
         fwd_ins: Dict[str, List] = {}
         out_grads: Dict[str, List] = {}
+        inner_outs: Dict[str, List[str]] = {}
         for k, v in ins.items():
             if k.startswith(GRAD_PREFIX_IN):
                 fwd_ins[k[len(GRAD_PREFIX_IN):]] = v
             elif k.startswith(GRAD_PREFIX_OG):
                 out_grads[k[len(GRAD_PREFIX_OG):]] = v
-            # fwd_out:: values not needed — forward is replayed (XLA CSE dedups)
+            elif k.startswith(GRAD_PREFIX_OUT):
+                # fwd_out:: VALUES are not needed (forward is replayed; XLA
+                # CSE dedups) but their slot structure reconstructs the
+                # forward op's outputs for the replay ctx below
+                inner_outs[k[len(GRAD_PREFIX_OUT):]] = [
+                    "_" if x is not None else "" for x in v]
+
+        # Replay the forward under a ctx whose op LOOKS like the forward
+        # op (type/attrs/outputs): kernels consult ctx.requested_outputs()
+        # and ctx.rng() — with the outer grad op's ctx they would see
+        # in_grad:: slot names and skip everything. This matters doubly for
+        # grad-of-grad, where fwd is itself a generic grad kernel whose
+        # `requested` derivation depends on the op's output slot names.
+        from .ir import OpDesc as _OpDesc
+
+        inner_op = _OpDesc(
+            type=fwd.type,
+            inputs={k: ["_" if x is not None else "" for x in v]
+                    for k, v in fwd_ins.items()},
+            outputs=inner_outs,
+            attrs=dict(attrs),
+        )
+        replay_ctx = ctx.child(inner_op)
 
         requested = {
             k[len(GRAD_PREFIX_IG):]
@@ -253,7 +289,7 @@ def make_generic_grad_kernel(fwd: OpDef) -> Callable:
 
         def f(dins):
             all_ins = {**rest_ins, **dins}
-            outs = fwd.call(all_ins, attrs, ctx)
+            outs = fwd.call(all_ins, attrs, replay_ctx)
             # Only float outputs participate in the cotangent structure.
             return {
                 k: [o for o in v if o is not None and _is_float(o)]
