@@ -1,0 +1,103 @@
+/* Python-free training demo (pure C).
+ *
+ * Reference capability: paddle/fluid/inference/train/demo/demo_trainer.cc
+ * — load a Python-authored training program and train it entirely from
+ * native code. This C program drives the PD_Trainer* C ABI exported by
+ * libptpred.so: it loads the fit_a_line training program saved by
+ * paddle_tpu.io.save_train_model, runs the startup block to initialize
+ * parameters, synthesizes a linear-regression stream y = w_true . x + b_true
+ * on the fly (no Python, no files beyond the model dir), and runs full
+ * forward+backward+SGD steps, printing first/last loss.
+ *
+ * Build: gcc demo_trainer.c -o demo_trainer -ldl
+ * Usage: ./demo_trainer <model_dir> <libptpred.so path>
+ * Exit:  0 if training converged (last loss < 0.05 and < first/20).
+ */
+
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#define NDIM 13
+#define BATCH 32
+#define STEPS 300
+
+typedef void* (*new_trainer_f)(const char*);
+typedef const char* (*err_f)(void*);
+typedef int (*startup_f)(void*);
+typedef int (*step_f)(void*, const char**, const void**, const int64_t**,
+                      const int*, const int*, int, float*);
+typedef void (*del_f)(void*);
+
+static uint64_t lcg = 12345;
+static float frand(void) { /* uniform [-1, 1) */
+  lcg = lcg * 6364136223846793005ULL + 1442695040888963407ULL;
+  return (float)((lcg >> 40) / 8388608.0 * 2.0 - 1.0);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <model_dir> <libptpred.so>\n", argv[0]);
+    return 2;
+  }
+  void* lib = dlopen(argv[2], RTLD_NOW);
+  if (!lib) {
+    fprintf(stderr, "dlopen failed: %s\n", dlerror());
+    return 2;
+  }
+  new_trainer_f PD_NewTrainer = (new_trainer_f)dlsym(lib, "PD_NewTrainer");
+  err_f PD_TrainerError = (err_f)dlsym(lib, "PD_TrainerError");
+  startup_f PD_TrainerRunStartup =
+      (startup_f)dlsym(lib, "PD_TrainerRunStartup");
+  step_f PD_TrainerRunStep = (step_f)dlsym(lib, "PD_TrainerRunStep");
+  del_f PD_DeleteTrainer = (del_f)dlsym(lib, "PD_DeleteTrainer");
+  if (!PD_NewTrainer || !PD_TrainerRunStep) {
+    fprintf(stderr, "missing PD_Trainer symbols\n");
+    return 2;
+  }
+
+  void* t = PD_NewTrainer(argv[1]);
+  if (PD_TrainerError(t)[0]) {
+    fprintf(stderr, "load failed: %s\n", PD_TrainerError(t));
+    return 2;
+  }
+  if (PD_TrainerRunStartup(t) != 0) {
+    fprintf(stderr, "startup failed: %s\n", PD_TrainerError(t));
+    return 2;
+  }
+
+  /* ground truth the trainer must recover */
+  float w_true[NDIM], b_true = 1.5f;
+  for (int j = 0; j < NDIM; ++j) w_true[j] = 0.25f * (float)j - 1.0f;
+
+  float x[BATCH][NDIM], y[BATCH][1];
+  const char* names[2] = {"x", "y"};
+  const void* datas[2] = {x, y};
+  int64_t xshape[2] = {BATCH, NDIM}, yshape[2] = {BATCH, 1};
+  const int64_t* shapes[2] = {xshape, yshape};
+  int ndims[2] = {2, 2};
+  int dtypes[2] = {0, 0}; /* f32 */
+
+  float first = -1.f, loss = 0.f;
+  for (int s = 0; s < STEPS; ++s) {
+    for (int i = 0; i < BATCH; ++i) {
+      double acc = b_true;
+      for (int j = 0; j < NDIM; ++j) {
+        x[i][j] = frand();
+        acc += (double)w_true[j] * x[i][j];
+      }
+      y[i][0] = (float)acc;
+    }
+    if (PD_TrainerRunStep(t, names, datas, shapes, ndims, dtypes, 2,
+                          &loss) != 0) {
+      fprintf(stderr, "step %d failed: %s\n", s, PD_TrainerError(t));
+      return 2;
+    }
+    if (s == 0) first = loss;
+  }
+  printf("first_loss=%.6f last_loss=%.6f\n", first, loss);
+  PD_DeleteTrainer(t);
+  dlclose(lib);
+  return (loss < 0.05f && loss < first / 20.f) ? 0 : 1;
+}

@@ -209,13 +209,14 @@ class Parser {
 // Tensor + npy
 // ---------------------------------------------------------------------------
 
-enum class DType { f32, i64, i32 };
+enum class DType { f32, i64, i32, i8 };
 
 struct Tensor {
   DType dtype = DType::f32;
   std::vector<int64_t> shape;
   std::vector<float> f;
   std::vector<int64_t> i;
+  std::vector<int8_t> q;   // int8 weights (calibrated INT8 models)
 
   int64_t numel() const {
     int64_t n = 1;
@@ -231,6 +232,11 @@ struct Tensor {
     shape = std::move(s);
     dtype = DType::i64;
     i.assign(static_cast<size_t>(numel()), 0);
+  }
+  void resize_q(std::vector<int64_t> s) {
+    shape = std::move(s);
+    dtype = DType::i8;
+    q.assign(static_cast<size_t>(numel()), 0);
   }
 };
 
@@ -305,6 +311,10 @@ static Tensor load_npy(const std::string& path) {
     std::vector<int32_t> tmp(n);
     in.read(reinterpret_cast<char*>(tmp.data()), n * 4);
     t.i.assign(tmp.begin(), tmp.end());
+  } else if (descr == "|i1") {
+    t.dtype = DType::i8;
+    t.q.resize(n);
+    in.read(reinterpret_cast<char*>(t.q.data()), n);
   } else {
     throw std::runtime_error("npy dtype unsupported: " + descr);
   }
@@ -381,6 +391,20 @@ struct Predictor {
   std::vector<std::string> feed_names, fetch_names;
   std::vector<Tensor> outputs;
   std::string error;
+  // training extensions (PD_NewTrainer): startup block + loss fetch +
+  // a small splitmix64 RNG for uniform_random initializers
+  std::vector<OpDesc> startup_ops;
+  std::string loss_name;
+  uint64_t rng = 0x9E3779B97F4A7C15ULL;
+
+  float next_uniform() {  // splitmix64 -> [0, 1)
+    rng += 0x9E3779B97F4A7C15ULL;
+    uint64_t z = rng;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    z ^= z >> 31;
+    return static_cast<float>(z >> 40) / static_cast<float>(1ULL << 24);
+  }
 };
 
 // ---------------------------------------------------------------------------
@@ -856,90 +880,426 @@ static void k_arg_max(Predictor& P, const OpDesc& op) {
   }
 }
 
-static void run_op(Predictor& P, const OpDesc& op) {
+static void k_ew_add(Predictor& P, const OpDesc& op) {
+  ewise_binary(P, op, [](float a, float b) { return a + b; });
+}
+static void k_ew_sub(Predictor& P, const OpDesc& op) {
+  ewise_binary(P, op, [](float a, float b) { return a - b; });
+}
+static void k_ew_mul(Predictor& P, const OpDesc& op) {
+  ewise_binary(P, op, [](float a, float b) { return a * b; });
+}
+static void k_ew_div(Predictor& P, const OpDesc& op) {
+  ewise_binary(P, op, [](float a, float b) { return a / b; });
+}
+static void k_sigmoid(Predictor& P, const OpDesc& op) {
+  ewise_unary(P, op, [](float v) { return 1.f / (1.f + std::exp(-v)); });
+}
+static void k_tanh(Predictor& P, const OpDesc& op) {
+  ewise_unary(P, op, [](float v) { return std::tanh(v); });
+}
+static void k_gelu(Predictor& P, const OpDesc& op) {
+  ewise_unary(P, op, [](float v) {
+    return 0.5f * v * (1.f + std::erf(v * 0.70710678f));
+  });
+}
+static void k_exp(Predictor& P, const OpDesc& op) {
+  ewise_unary(P, op, [](float v) { return std::exp(v); });
+}
+static void k_sqrt(Predictor& P, const OpDesc& op) {
+  ewise_unary(P, op, [](float v) { return std::sqrt(v); });
+}
+
+static void k_reshape_family(Predictor& P, const OpDesc& op) {
   const std::string& t = op.type;
-  if (t == "mul") return k_mul(P, op);
-  if (t == "matmul" || t == "matmul_v2") return k_matmul(P, op);
-  if (t == "elementwise_add")
-    return ewise_binary(P, op, [](float a, float b) { return a + b; });
-  if (t == "elementwise_sub")
-    return ewise_binary(P, op, [](float a, float b) { return a - b; });
-  if (t == "elementwise_mul")
-    return ewise_binary(P, op, [](float a, float b) { return a * b; });
-  if (t == "elementwise_div")
-    return ewise_binary(P, op, [](float a, float b) { return a / b; });
-  if (t == "relu") return k_relu(P, op);
-  if (t == "sigmoid")
-    return ewise_unary(P, op,
-                       [](float v) { return 1.f / (1.f + std::exp(-v)); });
-  if (t == "tanh") return ewise_unary(P, op, [](float v) {
-        return std::tanh(v);
-      });
-  if (t == "gelu") return ewise_unary(P, op, [](float v) {
-        return 0.5f * v * (1.f + std::erf(v * 0.70710678f));
-      });
-  if (t == "exp") return ewise_unary(P, op, [](float v) {
-        return std::exp(v);
-      });
-  if (t == "sqrt") return ewise_unary(P, op, [](float v) {
-        return std::sqrt(v);
-      });
-  if (t == "softmax") return k_softmax(P, op);
-  if (t == "scale") return k_scale(P, op);
-  if (t == "reshape" || t == "reshape2" || t == "flatten" ||
-      t == "flatten2" || t == "squeeze" || t == "squeeze2" ||
-      t == "unsqueeze" || t == "unsqueeze2") {
-    if (t.rfind("reshape", 0) == 0) return reshape_like(P, op);
-    // flatten/squeeze/unsqueeze: recompute from output var desc is not
-    // stored; derive: flatten2 keeps axis attr
-    const Tensor& x = var(P, op.in("X"));
-    Tensor& o = P.scope[op.out("Out")];
-    o = x;
-    if (t.rfind("flatten", 0) == 0) {
-      int64_t axis = static_cast<int64_t>(op.attr_num("axis", 1));
-      o.shape = {prod(x.shape, 0, axis),
-                 prod(x.shape, axis, x.shape.size())};
-    } else if (t.rfind("unsqueeze", 0) == 0) {
-      auto axes = op.attr_ints("axes");
-      std::vector<int64_t> s = x.shape;
-      for (auto a : axes) {
-        if (a < 0) a += static_cast<int64_t>(s.size()) + 1;
-        s.insert(s.begin() + a, 1);
-      }
-      o.shape = s;
-    } else {  // squeeze
-      auto axes = op.attr_ints("axes");
-      std::vector<int64_t> s;
-      for (size_t i = 0; i < x.shape.size(); ++i) {
-        bool drop = false;
-        for (auto a : axes) {
-          int64_t ax = a < 0 ? a + static_cast<int64_t>(x.shape.size()) : a;
-          if (static_cast<int64_t>(i) == ax && x.shape[i] == 1) drop = true;
-        }
-        if (axes.empty() && x.shape[i] == 1) drop = true;
-        if (!drop) s.push_back(x.shape[i]);
-      }
-      o.shape = s;
+  if (t.rfind("reshape", 0) == 0) return reshape_like(P, op);
+  // flatten/squeeze/unsqueeze: derive shape from attrs
+  const Tensor& x = var(P, op.in("X"));
+  Tensor& o = P.scope[op.out("Out")];
+  o = x;
+  if (t.rfind("flatten", 0) == 0) {
+    int64_t axis = static_cast<int64_t>(op.attr_num("axis", 1));
+    o.shape = {prod(x.shape, 0, axis),
+               prod(x.shape, axis, x.shape.size())};
+  } else if (t.rfind("unsqueeze", 0) == 0) {
+    auto axes = op.attr_ints("axes");
+    std::vector<int64_t> s = x.shape;
+    for (auto a : axes) {
+      if (a < 0) a += static_cast<int64_t>(s.size()) + 1;
+      s.insert(s.begin() + a, 1);
     }
-    return;
+    o.shape = s;
+  } else {  // squeeze
+    auto axes = op.attr_ints("axes");
+    std::vector<int64_t> s;
+    for (size_t i = 0; i < x.shape.size(); ++i) {
+      bool drop = false;
+      for (auto a : axes) {
+        int64_t ax = a < 0 ? a + static_cast<int64_t>(x.shape.size()) : a;
+        if (static_cast<int64_t>(i) == ax && x.shape[i] == 1) drop = true;
+      }
+      if (axes.empty() && x.shape[i] == 1) drop = true;
+      if (!drop) s.push_back(x.shape[i]);
+    }
+    o.shape = s;
   }
-  if (t == "transpose" || t == "transpose2") return k_transpose2(P, op);
-  if (t == "conv2d" || t == "depthwise_conv2d") return k_conv2d(P, op);
-  if (t == "pool2d") return k_pool2d(P, op);
-  if (t == "batch_norm" || t == "sync_batch_norm")
-    return k_batch_norm(P, op);
-  if (t == "layer_norm") return k_layer_norm(P, op);
-  if (t == "lookup_table" || t == "lookup_table_v2")
-    return k_lookup_table(P, op);
-  if (t == "dropout") return k_dropout(P, op);
-  if (t == "concat") return k_concat(P, op);
-  if (t == "reduce_mean") return k_reduce_mean(P, op);
-  if (t == "arg_max") return k_arg_max(P, op);
-  if (t == "assign") {
-    P.scope[op.out("Out")] = var(P, op.in("X"));
-    return;
+}
+
+static void k_assign(Predictor& P, const OpDesc& op) {
+  P.scope[op.out("Out")] = var(P, op.in("X"));
+}
+
+// -- training kernels (the fit_a_line fwd+bwd+sgd set; grad ops use the
+//    repo-wide fwd_in::/fwd_out::/out_grad::/in_grad:: slot convention) --
+
+static void k_mean(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  require_f32(x, "mean");
+  double s = 0;
+  for (float v : x.f) s += v;
+  Tensor& o = P.scope[op.out("Out")];
+  o.resize_f({1});
+  o.f[0] = static_cast<float>(s / std::max<int64_t>(1, x.numel()));
+}
+
+static void k_mean_grad(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("fwd_in::X"));
+  const Tensor& og = var(P, op.in("out_grad::Out"));
+  Tensor& gx = P.scope[op.out("in_grad::X")];
+  gx.resize_f(x.shape);
+  float g = og.f.empty() ? 0.f : og.f[0] / static_cast<float>(x.numel());
+  std::fill(gx.f.begin(), gx.f.end(), g);
+}
+
+static void k_square_error_cost(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  const Tensor& y = var(P, op.in("Y"));
+  Tensor& o = P.scope[op.out("Out")];
+  o.resize_f(x.shape);
+  for (int64_t i = 0; i < x.numel(); ++i) {
+    float d = x.f[i] - y.f[i];
+    o.f[i] = d * d;
   }
-  throw std::runtime_error("native predictor: unsupported op '" + t + "'");
+}
+
+static void k_square_error_cost_grad(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("fwd_in::X"));
+  const Tensor& y = var(P, op.in("fwd_in::Y"));
+  const Tensor& og = var(P, op.in("out_grad::Out"));
+  if (!op.out("in_grad::X").empty()) {
+    Tensor& gx = P.scope[op.out("in_grad::X")];
+    gx.resize_f(x.shape);
+    for (int64_t i = 0; i < x.numel(); ++i)
+      gx.f[i] = 2.f * (x.f[i] - y.f[i]) * og.f[i];
+  }
+  if (!op.out("in_grad::Y").empty()) {
+    Tensor& gy = P.scope[op.out("in_grad::Y")];
+    gy.resize_f(y.shape);
+    for (int64_t i = 0; i < y.numel(); ++i)
+      gy.f[i] = -2.f * (x.f[i] - y.f[i]) * og.f[i];
+  }
+}
+
+static void k_elementwise_add_grad(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("fwd_in::X"));
+  const Tensor& y = var(P, op.in("fwd_in::Y"));
+  const Tensor& og = var(P, op.in("out_grad::Out"));
+  if (!op.out("in_grad::X").empty()) {
+    Tensor& gx = P.scope[op.out("in_grad::X")];
+    gx = og;
+    gx.shape = x.shape;
+  }
+  if (!op.out("in_grad::Y").empty()) {
+    // broadcast reduction: sum og over the dims y lacks (y aligned at
+    // `axis`, reference elementwise broadcast semantics)
+    Tensor& gy = P.scope[op.out("in_grad::Y")];
+    gy.resize_f(y.shape);
+    int64_t axis = static_cast<int64_t>(op.attr_num(
+        "axis", static_cast<double>(x.shape.size() - y.shape.size())));
+    if (axis < 0) axis += static_cast<int64_t>(x.shape.size());
+    int64_t pre = prod(x.shape, 0, axis);
+    int64_t mid = y.numel();
+    int64_t post = x.numel() / std::max<int64_t>(1, pre * mid);
+    for (int64_t a = 0; a < pre; ++a)
+      for (int64_t m = 0; m < mid; ++m)
+        for (int64_t b = 0; b < post; ++b)
+          gy.f[m] += og.f[(a * mid + m) * post + b];
+  }
+}
+
+static void gemm_tn(const float* a, const float* b, float* c, int64_t m,
+                    int64_t k, int64_t n) {
+  // c[k,n] = a[m,k]^T @ b[m,n]
+  for (int64_t p = 0; p < k; ++p)
+    for (int64_t j = 0; j < n; ++j) c[p * n + j] = 0.f;
+  for (int64_t i = 0; i < m; ++i)
+    for (int64_t p = 0; p < k; ++p) {
+      float av = a[i * k + p];
+      if (av == 0.f) continue;
+      const float* brow = b + i * n;
+      float* crow = c + p * n;
+      for (int64_t j = 0; j < n; ++j) crow[j] += av * brow[j];
+    }
+}
+
+static void gemm_nt(const float* a, const float* b, float* c, int64_t m,
+                    int64_t n, int64_t k) {
+  // c[m,k] = a[m,n] @ b[k,n]^T
+  for (int64_t i = 0; i < m; ++i)
+    for (int64_t p = 0; p < k; ++p) {
+      double s = 0;
+      for (int64_t j = 0; j < n; ++j) s += a[i * n + j] * b[p * n + j];
+      c[i * k + p] = static_cast<float>(s);
+    }
+}
+
+static void k_mul_grad(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("fwd_in::X"));
+  const Tensor& y = var(P, op.in("fwd_in::Y"));
+  const Tensor& og = var(P, op.in("out_grad::Out"));
+  int64_t xd = static_cast<int64_t>(op.attr_num("x_num_col_dims", 1));
+  int64_t m = prod(x.shape, 0, xd);
+  int64_t k = prod(x.shape, xd, x.shape.size());
+  int64_t n = prod(y.shape, 1, y.shape.size());
+  if (!op.out("in_grad::Y").empty()) {
+    Tensor& gy = P.scope[op.out("in_grad::Y")];
+    gy.resize_f(y.shape);
+    gemm_tn(x.f.data(), og.f.data(), gy.f.data(), m, k, n);
+  }
+  if (!op.out("in_grad::X").empty()) {
+    Tensor& gx = P.scope[op.out("in_grad::X")];
+    gx.resize_f(x.shape);
+    gemm_nt(og.f.data(), y.f.data(), gx.f.data(), m, n, k);
+  }
+}
+
+static void k_sgd(Predictor& P, const OpDesc& op) {
+  Tensor& p = var(P, op.in("Param"));
+  const Tensor& g = var(P, op.in("Grad"));
+  const Tensor& lr = var(P, op.in("LearningRate"));
+  for (int64_t i = 0; i < p.numel(); ++i) p.f[i] -= lr.f[0] * g.f[i];
+}
+
+static void k_fill_constant(Predictor& P, const OpDesc& op) {
+  Tensor& o = P.scope[op.out("Out")];
+  auto shape = op.attr_ints("shape");
+  if (shape.empty()) shape = {1};
+  float v = static_cast<float>(op.attr_num("value", 0.0));
+  std::string dt = op.attr_str("dtype", "float32");
+  if (dt == "int64" || dt == "int32") {
+    o.resize_i(shape);
+    std::fill(o.i.begin(), o.i.end(), static_cast<int64_t>(v));
+  } else {
+    o.resize_f(shape);
+    std::fill(o.f.begin(), o.f.end(), v);
+  }
+}
+
+static void k_uniform_random(Predictor& P, const OpDesc& op) {
+  Tensor& o = P.scope[op.out("Out")];
+  auto shape = op.attr_ints("shape");
+  float lo = static_cast<float>(op.attr_num("min", -1.0));
+  float hi = static_cast<float>(op.attr_num("max", 1.0));
+  o.resize_f(shape);
+  for (auto& v : o.f) v = lo + (hi - lo) * P.next_uniform();
+}
+
+// -- INT8 runtime kernels (calibrated models rewritten by
+//    slim.quantization.calibrate_and_quantize; reference:
+//    inference/api/mkldnn_quantizer.cc + cpu_quantize_pass.cc) ------------
+
+static std::vector<int8_t> quantize_act(const Tensor& x, float s) {
+  std::vector<int8_t> out(x.f.size());
+  for (size_t i = 0; i < x.f.size(); ++i) {
+    float v = std::nearbyint(x.f[i] / s);
+    out[i] = static_cast<int8_t>(std::max(-127.f, std::min(127.f, v)));
+  }
+  return out;
+}
+
+static void gemm_i8(const int8_t* a, const int8_t* b, int32_t* c,
+                    int64_t m, int64_t k, int64_t n) {
+  for (int64_t i = 0; i < m; ++i) {
+    for (int64_t j = 0; j < n; ++j) c[i * n + j] = 0;
+    for (int64_t p = 0; p < k; ++p) {
+      int32_t av = a[i * k + p];
+      if (av == 0) continue;
+      const int8_t* brow = b + p * n;
+      int32_t* crow = c + i * n;
+      for (int64_t j = 0; j < n; ++j) crow[j] += av * brow[j];
+    }
+  }
+}
+
+static void k_quantized_mul(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("X"));
+  const Tensor& w = var(P, op.in("Y"));
+  const Tensor& ws = var(P, op.in("Scale"));
+  float xs = static_cast<float>(op.attr_num("x_scale", 1.0));
+  // matmul contracts the LAST dim; mul flattens at x_num_col_dims
+  int64_t xd = op.type == "quantized_matmul"
+                   ? static_cast<int64_t>(x.shape.size()) - 1
+                   : static_cast<int64_t>(op.attr_num("x_num_col_dims", 1));
+  int64_t m = prod(x.shape, 0, xd);
+  int64_t k = prod(x.shape, xd, x.shape.size());
+  int64_t n = prod(w.shape, 1, w.shape.size());
+  if (k != w.shape[0])
+    throw std::runtime_error(
+        "quantized mul/matmul: contracted dim " + std::to_string(k) +
+        " != weight rows " + std::to_string(w.shape[0]));
+  auto xq = quantize_act(x, xs);
+  std::vector<int32_t> acc(m * n);
+  gemm_i8(xq.data(), w.q.data(), acc.data(), m, k, n);
+  Tensor& o = P.scope[op.out("Out")];
+  std::vector<int64_t> oshape(x.shape.begin(), x.shape.begin() + xd);
+  oshape.push_back(n);
+  o.resize_f(oshape);
+  for (int64_t i = 0; i < m; ++i)
+    for (int64_t j = 0; j < n; ++j)
+      o.f[i * n + j] = acc[i * n + j] * xs * ws.f[j % ws.f.size()];
+}
+
+static void k_quantized_conv2d(Predictor& P, const OpDesc& op) {
+  // NCHW x [N,C,H,W], int8 filter [O,I,kh,kw], per-O scale
+  const Tensor& x = var(P, op.in("Input"));
+  const Tensor& w = var(P, op.in("Filter"));
+  const Tensor& ws = var(P, op.in("Scale"));
+  float xs = static_cast<float>(op.attr_num("x_scale", 1.0));
+  if (static_cast<int64_t>(op.attr_num("groups", 1)) > 1)
+    throw std::runtime_error("quantized_conv2d: groups > 1 unsupported");
+  for (auto d : op.attr_ints("dilations"))
+    if (d != 1)
+      throw std::runtime_error("quantized_conv2d: dilation unsupported");
+  auto strides = op.attr_ints("strides");
+  auto pads = op.attr_ints("paddings");
+  if (strides.empty()) strides = {1, 1};
+  if (pads.empty()) pads = {0, 0};
+  // 2-elem [ph, pw] or 4-elem symmetric [t, b, l, r]
+  int64_t ph = pads[0];
+  int64_t pw = pads.size() == 4 ? pads[2]
+                                : (pads.size() > 1 ? pads[1] : pads[0]);
+  if (pads.size() == 4 && (pads[0] != pads[1] || pads[2] != pads[3]))
+    throw std::runtime_error("quantized_conv2d: asymmetric padding");
+  int64_t N = x.shape[0], C = x.shape[1], H = x.shape[2], W = x.shape[3];
+  int64_t O = w.shape[0], kh = w.shape[2], kw = w.shape[3];
+  int64_t oh = (H + 2 * ph - kh) / strides[0] + 1;
+  int64_t ow = (W + 2 * pw - kw) / strides[1] + 1;
+  auto xq = quantize_act(x, xs);
+  Tensor& o = P.scope[op.out("Out").empty() ? op.out("Output")
+                                            : op.out("Out")];
+  o.resize_f({N, O, oh, ow});
+  for (int64_t nb = 0; nb < N; ++nb)
+    for (int64_t oc = 0; oc < O; ++oc) {
+      float sc = xs * ws.f[oc % ws.f.size()];
+      for (int64_t y = 0; y < oh; ++y)
+        for (int64_t xo = 0; xo < ow; ++xo) {
+          int32_t acc = 0;
+          for (int64_t ic = 0; ic < C; ++ic)
+            for (int64_t dy = 0; dy < kh; ++dy) {
+              int64_t iy = y * strides[0] + dy - ph;
+              if (iy < 0 || iy >= H) continue;
+              for (int64_t dx = 0; dx < kw; ++dx) {
+                int64_t ix = xo * strides[1] + dx - pw;
+                if (ix < 0 || ix >= W) continue;
+                acc += static_cast<int32_t>(
+                           xq[((nb * C + ic) * H + iy) * W + ix]) *
+                       w.q[((oc * C + ic) * kh + dy) * kw + dx];
+              }
+            }
+          o.f[((nb * O + oc) * oh + y) * ow + xo] = acc * sc;
+        }
+    }
+}
+
+// -- dispatch table: the single source of truth for the supported-op
+//    manifest (PD_SupportedOps) AND execution ------------------------------
+
+static const std::map<std::string, Kernel>& kernel_table() {
+  static const std::map<std::string, Kernel> T = {
+      {"mul", k_mul},
+      {"matmul", k_matmul},
+      {"matmul_v2", k_matmul},
+      {"elementwise_add", k_ew_add},
+      {"elementwise_sub", k_ew_sub},
+      {"elementwise_mul", k_ew_mul},
+      {"elementwise_div", k_ew_div},
+      {"relu", k_relu},
+      {"sigmoid", k_sigmoid},
+      {"tanh", k_tanh},
+      {"gelu", k_gelu},
+      {"exp", k_exp},
+      {"sqrt", k_sqrt},
+      {"softmax", k_softmax},
+      {"scale", k_scale},
+      {"reshape", k_reshape_family},
+      {"reshape2", k_reshape_family},
+      {"flatten", k_reshape_family},
+      {"flatten2", k_reshape_family},
+      {"squeeze", k_reshape_family},
+      {"squeeze2", k_reshape_family},
+      {"unsqueeze", k_reshape_family},
+      {"unsqueeze2", k_reshape_family},
+      {"transpose", k_transpose2},
+      {"transpose2", k_transpose2},
+      {"conv2d", k_conv2d},
+      {"depthwise_conv2d", k_conv2d},
+      {"pool2d", k_pool2d},
+      {"batch_norm", k_batch_norm},
+      {"sync_batch_norm", k_batch_norm},
+      {"layer_norm", k_layer_norm},
+      {"lookup_table", k_lookup_table},
+      {"lookup_table_v2", k_lookup_table},
+      {"dropout", k_dropout},
+      {"concat", k_concat},
+      {"reduce_mean", k_reduce_mean},
+      {"arg_max", k_arg_max},
+      {"assign", k_assign},
+      // training set (native trainer, reference
+      // inference/train/demo/demo_trainer.cc capability)
+      {"mean", k_mean},
+      {"mean_grad", k_mean_grad},
+      {"square_error_cost", k_square_error_cost},
+      {"square_error_cost_grad", k_square_error_cost_grad},
+      {"elementwise_add_grad", k_elementwise_add_grad},
+      {"mul_grad", k_mul_grad},
+      {"sgd", k_sgd},
+      {"fill_constant", k_fill_constant},
+      {"uniform_random", k_uniform_random},
+      // INT8 runtime (calibrated models)
+      {"quantized_mul", k_quantized_mul},
+      {"quantized_matmul", k_quantized_mul},
+      {"quantized_conv2d", k_quantized_conv2d},
+  };
+  return T;
+}
+
+static void run_op(Predictor& P, const OpDesc& op, size_t idx = 0) {
+  const auto& T = kernel_table();
+  auto it = T.find(op.type);
+  if (it == T.end())
+    throw std::runtime_error(
+        "native predictor: unsupported op '" + op.type + "' (op #" +
+        std::to_string(idx) +
+        " in block 0); query PD_SupportedOps() for the supported set");
+  it->second(P, op);
+}
+
+static std::vector<OpDesc> parse_block_ops(const pj::Value& block) {
+  std::vector<OpDesc> ops;
+  for (const auto& od : block.at("ops").items()) {
+    OpDesc op;
+    op.type = od.at("type").str;
+    if (op.type == "feed" || op.type == "fetch") continue;
+    for (const auto& [slot, names] : *od.at("inputs").obj) {
+      for (const auto& n : names.items()) op.inputs[slot].push_back(n.str);
+    }
+    for (const auto& [slot, names] : *od.at("outputs").obj) {
+      for (const auto& n : names.items()) op.outputs[slot].push_back(n.str);
+    }
+    op.attrs = od.at("attrs");
+    ops.push_back(std::move(op));
+  }
+  return ops;
 }
 
 // ---------------------------------------------------------------------------
@@ -972,21 +1332,7 @@ void* PD_NewPredictor(const char* model_dir) {
         P->scope[name] = load_npy(dir + "/" + fname + ".npy");
       }
     }
-    for (const auto& od : block.at("ops").items()) {
-      OpDesc op;
-      op.type = od.at("type").str;
-      if (op.type == "feed" || op.type == "fetch") continue;
-      for (const auto& [slot, names] : *od.at("inputs").obj) {
-        for (const auto& n : names.items())
-          op.inputs[slot].push_back(n.str);
-      }
-      for (const auto& [slot, names] : *od.at("outputs").obj) {
-        for (const auto& n : names.items())
-          op.outputs[slot].push_back(n.str);
-      }
-      op.attrs = od.at("attrs");
-      P->ops.push_back(std::move(op));
-    }
+    P->ops = parse_block_ops(block);
     P->load_ok = true;
   } catch (const std::exception& e) {
     P->error = e.what();
@@ -1034,7 +1380,7 @@ int PD_PredictorRun(void* h, const char** names, const void** datas,
       }
       P->scope[names[k]] = std::move(t);
     }
-    for (const auto& op : P->ops) run_op(*P, op);
+    for (size_t i = 0; i < P->ops.size(); ++i) run_op(*P, P->ops[i], i);
     P->outputs.clear();
     for (const auto& n : P->fetch_names) P->outputs.push_back(var(*P, n));
     return 0;
@@ -1061,6 +1407,110 @@ void PD_GetOutputData(void* h, int i, void* out) {
     std::memcpy(out, t.f.data(), t.numel() * 4);
   else
     std::memcpy(out, t.i.data(), t.numel() * 8);
+}
+
+// Supported-op manifest, emitted from the dispatch table itself so it can
+// never drift from what run_op executes.
+const char* PD_SupportedOps() {
+  static std::string joined = [] {
+    std::string s;
+    for (const auto& [name, _] : kernel_table()) {
+      if (!s.empty()) s += ",";
+      s += name;
+    }
+    return s;
+  }();
+  return joined.c_str();
+}
+
+// ---------------------------------------------------------------------------
+// Trainer C API (reference: inference/train/demo/demo_trainer.cc — training
+// from native code, no Python at runtime). Loads a __train__ file holding
+// {"main": ProgramDesc, "startup": ProgramDesc, "feed_names", "loss_name"}
+// saved by paddle_tpu.io.save_train_model, runs the startup block to
+// initialize parameters, then executes full fwd+bwd+sgd steps.
+// ---------------------------------------------------------------------------
+
+void* PD_NewTrainer(const char* model_dir) {
+  auto* P = new Predictor();
+  try {
+    std::string dir(model_dir);
+    std::ifstream in(dir + "/__train__");
+    if (!in) throw std::runtime_error("missing __train__ in " + dir);
+    std::stringstream ss;
+    ss << in.rdbuf();
+    pj::Value payload = pj::Parser(ss.str()).parse();
+    for (const auto& v : payload.at("feed_names").items())
+      P->feed_names.push_back(v.str);
+    P->loss_name = payload.at("loss_name").str;
+    P->ops = parse_block_ops(payload.at("main").at("blocks").items()[0]);
+    P->startup_ops =
+        parse_block_ops(payload.at("startup").at("blocks").items()[0]);
+    P->load_ok = true;
+  } catch (const std::exception& e) {
+    P->error = e.what();
+  }
+  return P;
+}
+
+void PD_DeleteTrainer(void* h) { delete static_cast<Predictor*>(h); }
+
+const char* PD_TrainerError(void* h) {
+  return static_cast<Predictor*>(h)->error.c_str();
+}
+
+int PD_TrainerRunStartup(void* h) {
+  auto* P = static_cast<Predictor*>(h);
+  if (!P->load_ok) return -1;
+  try {
+    for (size_t i = 0; i < P->startup_ops.size(); ++i)
+      run_op(*P, P->startup_ops[i], i);
+    return 0;
+  } catch (const std::exception& e) {
+    P->error = e.what();
+    return -1;
+  }
+}
+
+int PD_TrainerRunStep(void* h, const char** names, const void** datas,
+                      const int64_t** shapes, const int* ndims,
+                      const int* dtypes, int n_inputs, float* loss_out) {
+  auto* P = static_cast<Predictor*>(h);
+  if (!P->load_ok) return -1;
+  P->error.clear();
+  try {
+    for (int k = 0; k < n_inputs; ++k) {
+      Tensor t;
+      std::vector<int64_t> shape(shapes[k], shapes[k] + ndims[k]);
+      if (dtypes[k] == 0) {
+        t.resize_f(shape);
+        std::memcpy(t.f.data(), datas[k], t.numel() * 4);
+      } else {
+        t.resize_i(shape);
+        std::memcpy(t.i.data(), datas[k], t.numel() * 8);
+      }
+      P->scope[names[k]] = std::move(t);
+    }
+    for (size_t i = 0; i < P->ops.size(); ++i) run_op(*P, P->ops[i], i);
+    if (loss_out) *loss_out = var(*P, P->loss_name).f[0];
+    return 0;
+  } catch (const std::exception& e) {
+    P->error = e.what();
+    return -1;
+  }
+}
+
+// Copy a parameter's floats into `out` (capacity `cap`); returns numel
+// or -1 when the var is missing/not float.
+int64_t PD_TrainerGetParam(void* h, const char* name, float* out,
+                           int64_t cap) {
+  auto* P = static_cast<Predictor*>(h);
+  auto it = P->scope.find(name);
+  if (it == P->scope.end() || it->second.dtype != DType::f32) return -1;
+  int64_t n = it->second.numel();
+  if (out && cap >= n)
+    std::memcpy(out, it->second.f.data(), n * sizeof(float));
+  return n;
 }
 
 }  // extern "C"

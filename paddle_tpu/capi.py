@@ -66,8 +66,24 @@ def get_lib():
         lib.PD_GetOutputDtype.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.PD_GetOutputData.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                          ctypes.c_void_p]
+        lib.PD_SupportedOps.restype = ctypes.c_char_p
+        lib.PD_SupportedOps.argtypes = []
         _lib = lib
         return _lib
+
+
+def supported_ops() -> List[str]:
+    """The native engine's supported-op manifest, emitted from the C++
+    dispatch table itself (PD_SupportedOps) so it cannot drift from what
+    the interpreter executes."""
+    return get_lib().PD_SupportedOps().decode().split(",")
+
+
+def native_lib_path() -> str:
+    """Path to the built libptpred.so (builds on first use) — handed to
+    pure-C clients such as native/src/demo_trainer.c."""
+    get_lib()
+    return _LIB
 
 
 class NativePredictor:

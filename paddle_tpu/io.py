@@ -168,6 +168,26 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     return fetch_names
 
 
+def save_train_model(dirname, main_program, startup_program, feed_names,
+                     loss_name):
+    """Serialize a TRAINING program pair for the native C++ trainer
+    (native/src/predictor.cc PD_NewTrainer; reference capability:
+    inference/train/demo/demo_trainer.cc trains a Python-saved program
+    from pure C++). The __train__ file holds the main block (fwd + grad +
+    optimizer ops), the startup block (initializers), the feed names and
+    the loss var to report per step — no parameters are saved; the native
+    side runs the startup block to initialize them."""
+    import json
+
+    os.makedirs(dirname, exist_ok=True)
+    payload = {"main": main_program.desc.to_dict(),
+               "startup": startup_program.desc.to_dict(),
+               "feed_names": list(feed_names),
+               "loss_name": loss_name}
+    with open(os.path.join(dirname, "__train__"), "w") as f:
+        json.dump(payload, f)
+
+
 def load_inference_model(dirname, executor, model_filename=None,
                          params_filename=None):
     """reference: io.py:1171 → (program, feed_names, fetch_vars)."""
