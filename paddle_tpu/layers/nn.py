@@ -13,7 +13,8 @@ from ..layer_helper import LayerHelper
 from ..initializer import ConstantInitializer, NormalInitializer, XavierInitializer
 
 __all__ = [
-    "fc", "embedding", "distributed_embedding", "conv2d", "conv3d",
+    "fc", "embedding", "distributed_embedding", "box_embedding",
+    "conv2d", "conv3d",
     "conv2d_transpose",
     "depthwise_conv2d", "deformable_conv", "pool2d", "pool3d", "adaptive_pool2d", "adaptive_pool3d", "batch_norm",
     "layer_norm", "group_norm", "instance_norm", "l2_normalize", "dropout",
@@ -77,6 +78,28 @@ def distributed_embedding(input, size, table_name, sparse_lr=0.01,
     out = helper.create_variable_for_type_inference(dtype)
     helper.append_op(
         type="distributed_lookup_table",
+        inputs={"Ids": input, "Shadow": shadow},
+        outputs={"Out": out},
+        attrs={"table_name": table_name, "emb_dim": int(size[1]),
+               "sparse_lr": float(sparse_lr), "dtype": str(dtype)})
+    return out
+
+
+def box_embedding(input, size, table_name, sparse_lr=0.01,
+                  dtype="float32", name=None):
+    """Embedding served through the BoxPS-analogue hot-row cache
+    (reference: pull_box_sparse_op.cc + fleet/box_wrapper.h): lookups hit
+    the trainer-resident LRU (ps/box_cache.py) and only cache misses
+    reach the pservers; gradients apply locally and flush to the PS
+    asynchronously. Initialize with ps.sparse_table.init_sparse_table +
+    ps.box_cache.init_box_cache; `size` is (vocab, dim)."""
+    helper = LayerHelper("box_embedding", name=name)
+    shadow = helper.create_parameter(
+        None, shape=[1], dtype=dtype, is_bias=False,
+        default_initializer=ConstantInitializer(0.0))
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="pull_box_sparse",
         inputs={"Ids": input, "Shadow": shadow},
         outputs={"Out": out},
         attrs={"table_name": table_name, "emb_dim": int(size[1]),

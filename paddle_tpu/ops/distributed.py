@@ -136,6 +136,20 @@ def ps_recv(ins, attrs, ctx):
     return {"Out": val}
 
 
+def _sparse_push_token(name, ids, grads, lr, push_fn):
+    """Shared io_callback emitter for sparse-grad pushes (dlt + box ops):
+    push_fn(name, ids, grads, lr) runs host-side; returns the i32 token
+    the callers tie into their outputs so the push cannot be pruned."""
+
+    def _push(ids_v, g_v):
+        push_fn(name, np.asarray(ids_v), np.asarray(g_v, np.float32), lr)
+        return np.zeros((), np.int32)
+
+    return jax.experimental.io_callback(
+        _push, jax.ShapeDtypeStruct((), jnp.int32), ids, grads,
+        ordered=True)
+
+
 def _dlt_grad(ins, attrs, ctx):
     """Backward of distributed_lookup_table: push the sparse row gradients
     straight to the owning pservers (the async sparse-SGD update of the
@@ -149,15 +163,12 @@ def _dlt_grad(ins, attrs, ctx):
     ids = ins[GRAD_PREFIX_IN + "Ids"][0]
     og = ins[GRAD_PREFIX_OG + "Out"][0]
 
-    def _push(ids_v, g_v):
+    def _push_fn(n, i, g, r):
         from ..ps.sparse_table import push_row_grads
 
-        push_row_grads(get_client(), name, np.asarray(ids_v),
-                       np.asarray(g_v, np.float32), lr)
-        return np.zeros((), np.int32)
+        push_row_grads(get_client(), n, i, g, r)
 
-    token = jax.experimental.io_callback(
-        _push, jax.ShapeDtypeStruct((), jnp.int32), ids, og, ordered=True)
+    token = _sparse_push_token(name, ids, og, lr, _push_fn)
     shadow = ins[GRAD_PREFIX_IN + "Shadow"][0]
     # tie the push token into the returned grad so it can't be pruned
     return {GRAD_PREFIX_IG + "Shadow": [
@@ -191,6 +202,72 @@ def distributed_lookup_table(ins, attrs, ctx):
     if ins.get("Shadow") and ins["Shadow"][0] is not None:
         out = out + ins["Shadow"][0].astype(out.dtype) * 0
     return {"Out": out}
+
+
+def _box_push_fn(name, ids, grads, lr):
+    from ..ps.box_cache import get_box_cache
+
+    get_box_cache().push_sparse_grad(name, ids, grads, lr)
+
+
+def _box_grad(ins, attrs, ctx):
+    """Backward of pull_box_sparse = the reference's push_box_sparse op
+    (push_box_sparse_op.cc): apply the row grads to the trainer-resident
+    box cache (read-your-writes) and flush them to the PS asynchronously
+    (box_wrapper.h:46 PushSparseGrad)."""
+    from ..core.registry import GRAD_PREFIX_IG, GRAD_PREFIX_IN, GRAD_PREFIX_OG
+
+    name = attrs["table_name"]
+    lr = float(attrs.get("sparse_lr", 0.01))
+    ids = ins[GRAD_PREFIX_IN + "Ids"][0]
+    og = ins[GRAD_PREFIX_OG + "Out"][0]
+    token = _sparse_push_token(name, ids, og, lr, _box_push_fn)
+    shadow = ins[GRAD_PREFIX_IN + "Shadow"][0]
+    return {GRAD_PREFIX_IG + "Shadow": [
+        jnp.zeros_like(shadow) + token.astype(shadow.dtype) * 0]}
+
+
+@register_op("pull_box_sparse", grad=_box_grad, nondiff_inputs=("Ids",))
+def pull_box_sparse(ins, attrs, ctx):
+    """reference: operators/pull_box_sparse_op.cc + fleet/box_wrapper.h:41
+    PullSparse — embedding lookup through the trainer-resident hot-row
+    cache (ps/box_cache.py): cache hits never touch the remote PS; misses
+    fan out to the sharded servers and populate the LRU. Same Shadow
+    convention as distributed_lookup_table (the table is remote; the
+    differentiable Shadow scalar carries the backward hook)."""
+    name = attrs["table_name"]
+    dim = int(attrs["emb_dim"])
+    dtype = np.dtype(attrs.get("dtype", "float32"))
+    ids = ins["Ids"][0]
+
+    def _pull(ids_v):
+        from ..ps.box_cache import get_box_cache
+
+        return get_box_cache().pull_sparse(
+            name, np.asarray(ids_v), dim).astype(dtype)
+
+    flat_n = 1
+    for s in ids.shape:
+        flat_n *= s
+    rows = jax.experimental.io_callback(
+        _pull, jax.ShapeDtypeStruct((flat_n, dim), dtype), ids,
+        ordered=True)
+    out = rows.reshape(tuple(ids.shape) + (dim,))
+    if ins.get("Shadow") and ins["Shadow"][0] is not None:
+        out = out + ins["Shadow"][0].astype(out.dtype) * 0
+    return {"Out": out}
+
+
+@register_op("push_box_sparse", grad=None, nondiff_inputs=("Ids", "Grads"))
+def push_box_sparse(ins, attrs, ctx):
+    """reference: push_box_sparse_op.cc — standalone push (normally the
+    backward of pull_box_sparse emits it implicitly via _box_grad; this
+    op exists for programs that schedule the push explicitly)."""
+    name = attrs["table_name"]
+    lr = float(attrs.get("sparse_lr", 0.01))
+    token = _sparse_push_token(name, ins["Ids"][0], ins["Grads"][0], lr,
+                               _box_push_fn)
+    return {"Out": token}
 
 
 @register_op("listen_and_serv", grad=None)

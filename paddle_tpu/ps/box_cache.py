@@ -1,0 +1,177 @@
+"""BoxPS-analogue: a trainer-resident hot-row embedding cache over the PS.
+
+Reference: framework/fleet/box_wrapper.h (BoxWrapper::PullSparse :41,
+PushSparseGrad :46, BeginPass/EndPass :38-40) + operators/
+pull_box_sparse_op.cc / push_box_sparse_op.cc — BoxPS keeps the hot rows
+of giant CTR embeddings resident near the trainer so most lookups never
+touch the remote parameter server; gradients are applied locally (read-
+your-writes within a pass) and flushed to the PS asynchronously; pass
+boundaries (BeginPass/EndPass) resynchronize with the server.
+
+Here the "box" is a host-side LRU over (table, id) -> row:
+
+  pull_sparse : cache hits are served locally; misses fan out to the
+                sharded PS (ps/sparse_table.pull_rows) and populate the
+                LRU. Hit/miss counters expose the hit rate (BENCH_CTR).
+  push_sparse_grad : the SGD update is applied to the cached rows
+                immediately AND enqueued for a background flush thread
+                that batches pushes to the PS — the trainer never blocks
+                on the push RPC (box_wrapper's async PushSparseGrad).
+  begin_pass / end_pass : end_pass drains the flush queue synchronously;
+                begin_pass invalidates the cache so the next pull reads
+                server-fresh rows (multi-trainer staleness is bounded by
+                a pass, exactly the BoxPS contract).
+
+Single-trainer note: local-apply + server-apply see the SAME gradient
+once each, so cached and server rows stay bit-identical between passes;
+with multiple trainers the cache serves each trainer its own
+read-your-writes view while the server accumulates everyone's updates —
+the next begin_pass picks them up.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .client import PSClient
+from .sparse_table import pull_rows, push_row_grads
+
+
+class BoxSparseCache:
+    """Hot-row LRU embedding tier with async gradient flush."""
+
+    def __init__(self, client: PSClient, capacity_rows: int = 1 << 16,
+                 flush_queue_size: int = 64):
+        self.client = client
+        self.capacity = int(capacity_rows)
+        # (table, id) -> np row; OrderedDict in LRU order (front = oldest)
+        self._rows: "OrderedDict[Tuple[str, int], np.ndarray]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._flushq: "queue.Queue" = queue.Queue(maxsize=flush_queue_size)
+        self._stop = threading.Event()
+        self._flusher: Optional[threading.Thread] = None
+        self.hits = 0
+        self.misses = 0
+
+    # -- stats ---------------------------------------------------------------
+
+    @property
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def stats(self) -> Dict[str, float]:
+        return {"hits": self.hits, "misses": self.misses,
+                "hit_rate": round(self.hit_rate, 4),
+                "resident_rows": len(self._rows)}
+
+    # -- pass lifecycle (box_wrapper.h BeginPass/EndPass) --------------------
+
+    def begin_pass(self):
+        """Invalidate the cache: next pulls read server-fresh rows."""
+        self.end_pass()
+        with self._lock:
+            self._rows.clear()
+
+    def end_pass(self):
+        """Drain pending gradient flushes synchronously."""
+        self._stop.set()
+        if self._flusher is not None:
+            self._flusher.join(timeout=30)
+            self._flusher = None
+        while True:
+            try:
+                name, ids, grads, lr = self._flushq.get_nowait()
+            except queue.Empty:
+                break
+            push_row_grads(self.client, name, ids, grads, lr)
+        self._stop.clear()
+
+    # -- pull / push ---------------------------------------------------------
+
+    def pull_sparse(self, name: str, ids: np.ndarray,
+                    dim: int) -> np.ndarray:
+        ids = np.asarray(ids).reshape(-1)
+        out = np.empty((ids.size, dim), np.float32)
+        miss_idx = []
+        with self._lock:
+            for i, rid in enumerate(ids):
+                row = self._rows.get((name, int(rid)))
+                if row is not None:
+                    self._rows.move_to_end((name, int(rid)))
+                    out[i] = row
+                else:
+                    miss_idx.append(i)
+        if miss_idx:
+            miss_ids = ids[miss_idx]
+            # one fetch per unique id; in-batch duplicates share the row
+            # (and count as hits: they cost no extra RPC rows)
+            uniq, inv = np.unique(miss_ids, return_inverse=True)
+            self.misses += int(uniq.size)
+            self.hits += int(ids.size - uniq.size)
+            rows = pull_rows(self.client, name, uniq, dim=dim)
+            with self._lock:
+                for u, row in zip(uniq, rows):
+                    self._insert(name, int(u), row.astype(np.float32))
+            out[np.asarray(miss_idx)] = rows[inv]
+        else:
+            self.hits += int(ids.size)
+        return out
+
+    def _insert(self, name: str, rid: int, row: np.ndarray):
+        self._rows[(name, rid)] = row
+        self._rows.move_to_end((name, rid))
+        while len(self._rows) > self.capacity:
+            self._rows.popitem(last=False)     # evict the coldest
+
+    def push_sparse_grad(self, name: str, ids: np.ndarray,
+                         grads: np.ndarray, lr: float = 0.01):
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(ids.size, -1)
+        # 1) local apply: read-your-writes inside the pass
+        with self._lock:
+            for rid, g in zip(ids, grads):
+                row = self._rows.get((name, int(rid)))
+                if row is not None:
+                    row -= lr * g
+        # 2) async flush to the PS (bounded queue back-pressures like the
+        # communicator's send queues). The check-then-spawn is under the
+        # lock: two concurrent pushes must not each start a flusher
+        # (end_pass joins only the tracked thread).
+        with self._lock:
+            if self._flusher is None or not self._flusher.is_alive():
+                self._flusher = threading.Thread(target=self._flush_loop,
+                                                 daemon=True)
+                self._flusher.start()
+        self._flushq.put((name, ids.copy(), grads.copy(), lr))
+
+    def _flush_loop(self):
+        while not self._stop.is_set():
+            try:
+                name, ids, grads, lr = self._flushq.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            push_row_grads(self.client, name, ids, grads, lr)
+
+
+_BOX: Optional[BoxSparseCache] = None
+
+
+def init_box_cache(client: PSClient, capacity_rows: int = 1 << 16
+                   ) -> BoxSparseCache:
+    global _BOX
+    _BOX = BoxSparseCache(client, capacity_rows)
+    return _BOX
+
+
+def get_box_cache() -> BoxSparseCache:
+    if _BOX is None:
+        raise RuntimeError(
+            "box cache not initialized — call ps.box_cache.init_box_cache "
+            "(the BoxWrapper::GetInstance of this rebuild)")
+    return _BOX

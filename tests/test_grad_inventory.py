@@ -500,6 +500,9 @@ EXCEPTIONS = {
                "(tests/test_round2_ops.py end-to-end)",
     "distributed_lookup_table": "pushes sparse grads to live pservers "
                                 "(tests/test_ps.py end-to-end)",
+    "pull_box_sparse": "pushes sparse grads through the BoxPS hot-row "
+                       "cache to live pservers "
+                       "(tests/test_ps.py test_box_sparse_cache_end_to_end)",
     "fake_quantize_dequantize_abs_max":
         "straight-through estimator: analytic grad intentionally differs "
         "from the true (a.e. zero) derivative (tests/test_slim.py)",

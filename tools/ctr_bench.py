@@ -73,6 +73,47 @@ def bench_raw_sparse(client, n_iters=50, rows_per_call=512, V=100_000,
                    "servers": len(client.endpoints)}}), flush=True)
 
 
+def bench_box_cache(client, n_iters=50, rows_per_call=512, V=100_000,
+                    D=16, hot_frac=0.1, capacity=1 << 14):
+    """BoxPS-analogue pull throughput (reference: fleet/box_wrapper.h):
+    zipf-ish CTR id stream (10% hot ids get 90% of lookups) through the
+    hot-row LRU — reports rows/s and the cache hit rate."""
+    from paddle_tpu.ps.box_cache import BoxSparseCache
+    from paddle_tpu.ps.sparse_table import init_sparse_table
+
+    rng = np.random.RandomState(7)
+    init_sparse_table(client, "box_bench_table",
+                      rng.rand(V, D).astype("float32"))
+    box = BoxSparseCache(client, capacity_rows=capacity)
+    hot_n = int(V * hot_frac * 0.01)  # hot set sized well under capacity
+    hot = rng.randint(0, V, max(hot_n, 1))
+    batches = np.where(rng.rand(n_iters, rows_per_call) < 0.9,
+                       hot[rng.randint(0, hot.size,
+                                       (n_iters, rows_per_call))],
+                       rng.randint(0, V, (n_iters, rows_per_call)))
+    grads = rng.rand(rows_per_call, D).astype("float32")
+
+    t0 = time.perf_counter()
+    for i in range(n_iters):
+        box.pull_sparse("box_bench_table", batches[i], D)
+    dt_pull = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for i in range(n_iters):
+        box.push_sparse_grad("box_bench_table", batches[i], grads, lr=0.01)
+    box.end_pass()  # include flush drain in the push timing
+    dt_push = time.perf_counter() - t0
+    n_rows = n_iters * rows_per_call
+    print(json.dumps({
+        "metric": "box_cache_pull_rows_per_sec",
+        "value": round(n_rows / dt_pull, 1), "unit": "rows/s",
+        "detail": {"hit_rate": box.stats()["hit_rate"],
+                   "resident_rows": box.stats()["resident_rows"],
+                   "push_rows_per_sec_incl_flush":
+                       round(n_rows / dt_push, 1),
+                   "rows_per_call": rows_per_call, "dim": D,
+                   "servers": len(client.endpoints)}}), flush=True)
+
+
 def bench_raw_dense(client, n_iters=100, dim=100_000):
     """Dense push→adam-desc-apply per arrival (async-mode server path)."""
     rng = np.random.RandomState(1)
@@ -178,6 +219,7 @@ def main():
     bind_client(client)
     try:
         bench_raw_sparse(client)
+        bench_box_cache(client)
         bench_raw_dense(client)
         with tempfile.TemporaryDirectory() as td:
             bench_downpour_flow(client, td)
