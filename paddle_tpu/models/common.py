@@ -129,3 +129,39 @@ def conv2d_nhwc(x, w, stride=1, padding="SAME"):
 def maxpool2x2_nhwc(x):
     return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
                                  (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+
+
+# -- INT8 serving path (reference capability: contrib/float16's low-
+#    precision inference + mkldnn INT8 kernels; TPU-native form: int8
+#    MXU convs with per-output-channel weight scales + dynamic per-
+#    tensor activation scales) ---------------------------------------------
+
+
+def quantize_conv_weights_int8(params: Params) -> Params:
+    """Per-output-channel symmetric int8 for every 4-D HWIO conv weight
+    '*.w'; adds '<k>@scale' [O] and leaves everything else untouched.
+    The result feeds the same model apply(): conv helpers dispatch on
+    the weight dtype."""
+    out = dict(params)
+    for k, v in params.items():
+        if k.endswith(".w") and getattr(v, "ndim", 0) == 4:
+            w = jnp.asarray(v, jnp.float32)
+            amax = jnp.max(jnp.abs(w), axis=(0, 1, 2))
+            scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+            out[k] = jnp.clip(jnp.round(w / scale), -127,
+                              127).astype(jnp.int8)
+            out[k + "@scale"] = scale.astype(jnp.float32)
+    return out
+
+
+def conv2d_nhwc_int8(x, wq, w_scale, stride=1, padding="SAME"):
+    """int8 x int8 -> int32 MXU conv; activation quantized dynamically
+    (per-tensor abs-max), dequantized per output channel. Returns f32."""
+    xf = x.astype(jnp.float32)
+    xs = jnp.maximum(jnp.max(jnp.abs(xf)), 1e-8) / 127.0
+    xq = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
+    acc = jax.lax.conv_general_dilated(
+        xq, wq, (stride, stride), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * (xs * w_scale.reshape(1, 1, 1, -1))

@@ -78,9 +78,16 @@ def init(rng: jax.Array, cfg: ResNetConfig) -> Tuple[Params, Dict]:
 
 
 def _conv(params, name, x, stride=1, padding="SAME"):
-    w = params[f"{name}.w"].astype(x.dtype)
+    w = params[f"{name}.w"]
+    if w.dtype == jnp.int8:
+        # INT8 serving path (models/common.quantize_conv_weights_int8)
+        from .common import conv2d_nhwc_int8
+
+        return conv2d_nhwc_int8(
+            x, w, params[f"{name}.w@scale"], stride, padding
+        ).astype(x.dtype)
     return jax.lax.conv_general_dilated(
-        x, w, (stride, stride), padding,
+        x, w.astype(x.dtype), (stride, stride), padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
 

@@ -255,3 +255,81 @@ def moving_average_abs_max_scale(ins, attrs, ctx):
     return {"Out": x, "OutScale": scale.reshape(1),
             "OutState": new_state.reshape(1),
             "OutAccum": new_accum.reshape(1)}
+
+
+# ---------------------------------------------------------------------------
+# INT8 runtime ops — true integer compute for calibrated inference models
+# (reference: inference/api/mkldnn_quantizer.cc feeds calibration scales
+# into INT8 kernels via cpu_quantize_pass.cc; here
+# slim.quantization.calibrate_and_quantize rewrites the saved program to
+# these ops and both the XLA and native engines execute them).
+# ---------------------------------------------------------------------------
+
+
+def _quantize_activation(x, x_scale):
+    """Symmetric per-tensor int8 quantization of the activation."""
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / x_scale), -127, 127)
+    return xq.astype(jnp.int8)
+
+
+@register_op("quantized_mul", grad=None, nondiff_inputs=("Y", "Scale"))
+def quantized_mul(ins, attrs, ctx):
+    """mul with int8 weight + int8-quantized activation: int32 MXU
+    accumulation, dequantized by x_scale * w_scale (per output column)."""
+    import numpy as np
+
+    x, wq = ins["X"][0], ins["Y"][0]          # wq int8 [K, N]
+    w_scale = ins["Scale"][0]                  # [1, N] (per out channel)
+    x_scale = float(attrs["x_scale"])
+    xnc = int(attrs.get("x_num_col_dims", 1))
+    xm = x.reshape((int(np.prod(x.shape[:xnc])), -1))
+    xq = _quantize_activation(xm, x_scale)
+    acc = jax.lax.dot_general(
+        xq, wq, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (x_scale * w_scale.reshape(1, -1))
+    out_shape = x.shape[:xnc] + wq.shape[1:]
+    return {"Out": out.reshape(out_shape).astype(x.dtype)}
+
+
+@register_op("quantized_matmul", grad=None, nondiff_inputs=("Y", "Scale"))
+def quantized_matmul(ins, attrs, ctx):
+    """2-D matmul variant (transposes unsupported — the rewriter only
+    targets plain X @ W)."""
+    x, wq = ins["X"][0], ins["Y"][0]
+    w_scale = ins["Scale"][0]
+    x_scale = float(attrs["x_scale"])
+    xq = _quantize_activation(x, x_scale)
+    acc = jax.lax.dot_general(
+        xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    out = acc.astype(jnp.float32) * (x_scale * w_scale.reshape(1, -1))
+    return {"Out": out.astype(x.dtype)}
+
+
+@register_op("quantized_conv2d", grad=None, nondiff_inputs=("Filter", "Scale"))
+def quantized_conv2d(ins, attrs, ctx):
+    """conv2d (NCHW, reference layout) with int8 filter [O,I,H,W] and
+    int8-quantized activation; int32 accumulation, per-output-channel
+    dequant scale."""
+    x, wq = ins["Input"][0], ins["Filter"][0]
+    w_scale = ins["Scale"][0]                  # [O,1,1,1]
+    x_scale = float(attrs["x_scale"])
+    strides = tuple(int(s) for s in attrs.get("strides", [1, 1]))
+    pads = [int(p) for p in attrs.get("paddings", [0, 0])]
+    if len(pads) == 2:
+        pads = [pads[0], pads[0], pads[1], pads[1]]
+    dil = tuple(int(d) for d in attrs.get("dilations", [1, 1]))
+    xq = _quantize_activation(x, x_scale)
+    acc = jax.lax.conv_general_dilated(
+        xq, wq, strides,
+        ((pads[0], pads[1]), (pads[2], pads[3])),
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=int(attrs.get("groups", 1) or 1),
+        preferred_element_type=jnp.int32)
+    scale = (x_scale * w_scale.reshape(-1)).reshape(1, -1, 1, 1)
+    out = acc.astype(jnp.float32) * scale
+    if ins.get("Bias") and ins["Bias"][0] is not None:
+        out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
+    return {"Output": out.astype(x.dtype)}

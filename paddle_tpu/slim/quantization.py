@@ -149,3 +149,121 @@ def quantize_inference_model(model_dir: str,
                              save_model_path: Optional[str] = None):
     """One-call weight-only INT8 quantization of a saved inference model."""
     return PostTrainingQuantization(model_dir, save_model_path).quantize()
+
+
+# ---------------------------------------------------------------------------
+# Calibration-based INT8 runtime (reference: inference/api/
+# mkldnn_quantizer.cc — run calibration batches, collect per-activation
+# scales, rewrite the graph to INT8 kernels via cpu_quantize_pass.cc)
+# ---------------------------------------------------------------------------
+
+_INT8_REWRITE = {"mul": ("quantized_mul", "Y", "X"),
+                 "matmul": ("quantized_matmul", "Y", "X"),
+                 "conv2d": ("quantized_conv2d", "Filter", "Input")}
+
+
+def calibrate_and_quantize(model_dir: str, calibration_reader,
+                           save_model_path: Optional[str] = None,
+                           quantizable_op_type: Optional[Sequence[str]] = None
+                           ) -> Dict[str, float]:
+    """Full INT8 pipeline over a saved fp32 inference model:
+
+    1. run `calibration_reader` batches (iterable of feed dicts) through
+       the fp32 model, recording each quantizable op's activation-input
+       abs-max -> per-tensor activation scale (amax / 127);
+    2. quantize the weights (per-output-channel int8, existing PTQ);
+    3. REWRITE the saved program: mul/matmul/conv2d become
+       quantized_mul/quantized_matmul/quantized_conv2d consuming the int8
+       weight + scale vars with the calibrated x_scale attr.
+
+    The result is a model dir that both engines execute with true int8
+    matmul/conv compute (int32 accumulation): the XLA Predictor via
+    ops/quant.py's quantized_* kernels, the native C++ predictor via its
+    int8 gemm/conv kernels. Returns {activation_var: scale}."""
+    from ..core.executor import Executor, Scope, scope_guard
+    from ..core.ir import ProgramDesc, VarDesc
+    from ..core.places import CPUPlace
+    from .. import io as pt_io
+
+    op_types = set(quantizable_op_type or _INT8_REWRITE)
+    save_path = save_model_path or model_dir
+
+    # -- 1. calibration on the fp32 model ----------------------------------
+    exe = Executor(CPUPlace())
+    scope = Scope()
+    with scope_guard(scope):
+        program, feed_names, _ = pt_io.load_inference_model(model_dir, exe)
+        targets = []          # (op_idx, act_var, weight_var, op_type)
+        desc0 = program.desc.blocks[0]
+        for i, op in enumerate(desc0.ops):
+            if op.type not in op_types or op.type not in _INT8_REWRITE:
+                continue
+            _, wslot, xslot = _INT8_REWRITE[op.type]
+            wnames = op.inputs.get(wslot, [])
+            xnames = op.inputs.get(xslot, [])
+            if not wnames or not xnames:
+                continue
+            wv = desc0.vars.get(wnames[0])
+            if wv is None or not wv.persistable:
+                continue
+            if op.type == "matmul":
+                # quantized_matmul handles plain 2-D X @ W only — leave
+                # transposed/scaled/batched matmuls in fp32
+                xv = desc0.vars.get(xnames[0])
+                if (op.attrs.get("transpose_X") or
+                        op.attrs.get("transpose_Y") or
+                        float(op.attrs.get("alpha", 1.0)) != 1.0 or
+                        (xv is not None and xv.shape is not None
+                         and len(xv.shape) != 2)):
+                    continue
+            targets.append((i, xnames[0], wnames[0], op.type))
+        act_names = sorted({t[1] for t in targets})
+        amax = {n: 0.0 for n in act_names}
+        n_batches = 0
+        for feed in calibration_reader():
+            outs = exe.run(program, feed=feed, fetch_list=act_names)
+            for n, v in zip(act_names, outs):
+                amax[n] = max(amax[n], float(np.abs(np.asarray(v)).max()))
+            n_batches += 1
+        if n_batches == 0:
+            raise ValueError("calibration reader yielded no batches")
+    act_scales = {n: (m / 127.0 if m > 0 else 1.0)
+                  for n, m in amax.items()}
+
+    # -- 2. weight quantization --------------------------------------------
+    PostTrainingQuantization(
+        model_dir, save_path,
+        quantizable_op_type=[t for t in op_types]).quantize()
+
+    # -- 3. program rewrite -------------------------------------------------
+    model_path = os.path.join(save_path, "__model__")
+    with open(model_path) as f:
+        payload = json.load(f)
+    desc = ProgramDesc.from_dict(payload["program"])
+    meta_path = os.path.join(save_path, QUANT_META_FILE)
+    with open(meta_path) as f:
+        meta = json.load(f)
+    b0 = desc.blocks[0]
+    for i, xname, wname, op_type in targets:
+        if wname not in meta:
+            continue
+        op = b0.ops[i]
+        new_type, wslot, _ = _INT8_REWRITE[op_type]
+        q = np.load(os.path.join(save_path, _fname(wname, "@INT8")))
+        s = np.load(os.path.join(save_path, _fname(wname, "@SCALE")))
+        b0.vars[wname + "@INT8"] = VarDesc(
+            name=wname + "@INT8", shape=tuple(q.shape), dtype="int8",
+            persistable=True, stop_gradient=True)
+        b0.vars[wname + "@SCALE"] = VarDesc(
+            name=wname + "@SCALE", shape=tuple(s.shape), dtype="float32",
+            persistable=True, stop_gradient=True)
+        op.type = new_type
+        op.inputs[wslot] = [wname + "@INT8"]
+        op.inputs["Scale"] = [wname + "@SCALE"]
+        op.attrs["x_scale"] = float(act_scales[xname])
+        b0.vars.pop(wname, None)
+    payload["program"] = desc.to_dict()
+    payload["act_scales"] = act_scales
+    with open(model_path, "w") as f:
+        json.dump(payload, f)
+    return act_scales

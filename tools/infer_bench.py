@@ -35,6 +35,7 @@ sys.path.insert(0, REPO)
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 REF_MS = {
     ("vgg16", 1): 3.32,
@@ -97,23 +98,51 @@ def main():
     def rn_fn(p, x):
         return resnet.apply(p, rcfg, x, train=False)[0]
 
-    configs = [("vgg16", vgg_fn, vparams, 1), ("vgg16", vgg_fn, vparams, 64),
-               ("resnet50", rn_fn, rparams, 1),
-               ("resnet50", rn_fn, rparams, 128)]
-    for name, fn, params, bs in configs:
+    # INT8 variants: per-output-channel int8 conv weights + dynamic
+    # per-tensor activation scales, int32 MXU accumulation
+    # (models/common.quantize_conv_weights_int8; the reference's analogue
+    # is mkldnn INT8 inference, mkldnn_quantizer.cc)
+    from paddle_tpu.models.common import quantize_conv_weights_int8
+
+    vparams_q = quantize_conv_weights_int8(vparams)
+    rparams_q = quantize_conv_weights_int8(rparams)
+
+    configs = [("vgg16", vgg_fn, vparams, 1, "bf16"),
+               ("vgg16", vgg_fn, vparams, 64, "bf16"),
+               ("resnet50", rn_fn, rparams, 1, "bf16"),
+               ("resnet50", rn_fn, rparams, 128, "bf16"),
+               ("vgg16_int8", vgg_fn, vparams_q, 64, "int8"),
+               ("resnet50_int8", rn_fn, rparams_q, 128, "int8")]
+    for name, fn, params, bs, prec in configs:
         img = jax.random.normal(jax.random.key(2), (bs, 3, 224, 224),
                                 jnp.float32)
         ms = _device_latency_ms(fn, params, img)
-        ref = REF_MS[(name, bs)]
+        base = name.replace("_int8", "")
+        ref = REF_MS[(base, bs)]
+        detail = {"batch_size": bs, "platform": platform,
+                  "precision": prec,
+                  "reference_v100_fp16_ms": ref,
+                  "chained_serial_calls": N_CHAIN,
+                  "host_roundtrip_ms": round(rtt, 3),
+                  "source": "contrib/float16/float16_benchmark.md"}
+        if prec == "int8":
+            # accuracy delta vs the bf16 path on the same inputs
+            fp = np.asarray(jax.jit(fn)(
+                {k: v for k, v in (vparams if base == "vgg16"
+                                   else rparams).items()}, img[:2]),
+                np.float32)
+            qt = np.asarray(jax.jit(fn)(params, img[:2]), np.float32)
+            detail["int8_vs_bf16_max_abs_logit_delta"] = round(
+                float(np.abs(fp - qt).max()), 4)
+            detail["int8_vs_bf16_rel_logit_delta"] = round(
+                float(np.abs(fp - qt).max() / (np.abs(fp).max() + 1e-9)), 4)
+            detail["int8_vs_bf16_top1_agreement"] = round(
+                float((fp.argmax(-1) == qt.argmax(-1)).mean()), 4)
         print(json.dumps({
             "metric": f"{name}_infer_device_latency_ms_bs{bs}",
             "value": round(ms, 3), "unit": "ms",
             "vs_baseline": round(ref / ms, 3),
-            "detail": {"batch_size": bs, "platform": platform,
-                       "reference_v100_fp16_ms": ref,
-                       "chained_serial_calls": N_CHAIN,
-                       "host_roundtrip_ms": round(rtt, 3),
-                       "source": "contrib/float16/float16_benchmark.md"},
+            "detail": detail,
         }), flush=True)
     return 0
 
