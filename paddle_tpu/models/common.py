@@ -165,3 +165,16 @@ def conv2d_nhwc_int8(x, wq, w_scale, stride=1, padding="SAME"):
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         preferred_element_type=jnp.int32)
     return acc.astype(jnp.float32) * (xs * w_scale.reshape(1, 1, 1, -1))
+
+
+def conv2d_nhwc_auto(params: Params, name: str, x, stride=1,
+                     padding="SAME"):
+    """The dtype-dispatching conv the model zoo shares: int8 weights
+    (from quantize_conv_weights_int8) take the int8 MXU path, anything
+    else the plain bf16/f32 conv. Output in x.dtype either way."""
+    w = params[f"{name}.w"]
+    if w.dtype == jnp.int8:
+        return conv2d_nhwc_int8(
+            x, w, params[f"{name}.w@scale"], stride, padding
+        ).astype(x.dtype)
+    return conv2d_nhwc(x, w.astype(x.dtype), stride, padding)

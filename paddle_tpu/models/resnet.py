@@ -78,17 +78,9 @@ def init(rng: jax.Array, cfg: ResNetConfig) -> Tuple[Params, Dict]:
 
 
 def _conv(params, name, x, stride=1, padding="SAME"):
-    w = params[f"{name}.w"]
-    if w.dtype == jnp.int8:
-        # INT8 serving path (models/common.quantize_conv_weights_int8)
-        from .common import conv2d_nhwc_int8
+    from .common import conv2d_nhwc_auto
 
-        return conv2d_nhwc_int8(
-            x, w, params[f"{name}.w@scale"], stride, padding
-        ).astype(x.dtype)
-    return jax.lax.conv_general_dilated(
-        x, w.astype(x.dtype), (stride, stride), padding,
-        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    return conv2d_nhwc_auto(params, name, x, stride, padding)
 
 
 def _bn(params, state_updates, name, x, cfg, train: bool):

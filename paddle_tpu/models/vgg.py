@@ -13,7 +13,7 @@ from typing import Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-from .common import (ParamStore, Params, conv2d_nhwc, dense,
+from .common import (ParamStore, Params, conv2d_nhwc_auto, dense,
                      maxpool2x2_nhwc)
 
 # channels per conv block (VGG-16: 2-2-3-3-3 convs)
@@ -68,15 +68,7 @@ def apply(params: Params, cfg: VGGConfig, img: jax.Array) -> jax.Array:
     x = img.transpose(0, 2, 3, 1).astype(adt)     # NHWC
     for bi, (n_convs, _) in enumerate(BLOCKS):
         for ci in range(n_convs):
-            w = params[f"b{bi}.c{ci}.w"]
-            if w.dtype == jnp.int8:
-                # INT8 serving (models/common.quantize_conv_weights_int8)
-                from .common import conv2d_nhwc_int8
-
-                x = conv2d_nhwc_int8(
-                    x, w, params[f"b{bi}.c{ci}.w@scale"]).astype(adt)
-            else:
-                x = conv2d_nhwc(x, w.astype(adt))
+            x = conv2d_nhwc_auto(params, f"b{bi}.c{ci}", x)
             x = jax.nn.relu(x + params[f"b{bi}.c{ci}.b"].astype(adt))
         x = maxpool2x2_nhwc(x)
     b = x.shape[0]

@@ -23,17 +23,34 @@ import numpy as np
 from ..core.framework import Program
 
 
+def _strip_training_ops(train_program: Program) -> Program:
+    """Forward-only clone for evaluation: drop backward / optimizer /
+    lr-schedule ops so an eval pass can NEVER mutate parameters or
+    optimizer state (the reference Compressor takes a separate
+    eval_program for the same reason, compressor.py:236)."""
+    from ..core.framework import OpRole
+
+    p = train_program.clone(for_test=True)
+    drop = OpRole.Backward | OpRole.Optimize | OpRole.LRSched
+    for b in p.desc.blocks:
+        b.ops = [op for op in b.ops
+                 if not int(op.attrs.get(OpRole.AttrName, 0)) & drop]
+    p._rebuild_from_desc()
+    return p
+
+
 class CompressionContext:
     """What strategies see: the live training state."""
 
     def __init__(self, place, scope, train_program, startup_program,
-                 executor, eval_fn, epoch=0):
+                 executor, eval_fn, epoch=0, has_eval=False):
         self.place = place
         self.scope = scope
         self.train_program = train_program
         self.startup_program = startup_program
         self.executor = executor
         self.eval_fn = eval_fn
+        self.has_eval = has_eval
         self.epoch = epoch
         self.eval_history: List[float] = []
 
@@ -61,11 +78,13 @@ class QuantizationStrategy(Strategy):
     """Schedule QAT: insert fake-quant ops at start_epoch (reference:
     slim/quantization/quantization_strategy.py)."""
 
-    def __init__(self, start_epoch: int = 0, weight_bits: int = 8,
+    def __init__(self, start_epoch: int = 0, end_epoch: int = 10 ** 9,
+                 weight_bits: int = 8,
                  activation_bits: int = 8,
                  weight_quantize_type: str = "channel_wise_abs_max",
                  activation_quantize_type: str = "moving_average_abs_max"):
         self.start_epoch = int(start_epoch)
+        self.end_epoch = int(end_epoch)
         self.kw = dict(weight_bits=weight_bits,
                        activation_bits=activation_bits,
                        weight_quantize_type=weight_quantize_type,
@@ -101,10 +120,12 @@ class SensitivePruneStrategyScheduled(Strategy):
     epochs."""
 
     def __init__(self, pruned_params: Sequence[str],
-                 start_epoch: int = 0, max_metric_drop: float = 0.05,
+                 start_epoch: int = 0, end_epoch: int = 10 ** 9,
+                 max_metric_drop: float = 0.05,
                  sensitivity_ratios: Sequence[float] = (0.1, 0.3, 0.5, 0.7),
                  mode: str = "ratio"):
         self.start_epoch = int(start_epoch)
+        self.end_epoch = int(end_epoch)
         self.params = list(pruned_params)
         self.max_drop = float(max_metric_drop)
         self.ratios = list(sensitivity_ratios)
@@ -115,6 +136,11 @@ class SensitivePruneStrategyScheduled(Strategy):
     def on_epoch_begin(self, ctx):
         if self.applied or ctx.epoch < self.start_epoch:
             return
+        if not ctx.has_eval:
+            raise ValueError(
+                "SensitivePruneStrategy needs the Compressor's eval_func: "
+                "without a metric every prune ratio shows zero drop and "
+                "the maximum candidate ratio would be chosen blindly")
         from .prune import Pruner, SensitivePruneStrategy
 
         pruner = Pruner(self.mode)
@@ -131,8 +157,10 @@ class UniformPruneStrategy(Strategy):
     slim/prune/prune_strategy.py UniformPruneStrategy)."""
 
     def __init__(self, pruned_params: Sequence[str], ratio: float = 0.5,
-                 start_epoch: int = 0, mode: str = "ratio"):
+                 start_epoch: int = 0, end_epoch: int = 10 ** 9,
+                 mode: str = "ratio"):
         self.start_epoch = int(start_epoch)
+        self.end_epoch = int(end_epoch)
         self.params = list(pruned_params)
         self.ratio = float(ratio)
         self.mode = mode
@@ -183,6 +211,18 @@ class Compressor:
         self.epoch = int(epoch)
         self.strategies: List[Strategy] = []
         self.executor = Executor(place)
+        # eval runs on a forward-only clone of the train program so an
+        # eval (or a sensitivity probe) can never take an optimizer step;
+        # regenerated whenever a strategy mutates the train program
+        self._eval_prog = None
+        self._eval_prog_version = None
+
+    def _eval_program(self) -> Program:
+        ver = getattr(self.train_program, "_version", None)
+        if self._eval_prog is None or self._eval_prog_version != ver:
+            self._eval_prog = _strip_training_ops(self.train_program)
+            self._eval_prog_version = ver
+        return self._eval_prog
 
     # -- configuration (YAML path / YAML string / dict) ----------------------
 
@@ -224,7 +264,7 @@ class Compressor:
     def _eval(self, ctx) -> Optional[float]:
         if self.eval_func is None:
             return None
-        m = float(self.eval_func(self.train_program, self.executor,
+        m = float(self.eval_func(self._eval_program(), self.executor,
                                  self.scope))
         ctx.eval_history.append(m)
         return m
@@ -235,9 +275,10 @@ class Compressor:
         ctx = CompressionContext(
             self.place, self.scope, self.train_program,
             self.startup_program, self.executor,
-            eval_fn=lambda: (self.eval_func(self.train_program,
+            eval_fn=lambda: (self.eval_func(self._eval_program(),
                                             self.executor, self.scope)
-                             if self.eval_func else 0.0))
+                             if self.eval_func else 0.0),
+            has_eval=self.eval_func is not None)
         with scope_guard(self.scope):
             if self.startup_program is not None:
                 self.executor.run(self.startup_program)

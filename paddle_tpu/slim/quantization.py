@@ -216,6 +216,19 @@ def calibrate_and_quantize(model_dir: str, calibration_reader,
                         (xv is not None and xv.shape is not None
                          and len(xv.shape) != 2)):
                     continue
+            if op.type == "conv2d":
+                # quantized_conv2d covers the vanilla case both engines
+                # execute identically; grouped/dilated/auto-padded convs
+                # stay fp32 (the native int8 kernel rejects them)
+                pads = [int(p) for p in op.attrs.get("paddings", [0, 0])]
+                if (int(op.attrs.get("groups", 1) or 1) > 1 or
+                        any(int(d) != 1
+                            for d in op.attrs.get("dilations", [1, 1])) or
+                        op.attrs.get("padding_algorithm",
+                                     "EXPLICIT") != "EXPLICIT" or
+                        (len(pads) == 4 and (pads[0] != pads[1]
+                                             or pads[2] != pads[3]))):
+                    continue
             targets.append((i, xnames[0], wnames[0], op.type))
         act_names = sorted({t[1] for t in targets})
         amax = {n: 0.0 for n in act_names}
@@ -261,7 +274,13 @@ def calibrate_and_quantize(model_dir: str, calibration_reader,
         op.inputs[wslot] = [wname + "@INT8"]
         op.inputs["Scale"] = [wname + "@SCALE"]
         op.attrs["x_scale"] = float(act_scales[xname])
-        b0.vars.pop(wname, None)
+        # drop the fp32 weight desc ONLY if no remaining (skipped/fp32)
+        # op still reads it — a shared weight with a non-rewritten
+        # consumer must keep loading the float values
+        still_used = any(n == wname for o2 in b0.ops
+                         for ns in o2.inputs.values() for n in ns)
+        if not still_used:
+            b0.vars.pop(wname, None)
     payload["program"] = desc.to_dict()
     payload["act_scales"] = act_scales
     with open(model_path, "w") as f:

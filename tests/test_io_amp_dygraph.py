@@ -93,6 +93,66 @@ def test_dygraph_layer_training(rng):
     assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
 
 
+@pytest.mark.parametrize("clip_kind", ["value", "norm", "global_norm"])
+def test_dygraph_grad_clip_matches_static(clip_kind, rng):
+    """All three gradient-clip types in dygraph mode produce the SAME
+    post-step weights as the identically-initialized static program
+    (reference: dygraph_grad_clip.py covers ByValue/ByNorm/ByGlobalNorm).
+    Tight clip bounds guarantee the clip actually binds."""
+    X = rng.rand(8, 6).astype("float32") * 4.0
+    Y = (X @ rng.rand(6, 1)).astype("float32") * 3.0
+    W0 = rng.rand(6, 1).astype("float32")
+    b0 = rng.rand(1).astype("float32")
+
+    def make_clip():
+        return {"value": pt.clip.GradientClipByValue(max=0.02),
+                "norm": pt.clip.GradientClipByNorm(clip_norm=0.05),
+                "global_norm": pt.clip.GradientClipByGlobalNorm(
+                    clip_norm=0.05)}[clip_kind]
+
+    # dygraph: one clipped SGD step
+    with pt.dygraph.guard():
+        lin = pt.dygraph.nn.Linear(6, 1)
+        lin.weight.set_value(W0)
+        lin.bias.set_value(b0)
+        opt = pt.optimizer.SGD(learning_rate=0.1, grad_clip=make_clip())
+        loss = pt.layers.mean(pt.layers.square_error_cost(
+            input=lin(pt.dygraph.to_variable(X)),
+            label=pt.dygraph.to_variable(Y)))
+        loss.backward()
+        opt.minimize(loss, parameter_list=lin.parameters())
+        dy_w = np.asarray(lin.weight.numpy()).copy()
+        dy_b = np.asarray(lin.bias.numpy()).copy()
+
+    # static: identical init + clip + one step
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[6], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(input=x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred,
+                                                          label=y))
+        pt.optimizer.SGD(learning_rate=0.1,
+                         grad_clip=make_clip()).minimize(loss)
+        wname, bname = [p.name for p in main.all_parameters()]
+    with pt.scope_guard(pt.Scope()):
+        exe = pt.Executor(pt.CPUPlace())
+        exe.run(startup)
+        pt.global_scope().set_var(wname, W0)
+        pt.global_scope().set_var(bname, b0)
+        exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        st_w = np.asarray(pt.global_scope().find_var(wname))
+        st_b = np.asarray(pt.global_scope().find_var(bname))
+
+    # sanity: a step happened, and with these loss magnitudes the raw
+    # grads far exceed the clip bounds, so the clipped step is tiny —
+    # bounded by lr * max-clip * sqrt(numel) for every clip kind
+    step = np.abs(st_w - W0).max()
+    assert 0 < step <= 0.1 * 0.05 * np.sqrt(W0.size) + 1e-6, step
+    np.testing.assert_allclose(dy_w, st_w, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(dy_b, st_b, rtol=1e-5, atol=1e-6)
+
+
 def test_dygraph_matches_static(rng):
     """reference pattern: test_imperative_mnist.py compares dygraph vs
     static results for the same weights."""
