@@ -1,0 +1,84 @@
+"""SelectedRows — the sparse row-slice gradient value.
+
+Reference: framework/selected_rows.h:32 — `{height, rows[], value[N,D]}`,
+the representation embedding gradients take so optimizers touch only the
+rows a batch used (math/selected_rows_functor.cc merge/add; sparse
+branches in sgd_op/adam_op). TPU-native form: a registered pytree of
+(rows, ids) with the table height static, flowing through the lowered
+program like any other value — lookup_table's custom grad emits it when
+`is_sparse`, the `sum` op concatenates row sets, and the sgd/momentum/
+adam kernels apply true row-sparse updates (duplicates handled by a
+sort + segment-sum merge, exactly the reference's merge_add + per-row
+apply, but with static shapes for XLA).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SelectedRows:
+    """rows [N, D] values at int32 ids [N] of a [height, D] table."""
+
+    def __init__(self, rows: jax.Array, ids: jax.Array, height: int):
+        self.rows = rows
+        self.ids = ids
+        self.height = int(height)
+
+    @property
+    def dtype(self):
+        return self.rows.dtype
+
+    def astype(self, dt) -> "SelectedRows":
+        return SelectedRows(self.rows.astype(dt), self.ids, self.height)
+
+    def to_dense(self) -> jax.Array:
+        """Scatter-add into the dense [height, D] gradient."""
+        out = jnp.zeros((self.height,) + self.rows.shape[1:],
+                        self.rows.dtype)
+        return out.at[self.ids].add(self.rows)
+
+    def merged(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """(ids, rows, is_first): duplicates summed into the FIRST
+        occurrence slot (static shapes — the reference's merge_add).
+        Non-first slots keep their id but carry zero rows and
+        is_first=False; scatters should drop them via the masked-id
+        trick (see masked_ids)."""
+        order = jnp.argsort(self.ids)
+        sid = self.ids[order]
+        srows = self.rows[order]
+        is_first = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), sid[1:] != sid[:-1]])
+        seg = jnp.cumsum(is_first) - 1
+        summed = jax.ops.segment_sum(srows, seg,
+                                     num_segments=self.ids.shape[0])
+        rows = jnp.where(is_first[:, None], summed[seg], 0.0)
+        return sid, rows.astype(self.rows.dtype), is_first
+
+    def masked_ids(self, ids, keep) -> jax.Array:
+        """ids with non-kept slots pushed out of bounds: scatters in
+        mode='drop' then touch only the kept rows."""
+        return jnp.where(keep, ids, self.height)
+
+
+def _flatten(sr: SelectedRows):
+    return (sr.rows, sr.ids), sr.height
+
+
+def _unflatten(height, children):
+    rows, ids = children
+    return SelectedRows(rows, ids, height)
+
+
+jax.tree_util.register_pytree_node(SelectedRows, _flatten, _unflatten)
+
+
+def is_selected_rows(v) -> bool:
+    return isinstance(v, SelectedRows)
+
+
+def to_dense(v):
+    return v.to_dense() if isinstance(v, SelectedRows) else v

@@ -110,9 +110,12 @@ def box_embedding(input, size, table_name, sparse_lr=0.01,
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32"):
     """reference: layers/nn.py `embedding` → lookup_table_op. is_sparse
-    selects SelectedRows grads in the reference; on TPU dense scatter-add
-    grads are MXU/HBM-friendly, and the PS path handles truly huge tables
-    (see distributed_embedding)."""
+    selects SelectedRows gradients, exactly as in the reference: the W
+    grad flows through the program as a (rows, ids) row-slice value
+    (core/selected_rows.py) and the sgd/momentum/adam/adagrad kernels
+    apply true row-sparse updates — no dense [V, D] grad is ever
+    materialized. The PS path handles truly huge tables
+    (distributed_embedding); box_embedding adds the hot-row cache."""
     helper = LayerHelper("embedding", param_attr=param_attr)
     w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype)
     out = helper.create_variable_for_type_inference(dtype)

@@ -31,7 +31,20 @@ def _bcast(x, y, axis: int):
 
 def _ew(fn):
     def kernel(ins, attrs, ctx):
+        from ..core.selected_rows import (SelectedRows, is_selected_rows,
+                                          to_dense)
+
         x, y = ins["X"][0], ins["Y"][0]
+        # SelectedRows x (scalar) keeps the rows sparse — the
+        # GlobalNorm-clip `g * scale` composition; any other SelectedRows
+        # operand densifies (e.g. a sparse grad meeting a dense
+        # regularization term — the reference's elementwise ops have no
+        # SelectedRows kernels either)
+        if is_selected_rows(x) and not is_selected_rows(y) \
+                and getattr(y, "size", 0) == 1:
+            return {"Out": SelectedRows(fn(x.rows, y.reshape(())),
+                                        x.ids, x.height)}
+        x, y = to_dense(x), to_dense(y)
         x, y = _bcast(x, y, int(attrs.get("axis", -1)))
         return {"Out": fn(x, y)}
 
@@ -52,8 +65,19 @@ register_op("elementwise_floordiv", grad=None)(_ew(jnp.floor_divide))
 @register_op("sum")
 def sum_op(ins, attrs, ctx):
     """Multi-input add (reference: operators/sum_op.cc) — the grad
-    accumulator emitted by backward.py."""
+    accumulator emitted by backward.py. SelectedRows inputs concatenate
+    their row sets (reference sum_op's SelectedRows branch via
+    selected_rows_functor); mixing sparse and dense densifies."""
+    from ..core.selected_rows import SelectedRows, is_selected_rows
+
     xs = [x for x in ins["X"] if x is not None]
+    if any(is_selected_rows(x) for x in xs):
+        if all(is_selected_rows(x) for x in xs):
+            return {"Out": SelectedRows(
+                jnp.concatenate([x.rows for x in xs]),
+                jnp.concatenate([x.ids for x in xs]),
+                xs[0].height)}
+        xs = [x.to_dense() if is_selected_rows(x) else x for x in xs]
     out = xs[0]
     for x in xs[1:]:
         out = out + x

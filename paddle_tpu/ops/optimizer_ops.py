@@ -19,15 +19,38 @@ def _lr(ins):
     return lr.reshape(()) if getattr(lr, "ndim", 0) else lr
 
 
+def _dense_grad(ins):
+    """Densify a SelectedRows grad for kernels without a sparse branch
+    (the reference falls back the same way where no SelectedRows kernel
+    is registered)."""
+    from ..core.selected_rows import to_dense
+
+    return to_dense(ins["Grad"][0])
+
+
 @register_op("sgd", grad=None)
 def sgd(ins, attrs, ctx):
+    """reference: optimizers/sgd_op.cc — dense branch plus the
+    SelectedRows branch (sgd_op.h sparse path): only the touched rows
+    are updated; duplicate ids accumulate, matching the reference's
+    row-wise apply."""
+    from ..core.selected_rows import is_selected_rows
+
     p, g = ins["Param"][0], ins["Grad"][0]
-    return {"ParamOut": p - _lr(ins).astype(p.dtype) * g.astype(p.dtype)}
+    lr = _lr(ins).astype(p.dtype)
+    if is_selected_rows(g):
+        return {"ParamOut": p.at[g.ids].add(-lr * g.rows.astype(p.dtype))}
+    return {"ParamOut": p - lr * g.astype(p.dtype)}
 
 
 @register_op("momentum", grad=None)
 def momentum(ins, attrs, ctx):
-    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    """reference: optimizers/momentum_op.cc. Its SparseMomentumFunctor
+    (momentum_op.h) iterates the WHOLE parameter with g=0 for rows absent
+    from the SelectedRows grad — velocity decays and params move
+    everywhere, numerically identical to the dense path — so sparse
+    grads densify here (scatter-add) and take exactly that path."""
+    p, g, v = ins["Param"][0], _dense_grad(ins), ins["Velocity"][0]
     mu = attrs.get("mu", 0.9)
     lr = _lr(ins).astype(p.dtype)
     v_new = mu * v + g
@@ -42,7 +65,7 @@ def momentum(ins, attrs, ctx):
 def lars_momentum(ins, attrs, ctx):
     """reference: optimizers/lars_momentum_op.cc — layer-wise adaptive rate
     scaling for large-batch training."""
-    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    p, g, v = ins["Param"][0], _dense_grad(ins), ins["Velocity"][0]
     mu = attrs.get("mu", 0.9)
     coeff = attrs.get("lars_coeff", 0.001)
     wd = attrs.get("lars_weight_decay", 0.0005)
@@ -61,17 +84,38 @@ def lars_momentum(ins, attrs, ctx):
 @register_op("adam", grad=None)
 def adam(ins, attrs, ctx):
     """reference: optimizers/adam_op.cc (Beta1Pow/Beta2Pow threaded as 1-elem
-    tensors exactly like the reference)."""
+    tensors exactly like the reference). SelectedRows grads follow the
+    reference's lazy_mode attr (adam_op.h SparseAdamFunctor): the
+    DEFAULT lazy_mode=False is numerically dense-equivalent (every row's
+    moments decay, g=0 where untouched), so it densifies; lazy_mode=True
+    merges duplicates and updates ONLY the touched rows — untouched
+    rows' moments do not decay."""
+    from ..core.selected_rows import is_selected_rows
+
     p, g = ins["Param"][0], ins["Grad"][0]
+    if is_selected_rows(g) and not bool(attrs.get("lazy_mode", False)):
+        g = g.to_dense()
     m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
     b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
     b1 = attrs.get("beta1", 0.9)
     b2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
     lr = _lr(ins).astype(jnp.float32)
+    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
+    if is_selected_rows(g):
+        ids, rows, keep = g.merged()
+        sids = g.masked_ids(ids, keep)
+        rows = rows.astype(jnp.float32)
+        m1i = b1 * m1[ids] + (1 - b1) * rows
+        m2i = b2 * m2[ids] + (1 - b2) * jnp.square(rows)
+        step = lr_t * m1i / (jnp.sqrt(m2i) + eps)
+        return {"ParamOut": p.at[sids].add(-step.astype(p.dtype),
+                                           mode="drop"),
+                "Moment1Out": m1.at[sids].set(m1i, mode="drop"),
+                "Moment2Out": m2.at[sids].set(m2i, mode="drop"),
+                "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
     m1n = b1 * m1 + (1 - b1) * g
     m2n = b2 * m2 + (1 - b2) * jnp.square(g)
-    lr_t = lr * jnp.sqrt(1 - b2p.reshape(())) / (1 - b1p.reshape(()))
     p_new = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
     return {"ParamOut": p_new.astype(p.dtype), "Moment1Out": m1n, "Moment2Out": m2n,
             "Beta1PowOut": b1p * b1, "Beta2PowOut": b2p * b2}
@@ -89,7 +133,7 @@ def adamw(ins, attrs, ctx):
 
 @register_op("adamax", grad=None)
 def adamax(ins, attrs, ctx):
-    p, g = ins["Param"][0], ins["Grad"][0]
+    p, g = ins["Param"][0], _dense_grad(ins)
     m, u = ins["Moment"][0], ins["InfNorm"][0]
     b1p = ins["Beta1Pow"][0]
     b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
@@ -103,16 +147,28 @@ def adamax(ins, attrs, ctx):
 
 @register_op("adagrad", grad=None)
 def adagrad(ins, attrs, ctx):
+    """reference: optimizers/adagrad_op.cc incl. its SelectedRows branch
+    (duplicates merged, touched rows only)."""
+    from ..core.selected_rows import is_selected_rows
+
     p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
     eps = attrs.get("epsilon", 1e-6)
     lr = _lr(ins).astype(p.dtype)
+    if is_selected_rows(g):
+        ids, rows, keep = g.merged()
+        sids = g.masked_ids(ids, keep)
+        rows = rows.astype(p.dtype)
+        mom_i = mom[ids] + jnp.square(rows)
+        step = lr * rows / (jnp.sqrt(mom_i) + eps)
+        return {"ParamOut": p.at[sids].add(-step, mode="drop"),
+                "MomentOut": mom.at[sids].set(mom_i, mode="drop")}
     mom_new = mom + jnp.square(g)
     return {"ParamOut": p - lr * g / (jnp.sqrt(mom_new) + eps), "MomentOut": mom_new}
 
 
 @register_op("decayed_adagrad", grad=None)
 def decayed_adagrad(ins, attrs, ctx):
-    p, g, mom = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    p, g, mom = ins["Param"][0], _dense_grad(ins), ins["Moment"][0]
     decay = attrs.get("decay", 0.95)
     eps = attrs.get("epsilon", 1e-6)
     lr = _lr(ins).astype(p.dtype)
@@ -122,7 +178,7 @@ def decayed_adagrad(ins, attrs, ctx):
 
 @register_op("adadelta", grad=None)
 def adadelta(ins, attrs, ctx):
-    p, g = ins["Param"][0], ins["Grad"][0]
+    p, g = ins["Param"][0], _dense_grad(ins)
     avg_sq, avg_upd = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
     rho = attrs.get("rho", 0.95)
     eps = attrs.get("epsilon", 1e-6)
@@ -135,7 +191,7 @@ def adadelta(ins, attrs, ctx):
 
 @register_op("rmsprop", grad=None)
 def rmsprop(ins, attrs, ctx):
-    p, g = ins["Param"][0], ins["Grad"][0]
+    p, g = ins["Param"][0], _dense_grad(ins)
     ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
     rho = attrs.get("decay", 0.95)
     eps = attrs.get("epsilon", 1e-6)
@@ -154,7 +210,7 @@ def rmsprop(ins, attrs, ctx):
 
 @register_op("ftrl", grad=None)
 def ftrl(ins, attrs, ctx):
-    p, g = ins["Param"][0], ins["Grad"][0]
+    p, g = ins["Param"][0], _dense_grad(ins)
     sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
     l1 = attrs.get("l1", 0.0)
     l2 = attrs.get("l2", 0.0)
@@ -172,7 +228,7 @@ def ftrl(ins, attrs, ctx):
 @register_op("lamb", grad=None)
 def lamb(ins, attrs, ctx):
     """reference: optimizers/lamb_op.cc — layer-adaptive large-batch Adam."""
-    p, g = ins["Param"][0], ins["Grad"][0]
+    p, g = ins["Param"][0], _dense_grad(ins)
     m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
     b1p, b2p = ins["Beta1Pow"][0], ins["Beta2Pow"][0]
     b1 = attrs.get("beta1", 0.9)
@@ -197,7 +253,7 @@ def lamb(ins, attrs, ctx):
 def dpsgd(ins, attrs, ctx):
     """reference: optimizers/dpsgd_op.cc — differentially-private SGD
     (clip + gaussian noise)."""
-    p, g = ins["Param"][0], ins["Grad"][0]
+    p, g = ins["Param"][0], _dense_grad(ins)
     clip = attrs.get("clip", 10.0)
     batch_size = attrs.get("batch_size", 16.0)
     sigma = attrs.get("sigma", 1.0)
@@ -238,7 +294,7 @@ def dgc_momentum(ins, attrs, ctx):
     (mostly-zero) gradient — GSPMD handles the collective; the compression
     semantic (only top-k% of grads applied, rest accumulated locally) is
     preserved via the U/V accumulators."""
-    p, g = ins["Param"][0], ins["Grad"][0]
+    p, g = ins["Param"][0], _dense_grad(ins)
     u, v = ins["U"][0], ins["V"][0]
     mu = attrs.get("mu", 0.9)
     ratio = attrs.get("sparsity_ratio", 0.001)
@@ -268,7 +324,7 @@ def dgc_momentum(ins, attrs, ctx):
 def proximal_gd(ins, attrs, ctx):
     """reference: optimizers/proximal_gd_op.cc — prox_param = p - lr*g,
     then soft-threshold by l1 and shrink by l2."""
-    p, g = ins["Param"][0], ins["Grad"][0]
+    p, g = ins["Param"][0], _dense_grad(ins)
     lr = _lr(ins).astype(p.dtype)
     l1 = attrs.get("l1", 0.0)
     l2 = attrs.get("l2", 0.0)
@@ -285,7 +341,7 @@ def proximal_gd(ins, attrs, ctx):
 @register_op("proximal_adagrad", grad=None)
 def proximal_adagrad(ins, attrs, ctx):
     """reference: optimizers/proximal_adagrad_op.cc."""
-    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    p, g, m = ins["Param"][0], _dense_grad(ins), ins["Moment"][0]
     lr = _lr(ins).astype(p.dtype)
     l1 = attrs.get("l1", 0.0)
     l2 = attrs.get("l2", 0.0)

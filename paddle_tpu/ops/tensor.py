@@ -362,7 +362,39 @@ def one_hot(ins, attrs, ctx):
     return {"Out": jax.nn.one_hot(flat, depth, dtype=jnp.float32)}
 
 
-@register_op("lookup_table", nondiff_inputs=("Ids",))
+def _lookup_table_grad(ins, attrs, ctx):
+    """Custom grad for lookup_table(+_v2): with `is_sparse` the W grad is
+    a true SelectedRows (reference: lookup_table_op.cc W@GRAD declared
+    SELECTED_ROWS when is_sparse, selected_rows_functor.cc) — rows = the
+    incoming output grads, ids = the looked-up indices, no dense [V,D]
+    materialization. Dense mode keeps the scatter-add."""
+    from ..core.registry import GRAD_PREFIX_IG, GRAD_PREFIX_IN, GRAD_PREFIX_OG
+    from ..core.selected_rows import SelectedRows
+
+    w = ins[GRAD_PREFIX_IN + "W"][0]
+    ids = ins[GRAD_PREFIX_IN + "Ids"][0]
+    og = ins[GRAD_PREFIX_OG + "Out"][0]
+    padding_idx = int(attrs.get("padding_idx", -1))
+    idx = ids.astype(jnp.int32)
+    if ctx.op.type.startswith("lookup_table_grad") or \
+            ctx.op.type == "lookup_table":
+        # v1 squeezes a trailing [.,1] dim (mirror of the forward)
+        if idx.ndim > 1 and idx.shape[-1] == 1:
+            idx = idx[..., 0]
+    flat_ids = idx.reshape(-1)
+    rows = og.reshape(flat_ids.shape[0], -1).astype(w.dtype)
+    if padding_idx != -1:
+        rows = jnp.where((flat_ids == padding_idx)[:, None],
+                         jnp.zeros((), rows.dtype), rows)
+    if bool(attrs.get("is_sparse", False)):
+        gw = SelectedRows(rows, flat_ids, w.shape[0])
+    else:
+        gw = jnp.zeros_like(w).at[flat_ids].add(rows)
+    return {GRAD_PREFIX_IG + "W": [gw]}
+
+
+@register_op("lookup_table", grad=_lookup_table_grad,
+             nondiff_inputs=("Ids",))
 def lookup_table(ins, attrs, ctx):
     """reference: operators/lookup_table_op.cc — Ids [...,1] int64, W [V,D]."""
     w, ids = ins["W"][0], ins["Ids"][0]
@@ -378,7 +410,8 @@ def lookup_table(ins, attrs, ctx):
     return {"Out": out}
 
 
-@register_op("lookup_table_v2", nondiff_inputs=("Ids",))
+@register_op("lookup_table_v2", grad=_lookup_table_grad,
+             nondiff_inputs=("Ids",))
 def lookup_table_v2(ins, attrs, ctx):
     w, ids = ins["W"][0], ins["Ids"][0]
     padding_idx = int(attrs.get("padding_idx", -1))
@@ -502,14 +535,35 @@ def unique_with_counts(ins, attrs, ctx):
 
 @register_op("clip")
 def clip(ins, attrs, ctx):
+    """SelectedRows stay sparse: clip the row values elementwise
+    (reference clip_op's SelectedRows kernel clips the merged value)."""
+    from ..core.selected_rows import SelectedRows, is_selected_rows
+
     x = _x(ins)
+    if is_selected_rows(x):
+        ids, rows, _ = x.merged()
+        return {"Out": SelectedRows(
+            jnp.clip(rows, attrs.get("min"), attrs.get("max")),
+            ids, x.height)}
     return {"Out": jnp.clip(x, attrs.get("min"), attrs.get("max"))}
 
 
 @register_op("clip_by_norm")
 def clip_by_norm(ins, attrs, ctx):
+    """SelectedRows stay sparse: merge duplicate rows first (reference
+    clip_by_norm_op.h merges via merge_add), then scale by the norm of
+    the merged rows."""
+    from ..core.selected_rows import SelectedRows, is_selected_rows
+
     x = _x(ins)
     max_norm = attrs["max_norm"]
+    if is_selected_rows(x):
+        ids, rows, _ = x.merged()
+        norm = jnp.sqrt(jnp.sum(jnp.square(rows)))
+        scale = jnp.where(norm > max_norm,
+                          max_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return {"Out": SelectedRows(rows * scale.astype(rows.dtype),
+                                    ids, x.height)}
     norm = jnp.sqrt(jnp.sum(jnp.square(x)))
     scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
     return {"Out": x * scale.astype(x.dtype)}
@@ -517,7 +571,14 @@ def clip_by_norm(ins, attrs, ctx):
 
 @register_op("squared_l2_norm")
 def squared_l2_norm(ins, attrs, ctx):
+    """SelectedRows: norm of the MERGED rows (duplicates summed first,
+    like the reference's merge_add before GlobalNorm accumulation)."""
+    from ..core.selected_rows import is_selected_rows
+
     x = _x(ins)
+    if is_selected_rows(x):
+        _, rows, _ = x.merged()
+        return {"Out": jnp.sum(jnp.square(rows)).reshape(1)}
     return {"Out": jnp.sum(jnp.square(x)).reshape(1)}
 
 

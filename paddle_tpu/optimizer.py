@@ -360,6 +360,9 @@ class AdamOptimizer(Optimizer):
                  epsilon=1e-8, lazy_mode=False, **kw):
         super().__init__(learning_rate, **kw)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        # reference optimizer.py:1340 — lazy_mode selects the
+        # touched-rows-only sparse adam path (SelectedRows grads)
+        self._lazy_mode = bool(lazy_mode)
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
@@ -384,7 +387,8 @@ class AdamOptimizer(Optimizer):
             outputs={"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
                      "Beta1PowOut": b1p, "Beta2PowOut": b2p},
             attrs={"beta1": self._beta1, "beta2": self._beta2,
-                   "epsilon": self._epsilon})
+                   "epsilon": self._epsilon,
+                   "lazy_mode": self._lazy_mode})
 
 
 class AdamaxOptimizer(Optimizer):

@@ -1,0 +1,248 @@
+"""SelectedRows sparse gradients through the Program IR.
+
+Reference: framework/selected_rows.h:32 + lookup_table_op.cc (W@GRAD is
+SELECTED_ROWS when is_sparse) + the sparse branches of sgd/momentum/
+adam/adagrad (optimizers/*, math/selected_rows_functor.cc). These tests
+check the kernel math against explicit lazy numpy references (with
+duplicate ids) and the end-to-end program path."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.core.registry import get_op_def, KernelCtx
+from paddle_tpu.core.ir import OpDesc
+from paddle_tpu.core.selected_rows import SelectedRows
+
+
+def _call(op_type, ins, attrs):
+    op = OpDesc(type=op_type, inputs={}, outputs={}, attrs=dict(attrs))
+    return get_op_def(op_type).call(ins, dict(attrs), KernelCtx(op))
+
+
+def _sr(rows, ids, height):
+    return SelectedRows(jnp.asarray(rows, jnp.float32),
+                        jnp.asarray(ids, jnp.int32), height)
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+def test_sgd_sparse_matches_scatter(rng):
+    V, D = 7, 3
+    p = rng.randn(V, D).astype(np.float32)
+    ids = np.array([1, 4, 1], np.int32)         # duplicate id 1
+    rows = rng.randn(3, D).astype(np.float32)
+    out = _call("sgd", {"Param": [jnp.asarray(p)],
+                        "Grad": [_sr(rows, ids, V)],
+                        "LearningRate": [jnp.asarray([0.1], jnp.float32)]},
+                {})["ParamOut"][0]
+    want = p.copy()
+    np.add.at(want, ids, -0.1 * rows)           # dups accumulate
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+
+
+def test_adagrad_sparse_lazy_reference(rng):
+    V, D = 6, 2
+    p = rng.randn(V, D).astype(np.float32)
+    mom = np.abs(rng.randn(V, D)).astype(np.float32)
+    ids = np.array([2, 5, 2], np.int32)
+    rows = rng.randn(3, D).astype(np.float32)
+    out = _call("adagrad", {"Param": [jnp.asarray(p)],
+                            "Grad": [_sr(rows, ids, V)],
+                            "Moment": [jnp.asarray(mom)],
+                            "LearningRate": [jnp.asarray([0.1],
+                                                         jnp.float32)]},
+                {"epsilon": 1e-6})
+    # lazy reference: merge dups, update touched rows once
+    merged = {2: rows[0] + rows[2], 5: rows[1]}
+    want_p, want_m = p.copy(), mom.copy()
+    for i, g in merged.items():
+        want_m[i] = mom[i] + g * g
+        want_p[i] = p[i] - 0.1 * g / (np.sqrt(want_m[i]) + 1e-6)
+    np.testing.assert_allclose(np.asarray(out["ParamOut"][0]), want_p,
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out["MomentOut"][0]), want_m,
+                               rtol=1e-5)
+
+
+def test_adam_sparse_lazy_mode_gates_semantics(rng):
+    """lazy_mode=False (the reference default, adam_op.h) is
+    dense-equivalent: moments decay everywhere; lazy_mode=True freezes
+    untouched rows entirely."""
+    V, D = 5, 2
+    p = rng.randn(V, D).astype(np.float32)
+    m1 = rng.randn(V, D).astype(np.float32) * 0.1
+    m2 = np.abs(rng.randn(V, D)).astype(np.float32) * 0.1
+    ids = np.array([0, 3, 0], np.int32)
+    rows = rng.randn(3, D).astype(np.float32)
+
+    def run(grad, lazy):
+        return _call("adam", {"Param": [jnp.asarray(p)],
+                              "Grad": [grad],
+                              "Moment1": [jnp.asarray(m1)],
+                              "Moment2": [jnp.asarray(m2)],
+                              "Beta1Pow": [jnp.asarray([0.9],
+                                                       jnp.float32)],
+                              "Beta2Pow": [jnp.asarray([0.999],
+                                                       jnp.float32)],
+                              "LearningRate": [jnp.asarray([0.01],
+                                                           jnp.float32)]},
+                     {"beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+                      "lazy_mode": lazy})
+
+    sr = _sr(rows, ids, V)
+    # default mode == dense adam on the scattered grad, bit for bit
+    out_sparse = run(sr, False)
+    out_dense = run(sr.to_dense(), False)
+    for k in ("ParamOut", "Moment1Out", "Moment2Out"):
+        np.testing.assert_array_equal(np.asarray(out_sparse[k][0]),
+                                      np.asarray(out_dense[k][0]))
+    # lazy mode freezes untouched rows — params AND moments
+    out_lazy = run(sr, True)
+    po = np.asarray(out_lazy["ParamOut"][0])
+    m1o = np.asarray(out_lazy["Moment1Out"][0])
+    for i in (1, 2, 4):
+        np.testing.assert_array_equal(po[i], p[i])
+        np.testing.assert_array_equal(m1o[i], m1[i])
+    lr_t = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    for i, g in {0: rows[0] + rows[2], 3: rows[1]}.items():
+        m1n = 0.9 * m1[i] + 0.1 * g
+        m2n = 0.999 * m2[i] + 0.001 * g * g
+        np.testing.assert_allclose(po[i],
+                                   p[i] - lr_t * m1n /
+                                   (np.sqrt(m2n) + 1e-8), rtol=2e-5)
+
+
+def test_momentum_sparse_is_dense_equivalent(rng):
+    """The reference's SparseMomentumFunctor (momentum_op.h) walks the
+    whole param with g=0 for absent rows — velocity decays everywhere —
+    so the sparse path must equal the dense path exactly."""
+    V, D = 4, 2
+    p = rng.randn(V, D).astype(np.float32)
+    v = rng.randn(V, D).astype(np.float32)
+    ids = np.array([1, 1], np.int32)
+    rows = rng.randn(2, D).astype(np.float32)
+    sr = _sr(rows, ids, V)
+    feed = {"Param": [jnp.asarray(p)], "Velocity": [jnp.asarray(v)],
+            "LearningRate": [jnp.asarray([0.1], jnp.float32)]}
+    out_s = _call("momentum", {**feed, "Grad": [sr]}, {"mu": 0.9})
+    out_d = _call("momentum", {**feed, "Grad": [sr.to_dense()]},
+                  {"mu": 0.9})
+    for k in ("ParamOut", "VelocityOut"):
+        np.testing.assert_array_equal(np.asarray(out_s[k][0]),
+                                      np.asarray(out_d[k][0]))
+
+
+def test_sum_concatenates_selected_rows():
+    a = _sr([[1.0, 2.0]], [3], 5)
+    b = _sr([[10.0, 20.0], [30.0, 40.0]], [1, 3], 5)
+    out = _call("sum", {"X": [a, b]}, {})["Out"][0]
+    assert isinstance(out, SelectedRows)
+    np.testing.assert_allclose(np.asarray(out.to_dense()),
+                               np.asarray(a.to_dense() + b.to_dense()))
+    # mixed sparse + dense densifies
+    dense = jnp.ones((5, 2), jnp.float32)
+    out2 = _call("sum", {"X": [a, dense]}, {})["Out"][0]
+    assert not isinstance(out2, SelectedRows)
+    np.testing.assert_allclose(np.asarray(out2),
+                               np.asarray(a.to_dense() + dense))
+
+
+def test_clip_kernels_on_selected_rows():
+    sr = _sr([[3.0, -4.0], [1.0, 1.0], [3.0, 0.0]], [2, 0, 2], 6)
+    out = _call("clip", {"X": [sr]}, {"min": -1.0, "max": 1.0})["Out"][0]
+    assert isinstance(out, SelectedRows)
+    # merged row 2 = [6,-4] then clipped
+    np.testing.assert_allclose(np.asarray(out.to_dense()[2]), [1.0, -1.0])
+    out2 = _call("clip_by_norm", {"X": [sr]}, {"max_norm": 1.0})["Out"][0]
+    assert isinstance(out2, SelectedRows)
+    merged = sr.to_dense()
+    n = float(np.sqrt((np.asarray(merged) ** 2).sum()))
+    np.testing.assert_allclose(np.asarray(out2.to_dense()),
+                               np.asarray(merged) / n, rtol=1e-5)
+    sq = _call("squared_l2_norm", {"X": [sr]}, {})["Out"][0]
+    np.testing.assert_allclose(float(np.asarray(sq)[0]), n * n, rtol=1e-5)
+    # scalar multiply stays sparse (GlobalNorm's g * scale)
+    out3 = _call("elementwise_mul",
+                 {"X": [sr], "Y": [jnp.asarray([0.5], jnp.float32)]},
+                 {})["Out"][0]
+    assert isinstance(out3, SelectedRows)
+    np.testing.assert_allclose(np.asarray(out3.to_dense()),
+                               np.asarray(merged) * 0.5, rtol=1e-6)
+
+
+@pytest.mark.parametrize("clip_kind", ["value", "norm", "global_norm"])
+def test_sparse_embedding_with_regularizer_and_clip(clip_kind, rng):
+    """The round-trip that used to crash: is_sparse embedding + L2 decay
+    + every gradient-clip type trains through the Program IR."""
+    V, D = 10, 3
+    ids_np = rng.randint(0, V, (8, 1)).astype("int64")
+    y_np = rng.rand(8, 1).astype("float32")
+    clip = {"value": pt.clip.GradientClipByValue(max=0.1),
+            "norm": pt.clip.GradientClipByNorm(clip_norm=0.5),
+            "global_norm": pt.clip.GradientClipByGlobalNorm(
+                clip_norm=0.5)}[clip_kind]
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        w = pt.layers.data(name="w", shape=[1], dtype="int64")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        emb = pt.layers.embedding(w, (V, D), is_sparse=True)
+        emb = pt.layers.reshape(emb, shape=[-1, D])
+        pred = pt.layers.fc(input=emb, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(input=pred,
+                                                          label=y))
+        pt.optimizer.SGD(
+            0.1, regularization=pt.regularizer.L2Decay(1e-4),
+            grad_clip=clip).minimize(loss)
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        ls = [float(np.asarray(exe.run(main, feed={"w": ids_np, "y": y_np},
+                                       fetch_list=[loss])[0]).reshape(()))
+              for _ in range(5)]
+    assert np.isfinite(ls).all() and ls[-1] <= ls[0], ls
+
+
+def test_embedding_is_sparse_program_matches_dense(rng):
+    """End to end: embedding(is_sparse=True) + SGD produces EXACTLY the
+    same parameters as the dense program (sparse sgd == scatter-add),
+    while the W gradient flows as SelectedRows (no [V,D] dense grad)."""
+    V, D = 12, 4
+    ids_np = rng.randint(0, V, (6, 1)).astype("int64")
+    y_np = rng.rand(6, 1).astype("float32")
+
+    def build(is_sparse):
+        main, startup = pt.Program(), pt.Program()
+        main.random_seed = startup.random_seed = 11
+        with pt.framework.unique_name.guard(), \
+                pt.program_guard(main, startup):
+            w = pt.layers.data(name="w", shape=[1], dtype="int64")
+            y = pt.layers.data(name="y", shape=[1], dtype="float32")
+            emb = pt.layers.embedding(w, (V, D), is_sparse=is_sparse)
+            emb = pt.layers.reshape(emb, shape=[-1, D])
+            pred = pt.layers.fc(input=emb, size=1)
+            loss = pt.layers.mean(pt.layers.square_error_cost(
+                input=pred, label=y))
+            pt.optimizer.SGD(0.2).minimize(loss)
+            wname = [p.name for p in main.all_parameters()
+                     if "emb" in p.name or "lookup" in p.name
+                     or p.shape == (V, D)][0]
+        exe = pt.Executor(pt.CPUPlace())
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            for _ in range(3):
+                exe.run(main, feed={"w": ids_np, "y": y_np},
+                        fetch_list=[loss])
+            return np.asarray(pt.global_scope().find_var(wname)).copy()
+
+    w_sparse = build(True)
+    w_dense = build(False)
+    np.testing.assert_allclose(w_sparse, w_dense, rtol=1e-6, atol=1e-7)
+    # the table moved at all (training actually hit the embedding)
+    assert np.abs(w_sparse).sum() > 0
