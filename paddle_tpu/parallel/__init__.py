@@ -30,3 +30,6 @@ from .role_maker import (  # noqa: F401
 )
 from .fleet import fleet, Fleet, DistributedOptimizer  # noqa: F401
 from .spmd_executor import SPMDRunner  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    latest_step_dir, restore_train_state, save_train_state,
+)
