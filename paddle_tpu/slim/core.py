@@ -40,13 +40,24 @@ def _strip_training_ops(train_program: Program) -> Program:
 
 
 class CompressionContext:
-    """What strategies see: the live training state."""
+    """What strategies see: the live training state.
+
+    train_program is the PERSISTENT student program — mutating
+    strategies (QAT insertion, mask application) always target it.
+    active_program is what the train loop executes THIS epoch; the loop
+    resets it to train_program at every epoch start, so a strategy that
+    swaps it (distillation) holds the swap exactly for the epochs its
+    hooks run — restoration is automatic, including when the range
+    covers the final epoch."""
 
     def __init__(self, place, scope, train_program, startup_program,
-                 executor, eval_fn, epoch=0, has_eval=False):
+                 executor, eval_fn, epoch=0, has_eval=False,
+                 distill_program=None):
         self.place = place
         self.scope = scope
         self.train_program = train_program
+        self.active_program = train_program
+        self.distill_program = distill_program
         self.startup_program = startup_program
         self.executor = executor
         self.eval_fn = eval_fn
@@ -178,10 +189,36 @@ class UniformPruneStrategy(Strategy):
         self.applied = True
 
 
+class DistillationStrategy(Strategy):
+    """Schedule knowledge distillation for an epoch range (reference:
+    slim/distillation/distillation_strategy.py — trains on the
+    distillation graph within [start_epoch, end_epoch] and on the plain
+    student graph outside it). The distill program (student + spliced
+    teacher + distill loss + optimizer, built with
+    slim.distillation.merge) comes from the Compressor's
+    `distill_program` argument — YAML cannot carry a Program. Since the
+    run loop resets active_program every epoch, no restore bookkeeping
+    is needed; hooks only fire inside the range."""
+
+    def __init__(self, start_epoch: int = 0, end_epoch: int = 10 ** 9):
+        self.start_epoch = int(start_epoch)
+        self.end_epoch = int(end_epoch)
+        self.distilled_epochs: List[int] = []
+
+    def on_epoch_begin(self, ctx):
+        if ctx.distill_program is None:
+            raise ValueError(
+                "DistillationStrategy needs Compressor(distill_program=...) "
+                "— build it with slim.distillation.merge + a distill loss")
+        ctx.active_program = ctx.distill_program
+        self.distilled_epochs.append(ctx.epoch)
+
+
 _STRATEGY_TYPES = {
     "QuantizationStrategy": QuantizationStrategy,
     "SensitivePruneStrategy": SensitivePruneStrategyScheduled,
     "UniformPruneStrategy": UniformPruneStrategy,
+    "DistillationStrategy": DistillationStrategy,
 }
 
 
@@ -198,6 +235,7 @@ class Compressor:
                  train_reader: Optional[Callable] = None,
                  train_fetch_list: Optional[Sequence] = None,
                  eval_func: Optional[Callable] = None,
+                 distill_program: Optional[Program] = None,
                  epoch: int = 1):
         from ..core.executor import Executor
 
@@ -208,12 +246,18 @@ class Compressor:
         self.train_reader = train_reader
         self.train_fetch_list = list(train_fetch_list or [])
         self.eval_func = eval_func
+        # student + spliced teacher + distill loss (+ optimizer), for
+        # DistillationStrategy epochs (reference: teacher_programs arg)
+        self.distill_program = distill_program
         self.epoch = int(epoch)
         self.strategies: List[Strategy] = []
         self.executor = Executor(place)
-        # eval runs on a forward-only clone of the train program so an
-        # eval (or a sensitivity probe) can never take an optimizer step;
-        # regenerated whenever a strategy mutates the train program
+        # eval runs on a forward-only clone of the PERSISTENT student
+        # program (never the distill graph — the student params live in
+        # the shared scope, so evaluating the student is both correct
+        # and teacher-free) so an eval or sensitivity probe can never
+        # take an optimizer step; regenerated when a strategy mutates
+        # the program, keeping only the latest version's clone
         self._eval_prog = None
         self._eval_prog_version = None
 
@@ -278,7 +322,8 @@ class Compressor:
             eval_fn=lambda: (self.eval_func(self._eval_program(),
                                             self.executor, self.scope)
                              if self.eval_func else 0.0),
-            has_eval=self.eval_func is not None)
+            has_eval=self.eval_func is not None,
+            distill_program=self.distill_program)
         with scope_guard(self.scope):
             if self.startup_program is not None:
                 self.executor.run(self.startup_program)
@@ -286,12 +331,15 @@ class Compressor:
                 s.on_compression_begin(ctx)
             for e in range(self.epoch):
                 ctx.epoch = e
+                # reset each epoch: a swap (distillation) lasts exactly
+                # as long as its strategy's hooks keep setting it
+                ctx.active_program = ctx.train_program
                 for s in self.strategies:
                     if s.start_epoch <= e <= s.end_epoch:
                         s.on_epoch_begin(ctx)
                 if self.train_reader is not None:
                     for feed in self.train_reader():
-                        self.executor.run(self.train_program, feed=feed,
+                        self.executor.run(ctx.active_program, feed=feed,
                                           fetch_list=self.train_fetch_list)
                 for s in self.strategies:
                     if s.start_epoch <= e <= s.end_epoch:

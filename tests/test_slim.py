@@ -392,6 +392,92 @@ def test_int8_model_zoo_serving_path(rng):
         (np.abs(fp - q).max(), np.abs(fp).max())
 
 
+def test_compressor_distillation_schedule(rng):
+    """DistillationStrategy (reference: slim/distillation/
+    distillation_strategy.py): the Compressor trains on the distill
+    graph (student + spliced frozen teacher + soft-label loss) for the
+    scheduled epoch range and swaps back to the plain student program
+    afterwards."""
+    from paddle_tpu.slim import distillation
+    from paddle_tpu.slim.core import Compressor
+
+    X, Y = _mlp_data()
+
+    # teacher: train briefly so its logits carry signal
+    t_main, t_start, t_loss, t_logits = _build_mlp(seed=21)
+    with pt.program_guard(t_main, t_start):
+        pt.optimizer.Adam(learning_rate=0.05).minimize(t_loss)
+    scope = pt.Scope()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(scope):
+        exe.run(t_start)
+        for _ in range(40):
+            exe.run(t_main, feed={"x": X, "y": Y}, fetch_list=[t_loss])
+    t_infer = pt.Program()
+    with pt.framework.unique_name.guard("teacher_build"), \
+            pt.program_guard(t_infer, pt.Program()):
+        xv = pt.layers.data(name="x", shape=[8], dtype="float32")
+        hv = pt.layers.fc(xv, size=16, act="relu",
+                          param_attr=pt.ParamAttr(name="tw1"),
+                          bias_attr=pt.ParamAttr(name="tb1"))
+        t_out = pt.layers.fc(hv, size=4,
+                             param_attr=pt.ParamAttr(name="tw2"),
+                             bias_attr=pt.ParamAttr(name="tb2"))
+    # copy trained teacher weights under the inference program's names
+    with pt.scope_guard(scope):
+        t_params = [p.name for p in t_main.all_parameters()]
+        # sorted: fc_0.b_0, fc_0.w_0, fc_1.b_0, fc_1.w_0
+        for src, dst in zip(sorted(t_params),
+                            ["tb1", "tw1", "tb2", "tw2"]):
+            scope.set_var(dst, np.asarray(scope.find_var(src)))
+
+    # student + distill program
+    s_main, s_start, s_loss, s_logits = _build_mlp(seed=22)
+    with pt.program_guard(s_main, s_start):
+        pt.optimizer.Adam(learning_rate=0.03).minimize(s_loss)
+    distill = s_main.clone()
+    rename = distillation.merge(t_infer, distill, data_names=["x"])
+    with pt.scope_guard(scope):
+        distillation.init_teacher_scope(scope, rename)
+    with pt.program_guard(distill, s_start):
+        soft = distillation.soft_label_loss(
+            distill.current_block().var(rename[t_out.name]),
+            distill.current_block().var(s_logits.name))
+        # distill loss trains the student weights too
+        pt.optimizer.Adam(learning_rate=0.03).minimize(
+            soft, parameter_list=[p for p in distill.all_parameters()
+                                  if not p.name.startswith("teacher_")
+                                  and not p.name.startswith("t")])
+
+    def train_reader():
+        for _ in range(10):
+            yield {"x": X, "y": Y}
+
+    def eval_func(program, executor, scope_):
+        out = executor.run(program, feed={"x": X, "y": Y},
+                           fetch_list=[s_logits])[0]
+        return float((np.asarray(out).argmax(1) == Y[:, 0]).mean())
+
+    comp = Compressor(pt.CPUPlace(), scope, s_main, s_start,
+                      train_reader=train_reader,
+                      train_fetch_list=[s_loss],
+                      eval_func=eval_func,
+                      distill_program=distill).config({
+                          "strategies": {
+                              "distill": {"class": "DistillationStrategy",
+                                          "start_epoch": 1,
+                                          "end_epoch": 2}},
+                          "compressor": {"epoch": 4}})
+    ctx = comp.run()
+    # the persistent student program is never reassigned; the distill
+    # graph was active exactly for the scheduled epochs
+    assert ctx.train_program is s_main
+    assert ctx.active_program is s_main  # last epoch (3) out of range
+    assert comp.strategies[0].distilled_epochs == [1, 2]
+    assert len(ctx.eval_history) == 4
+    assert ctx.eval_history[-1] > 0.4, ctx.eval_history
+
+
 def test_compressor_rejects_unknown_strategy():
     from paddle_tpu.slim.core import Compressor
 
