@@ -156,9 +156,21 @@ class Optimizer:
     # -- eager (dygraph) updates --------------------------------------------
 
     def _eager_lr(self) -> float:
+        from .dygraph.learning_rate_scheduler import LearningRateDecay
+
+        # per-step cache: _eager_update calls this once PER PARAMETER,
+        # but a scheduler must advance once per minimize()
+        cached = getattr(self, "_eager_lr_step_cache", None)
+        if cached is not None:
+            return cached
+        if isinstance(self._learning_rate, LearningRateDecay):
+            # advances the schedule by one step (reference: dygraph
+            # LearningRateDecay.__call__)
+            return float(self._learning_rate())
         if isinstance(self._learning_rate, Variable):
             raise NotImplementedError(
-                "dygraph mode uses python-number learning rates")
+                "dygraph mode uses python-number learning rates or "
+                "dygraph.LearningRateDecay schedulers")
         return float(self._learning_rate)
 
     def _eager_update(self, pid, value, grad):
@@ -224,8 +236,16 @@ class Optimizer:
                  and p.grad is not None and p.name not in skip]
         pairs = [(p, self._eager_regularize(p, g)) for p, g in pairs]
         pairs = self._eager_clip(pairs)
-        for p, g in pairs:
-            p.set_value(self._eager_update(p, p.value, g))
+        # resolve the lr ONCE for this step (a LearningRateDecay
+        # scheduler advances on resolution) and pin it for the per-param
+        # update loop
+        self._eager_lr_step_cache = None
+        self._eager_lr_step_cache = self._eager_lr()
+        try:
+            for p, g in pairs:
+                p.set_value(self._eager_update(p, p.value, g))
+        finally:
+            self._eager_lr_step_cache = None
         return [], [(p, None) for p, _ in pairs]
 
 

@@ -297,6 +297,11 @@ class Compressor:
                 raise ValueError(
                     f"unknown compression strategy '{cls_name}' "
                     f"(known: {sorted(_STRATEGY_TYPES)})")
+            if cls is DistillationStrategy and self.distill_program is None:
+                raise ValueError(
+                    "DistillationStrategy configured but the Compressor "
+                    "was built without distill_program= — fail now, not "
+                    "after training up to its start_epoch")
             self.strategies.append(cls(**spec))
         comp = config.get("compressor", {}) or {}
         if "epoch" in comp:
@@ -345,6 +350,10 @@ class Compressor:
                     if s.start_epoch <= e <= s.end_epoch:
                         s.on_epoch_end(ctx)
                 self._eval(ctx)
+            # a swap covering the final epoch must not leak out of run():
+            # the returned ctx and on_compression_end always see the
+            # persistent student program as active
+            ctx.active_program = ctx.train_program
             for s in self.strategies:
                 s.on_compression_end(ctx)
         return ctx

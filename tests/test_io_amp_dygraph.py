@@ -153,6 +153,69 @@ def test_dygraph_grad_clip_matches_static(clip_kind, rng):
     np.testing.assert_allclose(dy_b, st_b, rtol=1e-5, atol=1e-6)
 
 
+def test_dygraph_lr_scheduler_steps_once_per_minimize(rng):
+    """A dygraph LearningRateDecay advances exactly ONE step per
+    minimize() — not once per parameter — and the applied lr follows the
+    schedule (reference: dygraph/learning_rate_scheduler.py consumed by
+    optimizer._global_learning_rate in dygraph mode)."""
+    X = rng.rand(8, 4).astype("float32")
+    Y = (X @ rng.rand(4, 1)).astype("float32")
+    sched = pt.dygraph.PiecewiseDecay(boundaries=[2, 4],
+                                      values=[0.1, 0.01, 0.001])
+    with pt.dygraph.guard():
+        lin = pt.dygraph.nn.Linear(4, 1)   # 2 parameters (w, b)
+        opt = pt.optimizer.SGD(learning_rate=sched)
+        seen = []
+        for i in range(5):
+            loss = pt.layers.mean(pt.layers.square_error_cost(
+                input=lin(pt.dygraph.to_variable(X)),
+                label=pt.dygraph.to_variable(Y)))
+            loss.backward()
+            w_before = np.asarray(lin.weight.numpy()).copy()
+            g = np.asarray(lin.weight.grad)
+            opt.minimize(loss, parameter_list=lin.parameters())
+            lin.clear_gradients()
+            w_after = np.asarray(lin.weight.numpy())
+            # recover the applied lr from the actual update
+            applied = float(np.mean((w_before - w_after)[g != 0]
+                                    / g[g != 0]))
+            seen.append(round(applied, 6))
+        # one schedule step per minimize: steps 0,1 -> 0.1; 2,3 -> 0.01;
+        # 4 -> 0.001
+        np.testing.assert_allclose(seen, [0.1, 0.1, 0.01, 0.01, 0.001],
+                                   rtol=1e-4)
+        assert sched.step_num == 5
+
+
+def test_dygraph_lr_schedules_match_static_formulas():
+    """Dygraph decay classes agree with the static-graph scheduler
+    formulas at every step."""
+    import math
+
+    nat = pt.dygraph.NaturalExpDecay(0.5, decay_steps=3, decay_rate=0.7)
+    exp = pt.dygraph.ExponentialDecay(0.5, decay_steps=3, decay_rate=0.7)
+    inv = pt.dygraph.InverseTimeDecay(0.5, decay_steps=3, decay_rate=0.7)
+    poly = pt.dygraph.PolynomialDecay(0.5, decay_steps=4,
+                                      end_learning_rate=0.1, power=2.0)
+    cos = pt.dygraph.CosineDecay(0.5, step_each_epoch=2, epochs=4)
+    noam = pt.dygraph.NoamDecay(d_model=64, warmup_steps=3)
+    for t in range(6):
+        np.testing.assert_allclose(nat(), 0.5 * math.exp(-0.7 * t / 3),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(exp(), 0.5 * 0.7 ** (t / 3), rtol=1e-6)
+        np.testing.assert_allclose(inv(), 0.5 / (1 + 0.7 * t / 3),
+                                   rtol=1e-6)
+        frac = min(t, 4) / 4
+        np.testing.assert_allclose(
+            poly(), (0.5 - 0.1) * (1 - frac) ** 2.0 + 0.1, rtol=1e-6)
+        np.testing.assert_allclose(
+            cos(), 0.5 * 0.5 * (math.cos((t // 2) * math.pi / 4) + 1),
+            rtol=1e-6)
+        n = t + 1                      # NoamDecay defaults begin=1
+        np.testing.assert_allclose(
+            noam(), 64 ** -0.5 * min(n ** -0.5, n * 3 ** -1.5), rtol=1e-6)
+
+
 def test_dygraph_matches_static(rng):
     """reference pattern: test_imperative_mnist.py compares dygraph vs
     static results for the same weights."""
