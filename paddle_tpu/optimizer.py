@@ -56,6 +56,14 @@ class Optimizer:
         if isinstance(self._learning_rate, Variable):
             self._learning_rate_var = self._learning_rate
             return
+        from .dygraph.learning_rate_scheduler import LearningRateDecay
+
+        if isinstance(self._learning_rate, LearningRateDecay):
+            raise TypeError(
+                "a dygraph LearningRateDecay scheduler only works in "
+                "imperative mode (inside dygraph.guard()); static-graph "
+                "programs use layers.learning_rate_scheduler decays "
+                "(exponential_decay, piecewise_decay, ...)")
         if self._learning_rate_var is None:
             from .layers.tensor import create_global_var
 
@@ -156,13 +164,13 @@ class Optimizer:
     # -- eager (dygraph) updates --------------------------------------------
 
     def _eager_lr(self) -> float:
-        from .dygraph.learning_rate_scheduler import LearningRateDecay
-
         # per-step cache: _eager_update calls this once PER PARAMETER,
         # but a scheduler must advance once per minimize()
         cached = getattr(self, "_eager_lr_step_cache", None)
         if cached is not None:
             return cached
+        from .dygraph.learning_rate_scheduler import LearningRateDecay
+
         if isinstance(self._learning_rate, LearningRateDecay):
             # advances the schedule by one step (reference: dygraph
             # LearningRateDecay.__call__)

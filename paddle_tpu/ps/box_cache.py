@@ -97,31 +97,32 @@ class BoxSparseCache:
     def pull_sparse(self, name: str, ids: np.ndarray,
                     dim: int) -> np.ndarray:
         ids = np.asarray(ids).reshape(-1)
-        out = np.empty((ids.size, dim), np.float32)
-        miss_idx = []
+        # operate on UNIQUE ids (CTR batches are duplicate-heavy): one
+        # dict probe per unique id and one vectorized gather at the end —
+        # per-ROW python work would make the cache slower than the raw
+        # RPC it is meant to avoid
+        uniq, inv = np.unique(ids, return_inverse=True)
+        uniq_rows = np.empty((uniq.size, dim), np.float32)
+        miss_pos = []
         with self._lock:
-            for i, rid in enumerate(ids):
+            for j, rid in enumerate(uniq):
                 row = self._rows.get((name, int(rid)))
                 if row is not None:
                     self._rows.move_to_end((name, int(rid)))
-                    out[i] = row
+                    uniq_rows[j] = row
                 else:
-                    miss_idx.append(i)
-        if miss_idx:
-            miss_ids = ids[miss_idx]
-            # one fetch per unique id; in-batch duplicates share the row
-            # (and count as hits: they cost no extra RPC rows)
-            uniq, inv = np.unique(miss_ids, return_inverse=True)
-            self.misses += int(uniq.size)
-            self.hits += int(ids.size - uniq.size)
-            rows = pull_rows(self.client, name, uniq, dim=dim)
+                    miss_pos.append(j)
+            # counters updated under the lock: concurrent trainer
+            # threads must not lose increments (stats drive BENCH_CTR)
+            self.misses += len(miss_pos)
+            self.hits += int(ids.size - len(miss_pos))
+        if miss_pos:
+            fetched = pull_rows(self.client, name, uniq[miss_pos], dim=dim)
+            uniq_rows[miss_pos] = fetched
             with self._lock:
-                for u, row in zip(uniq, rows):
+                for u, row in zip(uniq[miss_pos], fetched):
                     self._insert(name, int(u), row.astype(np.float32))
-            out[np.asarray(miss_idx)] = rows[inv]
-        else:
-            self.hits += int(ids.size)
-        return out
+        return uniq_rows[inv]
 
     def _insert(self, name: str, rid: int, row: np.ndarray):
         self._rows[(name, rid)] = row

@@ -77,7 +77,12 @@ def bench_box_cache(client, n_iters=50, rows_per_call=512, V=100_000,
                     D=16, hot_frac=0.1, capacity=1 << 14):
     """BoxPS-analogue pull throughput (reference: fleet/box_wrapper.h):
     zipf-ish CTR id stream (10% hot ids get 90% of lookups) through the
-    hot-row LRU — reports rows/s and the cache hit rate."""
+    hot-row LRU — reports rows/s and the cache hit rate. NOTE on
+    reading the number: against the LOOPBACK pservers of this bench the
+    RPC is nearly free, so the cache roughly breaks even on pull
+    throughput; its value is hit_rate x (RPC rows + round trips)
+    avoided, which dominates when the PS is across a real network —
+    exactly BoxPS's raison d'etre."""
     from paddle_tpu.ps.box_cache import BoxSparseCache
     from paddle_tpu.ps.sparse_table import init_sparse_table
 
