@@ -465,6 +465,74 @@ def test_box_sparse_cache_end_to_end():
         s.stop()
 
 
+def test_box_cache_concurrent_trainers():
+    """Hogwild-style concurrency over one box cache (the BoxPS usage:
+    many trainer threads share the box): pulls/pushes from 4 threads
+    must keep the hit/miss accounting exact, every pushed gradient must
+    land on the servers exactly once by end_pass, and values must stay
+    consistent."""
+    import threading
+
+    from paddle_tpu.ps import ParameterServer, PSClient
+    from paddle_tpu.ps.box_cache import BoxSparseCache
+    from paddle_tpu.ps.sparse_table import init_sparse_table, pull_rows
+
+    p1, p2 = _free_ports(2)
+    eps = [f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"]
+    servers = [ParameterServer(ep, num_trainers=1, mode="async")
+               for ep in eps]
+    for s in servers:
+        s.start_background()
+    client = PSClient(eps)
+    V, D, LR = 64, 4, 0.5
+    table = np.zeros((V, D), np.float32)
+    init_sparse_table(client, "cc_table", table)
+    box = BoxSparseCache(client, capacity_rows=V)
+
+    rng = np.random.RandomState(0)
+    n_threads, n_iters, per_call = 4, 25, 8
+    # mixed shared-hot + thread-private ids → real contention
+    batches = [[np.concatenate([rng.randint(0, 8, per_call // 2),
+                                rng.randint(8 + t * 14, 8 + (t + 1) * 14,
+                                            per_call // 2)])
+                for _ in range(n_iters)] for t in range(n_threads)]
+    errs = []
+
+    def worker(t):
+        try:
+            for ids in batches[t]:
+                box.pull_sparse("cc_table", ids, D)
+                box.push_sparse_grad("cc_table", ids,
+                                     np.ones((ids.size, D), np.float32),
+                                     lr=LR)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+        assert not th.is_alive(), "worker stalled — timeout, not a race"
+    assert not errs, errs
+    box.end_pass()
+
+    # accounting exact: every pulled id counted exactly once
+    assert box.hits + box.misses == n_threads * n_iters * per_call
+    # every gradient applied server-side exactly once: row value =
+    # -LR * (number of times the id was pushed across all threads)
+    counts = np.zeros(V, np.int64)
+    for t in range(n_threads):
+        for ids in batches[t]:
+            np.add.at(counts, ids, 1)
+    after = pull_rows(client, "cc_table", np.arange(V))
+    np.testing.assert_allclose(after, -LR * counts[:, None] *
+                               np.ones((1, D)), rtol=1e-6, atol=1e-6)
+    for s in servers:
+        s.stop()
+
+
 def test_downpour_style_ctr_training(tmp_path):
     """Downpour-worker flow (reference: DownpourWorker loop,
     downpour_worker.cc:611 — DataFeed batch → pull sparse → compute →
