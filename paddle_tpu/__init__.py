@@ -40,6 +40,7 @@ from . import metrics
 from . import io
 from .io import save, load, save_inference_model, load_inference_model
 from .core.flags import get_flags, set_flags
+from . import contrib
 from . import inference
 from .inference import AnalysisConfig, create_paddle_predictor
 from . import data_feeder

@@ -508,3 +508,60 @@ def test_batch_size_like_output_dim_idx():
                   "output_dim_idx": 1, "min": 0.0, "max": 1.0},
                  rng_seed=2)["Out"][0]
     assert out.shape == (4, 7)
+
+
+def test_contrib_analysis_utils():
+    """reference: contrib/memory_usage_calc.py:46, op_frequence.py:23,
+    model_stat.py:40 — the three Program-analysis helpers."""
+    import pytest as _pytest
+
+    import paddle_tpu as pt
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[1, 28, 28], dtype="float32")
+        c = pt.layers.conv2d(x, num_filters=6, filter_size=5, act="relu")
+        h = pt.layers.fc(c, size=10)
+        loss = pt.layers.mean(h)
+        pt.optimizer.SGD(0.1).minimize(loss)
+
+    lower, upper, unit = pt.contrib.memory_usage(main, batch_size=64)
+    assert 0 < lower <= upper and unit in ("B", "KB", "MB", "GB")
+    with _pytest.raises(ValueError):
+        pt.contrib.memory_usage(main, batch_size=0)
+    with _pytest.raises(TypeError):
+        pt.contrib.memory_usage("not a program", 1)
+
+    uni, adj = pt.contrib.op_freq_statistic(main)
+    uni_d = dict(uni)
+    assert uni_d["conv2d"] == 1 and uni_d.get("sgd", 0) >= 2
+    assert uni == sorted(uni, key=lambda kv: -kv[1])
+    assert any("conv2d," in k for k, _ in adj)  # producer->consumer edge
+
+    params, flops = pt.contrib.summary(main, batch_size=64)
+    # conv 6x1x5x5+6 + fc weights dominate; flops = 2*MACs > 0
+    assert params > 150 and flops > 0
+    # conv FLOPs at bs=64: 2 * 64*6*24*24 * 1*5*5
+    assert flops >= 2 * 64 * 6 * 24 * 24 * 25
+
+
+def test_contrib_summary_grouped_conv_and_matmul_transpose():
+    """The FLOP-count edge cases: depthwise/grouped conv must not divide
+    by groups twice (the filter dim 1 is already cin/groups), matmul
+    honors transpose_Y for the reduction dim, and activation-vs-
+    activation matmuls contribute zero PARAMs."""
+    import paddle_tpu as pt
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[32, 16, 16], dtype="float32")
+        pt.layers.conv2d(x, num_filters=32, filter_size=3, padding=1,
+                         groups=32)
+        a = pt.layers.data(name="a", shape=[64], dtype="float32")
+        b = pt.layers.data(name="b", shape=[10, 64], dtype="float32",
+                           append_batch_size=False)
+        pt.layers.matmul(a, b, transpose_y=True)
+    params, flops = pt.contrib.summary(main, batch_size=1)
+    # depthwise: 2*32*16*16*1*3*3 = 147456; matmul: 2*10*64 = 1280
+    assert flops == 147456 + 1280, flops
+    assert params == 32 * 1 * 3 * 3, params  # data var b is NOT params
