@@ -49,25 +49,52 @@ def _xla_mha(q, k, v, mask, scale):
     return jnp.einsum("bnts,bsnh->btnh", probs, v)
 
 
-def _use_pallas(q) -> bool:
+def _platform(q) -> str:
+    """Where this computation will actually run. Tracers carry no devices;
+    the active mesh (if any) decides — it may be a CPU mesh even when the
+    default backend is TPU (dryrun_multichip's in-process mode)."""
     try:
         dev = q.devices() if hasattr(q, "devices") else None
     except Exception:
         dev = None
-    platform = None
     if dev:
-        platform = next(iter(dev)).platform
-    else:
-        # Tracers carry no devices; the active mesh (if any) says where the
-        # computation will actually run — it may be a CPU mesh even when
-        # the default backend is TPU (dryrun_multichip's in-process mode).
-        from paddle_tpu.parallel.mesh import current_mesh
-        m = current_mesh()
-        if m is not None:
-            platform = m.devices.flat[0].platform
-        else:
-            platform = jax.default_backend()
-    if platform != "tpu" or q.ndim != 4:
+        return next(iter(dev)).platform
+    from paddle_tpu.parallel.mesh import current_mesh
+    m = current_mesh()
+    if m is not None:
+        return m.devices.flat[0].platform
+    return jax.default_backend()
+
+
+try:  # private but the only trace-time manual-region signal (jax 0.9)
+    from jax._src.core import get_axis_env as _get_axis_env
+except ImportError:  # jax moved the symbol: detection unavailable
+    _get_axis_env = None
+    import warnings
+
+    warnings.warn(
+        "jax._src.core.get_axis_env unavailable: pallas attention kernels "
+        "are disabled under >1-device meshes (cannot detect shard_map "
+        "manual regions); update _mesh_partitionable for this jax version")
+
+
+def _mesh_partitionable(q) -> bool:
+    """A pallas_call has no GSPMD partitioning rule: under a >1-device
+    mesh outside a shard_map manual region, XLA would all-gather the
+    operands (defeating dp/sp/tp sharding) or fail at lowering — which
+    the trace-time try/except in mha() cannot catch. Inside a manual
+    region shapes are already per-device local, so the kernel is safe."""
+    from paddle_tpu.parallel.mesh import current_mesh
+    m = current_mesh()
+    if m is None or m.devices.size == 1:
+        return True
+    if _get_axis_env is None:
+        return False  # conservative: warned once at import above
+    return bool(_get_axis_env().axis_sizes)  # inside shard_map
+
+
+def _use_pallas(q) -> bool:
+    if _platform(q) != "tpu" or q.ndim != 4 or not _mesh_partitionable(q):
         return False
     return _gate_allows(q.shape[1])
 
@@ -88,10 +115,12 @@ def _gate_allows(T: int) -> bool:
     # at 16384 (bs=1) — and XLA + rematerialization FITS at every one of
     # those shapes, so the round-2 hypothesis that score buffers crowd
     # HBM at T>=4096 is refuted on this chip/kernel version. Auto
-    # therefore never selects the jax-shipped flash kernel; it remains an
-    # explicit opt-in (FLAGS_flash_attention=on) and the long-context
-    # scaling path is exact ring attention over the 'sp' mesh axis
-    # (ops/pallas/ring_attention.py). Full table: PROFILE.md round 3;
+    # therefore never selects the jax-shipped LEGACY flash kernel; it
+    # remains an explicit opt-in (FLAGS_flash_attention=on). The long-T
+    # single-chip path is splash_attention (_use_splash, round 4 — tuned
+    # blocks beat XLA bf16-scores 2.2x at T=4096), and long-context
+    # *scaling* is exact ring attention over the 'sp' mesh axis
+    # (ops/pallas/ring_attention.py). Full tables: PROFILE.md rounds 3-4;
     # re-measured on-chip each round by bench.py's bert_long config.
     del T
     return False
@@ -106,6 +135,16 @@ def mha(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if _use_splash(q, k, mask, causal):
+        try:
+            return _splash_mha(q, k, v, scale, causal)
+        except Exception as e:  # unsupported shape: fall back, but say so
+            import warnings
+
+            warnings.warn(f"splash_attention failed at trace time "
+                          f"({type(e).__name__}: {str(e)[:200]}); falling "
+                          f"back to the XLA path — which may not fit at "
+                          f"this shape")
     if _use_pallas(q):
         try:
             return _pallas_mha(q, k, v, mask, scale, causal)
@@ -118,6 +157,79 @@ def mha(q: jax.Array, k: jax.Array, v: jax.Array,
 def _merge_causal(mask, T):
     cm = jnp.where(jnp.tril(jnp.ones((T, T), jnp.bool_)), 0.0, -1e9)[None, None]
     return cm if mask is None else mask + cm
+
+
+# ---------------------------------------------------------------------------
+# SplashAttention (the production TPU attention kernel shipped with jax)
+# ---------------------------------------------------------------------------
+
+# Measured on v5e (tools/attn_ab.py, fwd+bwd, bf16, 12 heads, head_dim 64):
+# splash with the block sizes below beats the XLA bf16-scores path for
+# T >= _SPLASH_MIN_T on full (bidirectional) masks and at every causal
+# shape — unlike the legacy flash_attention kernel, which never won.
+_SPLASH_MIN_T = 1024
+
+
+def _use_splash(q, k, mask, causal) -> bool:
+    """Splash handles the padding-free (mask=None) and causal cases; an
+    arbitrary additive mask falls back to the XLA/legacy paths."""
+    if q.ndim != 4 or mask is not None:
+        return False  # additive masks (padding) take the XLA path
+    T, Tk, hd = q.shape[1], k.shape[1], q.shape[-1]
+    if T % 128 or Tk % 128 or hd % 64:
+        return False
+    if _platform(q) != "tpu" or not _mesh_partitionable(q):
+        return False
+    from ...core.flags import get_flag
+
+    mode = str(get_flag("FLAGS_flash_attention")).lower()
+    if mode == "splash":
+        return True
+    if mode not in ("auto",):
+        return False  # explicit on(legacy flash)/off respected
+    return T >= _SPLASH_MIN_T
+
+
+def _splash_kernel(Tq: int, Tk: int, n_heads: int, causal: bool):
+    # NOT cached: the kernel pytree holds mask-info arrays; under a vjp
+    # trace those are tracers of that trace, and caching them across
+    # traces raises UnexpectedTracerError in the backward pass. Creation
+    # is cheap (lazy Full/Causal masks process block-wise in numpy).
+    from jax.experimental.pallas.ops.tpu import splash_attention as sa
+
+    # Block sizes tuned on v5e (tools/attn_ab.py, fwd+bwd, bf16, bs=8):
+    # at T=4096 full-mask this config runs 17.0 ms vs 37.4 ms XLA
+    # bf16-scores and 114 ms with the jax default all-128 blocks; at
+    # T=8192 it is 56 ms where the XLA path cannot even compile (13 GB
+    # of score buffers). Big fwd KV blocks amortize the online-softmax
+    # rescale; bwd q-blocks stay at 512 to fit dq/dkv accumulators in
+    # VMEM.
+    bq = min(1024, Tq)
+    bkv = min(2048, Tk)
+    bqb = min(512, Tq)
+    # bwd dkv/dq kv-block: 2048 wins at T>=4096 (17.0 vs 19.0 ms), 1024
+    # wins at T<=2048 (6.8 vs 9.2 ms at T=2048)
+    bkvb = min(2048 if Tk >= 4096 else 1024, Tk)
+    sizes = sa.BlockSizes(
+        block_q=bq, block_kv=bkv, block_kv_compute=bkv,
+        block_q_dkv=bqb, block_kv_dkv=bkvb, block_kv_dkv_compute=bkvb,
+        block_q_dq=bqb, block_kv_dq=bkvb)
+    one = (sa.CausalMask((Tq, Tk)) if causal else sa.FullMask((Tq, Tk)))
+    mask = sa.MultiHeadMask([one] * n_heads)
+    return sa.make_splash_mha(mask, head_shards=1, q_seq_shards=1,
+                              block_sizes=sizes)
+
+
+def _splash_mha(q, k, v, scale, causal):
+    B, T, N, H = q.shape
+    kernel = _splash_kernel(T, k.shape[1], N, bool(causal))
+    # kernel wants [N, T, H] per example; scale is folded into q (splash
+    # applies no sm_scale itself)
+    qt = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = jax.vmap(kernel)(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
