@@ -33,7 +33,8 @@ typedef void (*del_f)(void*);
 static uint64_t lcg = 12345;
 static float frand(void) { /* uniform [-1, 1) */
   lcg = lcg * 6364136223846793005ULL + 1442695040888963407ULL;
-  return (float)((lcg >> 40) / 8388608.0 * 2.0 - 1.0);
+  /* lcg>>40 leaves 24 bits: divide by 2^24 before scaling to [-1, 1) */
+  return (float)((lcg >> 40) / 16777216.0 * 2.0 - 1.0);
 }
 
 int main(int argc, char** argv) {

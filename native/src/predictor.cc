@@ -1016,7 +1016,10 @@ static void k_elementwise_add_grad(Predictor& P, const OpDesc& op) {
     gy.resize_f(y.shape);
     int64_t axis = static_cast<int64_t>(op.attr_num(
         "axis", static_cast<double>(x.shape.size() - y.shape.size())));
-    if (axis < 0) axis += static_cast<int64_t>(x.shape.size());
+    // reference convention: a negative axis means trailing alignment,
+    // i.e. Y's dims align with X's LAST rank(Y) dims (elementwise_op.h)
+    if (axis < 0)
+      axis = static_cast<int64_t>(x.shape.size() - y.shape.size());
     int64_t pre = prod(x.shape, 0, axis);
     int64_t mid = y.numel();
     int64_t post = x.numel() / std::max<int64_t>(1, pre * mid);

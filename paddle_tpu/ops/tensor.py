@@ -541,10 +541,14 @@ def clip(ins, attrs, ctx):
 
     x = _x(ins)
     if is_selected_rows(x):
-        ids, rows, _ = x.merged()
-        return {"Out": SelectedRows(
-            jnp.clip(rows, attrs.get("min"), attrs.get("max")),
-            ids, x.height)}
+        ids, rows, is_first = x.merged()
+        clipped = jnp.clip(rows, attrs.get("min"), attrs.get("max"))
+        # merged() zeroes non-first duplicate slots but keeps their real
+        # ids; with min>0 (or max<0) those zeros would clip to a nonzero
+        # value and later scatter-add into untouched slots — re-zero them
+        clipped = jnp.where(is_first[:, None], clipped,
+                            0.0).astype(rows.dtype)
+        return {"Out": SelectedRows(clipped, ids, x.height)}
     return {"Out": jnp.clip(x, attrs.get("min"), attrs.get("max"))}
 
 

@@ -51,6 +51,21 @@ class BoxSparseCache:
         self.capacity = int(capacity_rows)
         # (table, id) -> np row; OrderedDict in LRU order (front = oldest)
         self._rows: "OrderedDict[Tuple[str, int], np.ndarray]" = OrderedDict()
+        # Read-your-writes bookkeeping (all bounded, all under _lock):
+        #   _pending     (table,id) -> pushes queued but not yet applied
+        #                on the PS (decremented after each flush RPC;
+        #                bounded by the flush queue). While >0, a PS
+        #                fetch may predate the write — don't cache it,
+        #                and don't evict the locally-updated row.
+        #   _fetching    (table,id) -> refcount of in-flight pull misses
+        #                (bounded by concurrent pull batch sizes).
+        #   _fetch_dirty keys pushed while a fetch for them was in
+        #                flight: the fetched value predates the push —
+        #                don't cache it. Cleared when the last fetcher
+        #                for the key leaves.
+        self._pending: Dict[Tuple[str, int], int] = {}
+        self._fetching: Dict[Tuple[str, int], int] = {}
+        self._fetch_dirty: set = set()
         self._lock = threading.Lock()
         self._flushq: "queue.Queue" = queue.Queue(maxsize=flush_queue_size)
         self._stop = threading.Event()
@@ -77,20 +92,30 @@ class BoxSparseCache:
         self.end_pass()
         with self._lock:
             self._rows.clear()
+            self._pending.clear()
+            self._fetch_dirty.clear()
 
     def end_pass(self):
         """Drain pending gradient flushes synchronously."""
         self._stop.set()
-        if self._flusher is not None:
-            self._flusher.join(timeout=30)
-            self._flusher = None
-        while True:
-            try:
-                name, ids, grads, lr = self._flushq.get_nowait()
-            except queue.Empty:
-                break
-            push_row_grads(self.client, name, ids, grads, lr)
-        self._stop.clear()
+        try:
+            if self._flusher is not None:
+                self._flusher.join(timeout=30)
+                self._flusher = None
+            while True:
+                try:
+                    name, ids, grads, lr = self._flushq.get_nowait()
+                except queue.Empty:
+                    break
+                try:
+                    push_row_grads(self.client, name, ids, grads, lr)
+                finally:
+                    # even on RPC failure: counts must drop or the ids
+                    # stay uncacheable/unevictable forever (the lost
+                    # gradient is the PS contract's async-push risk)
+                    self._mark_flushed(name, ids)
+        finally:
+            self._stop.clear()  # a raised drain must not brick pushes
 
     # -- pull / push ---------------------------------------------------------
 
@@ -112,32 +137,78 @@ class BoxSparseCache:
                     uniq_rows[j] = row
                 else:
                     miss_pos.append(j)
+                    # registered in the SAME critical section as the miss
+                    # scan: a push landing any time after this is seen at
+                    # insert time (via _fetch_dirty), with no window
+                    key = (name, int(rid))
+                    self._fetching[key] = self._fetching.get(key, 0) + 1
             # counters updated under the lock: concurrent trainer
             # threads must not lose increments (stats drive BENCH_CTR)
             self.misses += len(miss_pos)
             self.hits += int(ids.size - len(miss_pos))
         if miss_pos:
-            fetched = pull_rows(self.client, name, uniq[miss_pos], dim=dim)
+            # the PS fetch runs OUTSIDE the lock; a fetched value may
+            # predate a local write if the id was pushed while we
+            # fetched (_fetch_dirty) or pushed earlier with the flush
+            # still queued (_pending) — caching it would violate
+            # read-your-writes within the pass. The refcounts registered
+            # above MUST be released even if the RPC raises, or the key
+            # becomes permanently uncacheable.
+            fetched = None
+            try:
+                fetched = pull_rows(self.client, name, uniq[miss_pos],
+                                    dim=dim)
+            finally:
+                with self._lock:
+                    for j, u in enumerate(uniq[miss_pos]):
+                        key = (name, int(u))
+                        self._fetching[key] -= 1
+                        if self._fetching[key] <= 0:
+                            del self._fetching[key]
+                            dirty = key in self._fetch_dirty
+                            self._fetch_dirty.discard(key)
+                        else:
+                            dirty = key in self._fetch_dirty
+                        if fetched is None:
+                            continue  # RPC failed: bookkeeping only
+                        if dirty or self._pending.get(key, 0) > 0:
+                            continue  # may be stale: don't cache
+                        if key in self._rows:
+                            continue  # another pull populated it
+                        self._insert(name, int(u),
+                                     fetched[j].astype(np.float32))
             uniq_rows[miss_pos] = fetched
-            with self._lock:
-                for u, row in zip(uniq[miss_pos], fetched):
-                    self._insert(name, int(u), row.astype(np.float32))
         return uniq_rows[inv]
 
     def _insert(self, name: str, rid: int, row: np.ndarray):
         self._rows[(name, rid)] = row
         self._rows.move_to_end((name, rid))
         while len(self._rows) > self.capacity:
-            self._rows.popitem(last=False)     # evict the coldest
+            # evict the coldest CLEAN row: a dirty row (pending flush)
+            # holds a local update the PS doesn't have yet — evicting it
+            # would serve stale reads on the next pull. Dirty rows are
+            # bounded by the flush queue, so the overshoot is too.
+            victim = next((k for k in self._rows
+                           if self._pending.get(k, 0) == 0), None)
+            if victim is None:
+                break
+            self._rows.pop(victim)
 
     def push_sparse_grad(self, name: str, ids: np.ndarray,
                          grads: np.ndarray, lr: float = 0.01):
         ids = np.asarray(ids).reshape(-1)
         grads = np.asarray(grads, np.float32).reshape(ids.size, -1)
-        # 1) local apply: read-your-writes inside the pass
+        # 1) local apply: read-your-writes inside the pass. _pending is
+        # bumped for EVERY id (cached or not) so pulls won't cache a PS
+        # value that predates this write, and in-flight fetches for the
+        # id are marked dirty.
         with self._lock:
             for rid, g in zip(ids, grads):
-                row = self._rows.get((name, int(rid)))
+                key = (name, int(rid))
+                self._pending[key] = self._pending.get(key, 0) + 1
+                if key in self._fetching:
+                    self._fetch_dirty.add(key)
+                row = self._rows.get(key)
                 if row is not None:
                     row -= lr * g
         # 2) async flush to the PS (bounded queue back-pressures like the
@@ -151,13 +222,33 @@ class BoxSparseCache:
                 self._flusher.start()
         self._flushq.put((name, ids.copy(), grads.copy(), lr))
 
+    def _mark_flushed(self, name: str, ids: np.ndarray):
+        """The PS has applied this batch: drop its _pending marks."""
+        with self._lock:
+            for rid in ids:
+                key = (name, int(rid))
+                n = self._pending.get(key, 0) - 1
+                if n <= 0:
+                    self._pending.pop(key, None)
+                else:
+                    self._pending[key] = n
+
     def _flush_loop(self):
         while not self._stop.is_set():
             try:
                 name, ids, grads, lr = self._flushq.get(timeout=0.05)
             except queue.Empty:
                 continue
-            push_row_grads(self.client, name, ids, grads, lr)
+            try:
+                push_row_grads(self.client, name, ids, grads, lr)
+            except Exception as e:  # keep the flusher alive; drop marks
+                import warnings
+
+                warnings.warn(f"box-cache flush RPC failed "
+                              f"({type(e).__name__}: {str(e)[:120]}); "
+                              f"gradient batch dropped")
+            finally:
+                self._mark_flushed(name, ids)
 
 
 _BOX: Optional[BoxSparseCache] = None

@@ -52,10 +52,18 @@ class PostTrainingQuantization:
     `save_model_path`)."""
 
     def __init__(self, model_dir: str, save_model_path: Optional[str] = None,
-                 quantizable_op_type: Optional[Sequence[str]] = None):
+                 quantizable_op_type: Optional[Sequence[str]] = None,
+                 quantizable_var_names: Optional[Sequence[str]] = None):
+        """quantizable_var_names: when given, quantize ONLY these weight
+        vars (callers that rewrite a subset of ops — calibrate_and_
+        quantize — must restrict the pass to the weights they rewrite;
+        quantizing a weight a skipped op still reads deletes the fp32
+        .npy that op needs in the native predictor)."""
         self.model_dir = model_dir
         self.save_path = save_model_path or model_dir
         self.op_types = set(quantizable_op_type or QUANT_OPS)
+        self.var_names = (None if quantizable_var_names is None
+                          else set(quantizable_var_names))
 
     def quantize(self) -> Dict[str, float]:
         """Returns {var_name: compression_ratio}."""
@@ -73,6 +81,8 @@ class PostTrainingQuantization:
                 if op.type not in self.op_types or slot is None:
                     continue
                 for n in op.inputs.get(slot, []):
+                    if self.var_names is not None and n not in self.var_names:
+                        continue
                     v = b.vars.get(n)
                     if v is not None and v.persistable:
                         targets[n] = op.type
@@ -244,9 +254,28 @@ def calibrate_and_quantize(model_dir: str, calibration_reader,
                   for n, m in amax.items()}
 
     # -- 2. weight quantization --------------------------------------------
+    # A weight read by any op OUTSIDE the rewrite set (a skipped
+    # quantizable op — grouped/dilated conv, transposed/non-2D matmul —
+    # or a non-quantizable consumer) must stay fp32 end to end: the
+    # native predictor loads persistables strictly from '<name>.npy',
+    # so quantizing it would delete the file that op still needs.
+    rewrite_idx = {t[0] for t in targets}
+    weight_of = {t[0]: t[2] for t in targets}
+    fp32_needed = set()
+    # scan ALL blocks: the rewrite touches block 0 only, so an op in a
+    # control-flow sub-block reading a shared weight also pins it fp32
+    for bi, blk in enumerate(program.desc.blocks):
+        for j, op in enumerate(blk.ops):
+            rewritten = bi == 0 and j in rewrite_idx
+            for slot, ns in op.inputs.items():
+                for n in ns:
+                    if not rewritten or n != weight_of.get(j):
+                        fp32_needed.add(n)
+    targets = [t for t in targets if t[2] not in fp32_needed]
     PostTrainingQuantization(
         model_dir, save_path,
-        quantizable_op_type=[t for t in op_types]).quantize()
+        quantizable_op_type=[t for t in op_types],
+        quantizable_var_names=[t[2] for t in targets]).quantize()
 
     # -- 3. program rewrite -------------------------------------------------
     model_path = os.path.join(save_path, "__model__")

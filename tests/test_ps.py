@@ -533,6 +533,89 @@ def test_box_cache_concurrent_trainers():
         s.stop()
 
 
+def test_box_cache_pull_push_race_read_your_writes():
+    """ADVICE r3: a push_sparse_grad landing while pull_sparse is mid-
+    fetch (lock released around the PS RPC) must not leave the fetched
+    PRE-update row in the cache — that is a read-your-writes violation
+    within the pass. The push is injected deterministically inside a
+    monkeypatched pull_rows, exactly in the unlocked window."""
+    from paddle_tpu.ps import ParameterServer, PSClient
+    from paddle_tpu.ps import box_cache as bc
+    from paddle_tpu.ps.sparse_table import init_sparse_table, pull_rows
+
+    (p1,) = _free_ports(1)
+    eps = [f"127.0.0.1:{p1}"]
+    server = ParameterServer(eps[0], num_trainers=1, mode="async")
+    server.start_background()
+    client = PSClient(eps)
+    V, D, LR = 8, 4, 0.5
+    init_sparse_table(client, "race_table", np.zeros((V, D), np.float32))
+    box = bc.BoxSparseCache(client, capacity_rows=V)
+
+    real_pull_rows = bc.pull_rows
+    raced = {"done": False}
+
+    def racing_pull_rows(cl, name, ids, dim):
+        out = real_pull_rows(cl, name, ids, dim=dim)
+        if not raced["done"]:
+            raced["done"] = True
+            # the id-3 row is NOT cached yet: this local apply is
+            # skipped, and only the push generation records the write
+            box.push_sparse_grad(name, np.array([3]),
+                                 np.ones((1, D), np.float32), lr=LR)
+        return out
+
+    bc.pull_rows = racing_pull_rows
+    try:
+        got = box.pull_sparse("race_table", np.array([3]), D)
+    finally:
+        bc.pull_rows = real_pull_rows
+    assert raced["done"]
+    # the pre-update fetched value is returned (the fetch predates the
+    # push) but must NOT be cached: a cached 0-row would serve stale
+    # reads for the rest of the pass
+    np.testing.assert_allclose(got, np.zeros((1, D)))
+    assert ("race_table", 3) not in box._rows, \
+        "stale pre-update row cached across a racing push"
+    # after the flush drains, the next pull sees the pushed update
+    box.end_pass()
+    np.testing.assert_allclose(
+        box.pull_sparse("race_table", np.array([3]), D),
+        np.full((1, D), -LR), rtol=1e-6)
+
+    # eviction protection: a DIRTY row (its flush still queued) must not
+    # be evicted by capacity pressure — a re-pull before the flush lands
+    # would cache the pre-update server value. Blocking the flush RPC
+    # makes the window deterministic.
+    import threading
+
+    gate = threading.Event()
+    real_push = bc.push_row_grads
+
+    def blocked_push(cl, name, ids, grads, lr):
+        gate.wait(timeout=30)
+        return real_push(cl, name, ids, grads, lr)
+
+    small = bc.BoxSparseCache(client, capacity_rows=2)
+    small.pull_sparse("race_table", np.array([0]), D)
+    bc.push_row_grads = blocked_push
+    try:
+        small.push_sparse_grad("race_table", np.array([0]),
+                               np.ones((1, D), np.float32), lr=LR)
+        # row 0 is dirty; pulling 4 more ids would normally evict it
+        small.pull_sparse("race_table", np.array([4, 5, 6, 7]), D)
+        assert ("race_table", 0) in small._rows, \
+            "dirty row evicted while its flush was still queued"
+        got0 = small.pull_sparse("race_table", np.array([0]), D)
+        np.testing.assert_allclose(got0, np.full((1, D), -LR), rtol=1e-6)
+    finally:
+        gate.set()
+        bc.push_row_grads = real_push
+    small.end_pass()
+    assert not small._pending, small._pending
+    server.stop()
+
+
 def test_downpour_style_ctr_training(tmp_path):
     """Downpour-worker flow (reference: DownpourWorker loop,
     downpour_worker.cc:611 — DataFeed batch → pull sparse → compute →

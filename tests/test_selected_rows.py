@@ -160,6 +160,13 @@ def test_clip_kernels_on_selected_rows():
     assert isinstance(out, SelectedRows)
     # merged row 2 = [6,-4] then clipped
     np.testing.assert_allclose(np.asarray(out.to_dense()[2]), [1.0, -1.0])
+    # duplicate ids + min>0: merged() zeroes non-first duplicate slots;
+    # clip must NOT lift those zeros to `min` (they would scatter-add
+    # into the duplicate's real row, corrupting it — ADVICE r3)
+    outp = _call("clip", {"X": [sr]}, {"min": 0.5, "max": 10.0})["Out"][0]
+    dense = np.asarray(outp.to_dense())
+    np.testing.assert_allclose(dense[2], [6.0, 0.5])  # clip([6,-4]) once
+    assert np.all(dense[[1, 3, 4, 5]] == 0)           # untouched rows
     out2 = _call("clip_by_norm", {"X": [sr]}, {"max_norm": 1.0})["Out"][0]
     assert isinstance(out2, SelectedRows)
     merged = sr.to_dense()

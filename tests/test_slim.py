@@ -367,6 +367,57 @@ def test_int8_calibration_end_to_end(tmp_path, rng):
     np.testing.assert_allclose(out_nat, out_xla, atol=1e-5)
 
 
+def test_int8_calibration_keeps_skipped_op_weights_fp32(tmp_path, rng):
+    """ADVICE r3 (medium): a quantizable-typed op that the rewrite skips
+    (here a grouped conv) must keep its fp32 .npy on disk — the native
+    C++ predictor loads persistables strictly from '<name>.npy', so
+    quantizing a weight a skipped op still reads breaks PD_NewPredictor.
+    Both engines must load and agree on the mixed int8/fp32 model."""
+    from paddle_tpu.slim.quantization import calibrate_and_quantize
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4, 12, 12], dtype="float32")
+        c = pt.layers.conv2d(input=x, num_filters=4, filter_size=3,
+                             groups=2, act="relu")  # grouped: rewrite skips
+        pred = pt.layers.fc(input=c, size=4, act="softmax")
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        X = rng.rand(8, 4, 12, 12).astype("float32")
+        d = str(tmp_path)
+        pt.io.save_inference_model(d, ["x"], [pred], exe,
+                                   main_program=main)
+
+    def reader():
+        for i in range(4):
+            yield {"x": X[i * 2:(i + 1) * 2]}
+
+    calibrate_and_quantize(d, reader)
+    import json
+
+    with open(os.path.join(d, "__model__")) as f:
+        payload = json.load(f)
+    b0 = payload["program"]["blocks"][0]
+    types = [op["type"] for op in b0["ops"]]
+    assert "quantized_mul" in types          # fc weight went int8
+    assert "conv2d" in types                 # grouped conv stayed fp32
+    assert "quantized_conv2d" not in types
+    conv = next(op for op in b0["ops"] if op["type"] == "conv2d")
+    wname = conv["inputs"]["Filter"][0]
+    assert os.path.exists(os.path.join(d, wname + ".npy")), \
+        "skipped op's fp32 weight file must survive the PTQ pass"
+    with open(os.path.join(d, "__quant_meta__.json")) as f:
+        assert wname not in json.load(f)
+
+    p = pt.create_paddle_predictor(pt.AnalysisConfig(d))
+    out_xla = list(p.predict(x=X).values())[0]
+    cfg = pt.AnalysisConfig(d)
+    cfg.enable_native_engine()
+    out_nat = list(pt.create_paddle_predictor(cfg).predict(x=X).values())[0]
+    np.testing.assert_allclose(out_nat, out_xla, atol=1e-5)
+
+
 def test_int8_model_zoo_serving_path(rng):
     """Model-level INT8 serving (models/common.quantize_conv_weights_int8):
     tiny ResNet forward with int8 conv weights + dynamic activation
