@@ -86,8 +86,12 @@ def _conv(params, name, x, stride=1, padding="SAME"):
 def _bn(params, state_updates, name, x, cfg, train: bool):
     """BN in fp32; updates running stats into state_updates when training.
     When the batch axis is sharded over 'dp', XLA computes the mean/var with
-    a cross-device reduction — sync-BN semantics by construction."""
-    xf = x.astype(jnp.float32)
+    a cross-device reduction — sync-BN semantics by construction.
+
+    Stats promote to f64 for f64 activations (x64 test runs): the one-pass
+    E[x^2]-E[x]^2 form has f32 cancellation noise that changes with shard
+    summation order, which would mask dp-vs-single parity checks."""
+    xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
     if train:
         # one-pass stats: E[x] and E[x^2] fuse into a single read of the
         # activations (jnp.var's (x-mean)^2 forces a second pass; measured

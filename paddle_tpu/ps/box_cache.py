@@ -109,6 +109,15 @@ class BoxSparseCache:
                     break
                 try:
                     push_row_grads(self.client, name, ids, grads, lr)
+                except Exception as e:  # keep draining the remaining
+                    # batches and let begin_pass still invalidate — an
+                    # aborted drain would leave ids uncacheable and skip
+                    # the cache clear (same policy as _flush_loop)
+                    import warnings
+
+                    warnings.warn(f"box-cache end_pass flush RPC failed "
+                                  f"({type(e).__name__}: {str(e)[:120]}); "
+                                  f"gradient batch dropped")
                 finally:
                     # even on RPC failure: counts must drop or the ids
                     # stay uncacheable/unevictable forever (the lost

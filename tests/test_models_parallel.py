@@ -81,6 +81,55 @@ def test_resnet_trains_with_bn_state():
     assert float(jnp.abs(state.params["stem.bn.mean"]).sum()) > 0
 
 
+def test_resnet_dp_matches_single_device_sync_bn():
+    """BASELINE config 5 correctness (VERDICT r3 #3): a conv+BN model
+    trained dp-sharded over 8 devices must produce the SAME losses as
+    the single-device run on the same global batch — this is exactly
+    the sync-BN-via-GSPMD claim (ops/nn.py batch_norm NOTE): the BN
+    batch reductions are global, i.e. per-device batch statistics do
+    NOT diverge from the global ones (reference needs
+    BuildStrategy.sync_batch_norm + sync_batch_norm_op.cu).
+
+    f64 end-to-end isolates the property: per-device BN stats would be a
+    STRUCTURAL divergence (each device normalizing by 2-sample instead of
+    16-sample statistics) visible at any precision, while at f32 the
+    shard summation order perturbs the one-pass E[x^2]-E[x]^2 variance by
+    ~1e-6 and ReLU-kink subgradient flips amplify that to percent-level
+    loss divergence within 2 steps (measured; see _bn's docstring). At
+    f64 the trajectories agree to ~1e-7 for 3 full steps."""
+    import dataclasses
+
+    cfg = dataclasses.replace(resnet.ResNetConfig.tiny(), dtype="float64")
+    batch = resnet.make_batch(jax.random.key(1), cfg, 16, hw=32)
+    batch["img"] = batch["img"].astype(jnp.float64)
+
+    def run(mesh):
+        params, axes = resnet.init(jax.random.key(0), cfg)
+        with mesh_guard(mesh):
+            init_state, step = make_train_step(
+                lambda p, b, r: resnet.loss_fn(p, cfg, b, r),
+                optax.sgd(0.05, momentum=0.9), mesh, axes, has_aux=True)
+            state = init_state(params)
+            losses = []
+            for i in range(3):
+                state, loss = step(state, batch, jax.random.key(10 + i))
+                losses.append(float(loss))
+            bn_mean = np.asarray(state.params["stem.bn.mean"], np.float64)
+        return losses, bn_mean
+
+    dp_losses, dp_bn = run(make_mesh(MeshConfig(dp=8)))
+    ref_losses, ref_bn = run(make_mesh(MeshConfig(dp=1),
+                                       devices=jax.devices()[:1]))
+    # step-for-step trajectory parity: unsynced BN is an O(1) structural
+    # difference; the 1e-5 bound leaves 2 orders of headroom over the
+    # measured 1e-7 numerical floor
+    np.testing.assert_allclose(dp_losses, ref_losses, rtol=1e-5)
+    # the running BN statistics agree too: they are the direct sync-BN
+    # observable (per-shard means would differ from the global mean)
+    np.testing.assert_allclose(dp_bn, ref_bn, rtol=1e-5, atol=1e-8)
+    assert dp_losses[-1] < dp_losses[0]
+
+
 def test_resnet_nhwc_matches_nchw():
     """The NHWC-native path (TPU bench path) and the NCHW reference-API
     shim compute identical logits for the same image content."""
