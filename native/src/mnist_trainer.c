@@ -1,0 +1,200 @@
+/* Python-free CONV training demo (pure C).
+ *
+ * Reference capability: paddle/fluid/train/test_train_recognize_digits.cc
+ * — load a Python-authored MNIST conv training program and train it
+ * entirely from native code. This drives the same PD_Trainer* C ABI as
+ * demo_trainer.c, but through the conv kernel set (conv2d/pool2d/
+ * softmax_with_cross_entropy and their grads, plus top_k/accuracy).
+ *
+ * Data: either a synthetic 10-class digit-prototype stream generated in
+ * C (one fixed random 28x28 prototype per class, samples = prototype +
+ * noise), or — the reference's imdb_demo pattern
+ * (train/imdb_demo/demo_trainer.cc drives the C++ DataFeed) — records
+ * streamed from a data FILE through the native datafeed library
+ * (libptio.so: reader threads, channel, shuffle buffer), with the file
+ * listed once per epoch. A LeNet must drive the softmax loss < 0.2 and
+ * top-1 train accuracy > 93%, the test_train_recognize_digits.cc bar.
+ *
+ * Build: gcc -O2 mnist_trainer.c -o mnist_trainer -ldl
+ * Usage: ./mnist_trainer <model_dir> <libptpred.so> [acc_var]
+ *                        [libptio.so datafile]   (feed mode)
+ * Exit:  0 on converged (mean recent loss < 0.2, recent accuracy > 0.93).
+ */
+
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#define HW 28
+#define NCLS 10
+#define BATCH 64
+#define STEPS 150
+#define TAIL 10 /* steps averaged for the convergence check */
+
+typedef void* (*new_trainer_f)(const char*);
+typedef const char* (*err_f)(void*);
+typedef int (*startup_f)(void*);
+typedef int (*step_f)(void*, const char**, const void**, const int64_t**,
+                      const int*, const int*, int, float*);
+typedef int64_t (*get_f)(void*, const char*, float*, int64_t);
+typedef void (*del_f)(void*);
+
+/* native datafeed (libptio.so) */
+typedef void* (*dfc_f)(void);
+typedef void (*dffl_f)(void*, const char**, int);
+typedef void (*dfsl_f)(void*, const int64_t*, int);
+typedef void (*dfbs_f)(void*, int);
+typedef void (*dfsh_f)(void*, int, uint64_t);
+typedef int (*dfst_f)(void*);
+typedef int (*dfnb_f)(void*, float*);
+typedef void (*dfd_f)(void*);
+
+static uint64_t lcg = 777;
+static float frand(void) { /* uniform [-1, 1) */
+  lcg = lcg * 6364136223846793005ULL + 1442695040888963407ULL;
+  return (float)((lcg >> 40) / 16777216.0 * 2.0 - 1.0);
+}
+static uint32_t urand(uint32_t n) {
+  lcg = lcg * 6364136223846793005ULL + 1442695040888963407ULL;
+  return (uint32_t)((lcg >> 33) % n);
+}
+
+static float proto[NCLS][HW * HW];
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: %s <model_dir> <libptpred.so> [acc_var]\n",
+            argv[0]);
+    return 2;
+  }
+  const char* acc_var = argc > 3 ? argv[3] : "train_acc";
+  void* lib = dlopen(argv[2], RTLD_NOW);
+  if (!lib) {
+    fprintf(stderr, "dlopen failed: %s\n", dlerror());
+    return 2;
+  }
+  new_trainer_f PD_NewTrainer = (new_trainer_f)dlsym(lib, "PD_NewTrainer");
+  err_f PD_TrainerError = (err_f)dlsym(lib, "PD_TrainerError");
+  startup_f PD_TrainerRunStartup =
+      (startup_f)dlsym(lib, "PD_TrainerRunStartup");
+  step_f PD_TrainerRunStep = (step_f)dlsym(lib, "PD_TrainerRunStep");
+  get_f PD_TrainerGetParam = (get_f)dlsym(lib, "PD_TrainerGetParam");
+  del_f PD_DeleteTrainer = (del_f)dlsym(lib, "PD_DeleteTrainer");
+  if (!PD_NewTrainer || !PD_TrainerRunStep || !PD_TrainerGetParam) {
+    fprintf(stderr, "missing PD_Trainer symbols\n");
+    return 2;
+  }
+
+  void* t = PD_NewTrainer(argv[1]);
+  if (PD_TrainerError(t)[0]) {
+    fprintf(stderr, "load failed: %s\n", PD_TrainerError(t));
+    return 2;
+  }
+  if (PD_TrainerRunStartup(t) != 0) {
+    fprintf(stderr, "startup failed: %s\n", PD_TrainerError(t));
+    return 2;
+  }
+
+  /* optional feed mode: stream records through the native datafeed */
+  void* feed = NULL;
+  dfnb_f ptio_next_batch = NULL;
+  dfd_f ptio_destroy = NULL;
+  static float rec[BATCH * (HW * HW + 1)];
+  if (argc > 5) {
+    void* iolib = dlopen(argv[4], RTLD_NOW);
+    if (!iolib) {
+      fprintf(stderr, "dlopen(libptio) failed: %s\n", dlerror());
+      return 2;
+    }
+    dfc_f create = (dfc_f)dlsym(iolib, "ptio_create");
+    dffl_f set_filelist = (dffl_f)dlsym(iolib, "ptio_set_filelist");
+    dfsl_f set_slots = (dfsl_f)dlsym(iolib, "ptio_set_slots");
+    dfbs_f set_bs = (dfbs_f)dlsym(iolib, "ptio_set_batch_size");
+    dfsh_f set_shuffle = (dfsh_f)dlsym(iolib, "ptio_set_shuffle");
+    dfst_f start = (dfst_f)dlsym(iolib, "ptio_start");
+    ptio_next_batch = (dfnb_f)dlsym(iolib, "ptio_next_batch");
+    ptio_destroy = (dfd_f)dlsym(iolib, "ptio_destroy");
+    if (!create || !start || !ptio_next_batch) {
+      fprintf(stderr, "missing ptio symbols\n");
+      return 2;
+    }
+    feed = create();
+    /* the same file listed once per pass = epochs (reference:
+     * Dataset::SetFileList semantics) */
+    const char* files[16];
+    int n_epochs = 8;
+    for (int e = 0; e < n_epochs; ++e) files[e] = argv[5];
+    set_filelist(feed, files, n_epochs);
+    int64_t slots[2] = {HW * HW, 1};
+    set_slots(feed, slots, 2);
+    set_bs(feed, BATCH);
+    set_shuffle(feed, 512, 7);
+    if (start(feed) != 0) {
+      fprintf(stderr, "ptio_start failed\n");
+      return 2;
+    }
+  }
+
+  /* class prototypes: smooth blobs so conv filters have structure to find */
+  for (int c = 0; c < NCLS; ++c)
+    for (int i = 0; i < HW * HW; ++i) proto[c][i] = frand();
+
+  static float x[BATCH][1][HW][HW];
+  static int64_t y[BATCH][1];
+  const char* names[2] = {"img", "label"};
+  const void* datas[2] = {x, y};
+  int64_t xshape[4] = {BATCH, 1, HW, HW}, yshape[2] = {BATCH, 1};
+  const int64_t* shapes[2] = {xshape, yshape};
+  int ndims[2] = {4, 2};
+  int dtypes[2] = {0, 1}; /* f32 imgs, i64 labels */
+
+  float first = -1.f, loss = 0.f, acc = 0.f;
+  float loss_ring[TAIL] = {0}, acc_ring[TAIL] = {0};
+  double tail_loss = 0, tail_acc = 0;
+  int steps_done = 0;
+  for (int s = 0; s < STEPS; ++s) {
+    if (feed) {
+      int got = ptio_next_batch(feed, rec);
+      if (got < BATCH) break; /* stream exhausted */
+      for (int i = 0; i < BATCH; ++i) {
+        const float* r = rec + i * (HW * HW + 1);
+        for (int j = 0; j < HW * HW; ++j) ((float*)x[i])[j] = r[j];
+        y[i][0] = (int64_t)r[HW * HW];
+      }
+    } else {
+      for (int i = 0; i < BATCH; ++i) {
+        int c = (int)urand(NCLS);
+        y[i][0] = c;
+        for (int j = 0; j < HW * HW; ++j)
+          ((float*)x[i])[j] = proto[c][j] + 0.35f * frand();
+      }
+    }
+    if (PD_TrainerRunStep(t, names, datas, shapes, ndims, dtypes, 2,
+                          &loss) != 0) {
+      fprintf(stderr, "step %d failed: %s\n", s, PD_TrainerError(t));
+      return 2;
+    }
+    if (PD_TrainerGetParam(t, acc_var, &acc, 1) != 1) {
+      fprintf(stderr, "missing accuracy var '%s'\n", acc_var);
+      return 2;
+    }
+    if (s == 0) first = loss;
+    loss_ring[s % TAIL] = loss;
+    acc_ring[s % TAIL] = acc;
+    ++steps_done;
+  }
+  int tail_n = steps_done < TAIL ? steps_done : TAIL;
+  for (int i = 0; i < tail_n; ++i) {
+    tail_loss += loss_ring[i];
+    tail_acc += acc_ring[i];
+  }
+  tail_loss /= tail_n > 0 ? tail_n : 1;
+  tail_acc /= tail_n > 0 ? tail_n : 1;
+  printf("first_loss=%.6f last_loss=%.6f last_acc=%.4f steps=%d\n", first,
+         tail_loss, tail_acc, steps_done);
+  if (feed && ptio_destroy) ptio_destroy(feed);
+  PD_DeleteTrainer(t);
+  dlclose(lib);
+  return (tail_n == TAIL && tail_loss < 0.2 && tail_acc > 0.93) ? 0 : 1;
+}

@@ -1076,6 +1076,276 @@ static void k_mul_grad(Predictor& P, const OpDesc& op) {
   }
 }
 
+static void k_relu_grad(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("fwd_in::X"));
+  const Tensor& og = var(P, op.in("out_grad::Out"));
+  Tensor& gx = P.scope[op.out("in_grad::X")];
+  gx.resize_f(x.shape);
+  for (int64_t i = 0; i < x.numel(); ++i)
+    gx.f[i] = x.f[i] > 0.f ? og.f[i] : 0.f;
+}
+
+static void k_softmax_with_cross_entropy(Predictor& P, const OpDesc& op) {
+  // reference: softmax_with_cross_entropy_op.cc (hard labels, last axis)
+  const Tensor& logits = var(P, op.in("Logits"));
+  const Tensor& label = var(P, op.in("Label"));
+  if (op.attr_num("soft_label", 0) != 0)
+    throw std::runtime_error(
+        "softmax_with_cross_entropy: soft_label unsupported in the native "
+        "trainer");
+  int64_t rank = static_cast<int64_t>(logits.shape.size());
+  int64_t axis = static_cast<int64_t>(op.attr_num("axis", -1));
+  if (axis != -1 && axis != rank - 1)
+    throw std::runtime_error(
+        "softmax_with_cross_entropy: only the last axis is supported in "
+        "the native trainer (got axis=" + std::to_string(axis) + ")");
+  int64_t d = logits.shape.back();
+  int64_t rows = logits.numel() / d;
+  Tensor& soft = P.scope[op.out("Softmax")];
+  soft.resize_f(logits.shape);
+  Tensor& loss = P.scope[op.out("Loss")];
+  std::vector<int64_t> lshape(logits.shape.begin(), logits.shape.end());
+  lshape.back() = 1;
+  loss.resize_f(lshape);
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xi = logits.f.data() + r * d;
+    float* si = soft.f.data() + r * d;
+    float mx = xi[0];
+    for (int64_t j = 1; j < d; ++j) mx = std::max(mx, xi[j]);
+    double sum = 0;
+    for (int64_t j = 0; j < d; ++j) {
+      si[j] = std::exp(xi[j] - mx);
+      sum += si[j];
+    }
+    float inv = static_cast<float>(1.0 / sum);
+    for (int64_t j = 0; j < d; ++j) si[j] *= inv;
+    int64_t l = label.i[r];
+    if (l < 0 || l >= d)
+      throw std::runtime_error(
+          "softmax_with_cross_entropy: label " + std::to_string(l) +
+          " out of range [0, " + std::to_string(d) + ") at row " +
+          std::to_string(r));
+    loss.f[r] = -std::log(std::max(si[l], 1e-30f));
+  }
+}
+
+static void k_softmax_with_cross_entropy_grad(Predictor& P,
+                                              const OpDesc& op) {
+  // dLogits = dLoss * (softmax - onehot(label)); softmax recomputed
+  // from the logits (numerically stable, independent of whether the
+  // Softmax intermediate survived serialization)
+  const Tensor& logits = var(P, op.in("fwd_in::Logits"));
+  const Tensor& label = var(P, op.in("fwd_in::Label"));
+  const Tensor& og = var(P, op.in("out_grad::Loss"));
+  if (!op.in("out_grad::Softmax").empty())
+    throw std::runtime_error(
+        "softmax_with_cross_entropy_grad: a gradient flowing into the "
+        "Softmax output (return_softmax=True feeding a differentiable "
+        "term) is unsupported in the native trainer");
+  Tensor& gx = P.scope[op.out("in_grad::Logits")];
+  gx.resize_f(logits.shape);
+  int64_t d = logits.shape.back();
+  int64_t rows = logits.numel() / d;
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xi = logits.f.data() + r * d;
+    float* gi = gx.f.data() + r * d;
+    float mx = xi[0];
+    for (int64_t j = 1; j < d; ++j) mx = std::max(mx, xi[j]);
+    double sum = 0;
+    for (int64_t j = 0; j < d; ++j) sum += std::exp(xi[j] - mx);
+    float g = og.f[r];
+    int64_t l = label.i[r];
+    if (l < 0 || l >= d)
+      throw std::runtime_error(
+          "softmax_with_cross_entropy_grad: label out of range");
+    float inv = static_cast<float>(1.0 / sum);
+    for (int64_t j = 0; j < d; ++j)
+      gi[j] = g * (std::exp(xi[j] - mx) * inv - (j == l ? 1.f : 0.f));
+  }
+}
+
+static void k_pool2d_grad(Predictor& P, const OpDesc& op) {
+  const Tensor& x = var(P, op.in("fwd_in::X"));
+  const Tensor& og = var(P, op.in("out_grad::Out"));
+  std::string ptype = op.attr_str("pooling_type", "max");
+  auto ksize = op.attr_ints("ksize");
+  auto strides = op.attr_ints("strides");
+  auto pads = op.attr_ints("paddings");
+  bool global = op.attr_num("global_pooling", 0) != 0;
+  if (strides.empty()) strides = ksize;
+  if (pads.empty()) pads = {0, 0};
+  int64_t N = x.shape[0], C = x.shape[1], H = x.shape[2], W = x.shape[3];
+  if (global) {
+    ksize = {H, W};
+    strides = {H, W};
+    pads = {0, 0};
+  }
+  int64_t HO = (H + 2 * pads[0] - ksize[0]) / strides[0] + 1;
+  int64_t WO = (W + 2 * pads[1] - ksize[1]) / strides[1] + 1;
+  bool exclusive = op.attr_num("exclusive", 1) != 0;
+  Tensor& gx = P.scope[op.out("in_grad::X")];
+  gx.resize_f(x.shape);
+  std::fill(gx.f.begin(), gx.f.end(), 0.f);
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t c = 0; c < C; ++c)
+      for (int64_t oh = 0; oh < HO; ++oh)
+        for (int64_t ow = 0; ow < WO; ++ow) {
+          float g = og.f[((n * C + c) * HO + oh) * WO + ow];
+          if (ptype == "max") {
+            // route to the FIRST maximal element (scan order), the
+            // reference/XLA tie-break
+            float best = -3.4e38f;
+            int64_t bi = -1;
+            for (int64_t kh = 0; kh < ksize[0]; ++kh)
+              for (int64_t kw = 0; kw < ksize[1]; ++kw) {
+                int64_t ih = oh * strides[0] - pads[0] + kh;
+                int64_t iw = ow * strides[1] - pads[1] + kw;
+                if (ih < 0 || ih >= H || iw < 0 || iw >= W) continue;
+                int64_t idx = ((n * C + c) * H + ih) * W + iw;
+                if (x.f[idx] > best) {
+                  best = x.f[idx];
+                  bi = idx;
+                }
+              }
+            if (bi >= 0) gx.f[bi] += g;
+          } else {
+            int64_t cnt = 0;
+            for (int64_t kh = 0; kh < ksize[0]; ++kh)
+              for (int64_t kw = 0; kw < ksize[1]; ++kw) {
+                int64_t ih = oh * strides[0] - pads[0] + kh;
+                int64_t iw = ow * strides[1] - pads[1] + kw;
+                if (ih >= 0 && ih < H && iw >= 0 && iw < W) ++cnt;
+              }
+            int64_t denom = exclusive ? cnt : ksize[0] * ksize[1];
+            float share = g / static_cast<float>(denom ? denom : 1);
+            for (int64_t kh = 0; kh < ksize[0]; ++kh)
+              for (int64_t kw = 0; kw < ksize[1]; ++kw) {
+                int64_t ih = oh * strides[0] - pads[0] + kh;
+                int64_t iw = ow * strides[1] - pads[1] + kw;
+                if (ih < 0 || ih >= H || iw < 0 || iw >= W) continue;
+                gx.f[((n * C + c) * H + ih) * W + iw] += share;
+              }
+          }
+        }
+}
+
+static void k_conv2d_grad(Predictor& P, const OpDesc& op) {
+  // dInput + dFilter for the plain/grouped NCHW conv (reference:
+  // conv_grad kernels in operators/conv_op.h, direct-loop form)
+  const Tensor& x = var(P, op.in("fwd_in::Input"));
+  const Tensor& w = var(P, op.in("fwd_in::Filter"));
+  const Tensor& og = var(P, op.in("out_grad::Output"));
+  auto strides = op.attr_ints("strides");
+  auto pads = op.attr_ints("paddings");
+  auto dil = op.attr_ints("dilations");
+  int64_t g = static_cast<int64_t>(op.attr_num("groups", 1));
+  if (strides.empty()) strides = {1, 1};
+  if (pads.empty()) pads = {0, 0};
+  if (dil.empty()) dil = {1, 1};
+  int64_t N = x.shape[0], C = x.shape[1], H = x.shape[2], W = x.shape[3];
+  int64_t O = w.shape[0], KH = w.shape[2], KW = w.shape[3];
+  if (op.type == "depthwise_conv2d_grad") g = C;
+  int64_t HO = og.shape[2], WO = og.shape[3];
+  int64_t cg = C / g, ogrp = O / g;
+  bool want_gx = !op.out("in_grad::Input").empty();
+  bool want_gw = !op.out("in_grad::Filter").empty();
+  Tensor* gx = nullptr;
+  Tensor* gw = nullptr;
+  if (want_gx) {
+    gx = &P.scope[op.out("in_grad::Input")];
+    gx->resize_f(x.shape);
+    std::fill(gx->f.begin(), gx->f.end(), 0.f);
+  }
+  if (want_gw) {
+    gw = &P.scope[op.out("in_grad::Filter")];
+    gw->resize_f(w.shape);
+    std::fill(gw->f.begin(), gw->f.end(), 0.f);
+  }
+  for (int64_t n = 0; n < N; ++n)
+    for (int64_t oc = 0; oc < O; ++oc) {
+      int64_t grp = oc / ogrp;
+      for (int64_t oh = 0; oh < HO; ++oh)
+        for (int64_t ow = 0; ow < WO; ++ow) {
+          float go = og.f[((n * O + oc) * HO + oh) * WO + ow];
+          if (go == 0.f) continue;
+          for (int64_t ic = 0; ic < cg; ++ic) {
+            int64_t c = grp * cg + ic;
+            for (int64_t kh = 0; kh < KH; ++kh) {
+              int64_t ih = oh * strides[0] - pads[0] + kh * dil[0];
+              if (ih < 0 || ih >= H) continue;
+              for (int64_t kw = 0; kw < KW; ++kw) {
+                int64_t iw = ow * strides[1] - pads[1] + kw * dil[1];
+                if (iw < 0 || iw >= W) continue;
+                int64_t xi = ((n * C + c) * H + ih) * W + iw;
+                int64_t wi = ((oc * cg + ic) * KH + kh) * KW + kw;
+                if (gx) gx->f[xi] += go * w.f[wi];
+                if (gw) gw->f[wi] += go * x.f[xi];
+              }
+            }
+          }
+        }
+    }
+}
+
+static void k_top_k(Predictor& P, const OpDesc& op) {
+  // reference: top_k_op.cc — values+indices of the k largest, descending
+  const Tensor& x = var(P, op.in("X"));
+  int64_t k = static_cast<int64_t>(op.attr_num("k", 1));
+  int64_t d = x.shape.back();
+  int64_t rows = x.numel() / d;
+  k = std::min(k, d);
+  Tensor& vals = P.scope[op.out("Out")];
+  Tensor& idxs = P.scope[op.out("Indices")];
+  std::vector<int64_t> oshape(x.shape.begin(), x.shape.end());
+  oshape.back() = k;
+  vals.resize_f(oshape);
+  idxs.resize_i(oshape);
+  std::vector<int64_t> order(d);
+  for (int64_t r = 0; r < rows; ++r) {
+    const float* xi = x.f.data() + r * d;
+    for (int64_t j = 0; j < d; ++j) order[j] = j;
+    std::partial_sort(order.begin(), order.begin() + k, order.end(),
+                      [&](int64_t a, int64_t b) {
+                        return xi[a] != xi[b] ? xi[a] > xi[b] : a < b;
+                      });
+    for (int64_t j = 0; j < k; ++j) {
+      vals.f[r * k + j] = xi[order[j]];
+      idxs.i[r * k + j] = order[j];
+    }
+  }
+}
+
+static void k_accuracy(Predictor& P, const OpDesc& op) {
+  // reference: metrics/accuracy_op.cc — correct if ANY of the top-k
+  // indices equals the label
+  const Tensor& idxs = var(P, op.in("Indices"));
+  const Tensor& label = var(P, op.in("Label"));
+  int64_t k = idxs.shape.back();
+  int64_t rows = idxs.numel() / k;
+  int64_t correct = 0;
+  for (int64_t r = 0; r < rows; ++r) {
+    int64_t l = label.i[r];
+    for (int64_t j = 0; j < k; ++j)
+      if (idxs.i[r * k + j] == l) {
+        ++correct;
+        break;
+      }
+  }
+  Tensor& acc = P.scope[op.out("Accuracy")];
+  acc.resize_f({1});
+  acc.f[0] = rows ? static_cast<float>(correct) / rows : 0.f;
+  if (!op.out("Correct").empty()) {
+    Tensor& c = P.scope[op.out("Correct")];
+    c.resize_i({1});
+    c.i[0] = correct;
+  }
+  if (!op.out("Total").empty()) {
+    Tensor& t = P.scope[op.out("Total")];
+    t.resize_i({1});
+    t.i[0] = rows;
+  }
+}
+
 static void k_sgd(Predictor& P, const OpDesc& op) {
   Tensor& p = var(P, op.in("Param"));
   const Tensor& g = var(P, op.in("Grad"));
@@ -1105,6 +1375,24 @@ static void k_uniform_random(Predictor& P, const OpDesc& op) {
   float hi = static_cast<float>(op.attr_num("max", 1.0));
   o.resize_f(shape);
   for (auto& v : o.f) v = lo + (hi - lo) * P.next_uniform();
+}
+
+static void k_gaussian_random(Predictor& P, const OpDesc& op) {
+  // reference: gaussian_random_op.cc (conv/fc MSRA-Xavier startup init);
+  // Box-Muller over the predictor's splitmix64 uniform source
+  Tensor& o = P.scope[op.out("Out")];
+  auto shape = op.attr_ints("shape");
+  float mean = static_cast<float>(op.attr_num("mean", 0.0));
+  float stddev = static_cast<float>(op.attr_num("std", 1.0));
+  o.resize_f(shape);
+  for (int64_t i = 0; i < o.numel(); i += 2) {
+    float u1 = std::max(P.next_uniform(), 1e-12f);
+    float u2 = P.next_uniform();
+    float r = std::sqrt(-2.f * std::log(u1));
+    o.f[i] = mean + stddev * r * std::cos(6.28318530718f * u2);
+    if (i + 1 < o.numel())
+      o.f[i + 1] = mean + stddev * r * std::sin(6.28318530718f * u2);
+  }
 }
 
 // -- INT8 runtime kernels (calibrated models rewritten by
@@ -1268,6 +1556,19 @@ static const std::map<std::string, Kernel>& kernel_table() {
       {"sgd", k_sgd},
       {"fill_constant", k_fill_constant},
       {"uniform_random", k_uniform_random},
+      {"gaussian_random", k_gaussian_random},
+      // conv-model training set (reference:
+      // train/test_train_recognize_digits.cc trains an MNIST conv model
+      // from pure C++; these kernels give the native trainer the same
+      // reach — see native/src/mnist_trainer.c)
+      {"relu_grad", k_relu_grad},
+      {"softmax_with_cross_entropy", k_softmax_with_cross_entropy},
+      {"softmax_with_cross_entropy_grad", k_softmax_with_cross_entropy_grad},
+      {"pool2d_grad", k_pool2d_grad},
+      {"conv2d_grad", k_conv2d_grad},
+      {"depthwise_conv2d_grad", k_conv2d_grad},
+      {"top_k", k_top_k},
+      {"accuracy", k_accuracy},
       // INT8 runtime (calibrated models)
       {"quantized_mul", k_quantized_mul},
       {"quantized_matmul", k_quantized_mul},

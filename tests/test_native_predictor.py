@@ -157,15 +157,33 @@ def test_native_supported_op_manifest_and_unsupported_error(tmp_path):
         pred.run({"x": np.zeros((2, 4), "float32")})
 
 
+def _compile_trainer(tmp_path, src_name):
+    """gcc-compile a native/src/*.c trainer client; returns the binary
+    path and a runner that asserts rc=0 and parses k=v stdout tokens."""
+    import os
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    src = os.path.join(repo, "native", "src", src_name)
+    binpath = str(tmp_path / src_name.removesuffix(".c"))
+    subprocess.run(["gcc", "-O2", src, "-o", binpath, "-ldl"], check=True,
+                   capture_output=True, text=True)
+
+    def run(*args):
+        proc = subprocess.run([binpath, *args], capture_output=True,
+                              text=True, timeout=300)
+        assert proc.returncode == 0, (proc.stdout, proc.stderr)
+        return dict(kv.split("=") for kv in proc.stdout.split())
+
+    return run
+
+
 def test_native_trainer_demo_pure_c(tmp_path):
     """Python-free training (reference: inference/train/demo/
     demo_trainer.cc): Python only AUTHORS the fit_a_line training program;
     a pure-C binary loads it through the PD_Trainer* ABI, runs the startup
     block, streams synthetic data and trains with full fwd+bwd+SGD steps
     to convergence."""
-    import os
-    import subprocess
-
     from paddle_tpu.capi import native_lib_path
 
     main, startup = pt.Program(), pt.Program()
@@ -178,18 +196,87 @@ def test_native_trainer_demo_pure_c(tmp_path):
     pt.io.save_train_model(str(tmp_path), main, startup, ["x", "y"],
                            loss.name)
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    src = os.path.join(repo, "native", "src", "demo_trainer.c")
-    binpath = str(tmp_path / "demo_trainer")
-    subprocess.run(["gcc", "-O2", src, "-o", binpath, "-ldl"], check=True,
-                   capture_output=True, text=True)
-    proc = subprocess.run([binpath, str(tmp_path), native_lib_path()],
-                          capture_output=True, text=True)
-    assert proc.returncode == 0, (proc.stdout, proc.stderr)
-    # "first_loss=... last_loss=..."
-    toks = dict(kv.split("=") for kv in proc.stdout.split())
+    run = _compile_trainer(tmp_path, "demo_trainer.c")
+    toks = run(str(tmp_path), native_lib_path())
     assert float(toks["last_loss"]) < 0.05
     assert float(toks["last_loss"]) < float(toks["first_loss"]) / 20
+
+
+def test_native_trainer_mnist_conv_pure_c(tmp_path):
+    """VERDICT r3 #4 (reference: train/test_train_recognize_digits.cc —
+    C++-only training of an MNIST conv model): Python only AUTHORS the
+    LeNet program (conv2d/pool2d/softmax_with_cross_entropy/accuracy +
+    SGD); a pure-C binary trains it through the PD_Trainer* ABI on a
+    synthetic digit stream to <0.2 loss and >93% train accuracy."""
+    from paddle_tpu.capi import native_lib_path
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        img = pt.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+        label = pt.layers.data(name="label", shape=[1], dtype="int64")
+        c = pt.layers.conv2d(img, num_filters=6, filter_size=5, act="relu")
+        c = pt.layers.pool2d(c, pool_size=2, pool_stride=2)
+        c = pt.layers.conv2d(c, num_filters=16, filter_size=5, act="relu")
+        c = pt.layers.pool2d(c, pool_size=2, pool_stride=2)
+        h = pt.layers.fc(c, size=120, act="relu")
+        h = pt.layers.fc(h, size=84, act="relu")
+        logits = pt.layers.fc(h, size=10)
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, label))
+        acc = pt.layers.accuracy(input=logits, label=label)
+        pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    pt.io.save_train_model(str(tmp_path), main, startup, ["img", "label"],
+                           loss.name)
+
+    run = _compile_trainer(tmp_path, "mnist_trainer.c")
+    toks = run(str(tmp_path), native_lib_path(), acc.name)
+    assert float(toks["last_loss"]) < 0.2, toks
+    assert float(toks["last_acc"]) > 0.93, toks
+
+
+def test_native_trainer_mnist_with_native_datafeed(tmp_path):
+    """Stretch of VERDICT r3 #4 (reference: train/imdb_demo/
+    demo_trainer.cc drives the C++ DataFeed): the pure-C trainer streams
+    its batches through the native datafeed library (reader threads +
+    channel + shuffle buffer, the file listed once per epoch) instead of
+    synthesizing data in C. Both halves are native; Python only authors
+    the program and writes the data file."""
+    import paddle_tpu.io_native as io_native
+    from paddle_tpu.capi import native_lib_path
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        img = pt.layers.data(name="img", shape=[1, 28, 28], dtype="float32")
+        label = pt.layers.data(name="label", shape=[1], dtype="int64")
+        c = pt.layers.conv2d(img, num_filters=6, filter_size=5, act="relu")
+        c = pt.layers.pool2d(c, pool_size=2, pool_stride=2)
+        h = pt.layers.fc(c, size=64, act="relu")
+        logits = pt.layers.fc(h, size=10)
+        loss = pt.layers.mean(
+            pt.layers.softmax_with_cross_entropy(logits, label))
+        acc = pt.layers.accuracy(input=logits, label=label)
+        pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    pt.io.save_train_model(str(tmp_path), main, startup, ["img", "label"],
+                           loss.name)
+
+    # data file: one record per line, 784 pixels + label (float text, the
+    # datafeed slot format); 10 noisy prototypes, 1500 records
+    rng = np.random.RandomState(0)
+    protos = rng.randn(10, 784).astype("float32")
+    labels = rng.randint(0, 10, 1500)
+    data = protos[labels] + 0.35 * rng.randn(1500, 784).astype("float32")
+    datafile = tmp_path / "digits.txt"
+    with open(datafile, "w") as f:
+        for row, lbl in zip(data, labels):
+            f.write(" ".join(f"{v:.4f}" for v in row) + f" {lbl}\n")
+
+    io_native.get_lib()  # lazy-build libptio.so before handing its path on
+    run = _compile_trainer(tmp_path, "mnist_trainer.c")
+    toks = run(str(tmp_path), native_lib_path(), acc.name,
+               io_native._LIB, str(datafile))
+    assert float(toks["last_loss"]) < 0.2, toks
+    assert float(toks["last_acc"]) > 0.93, toks
+    assert int(toks["steps"]) > 100, toks  # the stream really fed
 
 
 def test_native_predictor_recovers_after_bad_feed(tmp_path):
