@@ -2,23 +2,32 @@
 //
 // Reference: paddle/fluid/framework/data_feed.h:61 (DataFeed,
 // MultiSlotDataFeed), data_set.h:41 (Dataset: file-list sharding,
-// pipe_command preprocessing, in-memory global shuffle, channels feeding
-// worker threads). The reference implements this stack in C++ because the
-// Python GIL cannot sustain industrial CTR ingest rates; the same argument
-// holds on TPU hosts, where the input pipeline must outrun the MXU.
+// pipe_command preprocessing, channels feeding worker threads, and the
+// InMemoryDataset load/local-shuffle/global-shuffle family). The
+// reference implements this stack in C++ because the Python GIL cannot
+// sustain industrial CTR ingest rates; the same argument holds on TPU
+// hosts, where the input pipeline must outrun the MXU.
 //
 // This library keeps the same architecture: a reader thread per file shard
 // pushes parsed records into a bounded channel (the reference's
 // ChannelObject, framework/channel.h), an optional shuffle buffer
-// randomizes order, and batches are assembled into contiguous buffers the
-// Python side wraps zero-copy as numpy arrays.
+// randomizes order (streaming mode), and batches are assembled into
+// contiguous buffers the Python side wraps zero-copy as numpy arrays.
+// In-memory mode (ptio_load_into_memory + ptio_mem_*) holds the record
+// container natively; the CROSS-TRAINER global shuffle exchanges those
+// records over the PS RPC plane from the Python wrapper
+// (io_native.InMemoryNativeDataset.global_shuffle), mirroring
+// DatasetImpl::GlobalShuffle's fleet send_client path (data_set.cc:295).
 //
-// C ABI (consumed via ctypes, paddle_tpu/io/native.py):
+// C ABI (consumed via ctypes, paddle_tpu/io_native.py):
 //   ptio_create / ptio_destroy
 //   ptio_set_filelist, ptio_set_pipe_command, ptio_set_slots,
 //   ptio_set_batch_size, ptio_set_shuffle, ptio_set_num_threads,
-//   ptio_start, ptio_next_batch, ptio_release_batch, ptio_stats
+//   ptio_start, ptio_next_batch, ptio_stats
+//   ptio_load_into_memory, ptio_mem_count, ptio_mem_read, ptio_mem_write,
+//   ptio_mem_local_shuffle, ptio_mem_next_batch
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -114,6 +123,11 @@ struct Dataset {
   // shuffle state (single consumer assembles batches)
   std::vector<Record> shuffle_buf;
   std::mt19937_64 rng;
+
+  // in-memory mode (reference: InMemoryDataset, data_set.cc — records
+  // loaded into host memory so they can be globally re-shuffled across
+  // trainers before feeding)
+  std::vector<Record> memory;
 
   ~Dataset() { stop(); }
 
@@ -285,6 +299,106 @@ void ptio_stats(void* h, int64_t* records, int64_t* skipped) {
   auto* ds = static_cast<Dataset*>(h);
   *records = ds->records_read.load();
   *skipped = ds->lines_skipped.load();
+}
+
+// -- in-memory mode (reference: InMemoryDataset::LoadIntoMemory +
+// GlobalShuffle, data_set.cc:295 — the record CONTAINER is native; the
+// cross-trainer exchange plane is the fleet/PS RPC, driven from the
+// Python wrapper io_native.InMemoryNativeDataset) -------------------------
+
+// Synchronously read this trainer's file shard into ds->memory (no
+// channel, no threads). Returns the number of records loaded, -1 if the
+// dataset was already started in streaming mode.
+int64_t ptio_load_into_memory(void* h) {
+  auto* ds = static_cast<Dataset*>(h);
+  if (ds->started.load()) return -1;
+  ds->memory.clear();
+  // reuse the streaming reader by running it inline over one channel
+  ds->channel.add_writer();
+  std::thread t([ds] {
+    for (size_t i = 0; i < ds->files.size(); ++i) {
+      if ((int)(i % ds->num_trainers) != ds->trainer_id) continue;
+      read_file(ds, ds->files[i]);
+    }
+    ds->channel.writer_done();
+  });
+  Record r;
+  while (ds->channel.pop(&r)) ds->memory.push_back(std::move(r));
+  t.join();
+  return (int64_t)ds->memory.size();
+}
+
+int64_t ptio_mem_count(void* h) {
+  return (int64_t)static_cast<Dataset*>(h)->memory.size();
+}
+
+// Copy all in-memory records into out[n * record_len] (row-major).
+int64_t ptio_mem_read(void* h, float* out) {
+  auto* ds = static_cast<Dataset*>(h);
+  for (size_t i = 0; i < ds->memory.size(); ++i)
+    memcpy(out + (int64_t)i * ds->record_len, ds->memory[i].values.data(),
+           ds->record_len * sizeof(float));
+  return (int64_t)ds->memory.size();
+}
+
+// Replace the in-memory records with data[n * record_len] (the post-
+// global-shuffle set routed to this trainer).
+void ptio_mem_write(void* h, const float* data, int64_t n) {
+  auto* ds = static_cast<Dataset*>(h);
+  ds->memory.assign((size_t)n, Record{});
+  for (int64_t i = 0; i < n; ++i) {
+    ds->memory[i].values.assign(data + i * ds->record_len,
+                                data + (i + 1) * ds->record_len);
+  }
+}
+
+// Compute each in-memory record's target trainer under `seed`:
+// FNV-1a 64 over the record bytes, splitmix-style finalizer, mod n.
+// Native so (a) a 10M-record route costs no per-record Python work and
+// (b) every trainer process computes identical routes by construction.
+void ptio_mem_route(void* h, uint64_t seed, int num_trainers,
+                    int64_t* out) {
+  auto* ds = static_cast<Dataset*>(h);
+  for (size_t i = 0; i < ds->memory.size(); ++i) {
+    uint64_t x = 1469598103934665603ULL ^ seed;
+    const auto& v = ds->memory[i].values;
+    const unsigned char* p = (const unsigned char*)v.data();
+    size_t nb = v.size() * sizeof(float);
+    for (size_t b = 0; b < nb; ++b) {
+      x ^= p[b];
+      x *= 1099511628211ULL;
+    }
+    x ^= x >> 30;
+    x *= 0xBF58476D1CE4E5B9ULL;
+    x ^= x >> 27;
+    x *= 0x94D049BB133111EBULL;
+    x ^= x >> 31;
+    out[i] = (int64_t)(x % (uint64_t)(num_trainers > 0 ? num_trainers : 1));
+  }
+}
+
+// Local in-memory shuffle (reference: InMemoryDataset::LocalShuffle).
+void ptio_mem_local_shuffle(void* h, uint64_t seed) {
+  auto* ds = static_cast<Dataset*>(h);
+  std::mt19937_64 rng(seed);
+  std::shuffle(ds->memory.begin(), ds->memory.end(), rng);
+}
+
+// Assemble the next batch straight from memory starting at *cursor;
+// returns records copied (< batch_size at the tail) and advances cursor.
+int ptio_mem_next_batch(void* h, int64_t* cursor, float* out) {
+  auto* ds = static_cast<Dataset*>(h);
+  int got = 0;
+  while (got < ds->batch_size &&
+         *cursor < (int64_t)ds->memory.size()) {
+    memcpy(out + (int64_t)got * ds->record_len,
+           ds->memory[*cursor].values.data(),
+           ds->record_len * sizeof(float));
+    ++got;
+    ++*cursor;
+  }
+  if (got < ds->batch_size && ds->drop_last) return 0;
+  return got;
 }
 
 }  // extern "C"

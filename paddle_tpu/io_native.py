@@ -65,6 +65,25 @@ def get_lib():
         lib.ptio_stats.argtypes = [ctypes.c_void_p,
                                    ctypes.POINTER(ctypes.c_int64),
                                    ctypes.POINTER(ctypes.c_int64)]
+        lib.ptio_load_into_memory.argtypes = [ctypes.c_void_p]
+        lib.ptio_load_into_memory.restype = ctypes.c_int64
+        lib.ptio_mem_count.argtypes = [ctypes.c_void_p]
+        lib.ptio_mem_count.restype = ctypes.c_int64
+        lib.ptio_mem_read.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_float)]
+        lib.ptio_mem_read.restype = ctypes.c_int64
+        lib.ptio_mem_write.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_float),
+                                       ctypes.c_int64]
+        lib.ptio_mem_local_shuffle.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_uint64]
+        lib.ptio_mem_route.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                       ctypes.c_int,
+                                       ctypes.POINTER(ctypes.c_int64)]
+        lib.ptio_mem_next_batch.argtypes = [ctypes.c_void_p,
+                                            ctypes.POINTER(ctypes.c_int64),
+                                            ctypes.POINTER(ctypes.c_float)]
+        lib.ptio_mem_next_batch.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -138,14 +157,7 @@ class NativeDataset:
                 n = self._lib.ptio_next_batch(h, ptr)
                 if n <= 0:
                     break
-                batch = {}
-                off = 0
-                for name, shape in self.slots:
-                    size = int(np.prod(shape))
-                    batch[name] = (buf[:n, off:off + size]
-                                   .reshape((n,) + shape).copy())
-                    off += size
-                yield batch
+                yield self._assemble_batch(buf, n)
         finally:
             rec = ctypes.c_int64()
             skip = ctypes.c_int64()
@@ -153,6 +165,160 @@ class NativeDataset:
             self._last_stats = (rec.value, skip.value)
             self._lib.ptio_destroy(h)
 
+    def _assemble_batch(self, buf: np.ndarray, n: int) -> dict:
+        """Split a [n, record_len] buffer into named, shaped slot arrays."""
+        batch = {}
+        off = 0
+        for name, shape in self.slots:
+            size = int(np.prod(shape))
+            batch[name] = (buf[:n, off:off + size]
+                           .reshape((n,) + shape).copy())
+            off += size
+        return batch
+
     def stats(self) -> Tuple[int, int]:
         """(records_read, lines_skipped) of the last finished epoch."""
         return self._last_stats
+
+
+class InMemoryNativeDataset(NativeDataset):
+    """The reference's InMemoryDataset (python/paddle/fluid/dataset.py:518
+    `global_shuffle`, over framework/data_set.cc:295
+    `DatasetImpl::GlobalShuffle`): records are loaded into native memory,
+    then re-routed ACROSS trainers so each record lands on exactly one
+    trainer under a server-seeded permutation.
+
+    The record container and batch assembly are C++ (datafeed.cc
+    ptio_mem_*); the exchange plane is the PS RPC — the reference routes
+    through the fleet send_client the same way. Protocol per pass:
+    shuffle_begin (first arrival opens the pass and draws the seed) →
+    each trainer routes record i to hash(seed, record) % num_trainers via
+    shuffle_put → shuffle_done → shuffle_take barriers until every
+    trainer routed, then hands back this trainer's shard."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._h = None  # persistent handle holding the memory container
+        self._loaded = False
+
+    def _handle(self):
+        if self._h is None:
+            self._h = self._new_handle()
+        return self._h
+
+    def load_into_memory(self) -> int:
+        """Read this trainer's file shard into native memory; returns the
+        record count (reference: InMemoryDataset.load_into_memory)."""
+        h = self._handle()
+        n = self._lib.ptio_load_into_memory(h)
+        if n < 0:
+            raise RuntimeError("dataset already started in streaming mode")
+        rec = ctypes.c_int64()
+        skip = ctypes.c_int64()
+        self._lib.ptio_stats(h, ctypes.byref(rec), ctypes.byref(skip))
+        self._last_stats = (rec.value, skip.value)
+        self._loaded = True
+        return int(n)
+
+    def _mem_records(self) -> np.ndarray:
+        h = self._handle()
+        n = self._lib.ptio_mem_count(h)
+        out = np.empty((int(n), self.record_len), np.float32)
+        self._lib.ptio_mem_read(
+            h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return out
+
+    def _mem_replace(self, records: np.ndarray):
+        records = np.ascontiguousarray(records, np.float32)
+        self._lib.ptio_mem_write(
+            self._handle(),
+            records.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            records.shape[0])
+
+    def local_shuffle(self, seed: int = 0):
+        self._lib.ptio_mem_local_shuffle(self._handle(),
+                                         ctypes.c_uint64(seed))
+
+    def global_shuffle(self, client) -> int:
+        """Cross-trainer shuffle through the PS (client: ps.PSClient).
+        Every record lands on exactly one trainer: trainer t keeps record
+        r iff hash(seed, r) % num_trainers == t. Returns the new local
+        record count."""
+        tid = self._cfg["trainer_id"]
+        nt = self._cfg["num_trainers"]
+        ep = client.endpoints[0]  # one server coordinates the pass
+        conn = client._conns[ep]
+
+        out = conn.call({"op": "shuffle_begin", "trainer_id": tid})
+        if "error" in out:
+            raise RuntimeError(f"shuffle_begin: {out['error']}")
+        seed = int(out["seed"])
+
+        recs = self._mem_records()
+        # routing hash computed NATIVELY (datafeed.cc ptio_mem_route):
+        # per-record Python work would bottleneck CTR-scale passes, and
+        # the C implementation is identical in every trainer process so
+        # the exactly-one-trainer invariant holds by construction
+        targets = np.empty(recs.shape[0], np.int64)
+        self._lib.ptio_mem_route(
+            self._handle(), ctypes.c_uint64(seed), nt,
+            targets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        # records hashed back to THIS trainer never leave the process;
+        # only the cross-trainer fraction rides the PS exchange (the
+        # reference's GlobalShuffle routes trainer-to-trainer for the
+        # same reason — the PS here is the coordinator, so its peak
+        # buffer is O(dataset * (nt-1)/nt) for the pass)
+        kept = recs[targets == tid]
+        for t in range(nt):
+            if t == tid:
+                continue
+            part = recs[targets == t]
+            if part.size:
+                r = conn.call({"op": "shuffle_put", "target": t,
+                               "records": part})
+                if "error" in r:
+                    raise RuntimeError(f"shuffle_put: {r['error']}")
+        conn.call({"op": "shuffle_done", "trainer_id": tid})
+        out = conn.call({"op": "shuffle_take", "trainer_id": tid})
+        if "error" in out:
+            raise RuntimeError(f"shuffle_take: {out['error']}")
+        got = np.asarray(out["records"], np.float32)
+        got = got.reshape(-1, self.record_len) if got.size else \
+            np.zeros((0, self.record_len), np.float32)
+        merged = np.concatenate([kept, got], axis=0)
+        # per-trainer order randomized too (kept-then-taken concatenation
+        # is deterministic only after this local permutation)
+        perm = np.random.RandomState(seed ^ (tid + 1)).permutation(
+            merged.shape[0])
+        self._mem_replace(merged[perm])
+        return merged.shape[0]
+
+    def __iter__(self) -> Iterator[dict]:
+        """Batches straight from the in-memory container (post-shuffle
+        order; use load_into_memory()+global_shuffle() first). A loaded
+        dataset whose shard is legitimately empty (a small dataset hashed
+        entirely to peers) yields no batches."""
+        h = self._handle()
+        if not self._loaded:
+            raise RuntimeError(
+                "in-memory dataset not loaded — call load_into_memory()")
+        buf = np.empty((self.batch_size, self.record_len), np.float32)
+        ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        cursor = ctypes.c_int64(0)
+        while True:
+            n = self._lib.ptio_mem_next_batch(h, ctypes.byref(cursor), ptr)
+            if n <= 0:
+                break
+            yield self._assemble_batch(buf, n)
+
+    def release_memory(self):
+        if self._h is not None:
+            self._lib.ptio_destroy(self._h)
+            self._h = None
+            self._loaded = False
+
+    def __del__(self):
+        try:
+            self.release_memory()
+        except Exception:
+            pass

@@ -102,6 +102,19 @@ class ParameterServer:
         self._send_barrier = 0
         self._step_done = threading.Condition(self._barrier_lock)
         self._generation = 0
+        # global-shuffle exchange plane (reference:
+        # DatasetImpl::GlobalShuffle, data_set.cc:295 — records re-routed
+        # across trainers through the fleet RPC; here the PS coordinates
+        # the pass seed, buffers per-target record batches, and barriers
+        # until every trainer has routed before handing shards back)
+        self._shuf_lock = threading.Lock()
+        self._shuf_cv = threading.Condition(self._shuf_lock)
+        self._shuf_pass = 0
+        self._shuf_seed = 0
+        self._shuf_begun: set = set()
+        self._shuf_done: set = set()
+        self._shuf_taken: set = set()
+        self._shuf_buf: Dict[int, list] = {}
         self._server: Optional[socketserver.ThreadingTCPServer] = None
 
     # -- optimize-block execution (shared op registry) ---------------------
@@ -361,6 +374,80 @@ class ParameterServer:
                 return {"ok": True, "saved": saved}
             except OSError as e:
                 return {"error": f"checkpoint failed: {e}"}
+        if op == "shuffle_begin":
+            # first trainer of a round opens a new pass: fresh seed,
+            # fresh per-target buffers. Idempotent per (pass, trainer).
+            tid = int(msg["trainer_id"])
+            with self._shuf_cv:
+                # a trainer may lap its peers: if it already TOOK its
+                # shard of the current pass, this begin wants the NEXT
+                # pass — block until every trainer has taken (rollover
+                # clears all sets). A begin from a trainer still inside
+                # the current pass (retry) falls through idempotently.
+                ok = self._shuf_cv.wait_for(
+                    lambda: tid not in self._shuf_taken, timeout=120)
+                if not ok:
+                    return {"error": "shuffle_begin barrier timeout: a "
+                                     "peer never took its shard"}
+                if not self._shuf_begun:
+                    self._shuf_pass += 1
+                    self._shuf_seed = int(
+                        np.random.SeedSequence(
+                            [self._shuf_pass, 0x5EED]).generate_state(1)[0])
+                    self._shuf_buf = {t: [] for t in
+                                      range(self.num_trainers)}
+                    self._shuf_done.clear()
+                    self._shuf_taken.clear()
+                self._shuf_begun.add(tid)
+            return {"seed": self._shuf_seed, "pass_id": self._shuf_pass}
+        if op == "shuffle_put":
+            target = int(msg["target"])
+            if not (0 <= target < self.num_trainers):
+                return {"error": f"shuffle target {target} out of range"}
+            recs = np.asarray(msg["records"], np.float32)
+            with self._shuf_cv:
+                if target not in self._shuf_buf:
+                    return {"error": "no active shuffle pass (aborted?) — "
+                                     "call shuffle_begin again"}
+                self._shuf_buf[target].append(recs)
+            return {"ok": True}
+        if op == "shuffle_done":
+            with self._shuf_cv:
+                self._shuf_done.add(int(msg["trainer_id"]))
+                self._shuf_cv.notify_all()
+            return {"ok": True}
+        if op == "shuffle_take":
+            tid = int(msg["trainer_id"])
+            with self._shuf_cv:
+                ok = self._shuf_cv.wait_for(
+                    lambda: len(self._shuf_done) >= self.num_trainers,
+                    timeout=120)
+                if not ok:
+                    # ABORT the pass: a peer died mid-route. Clearing all
+                    # state here means a retry opens a fresh pass and
+                    # re-puts from scratch — leaving the half-routed
+                    # buffers would hand out duplicated records on retry.
+                    self._shuf_begun.clear()
+                    self._shuf_done.clear()
+                    self._shuf_taken.clear()
+                    self._shuf_buf = {}
+                    self._shuf_cv.notify_all()
+                    return {"error": "shuffle_take barrier timeout: a "
+                                     "peer trainer never finished routing; "
+                                     "pass aborted — retry re-routes from "
+                                     "scratch"}
+                parts = self._shuf_buf.get(tid, [])
+                out = (np.concatenate(parts, axis=0) if parts
+                       else np.zeros((0, 0), np.float32))
+                self._shuf_buf[tid] = []
+                self._shuf_taken.add(tid)
+                if len(self._shuf_taken) >= self.num_trainers:
+                    # rollover: next begin opens a fresh pass, and lapped
+                    # trainers blocked in shuffle_begin may proceed
+                    self._shuf_begun.clear()
+                    self._shuf_taken.clear()
+                    self._shuf_cv.notify_all()
+            return {"records": out, "pass_id": self._shuf_pass}
         if op == "shutdown":
             threading.Thread(target=self.stop, daemon=True).start()
             return {"ok": True}
