@@ -182,9 +182,111 @@ class Optimizer:
         return float(self._learning_rate)
 
     def _eager_update(self, pid, value, grad):
-        raise NotImplementedError(
-            f"{type(self).__name__} has no dygraph update; use SGD, "
-            f"Momentum or Adam in imperative mode")
+        # Generic imperative update (reference design: imperative/
+        # tracer.cc:45 — ONE op registry serves both static and dygraph
+        # modes). Subclasses may override with a direct jnp fast path
+        # (SGD/Momentum/Adam do); everyone else reuses their
+        # _append_optimize_op via a per-parameter scratch program whose
+        # ops are replayed eagerly through the kernel registry.
+        return self._eager_update_via_registry(pid, value, grad)
+
+    def _eager_update_via_registry(self, p, value, grad):
+        import jax
+        import jax.numpy as jnp
+
+        from .core.lowering import run_op
+
+        st = self._eager_state.setdefault(p, {})
+        plan = st.get("plan")
+        if plan is None:
+            plan = self._build_eager_plan(p, value)
+            st["plan"] = plan
+            # run the scratch startup ops once: accumulator fills + the
+            # lr var (overridden per step below)
+            env0: dict = {}
+            for op in plan["startup_ops"]:
+                run_op(op, env0, None, 0, None, None, True)
+            st["acc"] = {n: env0[n] for n in plan["state_vars"]
+                         if n in env0}
+        env = dict(st["acc"])
+        env[plan["param"]] = value
+        env[plan["grad"]] = grad
+        env[plan["lr"]] = jnp.asarray([self._eager_lr()], jnp.float32)
+        # fresh per-step key: stochastic kernels (dpsgd's DP noise) must
+        # not replay KernelCtx's fixed key(0) fallback every step
+        step = st.get("step", 0)
+        st["step"] = step + 1
+        rng_key = jax.random.fold_in(jax.random.key(0), step)
+        for op in plan["main_ops"]:
+            run_op(op, env, None, 0, None, rng_key, True)
+        st["acc"] = {n: env[n] for n in plan["state_vars"] if n in env}
+        return env[plan["param"]]
+
+    def _build_eager_plan(self, p, value):
+        """Author the single-parameter optimize block in a scratch static
+        program (tracer suspended) and capture its op descs."""
+        import contextlib
+
+        from .core import framework as fw
+        from .core.framework import program_guard
+
+        @contextlib.contextmanager
+        def static_mode():
+            t = fw._get_dygraph_tracer()
+            fw._set_dygraph_tracer(None)
+            try:
+                yield
+            finally:
+                fw._set_dygraph_tracer(t)
+
+        saved_lr = self._learning_rate
+        saved_lr_var = self._learning_rate_var
+        saved_acc = self._accumulators
+        main, startup = Program(), Program()
+        try:
+            # a scheduler cannot be materialized as a static global var;
+            # the plan's lr var is overridden with _eager_lr() per step.
+            # Accumulators build into a FRESH registry: the scratch
+            # program's vars must not leak into (or be short-circuited
+            # by) a static-mode use of the same optimizer instance.
+            self._learning_rate = float(self._eager_lr())
+            self._learning_rate_var = None
+            self._accumulators = defaultdict(dict)
+            with static_mode(), unique_name.guard(), \
+                    program_guard(main, startup):
+                blk = main.global_block()
+                pv = blk.create_var(name=p.name, shape=list(value.shape),
+                                    dtype=str(value.dtype),
+                                    persistable=True)
+                # attribute passthrough: optimize hooks may consult these
+                # (Lamb's exclude_from_weight_decay_fn, regularizers)
+                pv.optimize_attr = getattr(p, "optimize_attr",
+                                           {"learning_rate": 1.0})
+                pv.trainable = getattr(p, "trainable", True)
+                pv.regularizer = getattr(p, "regularizer", None)
+                pv.do_model_average = getattr(p, "do_model_average", None)
+                gv = blk.create_var(name=p.name + "@GRAD",
+                                    shape=list(value.shape),
+                                    dtype=str(value.dtype))
+                self._create_global_learning_rate()
+                self._create_accumulators(blk, [pv])
+                self._append_optimize_op(blk, (pv, gv))
+                self._finish_update(blk, [(pv, gv)])
+            state_vars = sorted(
+                {v.name for accs in self._accumulators.values()
+                 for pname, v in accs.items() if pname == p.name})
+            return {
+                "param": pv.name,
+                "grad": gv.name,
+                "lr": self._learning_rate_var.name,
+                "startup_ops": list(startup.desc.blocks[0].ops),
+                "main_ops": list(main.desc.blocks[0].ops),
+                "state_vars": state_vars,
+            }
+        finally:
+            self._learning_rate = saved_lr
+            self._learning_rate_var = saved_lr_var
+            self._accumulators = saved_acc
 
     def _eager_regularize(self, p, grad):
         reg = getattr(p, "regularizer", None) or self.regularization
@@ -606,6 +708,11 @@ class LambOptimizer(AdamOptimizer):
                          epsilon=epsilon, **kw)
         self._weight_decay = lamb_weight_decay
         self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _eager_update(self, pid, value, grad):
+        # do NOT inherit Adam's fast path: LAMB layerwise-normalizes the
+        # update and applies decoupled weight decay — replay the lamb op
+        return self._eager_update_via_registry(pid, value, grad)
 
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad
