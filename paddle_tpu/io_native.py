@@ -11,26 +11,18 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_SRC = os.path.join(_REPO, "native", "src", "datafeed.cc")
-_LIB_DIR = os.path.join(_REPO, "native", "build")
-_LIB = os.path.join(_LIB_DIR, "libptio.so")
+from .native_build import LIB_DIR, SRC_DIR, build_and_load
+
+_SRC = os.path.join(SRC_DIR, "datafeed.cc")
+_LIB = os.path.join(LIB_DIR, "libptio.so")
 
 _lib = None
 _lib_lock = threading.Lock()
-
-
-def _build_lib():
-    os.makedirs(_LIB_DIR, exist_ok=True)
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
-           _SRC, "-o", _LIB]
-    subprocess.run(cmd, check=True, capture_output=True, text=True)
 
 
 def get_lib():
@@ -39,10 +31,7 @@ def get_lib():
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB) or (
-                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
-            _build_lib()
-        lib = ctypes.CDLL(_LIB)
+        lib = build_and_load(_SRC, _LIB, ["-O2", "-pthread"])
         lib.ptio_create.restype = ctypes.c_void_p
         lib.ptio_destroy.argtypes = [ctypes.c_void_p]
         lib.ptio_set_filelist.argtypes = [

@@ -1,0 +1,49 @@
+"""Shared build-on-first-use helper for the native libraries.
+
+One implementation of the compile-then-load dance used by capi.py
+(libptpred), io_native.py (libptio) and ps/native_opt.py (libptpsopt):
+g++ the single source file into native/build/ when the .so is missing or
+older than its source, writing to a temp path and os.replace()-ing so a
+concurrent first-use in another process can never load a half-written
+library (os.replace is atomic on POSIX)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Sequence
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB_DIR = os.path.join(_REPO, "native", "build")
+SRC_DIR = os.path.join(_REPO, "native", "src")
+
+
+def build_and_load(src: str, lib_path: str,
+                   extra_flags: Sequence[str] = ()) -> ctypes.CDLL:
+    """Compile `src` into `lib_path` when missing/stale, then CDLL it.
+
+    Raises on compile failure. A load failure of an up-to-date file
+    triggers ONE rebuild (covers a partially-written .so from a crashed
+    earlier build) before propagating."""
+    os.makedirs(os.path.dirname(lib_path), exist_ok=True)
+
+    def build():
+        tmp = f"{lib_path}.tmp.{os.getpid()}"
+        cmd = ["g++", "-shared", "-fPIC", "-std=c++17", *extra_flags,
+               src, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"native build failed: {' '.join(cmd)}\n{e.stderr}") from e
+        os.replace(tmp, lib_path)
+
+    if not os.path.exists(lib_path) or (
+            os.path.getmtime(lib_path) < os.path.getmtime(src)):
+        build()
+    try:
+        return ctypes.CDLL(lib_path)
+    except OSError:
+        build()  # e.g. a truncated .so left by a crashed writer
+        return ctypes.CDLL(lib_path)

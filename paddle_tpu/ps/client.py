@@ -90,9 +90,24 @@ class PSClient:
         """reference: send_barrier_op — one per pserver per step."""
         gens = []
         for ep, c in self._conns.items():
-            out = c.call({"op": "send_barrier"})
+            out = c.call({"op": "send_barrier",
+                          "trainer_id": self.trainer_id})
             gens.append(out.get("generation", 0))
         self.generation = max(self.generation + 1, *gens) if gens else 0
+
+    def rejoin(self) -> int:
+        """Elastic restart: re-register with every pserver, discarding the
+        dead incarnation's partial step state, and resync the pull
+        generation to the live step (reference: ResetReceivedVars,
+        listen_and_serv_op.cc:178)."""
+        gens = []
+        for ep, c in self._conns.items():
+            out = c.call({"op": "rejoin", "trainer_id": self.trainer_id})
+            if "error" in out:
+                raise RuntimeError(f"rejoin: {out['error']}")
+            gens.append(out.get("generation", 0))
+        self.generation = max(gens) if gens else 0
+        return self.generation
 
     # -- GEO ----------------------------------------------------------------
 

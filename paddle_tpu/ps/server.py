@@ -66,13 +66,16 @@ class HeartBeatMonitor:
 
 
 class _VarState:
-    __slots__ = ("value", "grad_sum", "grad_count", "opt_descs", "grad_name",
-                 "lock")
+    __slots__ = ("value", "recv", "opt_descs", "grad_name", "lock")
 
     def __init__(self, value, opt_descs, grad_name=None):
         self.value = value
-        self.grad_sum = None
-        self.grad_count = 0
+        # sync mode: per-trainer received grads for the CURRENT step,
+        # keyed by trainer_id. Replace-on-resend semantics (a trainer
+        # that dies and rejoins mid-step must not double-count) — the
+        # reference's per-var received state, listen_and_serv_op.cc:178
+        # ResetReceivedVars.
+        self.recv: Dict[int, np.ndarray] = {}
         self.opt_descs = opt_descs  # [OpDesc dicts] from the transpiler
         # actual grad var name the descs reference (clipping and other
         # grad-rewriting passes rename it away from <param>@GRAD)
@@ -99,7 +102,7 @@ class ParameterServer:
         self.aux_owner: Dict[str, str] = {}    # aux name -> owning param
         self.monitor = HeartBeatMonitor(num_trainers)
         self._barrier_lock = threading.Lock()
-        self._send_barrier = 0
+        self._send_barrier: set = set()
         self._step_done = threading.Condition(self._barrier_lock)
         self._generation = 0
         # global-shuffle exchange plane (reference:
@@ -140,17 +143,35 @@ class ParameterServer:
             if names and names[0]:
                 env[names[0]] = val
 
+        from . import native_opt
+
         p = np.asarray(gi("Param"))
         g = np.asarray(gi("Grad"))
         lr = float(np.asarray(gi("LearningRate")).reshape(-1)[0])
+        nlib = native_opt.get_lib()
         if t == "sgd":
-            so("ParamOut", p - lr * g.astype(p.dtype))
+            pc, gc = native_opt.f32c(p), native_opt.f32c(g)
+            if nlib is not None and pc is not None and gc is not None:
+                so("ParamOut", native_opt.sgd(nlib, pc, gc, lr))
+            else:
+                so("ParamOut", p - lr * g.astype(p.dtype))
             return True
         if t == "momentum":
             v = np.asarray(gi("Velocity"))
             mu = float(attrs.get("mu", 0.9))
+            nes = bool(attrs.get("use_nesterov", False))
+            pc, gc, vc = (native_opt.f32c(p), native_opt.f32c(g),
+                          native_opt.f32c(v))
+            if nlib is not None and pc is not None and gc is not None \
+                    and vc is not None:
+                # fused kernel mutates v in place; the same array is the
+                # VelocityOut write-back
+                so("ParamOut", native_opt.momentum(nlib, pc, gc, vc, lr,
+                                                   mu, nes))
+                so("VelocityOut", vc)
+                return True
             v_new = mu * v + g
-            if attrs.get("use_nesterov", False):
+            if nes:
                 p_new = p - (g + mu * v_new) * lr
             else:
                 p_new = p - lr * v_new
@@ -162,11 +183,25 @@ class ParameterServer:
         m2 = np.asarray(gi("Moment2"))
         b1p_arr = np.asarray(gi("Beta1Pow"))
         b2p_arr = np.asarray(gi("Beta2Pow"))
-        b1p = b1p_arr.reshape(-1)[0]
-        b2p = b2p_arr.reshape(-1)[0]
         b1 = np.float32(attrs.get("beta1", 0.9))
         b2 = np.float32(attrs.get("beta2", 0.999))
         eps = float(attrs.get("epsilon", 1e-8))
+        cands = [native_opt.f32c(a) for a in (p, g, m1, m2, b1p_arr,
+                                              b2p_arr)]
+        if nlib is not None and all(a is not None for a in cands):
+            pc, gc, m1c, m2c, b1c, b2c = cands
+            # single fused pass (native/src/psopt.cc): moments and beta
+            # pows update in place — the same arrays are the write-backs
+            so("ParamOut", native_opt.adam(nlib, pc, gc, m1c, m2c, b1c,
+                                           b2c, lr, float(b1), float(b2),
+                                           eps))
+            so("Moment1Out", m1c)
+            so("Moment2Out", m2c)
+            so("Beta1PowOut", b1c)
+            so("Beta2PowOut", b2c)
+            return True
+        b1p = b1p_arr.reshape(-1)[0]
+        b2p = b2p_arr.reshape(-1)[0]
         m1n = b1 * m1 + (1 - b1) * g
         m2n = b2 * m2 + (1 - b2) * np.square(g)
         lr_t = np.float32(lr) * np.sqrt(1 - b2p) / (1 - b1p)
@@ -269,11 +304,9 @@ class ParameterServer:
                             grad = grad + self.dc_lambda * grad * grad * \
                                 (vs.value - bak)
                     self._run_opt(vs, name, grad)
-            else:  # sync: accumulate until barrier
+            else:  # sync: hold per-trainer until barrier (resend replaces)
                 with vs.lock:
-                    vs.grad_sum = grad if vs.grad_sum is None else \
-                        vs.grad_sum + grad
-                    vs.grad_count += 1
+                    vs.recv[tid] = grad
             return {"ok": True}
         if op == "send_delta":  # GEO-SGD (communicator.h:323)
             name = msg["name"]
@@ -285,18 +318,21 @@ class ParameterServer:
             return {"ok": True}
         if op == "send_barrier":
             # all grads of this trainer are in; when every trainer has
-            # barriered, apply optimize blocks (RunSyncLoop :110)
+            # barriered, apply optimize blocks (RunSyncLoop :110). The
+            # barrier is a SET of trainer ids — a re-sent barrier from a
+            # rejoined trainer cannot double-count.
+            tid = int(msg.get("trainer_id", 0))
             with self._barrier_lock:
-                self._send_barrier += 1
-                if self._send_barrier >= self.num_trainers:
-                    self._send_barrier = 0
+                self._send_barrier.add(tid)
+                if len(self._send_barrier) >= self.num_trainers:
+                    self._send_barrier.clear()
                     for name, vs in self.vars.items():
                         with vs.lock:
-                            if vs.grad_sum is not None:
-                                g = vs.grad_sum / max(vs.grad_count, 1)
+                            if vs.recv:
+                                g = (sum(vs.recv.values())
+                                     / max(len(vs.recv), 1))
                                 self._run_opt(vs, name, g)
-                                vs.grad_sum = None
-                                vs.grad_count = 0
+                                vs.recv.clear()
                     self._generation += 1
                     self._step_done.notify_all()
             return {"ok": True, "generation": self._generation}
@@ -328,6 +364,30 @@ class ParameterServer:
         if op == "heartbeat":
             self.monitor.beat(msg["trainer_id"], msg.get("state"))
             return {"ok": True}
+        if op == "rejoin":
+            # elastic rejoin (reference: listen_and_serv_op.cc:178-179
+            # ResetReceivedVars): a restarted trainer re-registers; the
+            # dead incarnation's partial step state is discarded so the
+            # new one can't double-contribute, and the current generation
+            # is returned so it resumes pulls at the live step. Peers
+            # blocked in the get-barrier are untouched: the rejoined
+            # trainer's next send+barrier completes the pending step.
+            tid = int(msg["trainer_id"])
+            with self.monitor._lock:
+                self.monitor.states[tid] = HeartBeatMonitor.RUNNING
+                self.monitor.last_beat[tid] = time.time()
+                if tid in self.monitor.lost:
+                    self.monitor.lost.remove(tid)
+            with self._barrier_lock:
+                self._send_barrier.discard(tid)
+            for vname, vs in list(self.vars.items()):
+                with vs.lock:
+                    vs.recv.pop(tid, None)
+                    # drop the dead incarnation's DC-ASGD pull snapshot:
+                    # compensating the reborn trainer's first push against
+                    # it would inject a wildly stale (w_now - w_at_pull)
+                    self._pull_snapshots.pop((tid, vname), None)
+            return {"ok": True, "generation": self._generation}
         if op == "has_var":
             return {"ok": msg["name"] in self.vars}
         if op == "all_completed":
@@ -475,6 +535,12 @@ class ParameterServer:
         self._server.serve_forever()
 
     def start_background(self):
+        # warm the fused optimizer library OFF the serving path: a lazy
+        # first-use compile inside the barrier critical section would
+        # stall every trainer's step-1 barrier for the g++ duration
+        from . import native_opt
+
+        threading.Thread(target=native_opt.get_lib, daemon=True).start()
         t = threading.Thread(target=self.serve_forever, daemon=True)
         t.start()
         # wait for the socket to bind
