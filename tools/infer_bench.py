@@ -126,12 +126,15 @@ def main():
                   "host_roundtrip_ms": round(rtt, 3),
                   "source": "contrib/float16/float16_benchmark.md"}
         if prec == "int8":
-            # accuracy delta vs the bf16 path on the same inputs
+            # accuracy delta vs the bf16 path over 32 probe images (3%
+            # top-1 granularity; random-init logits are near-tied, so
+            # tiny samples make agreement meaninglessly coarse, while
+            # the full 128-image batch costs two more large compiles)
+            probe = img[:32]
             fp = np.asarray(jax.jit(fn)(
-                {k: v for k, v in (vparams if base == "vgg16"
-                                   else rparams).items()}, img[:2]),
+                vparams if base == "vgg16" else rparams, probe),
                 np.float32)
-            qt = np.asarray(jax.jit(fn)(params, img[:2]), np.float32)
+            qt = np.asarray(jax.jit(fn)(params, probe), np.float32)
             detail["int8_vs_bf16_max_abs_logit_delta"] = round(
                 float(np.abs(fp - qt).max()), 4)
             detail["int8_vs_bf16_rel_logit_delta"] = round(
