@@ -803,6 +803,124 @@ def test_downpour_style_ctr_training(tmp_path):
         s.stop()
 
 
+def test_downpour_training_over_global_shuffle(tmp_path):
+    """InMemoryDataset end-to-end (reference: a Downpour job calling
+    dataset.load_into_memory() + global_shuffle() before
+    train_from_dataset, dataset.py:518): two trainer threads load their
+    file shards into native memory, globally re-shuffle records across
+    each other through the PS, then train a shared CTR model —
+    convergence + exactly-once record coverage per pass."""
+    import threading
+
+    import paddle_tpu as pt
+    from paddle_tpu.io_native import InMemoryNativeDataset
+    from paddle_tpu.ops.distributed import bind_client
+    from paddle_tpu.ps import ParameterServer, PSClient
+    from paddle_tpu.ps.sparse_table import init_sparse_table
+
+    (port,) = _free_ports(1)
+    eps = [f"127.0.0.1:{port}"]
+    server = ParameterServer(eps[0], num_trainers=2, mode="async")
+    server.start_background()
+    boot = PSClient(eps)
+    rng = np.random.RandomState(0)
+    V, D = 30, 8
+    init_sparse_table(boot, "gsctr_table",
+                      (rng.rand(V, D).astype("float32") * 0.1))
+
+    files = []
+    for i in range(4):
+        ids = rng.randint(0, V, (30, 1))
+        clicks = (ids % 3 == 0).astype(np.float32)
+        path = tmp_path / f"gs-{i}.txt"
+        np.savetxt(path, np.hstack([ids.astype(np.float32), clicks]),
+                   fmt="%.1f")
+        files.append(str(path))
+
+    def build_program():
+        main, startup = pt.Program(), pt.Program()
+        with pt.framework.unique_name.guard(), \
+                pt.program_guard(main, startup):
+            w = pt.layers.data(name="wf", shape=[1], dtype="float32")
+            label = pt.layers.data(name="label", shape=[1], dtype="float32")
+            ids64 = pt.layers.cast(w, "int64")
+            emb = pt.layers.distributed_embedding(
+                ids64, (V, D), "gsctr_table", sparse_lr=0.3)
+            emb = pt.layers.reshape(emb, shape=[-1, D])
+            pred = pt.layers.fc(input=emb, size=1, act="sigmoid")
+            loss = pt.layers.mean(pt.layers.log_loss(pred, label))
+            pt.optimizer.SGD(0.1).minimize(loss)
+        return main, startup, loss
+
+    # per-trainer datasets + shuffle clients; the shuffle exchange is
+    # COLLECTIVE (threads), training then runs each shard sequentially
+    # through one shared program/scope (the framework's unique_name /
+    # scope stack / bound client are process-global by design — the
+    # multi-thread training path is trainer.py's HogwildWorker, covered
+    # by test_multitrainer_threaded_training)
+    clients = [PSClient(eps, trainer_id=t) for t in (0, 1)]
+    dss = []
+    for tid in (0, 1):
+        ds = InMemoryNativeDataset(
+            slots=[("wf", (1,)), ("label", (1,))], batch_size=15,
+            trainer_id=tid, num_trainers=2, drop_last=False)
+        ds.set_filelist(files)
+        assert ds.load_into_memory() == 60
+        dss.append(ds)
+
+    bind_client(clients[0])
+    main, startup, loss = build_program()
+    exe = pt.Executor(pt.CPUPlace())
+    try:
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            full = None
+            first_loss = last_loss = None
+            for epoch in range(6):
+                errs = []
+                counts = {}
+
+                def shuffle(tid):
+                    try:
+                        counts[tid] = dss[tid].global_shuffle(clients[tid])
+                    except Exception as e:  # pragma: no cover
+                        errs.append(e)
+
+                # daemon: a wedged barrier must fail the test, not hang
+                # the interpreter at exit
+                ts = [threading.Thread(target=shuffle, args=(t,),
+                                       daemon=True) for t in (0, 1)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=120)
+                    assert not t.is_alive(), "shuffle barrier wedged"
+                assert not errs, errs
+
+                combined = []
+                for tid in (0, 1):
+                    seen = []
+                    for feed in iter(dss[tid]):
+                        seen.extend(feed["wf"].reshape(-1).tolist())
+                        l = float(np.asarray(exe.run(
+                            main, feed=feed,
+                            fetch_list=[loss])[0]).reshape(()))
+                        if first_loss is None:
+                            first_loss = l
+                        last_loss = l
+                    assert len(seen) == counts[tid]
+                    combined.extend(np.float32(s) for s in seen)
+                # exactly-once coverage: shards union to the full log
+                combined = sorted(combined)
+                if full is None:
+                    full = combined
+                assert combined == full, f"pass {epoch} lost/dup records"
+                assert len(combined) == 120
+        assert last_loss < first_loss, (first_loss, last_loss)
+    finally:
+        server.stop()
+
+
 @pytest.mark.slow
 def test_launch_ps_cli_runs_cluster():
     """reference: launch_ps.py — one CLI spawns pservers + trainers; the

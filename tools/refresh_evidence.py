@@ -1,13 +1,17 @@
-"""Regenerate every committed evidence artifact in one command.
+"""Regenerate the builder-owned evidence artifacts in one command.
 
 VERDICT r3 #2: evidence that drifts from claims is how overclaiming
 starts — INFER_BENCH.json and BENCH_CTR.json had gone stale against
 PARITY's round-3 claims, and PARITY's op count lagged the live registry.
-This tool re-runs the benchmark tools, rewrites the artifacts, and syncs
-PARITY.md's registered-op-type count with the live registry, so one
-invocation per round keeps every artifact fresh.
+This tool re-runs the benchmark tools, rewrites those artifacts, and
+syncs PARITY.md's registered-op-type count with the live registry.
 
-Usage: python tools/refresh_evidence.py            (all artifacts)
+Covered: INFER_BENCH.json, BENCH_CTR.json, PARITY.md op count.
+NOT covered (driver-generated at round end, do not hand-edit):
+BENCH_rXX.json (`python bench.py`), MULTICHIP_rXX.json
+(`__graft_entry__.dryrun_multichip`), COPYCHECK.json, BASELINE.json.
+
+Usage: python tools/refresh_evidence.py            (all covered artifacts)
        python tools/refresh_evidence.py ctr parity (a subset)
 """
 
