@@ -36,6 +36,16 @@ class TrainStrategy:
     shard_optimizer_states: bool = True   # Reduce/ZeRO-1 vs AllReduce
     accum_steps: int = 1                  # gradient merge (multi_batch_merge_pass)
     recompute: bool = False               # RecomputeOptimizer
+    # Rematerialization policy when recompute=True (the reference's
+    # RecomputeOptimizer(checkpoints=...) selects WHICH activations to
+    # keep; here the jax.checkpoint policy does):
+    #   None / "nothing"  - save nothing, recompute everything (blanket)
+    #   "dots"            - save every matmul/einsum output (attention
+    #                       scores and projections are NOT recomputed —
+    #                       the long-sequence-friendly policy)
+    #   "dots_no_batch"   - save contraction results with no batch dims
+    #                       (weights-gradient reuse, smaller footprint)
+    recompute_policy: Optional[str] = None
     clip_global_norm: Optional[float] = None
 
 
@@ -111,8 +121,25 @@ def make_train_step(
     batch_spec = batch_spec if batch_spec is not None else rules.spec(("batch", "seq"))
     repl = NamedSharding(mesh, P())
 
+    policies = {
+        None: None,
+        "nothing": jax.checkpoint_policies.nothing_saveable,
+        "dots": jax.checkpoint_policies.dots_saveable,
+        "dots_no_batch":
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    }
+    if strategy.recompute_policy not in policies:
+        raise ValueError(
+            f"unknown recompute_policy {strategy.recompute_policy!r}; "
+            f"choose from {sorted(k for k in policies if k)} or None")
+    if strategy.recompute_policy is not None and not strategy.recompute:
+        raise ValueError(
+            "recompute_policy is set but recompute=False — enable "
+            "recompute=True for the policy to take effect")
     if strategy.recompute:
-        loss_fn = jax.checkpoint(loss_fn)
+        # policy=None is jax.checkpoint's own default (save nothing)
+        loss_fn = jax.checkpoint(
+            loss_fn, policy=policies[strategy.recompute_policy])
 
     tx = optimizer
     if strategy.clip_global_norm:

@@ -55,6 +55,29 @@ def test_bert_zero1_and_grad_accum_match():
     np.testing.assert_allclose(base[0], accum[0], rtol=5e-2)
 
 
+def test_recompute_policies_preserve_numerics():
+    """Rematerialization (reference: RecomputeOptimizer with a
+    checkpoints list, optimizer.py:3267) trades FLOPs for memory without
+    changing math: every recompute policy must reproduce the no-remat
+    loss trajectory exactly (same graph, different schedule)."""
+    base = _train_bert(MeshConfig(dp=2), TrainStrategy(recompute=False))
+    for pol in (None, "nothing", "dots", "dots_no_batch"):
+        got = _train_bert(MeshConfig(dp=2),
+                          TrainStrategy(recompute=True,
+                                        recompute_policy=pol))
+        np.testing.assert_allclose(got, base, rtol=1e-6, atol=1e-7,
+                                   err_msg=f"policy={pol}")
+    with pytest.raises(ValueError, match="recompute_policy"):
+        _train_bert(MeshConfig(dp=2),
+                    TrainStrategy(recompute=True,
+                                  recompute_policy="bogus"))
+    # a policy without recompute=True is a configuration error, not a no-op
+    with pytest.raises(ValueError, match="recompute=False"):
+        _train_bert(MeshConfig(dp=2),
+                    TrainStrategy(recompute=False,
+                                  recompute_policy="dots"))
+
+
 def test_bert_grad_clip_runs():
     losses = _train_bert(MeshConfig(dp=2, tp=2, sp=2),
                          TrainStrategy(clip_global_norm=1.0))
