@@ -1,0 +1,12 @@
+"""Fleet API under the reference's canonical import paths
+(reference: python/paddle/fluid/incubate/fleet/):
+
+    from paddle_tpu.incubate.fleet.collective import fleet          # GSPMD
+    from paddle_tpu.incubate.fleet.parameter_server. \
+        distribute_transpiler import fleet                          # PS
+    from paddle_tpu.incubate.fleet.base.role_maker import \
+        PaddleCloudRoleMaker, UserDefinedRoleMaker
+
+The implementations live in paddle_tpu.parallel.fleet (collective) and
+paddle_tpu.ps.fleet (parameter server); these modules re-export them so
+reference launch scripts port with an import rename only."""

@@ -1,0 +1,1 @@
+"""reference: incubate/fleet/base/ — role makers + the Fleet base."""

@@ -1,0 +1,8 @@
+"""reference: incubate/fleet/base/fleet_base.py — the Fleet contract.
+The collective implementation is paddle_tpu.parallel.fleet.Fleet; the
+parameter-server one is paddle_tpu.ps.fleet.PSFleet."""
+
+from ....parallel.fleet import DistributedOptimizer, Fleet  # noqa: F401
+from ....ps.fleet import PSFleet  # noqa: F401
+
+__all__ = ["Fleet", "PSFleet", "DistributedOptimizer"]

@@ -1,0 +1,10 @@
+"""reference: incubate/fleet/base/role_maker.py — re-exported from
+paddle_tpu.parallel.role_maker (same env contract: PADDLE_TRAINER_ID,
+PADDLE_TRAINER_ENDPOINTS, PADDLE_PSERVERS_IP_PORT_LIST, TRAINING_ROLE)."""
+
+from ....parallel.role_maker import (Role, RoleMakerBase,  # noqa: F401
+                                     PaddleCloudRoleMaker,
+                                     UserDefinedRoleMaker)
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker"]

@@ -1,0 +1,8 @@
+"""reference: incubate/fleet/collective/__init__.py — the collective
+(GSPMD data-parallel) fleet singleton + optimizer wrapper + strategy."""
+
+from ....parallel.fleet import (DistributedOptimizer,  # noqa: F401
+                                Fleet, fleet)
+from ....parallel.strategy import DistributedStrategy  # noqa: F401
+
+__all__ = ["fleet", "Fleet", "DistributedOptimizer", "DistributedStrategy"]

@@ -1,0 +1,3 @@
+"""reference: incubate/fleet/parameter_server/ — PS-mode fleet
+(distribute_transpiler submodule; the closed-source pslib mode is
+replaced by the open TCP PS + box cache, see paddle_tpu/ps/)."""

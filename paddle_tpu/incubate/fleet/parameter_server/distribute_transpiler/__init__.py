@@ -1,0 +1,16 @@
+"""reference: incubate/fleet/parameter_server/distribute_transpiler/
+__init__.py — the transpiler-mode PS fleet singleton:
+
+    fleet.init(role_maker)
+    optimizer = fleet.distributed_optimizer(opt, DistributeTranspilerConfig())
+    optimizer.minimize(cost)
+    fleet.init_server(); fleet.run_server()     # on pservers (blocks)
+    fleet.init_worker(); ...; fleet.stop_worker()  # on trainers
+"""
+
+from .....ps.fleet import (PSFleet, TranspilerOptimizer,  # noqa: F401
+                           fleet)
+from .....ps.transpiler import DistributeTranspilerConfig  # noqa: F401
+
+__all__ = ["fleet", "PSFleet", "TranspilerOptimizer",
+           "DistributeTranspilerConfig"]

@@ -1,0 +1,224 @@
+"""Parameter-server fleet facade (transpiler mode).
+
+Reference: python/paddle/fluid/incubate/fleet/parameter_server/
+distribute_transpiler/__init__.py — the canonical user surface for PS
+training:
+
+    fleet.init(role_maker)
+    optimizer = fleet.distributed_optimizer(optimizer, config)
+    optimizer.minimize(cost)
+    if fleet.is_server():
+        fleet.init_server()
+        fleet.run_server()          # blocks
+    else:
+        fleet.init_worker()
+        exe.run(fleet.startup_program)
+        ... train on fleet.main_program ...
+        fleet.stop_worker()
+
+Wraps this repo's DistributeTranspiler + TCP PS: minimize() transpiles
+the program, init_worker() connects/binds the PSClient and publishes (or
+waits for) initial params, run_server() executes the pserver program's
+blocking listen loop, stop_worker() reports COMPLETED and the first
+worker shuts the servers down once every trainer has."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core import framework
+from ..parallel.role_maker import (PaddleCloudRoleMaker, Role,
+                                   RoleMakerBase, UserDefinedRoleMaker)
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
+
+__all__ = ["fleet", "PSFleet", "TranspilerOptimizer", "Role",
+           "PaddleCloudRoleMaker", "UserDefinedRoleMaker"]
+
+
+class PSFleet:
+    def __init__(self):
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._transpiler: Optional[DistributeTranspiler] = None
+        self._origin_main = None
+        self._origin_startup = None
+        self._client = None
+        self._server = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def init(self, role_maker: Optional[RoleMakerBase] = None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=False)
+        return self
+
+    def is_worker(self) -> bool:
+        return self._role_maker.is_worker()
+
+    def is_server(self) -> bool:
+        return self._role_maker.is_server()
+
+    def is_first_worker(self) -> bool:
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self) -> int:
+        return self._role_maker.worker_index()
+
+    def worker_num(self) -> int:
+        return self._role_maker.worker_num()
+
+    def server_endpoints(self, to_string: bool = False):
+        eps = self._role_maker.get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    # -- optimizer ----------------------------------------------------------
+
+    def distributed_optimizer(self, optimizer,
+                              strategy: Optional[
+                                  DistributeTranspilerConfig] = None):
+        if self._role_maker is None:
+            raise RuntimeError("call fleet.init(role_maker) first")
+        return TranspilerOptimizer(self, optimizer,
+                                   strategy or DistributeTranspilerConfig())
+
+    def _transpile(self, config: DistributeTranspilerConfig):
+        self._origin_main = framework.default_main_program()
+        self._origin_startup = framework.default_startup_program()
+        t = DistributeTranspiler(config)
+        t.transpile(self.worker_index(),
+                    program=self._origin_main,
+                    pservers=self.server_endpoints(to_string=True),
+                    trainers=self.worker_num(),
+                    sync_mode=config.sync_mode)
+        self._transpiler = t
+
+    # -- role-appropriate programs ------------------------------------------
+
+    @property
+    def main_program(self):
+        if self._transpiler is None:
+            raise RuntimeError("minimize() has not transpiled yet")
+        if self.is_server():
+            return self._transpiler.get_pserver_program(
+                self._current_server_endpoint())
+        return self._transpiler.get_trainer_program()
+
+    @property
+    def startup_program(self):
+        return self._origin_startup
+
+    def _current_server_endpoint(self) -> str:
+        import os
+
+        ep = os.environ.get("PS_CURRENT_ENDPOINT") or \
+            os.environ.get("POD_IP_PORT")
+        if ep:
+            return ep
+        # UserDefinedRoleMaker ONLY: its current_id explicitly indexes
+        # the server list when role=SERVER (reference role_maker.py).
+        # PaddleCloudRoleMaker must NOT fall back to worker_index() —
+        # PADDLE_TRAINER_ID is unset on pservers, so every server would
+        # silently resolve eps[0].
+        if isinstance(self._role_maker, UserDefinedRoleMaker):
+            eps = self._role_maker.get_pserver_endpoints()
+            idx = self._role_maker.worker_index()
+            if 0 <= idx < len(eps):
+                return eps[idx]
+        raise RuntimeError(
+            "cannot determine this pserver's endpoint: set "
+            "PS_CURRENT_ENDPOINT or use UserDefinedRoleMaker(current_id=i, "
+            "role=Role.SERVER)")
+
+    # -- server side ---------------------------------------------------------
+
+    def init_server(self):
+        """Prepare the pserver program before run_server.
+
+        Checkpoint restore is a TRAINER-side operation in this
+        architecture: the server's var table is populated by init_var
+        RPCs, so worker 0 restores by io.load_persistables into its
+        scope BEFORE init_worker() — publish_params then pushes the
+        restored values (the server-side save happens via
+        fleet.save_persistables → checkpoint_notify)."""
+        self._server_prog = self.main_program
+
+    def run_server(self):
+        """Execute the pserver listen loop (BLOCKS until shutdown)."""
+        from ..core.executor import Executor
+        from ..core.places import CPUPlace
+
+        if getattr(self, "_server_prog", None) is None:
+            self.init_server()
+        Executor(CPUPlace()).run(self._server_prog)
+
+    # -- worker side ---------------------------------------------------------
+
+    def init_worker(self, scope=None, publish_timeout: float = 120.0):
+        """Connect the PSClient, bind it for ps_send/ps_recv, and make
+        initial params available: the first worker publishes its startup
+        values, the rest wait (the reference's sync init_worker barrier)."""
+        from ..core.executor import global_scope
+        from ..ops.distributed import bind_client
+        from .client import PSClient
+
+        scope = scope or global_scope()
+        self._client = PSClient(self.server_endpoints(),
+                                trainer_id=self.worker_index())
+        bind_client(self._client)
+        t = self._transpiler
+        pnames = sorted(t._param_opt_descs)
+        if self.is_first_worker():
+            t.publish_params(scope, self._client)
+        else:
+            # wait for worker 0's publish, then PULL the published values
+            # into the local scope — every worker must start step 1 from
+            # the SAME parameters (the reference's init_worker sync),
+            # not its own local startup init
+            for n in pnames:
+                if not self._client.wait_var(n, timeout=publish_timeout):
+                    raise RuntimeError(
+                        f"init_worker: param '{n}' was never published by "
+                        f"worker 0 (timeout {publish_timeout}s)")
+                scope.set_var(n, np.asarray(self._client.pull(n)))
+        return self._client
+
+    def stop_worker(self, shutdown_timeout: float = 120.0):
+        """Report COMPLETED; the first worker waits for every trainer and
+        then shuts the servers down (reference fleet.stop_worker)."""
+        if self._client is None:
+            return
+        self._client.heartbeat(state=2)  # COMPLETED
+        if self.is_first_worker():
+            if not self._client.wait_all_completed(
+                    timeout=shutdown_timeout):
+                raise RuntimeError(
+                    f"stop_worker: not every trainer reported COMPLETED "
+                    f"within {shutdown_timeout}s (a peer likely crashed) "
+                    f"— pservers were NOT shut down")
+            self._client.shutdown_servers()
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        """Trainer-initiated server-side checkpoint (checkpoint_notify)."""
+        if self._client is not None and self.is_first_worker():
+            self._client.checkpoint_notify(dirname)
+
+
+class TranspilerOptimizer:
+    """reference: incubate/fleet/parameter_server/distribute_transpiler
+    TranspilerOptimizer — minimize() then transpile."""
+
+    def __init__(self, fleet_: PSFleet, optimizer, config):
+        self._fleet = fleet_
+        self._optimizer = optimizer
+        self._config = config
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        out = self._optimizer.minimize(loss, startup_program,
+                                       parameter_list, no_grad_set)
+        self._fleet._transpile(self._config)
+        return out
+
+
+fleet = PSFleet()

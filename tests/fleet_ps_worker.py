@@ -1,0 +1,66 @@
+"""PS-fleet-facade worker (reference pattern: fleet_ps_training in
+incubate/fleet/tests/fleet_deep_ctr.py — the SAME script runs as pserver
+or trainer, dispatched by fleet.is_server(), with all cluster wiring
+through the fleet API instead of hand-built transpiler calls)."""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.incubate.fleet.base.role_maker import PaddleCloudRoleMaker
+from paddle_tpu.incubate.fleet.parameter_server.distribute_transpiler import (
+    DistributeTranspilerConfig, fleet)
+
+
+def main():
+    fleet.init(PaddleCloudRoleMaker(is_collective=False))
+
+    main_prog, startup = pt.Program(), pt.Program()
+    main_prog.random_seed = startup.random_seed = 7
+    with pt.framework.unique_name.guard(), \
+            pt.program_guard(main_prog, startup):
+        x = pt.layers.data(name="x", shape=[8], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        h = pt.layers.fc(input=x, size=16, act="relu")
+        pred = pt.layers.fc(input=h, size=1)
+        loss = pt.layers.mean(
+            pt.layers.square_error_cost(input=pred, label=y))
+        opt = fleet.distributed_optimizer(
+            pt.optimizer.SGD(learning_rate=0.1),
+            DistributeTranspilerConfig())
+        opt.minimize(loss)
+
+    if fleet.is_server():
+        fleet.init_server()
+        fleet.run_server()  # blocks until the first worker shuts us down
+        return
+
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(fleet.startup_program)
+    fleet.init_worker()
+    rng = np.random.RandomState(5)
+    X = rng.rand(32, 8).astype("float32")
+    Y = (X @ rng.rand(8, 1)).astype("float32")
+    n = 32 // fleet.worker_num()
+    lo = fleet.worker_index() * n
+    Xs, Ys = X[lo:lo + n], Y[lo:lo + n]
+    losses = []
+    for _ in range(10):
+        l = exe.run(fleet.main_program, feed={"x": Xs, "y": Ys},
+                    fetch_list=[loss])[0]
+        losses.append(float(np.asarray(l).reshape(())))
+    fleet.stop_worker()
+    sys.stdout.write(json.dumps({"rank": fleet.worker_index(),
+                                 "losses": losses}) + "\n")
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

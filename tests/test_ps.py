@@ -921,6 +921,49 @@ def test_downpour_training_over_global_shuffle(tmp_path):
         server.stop()
 
 
+def test_ps_fleet_facade_trains_cluster(tmp_path):
+    """The reference's canonical PS user surface (incubate.fleet.
+    parameter_server.distribute_transpiler.fleet — init/
+    distributed_optimizer/init_server/run_server/init_worker/
+    stop_worker): one script (tests/fleet_ps_worker.py) runs as pserver
+    or trainer purely by TRAINING_ROLE, all wiring through the facade.
+    1 pserver + 2 sync trainers must converge and exit cleanly."""
+    (port,) = _free_ports(1)
+    eps = f"127.0.0.1:{port}"
+    env = dict(os.environ,
+               PADDLE_PSERVERS_IP_PORT_LIST=eps,
+               PADDLE_TRAINERS_NUM="2",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    script = os.path.join(REPO, "tests", "fleet_ps_worker.py")
+    ps = subprocess.Popen(
+        [sys.executable, script],
+        env=dict(env, TRAINING_ROLE="PSERVER", PS_CURRENT_ENDPOINT=eps),
+        cwd=REPO)
+    trainers = []
+    try:
+        time.sleep(1.5)
+        for tid in range(2):
+            trainers.append(subprocess.Popen(
+                [sys.executable, script],
+                env=dict(env, TRAINING_ROLE="TRAINER",
+                         PADDLE_TRAINER_ID=str(tid)),
+                cwd=REPO, stdout=subprocess.PIPE, text=True))
+        outs = [p.communicate(timeout=240)[0] for p in trainers]
+        assert all(p.returncode == 0 for p in trainers), outs
+        for o in outs:
+            rec = json.loads(o.strip().splitlines()[-1])
+            assert rec["losses"][-1] < rec["losses"][0], rec
+        # stop_worker's shutdown propagated: the pserver exits by itself
+        assert ps.wait(timeout=60) == 0
+    finally:
+        for p in trainers:
+            if p.poll() is None:
+                p.kill()
+        if ps.poll() is None:
+            ps.kill()
+
+
 @pytest.mark.slow
 def test_launch_ps_cli_runs_cluster():
     """reference: launch_ps.py — one CLI spawns pservers + trainers; the
