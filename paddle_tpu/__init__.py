@@ -28,7 +28,10 @@ from .core.framework import (
 )
 from .core.executor import Executor, global_scope, scope_guard, Scope
 from .core.backward import append_backward, gradients
-from .core.compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from .core.compiler import (CompiledProgram, BuildStrategy,
+                            ExecutionStrategy, ParallelExecutor)
+from .ps.transpiler import (DistributeTranspiler,
+                            DistributeTranspilerConfig)
 from .core import places
 from .core.places import CPUPlace, TPUPlace, CUDAPlace, is_compiled_with_tpu
 from . import layers

@@ -293,3 +293,49 @@ class _ShardedStep:
         for n, v in new_states.items():
             scope.set_var(n, v)
         return fetches, new_rng
+
+
+class ParallelExecutor:
+    """Legacy data-parallel executor facade (reference:
+    parallel_executor.py:28 — ``ParallelExecutor(use_cuda, loss_name,
+    ...)`` predating CompiledProgram.with_data_parallel; same engine
+    underneath here: ONE GSPMD-sharded jit over the local device mesh).
+    ``use_cuda`` maps to "use the accelerator" (TPU on this stack)."""
+
+    def __init__(self, use_cuda: bool = False,
+                 loss_name: Optional[str] = None,
+                 main_program: Optional[Program] = None,
+                 share_vars_from: Optional["ParallelExecutor"] = None,
+                 exec_strategy: Optional[ExecutionStrategy] = None,
+                 build_strategy: Optional[BuildStrategy] = None,
+                 num_trainers: int = 1, trainer_id: int = 0,
+                 scope=None):
+        from .executor import Executor, global_scope
+        from .places import CPUPlace, TPUPlace
+
+        if num_trainers > 1 and not jax.distributed.is_initialized():
+            raise RuntimeError(
+                "num_trainers > 1 requires jax.distributed to be "
+                "initialized (use fleet.init / distributed.launch)")
+        program = main_program or framework.default_main_program()
+        self._scope = scope if scope is not None else global_scope()
+        self._compiled = CompiledProgram(
+            program, build_strategy).with_data_parallel(
+            loss_name=loss_name, exec_strategy=exec_strategy,
+            share_vars_from=(share_vars_from._compiled
+                             if isinstance(share_vars_from,
+                                           ParallelExecutor)
+                             else share_vars_from))
+        self._exe = Executor(TPUPlace() if use_cuda else CPUPlace())
+
+    def run(self, fetch_list=None, feed=None, feed_dict=None,
+            return_numpy: bool = True):
+        """Reference signature: fetch_list FIRST (parallel_executor.py
+        run); feed_dict is the deprecated alias for feed."""
+        return self._exe.run(self._compiled,
+                             feed=feed if feed is not None else feed_dict,
+                             fetch_list=fetch_list, scope=self._scope,
+                             return_numpy=return_numpy)
+
+    def drop_local_exe_scopes(self):
+        """No-op: GSPMD keeps no per-device scopes to drop."""

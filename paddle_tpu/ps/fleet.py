@@ -53,23 +53,28 @@ class PSFleet:
             is_collective=False)
         return self
 
+    def _rm(self) -> RoleMakerBase:
+        if self._role_maker is None:
+            raise RuntimeError("call fleet.init(role_maker) first")
+        return self._role_maker
+
     def is_worker(self) -> bool:
-        return self._role_maker.is_worker()
+        return self._rm().is_worker()
 
     def is_server(self) -> bool:
-        return self._role_maker.is_server()
+        return self._rm().is_server()
 
     def is_first_worker(self) -> bool:
-        return self._role_maker.is_first_worker()
+        return self._rm().is_first_worker()
 
     def worker_index(self) -> int:
-        return self._role_maker.worker_index()
+        return self._rm().worker_index()
 
     def worker_num(self) -> int:
-        return self._role_maker.worker_num()
+        return self._rm().worker_num()
 
     def server_endpoints(self, to_string: bool = False):
-        eps = self._role_maker.get_pserver_endpoints()
+        eps = self._rm().get_pserver_endpoints()
         return ",".join(eps) if to_string else eps
 
     # -- optimizer ----------------------------------------------------------
@@ -82,9 +87,14 @@ class PSFleet:
         return TranspilerOptimizer(self, optimizer,
                                    strategy or DistributeTranspilerConfig())
 
-    def _transpile(self, config: DistributeTranspilerConfig):
-        self._origin_main = framework.default_main_program()
-        self._origin_startup = framework.default_startup_program()
+    def _transpile(self, config: DistributeTranspilerConfig,
+                   main_program=None, startup_program=None):
+        # the program that actually holds the optimize ops (loss.block.
+        # program — the user may have built it under a program_guard that
+        # has since exited), NOT necessarily the global default
+        self._origin_main = main_program or framework.default_main_program()
+        self._origin_startup = (startup_program
+                                or framework.default_startup_program())
         t = DistributeTranspiler(config)
         t.transpile(self.worker_index(),
                     program=self._origin_main,
@@ -199,8 +209,17 @@ class PSFleet:
             self._client.shutdown_servers()
 
     def save_persistables(self, executor, dirname, main_program=None):
-        """Trainer-initiated server-side checkpoint (checkpoint_notify)."""
-        if self._client is not None and self.is_first_worker():
+        """Trainer-initiated server-side checkpoint (checkpoint_notify).
+        Only worker 0 notifies (the reference's first-worker-saves
+        semantic); non-first workers no-op by design."""
+        if not self.is_worker():
+            raise RuntimeError(
+                "save_persistables is a worker-side call (servers persist "
+                "via the checkpoint_notify they receive)")
+        if self._client is None:
+            raise RuntimeError(
+                "save_persistables before init_worker(): no PS connection")
+        if self.is_first_worker():
             self._client.checkpoint_notify(dirname)
 
 
@@ -217,7 +236,9 @@ class TranspilerOptimizer:
                  no_grad_set=None):
         out = self._optimizer.minimize(loss, startup_program,
                                        parameter_list, no_grad_set)
-        self._fleet._transpile(self._config)
+        self._fleet._transpile(self._config,
+                               main_program=loss.block.program,
+                               startup_program=startup_program)
         return out
 
 
