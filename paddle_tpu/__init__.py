@@ -72,6 +72,87 @@ import sys as _sys
 
 fluid = _sys.modules[__name__]
 
+# top-level conveniences the reference exposes on the fluid package.
+# NOTE: fluid.embedding / fluid.one_hot are the V2 semantics (reference
+# input.py — lookup_table_v2 / one_hot_v2: NO trailing-1 squeeze), which
+# differ from layers.embedding / layers.one_hot (v1 ops).
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """reference: input.py `embedding` → lookup_table_v2 (keeps the id
+    tensor's shape: ids [N, 1] → out [N, 1, D], unlike layers.embedding
+    whose v1 op squeezes the trailing 1)."""
+    from .layer_helper import LayerHelper
+
+    helper = LayerHelper("embedding", param_attr=param_attr)
+    w = helper.create_parameter(param_attr, shape=list(size), dtype=dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    pidx = -1 if padding_idx is None else (
+        padding_idx if padding_idx >= 0 else size[0] + padding_idx)
+    helper.append_op(type="lookup_table_v2",
+                     inputs={"W": w, "Ids": input},
+                     outputs={"Out": out},
+                     attrs={"padding_idx": pidx, "is_sparse": is_sparse,
+                            "is_distributed": is_distributed})
+    return out
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    """reference: input.py `one_hot` → one_hot_v2 (appends the depth dim
+    to the UNCHANGED input shape: [N, 1] → [N, 1, depth], unlike
+    layers.one_hot whose v1 op replaces a trailing 1)."""
+    from .layer_helper import LayerHelper
+
+    helper = LayerHelper("one_hot_v2")
+    out = helper.create_variable_for_type_inference("float32")
+    helper.append_op(type="one_hot_v2", inputs={"X": input},
+                     outputs={"Out": out},
+                     attrs={"depth": depth,
+                            "allow_out_of_range": allow_out_of_range})
+    return out
+
+
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def name_scope(prefix: str = ""):
+    """reference: framework.name_scope — cosmetic op-name grouping for
+    graph visualization. Ops here are anonymous in the IR, so the scope
+    is purely for source compatibility."""
+    yield
+
+
+def is_compiled_with_cuda() -> bool:
+    """Reference API; this framework targets TPU (always False)."""
+    return False
+
+
+def require_version(min_version: str, max_version=None):
+    """reference: framework.require_version — raise when the installed
+    version falls outside [min_version, max_version]. Components are
+    zero-padded to equal length before comparison ("0.1" == "0.1.0");
+    non-numeric suffixes participate as strings so "0.1.0rc1" != "0.1.0"."""
+    def parse(v, width):
+        parts = []
+        for p in str(v).split("."):
+            num = "".join(ch for ch in p if ch.isdigit())
+            parts.append((int(num) if num else 0,
+                          "".join(ch for ch in p if not ch.isdigit())))
+        parts += [(0, "")] * (width - len(parts))
+        return tuple(parts)
+
+    width = max(len(str(v).split(".")) for v in
+                (__version__, min_version, max_version or "0"))
+    cur = parse(__version__, width)
+    if parse(min_version, width) > cur:
+        raise RuntimeError(
+            f"installed version {__version__} < required {min_version}")
+    if max_version is not None and parse(max_version, width) < cur:
+        raise RuntimeError(
+            f"installed version {__version__} > allowed {max_version}")
+
 
 def set_global_seed(seed: int):
     """Seed program-level RNG (reference: fluid.Program.random_seed)."""

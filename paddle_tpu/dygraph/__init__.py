@@ -8,7 +8,9 @@ by the static path — one op registry serves both modes (SURVEY §7 step 9).
 """
 
 from . import base
-from .base import guard, enable_dygraph, disable_dygraph, to_variable, enabled, grad
+from .base import (guard, enable_dygraph, disable_dygraph, to_variable,
+                   enabled, grad, no_grad)
+from .jit import TracedLayer
 from .tracer import Tracer
 from .varbase import VarBase
 from .layers import Layer

@@ -280,6 +280,50 @@ def test_dygraph_lr_schedules_match_static_formulas():
             noam(), 64 ** -0.5 * min(n ** -0.5, n * 3 ** -1.5), rtol=1e-6)
 
 
+def test_traced_layer_matches_dygraph_and_serves(tmp_path, rng):
+    """Dygraph-to-static tracing (reference: dygraph/jit.py TracedLayer):
+    trace a dygraph net once, the captured static Program reproduces the
+    eager outputs exactly, and save_inference_model produces a model dir
+    BOTH engines load and agree on."""
+    class Net(pt.dygraph.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = pt.dygraph.Linear(8, 16)
+            self.fc2 = pt.dygraph.Linear(16, 3)
+
+        def forward(self, x):
+            h = pt.layers.relu(self.fc1(x))
+            return self.fc2(h)
+
+    X = rng.randn(4, 8).astype("float32")
+    X2 = rng.randn(6, 8).astype("float32")  # different batch at run time
+    with pt.dygraph.guard():
+        net = Net()
+        x = pt.dygraph.to_variable(X)
+        dy_out, traced = pt.dygraph.TracedLayer.trace(net, [x])
+        dy_np = np.asarray(dy_out.numpy()).copy()
+        st_out = traced([x])
+        np.testing.assert_allclose(np.asarray(st_out[0].numpy()), dy_np,
+                                   rtol=1e-5, atol=1e-6)
+        # new data through the traced program matches eager on same data
+        dy2 = np.asarray(net(pt.dygraph.to_variable(X2)).numpy()).copy()
+        st2 = traced([pt.dygraph.to_variable(X2)])
+        np.testing.assert_allclose(np.asarray(st2[0].numpy()), dy2,
+                                   rtol=1e-5, atol=1e-6)
+        d = str(tmp_path / "traced")
+        traced.save_inference_model(d)
+
+    out_xla = list(pt.create_paddle_predictor(
+        pt.AnalysisConfig(d)).predict(**{traced._feed_names[0]: X}
+                                      ).values())[0]
+    np.testing.assert_allclose(out_xla, dy_np, rtol=1e-5, atol=1e-6)
+    cfg = pt.AnalysisConfig(d)
+    cfg.enable_native_engine()
+    out_nat = list(pt.create_paddle_predictor(cfg).predict(
+        **{traced._feed_names[0]: X}).values())[0]
+    np.testing.assert_allclose(out_nat, dy_np, rtol=1e-4, atol=1e-5)
+
+
 def test_dygraph_matches_static(rng):
     """reference pattern: test_imperative_mnist.py compares dygraph vs
     static results for the same weights."""
