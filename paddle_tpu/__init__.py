@@ -33,7 +33,8 @@ from .core.compiler import (CompiledProgram, BuildStrategy,
 from .ps.transpiler import (DistributeTranspiler,
                             DistributeTranspilerConfig)
 from .core import places
-from .core.places import CPUPlace, TPUPlace, CUDAPlace, is_compiled_with_tpu
+from .core.places import (CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
+                          TPUPinnedPlace, XPUPlace, is_compiled_with_tpu)
 from . import layers
 from . import initializer
 from . import regularizer
@@ -122,6 +123,83 @@ def name_scope(prefix: str = ""):
     graph visualization. Ops here are anonymous in the IR, so the scope
     is purely for source compatibility."""
     yield
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """reference: fluid/data.py `fluid.data` — the NEW-style feed var
+    whose `shape` INCLUDES the batch dim (None/-1 for dynamic), unlike
+    layers.data which prepends one."""
+    shape = [(-1 if s is None else int(s)) for s in shape]
+    return layers.data(name=name, shape=shape, dtype=dtype,
+                       append_batch_size=False, lod_level=lod_level)
+
+
+def cpu_places(device_count=None):
+    """reference: framework.cpu_places (CPU_NUM env). On this stack the
+    CPU side is the host process; a single place unless asked."""
+    import os as _os
+
+    n = device_count if device_count is not None else int(
+        _os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """reference: framework.cuda_places — accelerator places. Maps to the
+    available TPU devices (CUDAPlace aliases TPUPlace here)."""
+    import os as _os
+
+    import jax as _jax
+
+    if device_ids is None:
+        sel = _os.environ.get("FLAGS_selected_gpus", "")
+        device_ids = ([int(s) for s in sel.split(",") if s.strip()]
+                      if sel else range(len(_jax.devices())))
+    return [TPUPlace(i) for i in device_ids]
+
+
+def device_guard(device=None):
+    """reference: framework.device_guard — per-op placement hint. XLA
+    owns placement on TPU; accepted for source compatibility."""
+    return _contextlib.nullcontext()
+
+
+def memory_optimize(*args, **kwargs):
+    """Deprecated in the reference (io.py memory_optimize: 'has no
+    effect'); XLA buffer assignment owns memory here. No-op."""
+    import warnings as _w
+
+    _w.warn("memory_optimize is deprecated and has no effect "
+            "(XLA buffer assignment handles memory reuse)",
+            DeprecationWarning)
+
+
+def release_memory(*args, **kwargs):
+    """Deprecated reference API — no-op (see memory_optimize)."""
+    import warnings as _w
+
+    _w.warn("release_memory is deprecated and has no effect",
+            DeprecationWarning)
+
+
+def create_lod_tensor(*args, **kwargs):
+    """LoD tensors are a documented refusal on TPU (SURVEY §5): variable
+    length is padded batches + explicit lengths/masks. Raise loudly with
+    the migration recipe instead of AttributeError."""
+    raise NotImplementedError(
+        "LoDTensor does not exist on TPU: XLA needs static shapes. "
+        "Migrate to padded batches + a `length`/mask tensor — every "
+        "sequence op here takes an explicit `length` input (see the "
+        "sequence op group in paddle_tpu/ops/sequence.py)")
+
+
+def load_op_library(path):
+    """reference: framework.load_op_library (custom C++/CUDA op .so).
+    Custom ops here are JAX/Pallas kernels registered in Python."""
+    raise NotImplementedError(
+        "custom op libraries are not loadable on TPU; register a JAX "
+        "kernel instead: paddle_tpu.core.registry.register_op "
+        "(Pallas for hand-tuned TPU kernels)")
 
 
 def is_compiled_with_cuda() -> bool:
