@@ -34,7 +34,8 @@ from .ps.transpiler import (DistributeTranspiler,
                             DistributeTranspilerConfig)
 from .core import places
 from .core.places import (CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace,
-                          TPUPinnedPlace, XPUPlace, is_compiled_with_tpu)
+                          TPUPinnedPlace, XPUPlace, is_compiled_with_cuda,
+                          is_compiled_with_tpu)
 from . import layers
 from . import initializer
 from . import regularizer
@@ -153,8 +154,10 @@ def cuda_places(device_ids=None):
 
     if device_ids is None:
         sel = _os.environ.get("FLAGS_selected_gpus", "")
+        # LOCAL devices: TPUPlace.jax_device indexes jax.local_devices()
+        # (places.py) — global enumeration would overflow on multi-host
         device_ids = ([int(s) for s in sel.split(",") if s.strip()]
-                      if sel else range(len(_jax.devices())))
+                      if sel else range(len(_jax.local_devices())))
     return [TPUPlace(i) for i in device_ids]
 
 
@@ -200,11 +203,6 @@ def load_op_library(path):
         "custom op libraries are not loadable on TPU; register a JAX "
         "kernel instead: paddle_tpu.core.registry.register_op "
         "(Pallas for hand-tuned TPU kernels)")
-
-
-def is_compiled_with_cuda() -> bool:
-    """Reference API; this framework targets TPU (always False)."""
-    return False
 
 
 def require_version(min_version: str, max_version=None):
