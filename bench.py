@@ -329,25 +329,172 @@ def bench_bert_long(mesh, n_chips, platform, on_tpu):
     return ok
 
 
-def main():
+# ---------------------------------------------------------------------------
+# Orchestration: the round-4 post-mortem (VERDICT r4) showed a single wedged
+# TPU tunnel zeroing the whole file (rc=1, no metrics). The parent process
+# below therefore NEVER initializes a jax backend: it probes backend health
+# in a bounded subprocess, then runs each metric in its own subprocess with
+# its own timeout, forwarding the JSON lines. A hang or crash in one metric
+# costs exactly that metric (a structured {"metric":..., "error":...} line),
+# never the file.
+# ---------------------------------------------------------------------------
+
+# (name, tpu_metric, cpu_metric, timeout_s); bert prints LAST (flagship).
+BENCHES = [
+    ("lenet", "lenet_mnist_program_smoke_samples_per_sec",
+     "lenet_mnist_program_smoke_samples_per_sec", 600),
+    ("resnet50", "resnet50_train_samples_per_sec_per_chip",
+     "resnet_tiny_cpu_samples_per_sec", 900),
+    ("transformer", "transformer_big_nmt_train_samples_per_sec_per_chip",
+     "transformer_tiny_cpu_samples_per_sec", 900),
+    ("bert_long", "bert_long_seq4096_train_samples_per_sec_per_chip",
+     None, 900),  # CPU ladder covers tiny BERT; long-seq is TPU-only
+    ("bert", "bert_base_train_samples_per_sec_per_chip",
+     "bert_tiny_cpu_samples_per_sec", 900),
+]
+_BENCH_FNS = {
+    "lenet": bench_lenet_smoke, "resnet50": bench_resnet50,
+    "transformer": bench_transformer_big, "bert_long": bench_bert_long,
+    "bert": bench_bert,
+}
+
+
+def run_one(name):
+    """Child mode: run one bench in-process (the only mode that touches jax
+    backends)."""
+    import os
+
+    if os.environ.get("PADDLE_TPU_BENCH_FORCE_CPU"):
+        # The baked sitecustomize overrides JAX_PLATFORMS after env
+        # parsing; the config update is the only reliable CPU pin.
+        jax.config.update("jax_platforms", "cpu")
     from paddle_tpu.parallel import MeshConfig, make_mesh
 
     platform = jax.devices()[0].platform
     on_tpu = platform == "tpu"
     mesh = make_mesh(MeshConfig(dp=-1), devices=jax.devices()[:1]) \
         if len(jax.devices()) == 1 else make_mesh(MeshConfig(dp=-1))
-    n_chips = mesh.devices.size
-
-    ok = True
-    for bench in (bench_lenet_smoke, bench_resnet50, bench_transformer_big,
-                  bench_bert_long, bench_bert):
-        ok = bench(mesh, n_chips, platform, on_tpu) and ok
-        jax.clear_caches()  # free compiled executables between configs
-    # BASELINE config 5 (ResNet-50 data-parallel on v5e-8) needs 8 real
-    # chips; its sharded step is validated by __graft_entry__.dryrun and
-    # the ParallelExecutor parity tests on the virtual mesh.
+    ok = _BENCH_FNS[name](mesh, mesh.devices.size, platform, on_tpu)
     return 0 if ok else 1
 
 
+def _probe_backend(timeout_s):
+    """Probe default-platform health in a throwaway subprocess (a wedged
+    tunnel hangs *inside* backend init — only a killable process
+    boundary bounds it). Returns the platform string or None."""
+    import subprocess
+
+    code = ("import jax, json; d = jax.devices(); import jax.numpy as jnp;"
+            " v = float(jnp.ones((128, 128)).sum());"
+            " print(json.dumps({'platform': d[0].platform, 'ok': v == 16384.0}))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+        if proc.returncode == 0:
+            info = json.loads(proc.stdout.strip().splitlines()[-1])
+            if info.get("ok"):
+                return info["platform"]
+    except (subprocess.TimeoutExpired, ValueError, IndexError, OSError):
+        pass
+    return None
+
+
+def _emit_error(metric, error):
+    print(json.dumps({"metric": metric, "value": 0.0,
+                      "unit": "samples/s/chip", "vs_baseline": 0.0,
+                      "error": error[:300]}), flush=True)
+
+
+def _forward_child_output(stdout, stderr):
+    """Pass the child's JSON metric lines through; anything else (jax
+    warnings, tracebacks) goes to stderr. Returns emitted metric names."""
+    emitted = []
+    for line in (stdout or "").splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            rec = None
+        if not isinstance(rec, dict) or "metric" not in rec:
+            print(line, file=sys.stderr)
+            continue
+        print(line, flush=True)
+        emitted.append(rec["metric"])
+    if stderr:
+        sys.stderr.write(stderr[-4000:])
+    return emitted
+
+
+def main():
+    import os
+    import subprocess
+
+    from paddle_tpu.core.tpu_lock import tpu_singleflight
+
+    deadline = time.monotonic() + float(
+        os.environ.get("PADDLE_TPU_BENCH_DEADLINE_S", "3000"))
+    with tpu_singleflight(timeout=600.0):
+        if os.environ.get("PADDLE_TPU_BENCH_FORCE_CPU"):
+            platform = "cpu"  # explicit CPU run: skip the TPU probe
+        else:
+            platform = _probe_backend(240) or (time.sleep(20) or
+                                               _probe_backend(180))
+        env = dict(os.environ)
+        if platform is None:
+            # Wedged/absent default backend: record a structured failure
+            # per TPU metric, then still exercise the ladder on CPU so
+            # the bench machinery itself stays verified. Metrics whose
+            # name is platform-independent (lenet smoke) are skipped
+            # here — the CPU fallback emits the real line under the
+            # same name and a 0.0 error twin would contradict it.
+            for _, tpu_metric, cpu_metric, _ in BENCHES:
+                if tpu_metric != cpu_metric:
+                    _emit_error(tpu_metric,
+                                "TPU backend probe failed/hung (bounded "
+                                "at 240s+180s); falling back to CPU")
+            env["PADDLE_TPU_BENCH_FORCE_CPU"] = "1"
+        on_tpu = platform == "tpu"
+
+        all_ok = platform is not None
+        here = os.path.abspath(__file__)
+        for name, tpu_metric, cpu_metric, tmo in BENCHES:
+            expected = tpu_metric if on_tpu else cpu_metric
+            budget = min(tmo, deadline - time.monotonic())
+            if budget < 60:
+                if expected:
+                    _emit_error(expected, "bench deadline exhausted before "
+                                "this metric started")
+                all_ok = False
+                continue
+            try:
+                proc = subprocess.run(
+                    [sys.executable, here, "--one", name], env=env,
+                    capture_output=True, text=True, timeout=budget)
+                emitted = _forward_child_output(proc.stdout, proc.stderr)
+                if proc.returncode != 0:
+                    all_ok = False
+                if expected and expected not in emitted:
+                    _emit_error(expected,
+                                f"bench subprocess rc={proc.returncode} "
+                                "exited without emitting this metric")
+            except subprocess.TimeoutExpired as e:
+                _forward_child_output(
+                    e.stdout.decode() if isinstance(e.stdout, bytes)
+                    else e.stdout,
+                    e.stderr.decode() if isinstance(e.stderr, bytes)
+                    else e.stderr)
+                if expected:
+                    _emit_error(expected,
+                                f"bench subprocess timed out after "
+                                f"{budget:.0f}s (killed)")
+                all_ok = False
+        # BASELINE config 5 (ResNet-50 data-parallel on v5e-8) needs 8
+        # real chips; its sharded step is validated by
+        # __graft_entry__.dryrun and the ParallelExecutor parity tests.
+        return 0 if all_ok else 1
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--one":
+        sys.exit(run_one(sys.argv[2]))
     sys.exit(main())

@@ -1,0 +1,20 @@
+"""Victim for tests/test_proc_hygiene.py — NOT collected in normal runs
+(filename doesn't match python_files); run explicitly by the meta-test.
+
+Spawns a long-sleeping child, records its pid, then fails the assertion —
+modelling the round-4 leak where a trainer assertion stranded pserver
+children. The conftest autouse reaper must kill the child anyway.
+"""
+
+import os
+import subprocess
+import sys
+
+
+def test_spawn_child_then_fail():
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(300)"])
+    pid_file = os.environ["META_PID_FILE"]
+    with open(pid_file, "w") as f:
+        f.write(str(proc.pid))
+    assert False, "deliberate failure: teardown must still reap the child"

@@ -36,6 +36,106 @@ def pytest_configure(config):
         "markers", "slow: long-running multi-process tests")
 
 
+# ---------------------------------------------------------------------------
+# Subprocess hygiene (round-4 post-mortem: six ps_worker.py orphans leaked by
+# an assertion path wedged the single TPU chip for every later job). Every
+# Popen created anywhere during a test — test code, paddle_tpu launchers,
+# subprocess.run internals — is registered here and kill-waited at that
+# test's teardown regardless of outcome, so no assertion failure or
+# communicate() timeout can strand a pserver/trainer child. Reference
+# analogue: test_dist_base's unconditional kill-and-join discipline
+# (/root/reference/python/paddle/fluid/tests/unittests/test_dist_base.py:629).
+# ---------------------------------------------------------------------------
+
+import subprocess as _subprocess  # noqa: E402
+
+_live_procs = []
+_OrigPopen = _subprocess.Popen
+
+
+class _TrackedPopen(_OrigPopen):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        _live_procs.append(self)
+
+
+_subprocess.Popen = _TrackedPopen
+
+
+def _kill_wait(proc):
+    try:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+    except (OSError, _subprocess.TimeoutExpired):
+        # TimeoutExpired: child stuck in uninterruptible sleep (D-state on
+        # a wedged tunnel ioctl) — nothing more we can do, but the
+        # remaining procs/streams must still get their cleanup.
+        pass
+    for stream in (proc.stdin, proc.stdout, proc.stderr):
+        try:
+            if stream:
+                stream.close()
+        except OSError:
+            pass
+
+
+@pytest.fixture(autouse=True)
+def _reap_spawned_processes():
+    """Kill-wait every subprocess spawned during the test, pass or fail."""
+    start = len(_live_procs)
+    yield
+    for proc in _live_procs[start:]:
+        _kill_wait(proc)
+    del _live_procs[start:]
+
+
+_WORKER_SCRIPTS = ("tests/ps_worker.py", "tests/fleet_ps_worker.py",
+                   "tests/dygraph_dp_worker.py", "tests/hybrid_mesh_worker.py",
+                   "tests/dist_mnist_like.py")
+
+
+def reap_stray_workers():
+    """SIGKILL python processes (ours or reparented-to-init orphans)
+    running one of this repo's worker scripts. Matched conservatively —
+    python interpreter argv0 plus a worker-script argument — so an
+    editor or grep whose cmdline merely mentions the path is never
+    touched. Returns the pids reaped."""
+    import glob
+    import signal
+
+    reaped = []
+    for pid_dir in glob.glob("/proc/[0-9]*"):
+        pid = int(pid_dir.rsplit("/", 1)[1])
+        if pid == os.getpid():
+            continue
+        try:
+            with open(pid_dir + "/cmdline", "rb") as f:
+                argv = [a.decode(errors="replace")
+                        for a in f.read().split(b"\0") if a]
+        except OSError:
+            continue
+        if not argv or "python" not in os.path.basename(argv[0]):
+            continue
+        if any(any(a.endswith(w) for w in _WORKER_SCRIPTS)
+               for a in argv[1:]):
+            try:
+                os.kill(pid, signal.SIGKILL)
+                reaped.append(pid)
+            except OSError:
+                pass
+    return reaped
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # Belt-and-braces: anything that escaped per-test teardown (e.g. a
+    # grandchild reparented to init) is reaped by cmdline at session end.
+    for proc in _live_procs:
+        _kill_wait(proc)
+    _live_procs.clear()
+    reap_stray_workers()
+
+
 @pytest.fixture(autouse=True)
 def _fresh_programs():
     """Each test gets fresh default programs + scope (the reference's tests

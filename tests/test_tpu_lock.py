@@ -1,0 +1,94 @@
+"""Single-flight TPU lock tests (VERDICT r4 item 6).
+
+One tunneled chip; concurrent backend init wedges both processes. The
+lock serializes bench.py and every tools/ entry. These tests prove the
+three load-bearing behaviors: mutual exclusion, automatic release when
+a holder dies (an aborted tool run can't wedge the next bench), and
+lease-expiry kill of a hung holder INCLUDING its subprocess tree.
+"""
+
+import json
+import multiprocessing as mp
+import os
+import subprocess
+import sys
+import time
+
+from paddle_tpu.core import tpu_lock
+
+
+def _hold(lock_path, lease, hold_s, q):
+    fd = tpu_lock.acquire(timeout=10, lease_s=lease, lock_path=lock_path)
+    q.put(os.getpid())
+    time.sleep(hold_s)
+    tpu_lock.release(fd)
+
+
+def test_mutual_exclusion(tmp_path):
+    path = str(tmp_path / "lock")
+    q = mp.Queue()
+    proc = mp.Process(target=_hold, args=(path, 60, 3, q))
+    proc.start()
+    q.get(timeout=10)
+    t0 = time.time()
+    with tpu_lock.tpu_singleflight(timeout=30, lock_path=path):
+        waited = time.time() - t0
+    proc.join(timeout=10)
+    assert 2 < waited < 15, f"should have waited for the 3s holder: {waited}"
+
+
+def test_aborted_holder_releases_immediately(tmp_path):
+    """SIGKILLed holder (aborted tool run) => flock released by the kernel;
+    the next acquire must succeed without waiting for any lease."""
+    path = str(tmp_path / "lock")
+    q = mp.Queue()
+    proc = mp.Process(target=_hold, args=(path, 3600, 300, q))
+    proc.start()
+    q.get(timeout=10)
+    proc.kill()  # abort mid-hold, no release() runs
+    proc.join(timeout=10)
+    t0 = time.time()
+    with tpu_lock.tpu_singleflight(timeout=30, lock_path=path):
+        waited = time.time() - t0
+    assert waited < 10, f"lock not auto-released by holder death: {waited}"
+
+
+def test_expired_lease_holder_and_children_killed(tmp_path):
+    """A holder alive past its lease is SIGKILLed together with its
+    descendant subprocesses (bench children drive the chip; killing only
+    the parent would orphan them mid-compile)."""
+    path = str(tmp_path / "lock")
+    pid_file = tmp_path / "pids.json"
+    script = f"""
+import json, os, subprocess, sys, time
+sys.path.insert(0, {json.dumps(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))})
+from paddle_tpu.core import tpu_lock
+fd = tpu_lock.acquire(timeout=10, lease_s=1.0, lock_path={json.dumps(path)})
+child = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(300)"])
+json.dump({{"holder": os.getpid(), "child": child.pid}},
+          open({json.dumps(str(pid_file))}, "w"))
+time.sleep(300)
+"""
+    proc = subprocess.Popen([sys.executable, "-c", script])
+    deadline = time.time() + 20
+    while not pid_file.exists() and time.time() < deadline:
+        time.sleep(0.2)
+    pids = json.loads(pid_file.read_text())
+    time.sleep(1.2)  # let the 1s lease expire
+    t0 = time.time()
+    with tpu_lock.tpu_singleflight(timeout=30, lock_path=path):
+        waited = time.time() - t0
+    assert waited < 15, f"expired holder not killed in time: {waited}"
+    proc.wait(timeout=10)
+    assert proc.returncode == -9, f"holder not SIGKILLed: {proc.returncode}"
+    for _ in range(50):
+        if not os.path.exists(f"/proc/{pids['child']}"):
+            break
+        with open(f"/proc/{pids['child']}/stat") as f:
+            if f.read().split()[2] == "Z":
+                break
+        time.sleep(0.2)
+    else:
+        raise AssertionError(
+            f"holder's child {pids['child']} survived the lease-expiry kill")

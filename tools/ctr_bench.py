@@ -246,4 +246,5 @@ def main():
 
 
 if __name__ == "__main__":
+    # CPU-pinned (PS/TCP benchmark — no chip involvement): no TPU lock.
     sys.exit(main())

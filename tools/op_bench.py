@@ -78,4 +78,7 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    from paddle_tpu.core.tpu_lock import tpu_singleflight
+
+    with tpu_singleflight():  # one real chip: serialize vs bench/tools
+        sys.exit(main())

@@ -49,7 +49,10 @@ def run(data_format="NHWC", bs=256, n_steps=20, hw=224):
 
 
 if __name__ == "__main__":
+    from paddle_tpu.core.tpu_lock import tpu_singleflight
+
     fmt = (sys.argv[1] if len(sys.argv) > 1 else "nhwc").upper()
     bs = int(sys.argv[2]) if len(sys.argv) > 2 else 256
     steps = int(sys.argv[3]) if len(sys.argv) > 3 else 20
-    run(fmt, bs, steps)
+    with tpu_singleflight():  # one real chip: serialize vs bench/tools
+        run(fmt, bs, steps)

@@ -216,4 +216,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    from paddle_tpu.core.tpu_lock import tpu_singleflight
+
+    with tpu_singleflight():  # one real chip: serialize vs bench/tools
+        main()
