@@ -26,12 +26,19 @@ class Communicator:
             if op.type == "ps_send":
                 op._set_attr("use_communicator", True)
                 send_vars.append(op.attrs.get("var_name"))
-            elif op.type == "ps_recv":
+            elif op.type == "ps_send_many":
+                op._set_attr("use_communicator", True)
+                send_vars.extend(op.attrs.get("var_names", []))
+            elif op.type in ("ps_recv", "ps_recv_many"):
                 # the recv thread is authoritative; in-graph recv becomes
-                # a pass-through of the scope value (reference sets
-                # do_not_run on recv ops, communicator.py:42)
+                # a pass-through of the communicator's host cache
+                # (reference sets do_not_run on recv ops,
+                # communicator.py:42)
                 op._set_attr("do_not_run", True)
-                recv_params.append(op.attrs.get("var_name"))
+                if op.type == "ps_recv":
+                    recv_params.append(op.attrs.get("var_name"))
+                else:
+                    recv_params.extend(op.attrs.get("var_names", []))
         self.send_vars = send_vars
         self.recv_params = recv_params
         self._comm = AsyncCommunicator(get_client())

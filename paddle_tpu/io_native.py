@@ -185,10 +185,17 @@ class InMemoryNativeDataset(NativeDataset):
     shuffle_put → shuffle_done → shuffle_take barriers until every
     trainer routed, then hands back this trainer's shard."""
 
-    def __init__(self, *args, **kwargs):
+    def __init__(self, *args, merge_by_insid=False, **kwargs):
         super().__init__(*args, **kwargs)
         self._h = None  # persistent handle holding the memory container
         self._loaded = False
+        # Routing policy, matching the reference's split: the DEFAULT
+        # GlobalShuffle routes each record uniformly at random
+        # (data_set.cc GlobalShuffle), so duplicate-heavy CTR datasets
+        # stay balanced; content-hash routing (identical records
+        # co-locate on one trainer) is opt-in for merge-by-ins-id
+        # semantics (data_set.cc MergeByInsId preprocessing).
+        self._merge_by_insid = bool(merge_by_insid)
 
     def _handle(self):
         if self._h is None:
@@ -244,14 +251,24 @@ class InMemoryNativeDataset(NativeDataset):
         seed = int(out["seed"])
 
         recs = self._mem_records()
-        # routing hash computed NATIVELY (datafeed.cc ptio_mem_route):
-        # per-record Python work would bottleneck CTR-scale passes, and
-        # the C implementation is identical in every trainer process so
-        # the exactly-one-trainer invariant holds by construction
-        targets = np.empty(recs.shape[0], np.int64)
-        self._lib.ptio_mem_route(
-            self._handle(), ctypes.c_uint64(seed), nt,
-            targets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if self._merge_by_insid:
+            # content-hash routing (identical records co-locate),
+            # computed NATIVELY (datafeed.cc ptio_mem_route): a
+            # 10M-record route costs no per-record Python work
+            targets = np.empty(recs.shape[0], np.int64)
+            self._lib.ptio_mem_route(
+                self._handle(), ctypes.c_uint64(seed), nt,
+                targets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        else:
+            # reference-default routing: uniform random per record.
+            # Exactly-once holds because each record lives on exactly
+            # one trainer, which routes it to exactly one target —
+            # cross-trainer agreement on the route is NOT needed.
+            # Positional RNG (not content hash) so duplicate records
+            # spread across trainers instead of skewing one shard.
+            rs = np.random.RandomState(
+                (seed ^ (0x9E3779B9 * (tid + 1))) & 0x7FFFFFFF)
+            targets = rs.randint(0, nt, recs.shape[0]).astype(np.int64)
         # records hashed back to THIS trainer never leave the process;
         # only the cross-trainer fraction rides the PS exchange (the
         # reference's GlobalShuffle routes trainer-to-trainer for the

@@ -19,14 +19,48 @@ LIB_DIR = os.path.join(_REPO, "native", "build")
 SRC_DIR = os.path.join(_REPO, "native", "src")
 
 
+def _build_stamp(src: str, extra_flags: Sequence[str]) -> str:
+    """Staleness key: source bytes + flags + host CPU model. The CPU model
+    matters because callers pass ``-march=native`` — a cached .so reused
+    on a different CPU would SIGILL at first call, which the
+    load-failure rebuild below cannot catch (the load succeeds)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(src, "rb") as f:
+        h.update(f.read())
+    h.update("\0".join(extra_flags).encode())
+    try:
+        seen = set()
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                key = line.split(":", 1)[0].strip()
+                if key in ("model name", "flags") and key not in seen:
+                    seen.add(key)  # first core's entry is enough
+                    h.update(line.encode())
+                if len(seen) == 2:
+                    break
+    except OSError:
+        import platform
+
+        h.update(platform.processor().encode())
+    return h.hexdigest()
+
+
 def build_and_load(src: str, lib_path: str,
                    extra_flags: Sequence[str] = ()) -> ctypes.CDLL:
     """Compile `src` into `lib_path` when missing/stale, then CDLL it.
 
-    Raises on compile failure. A load failure of an up-to-date file
-    triggers ONE rebuild (covers a partially-written .so from a crashed
-    earlier build) before propagating."""
+    Staleness covers source content, compile flags, and host CPU (see
+    _build_stamp), recorded in a sidecar ``.stamp`` file — an mtime-only
+    check would happily reuse a ``-march=native`` .so on a different
+    machine or after a flag change. Raises on compile failure. A load
+    failure of an up-to-date file triggers ONE rebuild (covers a
+    partially-written .so from a crashed earlier build) before
+    propagating."""
     os.makedirs(os.path.dirname(lib_path), exist_ok=True)
+    stamp_path = lib_path + ".stamp"
+    want = _build_stamp(src, extra_flags)
 
     def build():
         tmp = f"{lib_path}.tmp.{os.getpid()}"
@@ -38,9 +72,19 @@ def build_and_load(src: str, lib_path: str,
             raise RuntimeError(
                 f"native build failed: {' '.join(cmd)}\n{e.stderr}") from e
         os.replace(tmp, lib_path)
+        stamp_tmp = f"{stamp_path}.tmp.{os.getpid()}"
+        with open(stamp_tmp, "w") as f:
+            f.write(want)
+        os.replace(stamp_tmp, stamp_path)
 
-    if not os.path.exists(lib_path) or (
-            os.path.getmtime(lib_path) < os.path.getmtime(src)):
+    def stamp_ok():
+        try:
+            with open(stamp_path) as f:
+                return f.read().strip() == want
+        except OSError:
+            return False
+
+    if not os.path.exists(lib_path) or not stamp_ok():
         build()
     try:
         return ctypes.CDLL(lib_path)

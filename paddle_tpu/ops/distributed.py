@@ -100,23 +100,87 @@ def ps_send_barrier(ins, attrs, ctx):
     return {"Out": token}
 
 
+@register_op("ps_send_many", grad=None, nondiff_inputs=("X",))
+def ps_send_many(ins, attrs, ctx):
+    """Merged dense send (reference: communicator.h:276 merged sends,
+    parameter_send.cc): every dense grad bound for the PS leaves in ONE
+    io_callback → PSClient.push_grads packs one RPC per target server,
+    amortizing the measured ~0.21 ms per-RPC floor across the model's
+    whole dense parameter set."""
+    names = list(attrs["var_names"])
+    xs = [x for x in ins["X"]]
+    use_comm = bool(attrs.get("use_communicator", False))
+
+    def _send(*gs):
+        if use_comm:
+            comm = get_communicator()
+            for n, g in zip(names, gs):
+                comm.push(n, np.asarray(g))
+        else:
+            get_client().push_grads(
+                {n: np.asarray(g) for n, g in zip(names, gs)})
+        return np.zeros((), np.int32)
+
+    token = jax.experimental.io_callback(
+        _send, jax.ShapeDtypeStruct((), jnp.int32), *xs, ordered=True)
+    return {"Out": token}
+
+
+@register_op("ps_recv_many", grad=None)
+def ps_recv_many(ins, attrs, ctx):
+    """Merged dense recv (reference: parameter_recv.cc): one io_callback
+    pulls every param in one RPC per owning server (PSClient.pull_many).
+    Under the communicator, params already refreshed by the recv thread
+    are read from its host-side cache; only the missing ones ride an
+    RPC."""
+    names = list(attrs["var_names"])
+    out_names = ctx.op.outputs.get("Out", [])
+    specs = [_var_spec(ctx, on, "ps_recv_many") for on in out_names]
+    do_not_run = bool(attrs.get("do_not_run", False))
+
+    def _pull():
+        vals: dict = {}
+        missing = list(names)
+        if do_not_run:
+            comm = get_communicator()
+            missing = []
+            for n in names:
+                v = comm.latest.get(n)
+                if v is None:
+                    missing.append(n)
+                else:
+                    vals[n] = np.asarray(v)
+        if missing:
+            vals.update(get_client().pull_many(missing))
+        return tuple(np.asarray(vals[n]).astype(s.dtype)
+                     for n, s in zip(names, specs))
+
+    outs = jax.experimental.io_callback(_pull, tuple(specs), ordered=True)
+    return {"Out": list(outs)}
+
+
+def _var_spec(ctx, var_name, op_label):
+    """Static output shape/dtype from the program's var desc (shared by
+    ps_recv / ps_recv_many — recv outputs have no input to infer from)."""
+    from ..core.ir import normalize_dtype
+
+    if ctx.program is not None:
+        for b in ctx.program.blocks:
+            if var_name in b.vars:
+                vd = b.vars[var_name]
+                return jax.ShapeDtypeStruct(
+                    tuple(vd.shape), np.dtype(normalize_dtype(vd.dtype)))
+    raise RuntimeError(f"{op_label}: unknown shape for {var_name}")
+
+
 @register_op("ps_recv", grad=None)
 def ps_recv(ins, attrs, ctx):
     name = attrs["var_name"]
-    # output shape comes from the program's var desc (static!)
     out_names = ctx.op.outputs.get("Out", [])
-    shape = dtype = None
-    if ctx.program is not None and out_names:
-        for b in ctx.program.blocks:
-            if out_names[0] in b.vars:
-                vd = b.vars[out_names[0]]
-                shape = tuple(vd.shape)
-                from ..core.ir import normalize_dtype
-
-                dtype = np.dtype(normalize_dtype(vd.dtype))
-                break
-    if shape is None:
-        raise RuntimeError(f"ps_recv: unknown shape for {name}")
+    if not out_names:
+        raise RuntimeError(f"ps_recv: no output var for {name}")
+    spec = _var_spec(ctx, out_names[0], "ps_recv")
+    shape, dtype = spec.shape, spec.dtype
     do_not_run = bool(attrs.get("do_not_run", False))
 
     def _pull():

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import warnings
 from collections import OrderedDict
 from typing import Dict, Optional, Tuple
 
@@ -101,7 +102,15 @@ class BoxSparseCache:
         try:
             if self._flusher is not None:
                 self._flusher.join(timeout=30)
-                self._flusher = None
+                if self._flusher.is_alive():
+                    # wedged mid-RPC: keep the reference so the spawn
+                    # check in push_sparse_grad (is_alive) can't start a
+                    # second flusher racing this one for the queue
+                    warnings.warn("box cache flusher still alive after "
+                                  "30s end_pass join (wedged push RPC?); "
+                                  "keeping it as the active flusher")
+                else:
+                    self._flusher = None
             while True:
                 try:
                     name, ids, grads, lr = self._flushq.get_nowait()

@@ -26,6 +26,13 @@ class _Conn:
                 f"host:port (check PADDLE_PSERVERS_IP_PORT_LIST)")
         host, port = endpoint.rsplit(":", 1)
         self.sock = socket.create_connection((host, int(port)))
+        # Bound every recv: the longest legitimate server-side wait is
+        # the 120 s sync get-/shuffle-barrier, so 180 s means "server
+        # wedged", turning a would-be infinite hang (e.g. end_pass
+        # draining into a dead server) into a ConnectionError the
+        # callers' error paths already handle. Per-chunk, so slow bulk
+        # transfers that keep making progress never trip it.
+        self.sock.settimeout(180.0)
         self.lock = threading.Lock()
 
     def call(self, msg) -> dict:
@@ -85,6 +92,43 @@ class PSClient:
                                 "generation": self.generation,
                                 "trainer_id": self.trainer_id})
         return np.asarray(out["value"])
+
+    # -- merged dense path (communicator.h:276 merged sends;
+    #    parameter_recv.cc batched recv). The measured per-RPC floor is
+    #    ~0.21 ms (PROFILE.md) — packing every dense var bound for the
+    #    same server into one frame amortizes it across the model's
+    #    whole dense parameter set.
+
+    def push_grads(self, grads: Dict[str, np.ndarray]):
+        """Push many dense grads in one RPC per target server."""
+        by_ep: Dict[str, list] = {}
+        for name, g in grads.items():
+            by_ep.setdefault(self.place(name), []).append((name, g))
+        for ep, items in by_ep.items():
+            out = self._conns[ep].call({
+                "op": "send_grads",
+                "names": [n for n, _ in items],
+                "grads": [np.asarray(g) for _, g in items],
+                "trainer_id": self.trainer_id})
+            if "error" in out:
+                raise RuntimeError(f"pserver: {out['error']}")
+
+    def pull_many(self, names) -> Dict[str, np.ndarray]:
+        """Pull many dense vars in one RPC per owning server."""
+        by_ep: Dict[str, list] = {}
+        for name in names:
+            by_ep.setdefault(self.place(name), []).append(name)
+        out_map: Dict[str, np.ndarray] = {}
+        for ep, ns in by_ep.items():
+            out = self._conns[ep].call({
+                "op": "get_many", "names": ns,
+                "generation": self.generation,
+                "trainer_id": self.trainer_id})
+            if "error" in out:
+                raise RuntimeError(f"pserver: {out['error']}")
+            for n, v in zip(ns, out["values"]):
+                out_map[n] = np.asarray(v)
+        return out_map
 
     def send_barrier(self):
         """reference: send_barrier_op — one per pserver per step."""
@@ -292,11 +336,11 @@ class AsyncCommunicator:
                 pass
 
     def recv_all(self):
-        """Pull every bound param into the recv scope (RecvAll)."""
-        if self._recv_scope is None:
+        """Pull every bound param into the recv scope (RecvAll) — merged:
+        one RPC per owning server, not one per var."""
+        if self._recv_scope is None or not self._recv_params:
             return
-        for pname in self._recv_params:
-            v = self.client.pull(pname)
+        for pname, v in self.client.pull_many(self._recv_params).items():
             self.latest[pname] = v
             self._recv_scope.set_var(pname, v)
 

@@ -190,7 +190,9 @@ class PSFleet:
                     raise RuntimeError(
                         f"init_worker: param '{n}' was never published by "
                         f"worker 0 (timeout {publish_timeout}s)")
-                scope.set_var(n, np.asarray(self._client.pull(n)))
+            # merged pull: one RPC per server for the whole param set
+            for n, v in self._client.pull_many(pnames).items():
+                scope.set_var(n, np.asarray(v))
         return self._client
 
     def stop_worker(self, shutdown_timeout: float = 120.0):

@@ -308,6 +308,30 @@ class ParameterServer:
                 with vs.lock:
                     vs.recv[tid] = grad
             return {"ok": True}
+        if op == "send_grads":
+            # merged dense send (communicator.h:276 merged sends): one
+            # RPC carries every grad placed on this server, amortizing
+            # the per-RPC round trip across vars
+            tid = msg.get("trainer_id", 0)
+            for name, grad in zip(msg["names"], msg["grads"]):
+                out = self.handle({"op": "send_grad", "name": name,
+                                   "grad": grad, "trainer_id": tid})
+                if "error" in out:
+                    return out
+            return {"ok": True}
+        if op == "get_many":
+            # merged dense pull (parameter_recv.cc batches recvs per
+            # endpoint); in sync mode only the first name pays the
+            # get-barrier wait — the rest observe the same generation
+            values = []
+            for name in msg["names"]:
+                out = self.handle({"op": "get", "name": name,
+                                   "generation": msg.get("generation", 0),
+                                   "trainer_id": msg.get("trainer_id", 0)})
+                if "error" in out:
+                    return out
+                values.append(out["value"])
+            return {"values": values}
         if op == "send_delta":  # GEO-SGD (communicator.h:323)
             name = msg["name"]
             vs = self.vars.get(name)
@@ -459,7 +483,13 @@ class ParameterServer:
                     self._shuf_done.clear()
                     self._shuf_taken.clear()
                 self._shuf_begun.add(tid)
-            return {"seed": self._shuf_seed, "pass_id": self._shuf_pass}
+                # snapshot under the cv: if a peer's timeout aborts this
+                # pass and another begin re-seeds it before we build the
+                # response, reading the attributes outside the lock would
+                # hand this trainer a different pass's seed and break the
+                # exactly-once partition
+                seed, pass_id = self._shuf_seed, self._shuf_pass
+            return {"seed": seed, "pass_id": pass_id}
         if op == "shuffle_put":
             target = int(msg["target"])
             if not (0 <= target < self.num_trainers):

@@ -94,12 +94,17 @@ class DistributeTranspiler:
                   if not (int(od.attrs.get(OpRole.AttrName, 0)) & OpRole.Optimize)]
         use_comm = (self.config.runtime_split_send_recv
                     and not self._sync_mode)
-        for pname, gname in self._grad_of.items():
-            if pname not in self._param_opt_descs:
-                continue
+        send_pairs = [(p, g) for p, g in self._grad_of.items()
+                      if p in self._param_opt_descs]
+        if send_pairs:
+            # ONE merged send op for all dense grads: the kernel packs
+            # one RPC per target server (communicator.h:276 merged
+            # sends), instead of one RPC per var
             tb.ops.append(OpDesc(
-                type="ps_send", inputs={"X": [gname]}, outputs={},
-                attrs={"var_name": pname, "use_communicator": use_comm,
+                type="ps_send_many",
+                inputs={"X": [g for _, g in send_pairs]}, outputs={},
+                attrs={"var_names": [p for p, _ in send_pairs],
+                       "use_communicator": use_comm,
                        OpRole.AttrName: OpRole.RPC}))
         # aux vars the optimize descs read that the TRAINER still updates
         # (LR schedulers & their counters) must refresh server-side every
@@ -122,10 +127,15 @@ class DistributeTranspiler:
         tb.ops.append(OpDesc(type="ps_send_barrier", inputs={}, outputs={},
                              attrs={"sync": self._sync_mode,
                                     OpRole.AttrName: OpRole.RPC}))
-        for pname in self._param_opt_descs:
+        recv_names = sorted(self._param_opt_descs)
+        if recv_names:
+            # ONE merged recv op: one RPC per owning server pulls this
+            # server's slice of the param set (parameter_recv.cc)
             tb.ops.append(OpDesc(
-                type="ps_recv", inputs={}, outputs={"Out": [pname]},
-                attrs={"var_name": pname, OpRole.AttrName: OpRole.RPC}))
+                type="ps_recv_many", inputs={},
+                outputs={"Out": recv_names},
+                attrs={"var_names": recv_names,
+                       OpRole.AttrName: OpRole.RPC}))
         trainer._rebuild_from_desc()
         self._trainer_program = trainer
         self._origin_program = program

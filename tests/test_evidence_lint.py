@@ -1,0 +1,17 @@
+"""Evidence-claim linter in CI (VERDICT r4 item 9): PARITY.md/PROFILE.md
+may only cite driver artifacts (BENCH_rNN/MULTICHIP_rNN) whose committed
+JSON exists and recorded success — a claim against a failed or absent
+driver file is overclaiming and fails the suite."""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+from refresh_evidence import lint_evidence_claims  # noqa: E402
+
+
+def test_driver_citations_are_valid():
+    errors = lint_evidence_claims()
+    assert not errors, "\n".join(errors)

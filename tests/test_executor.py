@@ -100,3 +100,76 @@ def test_rng_determinism():
         exe.run(startup)
         a2 = exe.run(main, feed={"x": X}, fetch_list=[out])[0]
     np.testing.assert_array_equal(a, a2)
+
+
+def test_step2_recompiles_nothing(rng):
+    """VERDICT r4 item 7: after the first run of a (program, feed-sig)
+    pair, later steps must hit BOTH cache levels — the executor's
+    program cache AND the jitted step's executable cache (no retrace,
+    no recompile)."""
+    main, startup, loss = _linreg_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    X = rng.rand(16, 13).astype("float32")
+    Y = rng.rand(16, 1).astype("float32")
+    for _ in range(4):
+        exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    stats = exe.cache_stats()
+    # one miss for startup, one for the first main step; steps 2-4 hit
+    assert stats["misses"] == 2 and stats["hits"] == 3, stats
+    assert stats["entries"] == 2, stats
+    (step,) = [s for s in exe._cache.values() if s.fetch_names]
+    # the jit layer compiled exactly one executable for the 4 runs
+    assert step.fn._cache_size() == 1
+
+
+def test_run_chained_matches_sequential(rng):
+    """Scan-chained fast path: n steps in ONE dispatch must leave the
+    scope in the same state as n sequential run() calls and return the
+    same per-step losses (identical op sequence => identical floats on
+    CPU)."""
+    X = rng.rand(32, 13).astype("float32")
+    Y = (X @ rng.rand(13, 1)).astype("float32")
+
+    def train(n_steps, chained):
+        pt.framework.unique_name.generator = \
+            pt.framework.UniqueNameGenerator()
+        main, startup, loss = _linreg_program()
+        exe = pt.Executor(pt.CPUPlace())
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe.run(startup)
+            if chained:
+                losses = exe.run_chained(main, feed={"x": X, "y": Y},
+                                         fetch_list=[loss],
+                                         n_steps=n_steps)[0]
+                losses = [float(v) for v in np.asarray(losses).ravel()]
+            else:
+                losses = [float(exe.run(main, feed={"x": X, "y": Y},
+                                        fetch_list=[loss])[0])
+                          for _ in range(n_steps)]
+            params = {v.name: np.array(scope.get(v.name))
+                      for v in main.list_vars()
+                      if isinstance(v, pt.Parameter)}
+        return losses, params
+
+    seq_losses, seq_params = train(5, chained=False)
+    ch_losses, ch_params = train(5, chained=True)
+    np.testing.assert_allclose(ch_losses, seq_losses, rtol=1e-6)
+    assert seq_params.keys() == ch_params.keys()
+    for name in seq_params:
+        np.testing.assert_allclose(ch_params[name], seq_params[name],
+                                   rtol=1e-5, atol=1e-7)
+    # chained executable is cached per n_steps: a second call reuses it
+    exe = pt.Executor(pt.CPUPlace())
+    pt.framework.unique_name.generator = pt.framework.UniqueNameGenerator()
+    main, startup, loss = _linreg_program()
+    scope = pt.Scope()
+    with pt.scope_guard(scope):
+        exe.run(startup)
+        exe.run_chained(main, feed={"x": X, "y": Y}, fetch_list=[loss],
+                        n_steps=3)
+        exe.run_chained(main, feed={"x": X, "y": Y}, fetch_list=[loss],
+                        n_steps=3)
+        (step,) = [s for s in exe._cache.values() if s.fetch_names]
+        assert step.chained_fn(3)._cache_size() == 1

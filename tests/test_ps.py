@@ -230,7 +230,13 @@ def test_transpiler_ships_decayed_lr():
     types = [op.type for op in t.get_trainer_program().global_block().ops]
     assert "ps_send_aux" in types      # decayed lr refreshes per step
     assert "sgd" not in types          # optimize ops moved to the server
-    assert types.count("ps_send") == 2  # w and b grads
+    # dense grads ride ONE merged send op (one RPC per target server)
+    assert types.count("ps_send_many") == 1
+    ops = t.get_trainer_program().global_block().ops
+    (send_op,) = [op for op in ops if op.type == "ps_send_many"]
+    assert len(send_op.attrs["var_names"]) == 2  # w and b grads
+    (recv_op,) = [op for op in ops if op.type == "ps_recv_many"]
+    assert len(recv_op.attrs["var_names"]) == 2
 
 
 def test_sync_ps_with_grad_clip_inproc(rng=np.random.RandomState(11)):

@@ -79,8 +79,46 @@ def refresh_parity_op_count():
         print(f"PARITY.md op count already {live}")
 
 
+def lint_evidence_claims():
+    """Claims may only cite driver evidence that exists AND recorded ok
+    (VERDICT r4 item 9: round 4 claimed a flagship number against a
+    BENCH file that was rc=1). Every ``BENCH_rNN``/``MULTICHIP_rNN``
+    name appearing in PARITY.md or PROFILE.md must have its committed
+    JSON present with rc==0 (bench) / ok==true (multichip). Returns a
+    list of violations; run by the test suite
+    (tests/test_evidence_lint.py) so a stale citation fails CI."""
+    pat = re.compile(r"\b(BENCH_r\d+|MULTICHIP_r\d+)\b")
+    errors = []
+    for doc in ("PARITY.md", "PROFILE.md"):
+        doc_path = os.path.join(_REPO, doc)
+        if not os.path.exists(doc_path):
+            continue
+        with open(doc_path) as f:
+            cited = sorted(set(pat.findall(f.read())))
+        for name in cited:
+            path = os.path.join(_REPO, name + ".json")
+            if not os.path.exists(path):
+                errors.append(f"{doc} cites {name}, but {name}.json "
+                              "does not exist")
+                continue
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+            except ValueError:
+                errors.append(f"{doc} cites {name}, but {name}.json is "
+                              "not valid JSON")
+                continue
+            if name.startswith("BENCH_") and data.get("rc") != 0:
+                errors.append(f"{doc} cites {name}, but its recorded "
+                              f"rc={data.get('rc')} (driver run failed)")
+            if name.startswith("MULTICHIP_") and not data.get("ok"):
+                errors.append(f"{doc} cites {name}, but its recorded "
+                              f"ok={data.get('ok')} (driver run failed)")
+    return errors
+
+
 def main():
-    known = {"infer", "ctr", "parity"}
+    known = {"infer", "ctr", "parity", "lint"}
     targets = set(sys.argv[1:]) or set(known)
     bad = targets - known
     if bad:
@@ -93,6 +131,13 @@ def main():
         refresh_ctr()
     if "infer" in targets:
         refresh_infer()
+    if "lint" in targets:
+        errors = lint_evidence_claims()
+        for e in errors:
+            print(f"EVIDENCE LINT: {e}", file=sys.stderr)
+        if errors:
+            return 1
+        print("evidence lint: all driver citations valid")
     return 0
 
 
