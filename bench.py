@@ -332,11 +332,12 @@ def bench_bert_long(mesh, n_chips, platform, on_tpu):
         xla_detail = f"fail: {str(e)[:120]}"
     jax.clear_caches()
 
-    # what the auto gate actually selects at this mesh size: splash is
-    # single-chip/manual-region only (pallas_call is not GSPMD-
-    # partitionable — attention.py _mesh_partitionable)
+    # what the auto gate selects at this mesh size: plain splash on one
+    # chip; under multi-chip meshes the r5 compositions ride instead
+    # (shard_map splash when seq is unsharded, ring-splash under sp —
+    # attention.py _multichip_splash_route)
     attn_label = ("splash(auto gate)" if mesh.devices.size == 1
-                  else "xla_bf16_scores(auto gate: multi-chip GSPMD)")
+                  else "splash_multichip(auto gate: shardmap/ring)")
     ok = _run_ladder(
         "bert_long_seq4096_train_samples_per_sec_per_chip",
         [8, 4, 2, 1], build_with("auto"), flops, 5, n_chips,

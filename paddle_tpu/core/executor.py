@@ -161,25 +161,40 @@ class _CompiledStep:
         mut_keys = set(self.mut_reads)
 
         def chained(feeds, const_states, mut_states, rng):
-            def body(carry, _):
-                mut, r = carry
-                fetches, new_states, new_r = step(feeds, const_states,
-                                                  mut, r)
+            def split(new_states, mut):
                 merged = dict(mut)
                 merged.update({k: v for k, v in new_states.items()
                                if k in mut_keys})
                 rest = {k: v for k, v in new_states.items()
                         if k not in mut_keys}
-                return (merged, new_r), (fetches, rest)
+                return merged, rest
 
-            (mut_f, rng_f), (ys_fetches, ys_rest) = jax.lax.scan(
-                body, (mut_states, rng), None, length=n_steps)
-            # write-only states: only the final iteration's value is
-            # observable in the scope (same as sequential execution)
-            last_rest = jax.tree_util.tree_map(lambda y: y[-1], ys_rest)
+            # step 1 runs outside the scan: write-only states don't exist
+            # before it, and the scan carry needs their fixed structure.
+            # Carrying them (instead of stacking as scan ys) keeps memory
+            # O(1) in n_steps — only the final value is observable in the
+            # scope, exactly like sequential execution.
+            fetches0, new0, rng1 = step(feeds, const_states, mut_states,
+                                        rng)
+            mut1, rest1 = split(new0, mut_states)
+
+            def body(carry, _):
+                mut, rest, r = carry
+                fetches, new_states, new_r = step(feeds, const_states,
+                                                  mut, r)
+                merged, new_rest = split(new_states, mut)
+                rest = dict(rest)
+                rest.update(new_rest)
+                return (merged, rest, new_r), fetches
+
+            (mut_f, rest_f, rng_f), ys = jax.lax.scan(
+                body, (mut1, rest1, rng1), None, length=n_steps - 1)
+            stacked = jax.tree_util.tree_map(
+                lambda f0, fs: jnp.concatenate([f0[None], fs]),
+                fetches0, ys)
             new_states = dict(mut_f)
-            new_states.update(last_rest)
-            return ys_fetches, new_states, rng_f
+            new_states.update(rest_f)
+            return stacked, new_states, rng_f
 
         fn = jax.jit(chained, donate_argnums=(2,))
         self._chained[n_steps] = fn
@@ -350,6 +365,9 @@ class Executor:
         trip (~100 ms on tunneled backends). Scope state afterwards
         matches n_steps sequential `run` calls; each fetch comes back
         stacked with a leading [n_steps] axis."""
+        if int(n_steps) < 1:
+            raise ValueError(f"run_chained needs n_steps >= 1, got "
+                             f"{n_steps}")
         program = program if program is not None \
             else framework.default_main_program()
         scope = scope if scope is not None else global_scope()

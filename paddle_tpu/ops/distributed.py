@@ -73,19 +73,22 @@ def ps_send(ins, attrs, ctx):
 
 @register_op("ps_send_aux", grad=None, nondiff_inputs=("X",))
 def ps_send_aux(ins, attrs, ctx):
-    """Refresh a trainer-maintained optimizer aux var (decayed LR, ...) on
+    """Refresh trainer-maintained optimizer aux vars (decayed LR, ...) on
     every server before the barrier (reference: the transpiler moves
     lr_decay ops to the pserver; here the trainer stays authoritative and
-    ships the value per step)."""
-    name = attrs["var_name"]
-    x = ins["X"][0]
+    ships the values per step). Accepts one var (var_name) or a merged
+    list (var_names, one RPC per server for all of them)."""
+    names = (list(attrs["var_names"]) if "var_names" in attrs
+             else [attrs["var_name"]])
+    xs = ins["X"]
 
-    def _send(v):
-        get_client().set_aux_all(name, np.asarray(v))
+    def _send(*vs):
+        get_client().set_aux_many(
+            {n: np.asarray(v) for n, v in zip(names, vs)})
         return np.zeros((), np.int32)
 
     token = jax.experimental.io_callback(
-        _send, jax.ShapeDtypeStruct((), jnp.int32), x, ordered=True)
+        _send, jax.ShapeDtypeStruct((), jnp.int32), *xs, ordered=True)
     return {"Out": token}
 
 

@@ -13,12 +13,21 @@ bf16 precision relative to the kernel's f32 score pipeline.
 
 from __future__ import annotations
 
+import collections
 import functools
 import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+# Trace-time gate observability: which attention path was selected.
+# Keys: "splash" (single-device / manual region), "splash_shardmap"
+# (dp/tp shard_map wrapper), "ring_splash" (sp ring with splash blocks),
+# "ring_xla" (sp ring, XLA blocks), "pallas_flash" (legacy kernel),
+# "xla". Incremented once per mha() trace; reset with GATE_COUNTS.clear()
+# in tests/dryruns to assert a path actually engaged (VERDICT r5 item 4).
+GATE_COUNTS: collections.Counter = collections.Counter()
 
 
 def _xla_mha(q, k, v, mask, scale):
@@ -126,6 +135,90 @@ def _gate_allows(T: int) -> bool:
     return False
 
 
+def _multichip_splash_route(q, k, mask, causal):
+    """Pick the multi-chip splash composition (VERDICT r5 item 4): under
+    a >1-device mesh OUTSIDE a manual region, a bare pallas_call cannot
+    be GSPMD-partitioned — but attention itself shards cleanly, so mha
+    builds the manual region around the kernel:
+
+    - seq axis unsharded  -> "shardmap": manualize (batch, heads); the
+      tuned kernel runs on per-device local blocks, zero collectives.
+    - seq axis sharded    -> "ring": full-mask ring attention over sp
+      with splash(lse) blocks (ring_attention.ring_splash); causal ring
+      keeps the exact XLA blocks ("ring_xla") because a splash mask is
+      static per trace and cannot track the rotating KV block's
+      diagonal.
+
+    Returns None (no reroute), "shardmap", "ring", or "ring_xla".
+    """
+    from paddle_tpu.parallel.mesh import current_mesh
+    from paddle_tpu.parallel.sharding import current_rules
+
+    m = current_mesh()
+    if m is None or m.devices.size == 1 or q.ndim != 4 or mask is not None:
+        return None
+    if _get_axis_env is not None and bool(_get_axis_env().axis_sizes):
+        return None  # already inside a manual region: _use_splash applies
+    from ...core.flags import get_flag
+
+    mode = str(get_flag("FLAGS_flash_attention")).lower()
+    platform = m.devices.flat[0].platform
+    force = mode == "splash"
+    if platform != "tpu" and not force:
+        return None  # interpret-mode execution is explicit opt-in
+    if not (force or (mode == "auto" and q.shape[1] >= _SPLASH_MIN_T)):
+        return None
+    rules = current_rules()
+
+    def _size(ax):
+        return m.shape.get(ax, 1) if ax else 1
+
+    b_ax, s_ax, h_ax = (rules.mesh_axis("batch"), rules.mesh_axis("seq"),
+                        rules.mesh_axis("heads"))
+    B, T, N, H = q.shape
+    Tk = k.shape[1]
+    sp = _size(s_ax)
+    if sp > 1:
+        if T % sp or Tk != T:
+            return None
+        if causal or (T // sp) % 128 or H % 64 or B % _size(b_ax) \
+                or N % _size(h_ax):
+            return "ring_xla"
+        return "ring"
+    if _size(b_ax) * _size(h_ax) == 1:
+        return None  # replicated: the plain paths handle it
+    if B % _size(b_ax) or N % _size(h_ax) or T % 128 or Tk % 128 or H % 64:
+        return None
+    return "shardmap"
+
+
+def _shardmap_splash_mha(q, k, v, scale, causal):
+    """Splash composed with dp/tp: attention is independent across batch
+    and heads, so manualizing those axes feeds the tuned kernel
+    per-device local blocks with NO collectives."""
+    from paddle_tpu.parallel.mesh import current_mesh
+    from paddle_tpu.parallel.sharding import current_rules
+
+    m = current_mesh()
+    rules = current_rules()
+    b_ax, h_ax = rules.mesh_axis("batch"), rules.mesh_axis("heads")
+    axes = {a for a in (b_ax, h_ax) if a and m.shape.get(a, 1) > 1}
+    spec = jax.sharding.PartitionSpec(
+        b_ax if b_ax in axes else None, None,
+        h_ax if h_ax in axes else None, None)
+    interpret = m.devices.flat[0].platform != "tpu"
+    abstract = jax.sharding.get_abstract_mesh()
+    sm_mesh = abstract if (abstract is not None and not abstract.empty) \
+        else m
+
+    @functools.partial(jax.shard_map, mesh=sm_mesh, in_specs=(spec,) * 3,
+                       out_specs=spec, axis_names=axes, check_vma=False)
+    def run(ql, kl, vl):
+        return _splash_mha(ql, kl, vl, scale, causal, interpret=interpret)
+
+    return run(q, k, v)
+
+
 def mha(q: jax.Array, k: jax.Array, v: jax.Array,
         mask: Optional[jax.Array] = None, scale: Optional[float] = None,
         causal: bool = False) -> jax.Array:
@@ -133,24 +226,59 @@ def mha(q: jax.Array, k: jax.Array, v: jax.Array,
 
     mask: additive [B, 1, 1, T] or [B, N, T, T] (float, -inf style), or None.
     """
+    import warnings
+
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     if _use_splash(q, k, mask, causal):
         try:
-            return _splash_mha(q, k, v, scale, causal)
+            out = _splash_mha(q, k, v, scale, causal,
+                              interpret=_platform(q) != "tpu")
+            GATE_COUNTS["splash"] += 1
+            return out
         except Exception as e:  # unsupported shape: fall back, but say so
-            import warnings
-
             warnings.warn(f"splash_attention failed at trace time "
                           f"({type(e).__name__}: {str(e)[:200]}); falling "
                           f"back to the XLA path — which may not fit at "
                           f"this shape")
+    route = _multichip_splash_route(q, k, mask, causal)
+    if route is not None:
+        try:
+            if route == "shardmap":
+                out = _shardmap_splash_mha(q, k, v, scale, causal)
+            else:
+                from paddle_tpu.parallel.mesh import current_mesh
+                from paddle_tpu.parallel.sharding import current_rules
+                from . import ring_attention as ra
+
+                m = current_mesh()
+                rules = current_rules()
+                if route == "ring":
+                    out = ra.ring_splash(
+                        q, k, v, m, s_axis=rules.mesh_axis("seq"),
+                        b_axis=rules.mesh_axis("batch"),
+                        h_axis=rules.mesh_axis("heads"), scale=scale)
+                else:  # "ring_xla": exact ring, XLA blocks
+                    out = ra.ring_attention(
+                        q, k, v, m, axis=rules.mesh_axis("seq"),
+                        causal=causal, scale=scale)
+            GATE_COUNTS[{"shardmap": "splash_shardmap",
+                         "ring": "ring_splash",
+                         "ring_xla": "ring_xla"}[route]] += 1
+            return out
+        except Exception as e:
+            warnings.warn(f"multi-chip splash route '{route}' failed at "
+                          f"trace time ({type(e).__name__}: "
+                          f"{str(e)[:200]}); falling back to GSPMD XLA")
     if _use_pallas(q):
         try:
-            return _pallas_mha(q, k, v, mask, scale, causal)
+            out = _pallas_mha(q, k, v, mask, scale, causal)
+            GATE_COUNTS["pallas_flash"] += 1
+            return out
         except Exception:  # fall back if kernel unsupported on this shape
             pass
     out = _xla_mha(q, k, v, mask if not causal else _merge_causal(mask, q.shape[1]), scale)
+    GATE_COUNTS["xla"] += 1
     return out.astype(q.dtype)
 
 
@@ -178,19 +306,24 @@ def _use_splash(q, k, mask, causal) -> bool:
     T, Tk, hd = q.shape[1], k.shape[1], q.shape[-1]
     if T % 128 or Tk % 128 or hd % 64:
         return False
-    if _platform(q) != "tpu" or not _mesh_partitionable(q):
+    if not _mesh_partitionable(q):
         return False
     from ...core.flags import get_flag
 
     mode = str(get_flag("FLAGS_flash_attention")).lower()
     if mode == "splash":
+        # explicit opt-in ALSO runs off-TPU, via the pallas interpreter —
+        # this is how CPU-mesh tests execute the real kernel
         return True
+    if _platform(q) != "tpu":
+        return False
     if mode not in ("auto",):
         return False  # explicit on(legacy flash)/off respected
     return T >= _SPLASH_MIN_T
 
 
-def _splash_kernel(Tq: int, Tk: int, n_heads: int, causal: bool):
+def _splash_kernel(Tq: int, Tk: int, n_heads: int, causal: bool,
+                   interpret: bool = False, save_residuals: bool = False):
     # NOT cached: the kernel pytree holds mask-info arrays; under a vjp
     # trace those are tracers of that trace, and caching them across
     # traces raises UnexpectedTracerError in the backward pass. Creation
@@ -216,13 +349,19 @@ def _splash_kernel(Tq: int, Tk: int, n_heads: int, causal: bool):
         block_q_dq=bqb, block_kv_dq=bkvb)
     one = (sa.CausalMask((Tq, Tk)) if causal else sa.FullMask((Tq, Tk)))
     mask = sa.MultiHeadMask([one] * n_heads)
+    # interpret=True runs the very same kernel via the pallas CPU
+    # interpreter — how the multi-chip compositions are executed (not
+    # just compile-checked) on the virtual CPU mesh; save_residuals
+    # returns the per-row logsumexp the ring merge needs.
     return sa.make_splash_mha(mask, head_shards=1, q_seq_shards=1,
-                              block_sizes=sizes)
+                              block_sizes=sizes, interpret=interpret,
+                              save_residuals=save_residuals)
 
 
-def _splash_mha(q, k, v, scale, causal):
+def _splash_mha(q, k, v, scale, causal, interpret=False):
     B, T, N, H = q.shape
-    kernel = _splash_kernel(T, k.shape[1], N, bool(causal))
+    kernel = _splash_kernel(T, k.shape[1], N, bool(causal),
+                            interpret=interpret)
     # kernel wants [N, T, H] per example; scale is folded into q (splash
     # applies no sm_scale itself)
     qt = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)
@@ -230,6 +369,20 @@ def _splash_mha(q, k, v, scale, causal):
     vt = v.transpose(0, 2, 1, 3)
     out = jax.vmap(kernel)(qt, kt, vt)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def _splash_block_with_lse(q, k, v, interpret=False):
+    """One full-mask splash block returning (out, logsumexp) — the ring
+    merge's building block. q,k,v: [B,T,N,H] (q pre-scaled); out
+    [B,T,N,H], lse [B,N,T] (f32)."""
+    B, T, N, H = q.shape
+    kernel = _splash_kernel(T, k.shape[1], N, causal=False,
+                            interpret=interpret, save_residuals=True)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out, (lse,) = jax.vmap(kernel)(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3), lse
 
 
 # ---------------------------------------------------------------------------

@@ -122,8 +122,6 @@ class BoxSparseCache:
                     # batches and let begin_pass still invalidate — an
                     # aborted drain would leave ids uncacheable and skip
                     # the cache clear (same policy as _flush_loop)
-                    import warnings
-
                     warnings.warn(f"box-cache end_pass flush RPC failed "
                                   f"({type(e).__name__}: {str(e)[:120]}); "
                                   f"gradient batch dropped")
@@ -260,8 +258,6 @@ class BoxSparseCache:
             try:
                 push_row_grads(self.client, name, ids, grads, lr)
             except Exception as e:  # keep the flusher alive; drop marks
-                import warnings
-
                 warnings.warn(f"box-cache flush RPC failed "
                               f"({type(e).__name__}: {str(e)[:120]}); "
                               f"gradient batch dropped")

@@ -173,9 +173,17 @@ class PSClient:
     def set_aux_all(self, name: str, value: np.ndarray):
         """Refresh an optimizer aux var (e.g. a decayed learning rate) on
         EVERY server — the trainer-side scheduler stays authoritative."""
+        self.set_aux_many({name: value})
+
+    def set_aux_many(self, values: Dict[str, np.ndarray]):
+        """Refresh many aux vars on every server, one RPC per server
+        (merged like push_grads; aux values are tiny, so the round trip
+        IS the cost)."""
+        msg = {"op": "init_aux_many",
+               "names": list(values),
+               "values": [np.asarray(v) for v in values.values()]}
         for c in self._conns.values():
-            c.call({"op": "init_aux", "name": name,
-                    "value": np.asarray(value)})
+            c.call(msg)
 
     def wait_var(self, name: str, timeout: float = 60.0) -> bool:
         """Poll until a var exists on its owner (trainer-0 publish sync)."""

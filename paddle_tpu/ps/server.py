@@ -263,6 +263,10 @@ class ParameterServer:
                                         msg.get("opt_descs", []),
                                         msg.get("grad_name"))
             return {"ok": True}
+        if op == "init_aux_many":
+            for n, v in zip(msg["names"], msg["values"]):
+                self.aux[n] = np.asarray(v)
+            return {"ok": True}
         if op == "init_aux":
             self.aux[msg["name"]] = np.asarray(msg["value"])
             if msg.get("owner"):

@@ -120,10 +120,14 @@ class DistributeTranspiler:
         for pname in self._param_opt_descs:
             aux_inputs.discard(pname)
             aux_inputs.discard(pname + "@GRAD")
-        for aname in sorted(aux_inputs & trainer_written):
+        aux_names = sorted(aux_inputs & trainer_written)
+        if aux_names:
+            # one merged aux refresh per step (they broadcast to every
+            # server, so merging saves (n_aux-1) RPCs per server)
             tb.ops.append(OpDesc(
-                type="ps_send_aux", inputs={"X": [aname]}, outputs={},
-                attrs={"var_name": aname, OpRole.AttrName: OpRole.RPC}))
+                type="ps_send_aux", inputs={"X": aux_names}, outputs={},
+                attrs={"var_names": aux_names,
+                       OpRole.AttrName: OpRole.RPC}))
         tb.ops.append(OpDesc(type="ps_send_barrier", inputs={}, outputs={},
                              attrs={"sync": self._sync_mode,
                                     OpRole.AttrName: OpRole.RPC}))

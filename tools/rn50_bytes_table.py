@@ -1,0 +1,197 @@
+"""ResNet-50 per-tensor HBM bytes table (VERDICT r5 item 5).
+
+The round-3 roofline (PROFILE.md, tools/rn50_roofline.py) showed the
+training step pinned to the HBM ceiling: 78 GB modeled traffic -> 95 ms
+byte floor vs 103 ms measured. The verdict's follow-up: bytes, not
+FLOPs, are the budget — so itemize them per tensor and quantify every
+attackable slice (bf16 optimizer state / master params, BN-pass fusion,
+residual traffic, batch scaling), or concede the measured 0.304 MFU is
+this part's ceiling for bs=256.
+
+Pure analysis (no chip needed): the byte model is exactly
+tools/rn50_roofline.py's stated pass-count model (validated there
+against measured per-stage GB/s at 93-126% of nominal peak), broken to
+per-tensor granularity and per-category attack surfaces.
+
+Pass model per conv+BN+relu unit (bf16 activations/weights):
+  fwd : conv(read in, read W, write out) + BN stats(read out)
+        + BN apply(read out, write out)
+  bwd : BN/relu bwd(read g, read act, write g)
+        + dgrad(read g, read W, write gx) + wgrad(read g, read act)
+  residual (per block): +3 out-sized passes
+Categories:
+  conv-io   3*a_in + 3*a_out   irreducible conv traffic (in/out/grads)
+  bn        6*a_out            stats read + apply r/w + bwd r/r/w
+  residual  3*a_out            skip add fwd/bwd
+  weights   2*wb               fwd + dgrad weight reads
+  optimizer f32 master+momentum read/write + f32 grad + bf16 cast
+"""
+
+import json
+
+BS = 256
+BF = 2           # bf16 activation/weight bytes
+F32 = 4
+PEAK_BW = 819e9
+PEAK_TF = 197e12
+STEP_FLOPS = 6.281e12       # exact conv sum, tools/rn50_roofline.py (bs=256)
+MEASURED_MS = 103.0          # BENCH_r03 step (one-pass BN, NHWC)
+
+
+def conv_unit(name, h, cin, cout, kh, kw, st):
+    oh = h // st
+    a_in = BS * h * h * cin * BF
+    a_out = BS * oh * oh * cout * BF
+    wb = kh * kw * cin * cout * BF
+    return {
+        "name": name, "shape": f"{h}²×{cin}→{oh}²×{cout} {kh}x{kw}/{st}",
+        "params": kh * kw * cin * cout,
+        "out_mb": a_out / 1e6,
+        "conv_io": 3 * a_in + 3 * a_out,
+        "bn": 6 * a_out,
+        "weights": 2 * wb,
+    }
+
+
+def build_units():
+    units = []
+    units.append(conv_unit("stem", 224, 3, 64, 7, 7, 2))
+    # maxpool: fwd read 112² write 56², bwd ~2 passes (select-and-scatter)
+    mp = BS * 112 * 112 * 64 * BF
+    units.append({"name": "maxpool", "shape": "112²×64→56²×64",
+                  "params": 0, "out_mb": mp / 4 / 1e6,
+                  "conv_io": mp + mp // 4 + 2 * (mp // 4), "bn": 0,
+                  "weights": 0})
+    h, c = 56, 64
+    residual = 0.0
+    for gi, blocks in ((0, 3), (1, 4), (2, 6), (3, 3)):
+        mid = 64 * (2 ** gi)
+        cout = mid * 4
+        for bi in range(blocks):
+            st = 2 if (bi == 0 and gi > 0) else 1
+            pre = f"g{gi}b{bi}"
+            units.append(conv_unit(f"{pre}.c1", h, c, mid, 1, 1, 1))
+            units.append(conv_unit(f"{pre}.c2", h, mid, mid, 3, 3, st))
+            oh = h // st
+            units.append(conv_unit(f"{pre}.c3", oh, mid, cout, 1, 1, 1))
+            if bi == 0:
+                units.append(conv_unit(f"{pre}.proj", h, c, cout, 1, 1,
+                                       st))
+            residual += 3 * BS * oh * oh * cout * BF
+            h, c = oh, cout
+    # head: GAP + fc(2048->1000) + softmax/loss — noise-level bytes
+    units.append({"name": "head", "shape": "7²×2048→1000",
+                  "params": 2048 * 1000, "out_mb": 0.5,
+                  "conv_io": 3 * BS * 2048 * BF + 3 * BS * 1000 * F32,
+                  "bn": 0, "weights": 2 * 2048 * 1000 * BF})
+    return units, residual
+
+
+def main():
+    units, residual = build_units()
+    n_params = sum(u["params"] for u in units) \
+        + 53 * 2 * 256  # BN scale/shift approx (gamma/beta per conv)
+    conv_io = sum(u["conv_io"] for u in units)
+    bn = sum(u["bn"] for u in units)
+    weights = sum(u["weights"] for u in units)
+    # optimizer: read f32 master + f32 momentum, write both, read f32
+    # wgrad, write bf16 compute copy
+    opt = n_params * (4 * F32 + F32 + BF)
+    total = conv_io + bn + residual + weights + opt
+
+    def ms(bytes_):
+        return bytes_ / PEAK_BW * 1e3
+
+    def mfu(bytes_):
+        return STEP_FLOPS / (bytes_ / PEAK_BW) / PEAK_TF
+
+    print("## ResNet-50 per-tensor HBM bytes (bs=256 NHWC bf16, "
+          "pass model = rn50_roofline.py)\n")
+    print("| unit | shape | out MB | conv-io GB | BN GB | weights MB |")
+    print("|---|---|---|---|---|---|")
+    groups = {}
+    for u in units:
+        key = u["name"].split("b")[0].split(".")[0]
+        g = groups.setdefault(key, {"conv_io": 0, "bn": 0, "weights": 0,
+                                    "n": 0})
+        g["conv_io"] += u["conv_io"]
+        g["bn"] += u["bn"]
+        g["weights"] += u["weights"]
+        g["n"] += 1
+    for u in units[:3] + [u for u in units if u["name"].endswith("b0.c2")]:
+        print(f"| {u['name']} | {u['shape']} | {u['out_mb']:.1f} | "
+              f"{u['conv_io'] / 1e9:.2f} | {u['bn'] / 1e9:.2f} | "
+              f"{u['weights'] / 1e6:.1f} |")
+    print(f"| … ({len(units)} units total; per-group sums below) |")
+    print("\n| group | units | conv-io GB | BN GB | weights MB |")
+    print("|---|---|---|---|---|")
+    for k, g in groups.items():
+        print(f"| {k} | {g['n']} | {g['conv_io'] / 1e9:.1f} | "
+              f"{g['bn'] / 1e9:.1f} | {g['weights'] / 1e6:.1f} |")
+
+    print("\n| category | GB/step | % | note |")
+    print("|---|---|---|---|")
+    for name, b, note in (
+        ("conv io (in/out/grads)", conv_io,
+         "irreducible conv activation traffic"),
+        ("BN passes", bn, "stats read + apply r/w + bwd r/r/w"),
+        ("residual adds", residual, "skip fwd/bwd"),
+        ("weight reads", weights, "fwd + dgrad"),
+        ("optimizer/master (f32)", opt,
+         "master+momentum r/w, f32 grad, bf16 cast"),
+    ):
+        print(f"| {name} | {b / 1e9:.1f} | {100 * b / total:.1f}% | "
+              f"{note} |")
+    print(f"| **total** | **{total / 1e9:.1f}** | 100% | floor "
+          f"{ms(total):.0f} ms @819 GB/s |")
+
+    print("\n### Attackable slices (what each buys)\n")
+    print("| change | GB saved | floor ms | ceiling MFU | verdict |")
+    print("|---|---|---|---|---|")
+    rows = []
+    rows.append(("baseline model", 0.0, total))
+    rows.append(("bf16 optimizer state + master params "
+                 "(optax accumulator_dtype)", opt * 0.55, total - opt * 0.55))
+    rows.append(("fuse BN apply into consumer conv (saves 2 of 6 BN "
+                 "passes; needs custom epilogue kernels)",
+                 bn / 3, total - bn / 3))
+    rows.append(("ideal fused conv+BN+relu fwd&bwd (4 of 6 passes; "
+                 "beyond XLA today)", 2 * bn / 3, total - 2 * bn / 3))
+    rows.append(("all of the above", opt * 0.55 + 2 * bn / 3,
+                 total - opt * 0.55 - 2 * bn / 3))
+    for name, saved, left in rows:
+        print(f"| {name} | {saved / 1e9:.1f} | {ms(left):.0f} | "
+              f"{mfu(left):.3f} | "
+              f"{'measured 0.304 = %d%% of this ceiling' % round(100 * 0.304 / mfu(left)) if saved == 0 else ''} |")
+    print(f"""
+### Reading
+
+- The no-change byte floor gives ceiling MFU {mfu(total):.3f} at bs=256 —
+  **below the 0.40 bar**. The measured 0.304 (BENCH_r03, 103 ms) already
+  runs at {100 * 0.304 / mfu(total):.0f}% of that ceiling; scheduling cannot close it.
+- bf16 optimizer state + master params saves {opt * 0.55 / 1e9:.1f} GB
+  (<1%): irrelevant for MFU at this model's activation/parameter ratio
+  (25.6M params vs {total / 1e9:.0f} GB of activation traffic). It remains useful
+  for HBM *capacity* (larger per-chip batch), not bandwidth.
+- The only lever that reaches ≥0.40 is removing BN passes with fused
+  conv+BN+relu kernels ({bn / 1e9:.0f} GB = {100 * bn / total:.0f}% of traffic): the 'ideal
+  fusion' row lands at {mfu(total - 2 * bn / 3):.3f}. XLA does not fuse
+  across the BN-stats reduction barrier today, and a Pallas conv+BN
+  epilogue kernel set (im2col matmul with fused stats/apply, fwd+bwd) is
+  the named line-item this table scopes — not a scheduling or layout fix.
+- Larger batch (bs=512 + remat) does not change bytes/image: activation
+  traffic dominates and scales linearly with batch; weight/optimizer
+  amortization is already <2% of the budget.
+
+Conclusion: **0.304 ≈ 90% of the architectural byte-floor ceiling
+({mfu(total):.3f}) for this part/batch**; ≥0.40 requires kernel-level
+conv+BN fusion, quantified above. (VERDICT r5 item 5 option (b).)""")
+    print(json.dumps({"metric": "rn50_bytes_total_gb",
+                      "value": round(total / 1e9, 1), "unit": "GB/step",
+                      "detail": {"floor_ms": round(ms(total), 1),
+                                 "ceiling_mfu": round(mfu(total), 4),
+                                 "measured_mfu": 0.304}}))
+
+
+if __name__ == "__main__":
+    main()
