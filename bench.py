@@ -17,6 +17,7 @@ parity tests instead. The flagship BERT line prints LAST.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -381,8 +382,6 @@ _BENCH_FNS = {
 def run_one(name):
     """Child mode: run one bench in-process (the only mode that touches jax
     backends)."""
-    import os
-
     if os.environ.get("PADDLE_TPU_BENCH_FORCE_CPU"):
         # The baked sitecustomize overrides JAX_PLATFORMS after env
         # parsing; the config update is the only reliable CPU pin.
@@ -397,25 +396,61 @@ def run_one(name):
     return 0 if ok else 1
 
 
+def _run_bounded(argv, timeout_s, env=None):
+    """subprocess.run with HARD bounds: the child runs in its own session
+    so a timeout kills the whole process group (a backend helper
+    grandchild inheriting the pipes would otherwise hold them open and
+    block subprocess.run's post-kill drain forever), and the post-kill
+    drain itself is bounded. Returns (rc, stdout, stderr); rc is None on
+    timeout."""
+    import signal
+    import subprocess
+
+    try:
+        proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True, env=env,
+                                start_new_session=True)
+    except OSError as e:
+        # spawn failure (fork EAGAIN/ENOMEM on an exhausted host) is the
+        # same class of event as a wedged backend: report it structured,
+        # don't crash the orchestrator
+        return None, "", f"spawn failed: {e}"
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            proc.kill()
+        try:
+            out, err = proc.communicate(timeout=15)
+        except (subprocess.TimeoutExpired, OSError):
+            out = err = ""
+            for stream in (proc.stdout, proc.stderr):
+                try:
+                    if stream:
+                        stream.close()
+                except OSError:
+                    pass
+        return None, out, err
+
+
 def _probe_backend(timeout_s):
     """Probe default-platform health in a throwaway subprocess (a wedged
     tunnel hangs *inside* backend init — only a killable process
     boundary bounds it). Returns the platform string or None."""
-    import subprocess
-
     code = ("import jax, json; d = jax.devices(); import jax.numpy as jnp;"
             " v = float(jnp.ones((128, 128)).sum());"
             " print(json.dumps({'platform': d[0].platform, 'ok': v == 16384.0}))")
-    try:
-        proc = subprocess.run([sys.executable, "-c", code],
-                              capture_output=True, text=True,
-                              timeout=timeout_s)
-        if proc.returncode == 0:
-            info = json.loads(proc.stdout.strip().splitlines()[-1])
+    rc, out, _ = _run_bounded([sys.executable, "-c", code], timeout_s)
+    if rc == 0:
+        try:
+            info = json.loads(out.strip().splitlines()[-1])
             if info.get("ok"):
                 return info["platform"]
-    except (subprocess.TimeoutExpired, ValueError, IndexError, OSError):
-        pass
+        except (ValueError, IndexError):
+            pass
     return None
 
 
@@ -445,9 +480,6 @@ def _forward_child_output(stdout, stderr):
 
 
 def main():
-    import os
-    import subprocess
-
     from paddle_tpu.core.tpu_lock import tpu_singleflight
 
     deadline = time.monotonic() + float(
@@ -485,27 +517,25 @@ def main():
                                 "this metric started")
                 all_ok = False
                 continue
-            try:
-                proc = subprocess.run(
-                    [sys.executable, here, "--one", name], env=env,
-                    capture_output=True, text=True, timeout=budget)
-                emitted = _forward_child_output(proc.stdout, proc.stderr)
-                if proc.returncode != 0:
-                    all_ok = False
+            rc, out, err = _run_bounded(
+                [sys.executable, here, "--one", name], budget, env=env)
+            emitted = _forward_child_output(out, err)
+            if rc is None:
                 if expected and expected not in emitted:
                     _emit_error(expected,
-                                f"bench subprocess rc={proc.returncode} "
-                                "exited without emitting this metric")
-            except subprocess.TimeoutExpired as e:
-                _forward_child_output(
-                    e.stdout.decode() if isinstance(e.stdout, bytes)
-                    else e.stdout,
-                    e.stderr.decode() if isinstance(e.stderr, bytes)
-                    else e.stderr)
-                if expected:
-                    _emit_error(expected,
                                 f"bench subprocess timed out after "
-                                f"{budget:.0f}s (killed)")
+                                f"{budget:.0f}s (process group killed)")
+                all_ok = False
+            elif rc != 0:
+                all_ok = False
+                if expected and expected not in emitted:
+                    _emit_error(expected,
+                                f"bench subprocess rc={rc} exited "
+                                "without emitting this metric")
+            elif expected and expected not in emitted:
+                _emit_error(expected,
+                            "bench subprocess exited rc=0 without "
+                            "emitting this metric")
                 all_ok = False
         # BASELINE config 5 (ResNet-50 data-parallel on v5e-8) needs 8
         # real chips; its sharded step is validated by

@@ -180,12 +180,11 @@ class _CompiledStep:
 
             def body(carry, _):
                 mut, rest, r = carry
+                del rest  # fully replaced: new_rest has the same key set
                 fetches, new_states, new_r = step(feeds, const_states,
                                                   mut, r)
                 merged, new_rest = split(new_states, mut)
-                rest = dict(rest)
-                rest.update(new_rest)
-                return (merged, rest, new_r), fetches
+                return (merged, new_rest, new_r), fetches
 
             (mut_f, rest_f, rng_f), ys = jax.lax.scan(
                 body, (mut1, rest1, rng1), None, length=n_steps - 1)

@@ -728,3 +728,113 @@ def py_func(ins, attrs, ctx):
 
     outs = jax.pure_callback(host, result_shapes, *xs)
     return {"Out": list(outs)}
+
+
+# ---------------------------------------------------------------------------
+# SelectedRows / distributed utility ops (reference behaviors:
+# merge_selected_rows_op.cc, get_tensor_from_selected_rows_op.cc,
+# split_selected_rows_op.cc, coalesce_tensor_op.cc, fake_init_op.cc,
+# controlflow/ops delete_var, distributed_ops/ref_by_trainer_id_op.cc).
+# TPU-native: every variant is static-shape — "merge" keeps the slot
+# count and zeroes duplicate slots (core/selected_rows.merged), "split"
+# masks out-of-section ids to the drop sentinel instead of shrinking.
+# ---------------------------------------------------------------------------
+
+
+@register_op("merge_selected_rows", grad=None)
+def merge_selected_rows(ins, attrs, ctx):
+    """Sum duplicate row ids (merge_selected_rows_op.cc — MergeAdd).
+    Static-shape: duplicates fold into their first-occurrence slot;
+    non-first slots carry zero rows (dropped by masked scatters)."""
+    from ..core.selected_rows import SelectedRows, is_selected_rows
+
+    x = ins["X"][0]
+    if not is_selected_rows(x):
+        return {"Out": x}  # dense input: nothing to merge
+    sid, rows, _ = x.merged()
+    return {"Out": SelectedRows(rows, sid, x.height)}
+
+
+@register_op("get_tensor_from_selected_rows", grad=None)
+def get_tensor_from_selected_rows(ins, attrs, ctx):
+    """SelectedRows value tensor as a plain dense tensor
+    (get_tensor_from_selected_rows_op.cc)."""
+    from ..core.selected_rows import is_selected_rows
+
+    x = ins["X"][0]
+    return {"Out": x.rows if is_selected_rows(x) else x}
+
+
+@register_op("split_selected_rows", grad=None)
+def split_selected_rows(ins, attrs, ctx):
+    """Split a SelectedRows by height sections (split_selected_rows_op.cc
+    — the PS shard split). Static-shape: each section keeps the full
+    slot count; ids outside the section are masked to the section's
+    height (the scatter drop sentinel) with zeroed rows, which is
+    scatter-equivalent to the reference's shrunken outputs."""
+    from ..core.selected_rows import SelectedRows, is_selected_rows
+
+    x = ins["X"][0]
+    assert is_selected_rows(x), "split_selected_rows wants SelectedRows"
+    sections = [int(s) for s in attrs["height_sections"]]
+    outs = []
+    off = 0
+    for h in sections:
+        inside = (x.ids >= off) & (x.ids < off + h)
+        local = jnp.where(inside, x.ids - off, h)
+        rows = jnp.where(inside[:, None], x.rows, 0)
+        outs.append(SelectedRows(rows, local, h))
+        off += h
+    return {"Out": outs}
+
+
+@register_op("coalesce_tensor", grad=None)
+def coalesce_tensor(ins, attrs, ctx):
+    """Pack many tensors into one contiguous fused buffer + per-tensor
+    views (coalesce_tensor_op.cc — the fused-allreduce/optimizer
+    enabler). Functionally: FusedOutput is the flat concat; Output
+    returns each tensor reshaped from its slice, so downstream ops see
+    the same values whether they consume the views or the fused flat."""
+    xs = ins["Input"]
+    dtype = xs[0].dtype
+    flat = jnp.concatenate([x.reshape(-1).astype(dtype) for x in xs])
+    outs, off = [], 0
+    set_constant = bool(attrs.get("set_constant", False))
+    const = float(attrs.get("constant", 0.0))
+    if set_constant:
+        flat = jnp.full_like(flat, const)
+    for x in xs:
+        n = int(np.prod(x.shape)) if x.shape else 1
+        outs.append(flat[off:off + n].reshape(x.shape))
+        off += n
+    return {"Output": outs, "FusedOutput": flat}
+
+
+@register_op("fake_init", grad=None)
+def fake_init(ins, attrs, ctx):
+    """Placeholder init for vars whose real storage lives remotely (the
+    trainer side of a distributed lookup table — fake_init_op.cc):
+    materializes zeros of the declared shape so the program traces."""
+    shape = [int(s) for s in attrs.get("shape", [1])]
+    from ..core.ir import normalize_dtype
+
+    dtype = np.dtype(normalize_dtype(attrs.get("dtype", 5)))
+    return {"Out": jnp.zeros(shape, dtype)}
+
+
+@register_op("delete_var", grad=None, nondiff_inputs=("X",))
+def delete_var(ins, attrs, ctx):
+    """Scope GC marker (controlflow delete ops): functional lowering has
+    no mutable scope mid-trace — dead values are freed by XLA liveness —
+    so this is a no-op accepted for program compatibility."""
+    return {}
+
+
+@register_op("ref_by_trainer_id", grad=None,
+             nondiff_inputs=("X", "TrainerId"))
+def ref_by_trainer_id(ins, attrs, ctx):
+    """Select this trainer's slice from a list input by TrainerId
+    (distributed_ops/ref_by_trainer_id_op.cc — DC-ASGD plumbing)."""
+    tid = jnp.asarray(ins["TrainerId"][0]).reshape(()).astype(jnp.int32)
+    xs = jnp.stack([jnp.asarray(x) for x in ins["X"]])
+    return {"Out": jnp.take(xs, tid, axis=0)}

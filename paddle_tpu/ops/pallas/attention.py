@@ -207,9 +207,9 @@ def _shardmap_splash_mha(q, k, v, scale, causal):
         b_ax if b_ax in axes else None, None,
         h_ax if h_ax in axes else None, None)
     interpret = m.devices.flat[0].platform != "tpu"
-    abstract = jax.sharding.get_abstract_mesh()
-    sm_mesh = abstract if (abstract is not None and not abstract.empty) \
-        else m
+    from .ring_attention import _shard_map_mesh
+
+    sm_mesh = _shard_map_mesh(m)
 
     @functools.partial(jax.shard_map, mesh=sm_mesh, in_specs=(spec,) * 3,
                        out_specs=spec, axis_names=axes, check_vma=False)

@@ -21,6 +21,17 @@ from jax.sharding import Mesh, PartitionSpec as P
 NEG_INF = -1e30
 
 
+def _shard_map_mesh(mesh):
+    """Mesh to hand jax.shard_map: under an active context mesh (set by
+    jit/mesh_guard) the ABSTRACT mesh must be passed — a concrete mesh no
+    longer matches (jax 0.9 behavior). Shared by every shard_map site in
+    the attention stack so an API shift is a one-line fix."""
+    abstract = jax.sharding.get_abstract_mesh()
+    return abstract if (abstract is not None and not abstract.empty) \
+        else mesh
+
+
+
 def _block_attn(q, k, v, scale, q_off, k_off, causal, Tq, Tk):
     """Partial (unnormalized) attention of local q against one k/v block.
     q: [B,Tq,N,H]; k,v: [B,Tk,N,H]. Returns (acc, m, l) contributions."""
@@ -56,10 +67,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     perm = [(i, (i + 1) % S) for i in range(S)]
 
-    # under an active context mesh (set by jit/mesh_guard) the abstract mesh
-    # must be passed to shard_map — a concrete mesh no longer matches
-    abstract = jax.sharding.get_abstract_mesh()
-    sm_mesh = abstract if (abstract is not None and not abstract.empty) else mesh
+    sm_mesh = _shard_map_mesh(mesh)
 
     @functools.partial(
         jax.shard_map, mesh=sm_mesh,
@@ -135,9 +143,7 @@ def ring_splash(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                        if a and mesh.shape.get(a, 1) > 1}
     spec = P(b_axis if b_axis in axes else None, s_axis,
              h_axis if h_axis in axes else None, None)
-    abstract = jax.sharding.get_abstract_mesh()
-    sm_mesh = abstract if (abstract is not None and not abstract.empty) \
-        else mesh
+    sm_mesh = _shard_map_mesh(mesh)
 
     @functools.partial(
         jax.shard_map, mesh=sm_mesh, in_specs=(spec,) * 3, out_specs=spec,

@@ -253,3 +253,79 @@ def test_embedding_is_sparse_program_matches_dense(rng):
     np.testing.assert_allclose(w_sparse, w_dense, rtol=1e-6, atol=1e-7)
     # the table moved at all (training actually hit the embedding)
     assert np.abs(w_sparse).sum() > 0
+
+
+def test_merge_selected_rows_op():
+    """merge_selected_rows_op.cc: duplicate ids sum into one slot; the
+    densified result equals the input's scatter-add."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.lowering import run_op
+    from paddle_tpu.core.ir import OpDesc
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    rows = jnp.asarray(np.array([[1., 2.], [3., 4.], [5., 6.]], "f"))
+    ids = jnp.asarray(np.array([2, 0, 2], "i"))
+    sr = SelectedRows(rows, ids, height=4)
+    env = {"x": sr}
+    run_op(OpDesc(type="merge_selected_rows", inputs={"X": ["x"]},
+                  outputs={"Out": ["y"]}, attrs={}), env, None, 0, None,
+           None, False)
+    merged = env["y"]
+    np.testing.assert_allclose(np.asarray(merged.to_dense()),
+                               np.asarray(sr.to_dense()))
+    # slot of the duplicate is zeroed
+    assert np.asarray(merged.rows).sum() == np.asarray(rows).sum()
+
+
+def test_get_tensor_and_split_selected_rows_ops():
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.lowering import run_op
+    from paddle_tpu.core.ir import OpDesc
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    rows = jnp.asarray(np.arange(8, dtype="f").reshape(4, 2))
+    ids = jnp.asarray(np.array([0, 3, 5, 6], "i"))
+    sr = SelectedRows(rows, ids, height=8)
+    env = {"x": sr}
+    run_op(OpDesc(type="get_tensor_from_selected_rows",
+                  inputs={"X": ["x"]}, outputs={"Out": ["t"]}, attrs={}),
+           env, None, 0, None, None, False)
+    np.testing.assert_allclose(np.asarray(env["t"]), np.asarray(rows))
+
+    run_op(OpDesc(type="split_selected_rows", inputs={"X": ["x"]},
+                  outputs={"Out": ["a", "b"]},
+                  attrs={"height_sections": [4, 4]}),
+           env, None, 0, None, None, False)
+    a, b = env["a"], env["b"]
+    # densified halves stitch back to the full scatter
+    full = np.asarray(sr.to_dense())
+    np.testing.assert_allclose(np.asarray(a.to_dense()), full[:4])
+    np.testing.assert_allclose(np.asarray(b.to_dense()), full[4:])
+
+
+def test_coalesce_tensor_and_ref_by_trainer_id():
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.lowering import run_op
+    from paddle_tpu.core.ir import OpDesc
+
+    x = jnp.asarray(np.arange(6, dtype="f").reshape(2, 3))
+    y = jnp.asarray(np.arange(4, dtype="f"))
+    env = {"x": x, "y": y}
+    run_op(OpDesc(type="coalesce_tensor", inputs={"Input": ["x", "y"]},
+                  outputs={"Output": ["xo", "yo"],
+                           "FusedOutput": ["flat"]}, attrs={}),
+           env, None, 0, None, None, False)
+    np.testing.assert_allclose(np.asarray(env["xo"]), np.asarray(x))
+    np.testing.assert_allclose(np.asarray(env["yo"]), np.asarray(y))
+    assert env["flat"].shape == (10,)
+
+    env = {"a": jnp.zeros(3), "b": jnp.ones(3),
+           "tid": jnp.asarray(np.array([1], "int64"))}
+    run_op(OpDesc(type="ref_by_trainer_id",
+                  inputs={"X": ["a", "b"], "TrainerId": ["tid"]},
+                  outputs={"Out": ["o"]}, attrs={}),
+           env, None, 0, None, None, False)
+    np.testing.assert_allclose(np.asarray(env["o"]), 1.0)
