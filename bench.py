@@ -522,9 +522,10 @@ def main():
             emitted = _forward_child_output(out, err)
             if rc is None:
                 if expected and expected not in emitted:
-                    _emit_error(expected,
-                                f"bench subprocess timed out after "
-                                f"{budget:.0f}s (process group killed)")
+                    reason = (err if err.startswith("spawn failed")
+                              else f"bench subprocess timed out after "
+                                   f"{budget:.0f}s (process group killed)")
+                    _emit_error(expected, reason)
                 all_ok = False
             elif rc != 0:
                 all_ok = False

@@ -147,14 +147,18 @@ class _CompiledStep:
         self.fn = jax.jit(step, donate_argnums=(2,))
         self._chained: Dict[int, Any] = {}
 
-    def chained_fn(self, n_steps: int):
-        """n_steps program iterations scan-chained in ONE executable
-        (same feeds each step). Amortizes the fixed per-invocation
-        dispatch/host-tunnel cost (~100 ms on tunneled backends,
-        PROFILE.md) so repeated-step timing measures framework+compute,
-        not transport. Reference analogue: the C++ executor's prepared-
-        context replay loop (executor.py:418 ExecutorPrepareContext)."""
-        fn = self._chained.get(n_steps)
+    def chained_fn(self, n_steps: int, per_step_feeds: bool = False):
+        """n_steps program iterations scan-chained in ONE executable.
+        Amortizes the fixed per-invocation dispatch/host-tunnel cost
+        (~100 ms on tunneled backends, PROFILE.md) so repeated-step
+        timing measures framework+compute, not transport. With
+        per_step_feeds, each feed carries a leading [n_steps] axis and
+        the scan consumes one slice per iteration — a whole data chunk
+        trains in ONE dispatch (the fast path under
+        train_from_dataset's batch loop). Reference analogue: the C++
+        executor's prepared-context replay loop (executor.py:418
+        ExecutorPrepareContext)."""
+        fn = self._chained.get((n_steps, per_step_feeds))
         if fn is not None:
             return fn
         step = self._step
@@ -169,25 +173,31 @@ class _CompiledStep:
                         if k not in mut_keys}
                 return merged, rest
 
+            def feeds_at(i):
+                if not per_step_feeds:
+                    return feeds
+                return {k: v[i] for k, v in feeds.items()}
+
             # step 1 runs outside the scan: write-only states don't exist
             # before it, and the scan carry needs their fixed structure.
             # Carrying them (instead of stacking as scan ys) keeps memory
             # O(1) in n_steps — only the final value is observable in the
             # scope, exactly like sequential execution.
-            fetches0, new0, rng1 = step(feeds, const_states, mut_states,
-                                        rng)
+            fetches0, new0, rng1 = step(feeds_at(0), const_states,
+                                        mut_states, rng)
             mut1, rest1 = split(new0, mut_states)
 
-            def body(carry, _):
+            def body(carry, i):
                 mut, rest, r = carry
                 del rest  # fully replaced: new_rest has the same key set
-                fetches, new_states, new_r = step(feeds, const_states,
-                                                  mut, r)
+                fetches, new_states, new_r = step(feeds_at(i),
+                                                  const_states, mut, r)
                 merged, new_rest = split(new_states, mut)
                 return (merged, new_rest, new_r), fetches
 
             (mut_f, rest_f, rng_f), ys = jax.lax.scan(
-                body, (mut1, rest1, rng1), None, length=n_steps - 1)
+                body, (mut1, rest1, rng1),
+                jnp.arange(1, n_steps), length=n_steps - 1)
             stacked = jax.tree_util.tree_map(
                 lambda f0, fs: jnp.concatenate([f0[None], fs]),
                 fetches0, ys)
@@ -196,16 +206,18 @@ class _CompiledStep:
             return stacked, new_states, rng_f
 
         fn = jax.jit(chained, donate_argnums=(2,))
-        self._chained[n_steps] = fn
+        self._chained[(n_steps, per_step_feeds)] = fn
         return fn
 
     def run_chained(self, scope: Scope, feed: Dict[str, Any], rng,
-                    n_steps: int):
+                    n_steps: int, per_step_feeds: bool = False):
         """Like __call__ but n_steps scan-chained; fetches come back
-        stacked along a leading [n_steps] axis."""
+        stacked along a leading [n_steps] axis. With per_step_feeds,
+        each feed value carries its own leading [n_steps] axis and step
+        i consumes slice i."""
         const_states, mut_states = self._gather_states(scope)
-        fetches, new_states, new_rng = self.chained_fn(n_steps)(
-            feed, const_states, mut_states, rng)
+        fetches, new_states, new_rng = self.chained_fn(
+            n_steps, per_step_feeds)(feed, const_states, mut_states, rng)
         for n, v in new_states.items():
             scope.set_var(n, v)
         return fetches, new_rng
@@ -356,12 +368,16 @@ class Executor:
         return step, norm_feed
 
     def run_chained(self, program=None, feed=None, fetch_list=None,
-                    n_steps=1, scope=None, return_numpy=True):
-        """Run `program` n_steps times with the SAME feeds inside one
-        jitted lax.scan — the cached-executable fast path: a single
-        dispatch covers n_steps iterations, so per-step overhead is
-        framework+compute time rather than the per-invocation host round
-        trip (~100 ms on tunneled backends). Scope state afterwards
+                    n_steps=1, scope=None, return_numpy=True,
+                    per_step_feeds=False):
+        """Run `program` n_steps times inside one jitted lax.scan — the
+        cached-executable fast path: a single dispatch covers n_steps
+        iterations, so per-step overhead is framework+compute time
+        rather than the per-invocation host round trip (~100 ms on
+        tunneled backends). With per_step_feeds, every feed value
+        carries a leading [n_steps] axis and step i trains on slice i
+        (a whole data chunk per dispatch — the fast path under a batch
+        loop); otherwise the same feeds repeat. Scope state afterwards
         matches n_steps sequential `run` calls; each fetch comes back
         stacked with a leading [n_steps] axis."""
         if int(n_steps) < 1:
@@ -371,12 +387,26 @@ class Executor:
             else framework.default_main_program()
         scope = scope if scope is not None else global_scope()
         fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
-        step, norm_feed = self._lookup_step(program, dict(feed or {}),
-                                            fetch_names, True)
+        feed = dict(feed or {})
+        if per_step_feeds:
+            for name, val in feed.items():
+                # shape only — np.asarray would force a device-to-host
+                # copy of the whole chunk on the very path built to
+                # avoid host round trips
+                shape = getattr(val, "shape", None)
+                if shape is None:
+                    shape = np.asarray(val).shape  # lists etc.
+                if tuple(shape[:1]) != (int(n_steps),):
+                    raise ValueError(
+                        f"per_step_feeds: feed '{name}' needs a leading "
+                        f"[{n_steps}] axis, got shape {tuple(shape)}")
+        step, norm_feed = self._lookup_step(program, feed, fetch_names,
+                                            True)
         rng = self._get_rng(scope, program)
         with jax.default_device(self.place.jax_device()):
-            fetches, new_rng = step.run_chained(scope, norm_feed, rng,
-                                                int(n_steps))
+            fetches, new_rng = step.run_chained(
+                scope, norm_feed, rng, int(n_steps),
+                per_step_feeds=bool(per_step_feeds))
         scope.set_var(RNG_STATE_VAR, new_rng)
         if return_numpy:
             return [np.asarray(f) for f in fetches]

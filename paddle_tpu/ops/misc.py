@@ -797,12 +797,21 @@ def coalesce_tensor(ins, attrs, ctx):
     the same values whether they consume the views or the fused flat."""
     xs = ins["Input"]
     dtype = xs[0].dtype
-    flat = jnp.concatenate([x.reshape(-1).astype(dtype) for x in xs])
-    outs, off = [], 0
+    if any(x.dtype != dtype for x in xs):
+        # the reference rejects inputs not matching its dtype attr
+        # (coalesce_tensor_op.cc); a silent cast would round fp32 grads
+        # through the first input's dtype
+        raise TypeError(
+            f"coalesce_tensor: mixed input dtypes "
+            f"{[str(x.dtype) for x in xs]} — all inputs must match")
+    total = sum(int(np.prod(x.shape)) if x.shape else 1 for x in xs)
     set_constant = bool(attrs.get("set_constant", False))
-    const = float(attrs.get("constant", 0.0))
     if set_constant:
-        flat = jnp.full_like(flat, const)
+        flat = jnp.full((total,), float(attrs.get("constant", 0.0)),
+                        dtype)
+    else:
+        flat = jnp.concatenate([x.reshape(-1) for x in xs])
+    outs, off = [], 0
     for x in xs:
         n = int(np.prod(x.shape)) if x.shape else 1
         outs.append(flat[off:off + n].reshape(x.shape))
@@ -834,7 +843,22 @@ def delete_var(ins, attrs, ctx):
              nondiff_inputs=("X", "TrainerId"))
 def ref_by_trainer_id(ins, attrs, ctx):
     """Select this trainer's slice from a list input by TrainerId
-    (distributed_ops/ref_by_trainer_id_op.cc — DC-ASGD plumbing)."""
+    (distributed_ops/ref_by_trainer_id_op.cc — DC-ASGD plumbing). The
+    reference enforces trainer_id < len(X); an out-of-range id here is
+    a misconfigured cluster and must fail fast, not clamp to the last
+    slice (jnp.take's jit default) and silently train on wrong data."""
     tid = jnp.asarray(ins["TrainerId"][0]).reshape(()).astype(jnp.int32)
+    n = len(ins["X"])
+    if not isinstance(tid, jax.core.Tracer):
+        concrete = int(tid)
+        if not 0 <= concrete < n:
+            raise ValueError(
+                f"ref_by_trainer_id: TrainerId {concrete} out of range "
+                f"for {n} inputs")
     xs = jnp.stack([jnp.asarray(x) for x in ins["X"]])
-    return {"Out": jnp.take(xs, tid, axis=0)}
+    # traced ids can't raise at runtime under jit: poison out-of-range
+    # selections with NaN so they surface instead of silently training
+    sel = jnp.take(xs, jnp.clip(tid, 0, n - 1), axis=0)
+    if jnp.issubdtype(xs.dtype, jnp.floating):
+        sel = jnp.where((tid >= 0) & (tid < n), sel, jnp.nan)
+    return {"Out": sel}

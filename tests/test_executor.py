@@ -173,3 +173,53 @@ def test_run_chained_matches_sequential(rng):
                         n_steps=3)
         (step,) = [s for s in exe._cache.values() if s.fetch_names]
         assert step.chained_fn(3)._cache_size() == 1
+
+
+def test_run_chained_per_step_feeds_matches_sequential(rng):
+    """per_step_feeds: a whole data chunk (leading [n_steps] axis) trains
+    in ONE dispatch; per-step losses and final params must match n
+    sequential run() calls on the individual batches."""
+    n, bs = 4, 16
+    Xs = rng.rand(n, bs, 13).astype("float32")
+    W = rng.rand(13, 1)
+    Ys = np.einsum("nbi,io->nbo", Xs, W).astype("float32")
+
+    def train(chained):
+        pt.framework.unique_name.generator = \
+            pt.framework.UniqueNameGenerator()
+        main, startup, loss = _linreg_program()
+        exe = pt.Executor(pt.CPUPlace())
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe.run(startup)
+            if chained:
+                losses = exe.run_chained(
+                    main, feed={"x": Xs, "y": Ys}, fetch_list=[loss],
+                    n_steps=n, per_step_feeds=True)[0]
+                losses = [float(v) for v in np.asarray(losses).ravel()]
+            else:
+                losses = [float(exe.run(main,
+                                        feed={"x": Xs[i], "y": Ys[i]},
+                                        fetch_list=[loss])[0])
+                          for i in range(n)]
+            params = {v.name: np.array(scope.get(v.name))
+                      for v in main.list_vars()
+                      if isinstance(v, pt.Parameter)}
+        return losses, params
+
+    seq_losses, seq_params = train(False)
+    ch_losses, ch_params = train(True)
+    np.testing.assert_allclose(ch_losses, seq_losses, rtol=1e-6)
+    for name in seq_params:
+        np.testing.assert_allclose(ch_params[name], seq_params[name],
+                                   rtol=1e-5, atol=1e-7)
+    # wrong leading axis is a clear error, not a cryptic trace failure
+    exe = pt.Executor(pt.CPUPlace())
+    pt.framework.unique_name.generator = pt.framework.UniqueNameGenerator()
+    main, startup, loss = _linreg_program()
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        with pytest.raises(ValueError, match="leading"):
+            exe.run_chained(main, feed={"x": Xs[0], "y": Ys[0]},
+                            fetch_list=[loss], n_steps=n,
+                            per_step_feeds=True)

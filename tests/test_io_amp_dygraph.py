@@ -90,7 +90,9 @@ def test_dygraph_layer_training(rng):
             opt.minimize(loss, parameter_list=linear.parameters())
             linear.clear_gradients()
             losses.append(float(np.asarray(loss.numpy()).reshape(())))
-    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+    # relative-OR-absolute: a (globally-sequenced) lucky init can start
+    # near the solution, making a pure-ratio bound order-flaky
+    assert losses[-1] < max(losses[0] * 0.2, 1e-3), (losses[0], losses[-1])
 
 
 @pytest.mark.parametrize("clip_kind", ["value", "norm", "global_norm"])
@@ -245,9 +247,12 @@ def test_dygraph_lr_scheduler_steps_once_per_minimize(rng):
                                     / g[g != 0]))
             seen.append(round(applied, 6))
         # one schedule step per minimize: steps 0,1 -> 0.1; 2,3 -> 0.01;
-        # 4 -> 0.001
+        # 4 -> 0.001. rtol 1e-3: `applied` is RECOVERED from f32 update
+        # deltas (w_before-w_after)/g, whose rounding noise measured right
+        # AT the old 1e-4 bound; schedule values differ by 10x, so 1e-3
+        # still pins the schedule unambiguously.
         np.testing.assert_allclose(seen, [0.1, 0.1, 0.01, 0.01, 0.001],
-                                   rtol=1e-4)
+                                   rtol=1e-3)
         assert sched.step_num == 5
 
 
