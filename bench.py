@@ -169,6 +169,8 @@ def bench_lenet_smoke(mesh, n_chips, platform, on_tpu):
 
 
 def bench_resnet50(mesh, n_chips, platform, on_tpu):
+    import dataclasses
+
     import optax
 
     from paddle_tpu.models import resnet
@@ -179,29 +181,54 @@ def bench_resnet50(mesh, n_chips, platform, on_tpu):
     hw = 224 if on_tpu else 32
     batch_sizes = [256, 128, 64, 32] if on_tpu else [16]
 
-    def build(bs):
-        params, axes = resnet.init(jax.random.key(0), cfg)
+    def build_with(cfg):
+        def build(bs):
+            params, axes = resnet.init(jax.random.key(0), cfg)
 
-        def loss_fn(p, b, r):
-            # NHWC end-to-end: a real TPU input pipeline delivers NHWC;
-            # the NCHW shim exists for reference-API parity only.
-            return resnet.loss_fn(p, cfg, b, r, data_format="NHWC")
+            def loss_fn(p, b, r):
+                # NHWC end-to-end: a real TPU input pipeline delivers
+                # NHWC; the NCHW shim is reference-API parity only.
+                return resnet.loss_fn(p, cfg, b, r, data_format="NHWC")
 
-        init_state, step = make_train_step(
-            loss_fn, optax.sgd(0.1, momentum=0.9), mesh, axes,
-            strategy=TrainStrategy(shard_optimizer_states=False),
-            has_aux=True)
-        state = init_state(params)
-        batch = resnet.make_batch(jax.random.key(1), cfg, bs, hw=hw,
-                                  data_format="NHWC")
-        return step, state, batch
+            init_state, step = make_train_step(
+                loss_fn, optax.sgd(0.1, momentum=0.9), mesh, axes,
+                strategy=TrainStrategy(shard_optimizer_states=False),
+                has_aux=True)
+            state = init_state(params)
+            batch = resnet.make_batch(jax.random.key(1), cfg, bs, hw=hw,
+                                      data_format="NHWC")
+            return step, state, batch
+        return build
+
+    # A/B the pallas fused-1x1 path (byte-floor attack, PROFILE.md r5)
+    # at a fixed shape; failure-isolated so a kernel/compile problem
+    # costs only this detail field, never the headline metric.
+    fused_ab = "not_measured"
+    if on_tpu and mesh.devices.size == 1:
+        from paddle_tpu.parallel import mesh_guard
+
+        def _fused_ab():
+            # inner function: its locals (params/moments/batch) die on
+            # unwind even when _measure raises, so a failed A/B cannot
+            # hold HBM through the headline ladder
+            cfgf = dataclasses.replace(cfg, fused_1x1=True)
+            with mesh_guard(mesh):
+                step, state, batch = build_with(cfgf)(128)
+                dt, _ = _measure(step, state, batch, 10)
+            return {"step_ms_bs128": round(1000 * dt / 10, 2)}
+
+        try:
+            fused_ab = _fused_ab()
+        except Exception as e:
+            fused_ab = f"fail: {str(e)[:120]}"
+        jax.clear_caches()
 
     return _run_ladder(
         "resnet50_train_samples_per_sec_per_chip" if on_tpu
         else "resnet_tiny_cpu_samples_per_sec",
-        batch_sizes, build, cfg.flops_per_image(hw),
-        20 if on_tpu else 3, n_chips, platform, {"image_hw": hw},
-        mesh=mesh)
+        batch_sizes, build_with(cfg), cfg.flops_per_image(hw),
+        20 if on_tpu else 3, n_chips, platform,
+        {"image_hw": hw, "fused_1x1_ab": fused_ab}, mesh=mesh)
 
 
 def bench_transformer_big(mesh, n_chips, platform, on_tpu):

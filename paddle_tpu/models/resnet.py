@@ -30,6 +30,13 @@ class ResNetConfig:
     dtype: str = "bfloat16"
     bn_momentum: float = 0.9
     bn_eps: float = 1e-5
+    # Pallas fused matmul+BN for the bottleneck 1x1 convs
+    # (ops/pallas/fused_dense_bn.py): conv1/conv3 run as matmuls with BN
+    # stats in the epilogue and the preceding BN-apply+relu in conv3's
+    # prologue — the byte-floor attack scoped by tools/rn50_bytes_table.py.
+    # Default OFF (the XLA path is the settled baseline); training-mode,
+    # single-device-or-manual-region only (pallas has no GSPMD rule).
+    fused_1x1: bool = False
 
     @staticmethod
     def resnet50():
@@ -83,6 +90,79 @@ def _conv(params, name, x, stride=1, padding="SAME"):
     return conv2d_nhwc_auto(params, name, x, stride, padding)
 
 
+def _bn_ema(params, state_updates, name, mean, var, cfg):
+    """Write the running-stat EMA updates for batch stats (mean, var)."""
+    m = cfg.bn_momentum
+    state_updates[f"{name}.mean"] = m * params[f"{name}.mean"] + (1 - m) * mean
+    state_updates[f"{name}.var"] = m * params[f"{name}.var"] + (1 - m) * var
+
+
+def _bn_stats(x):
+    """One-pass batch stats: E[x] and E[x^2] fuse into a single read of
+    the activations (jnp.var's (x-mean)^2 forces a second pass; measured
+    116->105 ms fwd+bwd for RN50 bs=256 — PROFILE.md). Promoted (f32, or
+    f64 under x64 rigs) accumulation keeps the cancellation benign (the
+    cudnn approach). Shared by _bn and the fused-1x1 path so stats
+    semantics cannot diverge."""
+    xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
+    mean = xf.mean((0, 1, 2))
+    var = jnp.maximum((xf * xf).mean((0, 1, 2)) - mean * mean, 0.0)
+    return mean, var
+
+
+def _fused_1x1_ok(params, p, cfg, train: bool) -> bool:
+    """Gate for the pallas fused-1x1 path: opt-in, training mode, fp
+    weights (the int8 serving path must keep conv2d_nhwc_auto's scale
+    dispatch), and a context where a pallas_call is legal (single
+    device / manual region)."""
+    if not (cfg.fused_1x1 and train):
+        return False
+    if params[f"{p}.conv1.w"].dtype == jnp.int8 or \
+            params[f"{p}.conv3.w"].dtype == jnp.int8:
+        return False
+    from ..parallel.mesh import current_mesh
+
+    m = current_mesh()
+    return m is None or m.devices.size == 1
+
+
+def _fused_block_tail(params, upd, p, x, cfg):
+    """conv1+bn1-stats, relu; conv2(3x3) unchanged via XLA; bn2-apply+
+    relu fused into conv3's prologue with bn3 stats in its epilogue.
+    Only the stride-1 non-proj shape runs fused (stride lives on conv2).
+    Returns the block's pre-residual output h (bn3-normalized)."""
+    from ..ops.pallas import fused_dense_bn as F
+
+    B, H, W, C = x.shape
+    w1 = params[f"{p}.conv1.w"].astype(x.dtype).reshape(C, -1)
+    h1, m1, v1 = F.matmul_stats(x.reshape(-1, C), w1)
+    _bn_ema(params, upd, f"{p}.bn1", m1, v1, cfg)
+    s1, b1 = F.fold_bn(m1, v1, params[f"{p}.bn1.scale"],
+                       params[f"{p}.bn1.bias"], cfg.bn_eps)
+    h1 = jnp.maximum(h1.astype(s1.dtype) * s1 + b1, 0.0).astype(x.dtype)
+    return h1.reshape(B, H, W, -1)
+
+
+def _fused_conv3(params, upd, p, h2raw, cfg):
+    """bn2-apply+relu (prologue) -> conv3 1x1 (matmul) -> bn3 stats
+    (epilogue), one kernel; h2raw is conv2's RAW output."""
+    from ..ops.pallas import fused_dense_bn as F
+
+    B, H, W, C = h2raw.shape
+    m2, v2 = _bn_stats(h2raw)
+    _bn_ema(params, upd, f"{p}.bn2", m2, v2, cfg)
+    s2, b2 = F.fold_bn(m2, v2, params[f"{p}.bn2.scale"],
+                       params[f"{p}.bn2.bias"], cfg.bn_eps)
+    w3 = params[f"{p}.conv3.w"].astype(h2raw.dtype).reshape(C, -1)
+    h3, m3, v3 = F.bn_act_matmul_stats(h2raw.reshape(-1, C), s2, b2, w3,
+                                       relu=True)
+    _bn_ema(params, upd, f"{p}.bn3", m3, v3, cfg)
+    s3, b3 = F.fold_bn(m3, v3, params[f"{p}.bn3.scale"],
+                       params[f"{p}.bn3.bias"], cfg.bn_eps)
+    h3 = (h3.astype(s3.dtype) * s3 + b3).astype(h2raw.dtype)
+    return h3.reshape(B, H, W, -1)
+
+
 def _bn(params, state_updates, name, x, cfg, train: bool):
     """BN in fp32; updates running stats into state_updates when training.
     When the batch axis is sharded over 'dp', XLA computes the mean/var with
@@ -93,15 +173,8 @@ def _bn(params, state_updates, name, x, cfg, train: bool):
     summation order, which would mask dp-vs-single parity checks."""
     xf = x.astype(jnp.promote_types(x.dtype, jnp.float32))
     if train:
-        # one-pass stats: E[x] and E[x^2] fuse into a single read of the
-        # activations (jnp.var's (x-mean)^2 forces a second pass; measured
-        # 116->105 ms fwd+bwd for RN50 bs=256 — PROFILE.md). f32
-        # accumulation keeps the cancellation benign (the cudnn approach).
-        mean = xf.mean((0, 1, 2))
-        var = jnp.maximum((xf * xf).mean((0, 1, 2)) - mean * mean, 0.0)
-        m = cfg.bn_momentum
-        state_updates[f"{name}.mean"] = m * params[f"{name}.mean"] + (1 - m) * mean
-        state_updates[f"{name}.var"] = m * params[f"{name}.var"] + (1 - m) * var
+        mean, var = _bn_stats(x)
+        _bn_ema(params, state_updates, name, mean, var, cfg)
     else:
         mean = params[f"{name}.mean"]
         var = params[f"{name}.var"]
@@ -141,13 +214,23 @@ def apply(params: Params, cfg: ResNetConfig, img: jax.Array,
             if bi == 0:
                 sc = _conv(params, f"{p}.proj", x, stride=stride)
                 sc = _bn(params, upd, f"{p}.proj.bn", sc, cfg, train)
-            h = jax.nn.relu(_bn(params, upd, f"{p}.bn1",
-                                _conv(params, f"{p}.conv1", x), cfg, train))
-            h = jax.nn.relu(_bn(params, upd, f"{p}.bn2",
-                                _conv(params, f"{p}.conv2", h, stride=stride),
-                                cfg, train))
-            h = _bn(params, upd, f"{p}.bn3",
-                    _conv(params, f"{p}.conv3", h), cfg, train)
+            if _fused_1x1_ok(params, p, cfg, train):
+                # pallas fused 1x1 path (byte-floor attack): conv1 with
+                # bn1 stats in its epilogue; bn2-apply+relu in conv3's
+                # prologue with bn3 stats in its epilogue
+                h = _fused_block_tail(params, upd, p, x, cfg)
+                h2raw = _conv(params, f"{p}.conv2", h, stride=stride)
+                h = _fused_conv3(params, upd, p, h2raw, cfg)
+            else:
+                h = jax.nn.relu(_bn(params, upd, f"{p}.bn1",
+                                    _conv(params, f"{p}.conv1", x), cfg,
+                                    train))
+                h = jax.nn.relu(_bn(params, upd, f"{p}.bn2",
+                                    _conv(params, f"{p}.conv2", h,
+                                          stride=stride),
+                                    cfg, train))
+                h = _bn(params, upd, f"{p}.bn3",
+                        _conv(params, f"{p}.conv3", h), cfg, train)
             x = jax.nn.relu(h + sc)
     x = x.mean((1, 2))  # global avg pool
     logits = dense(params, "head", x.astype(jnp.float32))
