@@ -54,6 +54,7 @@ from . import reader
 from .reader import DataLoader, PyReader
 from . import dygraph
 from .dygraph.base import enable_dygraph, disable_dygraph
+from . import observability
 from . import profiler
 from . import amp
 from . import param_attr

@@ -25,6 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability import telemetry as _telemetry
+from ..observability import tracing as _tracing
 from . import framework, lowering
 from .executor import RNG_STATE_VAR, Scope, _as_fetch_name, global_scope
 from .framework import Program
@@ -151,40 +153,43 @@ class CompiledProgram:
         return self._mesh
 
     def _run(self, executor, feed, fetch_list, scope, return_numpy):
-        program = self._program
-        scope = scope if scope is not None else global_scope()
-        feed = dict(feed or {})
-        fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
-        mesh = self._get_mesh()
+        with _telemetry.executor_step("sharded") as rec:
+            program = self._program
+            scope = scope if scope is not None else global_scope()
+            feed = dict(feed or {})
+            fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
+            mesh = self._get_mesh()
 
-        norm_feed = {}
-        for name, val in feed.items():
-            vdesc = None
-            for b in program.desc.blocks:
-                if name in b.vars:
-                    vdesc = b.vars[name]
-                    break
-            arr = jnp.asarray(val)
-            if vdesc is not None:
-                want = np.dtype(normalize_dtype(vdesc.dtype))
-                if arr.dtype != want:
-                    arr = arr.astype(want)
-            norm_feed[name] = arr
+            norm_feed = {}
+            for name, val in feed.items():
+                vdesc = None
+                for b in program.desc.blocks:
+                    if name in b.vars:
+                        vdesc = b.vars[name]
+                        break
+                arr = jnp.asarray(val)
+                if vdesc is not None:
+                    want = np.dtype(normalize_dtype(vdesc.dtype))
+                    if arr.dtype != want:
+                        arr = arr.astype(want)
+                norm_feed[name] = arr
+            rec.set_feed(norm_feed)
 
-        feed_sig = tuple(sorted((k, tuple(v.shape), str(v.dtype)) for k, v in norm_feed.items()))
-        key = (program._version, feed_sig, fetch_names)
-        step = self._cache.get(key)
-        if step is None:
-            step = _ShardedStep(program, tuple(norm_feed), fetch_names, mesh,
-                                self._build_strategy)
-            self._cache[key] = step
+            feed_sig = tuple(sorted((k, tuple(v.shape), str(v.dtype)) for k, v in norm_feed.items()))
+            key = (program._version, feed_sig, fetch_names)
+            step = self._cache.get(key)
+            if step is None:
+                step = _ShardedStep(program, tuple(norm_feed), fetch_names,
+                                    mesh, self._build_strategy)
+                self._cache[key] = step
 
-        rng = executor._get_rng(scope, program)
-        fetches, new_rng = step(scope, norm_feed, rng)
-        scope.set_var(RNG_STATE_VAR, new_rng)
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+            rng = executor._get_rng(scope, program)
+            with _tracing.span("compiled_program.run", cat="step",
+                               fetches=len(fetch_names)):
+                fetches, new_rng = step(scope, norm_feed, rng)
+            scope.set_var(RNG_STATE_VAR, new_rng)
+            return [np.asarray(f) for f in fetches] if return_numpy \
+                else list(fetches)
 
 
 class _ShardedStep:

@@ -12,12 +12,16 @@ ExecutorPrepareContext cache (executor.py:831 program cache).
 from __future__ import annotations
 
 import contextlib
+import threading
+import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import telemetry as _telemetry
+from ..observability import tracing as _tracing
 from . import framework, lowering
 from .framework import Program, Variable
 from .ir import normalize_dtype
@@ -249,6 +253,13 @@ class _CompiledStep:
         return fetches, new_rng
 
 
+# the cache-entries gauge promises a process-wide total, not the count of
+# whichever executor ran last; the lock keeps hot-path iteration safe
+# against a concurrent Executor() construction in another thread
+_live_executors: "weakref.WeakSet[Executor]" = weakref.WeakSet()
+_live_executors_lock = threading.Lock()
+
+
 class Executor:
     """reference: python/paddle/fluid/executor.py:418."""
 
@@ -257,6 +268,8 @@ class Executor:
         self._cache: Dict[Any, _CompiledStep] = {}
         self._cache_hits = 0
         self._cache_misses = 0
+        with _live_executors_lock:
+            _live_executors.add(self)
 
     def close(self):
         self._cache.clear()
@@ -264,7 +277,10 @@ class Executor:
     def cache_stats(self) -> Dict[str, int]:
         """Program-cache behavior, observable for benchmarks/tests: after
         the first run of a (program, feed-signature) pair every later
-        run must be a hit — step 2+ retraces/recompiles nothing."""
+        run must be a hit — step 2+ retraces/recompiles nothing. The same
+        events feed the process-wide registry
+        (paddle_tpu_executor_cache_total in observability.snapshot());
+        this per-instance view stays for single-executor assertions."""
         return {"hits": self._cache_hits, "misses": self._cache_misses,
                 "entries": len(self._cache)}
 
@@ -304,36 +320,40 @@ class Executor:
             server.serve_forever()  # blocks until shutdown request
             return []
 
-        step, norm_feed = self._lookup_step(program, feed, fetch_names,
-                                            use_program_cache)
-        rng = self._get_rng(scope, program)
-        with jax.default_device(self.place.jax_device()):
-            fetches, new_rng = step(scope, norm_feed, rng)
-        scope.set_var(RNG_STATE_VAR, new_rng)
+        with _telemetry.executor_step("run") as rec:
+            step, norm_feed = self._lookup_step(program, feed, fetch_names,
+                                                use_program_cache)
+            rec.set_feed(norm_feed)
+            rng = self._get_rng(scope, program)
+            with _tracing.span("executor.run", cat="step",
+                               fetches=len(fetch_names)):
+                with jax.default_device(self.place.jax_device()):
+                    fetches, new_rng = step(scope, norm_feed, rng)
+            scope.set_var(RNG_STATE_VAR, new_rng)
 
-        from .flags import get_flag
+            from .flags import get_flag
 
-        if get_flag("FLAGS_check_nan_inf"):
-            # reference: FLAGS_check_nan_inf (flags.cc:44) — per-op NaN scan;
-            # here the post-step scan covers every written state + fetch
-            for n in step.writes:
-                v = scope.find_var(n)
-                if v is not None and jnp.issubdtype(
-                        jnp.asarray(v).dtype, jnp.floating):
-                    if not bool(jnp.isfinite(v).all()):
+            if get_flag("FLAGS_check_nan_inf"):
+                # reference: FLAGS_check_nan_inf (flags.cc:44) — per-op NaN
+                # scan; here the post-step scan covers every written state
+                # + fetch
+                for n in step.writes:
+                    v = scope.find_var(n)
+                    if v is not None and jnp.issubdtype(
+                            jnp.asarray(v).dtype, jnp.floating):
+                        if not bool(jnp.isfinite(v).all()):
+                            raise RuntimeError(
+                                f"FLAGS_check_nan_inf: variable '{n}' "
+                                f"contains NaN/Inf after this step")
+                for name, f in zip(fetch_names, fetches):
+                    if jnp.issubdtype(jnp.asarray(f).dtype, jnp.floating) \
+                            and not bool(jnp.isfinite(f).all()):
                         raise RuntimeError(
-                            f"FLAGS_check_nan_inf: variable '{n}' contains "
-                            f"NaN/Inf after this step")
-            for name, f in zip(fetch_names, fetches):
-                if jnp.issubdtype(jnp.asarray(f).dtype, jnp.floating) and \
-                        not bool(jnp.isfinite(f).all()):
-                    raise RuntimeError(
-                        f"FLAGS_check_nan_inf: fetch '{name}' contains "
-                        f"NaN/Inf")
+                            f"FLAGS_check_nan_inf: fetch '{name}' contains "
+                            f"NaN/Inf")
 
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+            return [np.asarray(f) for f in fetches] if return_numpy \
+                else list(fetches)
 
     def _lookup_step(self, program: Program, feed: Dict[str, Any],
                      fetch_names: Tuple[str, ...], use_program_cache: bool):
@@ -358,6 +378,7 @@ class Executor:
         feed_sig = tuple(sorted((k, tuple(v.shape), str(v.dtype)) for k, v in norm_feed.items()))
         key = (id(program), program._version, feed_sig, fetch_names, program._is_test)
         step = self._cache.get(key) if use_program_cache else None
+        hit = step is not None
         if step is None:
             self._cache_misses += 1
             step = _CompiledStep(program, tuple(norm_feed), fetch_names, program._is_test)
@@ -365,6 +386,9 @@ class Executor:
                 self._cache[key] = step
         else:
             self._cache_hits += 1
+        with _live_executors_lock:
+            entries = sum(len(e._cache) for e in _live_executors)
+        _telemetry.record_cache_event(hit=hit, entries=entries)
         return step, norm_feed
 
     def run_chained(self, program=None, feed=None, fetch_list=None,
@@ -400,17 +424,20 @@ class Executor:
                     raise ValueError(
                         f"per_step_feeds: feed '{name}' needs a leading "
                         f"[{n_steps}] axis, got shape {tuple(shape)}")
-        step, norm_feed = self._lookup_step(program, feed, fetch_names,
-                                            True)
-        rng = self._get_rng(scope, program)
-        with jax.default_device(self.place.jax_device()):
-            fetches, new_rng = step.run_chained(
-                scope, norm_feed, rng, int(n_steps),
-                per_step_feeds=bool(per_step_feeds))
-        scope.set_var(RNG_STATE_VAR, new_rng)
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+        with _telemetry.executor_step("chained") as rec:
+            step, norm_feed = self._lookup_step(program, feed, fetch_names,
+                                                True)
+            rec.set_feed(norm_feed)
+            rng = self._get_rng(scope, program)
+            with _tracing.span("executor.run_chained", cat="step",
+                               n_steps=int(n_steps)):
+                with jax.default_device(self.place.jax_device()):
+                    fetches, new_rng = step.run_chained(
+                        scope, norm_feed, rng, int(n_steps),
+                        per_step_feeds=bool(per_step_feeds))
+            scope.set_var(RNG_STATE_VAR, new_rng)
+            return [np.asarray(f) for f in fetches] if return_numpy \
+                else list(fetches)
 
     def _get_rng(self, scope: Scope, program: Program):
         rng = scope.find_var(RNG_STATE_VAR)

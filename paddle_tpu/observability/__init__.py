@@ -1,0 +1,39 @@
+"""Unified observability layer: metrics registry, step telemetry, traces.
+
+Three pieces (see PROFILE.md §Observability for the user-facing guide):
+
+- metrics.py   — process-wide registry (counters/gauges/histograms with
+                 labels), JSON + Prometheus exposition, env-gated periodic
+                 dump (PADDLE_TPU_METRICS_DIR).
+- tracing.py   — one span store for profiler.RecordEvent host spans and
+                 step telemetry, merged with jax.profiler device traces
+                 into a single chrome-trace export.
+- telemetry.py — the metric vocabulary + record helpers the executor,
+                 trainer, and SPMD/pipeline stacks call on their hot
+                 paths.
+
+`tools/obsdump.py` pretty-prints dumps and rebuilds traces offline.
+"""
+
+from . import metrics
+from . import tracing
+from . import telemetry
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, counter, default_registry,
+    dump, gauge, histogram, maybe_start_dump_thread, render_prometheus,
+    reset, snapshot, stop_dump_thread,
+)
+from .tracing import (  # noqa: F401
+    Span, clear_spans, export_trace, get_spans, record_span, save_spans,
+    span,
+)
+
+__all__ = [
+    "metrics", "tracing", "telemetry",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "counter",
+    "default_registry", "dump", "gauge", "histogram",
+    "maybe_start_dump_thread", "render_prometheus", "reset", "snapshot",
+    "stop_dump_thread",
+    "Span", "clear_spans", "export_trace", "get_spans", "record_span",
+    "save_spans", "span",
+]

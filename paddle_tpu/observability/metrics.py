@@ -1,0 +1,373 @@
+"""Process-wide metrics registry: counters, gauges, histograms with labels.
+
+Reference analogue: the profiler's event aggregation tables
+(platform/profiler.cc DeviceTracer counters + the benchmark counters
+scattered through operators/); here a single registry every subsystem
+writes into, with JSON and Prometheus-text exposition so a serving
+deployment can scrape the process and `tools/obsdump.py` can pretty-print
+a dump offline.
+
+Env gating (read lazily, so tests can monkeypatch):
+  PADDLE_TPU_METRICS_DIR        if set, a daemon thread periodically writes
+                                metrics.json + metrics.prom into this dir
+  PADDLE_TPU_METRICS_INTERVAL_S dump period in seconds (default 60)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "default_registry", "counter", "gauge", "histogram",
+    "snapshot", "render_prometheus", "dump", "reset",
+    "maybe_start_dump_thread", "stop_dump_thread",
+]
+
+# Seconds-scale latency buckets: 50us .. 60s covers a jit dispatch on a
+# local backend through a cold compile on a tunneled one.
+DEFAULT_BUCKETS = (
+    50e-6, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2,
+    5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def _label_key(labelnames: Sequence[str], labels: Dict[str, str]):
+    if set(labels) != set(labelnames):
+        raise ValueError(
+            f"labels {sorted(labels)} do not match declared labelnames "
+            f"{sorted(labelnames)}")
+    return tuple(str(labels[n]) for n in labelnames)
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[str, ...], object] = {}
+
+    def _labels_dict(self, key: Tuple[str, ...]) -> Dict[str, str]:
+        return dict(zip(self.labelnames, key))
+
+    def clear(self):
+        with self._lock:
+            self._values.clear()
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (steps, bytes, cache hits)."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1, **labels):
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + amount
+
+    def value(self, **labels) -> float:
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            return self._values.get(key, 0)
+
+
+class Gauge(_Metric):
+    """Point-in-time value (cache entries, examples/sec, bubble fraction)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels):
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1, **labels):
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1, **labels):
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics): per label set
+    keeps (count, sum, per-bucket counts); `le` buckets are cumulative at
+    render time."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(),
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+
+    def observe(self, value: float, **labels):
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            st = self._values.get(key)
+            if st is None:
+                st = {"count": 0, "sum": 0.0,
+                      "buckets": [0] * len(self.buckets)}
+                self._values[key] = st
+            st["count"] += 1
+            st["sum"] += float(value)
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    st["buckets"][i] += 1
+                    break
+            # values above the top bucket land only in +Inf (count)
+
+    def stats(self, **labels) -> Dict[str, float]:
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            st = self._values.get(key)
+            if st is None:
+                return {"count": 0, "sum": 0.0, "avg": 0.0}
+            return {"count": st["count"], "sum": st["sum"],
+                    "avg": st["sum"] / max(1, st["count"])}
+
+
+class MetricsRegistry:
+    """get-or-create registry; re-registration with a different kind or
+    label set is a hard error (silent divergence would corrupt dumps)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name, help, labelnames, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if type(m) is not cls or m.labelnames != tuple(labelnames):
+                    raise ValueError(
+                        f"metric '{name}' already registered as "
+                        f"{type(m).__name__}{m.labelnames}, requested "
+                        f"{cls.__name__}{tuple(labelnames)}")
+                return m
+            m = cls(name, help, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="", labelnames=()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(),
+                  buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labelnames,
+                                   buckets=buckets)
+
+    def reset(self):
+        """Zero every metric's values; registered metric OBJECTS survive
+        (subsystems hold references to them)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m.clear()
+
+    # -- exposition ----------------------------------------------------
+
+    def snapshot(self) -> Dict[str, dict]:
+        """JSON-able view of every metric (the obsdump/dump format)."""
+        out = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            with m._lock:
+                series = []
+                for key, val in sorted(m._values.items()):
+                    entry = {"labels": m._labels_dict(key)}
+                    if m.kind == "histogram":
+                        entry.update(
+                            count=val["count"], sum=val["sum"],
+                            buckets=[
+                                {"le": b, "count": c} for b, c in
+                                zip(m.buckets, val["buckets"])])
+                    else:
+                        entry["value"] = val
+                    series.append(entry)
+            out[m.name] = {"type": m.kind, "help": m.help,
+                           "series": series}
+        return out
+
+    def render_prometheus(self) -> str:
+        return render_prometheus_snapshot(self.snapshot())
+
+    def dump(self, directory: str) -> str:
+        """Write metrics.json + metrics.prom into `directory` (tmp+rename
+        so a scraper never reads a torn file). Returns the json path."""
+        os.makedirs(directory, exist_ok=True)
+        snap = self.snapshot()
+        jpath = os.path.join(directory, "metrics.json")
+        ppath = os.path.join(directory, "metrics.prom")
+        for path, text in ((jpath, json.dumps(snap, indent=1)),
+                           (ppath, render_prometheus_snapshot(snap))):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(text)
+            os.replace(tmp, path)
+        return jpath
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(str(v))}"'
+             for k, v in sorted(labels.items())]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_prometheus_snapshot(snap: Dict[str, dict]) -> str:
+    """Prometheus text exposition from a snapshot() dict. Module-level so
+    tools/obsdump.py can render an offline metrics.json without importing
+    the framework (and the jax stack behind it)."""
+    lines = []
+    for name in sorted(snap):
+        m = snap[name]
+        if m.get("help"):
+            lines.append(f"# HELP {name} {m['help']}")
+        lines.append(f"# TYPE {name} {m['type']}")
+        for s in m["series"]:
+            labels = s.get("labels", {})
+            if m["type"] == "histogram":
+                cum = 0
+                for b in s["buckets"]:
+                    cum += b["count"]
+                    le = 'le="%g"' % b["le"]
+                    lines.append(
+                        f"{name}_bucket{_fmt_labels(labels, le)} {cum}")
+                inf = 'le="+Inf"'
+                lines.append(
+                    f"{name}_bucket{_fmt_labels(labels, inf)} "
+                    f"{s['count']}")
+                lines.append(f"{name}_sum{_fmt_labels(labels)} {s['sum']}")
+                lines.append(
+                    f"{name}_count{_fmt_labels(labels)} {s['count']}")
+            else:
+                lines.append(f"{name}{_fmt_labels(labels)} {s['value']}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Default registry + periodic env-gated dump
+# ---------------------------------------------------------------------------
+
+_default = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    return _default
+
+
+def counter(name, help="", labelnames=()) -> Counter:
+    return _default.counter(name, help, labelnames)
+
+
+def gauge(name, help="", labelnames=()) -> Gauge:
+    return _default.gauge(name, help, labelnames)
+
+
+def histogram(name, help="", labelnames=(), buckets=DEFAULT_BUCKETS):
+    return _default.histogram(name, help, labelnames, buckets)
+
+
+def snapshot() -> Dict[str, dict]:
+    return _default.snapshot()
+
+
+def render_prometheus() -> str:
+    return _default.render_prometheus()
+
+
+def dump(directory: Optional[str] = None) -> str:
+    d = directory or os.environ.get("PADDLE_TPU_METRICS_DIR")
+    if not d:
+        raise ValueError("no directory given and PADDLE_TPU_METRICS_DIR "
+                         "is unset")
+    return _default.dump(d)
+
+
+def reset():
+    _default.reset()
+
+
+_dump_thread: Optional[threading.Thread] = None
+_dump_stop = threading.Event()
+_dump_lock = threading.Lock()
+_atexit_registered = False
+
+
+def maybe_start_dump_thread() -> bool:
+    """Start the periodic dump daemon iff PADDLE_TPU_METRICS_DIR is set
+    and no dumper is running yet. Called from the telemetry hot-path
+    helpers, so merely setting the env var before training is enough."""
+    global _dump_thread, _atexit_registered
+    d = os.environ.get("PADDLE_TPU_METRICS_DIR")
+    if not d:
+        return False
+    with _dump_lock:
+        if _dump_thread is not None and _dump_thread.is_alive():
+            return True
+        try:
+            interval = float(os.environ.get(
+                "PADDLE_TPU_METRICS_INTERVAL_S", "60"))
+        except ValueError:
+            interval = 60.0  # malformed env must not kill the hot path
+        if interval <= 0:
+            interval = 60.0  # 0/negative would busy-loop the dumper
+        _dump_stop.clear()
+
+        def loop():
+            while not _dump_stop.wait(interval):
+                try:
+                    _default.dump(d)
+                except OSError:
+                    pass  # dir vanished mid-run; keep the trainer alive
+            # final dump so short runs still leave a snapshot behind
+            try:
+                _default.dump(d)
+            except OSError:
+                pass
+
+        _dump_thread = threading.Thread(
+            target=loop, name="paddle-tpu-metrics-dump", daemon=True)
+        _dump_thread.start()
+        if not _atexit_registered:
+            # daemon threads die silently at interpreter exit — without
+            # this, a run shorter than the interval leaves no snapshot
+            import atexit
+
+            atexit.register(stop_dump_thread)
+            _atexit_registered = True
+        return True
+
+
+def stop_dump_thread():
+    global _dump_thread
+    with _dump_lock:
+        t, _dump_thread = _dump_thread, None
+    if t is not None and t.is_alive():
+        _dump_stop.set()
+        t.join(timeout=5)

@@ -1,0 +1,157 @@
+"""Step-telemetry metric definitions + record helpers for the hot paths.
+
+Every framework subsystem funnels through these helpers instead of
+touching the registry ad hoc, so the metric names/labels stay one
+vocabulary (documented in PROFILE.md §Observability):
+
+  executor  — step wall time, feed bytes, program-cache hits/misses
+  trainer   — step/example throughput
+  spmd      — per-mesh-axis step time + collective-op counts
+  pipeline  — schedule shape (stages, microbatches, bubble fraction)
+
+This module must stay import-light (stdlib only): core/executor.py
+imports it at module load, before the rest of the package finishes
+initializing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Dict, Optional
+
+from . import metrics as _m
+
+__all__ = [
+    "executor_step", "feed_nbytes",
+    "record_executor_step", "record_cache_event", "record_trainer_step",
+    "record_trainer_run", "record_spmd_step", "record_pipeline_trace",
+]
+
+EXEC_STEPS = _m.counter(
+    "paddle_tpu_executor_steps_total",
+    "Executor.run / run_chained invocations", labelnames=("mode",))
+EXEC_STEP_SECONDS = _m.histogram(
+    "paddle_tpu_executor_step_seconds",
+    "End-to-end Executor step wall time (lookup+dispatch+fetch)",
+    labelnames=("mode",))
+EXEC_FEED_BYTES = _m.counter(
+    "paddle_tpu_executor_feed_bytes_total",
+    "Bytes of feed tensors handed to the executor")
+EXEC_CACHE = _m.counter(
+    "paddle_tpu_executor_cache_total",
+    "Program-cache lookups from _lookup_step (event=hit|miss; a miss is "
+    "a jit trace+compile)", labelnames=("event",))
+EXEC_CACHE_ENTRIES = _m.gauge(
+    "paddle_tpu_executor_cache_entries",
+    "Live compiled-step entries across executors")
+
+TRAINER_STEPS = _m.counter(
+    "paddle_tpu_trainer_steps_total", "Trainer-loop steps")
+TRAINER_EXAMPLES = _m.counter(
+    "paddle_tpu_trainer_examples_total",
+    "Examples consumed by trainer loops (leading feed dim)")
+TRAINER_STEP_SECONDS = _m.histogram(
+    "paddle_tpu_trainer_step_seconds", "Trainer-loop per-step wall time")
+TRAINER_EXAMPLES_PER_SEC = _m.gauge(
+    "paddle_tpu_trainer_examples_per_sec",
+    "Throughput of the last trainer run (examples / wall seconds)")
+TRAINER_RUNS = _m.counter(
+    "paddle_tpu_trainer_runs_total",
+    "train_from_dataset / worker epochs completed")
+
+SPMD_STEPS = _m.counter(
+    "paddle_tpu_spmd_steps_total", "SPMDRunner steps",
+    labelnames=("axis",))
+SPMD_STEP_SECONDS = _m.histogram(
+    "paddle_tpu_spmd_step_seconds", "SPMDRunner per-step wall time",
+    labelnames=("axis",))
+SPMD_COLLECTIVES = _m.counter(
+    "paddle_tpu_spmd_collectives_total",
+    "Collective ops executed (static per-program count x steps)",
+    labelnames=("axis", "op"))
+
+PIPELINE_TRACES = _m.counter(
+    "paddle_tpu_pipeline_traces_total",
+    "pipeline_apply traces (jit retrace = new schedule/shape)",
+    labelnames=("axis",))
+PIPELINE_STAGES = _m.gauge(
+    "paddle_tpu_pipeline_stages", "Stages in the last traced pipeline",
+    labelnames=("axis",))
+PIPELINE_MICROBATCHES = _m.gauge(
+    "paddle_tpu_pipeline_microbatches",
+    "Microbatches in the last traced pipeline", labelnames=("axis",))
+PIPELINE_BUBBLE_FRACTION = _m.gauge(
+    "paddle_tpu_pipeline_bubble_fraction",
+    "GPipe bubble (S-1)/(n_micro+S-1) of the last traced pipeline",
+    labelnames=("axis",))
+
+
+def record_executor_step(mode: str, seconds: float, feed_bytes: int):
+    EXEC_STEPS.inc(mode=mode)
+    EXEC_STEP_SECONDS.observe(seconds, mode=mode)
+    if feed_bytes:
+        EXEC_FEED_BYTES.inc(feed_bytes)
+    _m.maybe_start_dump_thread()
+
+
+def feed_nbytes(feed: Dict) -> int:
+    return sum(int(getattr(v, "nbytes", 0)) for v in feed.values())
+
+
+class _StepRecord:
+    __slots__ = ("feed_bytes",)
+
+    def __init__(self):
+        self.feed_bytes = 0
+
+    def set_feed(self, feed: Dict):
+        self.feed_bytes = feed_nbytes(feed)
+
+
+@contextlib.contextmanager
+def executor_step(mode: str):
+    """One executor-step telemetry window (shared by Executor.run,
+    run_chained, and CompiledProgram._run so the timing boundary and byte
+    accounting cannot drift apart). Records only on clean exit — a step
+    that raises is not a completed step. Call `set_feed(norm_feed)` once
+    feeds are normalized."""
+    rec = _StepRecord()
+    t0 = time.perf_counter()
+    yield rec
+    record_executor_step(mode, time.perf_counter() - t0, rec.feed_bytes)
+
+
+def record_cache_event(hit: bool, entries: int):
+    EXEC_CACHE.inc(event="hit" if hit else "miss")
+    EXEC_CACHE_ENTRIES.set(entries)
+
+
+def record_trainer_step(seconds: float, examples: int):
+    TRAINER_STEPS.inc()
+    TRAINER_STEP_SECONDS.observe(seconds)
+    if examples:
+        TRAINER_EXAMPLES.inc(examples)
+
+
+def record_trainer_run(total_seconds: float, examples: int):
+    TRAINER_RUNS.inc()
+    if total_seconds > 0 and examples:
+        TRAINER_EXAMPLES_PER_SEC.set(examples / total_seconds)
+
+
+def record_spmd_step(axis: str, seconds: float,
+                     collectives: Optional[Dict[str, int]] = None):
+    SPMD_STEPS.inc(axis=axis)
+    SPMD_STEP_SECONDS.observe(seconds, axis=axis)
+    for op, n in (collectives or {}).items():
+        SPMD_COLLECTIVES.inc(n, axis=axis, op=op)
+    _m.maybe_start_dump_thread()
+
+
+def record_pipeline_trace(axis: str, stages: int, n_micro: int):
+    PIPELINE_TRACES.inc(axis=axis)
+    PIPELINE_STAGES.set(stages, axis=axis)
+    PIPELINE_MICROBATCHES.set(n_micro, axis=axis)
+    PIPELINE_BUBBLE_FRACTION.set(
+        (stages - 1) / max(1, n_micro + stages - 1), axis=axis)

@@ -1,0 +1,173 @@
+"""Unified span store + chrome-trace export.
+
+One process-wide span list replaces the profiler's ad-hoc `_host_spans`:
+`profiler.RecordEvent` host spans (cat="host"), executor/trainer/SPMD step
+telemetry (cat="step"), and any other subsystem annotation all land here,
+and `export_trace` merges them with the jax.profiler device timeline
+(the `*.trace.json.gz` chrome traces jax writes under
+`plugins/profile/<run>/`) into ONE chrome://tracing / perfetto-loadable
+JSON file — the role of the reference's profiler.proto + tools/timeline.py
+converter.
+
+Timestamps: span ts/dur are time.perf_counter() seconds (matching what
+RecordEvent always recorded); exported values are microseconds. Device
+events keep their own profiler epoch — perfetto renders them as separate
+tracks, which is how the reference timeline showed host vs. CUPTI streams
+too.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import glob
+import gzip
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+
+__all__ = ["Span", "span", "record_span", "get_spans", "clear_spans",
+           "dropped_spans", "save_spans", "export_trace",
+           "merge_chrome_traces"]
+
+# Bound host memory: a week-long trainer recording a span per step must
+# not OOM the host. The store is a ring — the OLDEST spans are evicted
+# (and counted in dropped_spans()), so profiling a late window of a long
+# run still exports that window rather than stale day-one spans.
+MAX_SPANS = 200_000
+
+
+class Span(NamedTuple):
+    name: str
+    ts: float            # perf_counter seconds
+    dur: float           # seconds
+    cat: str             # "host" | "step" | subsystem-chosen
+    tid: int             # recording thread ident
+    args: Optional[Dict[str, Any]]
+
+
+_lock = threading.Lock()
+_spans: "collections.deque[Span]" = collections.deque()
+_dropped = 0
+
+
+def record_span(name: str, ts: float, dur: float, cat: str = "host",
+                args: Optional[Dict[str, Any]] = None):
+    global _dropped
+    sp = Span(name, ts, dur, cat, threading.get_ident(), args)
+    with _lock:
+        _spans.append(sp)
+        while len(_spans) > MAX_SPANS:
+            _spans.popleft()
+            _dropped += 1
+
+
+@contextlib.contextmanager
+def span(name: str, cat: str = "host", **args):
+    """Context-manager span recorded into the unified store."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_span(name, t0, time.perf_counter() - t0, cat,
+                    args or None)
+
+
+def get_spans(cat: Optional[str] = None) -> List[Span]:
+    with _lock:
+        out = list(_spans)
+    if cat is not None:
+        out = [s for s in out if s.cat == cat]
+    return out
+
+
+def dropped_spans() -> int:
+    with _lock:
+        return _dropped
+
+
+def clear_spans():
+    global _dropped
+    with _lock:
+        _spans.clear()
+        _dropped = 0
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export
+# ---------------------------------------------------------------------------
+
+# Host/step spans get stable synthetic pids so the tracks group cleanly in
+# the viewer; device traces keep their own pids (offset on collision is
+# unnecessary — jax pids are real OS pids, far from these).
+_PID_BY_CAT = {"host": 1, "step": 2}
+
+
+def spans_to_chrome_events(spans: Sequence[Span]) -> List[dict]:
+    events = []
+    tids: Dict[int, int] = {}
+    for s in spans:
+        tid = tids.setdefault(s.tid, len(tids))
+        ev = {"name": s.name, "ph": "X",
+              "pid": _PID_BY_CAT.get(s.cat, 3), "tid": tid,
+              "ts": s.ts * 1e6, "dur": s.dur * 1e6, "cat": s.cat}
+        if s.args:
+            ev["args"] = {k: v for k, v in s.args.items()}
+        events.append(ev)
+    return events
+
+
+def _load_chrome_trace(path: str) -> List[dict]:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    events = data.get("traceEvents", []) if isinstance(data, dict) else data
+    for e in events:
+        e.setdefault("cat", "device")
+    return events
+
+
+def find_device_traces(trace_dir: str) -> List[str]:
+    """The jax profiler writes plugins/profile/<run>/<host>.trace.json.gz;
+    accept plain .trace.json too."""
+    hits = []
+    for pat in ("**/*.trace.json.gz", "**/*.trace.json"):
+        hits.extend(glob.glob(os.path.join(trace_dir, pat),
+                              recursive=True))
+    return sorted(set(hits))
+
+
+def merge_chrome_traces(event_lists: Sequence[Sequence[dict]]) -> dict:
+    merged: List[dict] = []
+    for evs in event_lists:
+        merged.extend(evs)
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
+
+
+def export_trace(path: str, trace_dir: Optional[str] = None,
+                 spans: Optional[Sequence[Span]] = None) -> str:
+    """Write ONE chrome trace: the unified span store (host + step +
+    whatever else was recorded) plus every jax device trace found under
+    `trace_dir`. Returns `path`."""
+    lists = [spans_to_chrome_events(
+        spans if spans is not None else get_spans())]
+    if trace_dir and os.path.isdir(trace_dir):
+        for p in find_device_traces(trace_dir):
+            try:
+                lists.append(_load_chrome_trace(p))
+            except (OSError, ValueError):
+                continue  # truncated trace from a killed run: skip, keep ours
+    trace = merge_chrome_traces(lists)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
+
+
+def save_spans(path: str) -> str:
+    """Persist raw spans as JSON (spans.json in a run dir) so
+    tools/obsdump.py can rebuild a trace offline."""
+    with open(path, "w") as f:
+        json.dump([s._asdict() for s in get_spans()], f)
+    return path

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..observability import telemetry as _telemetry
+
 
 def pipeline_apply(
     stage_fn: Callable,          # (stage_params, x) -> y, stage-local
@@ -43,6 +45,10 @@ def pipeline_apply(
     """
     S = mesh.shape[axis]
     n_micro = x.shape[0]
+    # Recorded at trace time (this runs under jit): schedule shape +
+    # bubble, one PIPELINE_TRACES tick per retrace — a retrace in steady
+    # state is itself a signal worth alerting on.
+    _telemetry.record_pipeline_trace(axis, int(S), int(n_micro))
     if S == 1:
         def body1(carry, xm):
             return carry, stage_fn(

@@ -15,6 +15,7 @@ redundant)."""
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -22,6 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability import telemetry as _telemetry
+from ..observability import tracing as _tracing
 from ..core import framework, lowering
 from ..core.executor import RNG_STATE_VAR, Scope, _as_fetch_name, global_scope
 from ..core.framework import Program
@@ -45,6 +48,9 @@ class SPMDRunner:
 
     def run(self, executor, feed=None, fetch_list=None, scope: Optional[Scope] = None,
             return_numpy: bool = True):
+        # timer covers feed normalization + cache lookup + dispatch,
+        # matching Executor.run's span
+        t0 = time.perf_counter()
         program = self.program
         scope = scope if scope is not None else global_scope()
         feed = dict(feed or {})
@@ -73,13 +79,16 @@ class SPMDRunner:
             self._cache[key] = step
 
         rng = executor._get_rng(scope, program)
-        fetches, new_states, new_rng = step(scope, norm_feed, rng)
+        with _tracing.span("spmd.step", cat="step", axis=self.axis):
+            fetches, new_states, new_rng = step(scope, norm_feed, rng)
         for n, v in new_states.items():
             scope.set_var(n, v)
         scope.set_var(RNG_STATE_VAR, new_rng)
-        if return_numpy:
-            return [np.asarray(f) for f in fetches]
-        return list(fetches)
+        out = [np.asarray(f) for f in fetches] if return_numpy \
+            else list(fetches)
+        _telemetry.record_spmd_step(self.axis, time.perf_counter() - t0,
+                                    step.collective_counts)
+        return out
 
     def _build(self, feed_names: Tuple[str, ...], fetch_names: Tuple[str, ...]):
         desc = self.program.desc
@@ -172,4 +181,12 @@ class SPMDRunner:
                         f"{n_dev} devices on axis '{axis}'")
             return jitted(feed, const_states, mut_states, rng)
 
+        # static per-program collective census: the c_* ops the transpiler
+        # inserted, charged to the registry once per executed step
+        counts: Dict[str, int] = {}
+        for b in desc.blocks:
+            for op in b.ops:
+                if op.type.startswith("c_"):
+                    counts[op.type] = counts.get(op.type, 0) + 1
+        step.collective_counts = counts
         return step

@@ -3,7 +3,11 @@
 
 TPU-native: jax.profiler captures both host and device timelines into
 XPlane/perfetto traces — the role of profiler.proto + tools/timeline.py.
-`RecordEvent`-style op annotation maps to jax.profiler.TraceAnnotation."""
+`RecordEvent`-style op annotation maps to jax.profiler.TraceAnnotation;
+the host-side span record lands in the unified observability span store
+(observability/tracing.py), so `export_chrome_tracing` emits ONE trace
+holding RecordEvent host spans, executor/trainer step-telemetry spans,
+and the jax device timeline."""
 
 from __future__ import annotations
 
@@ -15,8 +19,11 @@ from typing import Optional
 
 import jax
 
+from .observability import tracing as _tracing
+
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
-           "RecordEvent", "cuda_profiler", "npu_profiler"]
+           "RecordEvent", "cuda_profiler", "npu_profiler",
+           "export_chrome_tracing"]
 
 _trace_dir: Optional[str] = None
 _host_events = defaultdict(list)
@@ -36,6 +43,11 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
 
 def start_profiler(state="All", profile_path="/tmp/profile", tracer_option=None):
     global _trace_dir, _active
+    if _active:
+        raise RuntimeError(
+            "start_profiler called while a trace is already active; call "
+            "stop_profiler() first (nested/overlapping jax traces are not "
+            "supported)")
     _trace_dir = profile_path if os.path.isdir(profile_path) or not \
         os.path.splitext(profile_path)[1] else os.path.dirname(profile_path)
     os.makedirs(_trace_dir or ".", exist_ok=True)
@@ -44,6 +56,8 @@ def start_profiler(state="All", profile_path="/tmp/profile", tracer_option=None)
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    """Safe no-op when no trace was started — a teardown path may call it
+    unconditionally."""
     global _active
     if _active:
         jax.profiler.stop_trace()
@@ -52,11 +66,19 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
 
 
 def reset_profiler():
+    """Clear ALL host-side profiler state: the aggregate event table, the
+    unified span store, and the remembered trace dir (so one test's trace
+    path cannot leak into the next export)."""
+    global _trace_dir
     _host_events.clear()
-    _host_spans.clear()
+    _tracing.clear_spans()
+    _trace_dir = None
 
 
-_host_spans = []
+def trace_dir() -> Optional[str]:
+    """Directory the current/last jax trace wrote into (None after
+    reset)."""
+    return _trace_dir
 
 
 def _print_host_events(sorted_key=None):
@@ -77,7 +99,8 @@ def _print_host_events(sorted_key=None):
 
 class RecordEvent:
     """reference: platform/profiler.h:81 RecordEvent RAII — host-side named
-    span + device TraceAnnotation."""
+    span + device TraceAnnotation. The host span is recorded with
+    cat="host" in the unified store."""
 
     def __init__(self, name: str):
         self.name = name
@@ -93,7 +116,7 @@ class RecordEvent:
         self._ann.__exit__(*a)
         dur = time.perf_counter() - self._t0
         _host_events[self.name].append(dur)
-        _host_spans.append((self.name, self._t0, dur))
+        _tracing.record_span(self.name, self._t0, dur, cat="host")
         return False
 
 
@@ -108,17 +131,15 @@ npu_profiler = cuda_profiler
 
 
 def export_chrome_tracing(path, events=None):
-    """Write the host RecordEvent table as a chrome://tracing JSON file
-    (reference: tools/timeline.py:131 converts profiler.proto to chrome
-    trace; device timelines come from jax.profiler's perfetto output)."""
-    import json
+    """Write ONE chrome://tracing JSON file (reference: tools/timeline.py:131
+    converted profiler.proto to chrome trace): the unified span store
+    (RecordEvent host spans, cat="host"; step telemetry, cat="step") plus
+    the jax.profiler device timeline when a trace dir is known.
 
-    evs = events if events is not None else list(_host_spans)
-    trace = {"traceEvents": [], "displayTimeUnit": "ms"}
-    for name, start, dur in evs:
-        trace["traceEvents"].append({
-            "name": name, "ph": "X", "pid": 0, "tid": 0,
-            "ts": start * 1e6, "dur": dur * 1e6, "cat": "host"})
-    with open(path, "w") as f:
-        json.dump(trace, f)
-    return path
+    `events`, if given, is the legacy list of (name, start_s, dur_s)
+    tuples and is exported verbatim instead of the span store."""
+    spans = None
+    if events is not None:
+        spans = [_tracing.Span(name, start, dur, "host", 0, None)
+                 for name, start, dur in events]
+    return _tracing.export_trace(path, trace_dir=_trace_dir, spans=spans)

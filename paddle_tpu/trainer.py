@@ -9,7 +9,23 @@ datafeed library (paddle_tpu/data/) supplies the pipelined batch source.
 
 from __future__ import annotations
 
+import time
 from typing import Optional, Sequence
+
+from .observability import telemetry as _telemetry
+
+
+def _batch_examples(feed) -> int:
+    """Leading dim of the first feed tensor — the examples-per-step count
+    every throughput metric is denominated in."""
+    try:
+        for v in feed.values():
+            shape = getattr(v, "shape", None)
+            if shape:
+                return int(shape[0])
+    except (AttributeError, TypeError):
+        pass
+    return 0
 
 
 def train_from_dataset(executor, program=None, dataset=None, scope=None,
@@ -22,16 +38,23 @@ def train_from_dataset(executor, program=None, dataset=None, scope=None,
         raise ValueError("dataset is required")
     fetch_list = fetch_list or []
     step = 0
+    examples = 0
+    run_t0 = time.perf_counter()
     batches = dataset._iter_batches() if hasattr(dataset, "_iter_batches") \
         else iter(dataset)
     for feed in batches:
+        t0 = time.perf_counter()
         vals = executor.run(program, feed=feed, fetch_list=fetch_list,
                             scope=scope)
+        n = _batch_examples(feed)
+        examples += n
+        _telemetry.record_trainer_step(time.perf_counter() - t0, n)
         if debug and fetch_list and step % print_period == 0:
             names = fetch_info or [getattr(f, "name", str(f)) for f in fetch_list]
             print(f"step {step}: " + ", ".join(
                 f"{n}={v}" for n, v in zip(names, vals)))
         step += 1
+    _telemetry.record_trainer_run(time.perf_counter() - run_t0, examples)
     return None
 
 
@@ -84,13 +107,19 @@ class HogwildWorker:
     def train(self):
         import contextlib
 
+        run_t0 = time.perf_counter()
+        examples = 0
         for feed in self.dataset._iter_batches() if hasattr(
                 self.dataset, "_iter_batches") else iter(self.dataset):
+            t0 = time.perf_counter()
             with self.step_lock if self.step_lock is not None else \
                     contextlib.nullcontext():
                 vals = self.executor.run(self.program, feed=feed,
                                          fetch_list=self.desc.fetch_list,
                                          scope=self.scope)
+            n = _batch_examples(feed)
+            examples += n
+            _telemetry.record_trainer_step(time.perf_counter() - t0, n)
             self.steps += 1
             if self.desc.fetch_list:
                 self.last_fetch = vals
@@ -101,6 +130,8 @@ class HogwildWorker:
                     print(f"worker {self.worker_id} step {self.steps}: " +
                           ", ".join(f"{n}={v}" for n, v in
                                     zip(names, vals)))
+        _telemetry.record_trainer_run(time.perf_counter() - run_t0,
+                                      examples)
 
 
 class MultiTrainer:

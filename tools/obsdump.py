@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""obsdump — pretty-print observability dumps and rebuild chrome traces.
+
+Offline companion to paddle_tpu/observability/: the `snapshot` and
+`trace` subcommands work on files alone and load ONLY
+observability/metrics.py + tracing.py (stdlib-only modules, imported by
+file path) — no framework or jax import, so they run in milliseconds on
+a CI host or a laptop holding a copied run dir. `snapshot --live`
+imports the framework and reads the in-process registry instead.
+
+Usage:
+  obsdump.py snapshot METRICS.json          # aligned table of every metric
+  obsdump.py snapshot METRICS.json --prom   # Prometheus text exposition
+  obsdump.py snapshot --live [--prom]       # current process registry
+  obsdump.py trace RUN_DIR -o out.json      # merge spans.json + jax
+                                            # *.trace.json(.gz) under
+                                            # RUN_DIR into ONE chrome trace
+
+The metrics JSON is what the registry's env-gated dumper
+(PADDLE_TPU_METRICS_DIR) writes; RUN_DIR is typically the profiler's
+profile_path (jax device traces) optionally holding a spans.json from
+observability.save_spans().
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_OBS_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "paddle_tpu", "observability")
+
+
+def _load_obs_module(name: str):
+    """Import observability/<name>.py by file path, bypassing the
+    paddle_tpu package __init__ (which drags in jax). metrics.py and
+    tracing.py are stdlib-only by contract (their module docstrings)."""
+    spec = importlib.util.spec_from_file_location(
+        f"_obsdump_{name}", os.path.join(_OBS_DIR, f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fmt_value(v):
+    if isinstance(v, float) and v != int(v):
+        return f"{v:.6g}"
+    return str(int(v)) if isinstance(v, float) else str(v)
+
+
+def _fmt_labels(labels):
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) \
+        + "}"
+
+
+def print_snapshot(snap, out=sys.stdout):
+    """Aligned table: name{labels}  value   (histograms: count/sum/avg)."""
+    rows = []
+    for name in sorted(snap):
+        m = snap[name]
+        for s in m["series"]:
+            label = name + _fmt_labels(s.get("labels", {}))
+            if m["type"] == "histogram":
+                cnt, tot = s["count"], s["sum"]
+                avg = tot / cnt if cnt else 0.0
+                val = (f"count={cnt} sum={tot:.6g} avg={avg:.6g}")
+            else:
+                val = _fmt_value(s["value"])
+            rows.append((label, m["type"], val))
+        if not m["series"]:
+            rows.append((name, m["type"], "(no samples)"))
+    width = max((len(r[0]) for r in rows), default=0)
+    for label, kind, val in rows:
+        print(f"{label:{width}s}  {kind:9s}  {val}", file=out)
+
+
+def cmd_snapshot(args) -> int:
+    if args.live:
+        import paddle_tpu  # noqa: F401 — registers all telemetry metrics
+
+        from paddle_tpu import observability
+        snap = observability.snapshot()
+    else:
+        if not args.path:
+            print("snapshot: need a metrics.json path or --live",
+                  file=sys.stderr)
+            return 2
+        with open(args.path) as f:
+            snap = json.load(f)
+    if args.prom:
+        sys.stdout.write(
+            _load_obs_module("metrics").render_prometheus_snapshot(snap))
+    else:
+        print_snapshot(snap)
+    return 0
+
+
+def cmd_trace(args) -> int:
+    if not os.path.isdir(args.run_dir):
+        print(f"trace: not a directory: {args.run_dir}", file=sys.stderr)
+        return 2
+    tracing = _load_obs_module("tracing")
+    lists = []
+    spans_json = os.path.join(args.run_dir, "spans.json")
+    if os.path.exists(spans_json):
+        with open(spans_json) as f:
+            spans = [tracing.Span(**s) for s in json.load(f)]
+        lists.append(tracing.spans_to_chrome_events(spans))
+    for p in tracing.find_device_traces(args.run_dir):
+        try:
+            lists.append(tracing._load_chrome_trace(p))
+        except (OSError, ValueError) as e:
+            print(f"trace: skipping unreadable {p}: {e}", file=sys.stderr)
+    if not lists:
+        print(f"trace: nothing to merge under {args.run_dir} (no "
+              f"spans.json or *.trace.json[.gz])", file=sys.stderr)
+        return 1
+    trace = tracing.merge_chrome_traces(lists)
+    with open(args.output, "w") as f:
+        json.dump(trace, f)
+    print(f"wrote {args.output}: {len(trace['traceEvents'])} events from "
+          f"{len(lists)} source(s)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="obsdump", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("snapshot", help="pretty-print a metrics snapshot")
+    sp.add_argument("path", nargs="?", help="metrics.json from "
+                    "PADDLE_TPU_METRICS_DIR (omit with --live)")
+    sp.add_argument("--live", action="store_true",
+                    help="read this process's registry instead of a file")
+    sp.add_argument("--prom", action="store_true",
+                    help="emit Prometheus text exposition")
+    sp.set_defaults(fn=cmd_snapshot)
+
+    tp = sub.add_parser("trace", help="merge a run dir into one chrome "
+                        "trace")
+    tp.add_argument("run_dir")
+    tp.add_argument("-o", "--output", default="trace.json")
+    tp.set_defaults(fn=cmd_trace)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
