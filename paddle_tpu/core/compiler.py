@@ -28,7 +28,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..observability import telemetry as _telemetry
 from ..observability import tracing as _tracing
 from . import framework, lowering
-from .executor import RNG_STATE_VAR, Scope, _as_fetch_name, global_scope
+from .executor import (RNG_STATE_VAR, Scope, _as_fetch_name, _JitDispatch,
+                       _post_step_health, global_scope)
 from .framework import Program
 from .ir import normalize_dtype
 
@@ -188,6 +189,7 @@ class CompiledProgram:
                                fetches=len(fetch_names)):
                 fetches, new_rng = step(scope, norm_feed, rng)
             scope.set_var(RNG_STATE_VAR, new_rng)
+            _post_step_health(step.writes, fetch_names, fetches, scope)
             return [np.asarray(f) for f in fetches] if return_numpy \
                 else list(fetches)
 
@@ -236,7 +238,7 @@ class _ShardedStep:
                 new_rng = jax.random.key_data(new_rng)
             return fetches, new_states, new_rng
 
-        self.fn = jax.jit(
+        self.fn = _JitDispatch(jax.jit(
             step,
             in_shardings=({n: batch for n in feed_names},
                           {n: repl for n in self.const_reads},
@@ -248,7 +250,8 @@ class _ShardedStep:
                            {n: repl for n in self.writes},
                            repl),
             donate_argnums=(2,),
-        )
+        ), "sharded", meta={"devices": int(mesh.size),
+                            "fetches": len(fetch_names)})
 
     def __call__(self, scope: Scope, feed, rng):
         def _state(n):

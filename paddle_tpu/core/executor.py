@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import time
 import weakref
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -20,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import health as _health
 from ..observability import telemetry as _telemetry
 from ..observability import tracing as _tracing
 from . import framework, lowering
@@ -28,6 +30,183 @@ from .ir import normalize_dtype
 from .places import CPUPlace, Place, default_place
 
 RNG_STATE_VAR = "__rng_state__"
+
+
+# ---------------------------------------------------------------------------
+# Compile introspection
+# ---------------------------------------------------------------------------
+
+
+def _compile_cost(compiled) -> Tuple[Optional[float], Optional[int]]:
+    """(flops, output bytes) from an AOT executable's cost/memory
+    analysis; either is None when the backend doesn't report it."""
+    flops = out_bytes = None
+    try:
+        ca = compiled.cost_analysis()
+        d = ca[0] if isinstance(ca, (list, tuple)) and ca else ca
+        if isinstance(d, dict) and d.get("flops", -1) >= 0:
+            flops = float(d["flops"])
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            out_bytes = int(getattr(ma, "output_size_in_bytes", 0))
+    except Exception:
+        pass
+    return flops, out_bytes
+
+
+class _JitDispatch:
+    """A jitted callable that AOT-compiles on first dispatch so the
+    compile itself is observable: wall seconds land in
+    `paddle_tpu_compile_seconds{kind}`, the executable's cost_analysis()
+    FLOPs in `paddle_tpu_compile_flops{kind}`, and a `compile` event in
+    the JSONL log. Falls back to the plain jit path — which compiles
+    transparently — if AOT lowering fails or a later call's avals drift
+    from the compiled signature (jax raises TypeError before executing,
+    so donated buffers are untouched)."""
+
+    def __init__(self, jit_fn, kind: str, meta: Optional[Dict] = None):
+        self._jit = jit_fn
+        self._kind = kind
+        self._meta = meta
+        self._aot = None
+        self._tried = False
+        self._compile_lock = threading.Lock()
+        self._recorded_jit_compiles = 0
+
+    def lower(self, *args, **kw):
+        return self._jit.lower(*args, **kw)
+
+    def _cache_size(self) -> int:
+        """Executables compiled for this callable (AOT + any jit-cache
+        fallbacks) — keeps the no-recompile assertions
+        (test_step2_recompiles_nothing) meaningful across the AOT path."""
+        return (1 if self._aot is not None else 0) + \
+            self._jit._cache_size()
+
+    def __getattr__(self, name):
+        # only reached for attrs not on the wrapper; avoid recursing if
+        # _jit itself is missing (e.g. mid-unpickle)
+        return getattr(object.__getattribute__(self, "_jit"), name)
+
+    def __call__(self, *args):
+        if not self._tried:
+            # double-checked: concurrent first dispatches (HogwildWorker
+            # threads on a shared executor) must compile ONCE, with the
+            # second thread waiting rather than jit-compiling a duplicate
+            with self._compile_lock:
+                if not self._tried:
+                    t0 = time.perf_counter()
+                    try:
+                        self._aot = self._jit.lower(*args).compile()
+                    except Exception:
+                        self._aot = None  # jit path compiles below
+                    else:
+                        seconds = time.perf_counter() - t0
+                        flops, out_bytes = _compile_cost(self._aot)
+                        _telemetry.record_compile(self._kind, seconds,
+                                                  flops=flops,
+                                                  out_bytes=out_bytes,
+                                                  meta=self._meta)
+                    self._tried = True
+        if self._aot is not None:
+            try:
+                return self._aot(*args)
+            except (TypeError, ValueError):
+                # signature drift, raised before execution: TypeError for
+                # aval/dtype mismatch, ValueError for input sharding or
+                # committed-device mismatch (jax 0.4.x). Plain jit
+                # recompiles transparently for both, so fall back for good
+                self._aot = None
+        # jit path: compiles transparently inside the call, so detect a
+        # fresh executable via the cache-size growth and time the call —
+        # compile-dominated when a compile happened. Keeps
+        # paddle_tpu_compiles_total honest after AOT failure/fallback
+        # (the recompile-storm signal must not go dark). The high-water
+        # mark makes concurrent dispatchers that blocked on the SAME
+        # compile record it once, not once per waiting thread.
+        t0 = time.perf_counter()
+        out = self._jit(*args)
+        after = self._jit._cache_size()
+        if after > self._recorded_jit_compiles:
+            with self._compile_lock:
+                if after > self._recorded_jit_compiles:
+                    self._recorded_jit_compiles = after
+                    _telemetry.record_compile(
+                        self._kind, time.perf_counter() - t0,
+                        meta=dict(self._meta or {}, jit_fallback=True))
+        return out
+
+
+def _health_scan(site: str, named_values, level: int):
+    """Device-side prefilter in front of health.check_numerics: reduce
+    isfinite (and the optional |x| threshold) ON DEVICE so the per-step
+    cost is one scalar transfer per float var — only arrays that are
+    actually suspect get downloaded to host for nan/inf classification.
+    (The pre-health FLAGS_check_nan_inf code had the same shape; the
+    health layer keeps the counting/event/raise semantics.)"""
+    suspects = []
+    thresh = _health.max_abs()
+    for n, v in named_values:
+        if v is None:
+            continue
+        try:
+            arr = jnp.asarray(v)
+        except (TypeError, ValueError):
+            continue
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            continue
+        bad = not bool(jnp.isfinite(arr).all())
+        if not bad and thresh is not None and arr.size:
+            bad = bool(jnp.abs(arr).max() > thresh)
+        if bad:
+            suspects.append((n, v))
+    # always called (even with no suspects) so the sweep counter ticks
+    _health.check_numerics(site, suspects, level=level)
+
+
+def _post_step_health(writes, fetch_names, fetches, scope):
+    """Shared post-step epilogue for Executor.run / run_chained /
+    CompiledProgram._run: resolve the check level (legacy
+    FLAGS_check_nan_inf forces raise semantics), scan written states +
+    fetches, and sample the device-memory gauge. One definition so the
+    level semantics and scan sites cannot drift between run paths."""
+    from .flags import get_flag
+
+    level = 2 if get_flag("FLAGS_check_nan_inf") \
+        else _health.check_level()
+    if level:
+        _health_scan("executor_state",
+                     ((n, scope.find_var(n)) for n in writes), level)
+        _health_scan("executor_fetch", zip(fetch_names, fetches), level)
+    if _health.introspection_enabled():
+        _record_live_device_memory()
+
+
+_MEM_SWEEP_MIN_INTERVAL_S = 5.0
+_last_mem_sweep = [0.0]  # monotonic seconds of the last live_arrays walk
+
+
+def _record_live_device_memory():
+    """Gauge live device-buffer bytes via jax.live_arrays(). Only called
+    when observability is enabled (health.introspection_enabled), and
+    rate-limited: the sweep walks every live jax.Array, which on a big
+    model costs more per step than any scraper can use — gauges are
+    sampled on seconds-scale intervals anyway."""
+    now = time.monotonic()
+    if now - _last_mem_sweep[0] < _MEM_SWEEP_MIN_INTERVAL_S:
+        return
+    _last_mem_sweep[0] = now
+    try:
+        nbytes = nbufs = 0
+        for a in jax.live_arrays():
+            nbytes += int(getattr(a, "nbytes", 0))
+            nbufs += 1
+    except Exception:
+        return
+    _telemetry.record_device_memory(nbytes, nbufs)
 
 
 class Scope:
@@ -148,7 +327,9 @@ class _CompiledStep:
         # mut_states (param updates) are donated: in-place on device, the
         # reference's overwrite-in-scope semantics without a copy.
         self._step = step
-        self.fn = jax.jit(step, donate_argnums=(2,))
+        self.fn = _JitDispatch(
+            jax.jit(step, donate_argnums=(2,)), "step",
+            meta={"fetches": len(fetch_names), "writes": len(writes)})
         self._chained: Dict[int, Any] = {}
 
     def chained_fn(self, n_steps: int, per_step_feeds: bool = False):
@@ -209,7 +390,10 @@ class _CompiledStep:
             new_states.update(rest_f)
             return stacked, new_states, rng_f
 
-        fn = jax.jit(chained, donate_argnums=(2,))
+        fn = _JitDispatch(
+            jax.jit(chained, donate_argnums=(2,)), "chained",
+            meta={"n_steps": int(n_steps),
+                  "per_step_feeds": bool(per_step_feeds)})
         self._chained[(n_steps, per_step_feeds)] = fn
         return fn
 
@@ -331,26 +515,13 @@ class Executor:
                     fetches, new_rng = step(scope, norm_feed, rng)
             scope.set_var(RNG_STATE_VAR, new_rng)
 
-            from .flags import get_flag
-
-            if get_flag("FLAGS_check_nan_inf"):
-                # reference: FLAGS_check_nan_inf (flags.cc:44) — per-op NaN
-                # scan; here the post-step scan covers every written state
-                # + fetch
-                for n in step.writes:
-                    v = scope.find_var(n)
-                    if v is not None and jnp.issubdtype(
-                            jnp.asarray(v).dtype, jnp.floating):
-                        if not bool(jnp.isfinite(v).all()):
-                            raise RuntimeError(
-                                f"FLAGS_check_nan_inf: variable '{n}' "
-                                f"contains NaN/Inf after this step")
-                for name, f in zip(fetch_names, fetches):
-                    if jnp.issubdtype(jnp.asarray(f).dtype, jnp.floating) \
-                            and not bool(jnp.isfinite(f).all()):
-                        raise RuntimeError(
-                            f"FLAGS_check_nan_inf: fetch '{name}' contains "
-                            f"NaN/Inf")
+            # reference: FLAGS_check_nan_inf (flags.cc:44). The legacy
+            # flag forces raise-level checking; PADDLE_TPU_CHECK_NUMERICS
+            # selects warn (1) or raise (2). Both route through the
+            # health layer so anomalies are counted, logged as events,
+            # and flip /healthz — the flag's raise semantics (and its
+            # post-step scan of every written state + fetch) are kept.
+            _post_step_health(step.writes, fetch_names, fetches, scope)
 
             return [np.asarray(f) for f in fetches] if return_numpy \
                 else list(fetches)
@@ -436,6 +607,7 @@ class Executor:
                         scope, norm_feed, rng, int(n_steps),
                         per_step_feeds=bool(per_step_feeds))
             scope.set_var(RNG_STATE_VAR, new_rng)
+            _post_step_health(step.writes, fetch_names, fetches, scope)
             return [np.asarray(f) for f in fetches] if return_numpy \
                 else list(fetches)
 

@@ -6,6 +6,13 @@ from __future__ import annotations
 
 from typing import Optional, Sequence
 
+from .observability import metrics as _m
+
+DOT_NODES = _m.gauge(
+    "paddle_tpu_debugger_dot_nodes",
+    "Node count of the most recently rendered DOT graph",
+    labelnames=("kind",))
+
 
 def _esc(s: str) -> str:
     return s.replace('"', '\\"')
@@ -27,12 +34,14 @@ def block_to_dot(block, skip_vars: Sequence[str] = (),
         vars_seen.add(name)
         v = block.desc.vars.get(name)
         shape = list(v.shape) if v is not None and v.shape else "?"
-        style = 'style=filled, fillcolor="#e0e0ff"' \
-            if v is not None and v.is_parameter else ""
+        attrs = [f'label="{_esc(name)}\\n{shape}"', "shape=ellipse"]
         if name in hi:
-            style = 'style=filled, fillcolor="#ffd0d0"'
-        lines.append(f'  "v_{_esc(name)}" [label="{_esc(name)}\\n{shape}", '
-                     f'shape=ellipse, {style}];')
+            attrs.append('style=filled, fillcolor="#ffd0d0"')
+        elif v is not None and v.is_parameter:
+            attrs.append('style=filled, fillcolor="#e0e0ff"')
+        # a plain var adds no style attr — joining only what exists keeps
+        # the attr list valid DOT (no dangling comma before "];")
+        lines.append(f'  "v_{_esc(name)}" [{", ".join(attrs)}];')
 
     for i, op in enumerate(block.desc.ops):
         lines.append(f'  "op_{i}" [label="{_esc(op.type)}", shape=box, '
@@ -48,6 +57,8 @@ def block_to_dot(block, skip_vars: Sequence[str] = (),
                     var_node(n)
                     lines.append(f'  "op_{i}" -> "v_{_esc(n)}";')
     lines.append("}")
+    DOT_NODES.set(len(block.desc.ops), kind="op")
+    DOT_NODES.set(len(vars_seen), kind="var")
     return "\n".join(lines)
 
 

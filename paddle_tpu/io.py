@@ -52,6 +52,7 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
         vars = [v for v in main_program.list_vars() if predicate is None or predicate(v)]
     scope = global_scope()
     os.makedirs(dirname, exist_ok=True)
+    saved = 0
     if filename is None:
         for v in vars:
             val = scope.find_var(v.name)
@@ -59,6 +60,7 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
                 continue
             np.save(os.path.join(dirname, var_filename(v.name)),
                     np.asarray(val))
+            saved += 1
     else:
         data = {}
         for v in vars:
@@ -66,6 +68,11 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
             if val is not None:
                 data[v.name] = np.asarray(val)
         np.savez(os.path.join(dirname, filename), **data)
+        saved = len(data)
+    from .observability import events as _events
+
+    _events.emit("checkpoint", site="save_vars", dir=str(dirname),
+                 vars=saved)
 
 
 def save_params(executor, dirname, main_program=None, filename=None):
@@ -225,6 +232,10 @@ def save(program: Program, model_path: str):
     np.savez(model_path + ".pdparams", **data)
     with open(model_path + ".pdmodel", "wb") as f:
         f.write(program.to_bytes())
+    from .observability import events as _events
+
+    _events.emit("checkpoint", site="save", dir=str(model_path),
+                 vars=len(data))
 
 
 def load(program: Program, model_path: str, executor=None, var_list=None):

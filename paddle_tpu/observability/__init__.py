@@ -1,6 +1,7 @@
-"""Unified observability layer: metrics registry, step telemetry, traces.
+"""Unified observability layer: metrics, telemetry, traces, health, HTTP.
 
-Three pieces (see PROFILE.md §Observability for the user-facing guide):
+Six pieces (see PROFILE.md §Observability and §Health for the
+user-facing guide):
 
 - metrics.py   — process-wide registry (counters/gauges/histograms with
                  labels), JSON + Prometheus exposition, env-gated periodic
@@ -10,14 +11,27 @@ Three pieces (see PROFILE.md §Observability for the user-facing guide):
                  into a single chrome-trace export.
 - telemetry.py — the metric vocabulary + record helpers the executor,
                  trainer, and SPMD/pipeline stacks call on their hot
-                 paths.
+                 paths (step timing, cache events, compiles, device
+                 memory).
+- health.py    — env-gated NaN/Inf/out-of-range scanning at the
+                 framework's observation points
+                 (PADDLE_TPU_CHECK_NUMERICS=0|1|2) + /healthz state.
+- events.py    — append-only JSONL event log (compile / step_summary /
+                 anomaly / checkpoint) with a bounded in-memory ring
+                 (PADDLE_TPU_EVENT_LOG).
+- httpd.py     — stdlib daemon thread serving /metrics, /healthz and
+                 /events?n=K live (PADDLE_TPU_METRICS_PORT).
 
-`tools/obsdump.py` pretty-prints dumps and rebuilds traces offline.
+`tools/obsdump.py` pretty-prints dumps, tails event logs, and rebuilds
+traces offline.
 """
 
 from . import metrics
 from . import tracing
 from . import telemetry
+from . import events
+from . import health
+from . import httpd
 from .metrics import (  # noqa: F401
     Counter, Gauge, Histogram, MetricsRegistry, counter, default_registry,
     dump, gauge, histogram, maybe_start_dump_thread, render_prometheus,
@@ -27,13 +41,19 @@ from .tracing import (  # noqa: F401
     Span, clear_spans, export_trace, get_spans, record_span, save_spans,
     span,
 )
+from .health import NumericsError, check_numerics  # noqa: F401
+from .httpd import (  # noqa: F401
+    maybe_start_http_server, start_http_server, stop_http_server,
+)
 
 __all__ = [
-    "metrics", "tracing", "telemetry",
+    "metrics", "tracing", "telemetry", "events", "health", "httpd",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "counter",
     "default_registry", "dump", "gauge", "histogram",
     "maybe_start_dump_thread", "render_prometheus", "reset", "snapshot",
     "stop_dump_thread",
     "Span", "clear_spans", "export_trace", "get_spans", "record_span",
     "save_spans", "span",
+    "NumericsError", "check_numerics",
+    "maybe_start_http_server", "start_http_server", "stop_http_server",
 ]

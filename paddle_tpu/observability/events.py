@@ -1,0 +1,136 @@
+"""Append-only JSONL event log: the discrete-occurrence companion to the
+metrics registry.
+
+Metrics answer "how much/how fast"; this log answers "what happened and
+when": XLA compiles (a recompile storm is a sequence of `compile` events
+seconds apart), trainer run summaries, tensor-health anomalies, and
+checkpoint writes. Every event carries a process-monotonic `seq` and a
+wall-clock `ts`, so a tail of the file reconstructs the run's story even
+after the process died — the reason long TPU jobs keep such a log on
+disk rather than only in memory.
+
+Sinks:
+  - an in-process ring (`recent()`), always on and bounded — this is what
+    the /events HTTP route and tests read;
+  - a JSONL file, appended when `PADDLE_TPU_EVENT_LOG` names a path (or,
+    if unset, `PADDLE_TPU_METRICS_DIR` is set, in which case
+    `<dir>/events.jsonl` is used). One `json.dumps` line per event,
+    append-only: `tools/obsdump.py events` tails and pretty-prints it.
+
+Schema (stable, documented in PROFILE.md §Health):
+  {"seq": int, "ts": float unix seconds, "kind": str, ...kind fields}
+
+This module is stdlib-only by contract: tools/obsdump.py imports it by
+file path without pulling in the framework or jax.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+__all__ = ["emit", "recent", "clear", "log_path", "read_jsonl",
+           "MAX_EVENTS", "KINDS"]
+
+# Known event kinds (emitters may add more; these are the documented core).
+KINDS = ("compile", "step_summary", "anomaly", "checkpoint")
+
+# Ring bound: a week-long run emitting a compile+summary event per minute
+# stays far under this; anomaly storms get truncated to the latest window.
+MAX_EVENTS = 4096
+
+_lock = threading.Lock()
+_file_lock = threading.Lock()  # file appends serialize separately: a
+# slow disk must not block ring readers (/events) or other emitters'
+# seq assignment
+_ring: "collections.deque[Dict[str, Any]]" = collections.deque(
+    maxlen=MAX_EVENTS)
+_seq = 0
+
+
+def log_path() -> Optional[str]:
+    """Resolved JSONL sink path, or None when file logging is off.
+    Re-read from the env on every call so tests can monkeypatch."""
+    p = os.environ.get("PADDLE_TPU_EVENT_LOG")
+    if p:
+        return p
+    d = os.environ.get("PADDLE_TPU_METRICS_DIR")
+    if d:
+        return os.path.join(d, "events.jsonl")
+    return None
+
+
+def emit(kind: str, **fields) -> Dict[str, Any]:
+    """Record one event: ring always, file when a sink is configured.
+    Returns the event dict (with seq/ts filled in)."""
+    global _seq
+    with _lock:
+        _seq += 1
+        ev: Dict[str, Any] = {"seq": _seq, "ts": time.time(), "kind": kind}
+        ev.update(fields)
+        _ring.append(ev)
+    path = log_path()
+    if path:
+        # outside the ring lock: concurrent writers may land file lines
+        # out of seq order, but each line is whole and carries its seq
+        try:
+            line = json.dumps(ev, default=str) + "\n"
+            with _file_lock:
+                d = os.path.dirname(path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                with open(path, "a") as f:
+                    f.write(line)
+        except OSError:
+            pass  # a full/vanished disk must not kill the trainer
+    return ev
+
+
+def _tail(evs: List[Dict[str, Any]], n: Optional[int]):
+    if n is None:
+        return evs
+    n = int(n)
+    return evs[-n:] if n > 0 else []  # [-0:] would mean "everything"
+
+
+def recent(n: int = 100, kind: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Last `n` events (oldest first), optionally filtered by kind."""
+    with _lock:
+        evs = list(_ring)
+    if kind is not None:
+        evs = [e for e in evs if e.get("kind") == kind]
+    return _tail(evs, n)
+
+
+def clear():
+    """Drop the in-memory ring (test hygiene; the file is append-only and
+    never truncated here)."""
+    with _lock:
+        _ring.clear()
+
+
+def read_jsonl(path: str, n: Optional[int] = None,
+               kind: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Parse a JSONL event file: last `n` events, optionally filtered by
+    kind. Malformed lines are skipped (a crash mid-append can truncate
+    the final line). tools/obsdump.py's `events` subcommand carries its
+    own single-file-handle variant of this logic so its --follow mode
+    has no gap between the initial tail and the stream."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if kind is not None and ev.get("kind") != kind:
+                continue
+            out.append(ev)
+    return _tail(out, n)

@@ -1,0 +1,166 @@
+"""Live metrics endpoint: a stdlib http.server daemon thread serving the
+process's observability state while it trains.
+
+The PR 1 registry is scrapeable only via file dumps
+(PADDLE_TPU_METRICS_DIR); a production deployment wants a live pull
+target. Routes:
+
+  GET /metrics      Prometheus text exposition of the default registry
+  GET /healthz      JSON from health.status(); HTTP 200 while "ok",
+                    503 once "degraded" (anomaly-aware, so a k8s
+                    liveness/readiness probe sees divergence directly)
+  GET /events?n=K[&kind=X]
+                    last K events from the in-memory ring, one JSON
+                    object per line (newline-delimited JSON)
+
+Env gating: PADDLE_TPU_METRICS_PORT. Unset/empty → no server, no
+socket. "0" → bind an ephemeral port (tests); any other integer → that
+port. `maybe_start_http_server()` is called from the telemetry hot-path
+helpers, so setting the env var before training is enough — nothing is
+started at import time (guarded by tests/test_obs_import_cost.py).
+
+Stdlib-only module; binds 127.0.0.1 by default (override with
+PADDLE_TPU_METRICS_HOST) — exposing process internals on all interfaces
+is an operator decision, not a default.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from . import events as _events
+from . import health as _health
+from . import metrics as _m
+
+__all__ = ["start_http_server", "maybe_start_http_server",
+           "stop_http_server", "server_port"]
+
+_lock = threading.Lock()
+_server: Optional[ThreadingHTTPServer] = None
+_thread: Optional[threading.Thread] = None
+_atexit_registered = False
+_start_failed = False  # remember a failed env-gated bind: the hot path
+# calls maybe_start every step and must not retry the syscall forever
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-metrics"
+
+    def log_message(self, format, *args):  # noqa: A002 - stdlib signature
+        pass  # scrapes every few seconds must not spam stderr
+
+    def _reply(self, code: int, content_type: str, body: str):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 - stdlib naming
+        try:
+            url = urlparse(self.path)
+            if url.path == "/metrics":
+                self._reply(200, PROM_CONTENT_TYPE,
+                            _m.render_prometheus())
+            elif url.path == "/healthz":
+                st = _health.status()
+                code = 200 if st["status"] == "ok" else 503
+                self._reply(code, "application/json",
+                            json.dumps(st) + "\n")
+            elif url.path == "/events":
+                q = parse_qs(url.query)
+                try:
+                    n = int(q.get("n", ["100"])[0])
+                except ValueError:
+                    n = 100
+                kind = q.get("kind", [None])[0]
+                lines = [json.dumps(e, default=str)
+                         for e in _events.recent(n=n, kind=kind)]
+                self._reply(200, "application/x-ndjson",
+                            "\n".join(lines) + ("\n" if lines else ""))
+            else:
+                self._reply(404, "text/plain",
+                            "not found; routes: /metrics /healthz "
+                            "/events?n=K\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper hung up mid-reply
+
+
+def server_port() -> Optional[int]:
+    """Bound port of the running server, or None when no server is up."""
+    with _lock:
+        if _server is None:
+            return None
+        return _server.server_address[1]
+
+
+def start_http_server(port: int = 0, host: Optional[str] = None) -> int:
+    """Start the daemon serving thread (idempotent: a second call returns
+    the already-bound port). port=0 binds an ephemeral port. Returns the
+    actual bound port."""
+    global _server, _thread, _atexit_registered
+    with _lock:
+        if _server is not None:
+            return _server.server_address[1]
+        host = host or os.environ.get("PADDLE_TPU_METRICS_HOST",
+                                      "127.0.0.1")
+        srv = ThreadingHTTPServer((host, int(port)), _Handler)
+        srv.daemon_threads = True
+        t = threading.Thread(target=srv.serve_forever,
+                             name="paddle-tpu-metrics-http", daemon=True)
+        t.start()
+        _server, _thread = srv, t
+        if not _atexit_registered:
+            import atexit
+
+            atexit.register(stop_http_server)
+            _atexit_registered = True
+        return srv.server_address[1]
+
+
+def maybe_start_http_server() -> bool:
+    """Start the server iff PADDLE_TPU_METRICS_PORT is set and none is
+    running. Called from the telemetry hot-path helpers; the unset case
+    is a single env dict lookup."""
+    global _start_failed
+    raw = os.environ.get("PADDLE_TPU_METRICS_PORT")
+    if not raw:
+        return False
+    with _lock:
+        if _server is not None:
+            return True
+        if _start_failed:
+            return False  # port was taken once; don't re-bind every step
+    try:
+        port = int(raw)
+    except ValueError:
+        return False  # malformed env must not kill the hot path
+    if port < 0:
+        return False
+    try:
+        start_http_server(port)
+    except OSError:
+        _start_failed = True  # cleared by stop_http_server()
+        return False  # port taken: keep training, scraping is best-effort
+    return True
+
+
+def stop_http_server():
+    global _server, _thread, _start_failed
+    with _lock:
+        srv, _server = _server, None
+        t, _thread = _thread, None
+        _start_failed = False
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()
+    if t is not None and t.is_alive():
+        t.join(timeout=5)

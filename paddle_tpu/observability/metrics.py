@@ -213,12 +213,16 @@ class MetricsRegistry:
 
     def dump(self, directory: str) -> str:
         """Write metrics.json + metrics.prom into `directory` (tmp+rename
-        so a scraper never reads a torn file). Returns the json path."""
+        so a scraper never reads a torn file). Returns the json path.
+        Non-finite gauge values (a NaN grad-norm is a legitimate health
+        reading) become strings — json.dumps would otherwise emit a bare
+        `NaN` token that strict JSON parsers reject, breaking the whole
+        dump exactly when divergence is being observed."""
         os.makedirs(directory, exist_ok=True)
         snap = self.snapshot()
         jpath = os.path.join(directory, "metrics.json")
         ppath = os.path.join(directory, "metrics.prom")
-        for path, text in ((jpath, json.dumps(snap, indent=1)),
+        for path, text in ((jpath, json.dumps(_json_safe(snap), indent=1)),
                            (ppath, render_prometheus_snapshot(snap))):
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
@@ -227,8 +231,36 @@ class MetricsRegistry:
         return jpath
 
 
+def _json_safe(obj):
+    """Strict-JSON view of a snapshot: non-finite floats → strings
+    ("nan"/"inf"/"-inf"), containers walked recursively."""
+    if isinstance(obj, float):
+        import math
+
+        return obj if math.isfinite(obj) else repr(obj)
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_json_safe(v) for v in obj]
+    return obj
+
+
 def _escape_label(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _sanitize_name(name: str) -> str:
+    """Prometheus metric names are [a-zA-Z_:][a-zA-Z0-9_:]* — registry
+    names that carry dots/dashes (or any other separator) are mapped to
+    underscores at exposition time, so the JSON snapshot keeps the
+    author's spelling while the text format stays parseable. Distinct
+    raw names can collide after mapping; last-writer-wins per line is
+    the accepted cost (don't name metrics `a.b` AND `a_b`)."""
+    out = ["_" if not (c.isascii() and (c.isalnum() or c in "_:"))
+           else c for c in name]
+    if out and out[0].isdigit():
+        out.insert(0, "_")
+    return "".join(out)
 
 
 def _fmt_labels(labels: Dict[str, str], extra: str = "") -> str:
@@ -242,16 +274,24 @@ def _fmt_labels(labels: Dict[str, str], extra: str = "") -> str:
 def render_prometheus_snapshot(snap: Dict[str, dict]) -> str:
     """Prometheus text exposition from a snapshot() dict. Module-level so
     tools/obsdump.py can render an offline metrics.json without importing
-    the framework (and the jax stack behind it)."""
+    the framework (and the jax stack behind it).
+
+    Names are sanitized to the exposition charset (dots/dashes →
+    underscores). Histograms render as three grouped families —
+    `name_bucket` under the histogram TYPE, then `name_sum` and
+    `name_count` each with their own # HELP/# TYPE (counter) block — so
+    line-oriented scrapers that treat _sum/_count as standalone series
+    still see typed, documented families."""
     lines = []
-    for name in sorted(snap):
-        m = snap[name]
-        if m.get("help"):
-            lines.append(f"# HELP {name} {m['help']}")
-        lines.append(f"# TYPE {name} {m['type']}")
-        for s in m["series"]:
-            labels = s.get("labels", {})
-            if m["type"] == "histogram":
+    for raw_name in sorted(snap):
+        m = snap[raw_name]
+        name = _sanitize_name(raw_name)
+        if m["type"] == "histogram":
+            if m.get("help"):
+                lines.append(f"# HELP {name} {m['help']}")
+            lines.append(f"# TYPE {name} histogram")
+            for s in m["series"]:
+                labels = s.get("labels", {})
                 cum = 0
                 for b in s["buckets"]:
                     cum += b["count"]
@@ -262,11 +302,27 @@ def render_prometheus_snapshot(snap: Dict[str, dict]) -> str:
                 lines.append(
                     f"{name}_bucket{_fmt_labels(labels, inf)} "
                     f"{s['count']}")
-                lines.append(f"{name}_sum{_fmt_labels(labels)} {s['sum']}")
-                lines.append(
-                    f"{name}_count{_fmt_labels(labels)} {s['count']}")
-            else:
-                lines.append(f"{name}{_fmt_labels(labels)} {s['value']}")
+            lines.append(f"# HELP {name}_sum Sum of observations for "
+                         f"{name}")
+            lines.append(f"# TYPE {name}_sum counter")
+            for s in m["series"]:
+                lines.append(f"{name}_sum"
+                             f"{_fmt_labels(s.get('labels', {}))} "
+                             f"{s['sum']}")
+            lines.append(f"# HELP {name}_count Count of observations "
+                         f"for {name}")
+            lines.append(f"# TYPE {name}_count counter")
+            for s in m["series"]:
+                lines.append(f"{name}_count"
+                             f"{_fmt_labels(s.get('labels', {}))} "
+                             f"{s['count']}")
+        else:
+            if m.get("help"):
+                lines.append(f"# HELP {name} {m['help']}")
+            lines.append(f"# TYPE {name} {m['type']}")
+            for s in m["series"]:
+                lines.append(f"{name}{_fmt_labels(s.get('labels', {}))} "
+                             f"{s['value']}")
     return "\n".join(lines) + "\n"
 
 

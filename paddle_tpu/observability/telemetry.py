@@ -20,12 +20,15 @@ import contextlib
 import time
 from typing import Dict, Optional
 
+from . import events as _events
+from . import httpd as _httpd
 from . import metrics as _m
 
 __all__ = [
     "executor_step", "feed_nbytes",
     "record_executor_step", "record_cache_event", "record_trainer_step",
     "record_trainer_run", "record_spmd_step", "record_pipeline_trace",
+    "record_compile", "record_device_memory",
 ]
 
 EXEC_STEPS = _m.counter(
@@ -86,6 +89,25 @@ PIPELINE_BUBBLE_FRACTION = _m.gauge(
     "GPipe bubble (S-1)/(n_micro+S-1) of the last traced pipeline",
     labelnames=("axis",))
 
+COMPILES = _m.counter(
+    "paddle_tpu_compiles_total",
+    "XLA compiles by program kind (step|chained|sharded|spmd); a rising "
+    "rate at steady state is a recompile storm", labelnames=("kind",))
+COMPILE_SECONDS = _m.histogram(
+    "paddle_tpu_compile_seconds",
+    "Wall seconds per XLA trace+compile", labelnames=("kind",))
+COMPILE_FLOPS = _m.gauge(
+    "paddle_tpu_compile_flops",
+    "cost_analysis() FLOPs estimate of the most recent compile",
+    labelnames=("kind",))
+DEVICE_LIVE_BYTES = _m.gauge(
+    "paddle_tpu_device_live_bytes",
+    "Bytes held by live device buffers (jax.live_arrays sum); monotonic "
+    "growth at steady state is a leak")
+DEVICE_LIVE_BUFFERS = _m.gauge(
+    "paddle_tpu_device_live_buffers",
+    "Count of live device arrays")
+
 
 def record_executor_step(mode: str, seconds: float, feed_bytes: int):
     EXEC_STEPS.inc(mode=mode)
@@ -93,6 +115,7 @@ def record_executor_step(mode: str, seconds: float, feed_bytes: int):
     if feed_bytes:
         EXEC_FEED_BYTES.inc(feed_bytes)
     _m.maybe_start_dump_thread()
+    _httpd.maybe_start_http_server()
 
 
 def feed_nbytes(feed: Dict) -> int:
@@ -147,6 +170,31 @@ def record_spmd_step(axis: str, seconds: float,
     for op, n in (collectives or {}).items():
         SPMD_COLLECTIVES.inc(n, axis=axis, op=op)
     _m.maybe_start_dump_thread()
+    _httpd.maybe_start_http_server()
+
+
+def record_compile(kind: str, seconds: float,
+                   flops: Optional[float] = None,
+                   out_bytes: Optional[int] = None,
+                   meta: Optional[Dict] = None):
+    """One XLA trace+compile: metrics + a `compile` event so a recompile
+    storm is visible both as a rate and as a timeline."""
+    COMPILES.inc(kind=kind)
+    COMPILE_SECONDS.observe(seconds, kind=kind)
+    fields: Dict = {"compile_kind": kind, "seconds": round(seconds, 6)}
+    if flops is not None:
+        COMPILE_FLOPS.set(flops, kind=kind)
+        fields["flops"] = flops
+    if out_bytes is not None:
+        fields["out_bytes"] = int(out_bytes)
+    if meta:
+        fields.update(meta)
+    _events.emit("compile", **fields)
+
+
+def record_device_memory(nbytes: int, nbuffers: int):
+    DEVICE_LIVE_BYTES.set(nbytes)
+    DEVICE_LIVE_BUFFERS.set(nbuffers)
 
 
 def record_pipeline_trace(axis: str, stages: int, n_micro: int):

@@ -17,6 +17,7 @@ import numpy as np
 
 from .core import framework
 from .core.backward import append_backward
+from .observability import health as _obs_health
 from .core.framework import (OpRole, Parameter, Program, Variable,
                              default_main_program, default_startup_program,
                              op_role_guard, unique_name)
@@ -345,6 +346,20 @@ class Optimizer:
                  if not p.stop_gradient and getattr(p, "trainable", True)
                  and p.grad is not None and p.name not in skip]
         pairs = [(p, self._eager_regularize(p, g)) for p, g in pairs]
+        if pairs and _obs_health.check_level():
+            # PRE-clip on purpose: clipping rescales a diverging norm
+            # down to clip_norm (and maps Inf grads to NaN), masking
+            # exactly what this check watches for. One scalar covers
+            # every gradient — a single NaN/Inf element poisons the
+            # global norm. Accumulate on device (same shape as
+            # _eager_clip's global-norm sum) so the check costs ONE host
+            # sync, not one per parameter.
+            import jax.numpy as jnp
+
+            total = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for _, g in pairs)
+            _obs_health.record_grad_global_norm(float(total) ** 0.5,
+                                                n_params=len(pairs))
         pairs = self._eager_clip(pairs)
         # resolve the lr ONCE for this step (a LearningRateDecay
         # scheduler advances on resolution) and pin it for the per-param

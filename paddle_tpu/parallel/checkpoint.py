@@ -37,6 +37,10 @@ def save_train_state(path: str, state: TrainState, force: bool = False):
         ckptr.save(path, {"params": state.params,
                           "opt_state": state.opt_state,
                           "step": state.step}, force=force)
+    from ..observability import events as _events
+
+    _events.emit("checkpoint", site="save_train_state", dir=path,
+                 step=int(state.step))
 
 
 def restore_train_state(path: str, template: TrainState) -> TrainState:

@@ -23,12 +23,30 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..observability import health as _health
 from ..observability import telemetry as _telemetry
 from ..observability import tracing as _tracing
 from ..core import framework, lowering
-from ..core.executor import RNG_STATE_VAR, Scope, _as_fetch_name, global_scope
+from ..core.executor import (RNG_STATE_VAR, Scope, _as_fetch_name,
+                             _JitDispatch, _health_scan,
+                             _record_live_device_memory, global_scope)
 from ..core.framework import Program
 from ..core.ir import normalize_dtype
+
+
+def _shard_map(f, mesh, in_specs, out_specs, axis_names, check_vma):
+    """jax.shard_map with a fallback to the pre-0.5 experimental API
+    (jax 0.4.x ships it as jax.experimental.shard_map without the
+    axis_names/check_vma kwargs; check_rep is the old name for the
+    replication check we disable)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, axis_names=axis_names,
+                             check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _esm
+
+    return _esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=bool(check_vma))
 
 
 class SPMDRunner:
@@ -84,6 +102,16 @@ class SPMDRunner:
         for n, v in new_states.items():
             scope.set_var(n, v)
         scope.set_var(RNG_STATE_VAR, new_rng)
+        level = _health.check_level()
+        if level:
+            # a NaN produced on ANY shard reaches the reduced/concatenated
+            # fetch, so this one scan attributes shard divergence to the
+            # fetched variable at site "spmd_fetch"
+            _health_scan("spmd_fetch", zip(fetch_names, fetches), level)
+        if _health.introspection_enabled():
+            # multi-device runs are where buffer leaks hurt most — the
+            # live-bytes gauge must not go dark on the SPMD-only path
+            _record_live_device_memory()
         out = [np.asarray(f) for f in fetches] if return_numpy \
             else list(fetches)
         _telemetry.record_spmd_step(self.axis, time.perf_counter() - t0,
@@ -149,7 +177,7 @@ class SPMDRunner:
         feed_specs = {n: P(axis) for n in feed_names}
         fetch_specs = [P() if scalar_fetch[n] else P(axis)
                        for n in fetch_names]
-        sm = jax.shard_map(
+        sm = _shard_map(
             device_step,
             mesh=self.mesh,
             in_specs=(feed_specs,
@@ -161,7 +189,8 @@ class SPMDRunner:
                        P()),
             axis_names={axis},
             check_vma=False)
-        jitted = jax.jit(sm)
+        jitted = _JitDispatch(jax.jit(sm), "spmd",
+                              meta={"axis": axis, "devices": int(n_dev)})
 
         def step(scope: Scope, feed, rng):
             def _state(n):

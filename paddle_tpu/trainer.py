@@ -12,7 +12,14 @@ from __future__ import annotations
 import time
 from typing import Optional, Sequence
 
+from .observability import events as _events
+from .observability import health as _health
 from .observability import telemetry as _telemetry
+
+
+def _fetch_names(fetch_list, fetch_info=None):
+    return list(fetch_info) if fetch_info else [
+        getattr(f, "name", str(f)) for f in (fetch_list or [])]
 
 
 def _batch_examples(feed) -> int:
@@ -37,6 +44,7 @@ def train_from_dataset(executor, program=None, dataset=None, scope=None,
     if dataset is None:
         raise ValueError("dataset is required")
     fetch_list = fetch_list or []
+    names = _fetch_names(fetch_list, fetch_info)
     step = 0
     examples = 0
     run_t0 = time.perf_counter()
@@ -46,15 +54,23 @@ def train_from_dataset(executor, program=None, dataset=None, scope=None,
         t0 = time.perf_counter()
         vals = executor.run(program, feed=feed, fetch_list=fetch_list,
                             scope=scope)
+        if fetch_list and _health.check_level():
+            # the fetched losses are the trainer's divergence canary
+            _health.check_numerics("trainer_loss", zip(names, vals),
+                                   step=step)
         n = _batch_examples(feed)
         examples += n
         _telemetry.record_trainer_step(time.perf_counter() - t0, n)
         if debug and fetch_list and step % print_period == 0:
-            names = fetch_info or [getattr(f, "name", str(f)) for f in fetch_list]
             print(f"step {step}: " + ", ".join(
                 f"{n}={v}" for n, v in zip(names, vals)))
         step += 1
-    _telemetry.record_trainer_run(time.perf_counter() - run_t0, examples)
+    seconds = time.perf_counter() - run_t0
+    _telemetry.record_trainer_run(seconds, examples)
+    _events.emit("step_summary", site="train_from_dataset", steps=step,
+                 examples=examples, seconds=round(seconds, 6),
+                 examples_per_sec=round(examples / seconds, 3)
+                 if seconds > 0 else 0.0)
     return None
 
 
@@ -107,6 +123,7 @@ class HogwildWorker:
     def train(self):
         import contextlib
 
+        names = _fetch_names(self.desc.fetch_list, self.desc.fetch_info)
         run_t0 = time.perf_counter()
         examples = 0
         for feed in self.dataset._iter_batches() if hasattr(
@@ -117,6 +134,9 @@ class HogwildWorker:
                 vals = self.executor.run(self.program, feed=feed,
                                          fetch_list=self.desc.fetch_list,
                                          scope=self.scope)
+            if self.desc.fetch_list and _health.check_level():
+                _health.check_numerics("trainer_loss", zip(names, vals),
+                                       step=self.steps)
             n = _batch_examples(feed)
             examples += n
             _telemetry.record_trainer_step(time.perf_counter() - t0, n)
@@ -124,14 +144,14 @@ class HogwildWorker:
             if self.desc.fetch_list:
                 self.last_fetch = vals
                 if self.steps % self.desc.print_period == 0:
-                    names = self.desc.fetch_info or [
-                        getattr(f, "name", str(f))
-                        for f in self.desc.fetch_list]
                     print(f"worker {self.worker_id} step {self.steps}: " +
                           ", ".join(f"{n}={v}" for n, v in
                                     zip(names, vals)))
-        _telemetry.record_trainer_run(time.perf_counter() - run_t0,
-                                      examples)
+        seconds = time.perf_counter() - run_t0
+        _telemetry.record_trainer_run(seconds, examples)
+        _events.emit("step_summary", site="hogwild_worker",
+                     worker=self.worker_id, steps=self.steps,
+                     examples=examples, seconds=round(seconds, 6))
 
 
 class MultiTrainer:

@@ -1,0 +1,509 @@
+"""Training health monitor tests (tier-1, fast): the env-gated tensor
+health layer (PADDLE_TPU_CHECK_NUMERICS), the JSONL event log, the
+/metrics HTTP daemon, compile/memory introspection, and the full
+acceptance flow — an injected NaN flips /healthz from ok to degraded
+over a real socket.
+
+Health state (anomaly count, last anomaly) is process-global, so every
+test runs under the autouse fixture that resets it and strips the
+observability env vars; registry assertions use BEFORE/AFTER deltas
+like tests/test_observability.py.
+
+No jax.profiler.start_trace anywhere here — the first trace costs ~17 s
+on this sandbox and would blow the tier-1 wall budget.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import events as oe
+from paddle_tpu.observability import health as oh
+from paddle_tpu.observability import httpd as ohttp
+
+OBS_ENV = ("PADDLE_TPU_CHECK_NUMERICS", "PADDLE_TPU_METRICS_PORT",
+           "PADDLE_TPU_METRICS_DIR", "PADDLE_TPU_METRICS_HOST",
+           "PADDLE_TPU_EVENT_LOG", "PADDLE_TPU_HEALTH_MAX_ABS")
+
+
+@pytest.fixture(autouse=True)
+def _clean_health_state(monkeypatch):
+    for var in OBS_ENV:
+        monkeypatch.delenv(var, raising=False)
+    ohttp.stop_http_server()
+    oh.reset()
+    oe.clear()
+    yield
+    ohttp.stop_http_server()
+    oh.reset()
+    oe.clear()
+
+
+def _counter_value(snap, name, **labels):
+    for s in snap.get(name, {}).get("series", []):
+        if s["labels"] == {k: str(v) for k, v in labels.items()}:
+            return s.get("value", s.get("count"))
+    return 0
+
+
+def _linreg_program(n_features=4):
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[n_features], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+def _get(url):
+    """(status, body) — 4xx/5xx come back as values, not exceptions."""
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# ---------------------------------------------------------------------------
+# check_numerics unit semantics
+# ---------------------------------------------------------------------------
+
+
+def test_check_level_env_parsing(monkeypatch):
+    assert oh.check_level() == 0
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "1")
+    assert oh.check_level() == 1
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "2")
+    assert oh.check_level() == 2
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "weird")
+    assert oh.check_level() == 0  # typo must not change semantics
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "9")
+    assert oh.check_level() == 2  # clamped
+
+
+def test_check_numerics_classification_and_levels():
+    before = obs.snapshot()
+    nan = np.array([1.0, np.nan], "float32")
+    inf = np.array([np.inf], "float32")
+    ints = np.array([1, 2])  # non-float: never scanned
+
+    # level 1: counted + logged, no raise
+    found = oh.check_numerics("unit_site",
+                              [("a", nan), ("b", inf), ("c", ints),
+                               ("d", None)], level=1)
+    kinds = {(a["var"], a["kind"]) for a in found}
+    assert kinds == {("a", "nan"), ("b", "inf")}
+    after = obs.snapshot()
+    assert _counter_value(after, "paddle_tpu_health_anomalies_total",
+                          kind="nan", site="unit_site") - \
+        _counter_value(before, "paddle_tpu_health_anomalies_total",
+                       kind="nan", site="unit_site") == 1
+    assert oh.status()["status"] == "degraded"
+    evs = oe.recent(kind="anomaly")
+    assert {e["var"] for e in evs} >= {"a", "b"}
+    assert all(e["site"] == "unit_site" for e in evs)
+
+    # level 2: raises with the offending names
+    with pytest.raises(obs.NumericsError, match="'a' \\(nan\\)"):
+        oh.check_numerics("unit_site", [("a", nan)], level=2)
+
+    # clean values: nothing recorded
+    assert oh.check_numerics("unit_site",
+                             [("ok", np.ones(3, "float32"))],
+                             level=2) == []
+
+
+def test_max_abs_overrange(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_HEALTH_MAX_ABS", "100")
+    found = oh.check_numerics(
+        "unit_site", [("big", np.array([1.0, 1e6], "float32"))], level=1)
+    assert [(a["var"], a["kind"]) for a in found] == [("big", "overrange")]
+    # Inf is not double-counted as overrange
+    found = oh.check_numerics(
+        "unit_site", [("inf", np.array([np.inf], "float32"))], level=1)
+    assert [a["kind"] for a in found] == ["inf"]
+    # a NaN in the same array must not mask a genuine overrange element
+    found = oh.check_numerics(
+        "unit_site",
+        [("mix", np.array([np.nan, 1e6, 1.0], "float32"))], level=1)
+    assert {a["kind"] for a in found} == {"nan", "overrange"}
+
+
+def test_check_numerics_catches_bfloat16():
+    """bfloat16 (the dominant TPU training dtype) is NOT an np.floating
+    subtype — it must still be scanned, like the legacy
+    FLAGS_check_nan_inf path (which used jnp.issubdtype) always did."""
+    import jax.numpy as jnp
+
+    bad = jnp.array([1.0, jnp.nan], dtype=jnp.bfloat16)
+    found = oh.check_numerics("unit_site", [("bf16", bad)], level=1)
+    assert [(a["var"], a["kind"]) for a in found] == [("bf16", "nan")]
+    ok = jnp.ones((3,), dtype=jnp.bfloat16)
+    assert oh.check_numerics("unit_site", [("ok", ok)], level=2) == []
+
+
+def test_events_ring_and_jsonl_file(tmp_path, monkeypatch):
+    log = tmp_path / "events.jsonl"
+    monkeypatch.setenv("PADDLE_TPU_EVENT_LOG", str(log))
+    e1 = oe.emit("compile", compile_kind="step", seconds=0.5)
+    e2 = oe.emit("anomaly", site="s", var="v", anomaly="nan")
+    assert e2["seq"] == e1["seq"] + 1  # monotonic seq
+    assert e2["ts"] >= e1["ts"] > 0    # wall time
+
+    lines = [json.loads(l) for l in log.read_text().splitlines()]
+    assert [l["kind"] for l in lines] == ["compile", "anomaly"]
+    assert oe.recent(kind="anomaly")[-1]["var"] == "v"
+    assert oe.read_jsonl(str(log), kind="compile")[0]["seconds"] == 0.5
+    # the file is append-only across emits
+    oe.emit("checkpoint", dir="/x")
+    assert len(log.read_text().splitlines()) == 3
+
+
+# ---------------------------------------------------------------------------
+# Executor / trainer / optimizer wiring
+# ---------------------------------------------------------------------------
+
+
+def test_executor_fetch_anomaly_warn_level(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "1")
+    before = obs.snapshot()
+    main, startup, loss = _linreg_program()
+    exe = pt.Executor(pt.CPUPlace())
+    X = np.ones((8, 4), "float32")
+    Y = np.ones((8, 1), "float32")
+    Xbad = X.copy()
+    Xbad[0, 0] = np.nan
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": Xbad, "y": Y}, fetch_list=[loss])  # no raise
+    after = obs.snapshot()
+    d = _counter_value(after, "paddle_tpu_health_anomalies_total",
+                       kind="nan", site="executor_fetch") - \
+        _counter_value(before, "paddle_tpu_health_anomalies_total",
+                       kind="nan", site="executor_fetch")
+    assert d == 1
+    assert oh.status()["status"] == "degraded"
+    ev = oe.recent(kind="anomaly")
+    assert any(e["site"] == "executor_fetch" for e in ev)
+
+
+def test_executor_raise_level_names_variable(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "2")
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[2], dtype="float32")
+        out = pt.layers.log(x)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    with pytest.raises(obs.NumericsError, match="NaN/Inf"):
+        exe.run(main, feed={"x": np.array([[-1.0, 2.0]], "float32")},
+                fetch_list=[out])
+
+
+def test_run_chained_health_check(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "2")
+    main, startup, loss = _linreg_program()
+    exe = pt.Executor(pt.CPUPlace())
+    Xbad = np.ones((8, 4), "float32")
+    Xbad[1, 1] = np.inf
+    Y = np.ones((8, 1), "float32")
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        with pytest.raises(obs.NumericsError):
+            exe.run_chained(main, feed={"x": Xbad, "y": Y},
+                            fetch_list=[loss], n_steps=3)
+
+
+def test_trainer_loss_site_attribution(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "1")
+
+    class _DS:
+        def _iter_batches(self):
+            X = np.ones((4, 4), "float32")
+            Y = np.ones((4, 1), "float32")
+            yield {"x": X, "y": Y}
+            Xb = X.copy()
+            Xb[2, 3] = np.nan
+            yield {"x": Xb, "y": Y}
+
+    main, startup, loss = _linreg_program()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        exe.train_from_dataset(main, _DS(), fetch_list=[loss])
+    evs = [e for e in oe.recent(kind="anomaly")
+           if e["site"] == "trainer_loss"]
+    assert evs and evs[-1]["var"] == loss.name
+    assert evs[-1]["step"] == 1  # the second batch diverged
+    # the trainer run also left a step_summary event
+    summaries = oe.recent(kind="step_summary")
+    assert summaries and summaries[-1]["steps"] == 2
+
+
+def test_optimizer_grad_global_norm(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "1")
+    with pt.dygraph.guard():
+        lin = pt.dygraph.Linear(4, 3)
+        xv = pt.dygraph.to_variable(np.ones((2, 4), "float32"))
+        loss = pt.layers.reduce_mean(lin(xv))
+        loss.backward()
+        pt.optimizer.SGD(learning_rate=0.1).minimize(
+            loss, parameter_list=lin.parameters())
+    norm = oh.GRAD_GLOBAL_NORM.value()
+    assert norm > 0 and np.isfinite(norm)
+    assert oh.status()["status"] == "ok"
+
+    with pt.dygraph.guard():
+        lin = pt.dygraph.Linear(4, 3)
+        xv = pt.dygraph.to_variable(np.full((2, 4), np.nan, "float32"))
+        loss = pt.layers.reduce_mean(lin(xv))
+        loss.backward()
+        pt.optimizer.SGD(learning_rate=0.1).minimize(
+            loss, parameter_list=lin.parameters())  # level 1: no raise
+    assert any(e["site"] == "optimizer_grad"
+               for e in oe.recent(kind="anomaly"))
+    assert oh.status()["status"] == "degraded"
+
+
+# ---------------------------------------------------------------------------
+# Compile / memory introspection
+# ---------------------------------------------------------------------------
+
+
+def test_compile_introspection_metrics_and_events():
+    before = obs.snapshot()
+    main, startup, loss = _linreg_program()
+    exe = pt.Executor(pt.CPUPlace())
+    X = np.ones((8, 4), "float32")
+    Y = np.ones((8, 1), "float32")
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for _ in range(3):
+            exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    after = obs.snapshot()
+    d = _counter_value(after, "paddle_tpu_compiles_total", kind="step") - \
+        _counter_value(before, "paddle_tpu_compiles_total", kind="step")
+    assert d == 2  # startup + main; steps 2-3 reuse the executable
+    evs = [e for e in oe.recent(kind="compile")
+           if e["compile_kind"] == "step"]
+    assert len(evs) == 2
+    assert all(e["seconds"] > 0 for e in evs)
+    # the CPU backend reports a cost model; the training step has FLOPs
+    assert any(e.get("flops") for e in evs)
+
+
+def test_device_live_bytes_gauge(tmp_path, monkeypatch):
+    from paddle_tpu.core import executor as executor_mod
+
+    # any observability env opt-in enables the per-step memory sweep
+    monkeypatch.setenv("PADDLE_TPU_EVENT_LOG",
+                       str(tmp_path / "ev.jsonl"))
+    # the sweep is rate-limited; force this step to sample
+    executor_mod._last_mem_sweep[0] = 0.0
+    main, startup, loss = _linreg_program()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        exe.run(main, feed={"x": np.ones((8, 4), "float32"),
+                            "y": np.ones((8, 1), "float32")},
+                fetch_list=[loss])
+    snap = obs.snapshot()
+    assert snap["paddle_tpu_device_live_bytes"]["series"][0]["value"] > 0
+    assert snap["paddle_tpu_device_live_buffers"]["series"][0]["value"] > 0
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint
+# ---------------------------------------------------------------------------
+
+
+def test_httpd_routes():
+    port = ohttp.start_http_server(0)
+    assert ohttp.server_port() == port
+    # idempotent: second start returns the same bound port
+    assert ohttp.start_http_server(0) == port
+
+    obs.counter("httpd_route_smoke_total").inc(3)
+    code, body = _get(f"http://127.0.0.1:{port}/metrics")
+    assert code == 200
+    assert "httpd_route_smoke_total 3" in body
+
+    code, body = _get(f"http://127.0.0.1:{port}/healthz")
+    assert code == 200 and json.loads(body)["status"] == "ok"
+
+    oe.emit("compile", compile_kind="t", seconds=0.1)
+    oe.emit("anomaly", site="s", var="v", anomaly="nan")
+    code, body = _get(f"http://127.0.0.1:{port}/events?n=5&kind=anomaly")
+    assert code == 200
+    evs = [json.loads(l) for l in body.splitlines()]
+    assert [e["kind"] for e in evs] == ["anomaly"]
+
+    code, _ = _get(f"http://127.0.0.1:{port}/nope")
+    assert code == 404
+
+    ohttp.stop_http_server()
+    assert ohttp.server_port() is None
+
+
+def test_maybe_start_respects_env(monkeypatch):
+    assert not ohttp.maybe_start_http_server()  # unset → no socket
+    assert ohttp.server_port() is None
+    monkeypatch.setenv("PADDLE_TPU_METRICS_PORT", "not-a-port")
+    assert not ohttp.maybe_start_http_server()  # malformed → no socket
+    monkeypatch.setenv("PADDLE_TPU_METRICS_PORT", "0")
+    assert ohttp.maybe_start_http_server()
+    assert ohttp.server_port() is not None
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: live flip over a real socket + zero-cost bypass
+# ---------------------------------------------------------------------------
+
+
+def test_acceptance_nan_flips_healthz_live(tmp_path, monkeypatch):
+    """ISSUE 2 acceptance: with PADDLE_TPU_CHECK_NUMERICS=1 and
+    PADDLE_TPU_METRICS_PORT set, a trainer loop that hits an injected
+    NaN increments health_anomalies_total, appends an `anomaly` event to
+    the JSONL log, and GET /healthz flips ok → degraded — all over a
+    real ephemeral-port socket via urllib."""
+    log = tmp_path / "events.jsonl"
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "1")
+    monkeypatch.setenv("PADDLE_TPU_METRICS_PORT", "0")  # ephemeral
+    monkeypatch.setenv("PADDLE_TPU_EVENT_LOG", str(log))
+
+    X = np.ones((4, 4), "float32")
+    Y = np.ones((4, 1), "float32")
+
+    class _Clean:
+        def _iter_batches(self):
+            for _ in range(3):
+                yield {"x": X, "y": Y}
+
+    class _Poisoned:
+        def _iter_batches(self):
+            yield {"x": X, "y": Y}
+            Xb = X.copy()
+            Xb[0, 0] = np.nan
+            yield {"x": Xb, "y": Y}
+
+    before = obs.snapshot()
+    main, startup, loss = _linreg_program()
+    exe = pt.Executor(pt.CPUPlace())
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        exe.train_from_dataset(main, _Clean(), fetch_list=[loss])
+        # the first step's telemetry started the server off the env var
+        port = ohttp.server_port()
+        assert port is not None
+        code, body = _get(f"http://127.0.0.1:{port}/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+
+        exe.train_from_dataset(main, _Poisoned(), fetch_list=[loss])
+
+    code, body = _get(f"http://127.0.0.1:{port}/healthz")
+    assert code == 503
+    payload = json.loads(body)
+    assert payload["status"] == "degraded" and payload["anomalies"] >= 1
+    assert payload["last_anomaly"]["anomaly"] == "nan"
+
+    code, body = _get(f"http://127.0.0.1:{port}/metrics")
+    assert code == 200
+    after = obs.snapshot()
+    assert _counter_value(after, "paddle_tpu_health_anomalies_total",
+                          kind="nan", site="trainer_loss") > \
+        _counter_value(before, "paddle_tpu_health_anomalies_total",
+                       kind="nan", site="trainer_loss")
+    assert 'paddle_tpu_health_anomalies_total{kind="nan",' \
+        'site="trainer_loss"}' in body
+
+    file_evs = [json.loads(l) for l in log.read_text().splitlines()]
+    assert any(e["kind"] == "anomaly" and e["site"] == "trainer_loss"
+               for e in file_evs)
+
+
+def test_bypass_when_env_unset(monkeypatch):
+    """ISSUE 2 acceptance (flip side): with the env vars unset, a
+    100-step Executor.run loop never enters the health layer (the scan
+    functions are booby-trapped to prove it), opens no listening socket,
+    and starts no server thread."""
+    from paddle_tpu.core import executor as executor_mod
+
+    def _boom(*a, **k):
+        raise AssertionError("health layer must be bypassed when "
+                             "PADDLE_TPU_CHECK_NUMERICS is unset")
+
+    monkeypatch.setattr(oh, "check_numerics", _boom)
+    monkeypatch.setattr(executor_mod, "_record_live_device_memory", _boom)
+
+    main, startup, loss = _linreg_program()
+    exe = pt.Executor(pt.CPUPlace())
+    X = np.ones((8, 4), "float32")
+    Y = np.ones((8, 1), "float32")
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        for _ in range(100):
+            exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    assert ohttp.server_port() is None
+    assert not [t for t in threading.enumerate()
+                if t.name == "paddle-tpu-metrics-http"]
+
+
+# ---------------------------------------------------------------------------
+# SPMD: shard divergence attribution (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_spmd_nan_shard_attributed_and_visible_in_healthz(monkeypatch):
+    """A NaN injected into ONE shard of a 2-device CPU-mesh run is
+    attributed to site=spmd_fetch with the fetched variable's name, and
+    surfaces in /healthz."""
+    import jax
+
+    from paddle_tpu.parallel import MeshConfig, SPMDRunner, make_mesh
+    from paddle_tpu.parallel.collective import GradAllReduce
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices (virtual CPU mesh)")
+    monkeypatch.setenv("PADDLE_TPU_CHECK_NUMERICS", "1")
+    monkeypatch.setenv("PADDLE_TPU_METRICS_PORT", "0")
+
+    before = obs.snapshot()
+    main, startup, loss = _linreg_program()
+    mesh = make_mesh(MeshConfig(dp=2), devices=jax.devices()[:2])
+    GradAllReduce(nranks=2).transpile(main)
+    runner = SPMDRunner(main, mesh)
+    exe = pt.Executor(pt.CPUPlace())
+    X = np.ones((8, 4), "float32")
+    Y = np.ones((8, 1), "float32")
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        runner.run(exe, feed={"x": X, "y": Y}, fetch_list=[loss])
+        port = ohttp.server_port()
+        assert port is not None
+        code, body = _get(f"http://127.0.0.1:{port}/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+
+        Xbad = X.copy()
+        Xbad[6, 2] = np.nan  # rows 4:8 are device 1's shard
+        runner.run(exe, feed={"x": Xbad, "y": Y}, fetch_list=[loss])
+
+    after = obs.snapshot()
+    assert _counter_value(after, "paddle_tpu_health_anomalies_total",
+                          kind="nan", site="spmd_fetch") - \
+        _counter_value(before, "paddle_tpu_health_anomalies_total",
+                       kind="nan", site="spmd_fetch") == 1
+    ev = [e for e in oe.recent(kind="anomaly")
+          if e["site"] == "spmd_fetch"]
+    assert ev and ev[-1]["var"] == loss.name
+
+    code, body = _get(f"http://127.0.0.1:{port}/healthz")
+    assert code == 503 and json.loads(body)["status"] == "degraded"

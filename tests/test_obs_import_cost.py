@@ -1,0 +1,124 @@
+"""CI guard: importing the framework must not start observability
+side-effects, and the observability modules themselves must stay cheap
+to import.
+
+Two invariants protected here (tier-1 speed depends on both):
+
+- `import paddle_tpu` starts NO http server, NO metrics-dump thread and
+  binds no socket — everything is env-gated and lazy (first hot-path
+  step), so a library user who never opts in pays nothing.
+- the stdlib observability modules (metrics/events/httpd/tracing,
+  loaded by file path exactly like tools/obsdump.py does) import far
+  under a fixed wall budget — obsdump must stay a millisecond-class
+  tool on hosts without jax.
+
+Deliberately NO jax.profiler.start_trace anywhere: the first trace in a
+process costs ~17 s of plugin init on this sandbox.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Generous CI budget: the four stdlib modules load in ~50 ms on this
+# sandbox; 5 s catches someone accidentally importing jax/numpy-at-top
+# (jax alone costs multiple seconds cold) without flaking on slow hosts.
+STDLIB_IMPORT_BUDGET_S = 5.0
+
+_PROBE = r"""
+import json, socket, sys, threading
+import paddle_tpu
+from paddle_tpu.observability import httpd, metrics
+out = {
+    "threads": sorted(t.name for t in threading.enumerate()),
+    "server_port": httpd.server_port(),
+    "dump_thread": metrics._dump_thread is not None,
+}
+print(json.dumps(out))
+"""
+
+
+def _clean_env():
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("PADDLE_TPU_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def test_import_paddle_tpu_starts_nothing():
+    r = subprocess.run([sys.executable, "-c", _PROBE],
+                       capture_output=True, text=True, timeout=120,
+                       env=_clean_env(), cwd=REPO)
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["server_port"] is None
+    assert out["dump_thread"] is False
+    bad = [t for t in out["threads"] if t.startswith("paddle-tpu")]
+    assert not bad, f"import started observability threads: {bad}"
+
+
+def test_stdlib_observability_import_under_budget():
+    probe = r"""
+import importlib.util, json, os, sys, time, types
+obs_dir = sys.argv[1]
+t0 = time.perf_counter()
+# load the whole layer as a synthetic package (so `from . import x`
+# resolves) WITHOUT touching paddle_tpu/__init__, which would pull jax
+pkg = types.ModuleType("obsprobe")
+pkg.__path__ = [obs_dir]
+sys.modules["obsprobe"] = pkg
+for name in ("metrics", "events", "health", "httpd", "tracing",
+             "telemetry"):
+    spec = importlib.util.spec_from_file_location(
+        "obsprobe." + name, os.path.join(obs_dir, name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["obsprobe." + name] = mod
+    spec.loader.exec_module(mod)
+elapsed = time.perf_counter() - t0
+assert "jax" not in sys.modules, "obs modules must not pull jax at top"
+print(json.dumps({"elapsed": elapsed}))
+"""
+    obs_dir = os.path.join(REPO, "paddle_tpu", "observability")
+    r = subprocess.run([sys.executable, "-c", probe, obs_dir],
+                       capture_output=True, text=True, timeout=60,
+                       env=_clean_env())
+    assert r.returncode == 0, r.stderr
+    elapsed = json.loads(r.stdout.strip().splitlines()[-1])["elapsed"]
+    assert elapsed < STDLIB_IMPORT_BUDGET_S, (
+        f"observability stdlib import took {elapsed:.2f}s "
+        f"(budget {STDLIB_IMPORT_BUDGET_S}s) — something heavy crept "
+        f"into a stdlib-only module")
+
+
+def test_obsdump_offline_needs_no_framework(tmp_path):
+    """The obsdump file paths (snapshot/events) run without importing
+    paddle_tpu or jax — fast enough for a laptop holding a run dir."""
+    snap = {"m_total": {"type": "counter", "help": "",
+                        "series": [{"labels": {}, "value": 4}]}}
+    mpath = tmp_path / "metrics.json"
+    mpath.write_text(json.dumps(snap))
+    epath = tmp_path / "events.jsonl"
+    epath.write_text('{"seq": 1, "ts": 1.0, "kind": "compile"}\n')
+    probe = r"""
+import importlib.util, sys
+tool, mpath, epath = sys.argv[1:4]
+spec = importlib.util.spec_from_file_location("_obsdump", tool)
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+assert mod.main(["snapshot", mpath]) == 0
+assert mod.main(["events", epath]) == 0
+assert "jax" not in sys.modules, "offline obsdump must not import jax"
+assert "paddle_tpu" not in sys.modules
+print("OFFLINE_OK")
+"""
+    r = subprocess.run(
+        [sys.executable, "-c", probe,
+         os.path.join(REPO, "tools", "obsdump.py"),
+         str(mpath), str(epath)],
+        capture_output=True, text=True, timeout=60, env=_clean_env())
+    assert r.returncode == 0, r.stderr
+    assert "OFFLINE_OK" in r.stdout
+    assert "m_total" in r.stdout and "compile" in r.stdout

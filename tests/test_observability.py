@@ -414,6 +414,153 @@ def test_training_under_profiler_exports_unified_trace(tmp_path,
     profiler.reset_profiler()
 
 
+RE_SAMPLE = __import__("re").compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+\-]+(e[+-]?\d+)?$',
+    __import__("re").IGNORECASE)
+
+
+def test_prometheus_exposition_conformance():
+    """Satellite: sanitized names + HELP/TYPE for histogram _sum/_count.
+    Every sample line must match the exposition grammar; histogram
+    buckets must be cumulative and capped by +Inf == count."""
+    reg = om.MetricsRegistry()
+    reg.counter("dotted.name-with-dash.total", "dots and dashes").inc(2)
+    reg.gauge("ok_name", "fine").set(1.5)
+    h = reg.histogram("lat.seconds", "latency", labelnames=("mode",),
+                      buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v, mode="run")
+    text = reg.render_prometheus()
+    lines = text.strip().splitlines()
+
+    # dots/dashes mapped to underscores everywhere
+    assert "dotted.name" not in text and "with-dash" not in text
+    assert "dotted_name_with_dash_total 2" in text
+
+    # each sample line parses; HELP/TYPE precede their family's samples
+    seen_types = {}
+    for ln in lines:
+        if ln.startswith("# HELP "):
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, name, kind = ln.split(" ", 3)
+            seen_types[name] = kind
+            continue
+        assert RE_SAMPLE.match(ln), f"malformed sample line: {ln!r}"
+
+    # histograms expose typed+documented _sum/_count families
+    assert seen_types["lat_seconds"] == "histogram"
+    assert seen_types["lat_seconds_sum"] == "counter"
+    assert seen_types["lat_seconds_count"] == "counter"
+    assert "# HELP lat_seconds_sum" in text
+    assert "# HELP lat_seconds_count" in text
+
+    # cumulative buckets: 1 (<=0.1), 2 (<=1), +Inf == count == 3
+    # (sorted labels first, `le` appended last by _fmt_labels)
+    assert 'lat_seconds_bucket{mode="run",le="0.1"} 1' in text
+    assert 'lat_seconds_bucket{mode="run",le="1"} 2' in text
+    assert 'lat_seconds_bucket{mode="run",le="+Inf"} 3' in text
+    assert 'lat_seconds_count{mode="run"} 3' in text
+
+
+def test_dump_is_strict_json_with_nonfinite_gauges(tmp_path):
+    """A NaN gauge (legitimate health reading) must not poison
+    metrics.json with a bare `NaN` token strict parsers reject."""
+    reg = om.MetricsRegistry()
+    reg.gauge("nan_gauge").set(float("nan"))
+    reg.gauge("inf_gauge").set(float("inf"))
+    path = reg.dump(str(tmp_path))
+    text = open(path).read()
+
+    def _no_constants(s):
+        raise AssertionError(f"bare non-finite token in dump: {s}")
+
+    snap = json.loads(text, parse_constant=_no_constants)
+    assert snap["nan_gauge"]["series"][0]["value"] == "nan"
+    assert snap["inf_gauge"]["series"][0]["value"] == "inf"
+
+
+def test_debugger_dot_parses_and_gauges_nodes():
+    """Satellite: plain (non-parameter, non-highlight) var nodes used to
+    render `shape=ellipse, ];` — invalid DOT. Sanity-parse the output
+    and check the node-count gauge."""
+    from paddle_tpu import debugger
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        pred = pt.layers.fc(x, size=2)
+        pt.layers.mean(pred)
+    block = main.global_block()
+    dot = debugger.block_to_dot(block, highlight=["x"])
+
+    assert dot.startswith("digraph") and dot.rstrip().endswith("}")
+    assert ", ]" not in dot  # the empty-style regression
+    # every node statement: "name" [attr, attr];  with balanced brackets
+    node_lines = [l.strip() for l in dot.splitlines()
+                  if l.strip().endswith("];")]
+    assert node_lines, dot
+    for ln in node_lines:
+        # attr list = first "[" .. the "]" closing the statement (labels
+        # may hold inner brackets from tensor shapes)
+        body = ln[ln.index("[") + 1:ln.rindex("]")].strip()
+        assert body and not body.endswith(","), ln
+        assert ln.count('"') % 2 == 0, ln
+
+    n_ops = len(block.desc.ops)
+    n_vars = len([l for l in dot.splitlines() if '"v_' in l and "[" in l])
+    snap = obs.snapshot()
+    series = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in snap["paddle_tpu_debugger_dot_nodes"]["series"]}
+    assert series[(("kind", "op"),)] == n_ops
+    assert series[(("kind", "var"),)] == n_vars
+
+    # draw_program routes through the same renderer
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".dot") as f:
+        path = debugger.draw_program(main, path=f.name)
+        assert ", ]" not in open(path).read()
+
+
+def test_obsdump_events_subcommand(tmp_path):
+    """Satellite: obsdump events tails/filters a JSONL log; unknown
+    subcommands exit nonzero."""
+    log = tmp_path / "events.jsonl"
+    rows = [
+        {"seq": 1, "ts": 1.5, "kind": "compile", "compile_kind": "step",
+         "seconds": 0.4},
+        {"seq": 2, "ts": 2.5, "kind": "anomaly", "site": "trainer_loss",
+         "var": "loss", "anomaly": "nan"},
+        {"seq": 3, "ts": 3.5, "kind": "anomaly", "site": "spmd_fetch",
+         "var": "loss", "anomaly": "inf"},
+    ]
+    log.write_text("".join(json.dumps(r) + "\n" for r in rows) +
+                   "{broken json\n")  # truncated tail line is skipped
+
+    r = subprocess.run([sys.executable, OBSDUMP, "events", str(log)],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    out_lines = r.stdout.strip().splitlines()
+    assert len(out_lines) == 3
+    assert "compile" in out_lines[0] and "anomaly" in out_lines[-1]
+
+    r = subprocess.run([sys.executable, OBSDUMP, "events", str(log),
+                        "-n", "1", "--kind", "anomaly"],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    filtered = r.stdout.strip().splitlines()
+    assert len(filtered) == 1 and "spmd_fetch" in filtered[0]
+
+    r = subprocess.run([sys.executable, OBSDUMP, "events",
+                        str(tmp_path / "missing.jsonl")],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode != 0
+
+    r = subprocess.run([sys.executable, OBSDUMP, "not-a-command"],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode != 0
+
+
 def test_span_store_cap_evicts_oldest(monkeypatch):
     ot.clear_spans()
     monkeypatch.setattr(ot, "MAX_SPANS", 10)

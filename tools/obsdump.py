@@ -15,6 +15,9 @@ Usage:
   obsdump.py trace RUN_DIR -o out.json      # merge spans.json + jax
                                             # *.trace.json(.gz) under
                                             # RUN_DIR into ONE chrome trace
+  obsdump.py events EVENTS.jsonl            # tail the JSONL event log
+                                            # (-n N, --kind K, --json,
+                                            # --follow)
 
 The metrics JSON is what the registry's env-gated dumper
 (PADDLE_TPU_METRICS_DIR) writes; RUN_DIR is typically the profiler's
@@ -47,9 +50,15 @@ def _load_obs_module(name: str):
 
 
 def _fmt_value(v):
-    if isinstance(v, float) and v != int(v):
-        return f"{v:.6g}"
-    return str(int(v)) if isinstance(v, float) else str(v)
+    import math
+
+    if isinstance(v, float):
+        # NaN/Inf are legitimate gauge values (a NaN grad-norm is exactly
+        # what the health metrics record) — int() would raise on them
+        if not math.isfinite(v) or v != int(v):
+            return f"{v:.6g}"
+        return str(int(v))
+    return str(v)
 
 
 def _fmt_labels(labels):
@@ -129,6 +138,75 @@ def cmd_trace(args) -> int:
     return 0
 
 
+def _fmt_event(ev) -> str:
+    import datetime
+
+    ts = ev.get("ts")
+    when = datetime.datetime.fromtimestamp(ts).isoformat(
+        timespec="milliseconds") if isinstance(ts, (int, float)) else "?"
+    rest = {k: v for k, v in ev.items()
+            if k not in ("seq", "ts", "kind")}
+    detail = " ".join(f"{k}={v}" for k, v in sorted(rest.items()))
+    return f"{ev.get('seq', '?'):>6}  {when}  " \
+           f"{ev.get('kind', '?'):<13} {detail}"
+
+
+def cmd_events(args) -> int:
+    """Tail/filter the observability JSONL event log (events.py emit
+    format). --follow polls for appended lines until interrupted; it is
+    OFF by default so scripted callers terminate."""
+    if not os.path.isfile(args.path):
+        print(f"events: no such file: {args.path}", file=sys.stderr)
+        return 2
+
+    def _parse(line):
+        try:
+            ev = json.loads(line)
+        except ValueError:
+            return None  # crash mid-append truncates the last line
+        if args.kind and ev.get("kind") != args.kind:
+            return None
+        return ev
+
+    # ONE handle for tail + follow: after read() the position is exactly
+    # where the tail ended, so events appended while we print the tail
+    # are picked up by the follow loop instead of falling into a gap
+    with open(args.path) as f:
+        text = f.read()
+        # an event being appended RIGHT NOW can straddle the read: carry
+        # the unterminated trailing fragment into the follow buffer
+        # rather than dropping it as a malformed tail line
+        buf = ""
+        if text and not text.endswith("\n"):
+            nl = text.rfind("\n")
+            text, buf = text[:nl + 1], text[nl + 1:]
+        evs = [ev for ev in map(_parse, text.splitlines()) if ev]
+        if args.n is not None and args.n >= 0:
+            evs = evs[-args.n:] if args.n else []
+        for ev in evs:
+            print(json.dumps(ev) if args.json else _fmt_event(ev))
+        if not args.follow:
+            return 0
+        import time as _time
+        try:
+            while True:
+                chunk = f.readline()
+                if not chunk:
+                    _time.sleep(0.2)
+                    continue
+                buf += chunk
+                if not buf.endswith("\n"):
+                    continue  # line still being written; keep buffering
+                line, buf = buf, ""
+                ev = _parse(line)
+                if ev is not None:
+                    print(json.dumps(ev) if args.json else _fmt_event(ev),
+                          flush=True)
+        except KeyboardInterrupt:
+            pass
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="obsdump", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -148,6 +226,21 @@ def main(argv=None) -> int:
     tp.add_argument("-o", "--output", default="trace.json")
     tp.set_defaults(fn=cmd_trace)
 
+    ep = sub.add_parser("events", help="tail/filter a JSONL event log")
+    ep.add_argument("path", help="events.jsonl (PADDLE_TPU_EVENT_LOG)")
+    ep.add_argument("-n", type=int, default=20,
+                    help="show the last N events (default 20)")
+    ep.add_argument("--kind", default=None,
+                    help="only events of this kind (compile|step_summary|"
+                    "anomaly|checkpoint|...)")
+    ep.add_argument("--json", action="store_true",
+                    help="raw JSON objects instead of the aligned table")
+    ep.add_argument("--follow", action="store_true",
+                    help="keep polling for appended events (default off)")
+    ep.set_defaults(fn=cmd_events)
+
+    # unknown/missing subcommands exit nonzero via argparse itself
+    # (required=True subparsers error out with status 2)
     args = ap.parse_args(argv)
     return args.fn(args)
 
