@@ -48,6 +48,7 @@ from .core.flags import get_flags, set_flags
 from . import contrib
 from . import inference
 from .inference import AnalysisConfig, create_paddle_predictor
+from . import serving
 from . import data_feeder
 from .data_feeder import DataFeeder
 from . import reader

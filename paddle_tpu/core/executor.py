@@ -91,26 +91,38 @@ class _JitDispatch:
         # _jit itself is missing (e.g. mid-unpickle)
         return getattr(object.__getattribute__(self, "_jit"), name)
 
+    def warm(self, *args) -> bool:
+        """AOT-compile for the given avals (concrete arrays or
+        jax.ShapeDtypeStructs) without executing — serving warmup
+        compiles every traffic bucket before the first request lands.
+        Records the same compile telemetry as a first dispatch; no-op
+        once compiled (or once AOT already failed). Returns whether an
+        AOT executable is in place. Double-checked lock: concurrent
+        first dispatches (HogwildWorker threads on a shared executor)
+        must compile ONCE, with the second thread waiting rather than
+        jit-compiling a duplicate."""
+        if self._tried:
+            return self._aot is not None
+        with self._compile_lock:
+            if not self._tried:
+                t0 = time.perf_counter()
+                try:
+                    self._aot = self._jit.lower(*args).compile()
+                except Exception:
+                    self._aot = None  # jit path compiles on dispatch
+                else:
+                    seconds = time.perf_counter() - t0
+                    flops, out_bytes = _compile_cost(self._aot)
+                    _telemetry.record_compile(self._kind, seconds,
+                                              flops=flops,
+                                              out_bytes=out_bytes,
+                                              meta=self._meta)
+                self._tried = True
+        return self._aot is not None
+
     def __call__(self, *args):
         if not self._tried:
-            # double-checked: concurrent first dispatches (HogwildWorker
-            # threads on a shared executor) must compile ONCE, with the
-            # second thread waiting rather than jit-compiling a duplicate
-            with self._compile_lock:
-                if not self._tried:
-                    t0 = time.perf_counter()
-                    try:
-                        self._aot = self._jit.lower(*args).compile()
-                    except Exception:
-                        self._aot = None  # jit path compiles below
-                    else:
-                        seconds = time.perf_counter() - t0
-                        flops, out_bytes = _compile_cost(self._aot)
-                        _telemetry.record_compile(self._kind, seconds,
-                                                  flops=flops,
-                                                  out_bytes=out_bytes,
-                                                  meta=self._meta)
-                    self._tried = True
+            self.warm(*args)
         if self._aot is not None:
             try:
                 return self._aot(*args)

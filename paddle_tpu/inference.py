@@ -21,7 +21,7 @@ import numpy as np
 
 from . import io
 from .core import lowering
-from .core.executor import Executor, Scope, scope_guard
+from .core.executor import Executor, Scope, _JitDispatch, scope_guard
 from .core.ir import normalize_dtype
 from .core.places import CPUPlace, Place, TPUPlace, default_place
 
@@ -39,6 +39,7 @@ class AnalysisConfig:
         self._enable_profile = False
         self._aot = False               # ahead-of-time compile at load
         self._native_engine = False     # C++ interpreter (capi) backend
+        self._bucketing = None          # serving.bucketing.BucketPolicy
 
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
         self._use_tpu = True  # accelerator = TPU in this framework
@@ -58,6 +59,19 @@ class AnalysisConfig:
 
     def enable_aot(self):
         self._aot = True
+
+    def enable_bucketing(self, max_batch: int = 64, buckets=None):
+        """Round every Run() batch up to the nearest configured bucket
+        (powers of two up to `max_batch` by default, or an explicit
+        `buckets` sequence), padding feeds and slicing outputs back to
+        the true batch — so bs=1..64 traffic hits at most log2(64)+1
+        compiled signatures instead of up to 64. Batches larger than
+        the biggest bucket fall back to exact-shape compilation. See
+        SERVING.md §Bucket policy."""
+        from .serving.bucketing import BucketPolicy
+
+        self._bucketing = BucketPolicy(max_batch=max_batch,
+                                       buckets=buckets)
 
     def enable_native_engine(self):
         """Serve through the C++ interpreter (native/src/predictor.cc) —
@@ -121,6 +135,35 @@ class Predictor:
                              for v in self._fetch_vars]
         self._program._is_test = True
         self._cache: Dict = {}
+        # which fetches carry the batch dim (declared leading dim is
+        # dynamic): bucketing must never slice an output whose fixed
+        # leading dim merely coincides with the bucket size. None =
+        # shape undeclared → fall back to the runtime-shape heuristic.
+        self._fetch_batched: Dict[str, Optional[bool]] = {}
+        for name in self._fetch_names:
+            self._fetch_batched[name] = self._var_batched(name)
+        # feeds get the symmetric treatment: a feed whose declared
+        # leading dim is fixed (lookup tables, masks) must be neither
+        # counted toward the batch size nor padded
+        self._feed_batched: Dict[str, Optional[bool]] = {
+            name: self._var_batched(name) for name in self._feed_names}
+
+    def _var_batched(self, name: str) -> Optional[bool]:
+        """Does `name`'s declared leading dim carry the batch (-1/0 =
+        dynamic)? None when the shape is undeclared."""
+        var = self._find_var(name)
+        shape = var.shape if var is not None else None
+        if shape is None:
+            return None
+        return bool(shape) and shape[0] in (-1, 0)
+
+    def _find_var(self, name: str):
+        """First match across blocks (a sub-block local must not shadow
+        the outer var — same rule the native path applies to feeds)."""
+        for b in self._program.desc.blocks:
+            if name in b.vars:
+                return b.vars[name]
+        return None
 
     def get_input_names(self) -> List[str]:
         return list(self._feed_names)
@@ -147,14 +190,52 @@ class Predictor:
                         val = self._scope.find_var(name)
                         if val is not None:
                             state[name] = jnp.asarray(val)
-            jitted = jax.jit(fwd)
+            # _JitDispatch: compiles land in paddle_tpu_compile_seconds
+            # {kind="infer"} and the `compile` event log, so a serving
+            # deployment can assert its bucket set stays closed
+            jitted = _JitDispatch(jax.jit(fwd), "infer", meta={
+                "signature": ",".join(f"{n}:{list(s)}" for n, s, _ in sig)})
             if self.config._aot:
                 shapes = {n: jax.ShapeDtypeStruct(s, np.dtype(d))
                           for n, s, d in sig}
-                jitted = jitted.lower(shapes, state).compile()
+                jitted.warm(shapes, state)
             step = (jitted, state)
             self._cache[sig] = step
         return step
+
+    def _feed_sig(self, batch_size: int):
+        """Signature tuple for the declared feed shapes at `batch_size`
+        (leading dynamic dim replaced; any other dynamic dim is an
+        error — such a model must be warmed by running a real batch)."""
+        entries = []
+        for name in self._feed_names:
+            var = self._find_var(name)
+            if var is None or var.shape is None:
+                raise ValueError(f"feed '{name}' has no declared shape; "
+                                 "cannot warm ahead of traffic")
+            shape = [int(d) for d in var.shape]
+            if shape and shape[0] in (-1, 0):
+                shape[0] = int(batch_size)
+            if any(d < 1 for d in shape):
+                raise ValueError(
+                    f"feed '{name}' has non-batch dynamic dims "
+                    f"{tuple(var.shape)}; warm it with a real batch")
+            dtype = np.dtype(normalize_dtype(var.dtype))
+            entries.append((name, tuple(shape), str(dtype)))
+        return tuple(sorted(entries))
+
+    def warm(self, batch_size: int) -> bool:
+        """AOT-compile the signature for `batch_size` without executing
+        — a bucketed serving deployment warms every configured bucket at
+        startup so no live request pays a compile. No-op on the native
+        engine (no XLA). Returns whether an AOT executable is ready."""
+        if self._native is not None:
+            return False
+        sig = self._feed_sig(batch_size)
+        jitted, state = self._compiled(sig)
+        shapes = {n: jax.ShapeDtypeStruct(s, np.dtype(d))
+                  for n, s, d in sig}
+        return jitted.warm(shapes, state)
 
     def run(self, inputs: Sequence[PaddleTensor]) -> List[PaddleTensor]:
         if self._native is not None:
@@ -172,21 +253,43 @@ class Predictor:
         feeds = {}
         for i, t in enumerate(inputs):
             name = t.name or self._feed_names[i]
-            want = None
-            for b in self._program.desc.blocks:
-                if name in b.vars:
-                    want = np.dtype(normalize_dtype(b.vars[name].dtype))
-                    break
+            var = self._find_var(name)
+            want = np.dtype(normalize_dtype(var.dtype)) \
+                if var is not None else None
             arr = np.asarray(t.data)
             if want is not None and arr.dtype != want:
                 arr = arr.astype(want)
             feeds[name] = arr
+        # opt-in shape bucketing: pad the batch up to its bucket so the
+        # jit cache stays bounded by the bucket set, then slice outputs
+        # back to the true batch (rows whose leading dim is the bucket)
+        policy = self.config._bucketing
+        true_n = bucket = None
+        if policy is not None:
+            from .serving.bucketing import common_batch
+
+            batched = {k: v for k, v in feeds.items()
+                       if self._feed_batched.get(k) is not False}
+            n = common_batch(batched) if batched else None
+            if n:
+                b = policy.bucket_for(n)
+                if b is not None and b != n:
+                    feeds = {k: (policy.pad_batch(v, b) if k in batched
+                                 else v)
+                             for k, v in feeds.items()}
+                    true_n, bucket = n, b
         sig = tuple(sorted((n, tuple(v.shape), str(v.dtype))
                            for n, v in feeds.items()))
         jitted, state = self._compiled(sig)
         outs = jitted({n: jnp.asarray(v) for n, v in feeds.items()}, state)
-        return [PaddleTensor(np.asarray(o), name=n)
-                for o, n in zip(outs, self._fetch_names)]
+        results = []
+        for o, name in zip(outs, self._fetch_names):
+            a = np.asarray(o)
+            if true_n is not None and a.ndim and a.shape[0] == bucket \
+                    and self._fetch_batched.get(name) is not False:
+                a = a[:true_n]
+            results.append(PaddleTensor(a, name=name))
+        return results
 
     # numpy-dict convenience API
     def predict(self, **feeds) -> Dict[str, np.ndarray]:

@@ -21,6 +21,10 @@ user-facing guide):
                  (PADDLE_TPU_EVENT_LOG).
 - httpd.py     — stdlib daemon thread serving /metrics, /healthz and
                  /events?n=K live (PADDLE_TPU_METRICS_PORT).
+- httpbase.py  — shared stdlib-HTTP lifecycle (quiet handler, locked
+                 idempotent start/stop, failed-bind caching, atexit);
+                 also the base of the serving frontend
+                 (paddle_tpu/serving/httpd.py, see SERVING.md).
 
 `tools/obsdump.py` pretty-prints dumps, tails event logs, and rebuilds
 traces offline.

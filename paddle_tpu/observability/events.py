@@ -37,7 +37,9 @@ __all__ = ["emit", "recent", "clear", "log_path", "read_jsonl",
            "MAX_EVENTS", "KINDS"]
 
 # Known event kinds (emitters may add more; these are the documented core).
-KINDS = ("compile", "step_summary", "anomaly", "checkpoint")
+# serve_start/serve_stop bracket a serving.Server's lifetime (SERVING.md).
+KINDS = ("compile", "step_summary", "anomaly", "checkpoint",
+         "serve_start", "serve_stop")
 
 # Ring bound: a week-long run emitting a compile+summary event per minute
 # stays far under this; anomaly storms get truncated to the latest window.

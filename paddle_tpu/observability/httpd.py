@@ -19,50 +19,32 @@ port. `maybe_start_http_server()` is called from the telemetry hot-path
 helpers, so setting the env var before training is enough — nothing is
 started at import time (guarded by tests/test_obs_import_cost.py).
 
-Stdlib-only module; binds 127.0.0.1 by default (override with
-PADDLE_TPU_METRICS_HOST) — exposing process internals on all interfaces
-is an operator decision, not a default.
+Server lifecycle (locked idempotent start/stop, failed-bind caching,
+atexit cleanup, 127.0.0.1 default bind overridable with
+PADDLE_TPU_METRICS_HOST) lives in the shared `httpbase.HTTPServerHandle`
+— the serving frontend (`paddle_tpu/serving/httpd.py`) reuses the same
+base.
 """
 
 from __future__ import annotations
 
 import json
-import os
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 from . import events as _events
 from . import health as _health
+from . import httpbase as _base
 from . import metrics as _m
 
 __all__ = ["start_http_server", "maybe_start_http_server",
            "stop_http_server", "server_port"]
 
-_lock = threading.Lock()
-_server: Optional[ThreadingHTTPServer] = None
-_thread: Optional[threading.Thread] = None
-_atexit_registered = False
-_start_failed = False  # remember a failed env-gated bind: the hot path
-# calls maybe_start every step and must not retry the syscall forever
-
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(_base.QuietHandler):
     server_version = "paddle-tpu-metrics"
-
-    def log_message(self, format, *args):  # noqa: A002 - stdlib signature
-        pass  # scrapes every few seconds must not spam stderr
-
-    def _reply(self, code: int, content_type: str, body: str):
-        data = body.encode("utf-8")
-        self.send_response(code)
-        self.send_header("Content-Type", content_type)
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        self.wfile.write(data)
 
     def do_GET(self):  # noqa: N802 - stdlib naming
         try:
@@ -90,77 +72,33 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(404, "text/plain",
                             "not found; routes: /metrics /healthz "
                             "/events?n=K\n")
-        except (BrokenPipeError, ConnectionResetError):
+        except _base.CLIENT_GONE:
             pass  # scraper hung up mid-reply
+
+
+_handle = _base.HTTPServerHandle(
+    _Handler, thread_name="paddle-tpu-metrics-http",
+    port_env="PADDLE_TPU_METRICS_PORT", host_env="PADDLE_TPU_METRICS_HOST")
 
 
 def server_port() -> Optional[int]:
     """Bound port of the running server, or None when no server is up."""
-    with _lock:
-        if _server is None:
-            return None
-        return _server.server_address[1]
+    return _handle.port()
 
 
 def start_http_server(port: int = 0, host: Optional[str] = None) -> int:
     """Start the daemon serving thread (idempotent: a second call returns
     the already-bound port). port=0 binds an ephemeral port. Returns the
     actual bound port."""
-    global _server, _thread, _atexit_registered
-    with _lock:
-        if _server is not None:
-            return _server.server_address[1]
-        host = host or os.environ.get("PADDLE_TPU_METRICS_HOST",
-                                      "127.0.0.1")
-        srv = ThreadingHTTPServer((host, int(port)), _Handler)
-        srv.daemon_threads = True
-        t = threading.Thread(target=srv.serve_forever,
-                             name="paddle-tpu-metrics-http", daemon=True)
-        t.start()
-        _server, _thread = srv, t
-        if not _atexit_registered:
-            import atexit
-
-            atexit.register(stop_http_server)
-            _atexit_registered = True
-        return srv.server_address[1]
+    return _handle.start(port, host)
 
 
 def maybe_start_http_server() -> bool:
     """Start the server iff PADDLE_TPU_METRICS_PORT is set and none is
     running. Called from the telemetry hot-path helpers; the unset case
     is a single env dict lookup."""
-    global _start_failed
-    raw = os.environ.get("PADDLE_TPU_METRICS_PORT")
-    if not raw:
-        return False
-    with _lock:
-        if _server is not None:
-            return True
-        if _start_failed:
-            return False  # port was taken once; don't re-bind every step
-    try:
-        port = int(raw)
-    except ValueError:
-        return False  # malformed env must not kill the hot path
-    if port < 0:
-        return False
-    try:
-        start_http_server(port)
-    except OSError:
-        _start_failed = True  # cleared by stop_http_server()
-        return False  # port taken: keep training, scraping is best-effort
-    return True
+    return _handle.maybe_start()
 
 
 def stop_http_server():
-    global _server, _thread, _start_failed
-    with _lock:
-        srv, _server = _server, None
-        t, _thread = _thread, None
-        _start_failed = False
-    if srv is not None:
-        srv.shutdown()
-        srv.server_close()
-    if t is not None and t.is_alive():
-        t.join(timeout=5)
+    _handle.stop()

@@ -25,6 +25,7 @@ __all__ = [
     "default_registry", "counter", "gauge", "histogram",
     "snapshot", "render_prometheus", "dump", "reset",
     "maybe_start_dump_thread", "stop_dump_thread",
+    "exponential_buckets",
 ]
 
 # Seconds-scale latency buckets: 50us .. 60s covers a jit dispatch on a
@@ -33,6 +34,19 @@ DEFAULT_BUCKETS = (
     50e-6, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2,
     5e-2, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
+
+
+def exponential_buckets(start: float, factor: float, count: int):
+    """Prometheus-style bucket helper: `count` upper bounds starting at
+    `start`, each `factor` x the previous — e.g. (1, 2, 8) → batch-size
+    buckets 1,2,4,...,128 for the serving batch histogram."""
+    if start <= 0 or factor <= 1 or count < 1:
+        raise ValueError("need start > 0, factor > 1, count >= 1")
+    out, v = [], float(start)
+    for _ in range(count):
+        out.append(v)
+        v *= factor
+    return tuple(out)
 
 
 def _label_key(labelnames: Sequence[str], labels: Dict[str, str]):

@@ -1,0 +1,38 @@
+"""Dynamic-batching TPU inference serving (SERVING.md is the guide).
+
+The synchronous `inference.Predictor` is a library; this package is the
+deployment surface in front of it:
+
+- bucketing.py — shape-bucket policy (powers-of-two batch buckets with
+                 pad/slice helpers) shared with the Predictor, so any
+                 batch size maps onto a small AOT-warmable signature
+                 set.
+- batcher.py   — bounded request queue + coalescing thread: largest
+                 fitting bucket under a max_wait_ms deadline,
+                 per-request timeouts, reject-not-block admission
+                 control, graceful drain.
+- engine.py    — Predictor wrapped with bucket-aware dispatch, AOT
+                 warmup of every bucket at startup, per-bucket
+                 latency/count accounting.
+- httpd.py     — JSON-over-HTTP frontend (POST /v1/predict,
+                 GET /v1/status) on the shared observability HTTP base.
+
+Telemetry flows through the PR 1/2 observability stack: queue depth,
+batch-size/queue-wait/end-to-end histograms, reject/timeout counters,
+per-bucket compile events — all visible on the /metrics endpoint and
+the JSONL event log. `tools/serve_bench.py` load-tests the whole path.
+"""
+
+from .bucketing import BucketPolicy, common_batch  # noqa: F401
+from .batcher import (  # noqa: F401
+    Batcher, EngineError, QueueFullError, RequestTimeout, ServerClosed,
+)
+from .engine import Engine, ServingConfig  # noqa: F401
+from .httpd import Server  # noqa: F401
+
+__all__ = [
+    "BucketPolicy", "common_batch",
+    "Batcher", "EngineError", "QueueFullError", "RequestTimeout",
+    "ServerClosed",
+    "Engine", "ServingConfig", "Server",
+]

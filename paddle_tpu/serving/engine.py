@@ -1,0 +1,150 @@
+"""Bucket-aware serving engine: the Predictor wrapped for batch traffic.
+
+Owns the BucketPolicy, builds the Predictor with bucketing enabled (so
+every dispatched batch lands on one of the configured signatures),
+AOT-warms every bucket at startup (no live request pays an XLA
+compile), and accounts per-bucket dispatch latency and batch counts in
+the metrics registry. Compile visibility itself comes from the PR 2
+`_JitDispatch` instrumentation inside the Predictor: each bucket's
+compile appears in `paddle_tpu_compile_seconds{kind="infer"}` and as a
+`compile` event, which is what lets a deployment assert its signature
+set stays closed under live traffic.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from ..inference import AnalysisConfig, Predictor, create_paddle_predictor
+from ..observability import metrics as _m
+from .bucketing import BucketPolicy, common_batch
+
+__all__ = ["ServingConfig", "Engine"]
+
+BUCKET_SECONDS = _m.histogram(
+    "paddle_tpu_serving_bucket_seconds",
+    "Engine dispatch wall time per bucket (pad + run + slice)",
+    labelnames=("bucket",))
+BATCHES = _m.counter(
+    "paddle_tpu_serving_batches_total",
+    "Dispatched batches per bucket", labelnames=("bucket",))
+PAD_ROWS = _m.counter(
+    "paddle_tpu_serving_pad_rows_total",
+    "Padding rows added by bucketing (wasted accelerator rows)")
+WARMUP_SECONDS = _m.gauge(
+    "paddle_tpu_serving_warmup_seconds",
+    "Wall seconds the last warmup spent compiling all buckets")
+
+
+class ServingConfig:
+    """Knobs for the dynamic-batching server (full reference in
+    SERVING.md §Configuration)."""
+
+    def __init__(self, model_dir: Optional[str] = None, *,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_batch: int = 64,
+                 max_queue: int = 128,
+                 max_wait_ms: float = 5.0,
+                 timeout_s: float = 30.0,
+                 warmup: bool = True,
+                 aot: bool = True,
+                 use_tpu: bool = True,
+                 device_id: int = 0,
+                 host: Optional[str] = None,
+                 port: int = 0):
+        self.model_dir = model_dir
+        self.buckets = tuple(buckets) if buckets is not None else None
+        self.max_batch = int(max_batch)
+        self.max_queue = int(max_queue)
+        self.max_wait_ms = float(max_wait_ms)
+        self.timeout_s = float(timeout_s)
+        self.warmup = bool(warmup)
+        self.aot = bool(aot)
+        self.use_tpu = bool(use_tpu)
+        self.device_id = int(device_id)
+        self.host = host
+        self.port = int(port)
+
+
+class Engine:
+    """Predictor + BucketPolicy with warmup and per-bucket accounting.
+    `run_batch` is the callable the Batcher dispatches to; it is also
+    safe to call directly (single-caller deployments that want bucketing
+    without the queue)."""
+
+    def __init__(self, config: ServingConfig,
+                 predictor: Optional[Predictor] = None):
+        self.config = config
+        self.policy = BucketPolicy(max_batch=config.max_batch,
+                                   buckets=config.buckets)
+        if predictor is None:
+            acfg = AnalysisConfig(config.model_dir)
+            if not config.use_tpu:
+                acfg.disable_gpu()
+            acfg._device_id = config.device_id
+            if config.aot:
+                acfg.enable_aot()
+            acfg.enable_bucketing(buckets=self.policy.buckets)
+            predictor = create_paddle_predictor(acfg)
+        else:
+            # an externally built predictor must agree on the signature
+            # set or live traffic would compile off-bucket shapes that
+            # warmup never touched — the engine's policy wins
+            predictor.config._bucketing = self.policy
+        self._pred = predictor
+        self.warmed = False
+
+    def output_batched(self, name: str) -> Optional[bool]:
+        """Does fetch `name` carry the batch dim? From the Predictor's
+        declared shapes (None when unknown — e.g. the native engine —
+        letting the batcher fall back to its shape heuristic)."""
+        return getattr(self._pred, "_fetch_batched", {}).get(name)
+
+    def warmup(self) -> int:
+        """AOT-compile every configured bucket; returns how many bucket
+        signatures are ready. Idempotent (per-bucket compiles are cached
+        by the Predictor)."""
+        t0 = time.perf_counter()
+        ready = 0
+        for b in self.policy.buckets:
+            try:
+                if self._pred.warm(b):
+                    ready += 1
+            except ValueError:
+                # dynamic non-batch dims: the first live batch per
+                # bucket compiles instead; serving still works
+                break
+        WARMUP_SECONDS.set(time.perf_counter() - t0)
+        self.warmed = True
+        return ready
+
+    def run_batch(self, feeds: Dict[str, np.ndarray]
+                  ) -> Dict[str, np.ndarray]:
+        """One bucket-shaped dispatch: the Predictor pads to the bucket,
+        runs the compiled signature, and slices back; this layer adds
+        the per-bucket latency/count/padding accounting."""
+        n = common_batch(feeds)
+        if not n:
+            raise ValueError("feeds must share a leading batch dim >= 1")
+        bucket = self.policy.bucket_for(n) or n
+        t0 = time.perf_counter()
+        out = self._pred.predict(**feeds)
+        BUCKET_SECONDS.observe(time.perf_counter() - t0,
+                               bucket=str(bucket))
+        BATCHES.inc(bucket=str(bucket))
+        if bucket != n:
+            PAD_ROWS.inc(bucket - n)
+        return out
+
+    def status(self) -> Dict:
+        return {
+            "buckets": [int(b) for b in self.policy.buckets],
+            "warmed": self.warmed,
+            "batches": {str(b): BATCHES.value(bucket=str(b))
+                        for b in self.policy.buckets},
+            "feeds": self._pred.get_input_names(),
+            "fetches": self._pred.get_output_names(),
+        }

@@ -1,0 +1,239 @@
+"""JSON-over-HTTP serving frontend + the `Server` that ties the
+subsystem together (engine + batcher + HTTP, one object to start/stop).
+
+Routes (schema documented in SERVING.md §HTTP API):
+
+  POST /v1/predict   {"feeds": {name: nested-list}, "timeout_s": opt}
+                     → 200 {"outputs": {name: nested-list}, "batch": n}
+                     → 400 malformed request / bad shapes
+                     → 503 queue full or draining (admission control —
+                       the client should back off or retry elsewhere)
+                     → 504 request missed its deadline
+                     → 500 engine error
+  GET  /v1/status    queue depth, buckets, request/batch counters,
+                     uptime — the operator's one-look view
+  GET  /v1/healthz   liveness: 200 once started (the process-wide
+                     anomaly-aware probe stays on the observability
+                     server, PADDLE_TPU_METRICS_PORT)
+
+Built on `observability.httpbase` — same silent logging, locked
+idempotent start/stop, daemon threading, and atexit discipline as the
+/metrics endpoint. Feed dtypes need not be declared client-side: the
+Predictor casts to the model's declared feed dtypes, so plain JSON
+numbers round-trip.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, Optional
+from urllib.parse import urlparse
+
+import numpy as np
+
+from ..observability import events as _events
+from ..observability import httpbase as _base
+from ..observability.metrics import _json_safe
+from .batcher import (Batcher, EngineError, QueueFullError,
+                      RequestTimeout, ServerClosed)
+from .engine import Engine, ServingConfig
+
+__all__ = ["Server"]
+
+
+class _ServingHandler(_base.QuietHandler):
+    server_version = "paddle-tpu-serving"
+    serving: "Server" = None  # bound per-Server via a subclass
+
+    def _json_reply(self, code: int, payload: Dict):
+        # strict-JSON discipline (same as metrics.dump): a model output
+        # containing NaN/Inf must not make json.dumps emit bare NaN
+        # tokens that RFC-8259 clients reject — non-finite floats become
+        # strings ("nan"/"inf"/"-inf"), documented in SERVING.md
+        self._reply(code, "application/json",
+                    json.dumps(_json_safe(payload)) + "\n")
+
+    def do_GET(self):  # noqa: N802 - stdlib naming
+        try:
+            path = urlparse(self.path).path
+            if path == "/v1/status":
+                self._json_reply(200, self.serving.status())
+            elif path == "/v1/healthz":
+                self._json_reply(200, {"status": "ok"})
+            else:
+                self._reply(404, "text/plain",
+                            "not found; routes: POST /v1/predict, "
+                            "GET /v1/status /v1/healthz\n")
+        except _base.CLIENT_GONE:
+            pass
+
+    def do_POST(self):  # noqa: N802 - stdlib naming
+        try:
+            path = urlparse(self.path).path
+            if path != "/v1/predict":
+                self._reply(404, "text/plain",
+                            "not found; POST route: /v1/predict\n")
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                payload = json.loads(self.rfile.read(length))
+            except (ValueError, TypeError):
+                self._json_reply(400, {"error": "body must be JSON"})
+                return
+            feeds = payload.get("feeds") if isinstance(payload, dict) \
+                else None
+            if not isinstance(feeds, dict) or not feeds:
+                self._json_reply(400, {"error":
+                                       'missing/empty "feeds" object'})
+                return
+            try:
+                arrays = {str(k): np.asarray(v) for k, v in feeds.items()}
+            except (ValueError, TypeError):
+                self._json_reply(400, {"error": "feeds must be rectangular "
+                                               "numeric arrays"})
+                return
+            timeout = payload.get("timeout_s")
+            try:
+                outs = self.serving.submit(arrays, timeout_s=timeout)
+            except (QueueFullError, ServerClosed) as e:
+                self._json_reply(503, {"error": str(e)})
+                return
+            except RequestTimeout as e:
+                self._json_reply(504, {"error": str(e)})
+                return
+            except EngineError as e:
+                # model/engine failure is the server's fault — a 400
+                # would make clients retry a request that cannot succeed
+                self._json_reply(500, {"error": str(e)})
+                return
+            except ValueError as e:
+                # pre-enqueue validation (empty/ragged/oversize feeds)
+                self._json_reply(400, {"error": str(e)})
+                return
+            except Exception as e:
+                self._json_reply(500, {"error": f"{type(e).__name__}: {e}"})
+                return
+            batch = next(iter(arrays.values())).shape[0] \
+                if next(iter(arrays.values())).ndim else 1
+            self._json_reply(200, {
+                "outputs": {k: np.asarray(v).tolist()
+                            for k, v in outs.items()},
+                "batch": int(batch)})
+        except _base.CLIENT_GONE:
+            pass
+
+
+class Server:
+    """The dynamic-batching TPU inference server: build with a
+    ServingConfig (or hand in an existing Predictor), `start()` to warm
+    the buckets and begin listening, `stop()` to drain and shut down.
+    Both are idempotent; stop is also registered atexit so tests and
+    crashing deployments never leak the listener or batcher thread."""
+
+    def __init__(self, config: ServingConfig,
+                 predictor=None):
+        self.config = config
+        self._engine = Engine(config, predictor=predictor)
+        self._batcher: Optional[Batcher] = None
+        handler = type("_BoundServingHandler", (_ServingHandler,),
+                       {"serving": self})
+        self._http = _base.HTTPServerHandle(
+            handler, thread_name="paddle-tpu-serving-http")
+        self._lock = threading.Lock()
+        self._started_t: Optional[float] = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self, port: Optional[int] = None) -> int:
+        """Warm the buckets, start the batcher and the HTTP listener.
+        Returns the bound port; a second call returns it unchanged."""
+        with self._lock:
+            if self._started_t is not None:
+                return self._http.port()
+            if self.config.warmup:
+                self._engine.warmup()
+            batcher = Batcher(
+                self._engine.run_batch, self._engine.policy,
+                max_queue=self.config.max_queue,
+                max_wait_ms=self.config.max_wait_ms,
+                timeout_s=self.config.timeout_s,
+                output_batched=self._engine.output_batched)
+            try:
+                bound = self._http.start(
+                    self.config.port if port is None else port,
+                    host=self.config.host)
+            except BaseException:
+                batcher.stop()  # a failed bind must not leak the thread
+                raise
+            self._batcher = batcher
+            self._started_t = time.monotonic()
+            import atexit
+
+            atexit.register(self.stop)
+            _events.emit("serve_start", port=bound,
+                         buckets=list(self._engine.policy.buckets),
+                         max_queue=self.config.max_queue,
+                         max_wait_ms=self.config.max_wait_ms)
+            return bound
+
+    def stop(self):
+        """Stop accepting (listener down first), drain the batcher so
+        in-flight requests finish, then emit `serve_stop`. Idempotent;
+        unregisters its atexit hook so stopped servers are collectable."""
+        # the whole teardown runs under the lock so a concurrent start()
+        # cannot interleave (and e.g. have its fresh batcher killed or
+        # its "bound" port be the one being closed)
+        with self._lock:
+            started = self._started_t is not None
+            self._started_t = None
+            import atexit
+
+            atexit.unregister(self.stop)
+            self._http.stop()
+            if self._batcher is not None:
+                self._batcher.stop()
+            if not started:
+                return  # safety path: a start() that raised mid-way
+            counts = self._counts()
+        _events.emit("serve_stop", ok=counts["ok"],
+                     rejected=counts["rejected"],
+                     timeout=counts["timeout"])
+
+    def _counts(self) -> Dict[str, int]:
+        """THIS server's outcomes (the Prometheus counter is process-
+        global; the batcher keeps per-instance counts)."""
+        b = self._batcher
+        return b.outcome_counts() if b is not None else \
+            {o: 0 for o in ("ok", "rejected", "timeout", "error")}
+
+    def port(self) -> Optional[int]:
+        return self._http.port()
+
+    # -- request path --------------------------------------------------
+
+    def submit(self, feeds: Dict[str, np.ndarray],
+               timeout_s: Optional[float] = None) -> Dict[str, np.ndarray]:
+        """In-process entry to the batched path (the HTTP handler and
+        embedded deployments share it)."""
+        batcher = self._batcher
+        if batcher is None:
+            raise ServerClosed("server not started")
+        return batcher.submit(feeds, timeout_s=timeout_s)
+
+    def status(self) -> Dict:
+        up = None if self._started_t is None \
+            else round(time.monotonic() - self._started_t, 3)
+        batcher = self._batcher
+        st = {
+            "uptime_s": up,
+            "port": self._http.port(),
+            "queue_depth": batcher.depth() if batcher else 0,
+            "max_queue": self.config.max_queue,
+            "max_wait_ms": self.config.max_wait_ms,
+            "timeout_s": self.config.timeout_s,
+            "requests": self._counts(),
+        }
+        st.update(self._engine.status())
+        return st

@@ -1,0 +1,196 @@
+"""Open-loop Poisson load generator for the serving subsystem.
+
+Starts a `serving.Server` on a LeNet-sized MLP, fires requests with
+exponential inter-arrival times at a fixed offered rate (open loop:
+arrivals do not wait for completions, so overload shows up as rejects
+and latency, not as a silently throttled client), and reports
+INFER_BENCH-style JSON lines: p50/p99 end-to-end latency, achieved
+throughput, and the reject rate.
+
+Run:  python tools/serve_bench.py [--rate 200] [--duration 10]
+      [--max-batch 16] [--max-wait-ms 5] [--max-queue 128] [--batch 1]
+      [--smoke]
+
+--smoke is the tier-1-safe mode the test suite invokes (CPU backend,
+~1.5 s of traffic, small model) — it validates the full HTTP path and
+the report schema, not absolute numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+
+def _build_args():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="offered load, requests/second")
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="seconds of traffic")
+    ap.add_argument("--batch", type=int, default=1,
+                    help="rows per request")
+    ap.add_argument("--max-batch", type=int, default=16)
+    ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    ap.add_argument("--max-queue", type=int, default=128)
+    ap.add_argument("--timeout-s", type=float, default=10.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU run for CI (overrides rate/duration)")
+    return ap.parse_args()
+
+
+def _save_model(tmpdir: str):
+    """LeNet-sized MLP (784→128→10) saved as an inference model."""
+    import numpy as np
+
+    import paddle_tpu as pt
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[784], dtype="float32")
+        h = pt.layers.fc(input=x, size=128, act="relu")
+        pred = pt.layers.fc(input=h, size=10, act="softmax")
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    pt.io.save_inference_model(tmpdir, ["x"], [pred], exe,
+                               main_program=main)
+    return np.random.RandomState(0).rand(64, 784).astype("float32")
+
+
+def _percentile(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(q / 100.0 * (len(xs) - 1))))
+    return xs[i]
+
+
+def run_bench(args) -> int:
+    import random
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from paddle_tpu.serving import ServingConfig, Server
+
+    tmpdir = tempfile.mkdtemp(prefix="serve_bench_")
+    probe = _save_model(tmpdir)
+    cfg = ServingConfig(
+        tmpdir, max_batch=args.max_batch, max_queue=args.max_queue,
+        max_wait_ms=args.max_wait_ms, timeout_s=args.timeout_s)
+    server = Server(cfg)
+    port = server.start(0)
+    url = f"http://127.0.0.1:{port}/v1/predict"
+
+    rng = random.Random(args.seed)
+    n_requests = max(1, int(args.rate * args.duration))
+    arrivals, t = [], 0.0
+    for _ in range(n_requests):
+        t += rng.expovariate(args.rate)
+        arrivals.append(t)
+
+    lock = threading.Lock()
+    oks, rejects, timeouts, errors = [], 0, 0, 0
+    body = json.dumps(
+        {"feeds": {"x": probe[:args.batch].tolist()}}).encode()
+
+    def fire():
+        nonlocal rejects, timeouts, errors
+        t0 = time.perf_counter()
+        try:
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type":
+                                         "application/json"})
+            with urllib.request.urlopen(req, timeout=args.timeout_s + 5):
+                pass
+            dt = (time.perf_counter() - t0) * 1000
+            with lock:
+                oks.append(dt)
+        except urllib.error.HTTPError as e:
+            with lock:
+                if e.code == 503:
+                    rejects += 1
+                elif e.code == 504:
+                    timeouts += 1
+                else:
+                    errors += 1
+        except Exception:
+            with lock:
+                errors += 1
+
+    # bound in-flight senders: unbounded per-request threads would
+    # distort the latencies being measured (thread-stack/scheduler
+    # pressure) and can hit RLIMIT under overload. At the cap the
+    # generator degrades toward closed-loop — visible as completed <
+    # requests in the report rather than a silent distortion.
+    cap = threading.Semaphore(max(64, 4 * args.max_queue))
+
+    def fire_capped():
+        try:
+            fire()
+        finally:
+            cap.release()
+
+    threads = []
+    start = time.perf_counter()
+    for at in arrivals:
+        delay = at - (time.perf_counter() - start)
+        if delay > 0:
+            time.sleep(delay)
+        cap.acquire()
+        th = threading.Thread(target=fire_capped, daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=args.timeout_s + 10)
+    wall = time.perf_counter() - start
+    server.stop()
+
+    done = len(oks) + rejects + timeouts + errors
+    detail = {
+        "rate_offered_rps": args.rate, "duration_s": args.duration,
+        "requests": n_requests, "completed": done, "ok": len(oks),
+        "rejected": rejects, "timeout": timeouts, "error": errors,
+        "rows_per_request": args.batch, "max_batch": args.max_batch,
+        "max_wait_ms": args.max_wait_ms, "max_queue": args.max_queue,
+        "platform": jax.devices()[0].platform, "smoke": bool(args.smoke),
+    }
+    for metric, value, unit in (
+            ("serving_p50_latency_ms", _percentile(oks, 50), "ms"),
+            ("serving_p99_latency_ms", _percentile(oks, 99), "ms"),
+            ("serving_throughput_rps",
+             round(len(oks) * args.batch / wall, 3) if wall > 0 else 0,
+             "req_rows/s"),
+            ("serving_reject_rate",
+             round(rejects / max(1, done), 4), "fraction")):
+        print(json.dumps({
+            "metric": metric,
+            "value": round(value, 3) if isinstance(value, float) else value,
+            "unit": unit, "detail": detail}), flush=True)
+    return 0 if (len(oks) > 0 and errors == 0) else 1
+
+
+def main() -> int:
+    args = _build_args()
+    if args.smoke:
+        # tier-1 safety: tiny, CPU-only, deterministic-ish
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        args.rate, args.duration = 80.0, 1.5
+        args.max_batch, args.max_queue = 8, 64
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.core.tpu_lock import tpu_singleflight
+
+    with tpu_singleflight():  # one real chip: serialize vs bench/tools
+        return run_bench(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
