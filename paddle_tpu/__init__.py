@@ -56,6 +56,7 @@ from .reader import DataLoader, PyReader
 from . import dygraph
 from .dygraph.base import enable_dygraph, disable_dygraph
 from . import observability
+from . import resilience
 from . import profiler
 from . import amp
 from . import param_attr

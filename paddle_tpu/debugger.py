@@ -65,9 +65,11 @@ def block_to_dot(block, skip_vars: Sequence[str] = (),
 def draw_block_graphviz(block, highlights: Optional[Sequence[str]] = None,
                         path: str = "/tmp/temp.dot"):
     """reference: debugger.py draw_block_graphviz — write DOT to `path`
-    (render with `dot -Tpng`)."""
-    with open(path, "w") as f:
-        f.write(block_to_dot(block, highlight=highlights or ()))
+    (render with `dot -Tpng`; atomic so a half-written DOT never
+    reaches the renderer)."""
+    from .resilience import atomic as _atomic
+
+    _atomic.write_text(path, block_to_dot(block, highlight=highlights or ()))
     return path
 
 

@@ -9,6 +9,19 @@ JAX model), same env contract so fleet.PaddleCloudRoleMaker works unchanged.
 `--backend cpu --nproc_per_node N` forces single-chip-per-process CPU
 processes for localhost cluster simulation (the test_dist_base pattern).
 
+Fault tolerance (RESILIENCE.md): a rank exiting with
+PREEMPT_EXIT_CODE (75) is a *preemption* — it already wrote its final
+checkpoint, so the launcher tears the group down and propagates 75 for
+the cluster scheduler to reschedule the whole job. Any other nonzero
+exit is a *crash*: the WHOLE GROUP is torn down (surviving ranks would
+otherwise hang in collectives waiting for the dead peer) and, while the
+`--max_restarts` budget lasts, respawned together after a capped
+exponential backoff — gang restart, the torchrun-elastic model, which
+is safe for collective jobs because no rank ever tries to rejoin a
+live ring. Workers resume from their last committed checkpoint
+(resilience.CheckpointManager), so a restart costs only the steps since
+it. `--max_restarts 0` restores fail-fast.
+
 Usage:
     python -m paddle_tpu.distributed.launch --nproc_per_node 2 train.py ...
 """
@@ -23,6 +36,8 @@ import subprocess
 import sys
 import time
 from typing import List
+
+from ..resilience.preemption import PREEMPT_EXIT_CODE
 
 
 def _free_ports(n: int) -> List[int]:
@@ -49,6 +64,13 @@ def launch_main(argv=None):
     parser.add_argument("--devices_per_proc", type=int, default=0,
                         help="with --backend cpu: virtual device count per proc")
     parser.add_argument("--log_dir", type=str, default="")
+    parser.add_argument("--max_restarts", type=int, default=2,
+                        help="whole-group crash-restart budget "
+                        "(preemption exits never count against it); "
+                        "0 restores the fail-fast behavior")
+    parser.add_argument("--restart_backoff_s", type=float, default=1.0,
+                        help="base of the capped exponential restart "
+                        "backoff (base, 2x, 4x, ... capped at 30s)")
     parser.add_argument("training_script")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -65,7 +87,7 @@ def launch_main(argv=None):
         ports = _free_ports(nproc)
     endpoints = [f"{ip}:{port}" for ip in ips for port in ports]
 
-    procs = []
+    ranks = []
     base = args.node_rank * nproc
     for local_rank in range(nproc):
         rank = base + local_rank
@@ -85,48 +107,138 @@ def launch_main(argv=None):
                                     f" --xla_force_host_platform_device_count="
                                     f"{args.devices_per_proc}").strip()
         cmd = [sys.executable, "-u", args.training_script] + args.training_script_args
-        out = None
+        log_path = None
         if args.log_dir:
             os.makedirs(args.log_dir, exist_ok=True)
-            out = open(os.path.join(args.log_dir, f"worker.{rank}.log"), "w")
-        procs.append((subprocess.Popen(cmd, env=env, stdout=out, stderr=out), out))
+            log_path = os.path.join(args.log_dir, f"worker.{rank}.log")
+        ranks.append(_Rank(rank, cmd, env, log_path))
 
-    # supervise the group: first nonzero exit tears everything down
-    # (reference launcher terminates all children on failure; otherwise the
-    # surviving ranks hang in collectives waiting for the dead peer)
+    for r in ranks:
+        r.spawn()
+    return _supervise(ranks, max_restarts=max(0, args.max_restarts),
+                      backoff_s=args.restart_backoff_s)
+
+
+class _Rank:
+    """One worker slot: enough state to respawn the process."""
+
+    def __init__(self, rank: int, cmd, env, log_path):
+        self.rank = rank
+        self.cmd = cmd
+        self.env = env
+        self.log_path = log_path
+        self.proc = None
+        self.out = None
+        self.launches = 0
+        self.done = False
+
+    def spawn(self):
+        self.close_out()
+        if self.log_path:
+            # first launch truncates; restarts append so the crash
+            # output that justified the restart survives in the log
+            mode = "w" if self.launches == 0 else "a"
+            self.out = open(self.log_path, mode)  # atomic-exempt: live log stream
+        self.proc = subprocess.Popen(self.cmd, env=self.env,
+                                     stdout=self.out, stderr=self.out)
+        self.launches += 1
+        self.done = False
+
+    def close_out(self):
+        if self.out:
+            try:
+                self.out.close()
+            except OSError:
+                pass
+            self.out = None
+
+
+def _drain_group(ranks: List["_Rank"]):
+    """Stop every live rank: SIGTERM, 15 s grace, then SIGKILL (a rank
+    wedged in a collective or masking signals never exits on its own),
+    and wait until all are gone."""
+    live = [r for r in ranks if r.proc is not None and r.proc.poll() is None]
+    for r in live:
+        r.proc.send_signal(signal.SIGTERM)
+    deadline = time.time() + 15.0
+    while time.time() < deadline and any(
+            r.proc.poll() is None for r in live):
+        time.sleep(0.2)
+    for r in live:
+        if r.proc.poll() is None:
+            r.proc.kill()
+    for r in live:
+        try:
+            r.proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass  # unkillable (D-state); nothing more to do
+
+
+def _supervise(ranks: List["_Rank"], max_restarts: int,
+               backoff_s: float) -> int:
+    """Babysit the group. Any crash tears the whole group down
+    (surviving ranks would hang in collectives waiting for the dead
+    peer) and, while the budget lasts, the group is respawned together
+    after a capped exponential backoff — gang restart, safe for
+    collective jobs. Preemption (PREEMPT_EXIT_CODE) and exhausted
+    budgets drain the group and propagate the code."""
     code = 0
+    restarts = 0
     try:
-        live = {p.pid: p for p, _ in procs}
-        term_deadline = None
-        while live:
-            for pid, p in list(live.items()):
-                rc = p.poll()
+        while True:
+            crash_rc = None
+            crash_rank = None
+            preempted = False
+            for r in ranks:
+                if r.done or r.proc is None:
+                    continue
+                rc = r.proc.poll()
                 if rc is None:
                     continue
-                del live[pid]
-                if rc != 0:
-                    code = code or rc
-                    if term_deadline is None:
-                        term_deadline = time.time() + 15.0
-                        for q in live.values():
-                            q.send_signal(signal.SIGTERM)
-            if term_deadline is not None and time.time() > term_deadline:
-                # SIGTERM grace expired (rank wedged in a collective or
-                # masking signals) — escalate
-                for q in live.values():
-                    if q.poll() is None:
-                        q.kill()
-                term_deadline = time.time() + 3600  # don't re-kill in a loop
+                r.done = True
+                if rc == PREEMPT_EXIT_CODE:
+                    # graceful preemption: the rank already wrote its
+                    # final checkpoint and asked the whole job to be
+                    # rescheduled — never retried in place
+                    preempted = True
+                elif rc != 0 and crash_rc is None:
+                    crash_rc = rc
+                    crash_rank = r.rank
+            if preempted:
+                code = PREEMPT_EXIT_CODE
+                _drain_group(ranks)
+                break
+            if crash_rc is not None:
+                if restarts >= max_restarts:
+                    code = crash_rc
+                    _drain_group(ranks)
+                    break
+                delay = min(30.0, backoff_s * (2 ** restarts))
+                restarts += 1
+                print(f"launch: rank {crash_rank} exited rc={crash_rc}; "
+                      f"group restart {restarts}/{max_restarts} in "
+                      f"{delay:.1f}s", file=sys.stderr, flush=True)
+                from ..observability import events as _events
+
+                _events.emit("rank_restart", rank=crash_rank, rc=crash_rc,
+                             restart=restarts, max_restarts=max_restarts,
+                             delay_s=round(delay, 3))
+                _drain_group(ranks)
+                time.sleep(delay)
+                for r in ranks:
+                    r.spawn()
+                continue
+            if all(r.done for r in ranks):
+                break
             time.sleep(0.2)
     except KeyboardInterrupt:
-        for p, _ in procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
+        for r in ranks:
+            if r.proc is not None and r.proc.poll() is None:
+                r.proc.send_signal(signal.SIGTERM)
         code = 1
     finally:
-        for _, out in procs:
-            if out:
-                out.close()
+        for r in ranks:
+            r.close_out()
     return code
 
 

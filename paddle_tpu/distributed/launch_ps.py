@@ -64,7 +64,7 @@ def launch_ps_main(argv=None):
         if args.log_dir:
             os.makedirs(args.log_dir, exist_ok=True)
             tag = f"{role.lower()}.{endpoint or idx}".replace(":", "_")
-            out = open(os.path.join(args.log_dir, tag + ".log"), "w")
+            out = open(os.path.join(args.log_dir, tag + ".log"), "w")  # atomic-exempt: live log stream
         cmd = [sys.executable, "-u", args.training_script] + \
             args.training_script_args
         return subprocess.Popen(cmd, env=env, stdout=out, stderr=out), out

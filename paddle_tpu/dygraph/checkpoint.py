@@ -1,5 +1,7 @@
 """Dygraph checkpointing (reference: python/paddle/fluid/dygraph/checkpoint.py
-— save_dygraph/load_dygraph)."""
+— save_dygraph/load_dygraph). Writes are atomic (resilience.atomic):
+a kill mid-save leaves the previous .pdparams intact, never a
+truncated one."""
 
 from __future__ import annotations
 
@@ -7,13 +9,15 @@ import os
 
 import numpy as np
 
+from ..resilience import atomic as _atomic
+
 __all__ = ["save_dygraph", "load_dygraph"]
 
 
 def save_dygraph(state_dict, model_path):
     os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
     arrays = {k: np.asarray(v) for k, v in state_dict.items()}
-    np.savez(model_path + ".pdparams", **arrays)
+    _atomic.np_savez(model_path + ".pdparams", **arrays)
 
 
 def load_dygraph(model_path):

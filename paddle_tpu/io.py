@@ -5,7 +5,12 @@ load_inference_model :1171; C++ save_op.cc/load_op.cc).
 
 Format: one .npy per var (like the reference's one-file-per-var save ops) or
 a single .npz when `filename` is given (save_combine_op.cc equivalent);
-programs serialize as JSON (`__model__`)."""
+programs serialize as JSON (`__model__`).
+
+Every writer here goes through resilience.atomic (tmp file +
+os.replace): a crash mid-`save_persistables` must never leave a
+truncated `.npz`/`.npy`/`__model__` that a later load trips over — the
+previous complete version, if any, survives any interruption."""
 
 from __future__ import annotations
 
@@ -13,6 +18,8 @@ import os
 from typing import List, Optional, Sequence
 
 import numpy as np
+
+from .resilience import atomic as _atomic
 
 from .core import framework
 from .core.executor import Executor, global_scope
@@ -58,8 +65,8 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
             val = scope.find_var(v.name)
             if val is None:
                 continue
-            np.save(os.path.join(dirname, var_filename(v.name)),
-                    np.asarray(val))
+            _atomic.np_save(os.path.join(dirname, var_filename(v.name)),
+                            np.asarray(val))
             saved += 1
     else:
         data = {}
@@ -67,7 +74,7 @@ def save_vars(executor, dirname, main_program=None, vars=None, predicate=None,
             val = scope.find_var(v.name)
             if val is not None:
                 data[v.name] = np.asarray(val)
-        np.savez(os.path.join(dirname, filename), **data)
+        _atomic.np_savez(os.path.join(dirname, filename), **data)
         saved = len(data)
     from .observability import events as _events
 
@@ -162,13 +169,10 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     pruned._attrs["fetch_names"] = fetch_names
     os.makedirs(dirname, exist_ok=True)
     model_path = os.path.join(dirname, model_filename or "__model__")
-    import json
-
     payload = {"program": pruned.desc.to_dict(),
                "feed_names": list(feeded_var_names),
                "fetch_names": fetch_names}
-    with open(model_path, "w") as f:
-        json.dump(payload, f)
+    _atomic.json_dump(payload, model_path)
     if not program_only:
         save_persistables(executor, dirname, main_program=pruned,
                           filename=params_filename)
@@ -184,15 +188,12 @@ def save_train_model(dirname, main_program, startup_program, feed_names,
     optimizer ops), the startup block (initializers), the feed names and
     the loss var to report per step — no parameters are saved; the native
     side runs the startup block to initialize them."""
-    import json
-
     os.makedirs(dirname, exist_ok=True)
     payload = {"main": main_program.desc.to_dict(),
                "startup": startup_program.desc.to_dict(),
                "feed_names": list(feed_names),
                "loss_name": loss_name}
-    with open(os.path.join(dirname, "__train__"), "w") as f:
-        json.dump(payload, f)
+    _atomic.json_dump(payload, os.path.join(dirname, "__train__"))
 
 
 def load_inference_model(dirname, executor, model_filename=None,
@@ -229,9 +230,8 @@ def save(program: Program, model_path: str):
         val = scope.find_var(v.name)
         if val is not None:
             data[v.name] = np.asarray(val)
-    np.savez(model_path + ".pdparams", **data)
-    with open(model_path + ".pdmodel", "wb") as f:
-        f.write(program.to_bytes())
+    _atomic.np_savez(model_path + ".pdparams", **data)
+    _atomic.write_bytes(model_path + ".pdmodel", program.to_bytes())
     from .observability import events as _events
 
     _events.emit("checkpoint", site="save", dir=str(model_path),

@@ -73,7 +73,7 @@ def build_and_load(src: str, lib_path: str,
                 f"native build failed: {' '.join(cmd)}\n{e.stderr}") from e
         os.replace(tmp, lib_path)
         stamp_tmp = f"{stamp_path}.tmp.{os.getpid()}"
-        with open(stamp_tmp, "w") as f:
+        with open(stamp_tmp, "w") as f:  # atomic-exempt: tmp file, os.replace'd below
             f.write(want)
         os.replace(stamp_tmp, stamp_path)
 

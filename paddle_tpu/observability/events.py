@@ -38,8 +38,13 @@ __all__ = ["emit", "recent", "clear", "log_path", "read_jsonl",
 
 # Known event kinds (emitters may add more; these are the documented core).
 # serve_start/serve_stop bracket a serving.Server's lifetime (SERVING.md).
+# restore/preempt/fault/recovery/rank_restart are the resilience layer's
+# story of a faulty run (RESILIENCE.md): checkpoint restores (incl.
+# corrupt-fallback skips), graceful-stop requests, injected faults,
+# recovery-policy actions, and launcher rank restarts.
 KINDS = ("compile", "step_summary", "anomaly", "checkpoint",
-         "serve_start", "serve_stop")
+         "serve_start", "serve_stop", "restore", "preempt", "fault",
+         "recovery", "rank_restart")
 
 # Ring bound: a week-long run emitting a compile+summary event per minute
 # stays far under this; anomaly storms get truncated to the latest window.

@@ -42,7 +42,8 @@ from . import metrics as _m
 
 __all__ = ["NumericsError", "check_level", "max_abs", "check_numerics",
            "record_grad_global_norm", "status", "anomaly_count", "reset",
-           "introspection_enabled"]
+           "introspection_enabled", "add_anomaly_listener",
+           "remove_anomaly_listener"]
 
 _log = logging.getLogger("paddle_tpu.health")
 
@@ -116,6 +117,26 @@ def introspection_enabled() -> bool:
 _state_lock = threading.Lock()
 _anomaly_count = 0
 _last_anomaly: Optional[Dict[str, Any]] = None
+_listeners: List[Any] = []
+
+
+def add_anomaly_listener(fn):
+    """Register `fn(event_dict)` to be called for every recorded
+    anomaly — the hook recovery policies (resilience/policy.py) use to
+    act on warn-level (level 1) anomalies that never raise. Listener
+    exceptions are swallowed with a log line: a broken policy hook must
+    not turn a warning into a crash."""
+    with _state_lock:
+        if fn not in _listeners:
+            _listeners.append(fn)
+
+
+def remove_anomaly_listener(fn):
+    with _state_lock:
+        try:
+            _listeners.remove(fn)
+        except ValueError:
+            pass
 
 
 def _classify(arr) -> List[Tuple[str, int]]:
@@ -202,6 +223,13 @@ def _record_anomalies(site: str, anomalies: List[Dict[str, Any]],
         with _state_lock:
             _anomaly_count += 1
             _last_anomaly = ev
+            listeners = list(_listeners)
+        for fn in listeners:  # outside the lock: a listener may read
+            # health state (anomaly_count) without deadlocking
+            try:
+                fn(ev)
+            except Exception:
+                _log.exception("anomaly listener %r failed", fn)
     LAST_ANOMALY_TS.set(now)
 
 

@@ -146,6 +146,23 @@ class Histogram(_Metric):
                     break
             # values above the top bucket land only in +Inf (count)
 
+    def time(self, **labels):
+        """Context manager observing the with-block's wall seconds —
+        the duration is recorded whether the block succeeds or raises
+        (a failed save's latency is still a latency)."""
+        import contextlib
+        import time as _time
+
+        @contextlib.contextmanager
+        def _timer():
+            t0 = _time.perf_counter()
+            try:
+                yield self
+            finally:
+                self.observe(_time.perf_counter() - t0, **labels)
+
+        return _timer()
+
     def stats(self, **labels) -> Dict[str, float]:
         key = _label_key(self.labelnames, labels)
         with self._lock:
@@ -239,7 +256,7 @@ class MetricsRegistry:
         for path, text in ((jpath, json.dumps(_json_safe(snap), indent=1)),
                            (ppath, render_prometheus_snapshot(snap))):
             tmp = path + ".tmp"
-            with open(tmp, "w") as f:
+            with open(tmp, "w") as f:  # atomic-exempt: tmp file, os.replace'd below
                 f.write(text)
             os.replace(tmp, path)
         return jpath

@@ -160,14 +160,16 @@ def export_trace(path: str, trace_dir: Optional[str] = None,
             except (OSError, ValueError):
                 continue  # truncated trace from a killed run: skip, keep ours
     trace = merge_chrome_traces(lists)
-    with open(path, "w") as f:
-        json.dump(trace, f)
+    from ..resilience.atomic import json_dump as _atomic_json_dump
+
+    _atomic_json_dump(trace, path)
     return path
 
 
 def save_spans(path: str) -> str:
     """Persist raw spans as JSON (spans.json in a run dir) so
     tools/obsdump.py can rebuild a trace offline."""
-    with open(path, "w") as f:
-        json.dump([s._asdict() for s in get_spans()], f)
+    from ..resilience.atomic import json_dump as _atomic_json_dump
+
+    _atomic_json_dump([s._asdict() for s in get_spans()], path)
     return path

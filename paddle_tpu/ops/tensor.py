@@ -776,7 +776,9 @@ def save_op(ins, attrs, ctx):
     path = attrs["file_path"]
 
     def host(v):
-        np.save(_resolve_save_path(path), np.asarray(v))
+        from ..resilience import atomic as _atomic
+
+        _atomic.np_save(_resolve_save_path(path), np.asarray(v))
 
     io_callback(host, None, x, ordered=True)
     return {}
@@ -795,8 +797,10 @@ def save_combine(ins, attrs, ctx):
     path = attrs["file_path"]
 
     def host(*arrays):
-        np.savez(_resolve_save_path(path),
-                 **{n: np.asarray(a) for n, a in zip(names, arrays)})
+        from ..resilience import atomic as _atomic
+
+        _atomic.np_savez(_resolve_save_path(path),
+                         **{n: np.asarray(a) for n, a in zip(names, arrays)})
 
     io_callback(host, None, *xs, ordered=True)
     return {}

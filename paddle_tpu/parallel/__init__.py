@@ -33,3 +33,4 @@ from .spmd_executor import SPMDRunner  # noqa: F401
 from .checkpoint import (  # noqa: F401
     latest_step_dir, restore_train_state, save_train_state,
 )
+from .train import train_loop  # noqa: F401

@@ -63,10 +63,21 @@ def restore_train_state(path: str, template: TrainState) -> TrainState:
                       restored["step"])
 
 
-def latest_step_dir(root: str) -> Optional[str]:
-    """Resume helper: `root/step_N` directories -> the highest-N path."""
+def latest_step_dir(root: str, committed_only: bool = False) -> Optional[str]:
+    """Resume helper: `root/step_N` directories -> the highest-N path.
+
+    CAUTION: with committed_only=False (the legacy default) this returns
+    the highest-numbered directory even if it is a PARTIAL write left by
+    a process that died mid-save. committed_only=True only counts
+    directories carrying resilience.CheckpointManager's commit marker;
+    for managed checkpoints prefer `CheckpointManager.restore_latest`,
+    which additionally falls back past corrupt-but-committed dirs."""
     if not os.path.isdir(root):
         return None
+    if committed_only:
+        from ..resilience.checkpoint_manager import CheckpointManager
+
+        return CheckpointManager(root).latest_committed_dir()
     best, best_n = None, -1
     for d in os.listdir(root):
         if d.startswith("step_") and os.path.isdir(os.path.join(root, d)):

@@ -252,3 +252,112 @@ def make_train_step(
         return state_shardings_cache[key](state, batch, rng)
 
     return init_state, jitted_step
+
+
+def train_loop(step_fn, state: TrainState, batches, *, rng=None,
+               manager=None, save_every: Optional[int] = None,
+               controller=None, max_steps: Optional[int] = None):
+    """Fault-tolerance-aware driver for a `make_train_step` step_fn.
+
+    The step boundary is the only safe interruption point (no donated
+    buffers in flight, device state consistent), so everything the
+    resilience layer does hangs off this loop:
+
+      - fault injection: `faults.check("step", step=N)` fires before
+        each step — `PADDLE_TPU_FAULT_SPEC="step=N:crash"` kills the
+        process exactly there, which is how the kill-and-resume tests
+        provoke arbitrary-step deaths;
+      - preemption: when a graceful stop was requested (SIGTERM with
+        PADDLE_TPU_PREEMPT_SIGNALS set, or programmatically), the loop
+        writes a final checkpoint via `manager` and returns
+        stop="preempted" — the caller exits with PREEMPT_EXIT_CODE;
+      - periodic checkpoints: every `save_every` completed steps,
+        `manager.save(state)` (commit marker + retention inside);
+      - recovery: a NumericsError from the post-step loss check (or a
+        blown warn-anomaly budget) is routed to `controller.handle`,
+        which skips the batch, rolls the state back to the last
+        committed checkpoint, or aborts per its RecoveryPolicy.
+
+    `batches` is either an iterable of batches or a callable
+    `batch_fn(step) -> batch | None` (None stops the loop). The callable
+    form keys data on the GLOBAL step number, which is what makes a
+    resumed run replay the exact uninterrupted trajectory — and what a
+    rollback needs to re-feed the steps it rewound over (an iterator
+    cannot rewind; with one, a rollback continues on fresh batches).
+    Per-step randomness is `jax.random.fold_in(rng, step)` for the same
+    reason. Returns (state, losses, stop) where `losses` maps executed
+    step number -> float loss and `stop` is
+    "completed" | "preempted" | "exhausted".
+    """
+    import time as _time
+
+    from ..observability import events as _events
+    from ..observability import health as _health
+    from ..resilience import faults as _faults
+    from ..resilience import preemption as _preempt
+
+    _preempt.maybe_install_from_env()
+    if controller is not None:
+        controller.attach()
+    if rng is None:
+        rng = jax.random.key(0)
+    get_batch = batches if callable(batches) else None
+    batch_iter = iter(batches) if get_batch is None else None
+    losses: Dict[int, float] = {}
+    steps_done = 0
+    stop = "completed"
+    t0 = _time.perf_counter()
+    try:
+        while True:
+            if max_steps is not None and steps_done >= max_steps:
+                stop = "exhausted"
+                break
+            step_no = int(state.step)
+            _faults.check("step", step=step_no)
+            if _preempt.stop_requested():
+                stop = "preempted"
+                if manager is not None and not manager.is_committed(
+                        manager.step_dir(step_no)):
+                    manager.save(state)
+                break
+            if controller is not None and controller.should_act():
+                action, state = controller.handle(None, state,
+                                                  step=step_no)
+                if action == "rollback":
+                    continue  # step_no re-derives from the rewound state
+            if get_batch is not None:
+                batch = get_batch(step_no)
+                if batch is None:
+                    break
+            else:
+                batch = next(batch_iter, None)
+                if batch is None:
+                    break
+            step_rng = jax.random.fold_in(rng, step_no)
+            try:
+                state, loss = step_fn(state, batch, step_rng)
+                loss_val = float(loss)
+                if _health.check_level():
+                    _health.check_numerics(
+                        "trainer_loss", [("loss", loss_val)],
+                        step=step_no)
+            except _health.NumericsError as e:
+                if controller is None:
+                    raise
+                action, state = controller.handle(e, state, step=step_no)
+                if action == "skip_batch":
+                    steps_done += 1
+                continue
+            losses[step_no] = loss_val
+            steps_done += 1
+            if (manager is not None and save_every
+                    and int(state.step) % save_every == 0):
+                manager.save(state)
+    finally:
+        if controller is not None:
+            controller.detach()
+    seconds = _time.perf_counter() - t0
+    _events.emit("step_summary", site="train_loop", steps=steps_done,
+                 stop=stop, final_step=int(state.step),
+                 seconds=round(seconds, 6))
+    return state, losses, stop

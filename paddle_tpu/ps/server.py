@@ -443,21 +443,25 @@ class ParameterServer:
                 owned_aux: Dict[str, list] = {}
                 for an, owner in self.aux_owner.items():
                     owned_aux.setdefault(owner, []).append(an)
+                from ..resilience import atomic as _atomic
+
                 for name, vs in list(self.vars.items()):
                     with vs.lock:
-                        np.save(os.path.join(dirname, var_filename(name)),
-                                vs.value)
+                        _atomic.np_save(
+                            os.path.join(dirname, var_filename(name)),
+                            vs.value)
                         for an in owned_aux.get(name, []):
                             if an in self.aux:
-                                np.save(os.path.join(
+                                _atomic.np_save(os.path.join(
                                     dirname, var_filename(an)),
                                     np.asarray(self.aux[an]))
                                 saved.append(an)
                     saved.append(name)
                 for an, val in list(self.aux.items()):
                     if an not in saved:   # ownerless aux: best effort
-                        np.save(os.path.join(dirname, var_filename(an)),
-                                np.asarray(val))
+                        _atomic.np_save(
+                            os.path.join(dirname, var_filename(an)),
+                            np.asarray(val))
                         saved.append(an)
                 return {"ok": True, "saved": saved}
             except OSError as e:

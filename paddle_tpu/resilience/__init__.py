@@ -1,0 +1,43 @@
+"""Fault tolerance for long training runs.
+
+The reference framework's production credibility rested on surviving
+failure — save/load_persistables plus distributed checkpoint reassembly
+(reference io.py:320,501,769) exist because multi-day parameter-server
+jobs die and resume. This package is that capability for the TPU-native
+stack, organized as five cooperating pieces:
+
+- atomic.py             crash-safe file writes (tmp + os.replace) — the
+                        primitive everything durable builds on
+- checkpoint_manager.py CheckpointManager: commit markers, retention,
+                        retry with backoff, corrupt-fallback restore
+- preemption.py         SIGTERM → stop-at-step-boundary → final
+                        checkpoint → PREEMPT_EXIT_CODE
+- policy.py             RecoveryPolicy/RecoveryController: skip-batch /
+                        rollback-with-LR-backoff / abort on health
+                        anomalies
+- faults.py             PADDLE_TPU_FAULT_SPEC deterministic fault
+                        injection — the harness that proves the rest
+
+Training-loop integration lives in parallel/train.py (`train_loop`) and
+trainer.py; the multi-process angle (rank restart budgets, preemption
+exit codes) in distributed/launch.py. See RESILIENCE.md for the
+checkpoint layout, the commit protocol and the fault-spec grammar.
+
+Importing this package must stay jax-free: orbax/jax load lazily inside
+CheckpointManager's default save/restore functions.
+"""
+
+from . import atomic  # noqa: F401
+from . import faults  # noqa: F401
+from . import preemption  # noqa: F401
+from . import retry  # noqa: F401
+from .checkpoint_manager import (  # noqa: F401
+    COMMIT_MARKER, CheckpointError, CheckpointManager,
+)
+from .faults import CRASH_EXIT_CODE, FaultInjected, InjectedIOError  # noqa: F401
+from .policy import (  # noqa: F401
+    RecoveryAbort, RecoveryController, RecoveryPolicy,
+    scale_learning_rate,
+)
+from .preemption import PREEMPT_EXIT_CODE  # noqa: F401
+from .retry import retry_io  # noqa: F401

@@ -1,0 +1,61 @@
+"""Capped-exponential-backoff retry for transient I/O.
+
+Checkpoint storage on TPU pods is network-attached (GCS/NFS); transient
+write failures are routine and must not kill a multi-day run, while a
+persistently dead disk must still surface promptly. `retry_io` is the
+one policy both the CheckpointManager and any other durable writer use:
+retry only the exception types the caller names (OSError by default —
+a ValueError from corrupt data is NOT transient and retrying it would
+mask a real bug), with exponentially growing, capped sleeps, counting
+every retry in the metrics registry so a flaky disk is visible in
+/metrics long before it becomes fatal.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Tuple, Type, TypeVar
+
+from ..observability import metrics as _m
+
+__all__ = ["retry_io"]
+
+_log = logging.getLogger("paddle_tpu.resilience")
+
+RETRIES = _m.counter(
+    "paddle_tpu_io_retries_total",
+    "Transient I/O failures retried with backoff", labelnames=("site",))
+EXHAUSTED = _m.counter(
+    "paddle_tpu_io_retries_exhausted_total",
+    "I/O operations that failed every retry attempt",
+    labelnames=("site",))
+
+T = TypeVar("T")
+
+
+def retry_io(fn: Callable[[], T], *, attempts: int = 3,
+             base_delay_s: float = 0.1, max_delay_s: float = 5.0,
+             retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+             site: str = "io", sleep: Callable[[float], None] = time.sleep
+             ) -> T:
+    """Run `fn`, retrying `retry_on` failures up to `attempts` total
+    tries with capped exponential backoff (base, 2*base, 4*base, ...
+    capped at `max_delay_s`). The final failure propagates unchanged.
+    `sleep` is injectable so tests don't wait wall-clock time."""
+    if attempts < 1:
+        raise ValueError("attempts must be >= 1")
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt + 1 >= attempts:
+                EXHAUSTED.inc(site=site)
+                raise
+            RETRIES.inc(site=site)
+            delay = min(max_delay_s, base_delay_s * (2 ** attempt))
+            _log.warning(
+                "retry_io[%s]: attempt %d/%d failed (%s); retrying in "
+                "%.2fs", site, attempt + 1, attempts, e, delay)
+            sleep(delay)
+    raise AssertionError("unreachable")

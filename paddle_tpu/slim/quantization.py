@@ -116,9 +116,12 @@ class PostTrainingQuantization:
             # per-output-channel: conv filters quantize along dim 0
             axis = 0 if "conv" in op_type else -1
             q, scale = _quantize_array(w, axis=axis)
-            np.save(os.path.join(self.save_path, _fname(name, "@INT8")), q)
-            np.save(os.path.join(self.save_path, _fname(name, "@SCALE")),
-                    scale)
+            from ..resilience import atomic as _atomic
+
+            _atomic.np_save(
+                os.path.join(self.save_path, _fname(name, "@INT8")), q)
+            _atomic.np_save(
+                os.path.join(self.save_path, _fname(name, "@SCALE")), scale)
             os.remove(path)
             meta[name] = {"axis": axis, "dtype": str(w.dtype)}
             ratios[name] = float(w.nbytes) / (q.nbytes + scale.nbytes)
@@ -128,8 +131,9 @@ class PostTrainingQuantization:
                 f"saved with a combined params_filename are not supported; "
                 f"re-save without params_filename")
         if meta:
-            with open(meta_path, "w") as f:
-                json.dump(meta, f)
+            from ..resilience.atomic import json_dump
+
+            json_dump(meta, meta_path)
         return ratios
 
 
@@ -312,6 +316,7 @@ def calibrate_and_quantize(model_dir: str, calibration_reader,
             b0.vars.pop(wname, None)
     payload["program"] = desc.to_dict()
     payload["act_scales"] = act_scales
-    with open(model_path, "w") as f:
-        json.dump(payload, f)
+    from ..resilience.atomic import json_dump
+
+    json_dump(payload, model_path)
     return act_scales

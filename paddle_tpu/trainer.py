@@ -15,6 +15,8 @@ from typing import Optional, Sequence
 from .observability import events as _events
 from .observability import health as _health
 from .observability import telemetry as _telemetry
+from .resilience import faults as _faults
+from .resilience import preemption as _preempt
 
 
 def _fetch_names(fetch_list, fetch_info=None):
@@ -50,7 +52,15 @@ def train_from_dataset(executor, program=None, dataset=None, scope=None,
     run_t0 = time.perf_counter()
     batches = dataset._iter_batches() if hasattr(dataset, "_iter_batches") \
         else iter(dataset)
+    _preempt.maybe_install_from_env()
+    stop = "completed"
     for feed in batches:
+        # step boundary: the only safe stop/injection point (see
+        # parallel.train.train_loop for the full fault-tolerant driver)
+        _faults.check("step", step=step)
+        if _preempt.stop_requested():
+            stop = "preempted"
+            break
         t0 = time.perf_counter()
         vals = executor.run(program, feed=feed, fetch_list=fetch_list,
                             scope=scope)
@@ -70,7 +80,7 @@ def train_from_dataset(executor, program=None, dataset=None, scope=None,
     _events.emit("step_summary", site="train_from_dataset", steps=step,
                  examples=examples, seconds=round(seconds, 6),
                  examples_per_sec=round(examples / seconds, 3)
-                 if seconds > 0 else 0.0)
+                 if seconds > 0 else 0.0, stop=stop)
     return None
 
 
@@ -128,6 +138,9 @@ class HogwildWorker:
         examples = 0
         for feed in self.dataset._iter_batches() if hasattr(
                 self.dataset, "_iter_batches") else iter(self.dataset):
+            _faults.check("step", step=self.steps)
+            if _preempt.stop_requested():
+                break  # graceful stop at the step boundary
             t0 = time.perf_counter()
             with self.step_lock if self.step_lock is not None else \
                     contextlib.nullcontext():

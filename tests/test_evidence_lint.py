@@ -1,9 +1,19 @@
-"""Evidence-claim linter in CI (VERDICT r4 item 9): PARITY.md/PROFILE.md
-may only cite driver artifacts (BENCH_rNN/MULTICHIP_rNN) whose committed
-JSON exists and recorded success — a claim against a failed or absent
-driver file is overclaiming and fails the suite."""
+"""Static repo-hygiene lints in CI.
+
+1. Evidence claims (VERDICT r4 item 9): PARITY.md/PROFILE.md may only
+   cite driver artifacts (BENCH_rNN/MULTICHIP_rNN) whose committed JSON
+   exists and recorded success — a claim against a failed or absent
+   driver file is overclaiming and fails the suite.
+2. Durable writes (RESILIENCE.md): bare `open(..., "w")` / `np.save` /
+   `json.dump` calls inside paddle_tpu/ bypass the crash-safe
+   tmp+os.replace helpers in resilience/atomic.py and can leave
+   truncated artifacts behind a kill. Every such call must go through
+   the helpers or carry an explicit `# atomic-exempt: <why>` comment
+   (log streams, tmp files that are os.replace'd manually, ...).
+"""
 
 import os
+import re
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -14,4 +24,59 @@ from refresh_evidence import lint_evidence_claims  # noqa: E402
 
 def test_driver_citations_are_valid():
     errors = lint_evidence_claims()
+    assert not errors, "\n".join(errors)
+
+
+# -- durable-write lint ------------------------------------------------------
+
+# `(?<![\w.])` keeps atomic_open/gzip.open/os.fdopen out of the `open`
+# match; modes are matched literally, so an `open(path, mode)` stream
+# helper with a variable mode is out of scope (it writes on the
+# caller's behalf, the caller owns durability). The open() pattern
+# allows anything (including nested calls' parens) between `open(` and
+# the quoted mode, which must be followed by `,` or `)` — so
+# `open(os.path.join(d, f), "w")` is caught, at the cost of a rare
+# false positive when a line happens to contain both `open(` and a
+# stray `"w")` (annotate those `# atomic-exempt:`).
+_WRITE_PATTERNS = (
+    (re.compile(r"(?<![\w.])np\.(save|savez|savez_compressed)\s*\("),
+     "np.save/np.savez"),
+    (re.compile(r"(?<![\w.])json\.dump\s*\("), "json.dump"),
+    (re.compile(
+        r"(?<![\w.])open\s*\(.*[\"'](w|wb|w\+|wb\+|x|xb)[\"']\s*[,)]"),
+     'open(..., "w")'),
+)
+
+# The helper module itself is the one place allowed to open durable
+# files for write.
+_ALLOWED_FILES = ("resilience/atomic.py",)
+
+
+def lint_durable_writes():
+    errors = []
+    pkg = os.path.join(_REPO, "paddle_tpu")
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, _REPO)
+            if rel.replace(os.sep, "/").endswith(_ALLOWED_FILES):
+                continue
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    if "atomic-exempt" in line:
+                        continue
+                    for pat, what in _WRITE_PATTERNS:
+                        if pat.search(line):
+                            errors.append(
+                                f"{rel}:{lineno}: bare {what} write — "
+                                f"use paddle_tpu.resilience.atomic or "
+                                f"add '# atomic-exempt: <why>': "
+                                f"{line.strip()}")
+    return errors
+
+
+def test_no_bare_durable_writes():
+    errors = lint_durable_writes()
     assert not errors, "\n".join(errors)

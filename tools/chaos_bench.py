@@ -1,0 +1,245 @@
+"""Scripted kill/resume cycles against the resilience stack.
+
+Drives the fault-tolerant training loop (parallel.train.train_loop +
+resilience.CheckpointManager) through whole-process crash + resume
+cycles and reports SERVING-bench-style JSON lines: checkpoint save and
+restore seconds, recovered-step overhead (steps re-executed because
+they post-dated the last committed checkpoint), and whether every
+resumed trajectory reproduced the uninterrupted baseline.
+
+Each cycle: run the worker with PADDLE_TPU_FAULT_SPEC="step=K:crash"
+(the injector os._exit()s the process at that exact step boundary —
+a hard kill, not an exception), then relaunch the same command; the
+worker restores via CheckpointManager.restore_latest() and finishes
+the run. Losses are keyed by global step, so equivalence with the
+baseline is a direct per-step comparison.
+
+Run:  python tools/chaos_bench.py [--steps 24] [--save-every 4]
+      [--kill-steps 7,15] [--smoke]
+
+--smoke is the tier-1-safe mode the test suite invokes (CPU backend,
+one short cycle) — it validates the whole kill/resume machinery and
+the report schema, not absolute numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _build_args():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=24,
+                    help="total training steps per run")
+    ap.add_argument("--save-every", type=int, default=4)
+    ap.add_argument("--kill-steps", type=str, default="7,15",
+                    help="comma-separated steps to crash at, one cycle "
+                    "per step")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--keep-last", type=int, default=2)
+    ap.add_argument("--timeout-s", type=float, default=300.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU run for CI (overrides steps/kills)")
+    # internal: run one training process instead of orchestrating
+    ap.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--ckpt-dir", type=str, default="",
+                    help=argparse.SUPPRESS)
+    return ap.parse_args()
+
+
+# ---------------------------------------------------------------------------
+# Worker mode: one training process (baseline, crashing, or resuming —
+# the fault spec and the checkpoint dir contents decide which).
+# ---------------------------------------------------------------------------
+
+
+def run_worker(args) -> int:
+    import jax
+    import optax
+
+    from paddle_tpu.models import lenet
+    from paddle_tpu.observability import events
+    from paddle_tpu.parallel import make_mesh, mesh_guard
+    from paddle_tpu.parallel.train import (TrainStrategy, make_train_step,
+                                           train_loop)
+    from paddle_tpu.resilience import CheckpointManager
+    from paddle_tpu.resilience.preemption import PREEMPT_EXIT_CODE
+
+    params, axes = lenet.init(jax.random.key(0))
+    mesh = make_mesh()
+    data_key = jax.random.key(42)
+
+    def batch_fn(step):
+        if step >= args.steps:
+            return None
+        k = jax.random.fold_in(data_key, step)
+        img = jax.random.normal(k, (args.batch, 1, 28, 28), "float32")
+        label = jax.random.randint(jax.random.fold_in(k, 1),
+                                   (args.batch, 1), 0, 10, "int32")
+        return {"img": img, "label": label}
+
+    with mesh_guard(mesh):
+        init_state, step_fn = make_train_step(
+            lenet.loss_fn, optax.adam(1e-3), mesh, axes,
+            strategy=TrainStrategy(shard_optimizer_states=False))
+        state = init_state(params)
+        mgr = CheckpointManager(args.ckpt_dir,
+                                keep_last_n=args.keep_last)
+        resumed_from = None
+        restored = mgr.restore_latest(state)
+        if restored is not None:
+            state = restored
+            resumed_from = int(state.step)
+        state, losses, stop = train_loop(
+            step_fn, state, batch_fn, rng=jax.random.key(7),
+            manager=mgr, save_every=args.save_every)
+
+    save_s = [e["seconds"] for e in events.recent(n=None, kind="checkpoint")
+              if e.get("site") == "manager_save" and "seconds" in e]
+    restore_s = [e["seconds"] for e in events.recent(n=None, kind="restore")
+                 if e.get("ok")]
+    print(json.dumps({
+        "worker": "chaos", "stop": stop, "final_step": int(state.step),
+        "resumed_from": resumed_from,
+        "losses": {str(k): float(v) for k, v in losses.items()},
+        "save_seconds": save_s, "restore_seconds": restore_s,
+    }), flush=True)
+    return PREEMPT_EXIT_CODE if stop == "preempted" else 0
+
+
+# ---------------------------------------------------------------------------
+# Orchestrator mode
+# ---------------------------------------------------------------------------
+
+
+def _spawn(args, ckpt_dir, fault_spec=None):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("PADDLE_TPU_FAULT_SPEC", None)
+    if fault_spec:
+        env["PADDLE_TPU_FAULT_SPEC"] = fault_spec
+    cmd = [sys.executable, os.path.abspath(__file__), "--worker",
+           "--ckpt-dir", ckpt_dir, "--steps", str(args.steps),
+           "--save-every", str(args.save_every),
+           "--batch", str(args.batch), "--keep-last", str(args.keep_last)]
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=args.timeout_s, cwd=_REPO, env=env)
+
+
+def _worker_report(proc):
+    for line in reversed(proc.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rep = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rep.get("worker") == "chaos":
+                return rep
+    return None
+
+
+def _percentile(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(q / 100.0 * (len(xs) - 1))))
+    return xs[i]
+
+
+def run_bench(args) -> int:
+    from paddle_tpu.resilience.faults import CRASH_EXIT_CODE
+
+    kill_steps = [int(s) for s in args.kill_steps.split(",") if s.strip()]
+    work = tempfile.mkdtemp(prefix="chaos_bench_")
+    failures = []
+    save_s, restore_s, recovered = [], [], []
+
+    base = _spawn(args, os.path.join(work, "baseline"))
+    base_rep = _worker_report(base)
+    if base.returncode != 0 or base_rep is None:
+        print(base.stdout + base.stderr, file=sys.stderr)
+        shutil.rmtree(work, ignore_errors=True)
+        raise SystemExit("chaos_bench: baseline run failed")
+    base_losses = base_rep["losses"]
+    save_s += base_rep["save_seconds"]
+
+    for kill in kill_steps:
+        ckpt = os.path.join(work, f"kill_{kill}")
+        crashed = _spawn(args, ckpt, fault_spec=f"step={kill}:crash")
+        if crashed.returncode != CRASH_EXIT_CODE:
+            failures.append(
+                f"kill@{kill}: expected crash rc={CRASH_EXIT_CODE}, got "
+                f"{crashed.returncode}: {crashed.stderr[-500:]}")
+            continue
+        resumed = _spawn(args, ckpt)
+        rep = _worker_report(resumed)
+        if resumed.returncode != 0 or rep is None:
+            failures.append(f"kill@{kill}: resume failed rc="
+                            f"{resumed.returncode}: {resumed.stderr[-500:]}")
+            continue
+        if rep["resumed_from"] is None:
+            failures.append(f"kill@{kill}: resume found no checkpoint")
+            continue
+        recovered.append(kill - rep["resumed_from"])
+        save_s += rep["save_seconds"]
+        restore_s += rep["restore_seconds"]
+        for step, loss in rep["losses"].items():
+            ref = base_losses.get(step)
+            if ref is None or abs(loss - ref) > 1e-5 * max(1.0, abs(ref)):
+                failures.append(
+                    f"kill@{kill}: step {step} loss {loss} != baseline "
+                    f"{ref}")
+                break
+    shutil.rmtree(work, ignore_errors=True)
+
+    detail = {
+        "steps": args.steps, "save_every": args.save_every,
+        "kill_steps": kill_steps, "cycles": len(kill_steps),
+        "failures": failures, "smoke": bool(args.smoke),
+    }
+    for metric, value, unit in (
+            ("chaos_save_seconds_p50", _percentile(save_s, 50), "s"),
+            ("chaos_restore_seconds_p50", _percentile(restore_s, 50), "s"),
+            ("chaos_recovered_steps_mean",
+             round(sum(recovered) / len(recovered), 3) if recovered
+             else None, "steps"),
+            ("chaos_equivalence_ok", 0.0 if failures else 1.0, "bool")):
+        print(json.dumps({
+            "metric": metric,
+            "value": round(value, 6) if isinstance(value, float) else value,
+            "unit": unit, "detail": detail}), flush=True)
+    if failures:
+        print("\n".join(failures), file=sys.stderr)
+    return 1 if failures else 0
+
+
+def main() -> int:
+    args = _build_args()
+    sys.path.insert(0, _REPO)
+    if args.worker:
+        if not args.ckpt_dir:
+            raise SystemExit("--worker needs --ckpt-dir")
+        return run_worker(args)
+    if args.smoke:
+        # tier-1 safety: tiny, CPU-only, a single kill/resume cycle
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        args.steps, args.save_every = 8, 2
+        args.kill_steps = "5"
+    from paddle_tpu.core.tpu_lock import tpu_singleflight
+
+    with tpu_singleflight():  # one real chip: serialize vs bench/tools
+        return run_bench(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
