@@ -92,19 +92,8 @@ def _run_ladder(metric, batch_sizes, build, flops_per_sample, n_steps,
     return False
 
 
-def bench_lenet_smoke(mesh, n_chips, platform, on_tpu):
-    """BASELINE config 1: MNIST LeNet single-chip smoke — the fluid
-    Program/Executor surface itself on the chip (feed numpy, fetch a
-    converging loss), not the jax-native path. Value is samples/s
-    through the FULL Program pipeline; vs_baseline=1.0 marks
-    convergence (loss halved), 0.0 otherwise."""
-    import numpy as np
-
-    import paddle_tpu as pt
-
-    rng = np.random.RandomState(0)
-    X = rng.rand(256, 1, 28, 28).astype("float32")
-    Y = rng.randint(0, 10, (256, 1)).astype("int64")
+def _build_lenet_program(pt):
+    """LeNet training Program used by the smoke and pipeline benches."""
     main, startup = pt.Program(), pt.Program()
     with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
         x = pt.layers.data(name="x", shape=[1, 28, 28], dtype="float32")
@@ -119,6 +108,23 @@ def bench_lenet_smoke(mesh, n_chips, platform, on_tpu):
         loss = pt.layers.mean(
             pt.layers.softmax_with_cross_entropy(logits, y))
         pt.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+    return main, startup, loss
+
+
+def bench_lenet_smoke(mesh, n_chips, platform, on_tpu):
+    """BASELINE config 1: MNIST LeNet single-chip smoke — the fluid
+    Program/Executor surface itself on the chip (feed numpy, fetch a
+    converging loss), not the jax-native path. Value is samples/s
+    through the FULL Program pipeline; vs_baseline=1.0 marks
+    convergence (loss halved), 0.0 otherwise."""
+    import numpy as np
+
+    import paddle_tpu as pt
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(256, 1, 28, 28).astype("float32")
+    Y = rng.randint(0, 10, (256, 1)).astype("int64")
+    main, startup, loss = _build_lenet_program(pt)
     place = pt.TPUPlace() if on_tpu else pt.CPUPlace()
     exe = pt.Executor(place)
     try:
@@ -166,6 +172,109 @@ def bench_lenet_smoke(mesh, n_chips, platform, on_tpu):
                        "scan_chained = cached-executable fast path "
                        "(one dispatch for all steps)"})
     return converged
+
+
+def bench_pipeline(mesh, n_chips, platform, on_tpu):
+    """Host-overlap pipeline block: the SAME LeNet Program trained on
+    the same per-step batches by (a) the per-call loop — one dispatch +
+    synchronous numpy fetch per step, the pre-async executor rhythm —
+    and (b) the streaming driver — run_stream window micro-chaining
+    with device prefetch and lazy fetches. Value is streaming
+    samples/s; vs_baseline = (streaming/per-call speedup) / 1.5, the
+    acceptance bar. detail carries both throughputs plus each phase's
+    host-blocked fraction (host_blocked_seconds delta over wall) and
+    the final-loss delta proving the drivers compute the same thing."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.observability import telemetry as T
+
+    rng = np.random.RandomState(0)
+    # Dispatch-bound regime (the INFER_BENCH/BENCH_r05 failure mode —
+    # host round trip ≫ device compute): small per-step batch so the
+    # per-call loop's fixed per-step host cost dominates. On TPU the
+    # tunnel makes EVERY shape dispatch-bound; on CPU this shape is
+    # where the regime lives.
+    bs, n_steps, window = 1, 128, 16
+    X = rng.rand(n_steps, bs, 1, 28, 28).astype("float32")
+    Y = rng.randint(0, 10, (n_steps, bs, 1)).astype("int64")
+    feeds = [{"x": X[i], "y": Y[i]} for i in range(n_steps)]
+    main, startup, loss = _build_lenet_program(pt)
+    place = pt.TPUPlace() if on_tpu else pt.CPUPlace()
+    exe = pt.Executor(place)
+
+    try:
+        # warm every executable on a throwaway scope so neither timed
+        # phase pays a compile (the program cache is scope-independent)
+        with pt.scope_guard(pt.Scope()):
+            exe.run(startup)
+            exe.run(main, feed=feeds[0], fetch_list=[loss])
+            for h in exe.run_stream(main, iter(feeds[:window + 1]),
+                                    fetch_list=[loss], window=window):
+                h.result()
+
+        def phase(streaming):
+            with pt.scope_guard(pt.Scope()):
+                exe.run(startup)
+                blocked0 = T.host_blocked_total()
+                t0 = time.perf_counter()
+                if streaming:
+                    last = None
+                    for h in exe.run_stream(main, iter(feeds),
+                                            fetch_list=[loss],
+                                            window=window):
+                        last = h
+                    final = float(np.asarray(last.result()[0]).ravel()[-1])
+                else:
+                    vals = [exe.run(main, feed=f, fetch_list=[loss])[0]
+                            for f in feeds]
+                    final = float(np.asarray(vals[-1]).reshape(()))
+                dt = time.perf_counter() - t0
+                blocked = T.host_blocked_total() - blocked0
+            return dt, final, blocked
+
+        # best-of-2 per driver: a noisy-neighbor CPU must not decide
+        # the speedup gate; losses are identical across repeats by
+        # construction (fresh scope, same seed, same feeds)
+        percall_dt, percall_loss, percall_blocked = min(
+            (phase(False) for _ in range(2)), key=lambda r: r[0])
+        stream_dt, stream_loss, stream_blocked = min(
+            (phase(True) for _ in range(2)), key=lambda r: r[0])
+    except Exception as e:
+        _emit_raw("pipeline_stream_samples_per_sec", 0.0, "samples/s",
+                  0.0, {"error": str(e)[:300]})
+        return False
+
+    percall_sps = bs * n_steps / percall_dt
+    stream_sps = bs * n_steps / stream_dt
+    speedup = stream_sps / percall_sps
+    loss_delta = abs(stream_loss - percall_loss)
+    blocked_percall = percall_blocked / percall_dt
+    blocked_stream = stream_blocked / stream_dt
+    # acceptance: 1.5x throughput, OR proven overlap where the
+    # per-call loop is host-bound (blocked > 70% while streaming
+    # stays < 30%) — the TPU-tunnel shape of the win
+    ok = (speedup >= 1.5
+          or (blocked_percall > 0.7 and blocked_stream < 0.3)) \
+        and loss_delta <= 1e-6 * max(1.0, abs(percall_loss))
+    _emit_raw("pipeline_stream_samples_per_sec", stream_sps, "samples/s",
+              speedup / 1.5,
+              {"platform": platform, "batch_size": bs, "steps": n_steps,
+               "window": window,
+               "per_call_samples_per_sec": round(percall_sps, 2),
+               "speedup": round(speedup, 3),
+               "host_blocked_frac_per_call": round(blocked_percall, 4),
+               "host_blocked_frac_stream": round(blocked_stream, 4),
+               "final_loss_per_call": round(percall_loss, 6),
+               "final_loss_stream": round(stream_loss, 6),
+               "loss_delta": loss_delta,
+               "note": "per-call = dispatch + sync numpy fetch per "
+                       "step; stream = run_stream unrolled-window "
+                       "micro-chaining + lazy fetches (device "
+                       "prefetch pays off on real TPU transfers, not "
+                       "CPU, so the CPU stream phase feeds host "
+                       "arrays)"})
+    return ok
 
 
 def bench_resnet50(mesh, n_chips, platform, on_tpu):
@@ -390,6 +499,8 @@ def bench_bert_long(mesh, n_chips, platform, on_tpu):
 BENCHES = [
     ("lenet", "lenet_mnist_program_smoke_samples_per_sec",
      "lenet_mnist_program_smoke_samples_per_sec", 600),
+    ("pipeline", "pipeline_stream_samples_per_sec",
+     "pipeline_stream_samples_per_sec", 600),
     ("resnet50", "resnet50_train_samples_per_sec_per_chip",
      "resnet_tiny_cpu_samples_per_sec", 900),
     ("transformer", "transformer_big_nmt_train_samples_per_sec_per_chip",
@@ -400,7 +511,8 @@ BENCHES = [
      "bert_tiny_cpu_samples_per_sec", 900),
 ]
 _BENCH_FNS = {
-    "lenet": bench_lenet_smoke, "resnet50": bench_resnet50,
+    "lenet": bench_lenet_smoke, "pipeline": bench_pipeline,
+    "resnet50": bench_resnet50,
     "transformer": bench_transformer_big, "bert_long": bench_bert_long,
     "bert": bench_bert,
 }

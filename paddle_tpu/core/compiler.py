@@ -28,10 +28,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..observability import telemetry as _telemetry
 from ..observability import tracing as _tracing
 from . import framework, lowering
-from .executor import (RNG_STATE_VAR, Scope, _as_fetch_name, _JitDispatch,
+from .executor import (RNG_STATE_VAR, Scope, _as_fetch_name,
+                       _finish_fetches, _JitDispatch, _normalize_feed,
                        _post_step_health, global_scope)
 from .framework import Program
-from .ir import normalize_dtype
 
 
 class ReduceStrategy(enum.IntEnum):
@@ -153,7 +153,8 @@ class CompiledProgram:
             self._mesh = Mesh(np.array(devices), ("data",))
         return self._mesh
 
-    def _run(self, executor, feed, fetch_list, scope, return_numpy):
+    def _run(self, executor, feed, fetch_list, scope, return_numpy,
+             sync: bool = True):
         with _telemetry.executor_step("sharded") as rec:
             program = self._program
             scope = scope if scope is not None else global_scope()
@@ -161,19 +162,7 @@ class CompiledProgram:
             fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
             mesh = self._get_mesh()
 
-            norm_feed = {}
-            for name, val in feed.items():
-                vdesc = None
-                for b in program.desc.blocks:
-                    if name in b.vars:
-                        vdesc = b.vars[name]
-                        break
-                arr = jnp.asarray(val)
-                if vdesc is not None:
-                    want = np.dtype(normalize_dtype(vdesc.dtype))
-                    if arr.dtype != want:
-                        arr = arr.astype(want)
-                norm_feed[name] = arr
+            norm_feed = _normalize_feed(program, feed)
             rec.set_feed(norm_feed)
 
             feed_sig = tuple(sorted((k, tuple(v.shape), str(v.dtype)) for k, v in norm_feed.items()))
@@ -190,8 +179,8 @@ class CompiledProgram:
                 fetches, new_rng = step(scope, norm_feed, rng)
             scope.set_var(RNG_STATE_VAR, new_rng)
             _post_step_health(step.writes, fetch_names, fetches, scope)
-            return [np.asarray(f) for f in fetches] if return_numpy \
-                else list(fetches)
+            return _finish_fetches(fetches, return_numpy, sync,
+                                   site="sharded")
 
 
 class _ShardedStep:

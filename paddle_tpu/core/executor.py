@@ -12,10 +12,14 @@ ExecutorPrepareContext cache (executor.py:831 program cache).
 from __future__ import annotations
 
 import contextlib
+import functools
+import os
 import threading
 import time
 import weakref
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from collections import OrderedDict
+from typing import (Any, Dict, Iterable, List, Optional, Sequence, Tuple,
+                    Union)
 
 import jax
 import jax.numpy as jnp
@@ -24,7 +28,7 @@ import numpy as np
 from ..observability import health as _health
 from ..observability import telemetry as _telemetry
 from ..observability import tracing as _tracing
-from . import framework, lowering
+from . import async_exec, framework, lowering
 from .framework import Program, Variable
 from .ir import normalize_dtype
 from .places import CPUPlace, Place, default_place
@@ -299,6 +303,111 @@ def _as_fetch_name(f) -> str:
     return str(f)
 
 
+@functools.lru_cache(maxsize=None)
+def _canonical_dtype_cached(want: str, x64: bool) -> np.dtype:
+    from jax import dtypes as _jdt
+
+    del x64  # part of the cache key only: canonicalization depends on it
+    return np.dtype(_jdt.canonicalize_dtype(np.dtype(want)))
+
+
+def _canonical_dtype(want) -> np.dtype:
+    """Feed-normalization target dtype, canonicalized to jax's x64
+    state. Without this, an int64-declared feed under 32-bit jax costs
+    an astype (plus a truncation warning) EVERY step on the hot path,
+    only for jnp to hand back int32 anyway. Cached per (dtype, x64
+    flag) — this runs once per feed var per step on every run path."""
+    return _canonical_dtype_cached(np.dtype(want).str,
+                                   bool(jax.config.jax_enable_x64))
+
+
+# run_stream unrolls its windows (straight-line XLA ~2x a rolled scan
+# on CPU conv bodies) only up to this size — unroll compile time grows
+# with n_steps, and past this the amortization no longer pays for it.
+_UNROLL_WINDOW_MAX = 32
+
+
+def _chained_cache_limit() -> int:
+    """Per-program bound on cached chained executables (PADDLE_TPU_
+    CHAINED_CACHE, default 8): every (n_steps, per_step_feeds) key is a
+    full XLA executable, so an unbounded map under a driver that varies
+    its window size is a memory leak with a compile bill attached."""
+    raw = os.environ.get("PADDLE_TPU_CHAINED_CACHE")
+    if not raw:
+        return 8
+    try:
+        return max(1, int(raw))
+    except ValueError:
+        return 8
+
+
+def _feed_signature(feed: Dict[str, Any]) -> Tuple:
+    """Shape/dtype signature of a feed dict — what decides whether two
+    per-step feeds can share a stacked window / compiled step."""
+    return tuple(sorted(
+        (k, tuple(getattr(v, "shape", ())),
+         str(getattr(v, "dtype", type(v).__name__)))
+        for k, v in feed.items()))
+
+
+def _stack_feed_window(feeds: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Collate same-signature per-step feeds with a leading [n] axis.
+    Host-resident windows take one memcpy + ONE transfer at dispatch
+    (np.stack) instead of K per-item transfers + a device concat;
+    device-resident (prefetched) values stay on device (jnp.stack)."""
+    def _stack(vals):
+        if all(isinstance(v, np.ndarray) for v in vals):
+            return np.stack(vals)
+        return jnp.stack(vals)
+
+    return {k: _stack([f[k] for f in feeds]) for k in feeds[0]}
+
+
+def _normalize_feed(program: Program, feed: Dict[str, Any]
+                    ) -> Dict[str, Any]:
+    """Feed normalization shared by every run path (Executor._lookup_
+    step, CompiledProgram._run, SPMDRunner.run): device-transfer via
+    jnp.asarray and cast to the var's declared dtype, canonicalized to
+    jax's x64 state."""
+    norm_feed = {}
+    for name, val in feed.items():
+        vdesc = None
+        for b in program.desc.blocks:
+            if name in b.vars:
+                vdesc = b.vars[name]
+                break
+        arr = jnp.asarray(val)
+        if vdesc is not None:
+            want = _canonical_dtype(normalize_dtype(vdesc.dtype))
+            if arr.dtype != want:
+                arr = arr.astype(want)
+        norm_feed[name] = arr
+    return norm_feed
+
+
+def _finish_fetches(fetches, return_numpy: bool, sync: bool,
+                    site: str = "executor"):
+    """Shared fetch epilogue for every run path. sync=False wraps the
+    device arrays in a lazy FetchHandle (nothing touches the host until
+    .result()). sync=True with return_numpy forces the classic
+    synchronous fetch — instrumented as host-blocked time, which is
+    exactly the per-step round trip the async paths exist to hide.
+    return_numpy=False returns the device arrays untouched."""
+    if not sync:
+        return async_exec.FetchHandle(fetches, site=site)
+    if not return_numpy:
+        return list(fetches)
+    t0 = time.perf_counter()
+    try:
+        jax.block_until_ready(fetches)
+    except Exception:
+        pass  # non-array fetches (rare lowering paths) convert below
+    out = [np.asarray(f) for f in fetches]
+    _telemetry.record_host_blocked("executor_sync",
+                                   time.perf_counter() - t0, stall=False)
+    return out
+
+
 class _CompiledStep:
     """One jitted program specialization."""
 
@@ -342,9 +451,14 @@ class _CompiledStep:
         self.fn = _JitDispatch(
             jax.jit(step, donate_argnums=(2,)), "step",
             meta={"fetches": len(fetch_names), "writes": len(writes)})
-        self._chained: Dict[int, Any] = {}
+        # LRU-bounded: each entry is a whole XLA executable (see
+        # _chained_cache_limit); evictions are counted in the registry.
+        # Key: (n_steps, per_step_feeds, unroll).
+        self._chained: "OrderedDict[Tuple[int, bool, bool], Any]" = \
+            OrderedDict()
 
-    def chained_fn(self, n_steps: int, per_step_feeds: bool = False):
+    def chained_fn(self, n_steps: int, per_step_feeds: bool = False,
+                   unroll: bool = False):
         """n_steps program iterations scan-chained in ONE executable.
         Amortizes the fixed per-invocation dispatch/host-tunnel cost
         (~100 ms on tunneled backends, PROFILE.md) so repeated-step
@@ -354,9 +468,17 @@ class _CompiledStep:
         trains in ONE dispatch (the fast path under
         train_from_dataset's batch loop). Reference analogue: the C++
         executor's prepared-context replay loop (executor.py:418
-        ExecutorPrepareContext)."""
-        fn = self._chained.get((n_steps, per_step_feeds))
+        ExecutorPrepareContext).
+
+        `unroll` unrolls the scan body: XLA optimizes the window as
+        straight-line code (on CPU a conv inside the rolled while-loop
+        runs ~2x slower than the same conv inlined), trading compile
+        time proportional to n_steps. The streaming driver uses it for
+        its small windows; leave it off for big n_steps."""
+        key = (n_steps, per_step_feeds, unroll)
+        fn = self._chained.get(key)
         if fn is not None:
+            self._chained.move_to_end(key)
             return fn
         step = self._step
         mut_keys = set(self.mut_reads)
@@ -394,7 +516,8 @@ class _CompiledStep:
 
             (mut_f, rest_f, rng_f), ys = jax.lax.scan(
                 body, (mut1, rest1, rng1),
-                jnp.arange(1, n_steps), length=n_steps - 1)
+                jnp.arange(1, n_steps), length=n_steps - 1,
+                unroll=bool(unroll))
             stacked = jax.tree_util.tree_map(
                 lambda f0, fs: jnp.concatenate([f0[None], fs]),
                 fetches0, ys)
@@ -405,19 +528,26 @@ class _CompiledStep:
         fn = _JitDispatch(
             jax.jit(chained, donate_argnums=(2,)), "chained",
             meta={"n_steps": int(n_steps),
-                  "per_step_feeds": bool(per_step_feeds)})
-        self._chained[(n_steps, per_step_feeds)] = fn
+                  "per_step_feeds": bool(per_step_feeds),
+                  "unroll": bool(unroll)})
+        self._chained[key] = fn
+        limit = _chained_cache_limit()
+        while len(self._chained) > limit:
+            self._chained.popitem(last=False)
+            _telemetry.record_chained_eviction()
         return fn
 
     def run_chained(self, scope: Scope, feed: Dict[str, Any], rng,
-                    n_steps: int, per_step_feeds: bool = False):
+                    n_steps: int, per_step_feeds: bool = False,
+                    unroll: bool = False):
         """Like __call__ but n_steps scan-chained; fetches come back
         stacked along a leading [n_steps] axis. With per_step_feeds,
         each feed value carries its own leading [n_steps] axis and step
         i consumes slice i."""
         const_states, mut_states = self._gather_states(scope)
         fetches, new_states, new_rng = self.chained_fn(
-            n_steps, per_step_feeds)(feed, const_states, mut_states, rng)
+            n_steps, per_step_feeds, unroll)(feed, const_states,
+                                             mut_states, rng)
         for n, v in new_states.items():
             scope.set_var(n, v)
         return fetches, new_rng
@@ -490,12 +620,19 @@ class Executor:
         scope: Optional[Scope] = None,
         return_numpy: bool = True,
         use_program_cache: bool = True,
+        sync: bool = True,
     ):
+        """One program step. sync=False returns a FetchHandle — the
+        device arrays stay put and the host moves on immediately;
+        .result() resolves to numpy on demand (async_exec). With
+        sync=True, return_numpy=False likewise returns the device
+        arrays untouched so callers can stay async by hand."""
         # CompiledProgram carries its own sharded run path (core/compiler.py).
         from .compiler import CompiledProgram
 
         if isinstance(program, CompiledProgram):
-            return program._run(self, feed, fetch_list, scope, return_numpy)
+            return program._run(self, feed, fetch_list, scope,
+                                return_numpy, sync=sync)
 
         program = program if program is not None else framework.default_main_program()
         scope = scope if scope is not None else global_scope()
@@ -535,8 +672,8 @@ class Executor:
             # post-step scan of every written state + fetch) are kept.
             _post_step_health(step.writes, fetch_names, fetches, scope)
 
-            return [np.asarray(f) for f in fetches] if return_numpy \
-                else list(fetches)
+            return _finish_fetches(fetches, return_numpy, sync,
+                                   site="executor")
 
     def _lookup_step(self, program: Program, feed: Dict[str, Any],
                      fetch_names: Tuple[str, ...], use_program_cache: bool):
@@ -544,20 +681,7 @@ class Executor:
         cache, keyed by (program identity+version, feed shapes/dtypes,
         fetches, mode) — the reference's ExecutorPrepareContext cache
         (executor.py:418/831)."""
-        norm_feed = {}
-        for name, val in feed.items():
-            vdesc = None
-            for b in program.desc.blocks:
-                if name in b.vars:
-                    vdesc = b.vars[name]
-                    break
-            arr = jnp.asarray(val)
-            if vdesc is not None:
-                want = np.dtype(normalize_dtype(vdesc.dtype))
-                if arr.dtype != want:
-                    arr = arr.astype(want)
-            norm_feed[name] = arr
-
+        norm_feed = _normalize_feed(program, feed)
         feed_sig = tuple(sorted((k, tuple(v.shape), str(v.dtype)) for k, v in norm_feed.items()))
         key = (id(program), program._version, feed_sig, fetch_names, program._is_test)
         step = self._cache.get(key) if use_program_cache else None
@@ -576,7 +700,7 @@ class Executor:
 
     def run_chained(self, program=None, feed=None, fetch_list=None,
                     n_steps=1, scope=None, return_numpy=True,
-                    per_step_feeds=False):
+                    per_step_feeds=False, sync=True, unroll=False):
         """Run `program` n_steps times inside one jitted lax.scan — the
         cached-executable fast path: a single dispatch covers n_steps
         iterations, so per-step overhead is framework+compute time
@@ -617,11 +741,86 @@ class Executor:
                 with jax.default_device(self.place.jax_device()):
                     fetches, new_rng = step.run_chained(
                         scope, norm_feed, rng, int(n_steps),
-                        per_step_feeds=bool(per_step_feeds))
+                        per_step_feeds=bool(per_step_feeds),
+                        unroll=bool(unroll))
             scope.set_var(RNG_STATE_VAR, new_rng)
             _post_step_health(step.writes, fetch_names, fetches, scope)
-            return [np.asarray(f) for f in fetches] if return_numpy \
-                else list(fetches)
+            return _finish_fetches(fetches, return_numpy, sync,
+                                   site="chained")
+
+    def run_stream(self, program=None, feed_iter: Optional[Iterable] = None,
+                   fetch_list=None, window: int = 8, scope=None,
+                   in_flight: int = async_exec.DEFAULT_IN_FLIGHT):
+        """Streaming driver: consume an ITERATOR of per-step feed dicts
+        and yield one lazy FetchHandle per window of up to `window`
+        micro-chained steps — the cached-executable amortization of
+        run_chained without requiring all feeds pre-stacked up front.
+
+        Feeds are buffered until the window fills (or the feed
+        signature changes — e.g. a short final batch — or the iterator
+        ends), host-collated with a leading [n] axis, and dispatched as
+        ONE chained executable with per_step_feeds=True. Each yielded
+        handle carries `.start_step`/`.n_steps`; its `.result()` is the
+        stacked fetch list. A bounded InFlightWindow (`in_flight`,
+        default 2) resolves the oldest handle before admitting a new
+        one, so no more than `in_flight` windows of fetch buffers are
+        ever device-resident; the remainder are drained when the
+        generator closes. Feeds may already be device arrays (a
+        DevicePrefetcher upstream) — collation then stays on device.
+
+        Scope state after exhaustion matches per-step `run` calls; see
+        RESILIENCE.md for the window-boundary semantics the
+        fault-tolerant drivers layer on top."""
+        if feed_iter is None:
+            raise ValueError("run_stream needs a feed iterator")
+        program = program if program is not None \
+            else framework.default_main_program()
+        scope = scope if scope is not None else global_scope()
+        window = max(1, int(window))
+        win = async_exec.InFlightWindow(limit=in_flight, site="stream")
+
+        def gen():
+            buf: List[Dict[str, Any]] = []
+            sig = None
+            step0 = 0
+
+            def flush():
+                nonlocal buf, step0
+                feeds, buf = buf, []
+                n = len(feeds)
+                stacked = _stack_feed_window(feeds)
+                # the explicit reserve is load-bearing: it must run
+                # BEFORE run_chained creates the new handle, or
+                # limit+1 windows of buffers coexist transiently
+                # (admit's own reserve would fire too late)
+                win.reserve()
+                h = self.run_chained(program, feed=stacked,
+                                     fetch_list=fetch_list, n_steps=n,
+                                     per_step_feeds=True, scope=scope,
+                                     sync=False,
+                                     unroll=n <= _UNROLL_WINDOW_MAX)
+                h.start_step, h.n_steps = step0, n
+                step0 += n
+                return win.admit(h)
+
+            try:
+                for feed in feed_iter:
+                    feed = dict(feed)
+                    s = _feed_signature(feed)
+                    if buf and s != sig:
+                        yield flush()
+                    sig = s
+                    buf.append(feed)
+                    if len(buf) >= window:
+                        yield flush()
+                if buf:
+                    yield flush()
+            finally:
+                # resolve stragglers so device fetch buffers free even
+                # when the consumer abandons the stream mid-way
+                win.drain()
+
+        return gen()
 
     def _get_rng(self, scope: Scope, program: Program):
         rng = scope.find_var(RNG_STATE_VAR)

@@ -238,6 +238,19 @@ class Predictor:
         return jitted.warm(shapes, state)
 
     def run(self, inputs: Sequence[PaddleTensor]) -> List[PaddleTensor]:
+        return self.run_handle(inputs).result()
+
+    def run_handle(self, inputs: Sequence[PaddleTensor]):
+        """Dispatch without fetching: returns a lazy
+        core.async_exec.FetchHandle whose `.result()` is the
+        List[PaddleTensor] `run` would return — pad-slice bucketing
+        postprocessing included. The device computes while the caller
+        (e.g. the serving Engine) does other host work; resolution
+        records the dispatch-to-ready latency. On the native engine
+        (no XLA, synchronous by construction) the handle is
+        pre-computed."""
+        from .core.async_exec import FetchHandle
+
         if self._native is not None:
             feed = {}
             for i, t in enumerate(inputs):
@@ -248,8 +261,11 @@ class Predictor:
                 feed[name] = np.asarray(t.data).astype(dt) if dt \
                     else np.asarray(t.data)
             outs = self._native.run(feed)
-            return [PaddleTensor(o, name=n)
-                    for n, o in zip(self._fetch_names, outs)]
+            return FetchHandle(
+                outs, site="infer",
+                transform=lambda arrs: [PaddleTensor(o, name=n)
+                                        for n, o in zip(self._fetch_names,
+                                                        arrs)])
         feeds = {}
         for i, t in enumerate(inputs):
             name = t.name or self._feed_names[i]
@@ -282,20 +298,28 @@ class Predictor:
                            for n, v in feeds.items()))
         jitted, state = self._compiled(sig)
         outs = jitted({n: jnp.asarray(v) for n, v in feeds.items()}, state)
-        results = []
-        for o, name in zip(outs, self._fetch_names):
-            a = np.asarray(o)
-            if true_n is not None and a.ndim and a.shape[0] == bucket \
-                    and self._fetch_batched.get(name) is not False:
-                a = a[:true_n]
-            results.append(PaddleTensor(a, name=name))
-        return results
+
+        def postprocess(arrs):
+            results = []
+            for a, name in zip(arrs, self._fetch_names):
+                if true_n is not None and a.ndim \
+                        and a.shape[0] == bucket \
+                        and self._fetch_batched.get(name) is not False:
+                    a = a[:true_n]
+                results.append(PaddleTensor(a, name=name))
+            return results
+
+        return FetchHandle(outs, site="infer", transform=postprocess)
 
     # numpy-dict convenience API
     def predict(self, **feeds) -> Dict[str, np.ndarray]:
+        return self.predict_handle(**feeds).result()
+
+    def predict_handle(self, **feeds):
+        """Lazy predict: dispatch now, numpy dict on `.result()`."""
         tensors = [PaddleTensor(v, name=k) for k, v in feeds.items()]
-        outs = self.run(tensors)
-        return {t.name: t.data for t in outs}
+        return self.run_handle(tensors).map(
+            lambda ts: {t.name: t.data for t in ts})
 
 
 def create_paddle_predictor(config: AnalysisConfig) -> Predictor:

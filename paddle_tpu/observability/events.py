@@ -44,7 +44,7 @@ __all__ = ["emit", "recent", "clear", "log_path", "read_jsonl",
 # recovery-policy actions, and launcher rank restarts.
 KINDS = ("compile", "step_summary", "anomaly", "checkpoint",
          "serve_start", "serve_stop", "restore", "preempt", "fault",
-         "recovery", "rank_restart")
+         "recovery", "rank_restart", "pipeline_stall")
 
 # Ring bound: a week-long run emitting a compile+summary event per minute
 # stays far under this; anomaly storms get truncated to the latest window.

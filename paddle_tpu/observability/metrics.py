@@ -93,6 +93,12 @@ class Counter(_Metric):
         with self._lock:
             return self._values.get(key, 0)
 
+    def total(self) -> float:
+        """Sum across every label set — the whole-process view a bench
+        wants (e.g. host-blocked seconds regardless of site)."""
+        with self._lock:
+            return float(sum(self._values.values()))
+
 
 class Gauge(_Metric):
     """Point-in-time value (cache entries, examples/sec, bubble fraction)."""

@@ -29,6 +29,10 @@ __all__ = [
     "record_executor_step", "record_cache_event", "record_trainer_step",
     "record_trainer_run", "record_spmd_step", "record_pipeline_trace",
     "record_compile", "record_device_memory",
+    "record_host_blocked", "record_dispatch_ready",
+    "record_prefetch_depth", "record_prefetch_item",
+    "record_async_inflight", "record_chained_eviction",
+    "host_blocked_total",
 ]
 
 EXEC_STEPS = _m.counter(
@@ -107,6 +111,40 @@ DEVICE_LIVE_BYTES = _m.gauge(
 DEVICE_LIVE_BUFFERS = _m.gauge(
     "paddle_tpu_device_live_buffers",
     "Count of live device arrays")
+
+# -- host-overlap pipeline (core/async_exec.py) -----------------------------
+# The host-overlap story in three numbers: how long the host sat blocked
+# on the device (should be ~0 when the pipeline hides transfers), how
+# long a dispatched fetch took to become ready (device-side latency the
+# host never has to see), and how full the prefetch buffer ran (0 depth
+# at steady state = the consumer is input-bound).
+HOST_BLOCKED_SECONDS = _m.counter(
+    "paddle_tpu_host_blocked_seconds_total",
+    "Wall seconds the host spent blocked waiting on device results or "
+    "an empty prefetch queue, by site (executor_sync|fetch:*|"
+    "prefetch:*)", labelnames=("site",))
+DISPATCH_READY_SECONDS = _m.histogram(
+    "paddle_tpu_dispatch_ready_seconds",
+    "Latency from dispatch to the fetched values being ready on host",
+    labelnames=("site",))
+PREFETCH_DEPTH = _m.gauge(
+    "paddle_tpu_prefetch_queue_depth",
+    "Items buffered in a prefetch stage right after the last put/get",
+    labelnames=("stage",))
+PREFETCH_ITEMS = _m.counter(
+    "paddle_tpu_prefetch_items_total",
+    "Items that passed through a prefetch stage", labelnames=("stage",))
+PIPELINE_STALLS = _m.counter(
+    "paddle_tpu_pipeline_stalls_total",
+    "Host blocks longer than PADDLE_TPU_STALL_EVENT_S (default 0.1s) — "
+    "each also appends a pipeline_stall event", labelnames=("site",))
+ASYNC_INFLIGHT = _m.gauge(
+    "paddle_tpu_async_inflight_fetches",
+    "Unresolved FetchHandles currently holding device buffers")
+CHAINED_EVICTIONS = _m.counter(
+    "paddle_tpu_chained_cache_evictions_total",
+    "Chained-executable cache entries evicted by the per-program LRU "
+    "bound (PADDLE_TPU_CHAINED_CACHE)")
 
 
 def record_executor_step(mode: str, seconds: float, feed_bytes: int):
@@ -195,6 +233,63 @@ def record_compile(kind: str, seconds: float,
 def record_device_memory(nbytes: int, nbuffers: int):
     DEVICE_LIVE_BYTES.set(nbytes)
     DEVICE_LIVE_BUFFERS.set(nbuffers)
+
+
+def _stall_event_threshold_s() -> float:
+    import os
+
+    raw = os.environ.get("PADDLE_TPU_STALL_EVENT_S")
+    if not raw:
+        return 0.1
+    try:
+        v = float(raw)
+    except ValueError:
+        return 0.1
+    return v if v > 0 else 0.1
+
+
+def record_host_blocked(site: str, seconds: float, stall: bool = True):
+    """Wall time the host spent waiting on the device (or on an empty
+    prefetch queue). Blocks past the stall threshold also count as
+    pipeline stalls and land in the event log — a stall timeline is how
+    an input-bound run is diagnosed after the fact. Pass stall=False
+    for sites where blocking is the caller's NORMAL rhythm (the
+    deliberately-synchronous fetch epilogue): its seconds still feed
+    the host-overlap fraction, but a 150 ms sync step is not a stall
+    and must not emit one event per step."""
+    if seconds <= 0:
+        return
+    HOST_BLOCKED_SECONDS.inc(seconds, site=site)
+    if stall and seconds >= _stall_event_threshold_s():
+        PIPELINE_STALLS.inc(site=site)
+        _events.emit("pipeline_stall", site=site,
+                     seconds=round(seconds, 6))
+
+
+def record_dispatch_ready(site: str, seconds: float):
+    DISPATCH_READY_SECONDS.observe(seconds, site=site)
+
+
+def record_prefetch_depth(stage: str, depth: int):
+    PREFETCH_DEPTH.set(depth, stage=stage)
+
+
+def record_prefetch_item(stage: str):
+    PREFETCH_ITEMS.inc(stage=stage)
+
+
+def record_async_inflight(n: int):
+    ASYNC_INFLIGHT.set(n)
+
+
+def record_chained_eviction():
+    CHAINED_EVICTIONS.inc()
+
+
+def host_blocked_total() -> float:
+    """Process-wide host-blocked seconds across every site — what
+    bench.py divides by wall time for the host-overlap fraction."""
+    return HOST_BLOCKED_SECONDS.total()
 
 
 def record_pipeline_trace(axis: str, stages: int, n_micro: int):

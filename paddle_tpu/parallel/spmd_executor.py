@@ -28,10 +28,10 @@ from ..observability import telemetry as _telemetry
 from ..observability import tracing as _tracing
 from ..core import framework, lowering
 from ..core.executor import (RNG_STATE_VAR, Scope, _as_fetch_name,
-                             _JitDispatch, _health_scan,
-                             _record_live_device_memory, global_scope)
+                             _finish_fetches, _JitDispatch, _health_scan,
+                             _normalize_feed, _record_live_device_memory,
+                             global_scope)
 from ..core.framework import Program
-from ..core.ir import normalize_dtype
 
 
 def _shard_map(f, mesh, in_specs, out_specs, axis_names, check_vma):
@@ -65,7 +65,7 @@ class SPMDRunner:
         self._cache: Dict[Any, Any] = {}
 
     def run(self, executor, feed=None, fetch_list=None, scope: Optional[Scope] = None,
-            return_numpy: bool = True):
+            return_numpy: bool = True, sync: bool = True):
         # timer covers feed normalization + cache lookup + dispatch,
         # matching Executor.run's span
         t0 = time.perf_counter()
@@ -74,20 +74,7 @@ class SPMDRunner:
         feed = dict(feed or {})
         fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
 
-        norm_feed = {}
-        for name, val in feed.items():
-            vdesc = None
-            for b in program.desc.blocks:
-                if name in b.vars:
-                    vdesc = b.vars[name]
-                    break
-            arr = jnp.asarray(val)
-            if vdesc is not None:
-                want = np.dtype(normalize_dtype(vdesc.dtype))
-                if arr.dtype != want:
-                    arr = arr.astype(want)
-            norm_feed[name] = arr
-
+        norm_feed = _normalize_feed(program, feed)
         sig = tuple(sorted((k, tuple(v.shape), str(v.dtype))
                            for k, v in norm_feed.items()))
         key = (program._version, sig, fetch_names)
@@ -112,8 +99,7 @@ class SPMDRunner:
             # multi-device runs are where buffer leaks hurt most — the
             # live-bytes gauge must not go dark on the SPMD-only path
             _record_live_device_memory()
-        out = [np.asarray(f) for f in fetches] if return_numpy \
-            else list(fetches)
+        out = _finish_fetches(fetches, return_numpy, sync, site="spmd")
         _telemetry.record_spmd_step(self.axis, time.perf_counter() - t0,
                                     step.collective_counts)
         return out

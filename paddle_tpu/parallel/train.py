@@ -256,7 +256,8 @@ def make_train_step(
 
 def train_loop(step_fn, state: TrainState, batches, *, rng=None,
                manager=None, save_every: Optional[int] = None,
-               controller=None, max_steps: Optional[int] = None):
+               controller=None, max_steps: Optional[int] = None,
+               fetch_window: Optional[int] = None):
     """Fault-tolerance-aware driver for a `make_train_step` step_fn.
 
     The step boundary is the only safe interruption point (no donated
@@ -288,9 +289,23 @@ def train_loop(step_fn, state: TrainState, batches, *, rng=None,
     reason. Returns (state, losses, stop) where `losses` maps executed
     step number -> float loss and `stop` is
     "completed" | "preempted" | "exhausted".
+
+    Loss fetching is ASYNC by default: `float(loss)` every step is a
+    full host round trip that serializes the device on the host loop,
+    so losses are parked as lazy FetchHandles and resolved only when
+    `fetch_window` (default 2) of them are outstanding — the host runs
+    ahead dispatching while the device computes, blocking only when it
+    outruns the device by the window (recorded as host-blocked time).
+    The trajectory is bit-identical to synchronous fetching: the same
+    arrays are resolved, just later. A per-step loss CONSUMER forces
+    fetch_window=1 automatically: health numerics checks and recovery
+    controllers must see step N's loss before step N+1 dispatches.
     """
     import time as _time
 
+    from collections import deque as _deque
+
+    from ..core import async_exec as _async
     from ..observability import events as _events
     from ..observability import health as _health
     from ..resilience import faults as _faults
@@ -306,13 +321,33 @@ def train_loop(step_fn, state: TrainState, batches, *, rng=None,
     losses: Dict[int, float] = {}
     steps_done = 0
     stop = "completed"
+    window = max(1, int(fetch_window or _async.DEFAULT_IN_FLIGHT))
+    if controller is not None or _health.check_level():
+        window = 1  # per-step loss consumers need the value NOW
+    pending: "_deque[Tuple[int, Any]]" = _deque()
+
+    def _resolve_oldest():
+        step_i, h = pending.popleft()
+        # backpressure keeping run-ahead bounded, not a pipeline stall
+        losses[step_i] = float(np.asarray(
+            h.result(stall=False)[0]).reshape(()))
+
+    # async mode tracks the step number host-side: `int(state.step)` is
+    # a device fetch of the step JUST dispatched, so deriving it every
+    # iteration would re-serialize the loop the fetch window exists to
+    # overlap. The counter is seeded from the (possibly restored) state
+    # once and advances with each successful step — the sync/controller
+    # paths keep reading the authoritative device value (rollback
+    # rewinds it).
+    host_step = int(state.step) if window > 1 else None
     t0 = _time.perf_counter()
     try:
         while True:
             if max_steps is not None and steps_done >= max_steps:
                 stop = "exhausted"
                 break
-            step_no = int(state.step)
+            step_no = host_step if host_step is not None \
+                else int(state.step)
             _faults.check("step", step=step_no)
             if _preempt.stop_requested():
                 stop = "preempted"
@@ -336,11 +371,21 @@ def train_loop(step_fn, state: TrainState, batches, *, rng=None,
             step_rng = jax.random.fold_in(rng, step_no)
             try:
                 state, loss = step_fn(state, batch, step_rng)
-                loss_val = float(loss)
-                if _health.check_level():
-                    _health.check_numerics(
-                        "trainer_loss", [("loss", loss_val)],
-                        step=step_no)
+                if window > 1:
+                    # resolve-first: never more than `window` handles
+                    # (and their device buffers) outstanding at once
+                    while len(pending) >= window:
+                        _resolve_oldest()
+                    pending.append((step_no, _async.FetchHandle(
+                        [loss], site="train_loop")))
+                    host_step += 1
+                else:
+                    loss_val = float(loss)
+                    if _health.check_level():
+                        _health.check_numerics(
+                            "trainer_loss", [("loss", loss_val)],
+                            step=step_no)
+                    losses[step_no] = loss_val
             except _health.NumericsError as e:
                 if controller is None:
                     raise
@@ -348,12 +393,15 @@ def train_loop(step_fn, state: TrainState, batches, *, rng=None,
                 if action == "skip_batch":
                     steps_done += 1
                 continue
-            losses[step_no] = loss_val
             steps_done += 1
+            completed = host_step if host_step is not None \
+                else int(state.step)
             if (manager is not None and save_every
-                    and int(state.step) % save_every == 0):
+                    and completed % save_every == 0):
                 manager.save(state)
     finally:
+        while pending:  # drain: every executed step's loss lands
+            _resolve_oldest()
         if controller is not None:
             controller.detach()
     seconds = _time.perf_counter() - t0

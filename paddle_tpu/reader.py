@@ -5,19 +5,22 @@ The reference pushes LoDTensors into a C++ LoDTensorBlockingQueue consumed by
 a graph-embedded `read` op with double-buffering to GPU
 (operators/reader/buffered_reader.cc). The TPU-native pipeline keeps the
 same shape: a background thread runs the user generator into a bounded
-queue (the C++ datafeed library provides the high-throughput path, see
-paddle_tpu/data/), and iteration yields feed dicts; device transfer overlaps
-via jax async dispatch.
+host queue (core/async_exec.Prefetcher — producer errors propagate to
+the iterating consumer, and the thread is joined when iteration stops
+early), and with `use_double_buffer` + places a second Prefetcher stage
+runs `jax.device_put` (sharded over the active SPMD mesh) into a
+bounded double buffer, so batch N+1 is on device while step N computes
+and batch N+2 is being collated on the host.
 """
 
 from __future__ import annotations
 
-import queue
-import threading
 from typing import Callable, Iterable, List, Optional, Sequence
 
 import numpy as np
 
+from .core.async_exec import (DevicePrefetcher, Prefetcher,
+                              device_prefetch_wanted)
 from .core.framework import Variable
 
 __all__ = ["DataLoader", "PyReader", "GeneratorLoader"]
@@ -41,6 +44,7 @@ class GeneratorLoader:
         self._generator: Optional[Callable] = None
         self._places = None
         self._batched = False
+        self._use_double_buffer = bool(use_double_buffer)
 
     @property
     def feed_list(self):
@@ -96,23 +100,24 @@ class GeneratorLoader:
 
     def __iter__(self):
         assert self._generator is not None, "call set_*_generator first"
-        q: "queue.Queue" = queue.Queue(maxsize=self._capacity)
-        stop = object()
-
-        def producer():
-            try:
-                for item in self._generator():
-                    q.put(item)
-            finally:
-                q.put(stop)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        while True:
-            item = q.get()
-            if item is stop:
-                break
-            yield item
+        # host producer stage: the bounded background queue the
+        # reference's LoDTensorBlockingQueue provides. Prefetcher owns
+        # the lifecycle — a generator exception re-raises HERE (not a
+        # silent hang/truncation), and the finally clause joins the
+        # thread when the consumer stops iterating early.
+        host = Prefetcher(self._generator(), depth=self._capacity,
+                          stage="host")
+        device = None
+        if device_prefetch_wanted(self._places, self._use_double_buffer):
+            # prefetch-to-device: batches go up via jax.device_put
+            # (sharded over the active SPMD mesh) two batches ahead
+            device = DevicePrefetcher(host, depth=2)
+        try:
+            yield from (device if device is not None else host)
+        finally:
+            if device is not None:
+                device.close()
+            host.close()
 
     # reference idiom: `for data in loader():`
     def __call__(self):
@@ -140,10 +145,12 @@ class DataLoader:
                                use_double_buffer)
 
     @staticmethod
-    def from_dataset(dataset, places=None, drop_last=True):
+    def from_dataset(dataset, places=None, drop_last=True,
+                     use_double_buffer=False):
         from .dataset_loader import DatasetLoader
 
-        return DatasetLoader(dataset, places, drop_last)
+        return DatasetLoader(dataset, places, drop_last,
+                             use_double_buffer=use_double_buffer)
 
 
 class PyReader(GeneratorLoader):

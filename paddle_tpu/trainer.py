@@ -5,13 +5,26 @@ framework/trainer.h:38, device_worker.h:103, SURVEY §3.6).
 Round-1: a host-side trainer loop over a Dataset's file shards feeding the
 compiled step (HogwildWorker semantics, hogwild_worker.cc:163); the C++
 datafeed library (paddle_tpu/data/) supplies the pipelined batch source.
+
+Since the host-overlap PR the default driver is STREAMING: batches are
+micro-chained into windows of PADDLE_TPU_STREAM_WINDOW steps (default 8,
+1 restores the per-step loop), dispatched as one cached executable each
+(core/executor.run_stream), with losses fetched lazily — the host only
+blocks on the device when a window's values are actually needed (debug
+prints, health checks) or when the bounded in-flight window applies
+backpressure. Preemption is honored at window boundaries; an active
+PADDLE_TPU_FAULT_SPEC drops the window to 1 so per-step fault schedules
+keep their exact step semantics (see RESILIENCE.md §Streaming windows).
 """
 
 from __future__ import annotations
 
+import contextlib
 import time
+from collections import deque
 from typing import Optional, Sequence
 
+from .core import async_exec as _async
 from .observability import events as _events
 from .observability import health as _health
 from .observability import telemetry as _telemetry
@@ -37,6 +50,62 @@ def _batch_examples(feed) -> int:
     return 0
 
 
+def _stream_window() -> int:
+    """Effective streaming window: the env default, forced to 1 while a
+    per-step consumer is active. A fault spec (step=N:crash) must see a
+    check at every step, not every window boundary; raise-level
+    numerics checking (PADDLE_TPU_CHECK_NUMERICS=2 or the legacy
+    FLAGS_check_nan_inf) must stop BEFORE the next step dispatches —
+    a windowed driver would let window-1 further steps mutate the
+    scope on NaN state before the boundary check raised."""
+    window = _async.stream_window_default()
+    if window <= 1:
+        return window
+    if _faults.active() or _health.check_level() >= 2:
+        return 1
+    from .core.flags import get_flag
+
+    if get_flag("FLAGS_check_nan_inf"):
+        return 1
+    return window
+
+
+def _preempting_feed_src(batches, ex_pending, on_preempt=None):
+    """Feed generator shared by the streaming drivers: checks for a
+    graceful-stop request before each batch (so a preemption landing
+    mid-window cuts the window short at a step boundary) and records
+    per-batch example counts for the telemetry split."""
+    for feed in batches:
+        if _preempt.stop_requested():
+            if on_preempt is not None:
+                on_preempt()
+            return
+        ex_pending.append(_batch_examples(feed))
+        yield feed
+
+
+def _record_window_steps(n, dt, ex_pending) -> int:
+    """Per-STEP telemetry for an n-step window that took dt wall
+    seconds — counters stay driver-independent. Returns the window's
+    example count."""
+    total = 0
+    for _ in range(n):
+        ex = ex_pending.popleft() if ex_pending else 0
+        total += ex
+        _telemetry.record_trainer_step(dt / n, ex)
+    return total
+
+
+def _check_window_numerics(names, vals, n, step_base):
+    """Per-step slices keep the anomaly's step attribution exact even
+    though the window resolved as one stacked fetch."""
+    for i in range(n):
+        _health.check_numerics(
+            "trainer_loss",
+            [(nm, v[i]) for nm, v in zip(names, vals)],
+            step=step_base + i)
+
+
 def train_from_dataset(executor, program=None, dataset=None, scope=None,
                        thread=0, debug=False, fetch_list=None,
                        fetch_info=None, print_period=100):
@@ -47,12 +116,21 @@ def train_from_dataset(executor, program=None, dataset=None, scope=None,
         raise ValueError("dataset is required")
     fetch_list = fetch_list or []
     names = _fetch_names(fetch_list, fetch_info)
-    step = 0
-    examples = 0
-    run_t0 = time.perf_counter()
     batches = dataset._iter_batches() if hasattr(dataset, "_iter_batches") \
         else iter(dataset)
     _preempt.maybe_install_from_env()
+    window = _stream_window()
+    # duck-typed executors (tests, remote stubs) without the streaming
+    # surface get the classic per-step loop, as do CompiledProgram-like
+    # inputs (no .desc — they carry their own sharded run path that
+    # executor.run delegates to)
+    if window > 1 and hasattr(executor, "run_stream") \
+            and hasattr(program, "desc"):
+        return _train_streaming(executor, program, batches, scope, debug,
+                                fetch_list, names, print_period, window)
+    step = 0
+    examples = 0
+    run_t0 = time.perf_counter()
     stop = "completed"
     for feed in batches:
         # step boundary: the only safe stop/injection point (see
@@ -84,6 +162,55 @@ def train_from_dataset(executor, program=None, dataset=None, scope=None,
     return None
 
 
+def _train_streaming(executor, program, batches, scope, debug, fetch_list,
+                     names, print_period, window):
+    """Windowed driver behind train_from_dataset: one chained dispatch
+    per window, lazy fetches, preemption honored between batches (so a
+    request lands at a step boundary mid-window: the current window is
+    cut short and flushed). Step/example telemetry stays per-step so
+    counters are driver-independent."""
+    step = 0
+    examples = 0
+    run_t0 = time.perf_counter()
+    outcome = {"stop": "completed"}
+    ex_pending = deque()
+
+    def on_preempt():
+        outcome["stop"] = "preempted"
+
+    check_lvl = _health.check_level()
+    want_vals = bool(fetch_list) and bool(debug or check_lvl)
+    t_last = time.perf_counter()
+    for h in executor.run_stream(
+            program, _preempting_feed_src(batches, ex_pending, on_preempt),
+            fetch_list=fetch_list, window=window, scope=scope):
+        n = h.n_steps
+        now = time.perf_counter()
+        dt = now - t_last
+        t_last = now
+        examples += _record_window_steps(n, dt, ex_pending)
+        if want_vals:
+            vals = h.result()  # stacked [n, ...] per fetch name
+            if check_lvl:
+                _check_window_numerics(names, vals, n, h.start_step)
+            if debug:
+                for i in range(n):
+                    s = h.start_step + i
+                    if s % print_period == 0:
+                        print(f"step {s}: " + ", ".join(
+                            f"{nm}={v[i]}"
+                            for nm, v in zip(names, vals)))
+        step += n
+    seconds = time.perf_counter() - run_t0
+    _telemetry.record_trainer_run(seconds, examples)
+    _events.emit("step_summary", site="train_from_dataset", steps=step,
+                 examples=examples, seconds=round(seconds, 6),
+                 examples_per_sec=round(examples / seconds, 3)
+                 if seconds > 0 else 0.0, stop=outcome["stop"],
+                 window=window)
+    return None
+
+
 def infer_from_dataset(executor, program=None, dataset=None, scope=None,
                        thread=0, debug=False, fetch_list=None,
                        fetch_info=None, print_period=100):
@@ -112,11 +239,12 @@ class TrainerDesc:
 class HogwildWorker:
     """One training thread: pull batches from its dataset shard, run the
     compiled step against the SHARED scope (reference:
-    hogwild_worker.cc:163 TrainFiles). The device step itself is
-    serialized by a shared lock — the XLA step donates parameter buffers
-    for the in-place update, so two in-flight steps would race on freed
-    buffers; threads overlap on the C++ reader pipeline and host-side
-    batch prep instead (one chip executes one step at a time anyway)."""
+    hogwild_worker.cc:163 TrainFiles). Dispatch is serialized by a shared
+    lock — the XLA step donates parameter buffers for the in-place
+    update; with the streaming driver the lock covers the window
+    dispatch (next() on the stream) while execution itself overlaps via
+    jax async dispatch, and threads additionally overlap on the C++
+    reader pipeline and host-side batch prep."""
 
     def __init__(self, worker_id, executor, program, dataset, scope,
                  desc: TrainerDesc, step_lock=None):
@@ -130,14 +258,19 @@ class HogwildWorker:
         self.steps = 0
         self.last_fetch = None
 
-    def train(self):
-        import contextlib
+    def _batches(self):
+        return self.dataset._iter_batches() if hasattr(
+            self.dataset, "_iter_batches") else iter(self.dataset)
 
+    def train(self):
+        window = _stream_window()
+        if window > 1 and hasattr(self.executor, "run_stream") \
+                and hasattr(self.program, "desc"):
+            return self._train_streaming(window)
         names = _fetch_names(self.desc.fetch_list, self.desc.fetch_info)
         run_t0 = time.perf_counter()
         examples = 0
-        for feed in self.dataset._iter_batches() if hasattr(
-                self.dataset, "_iter_batches") else iter(self.dataset):
+        for feed in self._batches():
             _faults.check("step", step=self.steps)
             if _preempt.stop_requested():
                 break  # graceful stop at the step boundary
@@ -165,6 +298,91 @@ class HogwildWorker:
         _events.emit("step_summary", site="hogwild_worker",
                      worker=self.worker_id, steps=self.steps,
                      examples=examples, seconds=round(seconds, 6))
+
+    def _train_streaming(self, window):
+        from .core.executor import (_UNROLL_WINDOW_MAX, _feed_signature,
+                                    _stack_feed_window)
+
+        names = _fetch_names(self.desc.fetch_list, self.desc.fetch_info)
+        fetch_list = self.desc.fetch_list
+        check_lvl = _health.check_level()
+        run_t0 = time.perf_counter()
+        state = {"examples": 0, "t_last": run_t0, "last": None}
+        ex_pending = deque()
+        lock = self.step_lock if self.step_lock is not None \
+            else contextlib.nullcontext()
+        win = _async.InFlightWindow(limit=_async.DEFAULT_IN_FLIGHT,
+                                    site="hogwild")
+
+        def consume(h, n):
+            now = time.perf_counter()
+            dt = now - state["t_last"]
+            state["t_last"] = now
+            state["examples"] += _record_window_steps(n, dt, ex_pending)
+            want_print = fetch_list and any(
+                (self.steps + i + 1) % self.desc.print_period == 0
+                for i in range(n))
+            if fetch_list and (check_lvl or want_print):
+                vals = h.result()
+                if check_lvl:
+                    _check_window_numerics(names, vals, n, self.steps)
+                if want_print:
+                    for i in range(n):
+                        s = self.steps + i + 1
+                        if s % self.desc.print_period == 0:
+                            print(f"worker {self.worker_id} step {s}: " +
+                                  ", ".join(f"{nm}={v[i]}" for nm, v in
+                                            zip(names, vals)))
+            self.steps += n
+            state["last"] = h
+
+        def dispatch(feeds):
+            # collate and backpressure-resolve OUTSIDE the shared lock
+            # (another worker's dispatch must not wait on our input or
+            # on the device draining our previous window); only the
+            # dispatch itself — donated-buffer territory — serializes.
+            n = len(feeds)
+            stacked = _stack_feed_window(feeds)
+            win.reserve()
+            with lock:
+                h = self.executor.run_chained(
+                    self.program, feed=stacked, fetch_list=fetch_list,
+                    n_steps=n, per_step_feeds=True, scope=self.scope,
+                    sync=False, unroll=n <= _UNROLL_WINDOW_MAX)
+            win.admit(h)
+            consume(h, n)
+
+        buf, sig = [], None
+        try:
+            # batch pull happens on THIS thread, outside the lock, so a
+            # slow dataset shard starves only its own worker
+            for feed in _preempting_feed_src(self._batches(), ex_pending):
+                feed = dict(feed)
+                s = _feed_signature(feed)
+                if buf and s != sig:
+                    dispatch(buf)
+                    buf = []
+                sig = s
+                buf.append(feed)
+                if len(buf) >= window:
+                    dispatch(buf)
+                    buf = []
+            if buf:
+                dispatch(buf)
+        finally:
+            win.drain()
+            if fetch_list and state["last"] is not None:
+                # decimated fetch: only the last COMPLETED window's
+                # final step materializes for last_fetch (per-step
+                # values stay lazy) — in the finally so a mid-run
+                # error still leaves the last good fetch readable
+                self.last_fetch = [v[-1] for v in state["last"].result()]
+        seconds = time.perf_counter() - run_t0
+        _telemetry.record_trainer_run(seconds, state["examples"])
+        _events.emit("step_summary", site="hogwild_worker",
+                     worker=self.worker_id, steps=self.steps,
+                     examples=state["examples"],
+                     seconds=round(seconds, 6), window=window)
 
 
 class MultiTrainer:
