@@ -29,8 +29,8 @@ from ..observability import telemetry as _telemetry
 from ..observability import tracing as _tracing
 from . import framework, lowering
 from .executor import (RNG_STATE_VAR, Scope, _as_fetch_name,
-                       _finish_fetches, _JitDispatch, _normalize_feed,
-                       _post_step_health, global_scope)
+                       _finish_fetches, _JitDispatch, mesh_device_kind,
+                       _normalize_feed, _post_step_health, global_scope)
 from .framework import Program
 
 
@@ -240,6 +240,7 @@ class _ShardedStep:
                            repl),
             donate_argnums=(2,),
         ), "sharded", meta={"devices": int(mesh.size),
+                            "device_kind": mesh_device_kind(mesh),
                             "fetches": len(fetch_names)})
 
     def __call__(self, scope: Scope, feed, rng):

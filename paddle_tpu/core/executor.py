@@ -28,7 +28,7 @@ import numpy as np
 from ..observability import health as _health
 from ..observability import telemetry as _telemetry
 from ..observability import tracing as _tracing
-from . import async_exec, framework, lowering
+from . import async_exec, compile_cache, framework, lowering
 from .framework import Program, Variable
 from .ir import normalize_dtype
 from .places import CPUPlace, Place, default_place
@@ -61,6 +61,17 @@ def _compile_cost(compiled) -> Tuple[Optional[float], Optional[int]]:
     return flops, out_bytes
 
 
+_JIT_FALLBACK = object()  # sentinel: AOT redispatch failed, use plain jit
+
+
+def mesh_device_kind(mesh) -> str:
+    """device_kind of a jax Mesh's first device — the compile-cache /
+    warmstart environment-binding component for sharded executables.
+    One definition so compiler.py and spmd_executor.py cannot drift."""
+    return getattr(next(iter(mesh.devices.flat), None),
+                   "device_kind", "unknown")
+
+
 class _JitDispatch:
     """A jitted callable that AOT-compiles on first dispatch so the
     compile itself is observable: wall seconds land in
@@ -69,7 +80,21 @@ class _JitDispatch:
     the JSONL log. Falls back to the plain jit path — which compiles
     transparently — if AOT lowering fails or a later call's avals drift
     from the compiled signature (jax raises TypeError before executing,
-    so donated buffers are untouched)."""
+    so donated buffers are untouched).
+
+    With PADDLE_TPU_COMPILE_CACHE set, warm()/first-dispatch consults
+    the persistent compile cache (core/compile_cache.py) before
+    compiling: a hit deserializes the stored executable (I/O, not XLA),
+    a miss compiles and persists for the next process. AOT outcomes are
+    remembered PER SIGNATURE (`_tried_sig`): after an AOT failure or a
+    signature drift, a warm()/dispatch with new avals retries instead of
+    being locked out — a reshaped serving bucket must still get its AOT
+    executable."""
+
+    # executables already built for a signature, kept so alternating
+    # shapes on ONE wrapper (SPMD partial final batch each epoch) swap
+    # executables instead of re-paying an AOT compile per alternation
+    _AOT_SIG_CAP = 8
 
     def __init__(self, jit_fn, kind: str, meta: Optional[Dict] = None):
         self._jit = jit_fn
@@ -77,8 +102,21 @@ class _JitDispatch:
         self._meta = meta
         self._aot = None
         self._tried = False
+        self._tried_sig = None
+        self._aot_by_sig: "OrderedDict[Tuple, Any]" = OrderedDict()
         self._compile_lock = threading.Lock()
         self._recorded_jit_compiles = 0
+
+    @staticmethod
+    def _aval_sig(args) -> Tuple:
+        """Hashable shape/dtype signature of a warm()/call argument
+        tuple — what decides whether a past AOT attempt covers these
+        avals."""
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        return (treedef, tuple(
+            (tuple(getattr(leaf, "shape", ()) or ()),
+             str(getattr(leaf, "dtype", type(leaf).__name__)))
+            for leaf in leaves))
 
     def lower(self, *args, **kw):
         return self._jit.lower(*args, **kw)
@@ -100,42 +138,137 @@ class _JitDispatch:
         jax.ShapeDtypeStructs) without executing — serving warmup
         compiles every traffic bucket before the first request lands.
         Records the same compile telemetry as a first dispatch; no-op
-        once compiled (or once AOT already failed). Returns whether an
-        AOT executable is in place. Double-checked lock: concurrent
-        first dispatches (HogwildWorker threads on a shared executor)
-        must compile ONCE, with the second thread waiting rather than
-        jit-compiling a duplicate."""
-        if self._tried:
+        once compiled (or once AOT already failed FOR THESE AVALS — a
+        new signature retries, so a reshaped bucket can still AOT).
+        Consults the persistent compile cache first when enabled: a hit
+        installs the deserialized executable and records cache (not
+        compile) telemetry, because no XLA compile happened. Returns
+        whether an AOT executable is in place. Double-checked lock:
+        concurrent first dispatches (HogwildWorker threads on a shared
+        executor) must compile ONCE, with the second thread waiting
+        rather than jit-compiling a duplicate."""
+        sig = self._aval_sig(args)
+        if self._tried and sig == self._tried_sig:
             return self._aot is not None
         with self._compile_lock:
-            if not self._tried:
-                t0 = time.perf_counter()
-                try:
-                    self._aot = self._jit.lower(*args).compile()
-                except Exception:
-                    self._aot = None  # jit path compiles on dispatch
-                else:
+            if self._tried and sig == self._tried_sig:
+                return self._aot is not None
+            remembered = self._aot_by_sig.get(sig)
+            if remembered is not None:
+                # a signature this wrapper already compiled (drifted
+                # away and came back): swap executables, no XLA
+                self._aot_by_sig.move_to_end(sig)
+                self._aot = remembered
+                self._tried, self._tried_sig = True, sig
+                return True
+            t0 = time.perf_counter()
+            aot = None
+            try:
+                lowered = self._jit.lower(*args)
+                key = (compile_cache.fingerprint(lowered)
+                       if compile_cache.enabled() else None)
+                if key:
+                    aot = compile_cache.load(key, self._kind)
+                if aot is None:
+                    aot = lowered.compile()
                     seconds = time.perf_counter() - t0
-                    flops, out_bytes = _compile_cost(self._aot)
+                    flops, out_bytes = _compile_cost(aot)
                     _telemetry.record_compile(self._kind, seconds,
                                               flops=flops,
                                               out_bytes=out_bytes,
                                               meta=self._meta)
-                self._tried = True
+                    if key:
+                        compile_cache.store(key, aot, self._kind)
+            except Exception:
+                aot = None  # jit path compiles on dispatch
+            self._aot = aot
+            if aot is not None:
+                self._remember_locked(sig, aot)
+            self._tried, self._tried_sig = True, sig
         return self._aot is not None
+
+    def _remember_locked(self, sig, executable):
+        """Record sig -> executable (caller holds _compile_lock)."""
+        self._aot_by_sig[sig] = executable
+        self._aot_by_sig.move_to_end(sig)
+        while len(self._aot_by_sig) > self._AOT_SIG_CAP:
+            self._aot_by_sig.popitem(last=False)
+
+    def adopt(self, executable, *args) -> bool:
+        """Install a pre-built executable (deserialized from a
+        warmstart artifact) as if warm(*args) had just compiled it —
+        the serving boot path where even the cache lookup's lowering
+        cost is skipped. `args` must be the avals warm() would have
+        been called with, so later warm() calls recognize the
+        signature as covered."""
+        with self._compile_lock:
+            self._aot = executable
+            self._tried = True
+            self._tried_sig = self._aval_sig(args) if args else None
+            if self._tried_sig is not None:
+                self._remember_locked(self._tried_sig, executable)
+        return True
+
+    def _dispatch_after_drift(self, args):
+        """The installed AOT executable raised TypeError/ValueError
+        before executing `args` — either signature drift (these avals
+        differ from the installed signature) or a genuinely
+        incompatible input (e.g. committed to another device;
+        _aval_sig ignores placement). Re-resolve an executable for
+        THIS call's own signature and run it: a signature this wrapper
+        already compiled is an _aot_by_sig dict swap, a new one warms
+        through the persistent cache / XLA — so alternating shapes
+        (SPMD partial final batch, reshaped serving buckets) never
+        re-pay a compile per alternation. Every shared-state decision
+        keys on this call's own sig, never the shared _tried_sig:
+        concurrent threads (HogwildWorker) drift independently and
+        must not evict each other's live executables. Returns
+        _JIT_FALLBACK when the signature's own executable fails too —
+        after evicting it and latching the signature, so a
+        persistently bad executable pays exceptions once, not per
+        hot-path call."""
+        sig = self._aval_sig(args)
+        with self._compile_lock:
+            exe = self._aot_by_sig.get(sig)
+            if exe is not None:
+                self._aot_by_sig.move_to_end(sig)
+                self._aot = exe
+                self._tried, self._tried_sig = True, sig
+        if exe is None and self.warm(*args):
+            with self._compile_lock:
+                exe = self._aot_by_sig.get(sig)
+        if exe is not None:
+            try:
+                return exe(*args)
+            except (TypeError, ValueError):
+                with self._compile_lock:
+                    self._aot_by_sig.pop(sig, None)
+                    if self._tried_sig == sig:
+                        self._aot = None
+                        self._tried = True
+        return _JIT_FALLBACK
 
     def __call__(self, *args):
         if not self._tried:
+            self.warm(*args)
+        elif self._aot is None and self._aval_sig(args) != self._tried_sig:
+            # a past AOT failure latched _aot=None at _tried_sig, but
+            # THIS call's signature is a different one: re-warm
+            # (remembered signatures are a dict swap; cost only lands
+            # on the already-degraded path) so one bad signature
+            # doesn't strand every other signature's executable on
+            # plain jit — the class contract is that new avals retry
             self.warm(*args)
         if self._aot is not None:
             try:
                 return self._aot(*args)
             except (TypeError, ValueError):
-                # signature drift, raised before execution: TypeError for
-                # aval/dtype mismatch, ValueError for input sharding or
-                # committed-device mismatch (jax 0.4.x). Plain jit
-                # recompiles transparently for both, so fall back for good
-                self._aot = None
+                # raised before execution: TypeError for aval/dtype
+                # mismatch, ValueError for sharding/committed-device
+                # mismatch (jax 0.4.x) — donated buffers untouched
+                out = self._dispatch_after_drift(args)
+                if out is not _JIT_FALLBACK:
+                    return out
         # jit path: compiles transparently inside the call, so detect a
         # fresh executable via the cache-size growth and time the call —
         # compile-dominated when a compile happened. Keeps

@@ -29,6 +29,13 @@ Design notes:
   that finds the lease expired SIGKILLs the holder's descendant tree,
   then the holder — an aborted or hung tool can never wedge the next
   run.
+- Waiter registration: every ``acquire()`` caller drops a pid beacon in
+  ``<lock>.waiters/`` for the duration of its wait, and the orphan
+  sweep spares registered waiters (and their descendants). Without
+  this, a second legitimate bench.py blocked in ``acquire()`` matched
+  the cmdline markers and was SIGKILLed whenever a holder died with
+  two or more contenders queued (ADVICE r5) — exactly the concurrency
+  the lock exists to serialize.
 """
 
 from __future__ import annotations
@@ -60,7 +67,7 @@ DEFAULT_LEASE_S = 900.0
 # would otherwise keep the tunnel busy while a new holder inits).
 _TPU_PROC_MARKERS = ("bench.py", "tools/attn_ab.py", "tools/infer_bench.py",
                      "tools/op_bench.py", "tools/rn50_exp.py",
-                     "tools/rn50_roofline.py")
+                     "tools/rn50_roofline.py", "tools/warmstart.py")
 
 
 def _read_holder(path):
@@ -97,8 +104,8 @@ def _pid_is_python(pid):
     return bool(argv) and "python" in os.path.basename(argv[0])
 
 
-def _descendants(root_pid):
-    """All live descendant pids of root_pid (breadth-first), via /proc."""
+def _children_map():
+    """ppid -> [child pids] for every live process (one /proc walk)."""
     children = {}
     for stat in glob.glob("/proc/[0-9]*/stat"):
         try:
@@ -108,12 +115,22 @@ def _descendants(root_pid):
             children.setdefault(int(parts[1]), []).append(pid)  # ppid
         except (OSError, ValueError, IndexError):
             continue
+    return children
+
+
+def _descendants_from(children, root_pid):
+    """Breadth-first descendants of root_pid over a _children_map()."""
     out, queue = [], list(children.get(root_pid, []))
     while queue:
         pid = queue.pop(0)
         out.append(pid)
         queue.extend(children.get(pid, []))
     return out
+
+
+def _descendants(root_pid):
+    """All live descendant pids of root_pid (breadth-first), via /proc."""
+    return _descendants_from(_children_map(), root_pid)
 
 
 def _kill_tree(root_pid):
@@ -146,13 +163,108 @@ def _maybe_kill_expired_holder(path):
     return False
 
 
-def _reap_tpu_orphans():
+def _waiters_dir(path):
+    return path + ".waiters"
+
+
+def _register_waiter(path):
+    """Record this pid as a live waiter blocked in acquire(): the
+    orphan sweep must never SIGKILL a process that is merely queueing
+    for the lock (the ADVICE r5 bug — a second legitimate bench.py
+    waiter matched the cmdline markers and died whenever a holder
+    crashed with >=2 waiters). One beacon file per pid, removed on
+    every acquire() exit path."""
+    d = _waiters_dir(path)
+    beacon = os.path.join(d, str(os.getpid()))
+    try:
+        os.makedirs(d, exist_ok=True)
+        # a torn/lost beacon only widens the conservative keep-set
+        # check below, so this single write needs no atomic publish
+        with open(beacon, "w") as f:  # atomic-exempt: pid beacon
+            f.write(json.dumps({"pid": os.getpid(),
+                                "registered_at": time.time()}))
+    except OSError:
+        return None  # unregisterable waiter: sweep falls back to markers
+    return beacon
+
+
+def _unregister_waiter(beacon):
+    if beacon:
+        try:
+            os.unlink(beacon)
+        except OSError:
+            pass
+
+
+def _pid_start_time(pid):
+    """Epoch seconds the process started: /proc/<pid>/stat field 22
+    (clock ticks since boot) + boot time. None when unreadable."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            stat = f.read()
+        # split after the parenthesized comm — it may contain spaces
+        ticks = float(stat.rsplit(") ", 1)[1].split()[19])
+        with open("/proc/stat") as f:
+            for line in f:
+                if line.startswith("btime "):
+                    return (float(line.split()[1])
+                            + ticks / os.sysconf("SC_CLK_TCK"))
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def _live_waiter_pids(path):
+    """Pids with a live waiter beacon. Beacons of dead pids are stale
+    (a SIGKILLed waiter can't clean up) and are swept here — as are
+    beacons whose pid was RECYCLED by an unrelated process (the process
+    started after the beacon was written), which would otherwise shield
+    a true orphan from the sweep forever."""
+    d = _waiters_dir(path)
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return set()
+    live = set()
+    for name in names:
+        try:
+            pid = int(name)
+        except ValueError:
+            continue
+        beacon = os.path.join(d, name)
+        stale = not os.path.exists(f"/proc/{pid}")
+        if not stale:
+            try:
+                with open(beacon) as f:
+                    registered_at = json.loads(f.read()).get(
+                        "registered_at")
+            except (OSError, ValueError):
+                registered_at = None  # torn write: keep conservatively
+            if registered_at is not None:
+                started = _pid_start_time(pid)
+                # 2 s slack covers clock-granularity skew between
+                # btime-derived start and time.time() at registration
+                stale = (started is not None
+                         and started > registered_at + 2.0)
+        if stale:
+            try:
+                os.unlink(beacon)
+            except OSError:
+                pass
+        else:
+            live.add(pid)
+    return live
+
+
+def _reap_tpu_orphans(lock_path=None):
     """Kill leftover chip-driving processes whose lock-holding ancestor
     died (e.g. bench.py's ``--one`` children after the orchestrator was
     OOM-killed: the flock released instantly, but the child is still
     mid-compile on the tunnel). Matched conservatively: python
     interpreters whose argv names one of the known TPU scripts, and that
-    are not us, our ancestors, or our descendants."""
+    are not us, our ancestors, our descendants, or a REGISTERED WAITER
+    blocked in acquire() on this lock (waiters queue legitimately; only
+    true orphans — marker processes nobody is waiting behind — die)."""
     keep = {os.getpid()}
     pid = os.getpid()
     while pid > 1:  # ancestors
@@ -163,6 +275,10 @@ def _reap_tpu_orphans():
         except (OSError, ValueError, IndexError):
             break
     keep.update(_descendants(os.getpid()))
+    if lock_path:
+        for waiter in _live_waiter_pids(lock_path):
+            keep.add(waiter)
+            keep.update(_descendants(waiter))
     reaped = []
     for proc_dir in glob.glob("/proc/[0-9]*"):
         pid = int(proc_dir.rsplit("/", 1)[1])
@@ -173,6 +289,22 @@ def _reap_tpu_orphans():
             continue
         if any(any(a.endswith(m) for m in _TPU_PROC_MARKERS)
                for a in argv[1:]):
+            if lock_path:
+                # re-read the beacon dir at the last moment: a waiter
+                # that registered AFTER the keep-set snapshot (entered
+                # acquire() while this sweep walked /proc) must not be
+                # killed — the registration race is exactly the ADVICE
+                # r5 false positive this sweep must never reproduce
+                fresh = _live_waiter_pids(lock_path)
+                shield = set(fresh)
+                if fresh:  # one /proc walk covers every waiter
+                    fresh_children = _children_map()
+                    for w in fresh:
+                        shield.update(
+                            _descendants_from(fresh_children, w))
+                if pid in shield:
+                    keep.add(pid)
+                    continue
             try:
                 os.kill(pid, signal.SIGKILL)
                 reaped.append(pid)
@@ -192,29 +324,44 @@ def acquire(timeout=600.0, lease_s=DEFAULT_LEASE_S, lock_path=None,
     """
     path = lock_path or DEFAULT_LOCK_PATH
     deadline = time.monotonic() + timeout
-    fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
-    while True:
-        try:
-            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
-            break
-        except OSError as e:
-            if e.errno not in (errno.EAGAIN, errno.EACCES):
+    # registered BEFORE the first flock attempt: another contender that
+    # wins the lock and runs the orphan sweep must see us as a waiter,
+    # not a reapable marker-matching orphan
+    beacon = _register_waiter(path)
+    try:
+        fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+    except OSError:
+        _unregister_waiter(beacon)
+        raise
+    try:
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError as e:
+                if e.errno not in (errno.EAGAIN, errno.EACCES):
+                    os.close(fd)
+                    raise
+            _maybe_kill_expired_holder(path)
+            if time.monotonic() >= deadline:
+                holder = _read_holder(path)
                 os.close(fd)
-                raise
-        _maybe_kill_expired_holder(path)
-        if time.monotonic() >= deadline:
-            holder = _read_holder(path)
-            os.close(fd)
-            raise TimeoutError(
-                f"TPU single-flight lock busy after {timeout:.0f}s "
-                f"(holder: {holder})")
-        time.sleep(poll_s)
-    prev = _read_holder(path)
-    if prev.get("pid") and prev["pid"] != os.getpid() \
-            and not os.path.exists(f"/proc/{prev['pid']}"):
-        _reap_tpu_orphans()
-    _write_holder(fd, lease_s)
-    return fd
+                raise TimeoutError(
+                    f"TPU single-flight lock busy after {timeout:.0f}s "
+                    f"(holder: {holder})")
+            time.sleep(poll_s)
+        prev = _read_holder(path)
+        if prev.get("pid") and prev["pid"] != os.getpid() \
+                and not os.path.exists(f"/proc/{prev['pid']}"):
+            _reap_tpu_orphans(path)
+        _write_holder(fd, lease_s)
+        return fd
+    finally:
+        # holder or not, we are no longer *waiting*; the holder's own
+        # liveness is covered by the flock + lease, and its descendants
+        # are never swept while it holds the lock (the sweep only runs
+        # in a process that just ACQUIRED it)
+        _unregister_waiter(beacon)
 
 
 def release(fd):

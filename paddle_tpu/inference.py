@@ -171,7 +171,7 @@ class Predictor:
     def get_output_names(self) -> List[str]:
         return list(self._fetch_names)
 
-    def _compiled(self, sig):
+    def _compiled(self, sig, warm: Optional[bool] = None):
         step = self._cache.get(sig)
         if step is None:
             desc = self._program.desc
@@ -195,7 +195,10 @@ class Predictor:
             # deployment can assert its bucket set stays closed
             jitted = _JitDispatch(jax.jit(fwd), "infer", meta={
                 "signature": ",".join(f"{n}:{list(s)}" for n, s, _ in sig)})
-            if self.config._aot:
+            # warm=False (adopt_warm) builds the slot for an executable
+            # that already exists — warming would compile the very thing
+            # the warmstart artifact exists to skip
+            if self.config._aot if warm is None else warm:
                 shapes = {n: jax.ShapeDtypeStruct(s, np.dtype(d))
                           for n, s, d in sig}
                 jitted.warm(shapes, state)
@@ -236,6 +239,73 @@ class Predictor:
         shapes = {n: jax.ShapeDtypeStruct(s, np.dtype(d))
                   for n, s, d in sig}
         return jitted.warm(shapes, state)
+
+    # -- warmstart (serialized-executable) export/import ---------------
+
+    def serialize_warm(self) -> Dict[Tuple, Dict]:
+        """Serialized executable per cached signature whose AOT compile
+        is ready — the payload of a serving warmstart artifact
+        (SERVING.md §Warmstart). Each entry carries the signature's
+        lowering FINGERPRINT (compile_cache.fingerprint over the
+        StableHLO this process's paddle_tpu emits, plus the environment
+        meta), re-checked at adoption: an artifact baked before a
+        lowering change must fall back to compiling, never serve the
+        old computation. Signatures a backend refuses to serialize are
+        skipped, not fatal: the artifact then simply covers fewer
+        buckets and boot compiles the rest."""
+        from .core import compile_cache
+
+        out: Dict[Tuple, Dict] = {}
+        for sig, (jitted, state) in self._cache.items():
+            exe = getattr(jitted, "_aot", None)
+            if exe is None:
+                continue
+            try:
+                shapes = {n: jax.ShapeDtypeStruct(s, np.dtype(d))
+                          for n, s, d in sig}
+                fp = compile_cache.fingerprint(
+                    jitted.lower(shapes, state))
+                out[sig] = {"blob":
+                            compile_cache.serialize_executable(exe),
+                            "fingerprint": fp}
+            except Exception:
+                continue
+        return out
+
+    def adopt_warm(self, entries: Dict[Tuple, Dict]) -> int:
+        """Install pre-serialized executables keyed by feed signature
+        (the inverse of serialize_warm, called by the serving engine at
+        boot): each adopted entry becomes a ready compiled-signature
+        cache slot without any XLA compile. Adoption DOES re-lower each
+        signature (tracing, milliseconds) to recompute its fingerprint
+        against the artifact's: a stale artifact — baked by a paddle_tpu
+        whose lowering has since changed, or under different compile
+        flags — is rejected per entry and that bucket warms/compiles
+        normally. Any malformed, undeserializable, or mismatched entry
+        is likewise skipped, never raised: a bad artifact costs a cold
+        bucket, not a serving boot. Returns how many signatures
+        adopted."""
+        from .core import compile_cache
+
+        if self._native is not None:
+            return 0
+        adopted = 0
+        for sig, entry in entries.items():
+            try:
+                jitted, state = self._compiled(sig, warm=False)
+                shapes = {n: jax.ShapeDtypeStruct(s, np.dtype(d))
+                          for n, s, d in sig}
+                fp = compile_cache.fingerprint(
+                    jitted.lower(shapes, state))
+                if fp is None or fp != entry["fingerprint"]:
+                    continue  # lowering/flags drifted since the bake
+                exe = compile_cache.deserialize_executable(
+                    entry["blob"])
+                jitted.adopt(exe, shapes, state)
+                adopted += 1
+            except Exception:
+                continue
+        return adopted
 
     def run(self, inputs: Sequence[PaddleTensor]) -> List[PaddleTensor]:
         return self.run_handle(inputs).result()

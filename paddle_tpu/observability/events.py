@@ -42,9 +42,10 @@ __all__ = ["emit", "recent", "clear", "log_path", "read_jsonl",
 # story of a faulty run (RESILIENCE.md): checkpoint restores (incl.
 # corrupt-fallback skips), graceful-stop requests, injected faults,
 # recovery-policy actions, and launcher rank restarts.
-KINDS = ("compile", "step_summary", "anomaly", "checkpoint",
-         "serve_start", "serve_stop", "restore", "preempt", "fault",
-         "recovery", "rank_restart", "pipeline_stall")
+KINDS = ("compile", "compile_cache", "step_summary", "anomaly",
+         "checkpoint", "serve_start", "serve_stop", "restore", "preempt",
+         "fault", "recovery", "rank_restart", "pipeline_stall",
+         "warmstart")
 
 # Ring bound: a week-long run emitting a compile+summary event per minute
 # stays far under this; anomaly storms get truncated to the latest window.

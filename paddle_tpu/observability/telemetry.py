@@ -28,7 +28,7 @@ __all__ = [
     "executor_step", "feed_nbytes",
     "record_executor_step", "record_cache_event", "record_trainer_step",
     "record_trainer_run", "record_spmd_step", "record_pipeline_trace",
-    "record_compile", "record_device_memory",
+    "record_compile", "record_compile_cache", "record_device_memory",
     "record_host_blocked", "record_dispatch_ready",
     "record_prefetch_depth", "record_prefetch_item",
     "record_async_inflight", "record_chained_eviction",
@@ -104,6 +104,16 @@ COMPILE_FLOPS = _m.gauge(
     "paddle_tpu_compile_flops",
     "cost_analysis() FLOPs estimate of the most recent compile",
     labelnames=("kind",))
+COMPILE_CACHE = _m.counter(
+    "paddle_tpu_compile_cache_total",
+    "Persistent compile-cache (PADDLE_TPU_COMPILE_CACHE) outcomes by "
+    "program kind: hit (deserialized, compile skipped), miss, store, "
+    "corrupt (bad/mismatched entry dropped), store_error, evict",
+    labelnames=("kind", "event"))
+COMPILE_CACHE_BYTES = _m.counter(
+    "paddle_tpu_compile_cache_bytes_total",
+    "Bytes read on compile-cache hits / written on stores / dropped on "
+    "evictions", labelnames=("kind", "event"))
 DEVICE_LIVE_BYTES = _m.gauge(
     "paddle_tpu_device_live_bytes",
     "Bytes held by live device buffers (jax.live_arrays sum); monotonic "
@@ -228,6 +238,31 @@ def record_compile(kind: str, seconds: float,
     if meta:
         fields.update(meta)
     _events.emit("compile", **fields)
+
+
+def record_compile_cache(kind: str, event: str, nbytes: int = 0,
+                         key: Optional[str] = None,
+                         seconds: Optional[float] = None,
+                         error: Optional[str] = None):
+    """One persistent-compile-cache outcome: a hit is a compile that
+    did NOT happen (its wall cost is deserialization I/O), so hits and
+    misses land in their own counter family rather than polluting
+    paddle_tpu_compiles_total — the recompile-storm signal stays
+    honest. Every outcome also appends a `compile_cache` event so a
+    restart's cache story is reconstructable from the JSONL log."""
+    COMPILE_CACHE.inc(kind=kind, event=event)
+    if nbytes:
+        COMPILE_CACHE_BYTES.inc(nbytes, kind=kind, event=event)
+    fields: Dict = {"compile_kind": kind, "event": event}
+    if nbytes:
+        fields["nbytes"] = int(nbytes)
+    if key:
+        fields["key"] = key[:16]  # enough to join with the cache file
+    if seconds is not None:
+        fields["seconds"] = round(seconds, 6)
+    if error:
+        fields["error"] = error
+    _events.emit("compile_cache", **fields)
 
 
 def record_device_memory(nbytes: int, nbuffers: int):

@@ -29,8 +29,8 @@ from ..observability import tracing as _tracing
 from ..core import framework, lowering
 from ..core.executor import (RNG_STATE_VAR, Scope, _as_fetch_name,
                              _finish_fetches, _JitDispatch, _health_scan,
-                             _normalize_feed, _record_live_device_memory,
-                             global_scope)
+                             mesh_device_kind, _normalize_feed,
+                             _record_live_device_memory, global_scope)
 from ..core.framework import Program
 
 
@@ -176,7 +176,9 @@ class SPMDRunner:
             axis_names={axis},
             check_vma=False)
         jitted = _JitDispatch(jax.jit(sm), "spmd",
-                              meta={"axis": axis, "devices": int(n_dev)})
+                              meta={"axis": axis, "devices": int(n_dev),
+                                    "device_kind":
+                                        mesh_device_kind(self.mesh)})
 
         def step(scope: Scope, feed, rng):
             def _state(n):

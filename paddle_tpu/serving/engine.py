@@ -13,16 +13,23 @@ set stays closed under live traffic.
 
 from __future__ import annotations
 
+import hashlib
+import os
+import pickle
 import time
 from typing import Dict, Optional, Sequence
 
 import numpy as np
 
+from ..core import compile_cache as _cc
 from ..inference import AnalysisConfig, Predictor, create_paddle_predictor
+from ..observability import events as _events
 from ..observability import metrics as _m
 from .bucketing import BucketPolicy, common_batch
 
-__all__ = ["ServingConfig", "Engine"]
+__all__ = ["ServingConfig", "Engine", "WARMSTART_FORMAT"]
+
+WARMSTART_FORMAT = "paddle_tpu-warmstart-v1"
 
 BUCKET_SECONDS = _m.histogram(
     "paddle_tpu_serving_bucket_seconds",
@@ -51,6 +58,7 @@ class ServingConfig:
                  timeout_s: float = 30.0,
                  warmup: bool = True,
                  aot: bool = True,
+                 warmstart: Optional[str] = None,
                  use_tpu: bool = True,
                  device_id: int = 0,
                  host: Optional[str] = None,
@@ -63,6 +71,7 @@ class ServingConfig:
         self.timeout_s = float(timeout_s)
         self.warmup = bool(warmup)
         self.aot = bool(aot)
+        self.warmstart = warmstart
         self.use_tpu = bool(use_tpu)
         self.device_id = int(device_id)
         self.host = host
@@ -96,6 +105,15 @@ class Engine:
             predictor.config._bucketing = self.policy
         self._pred = predictor
         self.warmed = False
+        # warmstart artifact: adopt each bucket's serialized executable
+        # before warmup() ever runs, so boot pays deserialization I/O,
+        # not XLA. A missing/mismatched artifact degrades to normal
+        # warmup — never an error at serving boot, but always a
+        # `warmstart` reject event (a typo'd path booting a fleet cold
+        # must be visible in the log, not just as adopted=0 in status).
+        self.warmstart_adopted = 0
+        if config.warmstart:
+            self.load_warmstart(config.warmstart)
 
     def output_batched(self, name: str) -> Optional[bool]:
         """Does fetch `name` carry the batch dim? From the Predictor's
@@ -120,6 +138,94 @@ class Engine:
         WARMUP_SECONDS.set(time.perf_counter() - t0)
         self.warmed = True
         return ready
+
+    # -- warmstart artifact (serialized bucket executables) -------------
+
+    def _model_digest(self) -> Optional[str]:
+        """Content digest of the served model's program (__model__
+        file): an artifact baked from a DIFFERENT program must never be
+        adopted — same bucket signatures, different computation. None
+        when there is no model dir (externally-built predictor); such
+        artifacts match only artifacts also baked without one."""
+        d = self.config.model_dir
+        if not d:
+            return None
+        try:
+            with open(os.path.join(d, "__model__"), "rb") as f:
+                return hashlib.sha256(f.read()).hexdigest()
+        except OSError:
+            return None
+
+    def export_warmstart(self, path: str) -> int:
+        """Serialize every warmed bucket executable into ONE artifact
+        at `path` (atomic write). Call after warmup(); returns how many
+        bucket signatures the artifact carries. The artifact embeds the
+        environment meta (jax version/backend/device kind) and the
+        model digest, both re-checked at load."""
+        entries = self._pred.serialize_warm()
+        art = dict(_cc.environment_meta(),
+                   format=WARMSTART_FORMAT,
+                   model_digest=self._model_digest(),
+                   buckets=[int(b) for b in self.policy.buckets],
+                   created_at=time.time(),
+                   entries=entries)
+        from ..resilience.atomic import write_bytes
+
+        write_bytes(path, pickle.dumps(art,
+                                       protocol=pickle.HIGHEST_PROTOCOL))
+        _events.emit("warmstart", action="export", path=path,
+                     entries=len(entries),
+                     buckets=[int(b) for b in self.policy.buckets])
+        return len(entries)
+
+    def load_warmstart(self, path: str) -> int:
+        """Adopt the bucket executables from a warmstart artifact.
+        Returns how many signatures were adopted (also reflected in
+        `warmstart_adopted` / `/v1/status`); 0 (with a `warmstart`
+        reject event) when the artifact is unreadable, from another
+        jax/backend/device, or baked from a different model — warmup
+        then compiles normally, so a stale artifact costs nothing but
+        the cold boot it failed to avoid."""
+        self.warmstart_adopted = self._load_warmstart(path)
+        return self.warmstart_adopted
+
+    def _load_warmstart(self, path: str) -> int:
+        try:
+            with open(path, "rb") as f:
+                art = pickle.loads(f.read())
+            if not isinstance(art, dict) \
+                    or art.get("format") != WARMSTART_FORMAT:
+                raise ValueError("not a warmstart artifact")
+        except Exception as e:
+            _events.emit("warmstart", action="reject", path=path,
+                         reason=f"unreadable: {str(e)[:200]}")
+            return 0
+        env = _cc.environment_meta()
+        stored = {k: art.get(k) for k in env}
+        if stored != env:
+            _events.emit("warmstart", action="reject", path=path,
+                         reason=f"environment mismatch: artifact "
+                                f"{stored} vs process {env}")
+            return 0
+        digest = self._model_digest()
+        if art.get("model_digest") != digest:
+            _events.emit("warmstart", action="reject", path=path,
+                         reason="model digest mismatch — artifact baked "
+                                "from a different program")
+            return 0
+        try:
+            entries = art.get("entries") or {}
+            adopted = self._pred.adopt_warm(entries)
+        except Exception as e:
+            # adopt_warm guards per entry, but an artifact whose
+            # entries container itself is malformed must still degrade
+            # to a cold boot, never crash Engine construction
+            _events.emit("warmstart", action="reject", path=path,
+                         reason=f"unadoptable entries: {str(e)[:200]}")
+            return 0
+        _events.emit("warmstart", action="load", path=path,
+                     entries=len(entries), adopted=adopted)
+        return adopted
 
     def run_batch(self, feeds: Dict[str, np.ndarray]
                   ) -> Dict[str, np.ndarray]:
@@ -148,6 +254,7 @@ class Engine:
         return {
             "buckets": [int(b) for b in self.policy.buckets],
             "warmed": self.warmed,
+            "warmstart_adopted": self.warmstart_adopted,
             "batches": {str(b): BATCHES.value(bucket=str(b))
                         for b in self.policy.buckets},
             "feeds": self._pred.get_input_names(),

@@ -42,6 +42,10 @@ _WRITE_PATTERNS = (
     (re.compile(r"(?<![\w.])np\.(save|savez|savez_compressed)\s*\("),
      "np.save/np.savez"),
     (re.compile(r"(?<![\w.])json\.dump\s*\("), "json.dump"),
+    # pickle.dump (not .dumps) streams into an already-open handle —
+    # the compile-cache/warmstart writers must pickle.dumps into
+    # atomic.write_bytes instead
+    (re.compile(r"(?<![\w.])pickle\.dump\s*\("), "pickle.dump"),
     (re.compile(
         r"(?<![\w.])open\s*\(.*[\"'](w|wb|w\+|wb\+|x|xb)[\"']\s*[,)]"),
      'open(..., "w")'),
@@ -80,3 +84,31 @@ def lint_durable_writes():
 def test_no_bare_durable_writes():
     errors = lint_durable_writes()
     assert not errors, "\n".join(errors)
+
+
+# -- compile-cache writer lint (ISSUE 6) -------------------------------------
+
+# The persistent compile cache and the serving warmstart artifact are
+# exactly the durable files a restart depends on: a torn entry turns
+# every future restart into a corrupt-entry fallback, re-paying the
+# compile the cache exists to kill.
+_CACHE_WRITERS = ("paddle_tpu/core/compile_cache.py",
+                  "paddle_tpu/serving/engine.py")
+
+
+def test_cache_writers_route_through_atomic():
+    for rel in _CACHE_WRITERS:
+        path = os.path.join(_REPO, *rel.split("/"))
+        with open(path) as f:
+            src = f.read()
+        assert "resilience.atomic import write_bytes" in src, \
+            f"{rel}: cache writer must publish via " \
+            f"resilience.atomic.write_bytes"
+        for lineno, line in enumerate(src.splitlines(), 1):
+            if "atomic-exempt" in line:
+                continue
+            for pat, what in _WRITE_PATTERNS:
+                assert not pat.search(line), (
+                    f"{rel}:{lineno}: cache writer uses bare {what} — "
+                    f"publish through resilience.atomic.write_bytes: "
+                    f"{line.strip()}")

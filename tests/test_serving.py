@@ -22,9 +22,10 @@ import pytest
 
 import paddle_tpu as pt
 from paddle_tpu.observability import events as oe
-from paddle_tpu.serving import (Batcher, BucketPolicy, QueueFullError,
-                                RequestTimeout, ServerClosed, Server,
-                                ServingConfig, common_batch)
+from paddle_tpu.serving import (Batcher, BucketPolicy, Engine,
+                                QueueFullError, RequestTimeout,
+                                ServerClosed, Server, ServingConfig,
+                                common_batch)
 
 
 @pytest.fixture(autouse=True)
@@ -607,6 +608,142 @@ def test_engine_overrides_external_predictor_policy(tmp_path, rng):
     out = eng.run_batch({"x": X[:2]})
     np.testing.assert_allclose(list(out.values())[0], ref[:2], atol=1e-5)
     assert len(_infer_compiles()) == 2  # bs=2 rode the warmed bucket 3
+
+
+# ---------------------------------------------------------------------------
+# Warmstart artifact (serialized bucket executables; ISSUE 6)
+# ---------------------------------------------------------------------------
+
+
+def test_warmstart_export_load_roundtrip(tmp_path, rng):
+    """bake → boot: a fresh engine adopting the artifact serves every
+    bucket with ZERO compile events, and replies are bit-identical to
+    the engine that compiled from scratch."""
+    X, _ = _save_softmax_model(tmp_path / "model", rng)
+    art = str(tmp_path / "warm.bin")
+    cfg = ServingConfig(str(tmp_path / "model"), buckets=(1, 2, 4),
+                        use_tpu=False)
+    eng = Engine(cfg)
+    assert eng.warmup() == 3
+    assert eng.export_warmstart(art) == 3
+    out_cold = eng.run_batch({"x": X[:3]})
+
+    seq0 = oe.recent()[-1]["seq"] if oe.recent() else -1
+    eng2 = Engine(ServingConfig(str(tmp_path / "model"),
+                                buckets=(1, 2, 4), use_tpu=False,
+                                warmstart=art))
+    assert eng2.warmstart_adopted == 3
+    assert eng2.warmup() == 3  # no-op: every bucket already AOT
+    out_warm = eng2.run_batch({"x": X[:3]})
+    new = [e for e in oe.recent() if e["seq"] > seq0]
+    assert not [e for e in new if e["kind"] == "compile"], \
+        "warmstart boot must not compile"
+    assert eng2.status()["warmstart_adopted"] == 3
+    k = list(out_cold)[0]
+    np.testing.assert_array_equal(out_cold[k], out_warm[k])
+
+
+def test_warmstart_rejects_different_model(tmp_path, rng):
+    """An artifact baked from a DIFFERENT program must be rejected via
+    the model digest — same signatures, different computation is the
+    silent-wrong-answers failure mode."""
+    _save_softmax_model(tmp_path / "m1", rng)
+    _save_softmax_model(tmp_path / "m2", rng, classes=5)
+    art = str(tmp_path / "warm.bin")
+    eng1 = Engine(ServingConfig(str(tmp_path / "m1"), buckets=(1, 2),
+                                use_tpu=False))
+    eng1.warmup()
+    assert eng1.export_warmstart(art) == 2
+    eng2 = Engine(ServingConfig(str(tmp_path / "m2"), buckets=(1, 2),
+                                use_tpu=False, warmstart=art))
+    assert eng2.warmstart_adopted == 0
+    rejects = [e for e in oe.recent() if e["kind"] == "warmstart"
+               and e.get("action") == "reject"]
+    assert rejects and "digest" in rejects[-1]["reason"]
+    assert eng2.warmup() == 2  # degraded to a normal compile warmup
+
+
+def test_warmstart_rejects_stale_lowering_fingerprint(tmp_path, rng):
+    """Every entry embeds its signature's lowering fingerprint, and
+    adoption re-lowers to verify it: an artifact baked before a
+    paddle_tpu lowering change (same jax/backend/model digest!) must
+    fall back to compiling that bucket, never serve the old
+    computation. Simulated by tampering with one stored fingerprint."""
+    import pickle
+
+    _save_softmax_model(tmp_path / "model", rng)
+    art = str(tmp_path / "warm.bin")
+    eng1 = Engine(ServingConfig(str(tmp_path / "model"), buckets=(1, 2),
+                                use_tpu=False))
+    eng1.warmup()
+    assert eng1.export_warmstart(art) == 2
+    with open(art, "rb") as f:
+        blob = pickle.loads(f.read())
+    sig = next(iter(blob["entries"]))
+    blob["entries"][sig]["fingerprint"] = "0" * 64
+    with open(art, "wb") as f:  # atomic-exempt: test fixture tamper
+        f.write(pickle.dumps(blob))
+    eng2 = Engine(ServingConfig(str(tmp_path / "model"), buckets=(1, 2),
+                                use_tpu=False, warmstart=art))
+    assert eng2.warmstart_adopted == 1  # the untampered entry only
+    assert eng2.warmup() == 2  # tampered bucket compiled normally
+
+
+def test_warmstart_rejects_garbage_artifact(tmp_path, rng):
+    _save_softmax_model(tmp_path / "model", rng)
+    art = tmp_path / "warm.bin"
+    art.write_bytes(b"definitely not a pickle")
+    eng = Engine(ServingConfig(str(tmp_path / "model"), buckets=(1,),
+                               use_tpu=False, warmstart=str(art)))
+    assert eng.warmstart_adopted == 0
+    assert eng.warmup() == 1
+
+
+def test_warmstart_missing_artifact_emits_reject(tmp_path, rng):
+    """A typo'd warmstart path boots the fleet cold — that must leave
+    a reject event in the log, not just a silent adopted=0."""
+    _save_softmax_model(tmp_path / "model", rng)
+    eng = Engine(ServingConfig(str(tmp_path / "model"), buckets=(1,),
+                               use_tpu=False,
+                               warmstart=str(tmp_path / "nope.warm")))
+    assert eng.warmstart_adopted == 0
+    rejects = [e for e in oe.recent() if e["kind"] == "warmstart"
+               and e.get("action") == "reject"]
+    assert rejects and "unreadable" in rejects[-1]["reason"]
+    assert eng.warmup() == 1  # degraded to a normal compile warmup
+
+
+@pytest.mark.slow
+def test_warmstart_tool_bake_inspect(tmp_path, rng):
+    """tools/warmstart.py CLI: bake writes a loadable artifact and
+    prints its summary; inspect reads it back without jax."""
+    import os
+    import subprocess
+
+    _save_softmax_model(tmp_path / "model", rng)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    art = str(tmp_path / "warm.bin")
+    tool = os.path.join(repo, "tools", "warmstart.py")
+    proc = subprocess.run(
+        [sys.executable, tool, "bake", "--model-dir",
+         str(tmp_path / "model"), "--out", art, "--buckets", "1,2,4",
+         "--cpu"],
+        capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["entries"] == 3 and summary["buckets"] == [1, 2, 4]
+    proc = subprocess.run([sys.executable, tool, "inspect", art],
+                          capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    info = json.loads(proc.stdout)
+    assert info["entries"] == 3 and info["backend"] == "cpu"
+    assert all(s["blob_bytes"] > 0 for s in info["signatures"])
+    # and the engine can boot from the CLI-baked artifact
+    eng = Engine(ServingConfig(str(tmp_path / "model"),
+                               buckets=(1, 2, 4), use_tpu=False,
+                               warmstart=art))
+    assert eng.warmstart_adopted == 3
 
 
 # ---------------------------------------------------------------------------

@@ -53,6 +53,60 @@ def test_aborted_holder_releases_immediately(tmp_path):
     assert waited < 10, f"lock not auto-released by holder death: {waited}"
 
 
+def test_reap_spares_registered_waiters(tmp_path):
+    """ADVICE r5: _reap_tpu_orphans must not SIGKILL a marker-matching
+    process that is merely BLOCKED IN acquire() on the same lock. A
+    holder dies with a waiter queued; the next acquirer's orphan sweep
+    runs (dead previous holder) and must spare the registered waiter,
+    which then gets the lock in turn."""
+    path = str(tmp_path / "lock")
+    # the waiter runs a script NAMED bench.py so its argv matches the
+    # orphan markers — the exact false-positive shape from the advisory
+    waiter_script = tmp_path / "bench.py"
+    waiter_script.write_text(f"""
+import json, os, sys, time
+sys.path.insert(0, {json.dumps(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))})
+from paddle_tpu.core import tpu_lock
+fd = tpu_lock.acquire(timeout=60, lock_path={json.dumps(path)})
+print("ACQUIRED", flush=True)
+tpu_lock.release(fd)
+""")
+    q = mp.Queue()
+    holder = mp.Process(target=_hold, args=(path, 3600, 300, q))
+    holder.start()
+    q.get(timeout=10)
+    waiter = subprocess.Popen(
+        [sys.executable, str(waiter_script)], stdout=subprocess.PIPE,
+        text=True)
+    try:
+        deadline = time.time() + 15
+        waiters_dir = tmp_path / "lock.waiters"
+        while time.time() < deadline:
+            if waiters_dir.is_dir() and any(
+                    n.isdigit() for n in os.listdir(waiters_dir)):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("waiter never registered its beacon")
+        holder.kill()  # dead previous holder => next acquirer sweeps
+        holder.join(timeout=10)
+        # contend: we or the waiter wins first; either way the sweep
+        # that runs on OUR acquire must leave the waiter alive
+        fd = tpu_lock.acquire(timeout=30, lock_path=path)
+        assert waiter.poll() is None or waiter.returncode == 0, \
+            f"registered waiter was reaped (rc={waiter.returncode})"
+        tpu_lock.release(fd)
+        out, _ = waiter.communicate(timeout=30)
+        assert waiter.returncode == 0 and "ACQUIRED" in out, \
+            f"waiter rc={waiter.returncode} out={out!r}"
+    finally:
+        if waiter.poll() is None:
+            waiter.kill()
+        if holder.is_alive():
+            holder.kill()
+
+
 def test_expired_lease_holder_and_children_killed(tmp_path):
     """A holder alive past its lease is SIGKILLed together with its
     descendant subprocesses (bench children drive the chip; killing only

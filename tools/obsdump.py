@@ -18,6 +18,10 @@ Usage:
   obsdump.py events EVENTS.jsonl            # tail the JSONL event log
                                             # (-n N, --kind K, --json,
                                             # --follow)
+  obsdump.py cache METRICS.json             # per-kind persistent
+                                            # compile-cache hit/miss/
+                                            # bytes table (--live,
+                                            # --json)
 
 The metrics JSON is what the registry's env-gated dumper
 (PADDLE_TPU_METRICS_DIR) writes; RUN_DIR is typically the profiler's
@@ -207,6 +211,64 @@ def cmd_events(args) -> int:
     return 0
 
 
+def cmd_cache(args) -> int:
+    """Per-kind persistent compile-cache summary from a metrics
+    snapshot: hit/miss/corrupt/store/evict counts and the bytes moved,
+    i.e. the restart-storm story of PADDLE_TPU_COMPILE_CACHE
+    (PROFILE.md §Compile-cache) in one table."""
+    if args.live:
+        import paddle_tpu  # noqa: F401 — registers all telemetry metrics
+
+        from paddle_tpu import observability
+        snap = observability.snapshot()
+    else:
+        if not args.path:
+            print("cache: need a metrics.json path or --live",
+                  file=sys.stderr)
+            return 2
+        with open(args.path) as f:
+            snap = json.load(f)
+
+    counts = {}  # (kind, event) -> count
+    nbytes = {}  # (kind, event) -> bytes
+    for name, dest in (("paddle_tpu_compile_cache_total", counts),
+                       ("paddle_tpu_compile_cache_bytes_total", nbytes)):
+        for s in (snap.get(name) or {}).get("series", []):
+            labels = s.get("labels", {})
+            key = (labels.get("kind", "?"), labels.get("event", "?"))
+            dest[key] = dest.get(key, 0) + s.get("value", 0)
+    kinds = sorted({k for k, _ in list(counts) + list(nbytes)})
+    if not kinds:
+        print("no compile-cache samples in this snapshot (is "
+              "PADDLE_TPU_COMPILE_CACHE set?)")
+        return 0
+
+    events = ("hit", "miss", "corrupt", "store", "store_error", "evict")
+    rows = []
+    for kind in kinds:
+        c = {ev: int(counts.get((kind, ev), 0)) for ev in events}
+        b = {ev: int(nbytes.get((kind, ev), 0)) for ev in events}
+        lookups = c["hit"] + c["miss"] + c["corrupt"]
+        rows.append({
+            "kind": kind, **c,
+            "hit_rate": round(c["hit"] / lookups, 4) if lookups else 0.0,
+            "hit_bytes": b["hit"], "store_bytes": b["store"],
+            "evict_bytes": b["evict"],
+        })
+    if args.json:
+        print(json.dumps(rows, indent=2))
+        return 0
+    cols = ("kind", "hit", "miss", "corrupt", "store", "store_error",
+            "evict", "hit_rate", "hit_bytes", "store_bytes",
+            "evict_bytes")
+    widths = {c: max(len(c), *(len(str(r[c])) for r in rows))
+              for c in cols}
+    print("  ".join(f"{c:>{widths[c]}}" for c in cols))
+    for r in rows:
+        print("  ".join(f"{str(r[c]):>{widths[c]}}" for c in cols))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="obsdump", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -238,6 +300,16 @@ def main(argv=None) -> int:
     ep.add_argument("--follow", action="store_true",
                     help="keep polling for appended events (default off)")
     ep.set_defaults(fn=cmd_events)
+
+    cp = sub.add_parser("cache", help="per-kind compile-cache "
+                        "hit/miss/bytes from a metrics snapshot")
+    cp.add_argument("path", nargs="?", help="metrics.json from "
+                    "PADDLE_TPU_METRICS_DIR (omit with --live)")
+    cp.add_argument("--live", action="store_true",
+                    help="read this process's registry instead of a file")
+    cp.add_argument("--json", action="store_true",
+                    help="rows as JSON instead of the aligned table")
+    cp.set_defaults(fn=cmd_cache)
 
     # unknown/missing subcommands exit nonzero via argparse itself
     # (required=True subparsers error out with status 2)

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""warmstart — pre-bake a serving model's bucket executables offline.
+
+A serving boot normally XLA-compiles every traffic bucket during
+warmup; with a warmstart artifact the engine deserializes them instead,
+so time-to-first-healthy is I/O-bound (SERVING.md §Warmstart). This
+tool is the offline half: load the model, warm the full bucket set
+once, and serialize the executables into one artifact the engine (or
+`ServingConfig(warmstart=...)`) adopts at boot.
+
+Usage:
+  warmstart.py bake --model-dir DIR --out ART [--buckets 1,2,4,8]
+                    [--max-batch N] [--cpu]
+  warmstart.py inspect ART
+
+`bake` prints one JSON line: buckets warmed, entries serialized,
+warmup seconds, artifact size. `inspect` reads only the artifact
+(stdlib, no jax import) and prints its metadata + per-signature blob
+sizes — what an operator checks before shipping the artifact to the
+serving fleet. NOTE: artifacts are pickles; `inspect` unpickles, so
+(like the engine) only run it on artifacts from the trusted channel
+that carries the model files themselves.
+
+The artifact is environment-bound (jax version, backend, device kind)
+and model-bound (digest of __model__): the engine rejects a mismatched
+artifact and falls back to compiling, so baking on the wrong machine
+costs nothing but the cold boot it failed to avoid.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def cmd_bake(args) -> int:
+    import contextlib
+
+    sys.path.insert(0, _REPO)
+    import jax
+
+    if args.cpu:
+        # use_tpu=False alone still compiles on the DEFAULT backend
+        # (the Predictor's jax.jit), and artifacts are backend-stamped:
+        # without this pin a TPU host would bake tpu-stamped blobs that
+        # every CPU serving boot rejects. Must happen before any jax
+        # use; the env var alone is overridden by the baked
+        # sitecustomize.
+        jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.serving.engine import Engine, ServingConfig
+
+    buckets = None
+    if args.buckets:
+        try:
+            buckets = sorted({int(b) for b in args.buckets.split(",")})
+        except ValueError:
+            print(f"bake: bad --buckets {args.buckets!r} (want e.g. "
+                  f"1,2,4,8)", file=sys.stderr)
+            return 2
+    cfg = ServingConfig(args.model_dir, buckets=buckets,
+                        max_batch=args.max_batch,
+                        use_tpu=not args.cpu, aot=True)
+    if args.cpu:
+        guard = contextlib.nullcontext()
+    else:
+        # baking drives the chip: serialize against bench/other tools
+        from paddle_tpu.core.tpu_lock import tpu_singleflight
+
+        guard = tpu_singleflight(timeout=600.0)
+    with guard:
+        t0 = time.perf_counter()
+        engine = Engine(cfg)
+        ready = engine.warmup()
+        warm_s = time.perf_counter() - t0
+        n = engine.export_warmstart(args.out)
+    print(json.dumps({
+        "artifact": args.out,
+        "model_dir": args.model_dir,
+        "buckets": [int(b) for b in engine.policy.buckets],
+        "buckets_ready": ready,
+        "entries": n,
+        "warmup_seconds": round(warm_s, 3),
+        "artifact_bytes": os.path.getsize(args.out),
+    }), flush=True)
+    return 0 if n else 1
+
+
+def cmd_inspect(args) -> int:
+    try:
+        with open(args.artifact, "rb") as f:
+            art = pickle.loads(f.read())
+    # pickle.loads on a truncated/foreign stream raises well beyond
+    # UnpicklingError (EOFError, ImportError, AttributeError, ...);
+    # the operator check must print its diagnostic + rc=2, not a
+    # traceback, for any of them
+    except Exception as e:
+        print(f"inspect: cannot read {args.artifact}: {e}",
+              file=sys.stderr)
+        return 2
+    if not isinstance(art, dict) or "entries" not in art:
+        print(f"inspect: {args.artifact} is not a warmstart artifact",
+              file=sys.stderr)
+        return 2
+    # a dict with "entries" can still be structurally malformed
+    # (tampered/truncated-then-repickled, or a future format): the
+    # same diagnostic-not-traceback contract applies to shape errors
+    # as to unpickling errors
+    try:
+        entries = art["entries"]
+        report = {
+            "format": art.get("format"),
+            "jax_version": art.get("jax_version"),
+            "backend": art.get("backend"),
+            "device_kind": art.get("device_kind"),
+            "model_digest": art.get("model_digest"),
+            "buckets": art.get("buckets"),
+            "created_at": art.get("created_at"),
+            "entries": len(entries),
+            "signatures": [
+                {"feeds": [f"{n}:{list(s)}:{d}" for n, s, d in sig],
+                 "blob_bytes": len(e["blob"]),
+                 "fingerprint": (e.get("fingerprint") or "")[:16]}
+                for sig, e in sorted(entries.items())
+            ],
+        }
+    except Exception as e:
+        print(f"inspect: {args.artifact} has malformed entries: {e!r}",
+              file=sys.stderr)
+        return 2
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="warmstart", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    bp = sub.add_parser("bake", help="warm every bucket and serialize "
+                        "the executables into one artifact")
+    bp.add_argument("--model-dir", required=True,
+                    help="saved inference model directory")
+    bp.add_argument("--out", required=True, help="artifact path")
+    bp.add_argument("--buckets", default=None,
+                    help="comma-separated batch buckets (default: pow2 "
+                    "up to --max-batch)")
+    bp.add_argument("--max-batch", type=int, default=64)
+    bp.add_argument("--cpu", action="store_true",
+                    help="bake for the CPU backend (artifacts are "
+                    "backend-bound)")
+    bp.set_defaults(fn=cmd_bake)
+
+    ip = sub.add_parser("inspect", help="print an artifact's metadata "
+                        "(no jax import)")
+    ip.add_argument("artifact")
+    ip.set_defaults(fn=cmd_inspect)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
