@@ -577,6 +577,234 @@ def bench_coldstart(smoke=False):
     return train_ok and serve_ok
 
 
+# ---------------------------------------------------------------------------
+# Precision block (ISSUE 7): the mixed-precision + int8 hot paths.
+# Train A/B — the SAME LeNet Program trained by the streaming driver
+# under f32 vs mixed_bf16 (bf16 feeds end to end, so the hot path pays
+# ZERO silent upcasts) with loss parity asserted. Serve A/B — the same
+# saved model behind the bucketed Engine at f32 vs int8 (calibrated
+# post-training quantization) with per-request p50/p99 and the reply
+# accuracy delta. On TPU these are the native-width numbers the
+# roadmap's per-chip-speed axis asks for; on CPU the block verifies
+# both paths end to end (bf16/int8 emulation makes CPU speedups
+# meaningless, so acceptance is parity + zero-upcast, not throughput).
+# ---------------------------------------------------------------------------
+
+
+# stated acceptance bounds (also asserted by the --smoke slow test):
+# per-step |loss_mixed - loss_f32| <= 0.05 * max(1, |loss_f32|) with a
+# final-loss relative delta <= 0.05; int8 replies within 0.05 absolute
+# of f32 on the same bucket set (softmax outputs, so 0.05 is 5 points)
+PRECISION_LOSS_REL_BOUND = 0.05
+PRECISION_INT8_ABS_BOUND = 0.05
+
+
+def bench_precision(mesh, n_chips, platform, on_tpu):
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.core import precision as pr
+    from paddle_tpu.core.executor import _normalize_feed
+
+    smoke = bool(os.environ.get("PADDLE_TPU_BENCH_SMOKE")
+                 or os.environ.get("PADDLE_TPU_COLDSTART_SMOKE"))
+    ok_train = ok_serve = False
+
+    # -- train A/B: f32 vs mixed_bf16 through run_stream ----------------
+    try:
+        import ml_dtypes
+
+        rng = np.random.RandomState(0)
+        bs = 8
+        n_steps, window = (32, 8) if smoke else (128, 16)
+        X = rng.rand(n_steps, bs, 1, 28, 28).astype("float32")
+        Y = rng.randint(0, 10, (n_steps, bs, 1)).astype("int64")
+        main, startup, loss = _build_lenet_program(pt)
+        place = pt.TPUPlace() if on_tpu else pt.CPUPlace()
+        exe = pt.Executor(place)
+
+        def feeds_for(policy):
+            # the input pipeline delivers the policy's width: bf16
+            # feeds under mixed_bf16, proving the hot path never
+            # upcasts them (the pre-PR executor astype'd every feed
+            # to the declared f32 — core/executor.py _normalize_feed)
+            if policy == "mixed_bf16":
+                Xp = X.astype(ml_dtypes.bfloat16)
+            else:
+                Xp = X
+            return [{"x": Xp[i], "y": Y[i]} for i in range(n_steps)]
+
+        def phase(policy):
+            pr.set_program_precision(main, policy)
+            feeds = feeds_for(policy)
+            # warm compiles on a throwaway scope
+            with pt.scope_guard(pt.Scope()):
+                exe.run(startup)
+                for h in exe.run_stream(main, iter(feeds[:window + 1]),
+                                        fetch_list=[loss], window=window):
+                    h.result()
+            with pt.scope_guard(pt.Scope()):
+                exe.run(startup)
+                losses = []
+                t0 = time.perf_counter()
+                for h in exe.run_stream(main, iter(feeds),
+                                        fetch_list=[loss], window=window):
+                    losses.extend(
+                        float(v) for v in np.asarray(
+                            h.result()[0], np.float32).ravel())
+                dt = time.perf_counter() - t0
+            return dt, losses
+
+        # best-of-2 per policy: noisy-neighbor CPU must not decide the A/B
+        f32_dt, f32_losses = min((phase("f32") for _ in range(2)),
+                                 key=lambda r: r[0])
+        bf16_dt, bf16_losses = min((phase("mixed_bf16")
+                                    for _ in range(2)),
+                                   key=lambda r: r[0])
+        pr.set_program_precision(main, None)
+
+        # zero-upcast probe: a bf16 feed under the mixed policy must
+        # come back from feed normalization UNTOUCHED (same buffer, no
+        # astype) — the acceptance criterion made checkable
+        xb = jnp.asarray(X[0].astype(ml_dtypes.bfloat16))
+        probe = _normalize_feed(main, {"x": xb},
+                                pr.get_policy("mixed_bf16"))
+        upcast_free = probe["x"] is xb and probe["x"].dtype == xb.dtype
+
+        rel = [abs(a - b) / max(1.0, abs(b))
+               for a, b in zip(bf16_losses, f32_losses)]
+        max_rel = max(rel)
+        final_rel = abs(bf16_losses[-1] - f32_losses[-1]) \
+            / max(1.0, abs(f32_losses[-1]))
+        f32_sps = bs * n_steps / f32_dt
+        bf16_sps = bs * n_steps / bf16_dt
+        speedup = bf16_sps / f32_sps
+        ok_train = (max_rel <= PRECISION_LOSS_REL_BOUND
+                    and final_rel <= PRECISION_LOSS_REL_BOUND
+                    and upcast_free
+                    and f32_losses[-1] < f32_losses[0]
+                    and bf16_losses[-1] < bf16_losses[0])
+        _emit_raw(
+            "precision_bf16_train_samples_per_sec", bf16_sps,
+            "samples/s", speedup,
+            {"platform": platform, "batch_size": bs, "steps": n_steps,
+             "window": window, "policy": "mixed_bf16",
+             "f32_samples_per_sec": round(f32_sps, 2),
+             "bf16_vs_f32_speedup": round(speedup, 3),
+             "loss_rel_delta_max": round(max_rel, 5),
+             "loss_rel_delta_final": round(final_rel, 5),
+             "loss_rel_bound": PRECISION_LOSS_REL_BOUND,
+             "final_loss_f32": round(f32_losses[-1], 5),
+             "final_loss_bf16": round(bf16_losses[-1], 5),
+             "bf16_feeds_upcast_free": bool(upcast_free),
+             "note": "run_stream windowed driver, bf16 feeds end to "
+                     "end under mixed_bf16 (zero per-step astype on "
+                     "the hot path); CPU emulates bf16 so only TPU "
+                     "speedups are meaningful"})
+    except Exception as e:
+        _emit_raw("precision_bf16_train_samples_per_sec", 0.0,
+                  "samples/s", 0.0, {"error": str(e)[:300]})
+
+    # -- serve A/B: f32 vs int8 through the bucketed Engine --------------
+    try:
+        import shutil
+        import tempfile
+
+        from paddle_tpu.serving import Engine, ServingConfig
+
+        tmp = tempfile.mkdtemp(prefix="paddle_tpu_precision_")
+        try:
+            md = os.path.join(tmp, "model")
+            mainm, startm = pt.Program(), pt.Program()
+            with pt.framework.unique_name.guard(), \
+                    pt.program_guard(mainm, startm):
+                x = pt.layers.data(name="x", shape=[64], dtype="float32")
+                h = pt.layers.fc(input=x, size=128, act="relu")
+                predv = pt.layers.fc(input=h, size=16, act="softmax")
+            exe2 = pt.Executor(pt.CPUPlace())
+            with pt.scope_guard(pt.Scope()):
+                exe2.run(startm)
+                pt.io.save_inference_model(md, ["x"], [predv], exe2,
+                                           main_program=mainm)
+            rngs = np.random.RandomState(1)
+            cal = [{"x": rngs.rand(4, 64).astype("float32")}
+                   for _ in range(8)]
+            buckets = (1, 2, 4)
+            n_req = 40 if smoke else 200
+
+            def build(precision):
+                cfg = ServingConfig(
+                    md, buckets=buckets, use_tpu=on_tpu,
+                    precision=precision,
+                    calibration=(lambda: iter(cal))
+                    if precision == "int8" else None)
+                eng = Engine(cfg)
+                eng.warmup()
+                return eng
+
+            def measure(eng):
+                reqs = [{"x": rngs.rand(2, 64).astype("float32")}
+                        for _ in range(n_req)]
+                eng.run_batch(reqs[0])  # page in the bucket
+                lat = []
+                outs = []
+                for r in reqs:
+                    t0 = time.perf_counter()
+                    o = eng.run_batch(r)
+                    lat.append(time.perf_counter() - t0)
+                    outs.append(o)
+                ms = np.asarray(lat) * 1000.0
+                return (float(np.percentile(ms, 50)),
+                        float(np.percentile(ms, 99)), reqs, outs)
+
+            e32 = build("f32")
+            p50_f32, p99_f32, reqs, outs_f32 = measure(e32)
+            e8 = build("int8")
+            # same request stream through int8: accuracy delta measured
+            # on identical inputs, latency on its own pass
+            lat = []
+            max_abs = 0.0
+            for r, o32 in zip(reqs, outs_f32):
+                t0 = time.perf_counter()
+                o8 = e8.run_batch(r)
+                lat.append(time.perf_counter() - t0)
+                for k in o32:
+                    if k in o8:
+                        max_abs = max(max_abs, float(np.abs(
+                            np.asarray(o8[k], np.float32)
+                            - np.asarray(o32[k], np.float32)).max()))
+            ms = np.asarray(lat[1:] or lat) * 1000.0
+            p50_i8 = float(np.percentile(ms, 50))
+            p99_i8 = float(np.percentile(ms, 99))
+            ok_serve = (max_abs <= PRECISION_INT8_ABS_BOUND
+                        and e8.status()["precision"] == "int8"
+                        and e8.accuracy_delta is not None)
+            _emit_raw(
+                "precision_int8_serving_p50_ms", p50_i8, "ms",
+                p50_f32 / max(p50_i8, 1e-6),
+                {"platform": platform, "buckets": list(buckets),
+                 "requests": n_req,
+                 "f32_p50_ms": round(p50_f32, 3),
+                 "f32_p99_ms": round(p99_f32, 3),
+                 "int8_p50_ms": round(p50_i8, 3),
+                 "int8_p99_ms": round(p99_i8, 3),
+                 "p50_speedup": round(p50_f32 / max(p50_i8, 1e-6), 3),
+                 "accuracy_delta_max_abs": round(max_abs, 6),
+                 "accuracy_bound": PRECISION_INT8_ABS_BOUND,
+                 "engine_accuracy_delta": e8.accuracy_delta,
+                 "note": "per-request Engine.run_batch on the shared "
+                         "bucket set; int8 = calibrated post-training "
+                         "quantization (quantized_* kernels, f32 "
+                         "replies); CPU int8 matmul is emulated so "
+                         "only TPU latency wins are meaningful"})
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    except Exception as e:
+        _emit_raw("precision_int8_serving_p50_ms", 0.0, "ms", 0.0,
+                  {"error": str(e)[:300]})
+    return ok_train and ok_serve
+
+
 def bench_resnet50(mesh, n_chips, platform, on_tpu):
     import dataclasses
 
@@ -803,6 +1031,8 @@ BENCHES = [
      "pipeline_stream_samples_per_sec", 600),
     ("coldstart", "coldstart_restart_compile_speedup",
      "coldstart_restart_compile_speedup", 900),
+    ("precision", "precision_bf16_train_samples_per_sec",
+     "precision_bf16_train_samples_per_sec", 900),
     ("resnet50", "resnet50_train_samples_per_sec_per_chip",
      "resnet_tiny_cpu_samples_per_sec", 900),
     ("transformer", "transformer_big_nmt_train_samples_per_sec_per_chip",
@@ -814,7 +1044,7 @@ BENCHES = [
 ]
 _BENCH_FNS = {
     "lenet": bench_lenet_smoke, "pipeline": bench_pipeline,
-    "resnet50": bench_resnet50,
+    "precision": bench_precision, "resnet50": bench_resnet50,
     "transformer": bench_transformer_big, "bert_long": bench_bert_long,
     "bert": bench_bert,
 }
@@ -996,7 +1226,9 @@ if __name__ == "__main__":
         sys.exit(_coldstart_child(sys.argv[2:]))
     if len(sys.argv) > 2 and sys.argv[1] == "--one":
         if "--smoke" in sys.argv[3:]:
-            # coldstart's measurement children inherit this via env
+            # coldstart's measurement children inherit this via env;
+            # the precision block reads the generic flag
             os.environ["PADDLE_TPU_COLDSTART_SMOKE"] = "1"
+            os.environ["PADDLE_TPU_BENCH_SMOKE"] = "1"
         sys.exit(run_one(sys.argv[2]))
     sys.exit(main())

@@ -6,9 +6,15 @@ dynamic loss scaling).
 
 TPU-native: the preferred low-precision dtype is **bfloat16**, which needs NO
 loss scaling (same exponent range as fp32) — `decorate` with
-use_bf16=True (default) simply casts white-list op inputs to bf16 and keeps
-master weights in fp32. The fp16 path with dynamic loss scaling is kept for
-parity.
+use_bf16=True (default) pins the program to the `mixed_bf16` PRECISION
+POLICY (core/precision.py): white-list op inputs cast to bf16
+jnp-natively at lowering time, master weights stay fp32, and the
+policy is part of the executor cache / compile-cache keys. The fp16
+path with static loss scaling is kept for parity
+(`decorate(use_bf16=False)`), and the legacy protobuf cast-op rewrite
+survives behind `decorate(..., rewrite=True)`. The jax-native trainer's
+DYNAMIC loss scaling (state inside TrainState) lives in
+parallel/train.py `make_train_step(precision="mixed_bf16")`.
 """
 
 from .decorator import decorate, OptimizerWithMixedPrecision

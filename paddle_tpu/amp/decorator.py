@@ -1,11 +1,25 @@
 """AMP decorator (reference: contrib/mixed_precision/decorator.py:208
 `decorate` → OptimizerWithMixedPrecision:27 — cast insertion per white/black
-lists + loss scaling)."""
+lists + loss scaling).
+
+Rebuilt on the PRECISION POLICY (core/precision.py): instead of
+rewriting the protobuf with cast ops, `decorate` pins the program to
+the `mixed_bf16` (or `mixed_f16`) policy and the executor inserts the
+white/black-list casts jnp-natively at LOWERING time — XLA sees and
+fuses them, the program desc stays clean, and the same policy is part
+of the executor cache key / compile-cache fingerprint so flipping it
+recompiles. The legacy protobuf pass survives as `rewrite_program`
+(and `decorate(..., rewrite=True)`) for parity with the reference.
+The jax-native trainer's dynamic loss scaling lives in
+parallel/train.py make_train_step(precision=...), with its state
+inside TrainState; this fluid-path decorator keeps the reference's
+static scale-var + unscale + zero-nonfinite-grad machinery for f16."""
 
 from __future__ import annotations
 
 from typing import Dict, Optional
 
+from ..core import precision as _precision
 from ..core.framework import (OpRole, Program, Variable, default_main_program,
                               op_role_guard, unique_name)
 from ..core.ir import OpDesc
@@ -77,11 +91,18 @@ class OptimizerWithMixedPrecision:
     def __init__(self, optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
                  use_dynamic_loss_scaling=True, incr_every_n_steps=1000,
                  decr_every_n_nan_or_inf=2, incr_ratio=2.0, decr_ratio=0.8,
-                 use_bf16=True):
+                 use_bf16=True, rewrite=False):
         self._optimizer = optimizer
         self._amp_lists = amp_lists or AutoMixedPrecisionLists()
         self._use_bf16 = use_bf16
         self._dest_dtype = "bfloat16" if use_bf16 else "float16"
+        self._policy_name = "mixed_bf16" if use_bf16 else "mixed_f16"
+        # rewrite=True restores the legacy protobuf cast-op pass; the
+        # default pins the program's precision policy instead and the
+        # executor autocasts at lowering time. Custom amp_lists force
+        # the rewrite path too — the policy autocast uses the module
+        # white/black lists, not per-optimizer customizations.
+        self._rewrite = bool(rewrite) or amp_lists is not None
         # bf16 has fp32's exponent range — no loss scaling needed
         self._loss_scaling = 1.0 if use_bf16 else init_loss_scaling
         self._use_dynamic = use_dynamic_loss_scaling and not use_bf16
@@ -93,7 +114,10 @@ class OptimizerWithMixedPrecision:
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, callbacks=None):
         program = loss.block.program
-        rewrite_program(program, self._amp_lists, self._dest_dtype)
+        if self._rewrite:
+            rewrite_program(program, self._amp_lists, self._dest_dtype)
+        else:
+            _precision.set_program_precision(program, self._policy_name)
         loss = program.global_block().var(loss.name)
         from ..layers import ops as _lops
         from ..layers import tensor as _lt
@@ -143,9 +167,12 @@ class OptimizerWithMixedPrecision:
 def decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
              incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
              incr_ratio=2.0, decr_ratio=0.8, use_dynamic_loss_scaling=True,
-             use_bf16=True):
-    """reference: decorator.py:208."""
+             use_bf16=True, rewrite=False):
+    """reference: decorator.py:208. Pins the loss's program to the
+    mixed_bf16/mixed_f16 precision policy (lowering-time jnp autocast);
+    pass rewrite=True (or custom amp_lists) for the legacy protobuf
+    cast-insertion pass."""
     return OptimizerWithMixedPrecision(
         optimizer, amp_lists, init_loss_scaling, use_dynamic_loss_scaling,
         incr_every_n_steps, decr_every_n_nan_or_inf, incr_ratio, decr_ratio,
-        use_bf16=use_bf16)
+        use_bf16=use_bf16, rewrite=rewrite)

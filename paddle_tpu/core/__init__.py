@@ -7,6 +7,7 @@ the execution model is compile-once (JAX/XLA) instead of interpret-per-op.
 from . import ir
 from . import registry
 from . import framework
+from . import precision
 from . import lowering
 from . import executor
 from . import backward

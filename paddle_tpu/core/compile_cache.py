@@ -126,10 +126,18 @@ def environment_meta() -> Dict[str, str]:
             "matmul_precision": precision}
 
 
-def fingerprint(lowered) -> Optional[str]:
+def fingerprint(lowered, extra: Optional[str] = None) -> Optional[str]:
     """Content address of a `jax.stages.Lowered`: sha256 over the
-    StableHLO module text + the environment meta. None when the module
-    text is unavailable (exotic lowerings) — caller compiles fresh."""
+    StableHLO module text + the environment meta + `extra` caller key
+    material. None when the module text is unavailable (exotic
+    lowerings) — caller compiles fresh.
+
+    `extra` carries per-dispatch key components that are neither module
+    content nor process environment — today the PRECISION POLICY name
+    (_JitDispatch.cache_fingerprint): two policies usually lower to
+    different StableHLO anyway, but the policy is kept as explicit key
+    material so a policy flip is GUARANTEED to miss even for a program
+    whose lowered text happens to be width-invariant."""
     try:
         text = lowered.as_text()
     except Exception:
@@ -139,6 +147,9 @@ def fingerprint(lowered) -> Optional[str]:
     for k, v in sorted(environment_meta().items()):
         h.update(b"\0")
         h.update(f"{k}={v}".encode())
+    if extra:
+        h.update(b"\0extra=")
+        h.update(str(extra).encode())
     return h.hexdigest()
 
 

@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..observability import telemetry as _telemetry
 from ..observability import tracing as _tracing
 from . import framework, lowering
+from . import precision as _precision
 from .executor import (RNG_STATE_VAR, Scope, _as_fetch_name,
                        _finish_fetches, _JitDispatch, mesh_device_kind,
                        _normalize_feed, _post_step_health, global_scope)
@@ -162,15 +163,17 @@ class CompiledProgram:
             fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
             mesh = self._get_mesh()
 
-            norm_feed = _normalize_feed(program, feed)
+            policy = _precision.resolve(program)
+            norm_feed = _normalize_feed(program, feed, policy)
             rec.set_feed(norm_feed)
 
             feed_sig = tuple(sorted((k, tuple(v.shape), str(v.dtype)) for k, v in norm_feed.items()))
-            key = (program._version, feed_sig, fetch_names)
+            key = (program._version, feed_sig, fetch_names, policy.name)
             step = self._cache.get(key)
             if step is None:
                 step = _ShardedStep(program, tuple(norm_feed), fetch_names,
-                                    mesh, self._build_strategy)
+                                    mesh, self._build_strategy,
+                                    policy=policy)
                 self._cache[key] = step
 
             rng = executor._get_rng(scope, program)
@@ -189,9 +192,13 @@ class _ShardedStep:
     ParallelExecutor splits the fed batch across devices)."""
 
     def __init__(self, program: Program, feed_names, fetch_names, mesh: Mesh,
-                 strategy: BuildStrategy):
+                 strategy: BuildStrategy,
+                 policy: Optional["_precision.PrecisionPolicy"] = None):
         desc = program.desc
         self.mesh = mesh
+        policy = policy if policy is not None \
+            else _precision.resolve(program)
+        self.policy = policy
         reads, writes = lowering.analyze_state_vars(desc, set(feed_names))
         persistable = {v.name for b in desc.blocks for v in b.vars.values() if v.persistable}
         for n in fetch_names:
@@ -219,8 +226,13 @@ class _ShardedStep:
             env = dict(const_states)
             env.update(mut_states)
             env.update(feeds)
+            if policy.cast_state:
+                env = {k: _precision.cast_floating(v, policy.compute_dtype)
+                       for k, v in env.items()}
             step_key, new_rng = jax.random.split(rng)
-            lowering.lower_block(desc, 0, env, rng_key=step_key, is_test=is_test)
+            with _precision.autocast(policy):
+                lowering.lower_block(desc, 0, env, rng_key=step_key,
+                                     is_test=is_test)
             fetches = [env[n] for n in fetch_names]
             new_states = {n: env[n] for n in self.writes if n in env}
             if multiproc:
@@ -241,7 +253,8 @@ class _ShardedStep:
             donate_argnums=(2,),
         ), "sharded", meta={"devices": int(mesh.size),
                             "device_kind": mesh_device_kind(mesh),
-                            "fetches": len(fetch_names)})
+                            "fetches": len(fetch_names)},
+            policy=policy.name)
 
     def __call__(self, scope: Scope, feed, rng):
         def _state(n):

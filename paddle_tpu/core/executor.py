@@ -29,6 +29,7 @@ from ..observability import health as _health
 from ..observability import telemetry as _telemetry
 from ..observability import tracing as _tracing
 from . import async_exec, compile_cache, framework, lowering
+from . import precision as _precision
 from .framework import Program, Variable
 from .ir import normalize_dtype
 from .places import CPUPlace, Place, default_place
@@ -89,16 +90,26 @@ class _JitDispatch:
     remembered PER SIGNATURE (`_tried_sig`): after an AOT failure or a
     signature drift, a warm()/dispatch with new avals retries instead of
     being locked out — a reshaped serving bucket must still get its AOT
-    executable."""
+    executable.
+
+    `policy` names the precision policy the wrapped computation was
+    built under (core/precision.py). It is part of the aval SIGNATURE
+    and of the persistent compile-cache fingerprint: a policy flip can
+    never be served an executable compiled under the old policy — it
+    misses and recompiles instead."""
 
     # executables already built for a signature, kept so alternating
     # shapes on ONE wrapper (SPMD partial final batch each epoch) swap
     # executables instead of re-paying an AOT compile per alternation
     _AOT_SIG_CAP = 8
 
-    def __init__(self, jit_fn, kind: str, meta: Optional[Dict] = None):
+    def __init__(self, jit_fn, kind: str, meta: Optional[Dict] = None,
+                 policy: Optional[str] = None):
         self._jit = jit_fn
         self._kind = kind
+        self._policy = str(policy) if policy else "f32"
+        if self._policy != "f32":
+            meta = dict(meta or {}, policy=self._policy)
         self._meta = meta
         self._aot = None
         self._tried = False
@@ -107,16 +118,29 @@ class _JitDispatch:
         self._compile_lock = threading.Lock()
         self._recorded_jit_compiles = 0
 
-    @staticmethod
-    def _aval_sig(args) -> Tuple:
+    def _aval_sig(self, args) -> Tuple:
         """Hashable shape/dtype signature of a warm()/call argument
         tuple — what decides whether a past AOT attempt covers these
-        avals."""
+        avals. Leads with the precision policy: two executables for the
+        same avals under different policies are different programs."""
         leaves, treedef = jax.tree_util.tree_flatten(args)
-        return (treedef, tuple(
+        return (self._policy, treedef, tuple(
             (tuple(getattr(leaf, "shape", ()) or ()),
              str(getattr(leaf, "dtype", type(leaf).__name__)))
             for leaf in leaves))
+
+    def cache_fingerprint(self, lowered) -> Optional[str]:
+        """Persistent compile-cache key for `lowered` under this
+        wrapper's precision policy — the policy is key material, so a
+        flipped policy always misses instead of deserializing the old
+        policy's executable (used by warm() and the serving warmstart
+        bake/adopt pair, which must agree byte-for-byte). The default
+        f32 policy contributes NO extra key material so f32 keys stay
+        byte-identical to the pre-policy (PR 6) keys — upgrading must
+        not invalidate every warm cache dir and baked artifact."""
+        return compile_cache.fingerprint(
+            lowered,
+            extra=None if self._policy == "f32" else self._policy)
 
     def lower(self, *args, **kw):
         return self._jit.lower(*args, **kw)
@@ -165,7 +189,7 @@ class _JitDispatch:
             aot = None
             try:
                 lowered = self._jit.lower(*args)
-                key = (compile_cache.fingerprint(lowered)
+                key = (self.cache_fingerprint(lowered)
                        if compile_cache.enabled() else None)
                 if key:
                     aot = compile_cache.load(key, self._kind)
@@ -496,12 +520,19 @@ def _stack_feed_window(feeds: List[Dict[str, Any]]) -> Dict[str, Any]:
     return {k: _stack([f[k] for f in feeds]) for k in feeds[0]}
 
 
-def _normalize_feed(program: Program, feed: Dict[str, Any]
+def _normalize_feed(program: Program, feed: Dict[str, Any],
+                    policy: Optional["_precision.PrecisionPolicy"] = None
                     ) -> Dict[str, Any]:
     """Feed normalization shared by every run path (Executor._lookup_
     step, CompiledProgram._run, SPMDRunner.run): device-transfer via
     jnp.asarray and cast to the var's declared dtype, canonicalized to
-    jax's x64 state."""
+    jax's x64 state — except that under a non-f32 precision policy
+    FLOATING feeds target the policy's compute dtype instead of the
+    declared one. That kills the silent upcast on the stream hot path:
+    a bf16 feed under a bf16/mixed_bf16 policy already matches the
+    target and is passed through with no astype at all."""
+    if policy is None:
+        policy = _precision.resolve(program)
     norm_feed = {}
     for name, val in feed.items():
         vdesc = None
@@ -511,7 +542,8 @@ def _normalize_feed(program: Program, feed: Dict[str, Any]
                 break
         arr = jnp.asarray(val)
         if vdesc is not None:
-            want = _canonical_dtype(normalize_dtype(vdesc.dtype))
+            want = policy.feed_dtype(
+                _canonical_dtype(normalize_dtype(vdesc.dtype)))
             if arr.dtype != want:
                 arr = arr.astype(want)
         norm_feed[name] = arr
@@ -542,11 +574,20 @@ def _finish_fetches(fetches, return_numpy: bool, sync: bool,
 
 
 class _CompiledStep:
-    """One jitted program specialization."""
+    """One jitted program specialization, built under ONE precision
+    policy: a pure-bf16 policy casts floating state to the compute
+    dtype at step entry (inside the jit — params stay bf16 on device
+    thereafter, so the cast is a one-time signature transition), a
+    mixed policy activates the lowering-time op autocast instead and
+    leaves master state f32."""
 
     def __init__(self, program: Program, feed_names: Tuple[str, ...],
-                 fetch_names: Tuple[str, ...], is_test: bool):
+                 fetch_names: Tuple[str, ...], is_test: bool,
+                 policy: Optional["_precision.PrecisionPolicy"] = None):
         desc = program.desc
+        policy = policy if policy is not None \
+            else _precision.resolve(program)
+        self.policy = policy
         reads, writes = lowering.analyze_state_vars(desc, set(feed_names))
         persistable = {
             v.name
@@ -567,8 +608,16 @@ class _CompiledStep:
             env = dict(const_states)
             env.update(mut_states)
             env.update(feeds)
+            if policy.cast_state:
+                # pure low-precision: state joins the compute width; the
+                # first step's f32->bf16 casts compile once, thereafter
+                # the scope holds bf16 arrays and the cast is a no-op
+                env = {k: _precision.cast_floating(v, policy.compute_dtype)
+                       for k, v in env.items()}
             step_key, new_rng = jax.random.split(rng)
-            lowering.lower_block(desc, 0, env, rng_key=step_key, is_test=is_test)
+            with _precision.autocast(policy):
+                lowering.lower_block(desc, 0, env, rng_key=step_key,
+                                     is_test=is_test)
             fetches = []
             for n in fetch_names:
                 if n not in env:
@@ -583,7 +632,8 @@ class _CompiledStep:
         self._step = step
         self.fn = _JitDispatch(
             jax.jit(step, donate_argnums=(2,)), "step",
-            meta={"fetches": len(fetch_names), "writes": len(writes)})
+            meta={"fetches": len(fetch_names), "writes": len(writes)},
+            policy=policy.name)
         # LRU-bounded: each entry is a whole XLA executable (see
         # _chained_cache_limit); evictions are counted in the registry.
         # Key: (n_steps, per_step_feeds, unroll).
@@ -662,7 +712,8 @@ class _CompiledStep:
             jax.jit(chained, donate_argnums=(2,)), "chained",
             meta={"n_steps": int(n_steps),
                   "per_step_feeds": bool(per_step_feeds),
-                  "unroll": bool(unroll)})
+                  "unroll": bool(unroll)},
+            policy=self.policy.name)
         self._chained[key] = fn
         limit = _chained_cache_limit()
         while len(self._chained) > limit:
@@ -812,16 +863,23 @@ class Executor:
                      fetch_names: Tuple[str, ...], use_program_cache: bool):
         """Normalize feeds and resolve the compiled step from the program
         cache, keyed by (program identity+version, feed shapes/dtypes,
-        fetches, mode) — the reference's ExecutorPrepareContext cache
-        (executor.py:418/831)."""
-        norm_feed = _normalize_feed(program, feed)
+        fetches, mode, PRECISION POLICY) — the reference's
+        ExecutorPrepareContext cache (executor.py:418/831). The policy
+        is resolved once here (program attr > PADDLE_TPU_PRECISION >
+        f32) and baked into both the feed normalization and the
+        compiled step, so a policy flip re-keys instead of reusing the
+        old width's executable."""
+        policy = _precision.resolve(program)
+        norm_feed = _normalize_feed(program, feed, policy)
         feed_sig = tuple(sorted((k, tuple(v.shape), str(v.dtype)) for k, v in norm_feed.items()))
-        key = (id(program), program._version, feed_sig, fetch_names, program._is_test)
+        key = (id(program), program._version, feed_sig, fetch_names,
+               program._is_test, policy.name)
         step = self._cache.get(key) if use_program_cache else None
         hit = step is not None
         if step is None:
             self._cache_misses += 1
-            step = _CompiledStep(program, tuple(norm_feed), fetch_names, program._is_test)
+            step = _CompiledStep(program, tuple(norm_feed), fetch_names,
+                                 program._is_test, policy=policy)
             if use_program_cache:
                 self._cache[key] = step
         else:

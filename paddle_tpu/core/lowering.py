@@ -17,6 +17,7 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 import jax
 import jax.numpy as jnp
 
+from . import precision as _precision
 from . import registry
 from .ir import OpDesc, ProgramDesc, VarType
 from .registry import KernelCtx
@@ -77,6 +78,14 @@ def run_op(
                     f"not in scope, and not produced by an earlier op)"
                 )
         ins[slot] = vals
+    # mixed-precision policies insert their casts HERE, jnp-natively at
+    # trace time (white-list ops take compute-dtype floats, black-list
+    # ops take f32) — the executor activates the policy around
+    # lower_block, so XLA sees and fuses the casts; grad ops inherit
+    # their forward op's class (core/precision.py).
+    pol = _precision.active_autocast()
+    if pol is not None:
+        ins = _precision.autocast_op_inputs(op.type, ins, pol)
     ctx = KernelCtx(
         op,
         lower_block_fn=lower_sub,

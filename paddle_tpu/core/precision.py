@@ -1,0 +1,323 @@
+"""Precision policy — a first-class executor concept.
+
+Reference capability: contrib/mixed_precision (fp16 cast insertion +
+dynamic loss scaling) and slim/quantization (post-training INT8) exist
+because half/int8 hot paths are where real throughput lives. On TPU,
+bf16 is the native matmul width; this module makes the choice of
+compute width an explicit, named POLICY that every run path resolves
+the same way, instead of an ad-hoc property of whichever cast ops a
+program rewrite happened to insert.
+
+Named policies:
+
+  f32         — today's behavior, bit for bit: feeds canonicalize to
+                the declared var dtype, nothing is cast.
+  bf16        — pure bf16: floating feeds AND state (params, optimizer
+                moments) are cast to bfloat16; the whole step computes
+                and stores in bf16. Maximum speed, fewest bytes.
+  mixed_bf16  — bf16 compute with f32 master params/optimizer state:
+                floating feeds arrive/cast to bf16, white-list ops
+                (matmul/conv family) compute in bf16, black-list ops
+                (softmax/norm/reductions) compute in f32 — the casts
+                are inserted jnp-natively at LOWERING time, inside the
+                jit trace, so XLA fuses them — and the jax-native
+                trainer adds dynamic loss scaling whose state lives in
+                TrainState (checkpointed by CheckpointManager).
+  mixed_f16   — same shape with float16 compute; kept for reference
+                parity (amp.decorate(use_bf16=False)). f16's narrow
+                exponent range is why loss scaling exists at all.
+
+Resolution order (first hit wins), shared by Executor.run/run_chained/
+run_stream, CompiledProgram, SPMDRunner, the Predictor, and
+make_train_step:
+
+  1. explicit argument (ServingConfig(precision=...),
+     AnalysisConfig.set_precision, make_train_step(precision=...))
+  2. program attr (`set_program_precision(program, name)`, also set by
+     amp.decorate on the program it rewrites)
+  3. env `PADDLE_TPU_PRECISION`
+  4. default: f32
+
+The resolved policy is part of the executor program-cache key, the
+`_JitDispatch` aval signature, and the persistent compile-cache
+fingerprint — flipping the policy can never serve a stale executable
+compiled under the old one.
+
+The int8 SERVING path is not a policy here (it rewrites the saved
+program to quantized_* ops via slim/quantization and serves that
+program under f32 semantics); `ServingConfig(precision="int8")` drives
+it in serving/engine.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Any, Dict, List, Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "PrecisionPolicy", "POLICY_NAMES", "get_policy", "resolve",
+    "set_program_precision", "program_precision", "env_precision",
+    "autocast", "active_autocast", "autocast_op_inputs", "cast_floating",
+    "cast_tree", "init_loss_scale_state", "LOSS_SCALE_COUNTER_KEYS",
+]
+
+ENV_VAR = "PADDLE_TPU_PRECISION"
+PROGRAM_ATTR = "precision"
+
+
+class PrecisionPolicy:
+    """One named precision configuration. Immutable; compare by name."""
+
+    def __init__(self, name: str, *,
+                 compute_dtype: Optional[str] = None,
+                 cast_state: bool = False,
+                 op_autocast: bool = False,
+                 dynamic_loss_scale: bool = False,
+                 init_loss_scale: float = 2.0 ** 15,
+                 growth_interval: int = 1000,
+                 incr_ratio: float = 2.0,
+                 decr_ratio: float = 0.5,
+                 min_loss_scale: float = 1.0,
+                 max_loss_scale: float = 2.0 ** 24):
+        self.name = name
+        # None = leave dtypes alone (the f32 policy must be a byte-for-
+        # byte no-op, including float64 feeds under x64 jax)
+        self.compute_dtype = (np.dtype(compute_dtype)
+                              if compute_dtype is not None else None)
+        self.cast_state = cast_state
+        self.op_autocast = op_autocast
+        self.dynamic_loss_scale = dynamic_loss_scale
+        self.init_loss_scale = float(init_loss_scale)
+        self.growth_interval = int(growth_interval)
+        self.incr_ratio = float(incr_ratio)
+        self.decr_ratio = float(decr_ratio)
+        self.min_loss_scale = float(min_loss_scale)
+        self.max_loss_scale = float(max_loss_scale)
+
+    def feed_dtype(self, declared: np.dtype) -> np.dtype:
+        """Feed-normalization target for a var declared `declared`:
+        floating feeds follow the policy's compute width, everything
+        else (ints, bools, keys) keeps the declared dtype. This is what
+        kills the silent bf16→f32 upcast on the stream hot path: under
+        a bf16 policy a bf16 feed already IS the target dtype, so no
+        per-step astype happens at all."""
+        # jnp.issubdtype: np.issubdtype does not recognize the
+        # ml_dtypes extension floats (bfloat16) as np.floating
+        if self.compute_dtype is not None and \
+                jnp.issubdtype(declared, jnp.floating):
+            return self.compute_dtype
+        return declared
+
+    def __repr__(self):
+        return f"PrecisionPolicy({self.name!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, PrecisionPolicy) and \
+            other.name == self.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+_POLICIES: Dict[str, PrecisionPolicy] = {
+    "f32": PrecisionPolicy("f32"),
+    "bf16": PrecisionPolicy("bf16", compute_dtype="bfloat16",
+                            cast_state=True),
+    # bf16 shares f32's exponent range, so overflow is as rare as in
+    # f32 — but the dynamic-scaling machinery still skips nonfinite
+    # steps and its state must live in TrainState either way, so the
+    # policy keeps it on with the reference's classic 2^15 seed.
+    "mixed_bf16": PrecisionPolicy("mixed_bf16", compute_dtype="bfloat16",
+                                  op_autocast=True,
+                                  dynamic_loss_scale=True),
+    "mixed_f16": PrecisionPolicy("mixed_f16", compute_dtype="float16",
+                                 op_autocast=True,
+                                 dynamic_loss_scale=True),
+}
+
+POLICY_NAMES = tuple(sorted(_POLICIES))
+
+
+def get_policy(name: Union[str, PrecisionPolicy, None]) -> PrecisionPolicy:
+    """Policy for `name` (a PrecisionPolicy passes through; None = f32).
+    Unknown names fail fast — a typo'd PADDLE_TPU_PRECISION silently
+    meaning f32 would be the exact class of silent wrong-width bug this
+    module exists to kill."""
+    if name is None:
+        return _POLICIES["f32"]
+    if isinstance(name, PrecisionPolicy):
+        return name
+    pol = _POLICIES.get(str(name))
+    if pol is None:
+        raise ValueError(
+            f"unknown precision policy {name!r}; choose from "
+            f"{list(POLICY_NAMES)}")
+    return pol
+
+
+def env_precision() -> Optional[str]:
+    raw = os.environ.get(ENV_VAR)
+    return raw or None
+
+
+def set_program_precision(program, name: Optional[str]):
+    """Pin `program` to a named policy (None clears it). Bumps the
+    program version so every executor program-cache key re-keys — the
+    old policy's compiled steps are never served for the new one."""
+    if name is not None:
+        get_policy(name)  # validate before mutating
+    new = str(name) if name is not None else None
+    if program._attrs.get(PROGRAM_ATTR) == new:
+        return  # re-pinning the same policy must not invalidate the
+        # program's compiled steps (bench/decorator paths re-pin)
+    if new is None:
+        program._attrs.pop(PROGRAM_ATTR, None)
+    else:
+        program._attrs[PROGRAM_ATTR] = new
+    program._bump_version()
+
+
+def program_precision(program) -> Optional[str]:
+    attrs = getattr(program, "_attrs", None)
+    if not attrs:
+        return None
+    return attrs.get(PROGRAM_ATTR)
+
+
+def resolve(program=None, explicit=None) -> PrecisionPolicy:
+    """The policy in effect for a run: explicit arg > program attr >
+    PADDLE_TPU_PRECISION > f32."""
+    if explicit is not None:
+        return get_policy(explicit)
+    name = program_precision(program) if program is not None else None
+    if name is None:
+        name = env_precision()
+    return get_policy(name)
+
+
+# ---------------------------------------------------------------------------
+# Casting helpers
+# ---------------------------------------------------------------------------
+
+
+def cast_floating(value, dtype):
+    """`value` cast to `dtype` iff it is a floating array of another
+    float width; ints/bools/keys/non-arrays pass through untouched."""
+    if value is None or dtype is None:
+        return value
+    vdt = getattr(value, "dtype", None)
+    if vdt is None:
+        return value
+    try:
+        if not jnp.issubdtype(vdt, jnp.floating) or vdt == dtype:
+            return value
+    except TypeError:
+        return value  # exotic dtypes (prng keys) are never cast
+    return value.astype(dtype)
+
+
+def cast_tree(tree, dtype):
+    """cast_floating over every leaf of a pytree."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda v: cast_floating(v, dtype), tree)
+
+
+# ---------------------------------------------------------------------------
+# Lowering-time op autocast (the jnp-native replacement for the amp
+# protobuf cast-op rewrite): core/lowering.run_op consults the active
+# policy for every op it traces, casting white-list op inputs to the
+# compute dtype and black-list op inputs back to f32. The casts are
+# jnp ops inserted inside the jit trace — XLA fuses them — and grad ops
+# (`foo_grad`, lowered via jax.vjp of `foo`) inherit their forward op's
+# class, so the backward matmuls run at the same width as the forward.
+# ---------------------------------------------------------------------------
+
+_tl = threading.local()
+_op_lists = None  # (white, black), loaded lazily from amp.fp16_lists
+
+
+def _lists():
+    global _op_lists
+    if _op_lists is None:
+        from ..amp import fp16_lists
+
+        _op_lists = (frozenset(fp16_lists.white_list),
+                     frozenset(fp16_lists.black_list))
+    return _op_lists
+
+
+@contextlib.contextmanager
+def autocast(policy: Optional[PrecisionPolicy]):
+    """Activate lowering-time op autocast for the with-block (a trace).
+    No-op for policies without op_autocast. Thread-local: concurrent
+    HogwildWorker traces on other threads are unaffected."""
+    if policy is None or not policy.op_autocast:
+        yield
+        return
+    prev = getattr(_tl, "policy", None)
+    _tl.policy = policy
+    try:
+        yield
+    finally:
+        _tl.policy = prev
+
+
+def active_autocast() -> Optional[PrecisionPolicy]:
+    return getattr(_tl, "policy", None)
+
+
+def _base_op_type(op_type: str) -> str:
+    # conv2d_grad / conv2d_grad_grad classify as conv2d
+    while op_type.endswith("_grad"):
+        op_type = op_type[:-len("_grad")]
+    return op_type
+
+
+def autocast_op_inputs(op_type: str, ins: Dict[str, List],
+                       policy: PrecisionPolicy) -> Dict[str, List]:
+    """Cast `ins` (slot -> value list) for `op_type` under `policy`:
+    white-list ops take compute-dtype floats, black-list ops take f32
+    floats, everything else passes through (dtype propagation decides).
+    """
+    white, black = _lists()
+    base = _base_op_type(op_type)
+    if base in white:
+        want = policy.compute_dtype
+    elif base in black:
+        want = np.dtype(np.float32)
+    else:
+        return ins
+    return {slot: [cast_floating(v, want) for v in vals]
+            for slot, vals in ins.items()}
+
+
+# ---------------------------------------------------------------------------
+# Dynamic loss scaling state (the TrainState-resident piece). The state
+# is a plain dict pytree so orbax checkpoints round-trip it with zero
+# special casing; hyperparameters (ratios, interval) stay static in the
+# policy and are closed over by the jitted step.
+# ---------------------------------------------------------------------------
+
+# cumulative device-side outcome counters, diffed host-side by the
+# trainer to tick paddle_tpu_amp_total{event=...}
+LOSS_SCALE_COUNTER_KEYS = ("overflows", "growths")
+
+
+def init_loss_scale_state(policy: PrecisionPolicy) -> Optional[Dict[str, Any]]:
+    """Fresh loss-scale state for `policy`, or None when the policy has
+    no dynamic loss scaling (the TrainState field stays an empty
+    subtree, keeping old checkpoints restorable)."""
+    if not policy.dynamic_loss_scale:
+        return None
+    return {
+        "scale": jnp.asarray(policy.init_loss_scale, jnp.float32),
+        "good_steps": jnp.asarray(0, jnp.int32),
+        "overflows": jnp.asarray(0, jnp.int32),
+        "growths": jnp.asarray(0, jnp.int32),
+    }

@@ -299,11 +299,23 @@ def make_generic_grad_kernel(fwd: OpDef) -> Callable:
 
         primal_out, vjp_fn = jax.vjp(f, diff_ins)
 
+        def _cot(g, v):
+            # vjp demands the cotangent's dtype match the primal output
+            # exactly. Under a mixed-precision policy the upstream grad
+            # may arrive at a different float width than this op's
+            # forward computed in (a bf16 matmul grad flowing into an
+            # f32 gray op) — the cast is the transpose of the identity
+            # cast autocast conceptually inserted between them.
+            if hasattr(g, "dtype") and g.dtype != v.dtype:
+                return g.astype(v.dtype)
+            return g
+
         cots = {}
         for slot, vals in primal_out.items():
             given = out_grads.get(slot)
             cots[slot] = [
-                (given[i] if given is not None and i < len(given) and given[i] is not None
+                (_cot(given[i], v) if given is not None and i < len(given)
+                 and given[i] is not None
                  else jnp.zeros(v.shape, v.dtype))
                 for i, v in enumerate(vals)
             ]

@@ -21,6 +21,7 @@ import numpy as np
 
 from . import io
 from .core import lowering
+from .core import precision as _precision
 from .core.executor import Executor, Scope, _JitDispatch, scope_guard
 from .core.ir import normalize_dtype
 from .core.places import CPUPlace, Place, TPUPlace, default_place
@@ -40,6 +41,7 @@ class AnalysisConfig:
         self._aot = False               # ahead-of-time compile at load
         self._native_engine = False     # C++ interpreter (capi) backend
         self._bucketing = None          # serving.bucketing.BucketPolicy
+        self._precision = None          # core.precision policy name
 
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
         self._use_tpu = True  # accelerator = TPU in this framework
@@ -72,6 +74,17 @@ class AnalysisConfig:
 
         self._bucketing = BucketPolicy(max_batch=max_batch,
                                        buckets=buckets)
+
+    def set_precision(self, name: Optional[str]):
+        """Serve under a named precision policy (core/precision.py:
+        "f32" | "bf16" | "mixed_bf16"): floating feeds normalize to the
+        policy's compute dtype and the loaded program lowers under its
+        autocast. Resolution order: this config > the loaded program's
+        precision attr > PADDLE_TPU_PRECISION > f32. Ignored by the
+        native (C++) engine, which is f32-only."""
+        if name is not None:
+            _precision.get_policy(name)  # fail fast on typos
+        self._precision = name
 
     def enable_native_engine(self):
         """Serve through the C++ interpreter (native/src/predictor.cc) —
@@ -134,6 +147,10 @@ class Predictor:
         self._fetch_names = [v if isinstance(v, str) else v.name
                              for v in self._fetch_vars]
         self._program._is_test = True
+        # one policy per Predictor, resolved at load: config >
+        # program attr (a model saved under a policy keeps it) > env
+        self._policy = _precision.resolve(self._program,
+                                          explicit=config._precision)
         self._cache: Dict = {}
         # which fetches carry the batch dim (declared leading dim is
         # dynamic): bucketing must never slice an output whose fixed
@@ -176,11 +193,14 @@ class Predictor:
         if step is None:
             desc = self._program.desc
             feed_names = tuple(n for n, _, _ in sig)
+            policy = self._policy
 
             def fwd(feeds, state):
                 env = dict(state)
                 env.update(feeds)
-                lowering.lower_block(desc, 0, env, rng_key=None, is_test=True)
+                with _precision.autocast(policy):
+                    lowering.lower_block(desc, 0, env, rng_key=None,
+                                         is_test=True)
                 return [env[n] for n in self._fetch_names]
 
             state = {}
@@ -189,12 +209,19 @@ class Predictor:
                     if v.persistable:
                         val = self._scope.find_var(name)
                         if val is not None:
-                            state[name] = jnp.asarray(val)
+                            arr = jnp.asarray(val)
+                            if policy.cast_state:
+                                # pure low-precision serving: params are
+                                # cast ONCE here, not per request
+                                arr = _precision.cast_floating(
+                                    arr, policy.compute_dtype)
+                            state[name] = arr
             # _JitDispatch: compiles land in paddle_tpu_compile_seconds
             # {kind="infer"} and the `compile` event log, so a serving
             # deployment can assert its bucket set stays closed
             jitted = _JitDispatch(jax.jit(fwd), "infer", meta={
-                "signature": ",".join(f"{n}:{list(s)}" for n, s, _ in sig)})
+                "signature": ",".join(f"{n}:{list(s)}" for n, s, _ in sig)},
+                policy=policy.name)
             # warm=False (adopt_warm) builds the slot for an executable
             # that already exists — warming would compile the very thing
             # the warmstart artifact exists to skip
@@ -223,7 +250,8 @@ class Predictor:
                 raise ValueError(
                     f"feed '{name}' has non-batch dynamic dims "
                     f"{tuple(var.shape)}; warm it with a real batch")
-            dtype = np.dtype(normalize_dtype(var.dtype))
+            dtype = self._policy.feed_dtype(
+                np.dtype(normalize_dtype(var.dtype)))
             entries.append((name, tuple(shape), str(dtype)))
         return tuple(sorted(entries))
 
@@ -263,7 +291,10 @@ class Predictor:
             try:
                 shapes = {n: jax.ShapeDtypeStruct(s, np.dtype(d))
                           for n, s, d in sig}
-                fp = compile_cache.fingerprint(
+                # cache_fingerprint, not bare fingerprint: the policy is
+                # key material, so an artifact baked under one policy is
+                # rejected by a process serving another
+                fp = jitted.cache_fingerprint(
                     jitted.lower(shapes, state))
                 out[sig] = {"blob":
                             compile_cache.serialize_executable(exe),
@@ -295,7 +326,7 @@ class Predictor:
                 jitted, state = self._compiled(sig, warm=False)
                 shapes = {n: jax.ShapeDtypeStruct(s, np.dtype(d))
                           for n, s, d in sig}
-                fp = compile_cache.fingerprint(
+                fp = jitted.cache_fingerprint(
                     jitted.lower(shapes, state))
                 if fp is None or fp != entry["fingerprint"]:
                     continue  # lowering/flags drifted since the bake
@@ -340,7 +371,8 @@ class Predictor:
         for i, t in enumerate(inputs):
             name = t.name or self._feed_names[i]
             var = self._find_var(name)
-            want = np.dtype(normalize_dtype(var.dtype)) \
+            want = self._policy.feed_dtype(
+                np.dtype(normalize_dtype(var.dtype))) \
                 if var is not None else None
             arr = np.asarray(t.data)
             if want is not None and arr.dtype != want:

@@ -29,6 +29,7 @@ __all__ = [
     "record_executor_step", "record_cache_event", "record_trainer_step",
     "record_trainer_run", "record_spmd_step", "record_pipeline_trace",
     "record_compile", "record_compile_cache", "record_device_memory",
+    "record_amp",
     "record_host_blocked", "record_dispatch_ready",
     "record_prefetch_depth", "record_prefetch_item",
     "record_async_inflight", "record_chained_eviction",
@@ -114,6 +115,17 @@ COMPILE_CACHE_BYTES = _m.counter(
     "paddle_tpu_compile_cache_bytes_total",
     "Bytes read on compile-cache hits / written on stores / dropped on "
     "evictions", labelnames=("kind", "event"))
+AMP_EVENTS = _m.counter(
+    "paddle_tpu_amp_total",
+    "Dynamic loss-scaling outcomes under a mixed-precision policy: "
+    "overflow (nonfinite grads detected), skip (the update those grads "
+    "would have applied was dropped), growth (scale grew after a clean "
+    "streak). A rising overflow rate at steady state means the scale "
+    "is thrashing — lower init_loss_scale or widen growth_interval",
+    labelnames=("event",))
+AMP_LOSS_SCALE = _m.gauge(
+    "paddle_tpu_amp_loss_scale",
+    "Current dynamic loss scale (last host-observed value)")
 DEVICE_LIVE_BYTES = _m.gauge(
     "paddle_tpu_device_live_bytes",
     "Bytes held by live device buffers (jax.live_arrays sum); monotonic "
@@ -263,6 +275,27 @@ def record_compile_cache(kind: str, event: str, nbytes: int = 0,
     if error:
         fields["error"] = error
     _events.emit("compile_cache", **fields)
+
+
+def record_amp(event: str, n: int = 1, step: Optional[int] = None,
+               scale: Optional[float] = None):
+    """`n` dynamic loss-scaling outcomes of kind `event`
+    (overflow|growth|skip). Overflows additionally land in the JSONL
+    log as `amp_overflow` events — a scale-thrash timeline is how a
+    diverging mixed-precision run is diagnosed after the fact
+    (tools/obsdump.py events --kind amp_overflow)."""
+    if n <= 0:
+        return
+    AMP_EVENTS.inc(n, event=event)
+    if scale is not None:
+        AMP_LOSS_SCALE.set(float(scale))
+    if event == "overflow":
+        fields: Dict = {"count": int(n)}
+        if step is not None:
+            fields["step"] = int(step)
+        if scale is not None:
+            fields["scale"] = float(scale)
+        _events.emit("amp_overflow", **fields)
 
 
 def record_device_memory(nbytes: int, nbuffers: int):

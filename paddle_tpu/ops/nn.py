@@ -34,25 +34,32 @@ def _conv_padding(attrs, spatial_rank, strides, x_spatial, k_spatial, dilations)
     return [(pads[2 * i], pads[2 * i + 1]) for i in range(spatial_rank)]
 
 
-def _conv_nd(x, w, attrs, nd, feature_group_count=None):
+def _conv_nd(x, w, attrs, nd, feature_group_count=None, f32_accum=True):
     strides = tuple(int(s) for s in attrs.get("strides", [1] * nd))
     dilations = tuple(int(d) for d in attrs.get("dilations", [1] * nd))
     groups = int(attrs.get("groups", 1)) if feature_group_count is None else feature_group_count
     padding = _conv_padding(attrs, nd, strides, x.shape[2:], w.shape[2:], dilations)
     dn_str = ("NCHW", "OIHW", "NCHW") if nd == 2 else ("NCDHW", "OIDHW", "NCDHW")
     dn = jax.lax.conv_dimension_numbers(x.shape, w.shape, dn_str)
+    # f32_accum (inference only): explicit f32 accumulation for bf16
+    # convs. The TRAINING path must not request it — jax 0.4.x's conv
+    # transpose rule feeds the f32-typed cotangent back into a conv
+    # against the bf16 filter and rejects the dtype mix, so the
+    # differentiable path accumulates at the input width (the TPU MXU
+    # accumulates bf16 partials in f32 internally regardless).
+    accum = jnp.float32 if f32_accum and x.dtype == jnp.bfloat16 else None
     return jax.lax.conv_general_dilated(
         x, w, window_strides=strides, padding=padding,
         rhs_dilation=dilations, dimension_numbers=dn,
         feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None,
+        preferred_element_type=accum,
     ).astype(x.dtype)
 
 
 @register_op("conv2d", nondiff_inputs=())
 def conv2d(ins, attrs, ctx):
     x, w = ins["Input"][0], ins["Filter"][0]
-    out = _conv_nd(x, w, attrs, 2)
+    out = _conv_nd(x, w, attrs, 2, f32_accum=ctx.is_test)
     if ins.get("Bias") and ins["Bias"][0] is not None:
         out = out + ins["Bias"][0].reshape(1, -1, 1, 1)
     return {"Output": out}
@@ -62,14 +69,15 @@ def conv2d(ins, attrs, ctx):
 def depthwise_conv2d(ins, attrs, ctx):
     x, w = ins["Input"][0], ins["Filter"][0]
     # reference: groups == in_channels; lax expects OIHW with I = C/groups = 1
-    out = _conv_nd(x, w, attrs, 2, feature_group_count=x.shape[1])
+    out = _conv_nd(x, w, attrs, 2, feature_group_count=x.shape[1],
+                   f32_accum=ctx.is_test)
     return {"Output": out}
 
 
 @register_op("conv3d")
 def conv3d(ins, attrs, ctx):
     x, w = ins["Input"][0], ins["Filter"][0]
-    return {"Output": _conv_nd(x, w, attrs, 3)}
+    return {"Output": _conv_nd(x, w, attrs, 3, f32_accum=ctx.is_test)}
 
 
 @register_op("conv2d_transpose")

@@ -13,16 +13,52 @@ directly into the target NamedShardings.
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Optional
+from typing import Dict, Optional
 
 import jax
 
 from .train import TrainState
 
+# leaf-dtype manifest written next to every orbax payload: restore
+# compares it against the template's dtypes so a checkpoint written
+# under one precision policy can never SILENTLY restore into another
+# width — it either casts explicitly (cast_dtypes=True) or fails with
+# the mismatch list. Pre-manifest checkpoints restore as before.
+DTYPES_FILE = "_DTYPES.json"
+
+
+class PrecisionMismatchError(ValueError):
+    """Checkpoint leaf dtypes disagree with the restore template's —
+    e.g. a bf16-policy checkpoint restored into an f32-policy run.
+    Re-restore with cast_dtypes=True to convert explicitly, or rebuild
+    the template under the checkpoint's policy."""
+
+
+def _payload(state: TrainState) -> Dict:
+    payload = {"params": state.params, "opt_state": state.opt_state,
+               "step": state.step}
+    if getattr(state, "loss_scale", None) is not None:
+        # dynamic loss-scaling state (mixed-precision policies) rides
+        # the same orbax payload, so CheckpointManager round-trips it
+        payload["loss_scale"] = state.loss_scale
+    return payload
+
+
+def _dtype_manifest(tree) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        dt = getattr(leaf, "dtype", None)
+        if dt is not None:
+            out[jax.tree_util.keystr(path)] = str(dt)
+    return out
+
 
 def save_train_state(path: str, state: TrainState, force: bool = False):
-    """Write {params, opt_state, step} with their shardings to `path`.
+    """Write {params, opt_state, step[, loss_scale]} with their
+    shardings to `path`, plus a leaf-dtype manifest (_DTYPES.json) that
+    restore uses to refuse silent cross-precision restores.
 
     force=False refuses to overwrite an existing checkpoint: orbax
     deletes the old directory BEFORE the new write commits, so
@@ -33,34 +69,148 @@ def save_train_state(path: str, state: TrainState, force: bool = False):
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
+    payload = _payload(state)
     with ocp.StandardCheckpointer() as ckptr:
-        ckptr.save(path, {"params": state.params,
-                          "opt_state": state.opt_state,
-                          "step": state.step}, force=force)
+        ckptr.save(path, payload, force=force)
     from ..observability import events as _events
+    from ..resilience.atomic import json_dump
 
+    json_dump(_dtype_manifest(payload), os.path.join(path, DTYPES_FILE))
     _events.emit("checkpoint", site="save_train_state", dir=path,
                  step=int(state.step))
 
 
-def restore_train_state(path: str, template: TrainState) -> TrainState:
+def restore_train_state(path: str, template: TrainState,
+                        cast_dtypes: bool = False) -> TrainState:
     """Restore into the TEMPLATE's structure and shardings — pass a
     freshly-built `init_state(params)` result; its (possibly ZeRO-1
     sharded) layout tells orbax where every shard of every array lands.
-    """
+
+    Precision safety: when the checkpoint carries a dtype manifest and
+    any leaf width disagrees with the template (a bf16 checkpoint into
+    an f32-policy template, or vice versa), the restore FAILS with a
+    PrecisionMismatchError listing the offenders — restoring across
+    widths silently would corrupt the run's numerics story. Pass
+    cast_dtypes=True to reshard dtypes explicitly instead: leaves are
+    read back at their SAVED dtype and cast to the template's.
+
+    The same contract covers STRUCTURE: dynamic loss-scaling state
+    exists only under mixed policies, so a checkpoint and template
+    disagreeing on its presence is also a cross-precision restore —
+    it fails with PrecisionMismatchError, or under cast_dtypes=True
+    reshards explicitly (template-side loss-scale state keeps its
+    fresh init; checkpoint-side state is read and dropped)."""
     import orbax.checkpoint as ocp
 
     path = os.path.abspath(path)
     target = {"params": template.params,
               "opt_state": template.opt_state,
               "step": template.step}
-    abstract = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
-        if hasattr(x, "sharding") else x, target)
+    if getattr(template, "loss_scale", None) is not None:
+        target["loss_scale"] = template.loss_scale
+
+    saved_dtypes: Optional[Dict[str, str]] = None
+    manifest_path = os.path.join(path, DTYPES_FILE)
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as f:
+            saved_dtypes = json.load(f)
+
+    # structure guard BEFORE the per-leaf dtype loop (which only sees
+    # keys present on both sides): loss-scale presence differing would
+    # otherwise die inside orbax with an opaque tree-structure error
+    # that cast_dtypes could never fix. Manifest-less checkpoints
+    # predate loss-scale payloads, so no manifest == no saved state.
+    tmpl_has_ls = "loss_scale" in target
+    saved_has_ls = (saved_dtypes is not None
+                    and any(k.startswith("['loss_scale']")
+                            for k in saved_dtypes))
+    drop_saved_ls = False
+    if saved_has_ls != tmpl_has_ls:
+        if not cast_dtypes:
+            side = ("the checkpoint carries dynamic loss-scaling state "
+                    "but the restore template has none"
+                    if saved_has_ls else
+                    "the restore template expects dynamic loss-scaling "
+                    "state but the checkpoint has none")
+            raise PrecisionMismatchError(
+                f"checkpoint at {path} was written under a different "
+                f"precision policy than the restore template ({side}). "
+                f"Restore with cast_dtypes=True to reshard explicitly "
+                f"— the template's fresh loss-scale state is kept, a "
+                f"checkpoint-side one is dropped — or rebuild the "
+                f"template under the checkpoint's policy.")
+        if tmpl_has_ls:
+            # f32-era checkpoint into a mixed template: restore the
+            # shared items; the template keeps its fresh loss scale
+            target.pop("loss_scale")
+
+        else:
+            drop_saved_ls = True
+
+    mismatches = []
+    if saved_dtypes is not None:
+        for key, want in _dtype_manifest(target).items():
+            have = saved_dtypes.get(key)
+            if have is not None and have != want:
+                mismatches.append((key, have, want))
+        if mismatches and not cast_dtypes:
+            head = ", ".join(f"{k}: checkpoint {h} vs template {w}"
+                             for k, h, w in mismatches[:8])
+            raise PrecisionMismatchError(
+                f"checkpoint at {path} was written under a different "
+                f"precision than the restore template ({len(mismatches)}"
+                f" leaf dtype mismatches: {head}"
+                f"{', ...' if len(mismatches) > 8 else ''}). Restore "
+                f"with cast_dtypes=True to convert explicitly, or "
+                f"rebuild the template under the checkpoint's policy.")
+
+    mismatch_keys = {k for k, _, _ in mismatches}
+
+    def leaf_abstract(kpath, x):
+        if not hasattr(x, "sharding"):
+            return x
+        dtype = x.dtype
+        key = jax.tree_util.keystr(kpath)
+        if key in mismatch_keys:
+            # explicit dtype reshard: read at the SAVED width (the
+            # bytes on disk), cast to the template width afterwards
+            import numpy as np
+
+            dtype = np.dtype(saved_dtypes[key])
+        return jax.ShapeDtypeStruct(x.shape, dtype, sharding=x.sharding)
+
+    abstract = jax.tree_util.tree_map_with_path(leaf_abstract, target)
     with ocp.StandardCheckpointer() as ckptr:
+        if drop_saved_ls:
+            # orbax demands an exact top-level structure match, so the
+            # checkpoint-only loss_scale item must appear in the
+            # abstract tree — shape/dtype come from the checkpoint's
+            # own metadata; the restored values are dropped below
+            import numpy as np
+
+            abstract["loss_scale"] = jax.tree.map(
+                lambda m: jax.ShapeDtypeStruct(
+                    tuple(m.shape), np.dtype(str(m.dtype))),
+                ckptr.metadata(path)["loss_scale"])
         restored = ckptr.restore(path, abstract)
+    if drop_saved_ls:
+        restored.pop("loss_scale", None)
+    if mismatch_keys:
+        def recast(kpath, saved, tmpl):
+            if jax.tree_util.keystr(kpath) in mismatch_keys:
+                return jax.device_put(saved.astype(tmpl.dtype),
+                                      tmpl.sharding)
+            return saved
+
+        restored = jax.tree_util.tree_map_with_path(
+            lambda p, s, t: recast(p, s, t), restored, target)
+    loss_scale = restored.get("loss_scale")
+    if tmpl_has_ls and loss_scale is None:
+        # explicit cross-precision reshard into a mixed template: the
+        # checkpoint had no loss-scale state, keep the fresh init
+        loss_scale = template.loss_scale
     return TrainState(restored["params"], restored["opt_state"],
-                      restored["step"])
+                      restored["step"], loss_scale)
 
 
 def latest_step_dir(root: str, committed_only: bool = False) -> Optional[str]:

@@ -27,6 +27,7 @@ from ..observability import health as _health
 from ..observability import telemetry as _telemetry
 from ..observability import tracing as _tracing
 from ..core import framework, lowering
+from ..core import precision as _precision
 from ..core.executor import (RNG_STATE_VAR, Scope, _as_fetch_name,
                              _finish_fetches, _JitDispatch, _health_scan,
                              mesh_device_kind, _normalize_feed,
@@ -74,13 +75,14 @@ class SPMDRunner:
         feed = dict(feed or {})
         fetch_names = tuple(_as_fetch_name(f) for f in (fetch_list or []))
 
-        norm_feed = _normalize_feed(program, feed)
+        policy = _precision.resolve(program)
+        norm_feed = _normalize_feed(program, feed, policy)
         sig = tuple(sorted((k, tuple(v.shape), str(v.dtype))
                            for k, v in norm_feed.items()))
-        key = (program._version, sig, fetch_names)
+        key = (program._version, sig, fetch_names, policy.name)
         step = self._cache.get(key)
         if step is None:
-            step = self._build(tuple(norm_feed), fetch_names)
+            step = self._build(tuple(norm_feed), fetch_names, policy)
             self._cache[key] = step
 
         rng = executor._get_rng(scope, program)
@@ -104,7 +106,11 @@ class SPMDRunner:
                                     step.collective_counts)
         return out
 
-    def _build(self, feed_names: Tuple[str, ...], fetch_names: Tuple[str, ...]):
+    def _build(self, feed_names: Tuple[str, ...],
+               fetch_names: Tuple[str, ...],
+               policy: Optional["_precision.PrecisionPolicy"] = None):
+        policy = policy if policy is not None \
+            else _precision.resolve(self.program)
         desc = self.program.desc
         axis = self.axis
         n_dev = self.mesh.shape[axis]
@@ -140,11 +146,16 @@ class SPMDRunner:
             env = dict(const_states)
             env.update(mut_states)
             env.update(feeds)
+            if policy.cast_state:
+                env = {k: _precision.cast_floating(v,
+                                                   policy.compute_dtype)
+                       for k, v in env.items()}
             # per-device rng stream (reference: different seed per trainer)
             rng_local = jax.random.fold_in(rng, jax.lax.axis_index(axis))
             step_key, new_rng = jax.random.split(rng_local)
-            lowering.lower_block(desc, 0, env, rng_key=step_key,
-                                 is_test=is_test)
+            with _precision.autocast(policy):
+                lowering.lower_block(desc, 0, env, rng_key=step_key,
+                                     is_test=is_test)
             fetches = []
             for n in fetch_names:
                 if n not in env:
@@ -178,7 +189,8 @@ class SPMDRunner:
         jitted = _JitDispatch(jax.jit(sm), "spmd",
                               meta={"axis": axis, "devices": int(n_dev),
                                     "device_kind":
-                                        mesh_device_kind(self.mesh)})
+                                        mesh_device_kind(self.mesh)},
+                              policy=policy.name)
 
         def step(scope: Scope, feed, rng):
             def _state(n):

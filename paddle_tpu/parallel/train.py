@@ -25,6 +25,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import precision as _precision
 from ..models.common import Params, ParamAxes, is_trainable
 from .sharding import LogicalRules, current_rules, named_sharding_tree
 
@@ -50,15 +51,25 @@ class TrainStrategy:
 
 
 class TrainState:
-    """params + opt state + step, all sharded."""
+    """params + opt state + step, all sharded.
 
-    def __init__(self, params, opt_state, step):
+    `loss_scale` is the dynamic loss-scaling state of a mixed-precision
+    policy (core/precision.py init_loss_scale_state: scale, good_steps,
+    cumulative overflow/growth counters) and None under f32/bf16 — a
+    None subtree has no leaves, so checkpoints written before this
+    field existed keep restoring unchanged, while mixed-precision
+    checkpoints round-trip the scale bit-identically through
+    CheckpointManager."""
+
+    def __init__(self, params, opt_state, step, loss_scale=None):
         self.params = params
         self.opt_state = opt_state
         self.step = step
+        self.loss_scale = loss_scale
 
     def tree_flatten(self):
-        return (self.params, self.opt_state, self.step), None
+        return (self.params, self.opt_state, self.step,
+                self.loss_scale), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -109,14 +120,31 @@ def make_train_step(
     strategy: Optional[TrainStrategy] = None,
     batch_spec: Optional[P] = None,
     has_aux: bool = False,
+    precision=None,
 ):
     """Returns (init_state_fn, step_fn).
 
     loss_fn(params, batch, rng) -> scalar loss. step_fn(state, batch, rng)
     -> (state, loss), jitted over `mesh` with full shardings.
+
+    `precision` selects the core/precision.py policy (name or
+    PrecisionPolicy; default resolves PADDLE_TPU_PRECISION, else f32):
+
+      f32         — today's step, bit for bit.
+      bf16        — params/opt state initialized AND computed in bf16.
+      mixed_bf16  — f32 master params + optimizer state, loss/grads
+                    computed with bf16-cast params and batch, plus
+                    DYNAMIC LOSS SCALING: the scale/good-step state
+                    lives in TrainState.loss_scale (checkpointed by
+                    CheckpointManager), nonfinite grads skip the
+                    update and shrink the scale, growth_interval clean
+                    steps grow it, and cumulative overflow/growth
+                    counters feed paddle_tpu_amp_total via
+                    sync_loss_scale_metrics (train_loop calls it).
     """
     strategy = strategy or TrainStrategy()
     rules = rules or current_rules()
+    policy = _precision.resolve(explicit=precision)
     p_shardings = param_shardings(mesh, param_axes, rules)
     batch_spec = batch_spec if batch_spec is not None else rules.spec(("batch", "seq"))
     repl = NamedSharding(mesh, P())
@@ -155,6 +183,12 @@ def make_train_step(
         """Takes ownership of `params`: buffers may be aliased into the
         donated TrainState (the reference's overwrite-in-scope semantics,
         scope.h). Re-init or copy if the caller needs them afterwards."""
+        if policy.cast_state:
+            # pure low-precision: master weights themselves live at the
+            # compute width (mixed policies keep f32 masters instead)
+            params = {k: _precision.cast_floating(
+                jnp.asarray(v), policy.compute_dtype)
+                for k, v in params.items()}
         params = {
             k: jax.device_put(v, p_shardings[k]) for k, v in params.items()
         }
@@ -162,7 +196,10 @@ def make_train_step(
             tx.init,
             out_shardings=_opt_shardings(tx, params, p_shardings))(params)
         step = jax.device_put(jnp.zeros((), jnp.int32), repl)
-        return TrainState(params, opt_state, step)
+        loss_scale = _precision.init_loss_scale_state(policy)
+        if loss_scale is not None:
+            loss_scale = jax.device_put(loss_scale, repl)
+        return TrainState(params, opt_state, step, loss_scale)
 
     def _opt_shardings(tx, params, p_shardings):
         shape = jax.eval_shape(tx.init, params)
@@ -182,13 +219,13 @@ def make_train_step(
 
         return jax.tree_util.tree_map_with_path(leaf_sharding, shape)
 
-    def microbatch_grads(params, batch, rng):
+    def microbatch_grads(fn, params, batch, rng):
         if strategy.accum_steps == 1:
             if has_aux:
                 (loss, aux), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params, batch, rng)
+                    fn, has_aux=True)(params, batch, rng)
                 return loss, grads, aux
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch, rng)
+            loss, grads = jax.value_and_grad(fn)(params, batch, rng)
             return loss, grads, {}
         # gradient merge: scan over accum_steps microbatches
         # (reference: multi_batch_merge_pass.cc / gradient_merge)
@@ -197,9 +234,9 @@ def make_train_step(
             mb_batch, mb_rng = xs
             if has_aux:
                 (loss, aux), g = jax.value_and_grad(
-                    loss_fn, has_aux=True)(params, mb_batch, mb_rng)
+                    fn, has_aux=True)(params, mb_batch, mb_rng)
             else:
-                loss, g = jax.value_and_grad(loss_fn)(params, mb_batch, mb_rng)
+                loss, g = jax.value_and_grad(fn)(params, mb_batch, mb_rng)
                 aux = {}
             acc = jax.tree.map(jnp.add, acc, g)
             return (acc, loss_sum + loss), aux
@@ -215,14 +252,82 @@ def make_train_step(
         inv = 1.0 / n
         return loss_sum * inv, jax.tree.map(lambda g: g * inv, grads), aux
 
+    use_amp = policy.dynamic_loss_scale and policy.compute_dtype is not None
+
     def step_fn(state: TrainState, batch, rng):
-        loss, grads, aux = microbatch_grads(state.params, batch, rng)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        # aux = non-trainable state updates keyed like params (BN stats)
+        if policy.compute_dtype is not None:
+            # compute-width batch: an already-bf16 input pipeline makes
+            # this the identity; under jit the cast fuses either way
+            batch = _precision.cast_tree(batch, policy.compute_dtype)
+        if not use_amp:
+            loss, grads, aux = microbatch_grads(loss_fn, state.params,
+                                                batch, rng)
+            updates, opt_state = tx.update(grads, state.opt_state,
+                                           state.params)
+            params = optax.apply_updates(state.params, updates)
+            # aux = non-trainable state updates keyed like params (BN stats)
+            for k, v in aux.items():
+                params[k] = v.astype(params[k].dtype)
+            return TrainState(params, opt_state, state.step + 1,
+                              state.loss_scale), loss
+
+        # mixed policy: bf16/f16 compute against f32 master params +
+        # dynamic loss scaling (reference: contrib/mixed_precision
+        # check_finite_and_unscale / update_loss_scaling ops, rebuilt
+        # jnp-natively with the scale state inside TrainState)
+        ls = state.loss_scale
+        scale = ls["scale"]
+
+        def scaled_loss(p, b, r):
+            pc = _precision.cast_tree(p, policy.compute_dtype)
+            if has_aux:
+                loss, aux = loss_fn(pc, b, r)
+                return loss.astype(jnp.float32) * scale, aux
+            return loss_fn(pc, b, r).astype(jnp.float32) * scale
+
+        loss_s, grads_s, aux = microbatch_grads(scaled_loss, state.params,
+                                                batch, rng)
+        inv = 1.0 / scale
+        # grads come back f32 (the param cast's transpose casts up);
+        # astype guards exotic loss_fns that detach to compute dtype
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv,
+                             grads_s)
+        loss = loss_s * inv
+        finite = jnp.isfinite(loss)
+        for g in jax.tree.leaves(grads):
+            finite = finite & jnp.all(jnp.isfinite(g))
+
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
         for k, v in aux.items():
-            params[k] = v.astype(params[k].dtype)
-        return TrainState(params, opt_state, state.step + 1), loss
+            new_params[k] = v.astype(new_params[k].dtype)
+        # overflow skips the whole update: params AND optimizer state
+        # keep their pre-step values (select, so the nonfinite updates
+        # never propagate)
+        params = jax.tree.map(lambda new, old: jnp.where(finite, new, old),
+                              new_params, state.params)
+        opt_state = jax.tree.map(
+            lambda new, old: jnp.where(finite, new, old),
+            new_opt, state.opt_state)
+
+        good = ls["good_steps"] + 1
+        grow = finite & (good >= policy.growth_interval)
+        new_scale = jnp.where(
+            finite,
+            jnp.where(grow,
+                      jnp.minimum(scale * policy.incr_ratio,
+                                  policy.max_loss_scale),
+                      scale),
+            jnp.maximum(scale * policy.decr_ratio,
+                        policy.min_loss_scale))
+        new_ls = {
+            "scale": new_scale.astype(jnp.float32),
+            "good_steps": jnp.where(finite & ~grow, good,
+                                    0).astype(jnp.int32),
+            "overflows": ls["overflows"] + (~finite).astype(jnp.int32),
+            "growths": ls["growths"] + grow.astype(jnp.int32),
+        }
+        return TrainState(params, opt_state, state.step + 1, new_ls), loss
 
     state_shardings_cache = {}
 
@@ -232,7 +337,8 @@ def make_train_step(
             st_sh = TrainState(
                 p_shardings,
                 jax.tree.map(lambda x: x.sharding, state.opt_state),
-                repl)
+                repl,
+                jax.tree.map(lambda x: repl, state.loss_scale))
             def leaf_sharding(x):
                 spec = []
                 for i, ax in enumerate(tuple(batch_spec)[:x.ndim]):
@@ -252,6 +358,44 @@ def make_train_step(
         return state_shardings_cache[key](state, batch, rng)
 
     return init_state, jitted_step
+
+
+def sync_loss_scale_metrics(state: TrainState,
+                            last: Optional[Dict[str, Any]] = None
+                            ) -> Optional[Dict[str, Any]]:
+    """Diff TrainState.loss_scale's cumulative device counters against
+    `last` (the previous return value) and tick
+    paddle_tpu_amp_total{event=overflow|growth|skip} + the loss-scale
+    gauge; overflows also land as `amp_overflow` events. Reads three
+    device scalars, so callers sync at a cadence they already block at
+    (train_loop: per step in sync mode, at drain in async mode).
+    Returns the new cumulative snapshot (None loss_scale → `last`
+    unchanged). `last=None` BASELINES without recording — a restored
+    checkpoint's lifetime counters must not replay as fresh events."""
+    from ..observability import telemetry as _telemetry
+
+    ls = getattr(state, "loss_scale", None)
+    if ls is None:
+        return last
+    cur = {"overflows": int(ls["overflows"]),
+           "growths": int(ls["growths"]),
+           "scale": float(ls["scale"])}
+    _telemetry.AMP_LOSS_SCALE.set(cur["scale"])
+    if last is None:
+        return cur
+    prev = last
+    step = None
+    try:
+        step = int(state.step)
+    except Exception:
+        pass
+    d_over = cur["overflows"] - int(prev.get("overflows", 0))
+    d_grow = cur["growths"] - int(prev.get("growths", 0))
+    _telemetry.record_amp("overflow", d_over, step=step,
+                          scale=cur["scale"])
+    _telemetry.record_amp("skip", d_over)
+    _telemetry.record_amp("growth", d_grow, scale=cur["scale"])
+    return cur
 
 
 def train_loop(step_fn, state: TrainState, batches, *, rng=None,
@@ -340,6 +484,8 @@ def train_loop(step_fn, state: TrainState, batches, *, rng=None,
     # paths keep reading the authoritative device value (rollback
     # rewinds it).
     host_step = int(state.step) if window > 1 else None
+    amp_seen = sync_loss_scale_metrics(state) \
+        if getattr(state, "loss_scale", None) is not None else None
     t0 = _time.perf_counter()
     try:
         while True:
@@ -386,6 +532,12 @@ def train_loop(step_fn, state: TrainState, batches, *, rng=None,
                             "trainer_loss", [("loss", loss_val)],
                             step=step_no)
                     losses[step_no] = loss_val
+                    if amp_seen is not None:
+                        # sync mode already blocked on the loss; the
+                        # loss-scale counters ride the same sync so
+                        # overflow events carry exact step attribution
+                        amp_seen = sync_loss_scale_metrics(state,
+                                                           amp_seen)
             except _health.NumericsError as e:
                 if controller is None:
                     raise
@@ -402,6 +554,9 @@ def train_loop(step_fn, state: TrainState, batches, *, rng=None,
     finally:
         while pending:  # drain: every executed step's loss lands
             _resolve_oldest()
+        if amp_seen is not None:
+            # async mode: aggregate outcome counts land at drain time
+            amp_seen = sync_loss_scale_metrics(state, amp_seen)
         if controller is not None:
             controller.detach()
     seconds = _time.perf_counter() - t0

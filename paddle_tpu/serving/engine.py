@@ -14,6 +14,7 @@ set stays closed under live traffic.
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import pickle
 import time
@@ -22,6 +23,7 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 from ..core import compile_cache as _cc
+from ..core import precision as _precision
 from ..inference import AnalysisConfig, Predictor, create_paddle_predictor
 from ..observability import events as _events
 from ..observability import metrics as _m
@@ -30,6 +32,12 @@ from .bucketing import BucketPolicy, common_batch
 __all__ = ["ServingConfig", "Engine", "WARMSTART_FORMAT"]
 
 WARMSTART_FORMAT = "paddle_tpu-warmstart-v1"
+
+# written into the .int8 sibling after calibrate_and_quantize: records
+# the sha256 of the SOURCE model's __model__ so later boots with
+# calibration= still configured can prove the sibling was quantized
+# from this very program and skip recalibration
+QUANT_SRC_FILE = "__quant_source__.json"
 
 BUCKET_SECONDS = _m.histogram(
     "paddle_tpu_serving_bucket_seconds",
@@ -44,6 +52,11 @@ PAD_ROWS = _m.counter(
 WARMUP_SECONDS = _m.gauge(
     "paddle_tpu_serving_warmup_seconds",
     "Wall seconds the last warmup spent compiling all buckets")
+ACCURACY_DELTA = _m.gauge(
+    "paddle_tpu_serving_accuracy_delta",
+    "Reduced-precision reply deviation from the f32 reference on the "
+    "calibration batches (stat=max_abs|mean_abs), set at engine boot "
+    "for int8/bf16 precision", labelnames=("stat",))
 
 
 class ServingConfig:
@@ -62,7 +75,10 @@ class ServingConfig:
                  use_tpu: bool = True,
                  device_id: int = 0,
                  host: Optional[str] = None,
-                 port: int = 0):
+                 port: int = 0,
+                 precision: str = "f32",
+                 calibration=None,
+                 accuracy_check_batches: int = 4):
         self.model_dir = model_dir
         self.buckets = tuple(buckets) if buckets is not None else None
         self.max_batch = int(max_batch)
@@ -76,6 +92,26 @@ class ServingConfig:
         self.device_id = int(device_id)
         self.host = host
         self.port = int(port)
+        # precision: "f32" (default), "bf16"/"mixed_bf16" (policy-based
+        # reduced-precision executables per bucket), or "int8"
+        # (calibrated post-training quantization of the saved model —
+        # needs `calibration`, a callable returning an iterable of feed
+        # dicts, unless a previously quantized sibling dir exists).
+        # accuracy_check_batches bounds the boot-time f32-vs-reduced
+        # reply comparison that feeds /v1/status accuracy_delta (0
+        # disables the check).
+        if precision not in ("f32", "bf16", "mixed_bf16", "int8"):
+            # typos fail with the policy module's full-list message;
+            # valid-but-unserved policies (mixed_f16) must ALSO fail
+            # fast — silently serving f32 while status reports the
+            # requested name is the wrong-width bug this exists to kill
+            _precision.get_policy(precision)
+            raise ValueError(
+                f"unknown precision policy {precision!r} for serving; "
+                "choose from ['f32', 'bf16', 'mixed_bf16', 'int8']")
+        self.precision = str(precision)
+        self.calibration = calibration
+        self.accuracy_check_batches = int(accuracy_check_batches)
 
 
 class Engine:
@@ -89,16 +125,48 @@ class Engine:
         self.config = config
         self.policy = BucketPolicy(max_batch=config.max_batch,
                                    buckets=config.buckets)
+        self.precision = getattr(config, "precision", "f32")
+        self.accuracy_delta: Optional[Dict] = None
+        # the directory whose program is actually served (== model_dir
+        # except under int8, where it is the calibrated+quantized
+        # sibling); warmstart digests bind to THIS program
+        self._served_dir = config.model_dir
         if predictor is None:
-            acfg = AnalysisConfig(config.model_dir)
+            if self.precision == "int8":
+                self._served_dir = self._prepare_int8_model()
+            acfg = AnalysisConfig(self._served_dir)
             if not config.use_tpu:
                 acfg.disable_gpu()
             acfg._device_id = config.device_id
             if config.aot:
                 acfg.enable_aot()
+            # ALWAYS pin the policy: an explicit ServingConfig precision
+            # must win the resolution order over PADDLE_TPU_PRECISION /
+            # program attrs. "f32" pins f32; "int8" pins f32 too — the
+            # quantized program's int8 math lives in the quantized_*
+            # kernels, and its f32 glue must match the f32-computed
+            # calibration scales, not an ambient bf16 autocast.
+            acfg.set_precision(self.precision
+                               if self.precision in ("bf16", "mixed_bf16")
+                               else "f32")
             acfg.enable_bucketing(buckets=self.policy.buckets)
             predictor = create_paddle_predictor(acfg)
         else:
+            if self.precision == "int8":
+                raise ValueError(
+                    "ServingConfig(precision='int8') cannot adopt an "
+                    "externally built predictor — post-training "
+                    "quantization rewrites the saved model; build the "
+                    "Engine from model_dir instead")
+            have = getattr(getattr(predictor, "_policy", None),
+                           "name", None)
+            if self.precision != "f32" and have != self.precision:
+                raise ValueError(
+                    f"externally built predictor was loaded under "
+                    f"policy {have or 'f32'!r} but ServingConfig("
+                    f"precision={self.precision!r}) was requested — "
+                    "status and accuracy accounting would misreport; "
+                    "call set_precision on its AnalysisConfig instead")
             # an externally built predictor must agree on the signature
             # set or live traffic would compile off-bucket shapes that
             # warmup never touched — the engine's policy wins
@@ -114,6 +182,142 @@ class Engine:
         self.warmstart_adopted = 0
         if config.warmstart:
             self.load_warmstart(config.warmstart)
+        if self.precision != "f32" and config.model_dir \
+                and getattr(config, "calibration", None) is not None \
+                and getattr(config, "accuracy_check_batches", 0) > 0:
+            self._measure_accuracy_delta()
+
+    # -- reduced-precision boot helpers ---------------------------------
+
+    def _calibration_reader(self):
+        """`config.calibration` as the callable-returning-an-iterable
+        contract slim.quantization.calibrate_and_quantize expects (a
+        plain list/tuple of feed dicts is wrapped)."""
+        cal = self.config.calibration
+        if callable(cal):
+            return cal
+        return lambda: iter(cal)
+
+    def _prepare_int8_model(self) -> str:
+        """Calibrate + quantize the saved model into a `.int8` sibling
+        dir and serve THAT program: every bucket warmed afterwards is a
+        quantized executable (int8 matmul/conv, int32 accumulation,
+        f32 replies — ops/quant.py quantized_* kernels dequantize
+        before returning). With no calibration configured, a previously
+        quantized sibling is reused so restarts don't re-calibrate."""
+        cfg = self.config
+        if not cfg.model_dir:
+            raise ValueError("ServingConfig(precision='int8') needs a "
+                             "model_dir (externally built predictors "
+                             "cannot be post-training quantized)")
+        from ..slim.quantization import (QUANT_META_FILE,
+                                         calibrate_and_quantize)
+
+        int8_dir = cfg.model_dir.rstrip("/\\") + ".int8"
+        src_digest = self._digest_model_file(cfg.model_dir)
+        src_path = os.path.join(int8_dir, QUANT_SRC_FILE)
+        recorded = None
+        if os.path.exists(src_path):
+            try:
+                with open(src_path) as f:
+                    recorded = json.load(f).get("source_model_digest")
+            except (OSError, ValueError):
+                recorded = None
+        complete = os.path.exists(os.path.join(int8_dir, QUANT_META_FILE))
+        if cfg.calibration is None:
+            if complete:
+                if recorded is not None and src_digest is not None \
+                        and recorded != src_digest:
+                    # quantized from a DIFFERENT model (model_dir was
+                    # replaced since): serving it silently would answer
+                    # with the old model's weights
+                    raise ValueError(
+                        f"previously quantized sibling {int8_dir} was "
+                        f"built from a different model than the current"
+                        f" {cfg.model_dir} — pass calibration= to "
+                        "requantize it")
+                _events.emit("quantize", action="serving_reuse",
+                             dir=int8_dir)
+                return int8_dir
+            raise ValueError(
+                "ServingConfig(precision='int8') needs calibration= (a "
+                "callable returning an iterable of feed dicts) — no "
+                f"previously quantized model found at {int8_dir}")
+        # calibration configured: still reuse a sibling quantized from
+        # THIS program (source-digest marker) — static configs keep
+        # calibration= set on every boot, and a gang restart must not
+        # pay a full recalibration for an unchanged model
+        if complete and src_digest is not None \
+                and recorded == src_digest:
+            _events.emit("quantize", action="serving_reuse",
+                         dir=int8_dir, source_digest=src_digest)
+            return int8_dir
+        import shutil
+
+        shutil.rmtree(int8_dir, ignore_errors=True)
+        act_scales = calibrate_and_quantize(
+            cfg.model_dir, self._calibration_reader(),
+            save_model_path=int8_dir)
+        if src_digest is not None:
+            from ..resilience.atomic import json_dump
+            json_dump({"source_model_digest": src_digest}, src_path)
+        _events.emit("quantize", action="serving_calibrate",
+                     dir=int8_dir, activations=len(act_scales))
+        return int8_dir
+
+    def _measure_accuracy_delta(self):
+        """Boot-time accuracy accounting for reduced-precision serving:
+        run the first `accuracy_check_batches` calibration batches
+        through an f32 reference predictor AND this engine's predictor
+        (both bucket-padded, so no off-bucket signature is minted) and
+        record the reply deviation in /v1/status + the metrics
+        registry. A failure here downgrades to accuracy_delta=None with
+        an event — never a boot failure."""
+        import itertools
+
+        cfg = self.config
+        try:
+            batches = list(itertools.islice(
+                iter(self._calibration_reader()()),
+                int(cfg.accuracy_check_batches)))
+            if not batches:
+                return
+            acfg = AnalysisConfig(cfg.model_dir)
+            if not cfg.use_tpu:
+                acfg.disable_gpu()
+            acfg._device_id = cfg.device_id
+            # the reference MUST be f32 — without the pin it would
+            # resolve the same program-attr/env policy as the engine
+            # and the reported delta would be reduced-vs-reduced
+            acfg.set_precision("f32")
+            acfg.enable_bucketing(buckets=self.policy.buckets)
+            ref = create_paddle_predictor(acfg)
+            max_d, sum_d, n_vals = 0.0, 0.0, 0
+            for feed in batches:
+                a = ref.predict(**feed)
+                b = self._pred.predict(**feed)
+                for name in a:
+                    if name not in b:
+                        continue
+                    d = np.abs(np.asarray(a[name], np.float32)
+                               - np.asarray(b[name], np.float32))
+                    if d.size:
+                        max_d = max(max_d, float(d.max()))
+                        sum_d += float(d.sum())
+                        n_vals += d.size
+            self.accuracy_delta = {
+                "vs": "f32", "max_abs": max_d,
+                "mean_abs": sum_d / max(n_vals, 1),
+                "batches": len(batches)}
+            ACCURACY_DELTA.set(max_d, stat="max_abs")
+            ACCURACY_DELTA.set(self.accuracy_delta["mean_abs"],
+                               stat="mean_abs")
+            _events.emit("quantize", action="accuracy_check",
+                         precision=self.precision, **self.accuracy_delta)
+        except Exception as e:
+            self.accuracy_delta = None
+            _events.emit("quantize", action="accuracy_check_failed",
+                         precision=self.precision, error=str(e)[:200])
 
     def output_batched(self, name: str) -> Optional[bool]:
         """Does fetch `name` carry the batch dim? From the Predictor's
@@ -141,20 +345,25 @@ class Engine:
 
     # -- warmstart artifact (serialized bucket executables) -------------
 
+    @staticmethod
+    def _digest_model_file(model_dir: Optional[str]) -> Optional[str]:
+        """sha256 of `model_dir`'s __model__ program file, None when it
+        is unreadable or there is no dir."""
+        if not model_dir:
+            return None
+        try:
+            with open(os.path.join(model_dir, "__model__"), "rb") as f:
+                return hashlib.sha256(f.read()).hexdigest()
+        except OSError:
+            return None
+
     def _model_digest(self) -> Optional[str]:
         """Content digest of the served model's program (__model__
         file): an artifact baked from a DIFFERENT program must never be
         adopted — same bucket signatures, different computation. None
         when there is no model dir (externally-built predictor); such
         artifacts match only artifacts also baked without one."""
-        d = self.config.model_dir
-        if not d:
-            return None
-        try:
-            with open(os.path.join(d, "__model__"), "rb") as f:
-                return hashlib.sha256(f.read()).hexdigest()
-        except OSError:
-            return None
+        return self._digest_model_file(self._served_dir)
 
     def export_warmstart(self, path: str) -> int:
         """Serialize every warmed bucket executable into ONE artifact
@@ -254,6 +463,8 @@ class Engine:
         return {
             "buckets": [int(b) for b in self.policy.buckets],
             "warmed": self.warmed,
+            "precision": self.precision,
+            "accuracy_delta": self.accuracy_delta,
             "warmstart_adopted": self.warmstart_adopted,
             "batches": {str(b): BATCHES.value(bucket=str(b))
                         for b in self.policy.buckets},

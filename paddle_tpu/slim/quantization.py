@@ -19,7 +19,31 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..observability import events as _events
+from ..observability import metrics as _m
+
 QUANT_META_FILE = "__quant_meta__.json"
+
+# Calibration/quantization visibility (ISSUE 7 satellite): the passes
+# used to run silently — a degenerate scale (a dead activation, a
+# near-zero weight channel) was invisible until accuracy fell over.
+# Every computed scale now lands in a histogram, per-var counts in a
+# counter, and each pass appends a `quantize` event to the JSONL log.
+QUANT_SCALE = _m.histogram(
+    "paddle_tpu_quant_scale",
+    "Quantization scales computed by slim passes (kind=weight is one "
+    "sample per output channel, kind=activation one per calibrated "
+    "tensor); a spike at the 1.0 fallback bucket means all-zero "
+    "tensors were calibrated",
+    labelnames=("kind",),
+    buckets=_m.exponential_buckets(1e-8, 10.0, 12))
+QUANT_VARS = _m.counter(
+    "paddle_tpu_quant_vars_total",
+    "Tensors quantized/calibrated by slim passes",
+    labelnames=("kind",))
+QUANT_BYTES_SAVED = _m.counter(
+    "paddle_tpu_quant_bytes_saved_total",
+    "fp32 bytes minus int8+scale bytes across quantized weights")
 QUANT_OPS = {"mul": "Y", "matmul": "Y", "matmul_v2": "Y",
              "conv2d": "Filter", "depthwise_conv2d": "Filter",
              "conv3d": "Filter", "lookup_table": "W"}
@@ -89,11 +113,15 @@ class PostTrainingQuantization:
 
         os.makedirs(self.save_path, exist_ok=True)
         if os.path.abspath(self.save_path) != os.path.abspath(self.model_dir):
-            import shutil
+            from ..resilience.atomic import write_bytes
 
+            # atomic copy (was shutil.copy): a crash mid-copy must not
+            # leave a half-written __model__/weight file that a later
+            # boot would happily load
             for fn in os.listdir(self.model_dir):
-                shutil.copy(os.path.join(self.model_dir, fn),
-                            os.path.join(self.save_path, fn))
+                with open(os.path.join(self.model_dir, fn), "rb") as f:
+                    write_bytes(os.path.join(self.save_path, fn),
+                                f.read())
 
         # merge with any existing meta (re-quantizing an already-quantized
         # model must not clobber it)
@@ -125,6 +153,11 @@ class PostTrainingQuantization:
             os.remove(path)
             meta[name] = {"axis": axis, "dtype": str(w.dtype)}
             ratios[name] = float(w.nbytes) / (q.nbytes + scale.nbytes)
+            for s in np.asarray(scale, np.float32).ravel():
+                QUANT_SCALE.observe(float(s), kind="weight")
+            QUANT_VARS.inc(kind="weight")
+            QUANT_BYTES_SAVED.inc(
+                max(0, int(w.nbytes) - int(q.nbytes + scale.nbytes)))
         if missing and not ratios and not meta:
             raise ValueError(
                 f"no per-var .npy weight files found for {missing} — models "
@@ -134,6 +167,12 @@ class PostTrainingQuantization:
             from ..resilience.atomic import json_dump
 
             json_dump(meta, meta_path)
+        if ratios:
+            _events.emit(
+                "quantize", action="weights", dir=self.save_path,
+                vars=len(ratios),
+                mean_compression=round(
+                    sum(ratios.values()) / len(ratios), 3))
         return ratios
 
 
@@ -256,6 +295,11 @@ def calibrate_and_quantize(model_dir: str, calibration_reader,
             raise ValueError("calibration reader yielded no batches")
     act_scales = {n: (m / 127.0 if m > 0 else 1.0)
                   for n, m in amax.items()}
+    for s in act_scales.values():
+        QUANT_SCALE.observe(float(s), kind="activation")
+        QUANT_VARS.inc(kind="activation")
+    _events.emit("quantize", action="calibrate", dir=save_path,
+                 activations=len(act_scales), batches=n_batches)
 
     # -- 2. weight quantization --------------------------------------------
     # A weight read by any op OUTSIDE the rewrite set (a skipped

@@ -23,6 +23,13 @@ Usage:
                                             # bytes table (--live,
                                             # --json)
 
+Mixed-precision runs: `snapshot` surfaces the dynamic loss-scaling
+counters (paddle_tpu_amp_total{event=overflow|growth|skip}, the
+paddle_tpu_amp_loss_scale gauge) and the quantization-scale histogram
+(paddle_tpu_quant_scale{kind}); `events --kind amp_overflow` tails the
+scale-thrash timeline and `events --kind quantize` the calibration
+story (PROFILE.md §Precision).
+
 The metrics JSON is what the registry's env-gated dumper
 (PADDLE_TPU_METRICS_DIR) writes; RUN_DIR is typically the profiler's
 profile_path (jax device traces) optionally holding a spans.json from
@@ -294,7 +301,7 @@ def main(argv=None) -> int:
                     help="show the last N events (default 20)")
     ep.add_argument("--kind", default=None,
                     help="only events of this kind (compile|step_summary|"
-                    "anomaly|checkpoint|...)")
+                    "anomaly|checkpoint|amp_overflow|quantize|...)")
     ep.add_argument("--json", action="store_true",
                     help="raw JSON objects instead of the aligned table")
     ep.add_argument("--follow", action="store_true",
