@@ -169,7 +169,7 @@ class FetchHandle:
                 jax.block_until_ready(
                     [v for v in values if hasattr(v, "block_until_ready")
                      or hasattr(v, "devices")])
-            except Exception:
+            except Exception:  # lint-exempt:swallow: non-jax values (numpy, scalars) need no wait
                 pass  # non-jax values (numpy, scalars) need no wait
             out = [np.asarray(v) for v in values]
             now = time.perf_counter()
@@ -231,7 +231,7 @@ class FetchHandle:
         try:
             if not self._resolved:
                 _track_close()
-        except Exception:
+        except Exception:  # lint-exempt:swallow: best-effort gauge accounting in a destructor path
             pass
 
 
@@ -417,7 +417,7 @@ class Prefetcher:
     def __del__(self):
         try:
             self._stop.set()
-        except Exception:
+        except Exception:  # lint-exempt:swallow: interpreter-teardown __del__: Event may be gone
             pass
 
 

@@ -31,7 +31,8 @@ from . import framework, lowering
 from . import precision as _precision
 from .executor import (RNG_STATE_VAR, Scope, _as_fetch_name,
                        _finish_fetches, _JitDispatch, mesh_device_kind,
-                       _normalize_feed, _post_step_health, global_scope)
+                       _normalize_feed, _post_step_health,
+                       _pre_run_validate, global_scope)
 from .framework import Program
 
 
@@ -165,6 +166,8 @@ class CompiledProgram:
 
             policy = _precision.resolve(program)
             norm_feed = _normalize_feed(program, feed, policy)
+            _pre_run_validate(program, tuple(norm_feed), fetch_names,
+                              policy, where="sharded")
             rec.set_feed(norm_feed)
 
             feed_sig = tuple(sorted((k, tuple(v.shape), str(v.dtype)) for k, v in norm_feed.items()))

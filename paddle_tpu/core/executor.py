@@ -51,13 +51,13 @@ def _compile_cost(compiled) -> Tuple[Optional[float], Optional[int]]:
         d = ca[0] if isinstance(ca, (list, tuple)) and ca else ca
         if isinstance(d, dict) and d.get("flops", -1) >= 0:
             flops = float(d["flops"])
-    except Exception:
+    except Exception:  # lint-exempt:swallow: cost_analysis is backend-optional introspection
         pass
     try:
         ma = compiled.memory_analysis()
         if ma is not None:
             out_bytes = int(getattr(ma, "output_size_in_bytes", 0))
-    except Exception:
+    except Exception:  # lint-exempt:swallow: memory_analysis is backend-optional introspection
         pass
     return flops, out_bytes
 
@@ -520,6 +520,22 @@ def _stack_feed_window(feeds: List[Dict[str, Any]]) -> Dict[str, Any]:
     return {k: _stack([f[k] for f in feeds]) for k in feeds[0]}
 
 
+def _pre_run_validate(program: Program, feed_names, fetch_names,
+                      policy, where: str):
+    """Env-gated static analysis in front of every run path
+    (PADDLE_TPU_VALIDATE=0|1|2 — off/warn/error; paddle_tpu/analysis).
+    The env probe keeps the default hot path at one dict lookup and the
+    analysis package entirely unimported; when enabled, results are
+    cached per (program version, run signature) so a steady-state loop
+    pays for exactly one walk."""
+    if not os.environ.get("PADDLE_TPU_VALIDATE"):
+        return
+    from ..analysis import maybe_validate
+
+    maybe_validate(program, feed_names=feed_names,
+                   fetch_names=fetch_names, policy=policy, where=where)
+
+
 def _normalize_feed(program: Program, feed: Dict[str, Any],
                     policy: Optional["_precision.PrecisionPolicy"] = None
                     ) -> Dict[str, Any]:
@@ -565,7 +581,7 @@ def _finish_fetches(fetches, return_numpy: bool, sync: bool,
     t0 = time.perf_counter()
     try:
         jax.block_until_ready(fetches)
-    except Exception:
+    except Exception:  # lint-exempt:swallow: non-array fetches (rare lowering paths) convert below
         pass  # non-array fetches (rare lowering paths) convert below
     out = [np.asarray(f) for f in fetches]
     _telemetry.record_host_blocked("executor_sync",
@@ -871,6 +887,8 @@ class Executor:
         old width's executable."""
         policy = _precision.resolve(program)
         norm_feed = _normalize_feed(program, feed, policy)
+        _pre_run_validate(program, tuple(norm_feed), fetch_names, policy,
+                          where="executor")
         feed_sig = tuple(sorted((k, tuple(v.shape), str(v.dtype)) for k, v in norm_feed.items()))
         key = (id(program), program._version, feed_sig, fetch_names,
                program._is_test, policy.name)

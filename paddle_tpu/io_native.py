@@ -237,9 +237,16 @@ class InMemoryNativeDataset(NativeDataset):
 
     def global_shuffle(self, client) -> int:
         """Cross-trainer shuffle through the PS (client: ps.PSClient).
-        Every record lands on exactly one trainer: trainer t keeps record
-        r iff hash(seed, r) % num_trainers == t. Returns the new local
-        record count."""
+        Every record lands on exactly one trainer. Default routing is
+        per-trainer positional uniform-random: each trainer draws a
+        target per record from an RNG seeded by (shuffle seed, its own
+        trainer_id) — exactly-once holds because each record lives on
+        exactly one trainer, which routes it to exactly one target, so
+        no cross-trainer agreement on routes is needed (and duplicate
+        records spread instead of skewing one shard). With
+        `merge_by_insid` set, routing switches to the content-hash
+        (natively computed) so identical records co-locate on one
+        trainer. Returns the new local record count."""
         tid = self._cfg["trainer_id"]
         nt = self._cfg["num_trainers"]
         ep = client.endpoints[0]  # one server coordinates the pass
@@ -326,5 +333,5 @@ class InMemoryNativeDataset(NativeDataset):
     def __del__(self):
         try:
             self.release_memory()
-        except Exception:
+        except Exception:  # lint-exempt:swallow: interpreter-teardown __del__: native lib may be gone
             pass

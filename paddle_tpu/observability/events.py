@@ -45,7 +45,7 @@ __all__ = ["emit", "recent", "clear", "log_path", "read_jsonl",
 KINDS = ("compile", "compile_cache", "step_summary", "anomaly",
          "checkpoint", "serve_start", "serve_stop", "restore", "preempt",
          "fault", "recovery", "rank_restart", "pipeline_stall",
-         "warmstart", "amp_overflow", "quantize")
+         "warmstart", "amp_overflow", "quantize", "analysis")
 
 # Ring bound: a week-long run emitting a compile+summary event per minute
 # stays far under this; anomaly storms get truncated to the latest window.

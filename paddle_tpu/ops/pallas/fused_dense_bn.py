@@ -218,8 +218,17 @@ def _bn_mm_stats_kernel(x_ref, s_ref, b_ref, w_ref, y_ref, ps_ref,
 
 
 def _bn_mm_stats_ref(x, scale, shift, w, relu):
-    y = _bn_mm_ref(x, scale, shift, w, relu)
-    y32 = y.astype(_acc_dt(x))
+    # stats from the PRE-downcast accumulator product, mirroring both
+    # _mm_stats_ref and the kernel (which reduces the f32 `y` before
+    # y_ref downcasts it): at bf16 the bwd must differentiate the same
+    # stats the fwd computed, not stats of the already-rounded y
+    # (ADVICE r5)
+    xn = x.astype(scale.dtype) * scale + shift
+    if relu:
+        xn = jnp.maximum(xn, 0.0)
+    y32 = jnp.dot(xn.astype(x.dtype), w,
+                  preferred_element_type=_acc_dt(x))
+    y = y32.astype(x.dtype)
     mean = jnp.mean(y32, axis=0)
     var = jnp.maximum(jnp.mean(y32 * y32, axis=0) - mean * mean, 0.0)
     return y, mean, var

@@ -387,7 +387,7 @@ def sync_loss_scale_metrics(state: TrainState,
     step = None
     try:
         step = int(state.step)
-    except Exception:
+    except Exception:  # lint-exempt:swallow: step is optional telemetry on a diffed counter
         pass
     d_over = cur["overflows"] - int(prev.get("overflows", 0))
     d_grow = cur["growths"] - int(prev.get("growths", 0))

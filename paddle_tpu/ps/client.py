@@ -234,7 +234,7 @@ class PSClient:
         for c in self._conns.values():
             try:
                 c.call({"op": "shutdown"})
-            except Exception:
+            except Exception:  # lint-exempt:swallow: best-effort shutdown fanout to dying servers
                 pass
 
 

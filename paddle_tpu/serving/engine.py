@@ -180,12 +180,40 @@ class Engine:
         # `warmstart` reject event (a typo'd path booting a fleet cold
         # must be visible in the log, not just as adopted=0 in status).
         self.warmstart_adopted = 0
+        # boot-time static analysis of the served program (the
+        # reference's AnalysisPredictor runs its ir_analysis passes at
+        # exactly this point): boot is one-time, so the walk always
+        # runs; PADDLE_TPU_VALIDATE=2 refuses to serve a program with
+        # error-severity findings, anything less records them in
+        # /v1/status + the analysis metrics/event and boots anyway.
+        self.analysis: Optional[Dict[str, int]] = self._validate_boot()
         if config.warmstart:
             self.load_warmstart(config.warmstart)
         if self.precision != "f32" and config.model_dir \
                 and getattr(config, "calibration", None) is not None \
                 and getattr(config, "accuracy_check_batches", 0) > 0:
             self._measure_accuracy_delta()
+
+    def _validate_boot(self) -> Optional[Dict[str, int]]:
+        """Static-analysis walk over the served program (None for the
+        native engine, which carries no ProgramDesc). AnalysisError
+        propagates at PADDLE_TPU_VALIDATE=2 — a fleet must fail a bad
+        deploy at boot, not on the first live request."""
+        prog = getattr(self._pred, "_program", None)
+        if prog is None:
+            return None
+        from ..analysis import validate_level, validate_program
+
+        findings = validate_program(
+            prog.desc,
+            feed_names=self._pred.get_input_names(),
+            fetch_names=self._pred.get_output_names(),
+            policy=getattr(self._pred, "_policy", None),
+            is_test=True, level=validate_level(), where="serving")
+        out = {"errors": 0, "warnings": 0, "infos": 0}
+        for f in findings:
+            out[f.severity + "s"] = out.get(f.severity + "s", 0) + 1
+        return out
 
     # -- reduced-precision boot helpers ---------------------------------
 
@@ -465,6 +493,7 @@ class Engine:
             "warmed": self.warmed,
             "precision": self.precision,
             "accuracy_delta": self.accuracy_delta,
+            "analysis": self.analysis,
             "warmstart_adopted": self.warmstart_adopted,
             "batches": {str(b): BATCHES.value(bucket=str(b))
                         for b in self.policy.buckets},

@@ -1,0 +1,478 @@
+"""Static-analysis pass pipeline (paddle_tpu/analysis, ISSUE 8).
+
+Seeded-defect coverage: every pass catches its defect class with an
+op/var-addressed message; clean in-repo programs produce zero
+error-severity findings; executor validation is env-gated and cached
+per program version (zero per-step overhead after the first run,
+proven by counting walker invocations)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import analysis
+from paddle_tpu.core.ir import OpDesc, VarDesc
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny_program():
+    """x[?,4] -> fc(3) -> mean loss; returns (main, x, y, loss)."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), \
+            pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        y = pt.layers.fc(x, size=3)
+        loss = pt.layers.reduce_mean(y)
+    return main, startup, x, y, loss
+
+
+def _errors(findings):
+    return [f for f in findings if f.severity == analysis.ERROR]
+
+
+def _by_pass(findings, name):
+    return [f for f in findings if f.pass_name == name]
+
+
+# ---------------------------------------------------------------------------
+# clean programs
+# ---------------------------------------------------------------------------
+
+
+def test_lenet_trainer_program_validates_clean():
+    """The full static-graph LeNet (fwd + generic-vjp bwd + Adam) — the
+    in-repo models/ network with a program builder — has zero
+    error-severity findings under f32 AND mixed policies."""
+    from paddle_tpu.models import lenet
+
+    main, startup, feeds, loss, acc = lenet.build_program(pt)
+    for policy in (None, "mixed_bf16"):
+        fs = analysis.run_passes(
+            main.desc, feed_names=feeds,
+            fetch_names=[loss.name, acc.name], policy=policy)
+        assert not _errors(fs), "\n".join(str(f) for f in _errors(fs))
+    fs = analysis.run_passes(startup.desc)
+    assert not _errors(fs)
+
+
+def test_layers_networks_validate_clean():
+    """Representative layers-built nets (regression, embedding) are
+    clean end to end, fetches bound."""
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), \
+            pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[13], dtype="float32")
+        yt = pt.layers.data(name="y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(x, size=1)
+        loss = pt.layers.reduce_mean(
+            pt.layers.square_error_cost(input=pred, label=yt))
+        pt.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    fs = analysis.run_passes(main.desc, feed_names=["x", "y"],
+                             fetch_names=[loss.name])
+    assert not _errors(fs), "\n".join(map(str, fs))
+
+    main2, startup2 = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), \
+            pt.program_guard(main2, startup2):
+        w = pt.layers.data(name="w", shape=[1], dtype="int64")
+        emb = pt.layers.embedding(input=w, size=(50, 8))
+        out = pt.layers.reduce_mean(pt.layers.fc(emb, size=4))
+    fs = analysis.run_passes(main2.desc, feed_names=["w"],
+                             fetch_names=[out.name])
+    assert not _errors(fs), "\n".join(map(str, fs))
+
+
+# ---------------------------------------------------------------------------
+# seeded defects, one per pass class
+# ---------------------------------------------------------------------------
+
+
+def test_undefined_var_caught():
+    main, *_ , loss = _tiny_program()
+    d = main.desc.clone()
+    d.block(0).ops.insert(1, OpDesc(type="relu",
+                                    inputs={"X": ["ghost"]},
+                                    outputs={"Out": ["ghost_out"]}))
+    fs = analysis.run_passes(d, feed_names=["x"],
+                             fetch_names=[loss.name])
+    errs = _by_pass(_errors(fs), "def_use")
+    assert errs, fs
+    f = errs[0]
+    assert f.var == "ghost" and f.op_type == "relu" \
+        and f.op_idx == 1 and "no value" in f.message
+
+
+def test_dangling_fetch_caught():
+    main, *_rest = _tiny_program()
+    fs = analysis.run_passes(main.desc, feed_names=["x"],
+                             fetch_names=["never_made"])
+    errs = [f for f in _errors(fs) if f.var == "never_made"]
+    assert errs and "never produced" in errs[0].message
+
+
+def test_unknown_op_caught_with_suggestion():
+    main, _s, x, y, loss = _tiny_program()
+    d = main.desc.clone()
+    d.block(0).ops.append(OpDesc(type="matmull",
+                                 inputs={"X": [loss.name]},
+                                 outputs={"Out": ["z"]}))
+    fs = analysis.run_passes(d, feed_names=["x"], fetch_names=["z"])
+    errs = _by_pass(_errors(fs), "unsupported_op")
+    assert errs, fs
+    assert "matmull" in errs[0].message
+    assert "matmul" in errs[0].message  # close-name suggestion
+
+
+def test_dtype_mismatch_caught():
+    main, _s, x, y, loss = _tiny_program()
+    d = main.desc.clone()
+    d.block(0).vars[y.name].dtype = "int32"
+    fs = analysis.run_passes(d, feed_names=["x"],
+                             fetch_names=[loss.name])
+    errs = _by_pass(_errors(fs), "shape_dtype")
+    assert errs, fs
+    assert any("dtype" in f.message and f.var == y.name for f in errs)
+
+
+def test_shape_mismatch_caught():
+    main, _s, x, y, loss = _tiny_program()
+    d = main.desc.clone()
+    d.block(0).vars[y.name].shape = (7, 7)
+    fs = analysis.run_passes(d, feed_names=["x"],
+                             fetch_names=[loss.name])
+    errs = _by_pass(_errors(fs), "shape_dtype")
+    assert errs and any("shape" in f.message for f in errs)
+
+
+def test_incompatible_op_inputs_caught_before_trace():
+    """A genuinely impossible op (matmul of mismatched contraction
+    dims) is an ERROR from the inference walker, not a jax trace
+    blowup."""
+    d = pt.Program().desc
+    d.block(0).vars["a"] = VarDesc(name="a", shape=(2, 5))
+    d.block(0).vars["b"] = VarDesc(name="b", shape=(4, 3))
+    d.block(0).ops.append(OpDesc(type="matmul",
+                                 inputs={"X": ["a"], "Y": ["b"]},
+                                 outputs={"Out": ["c"]}))
+    fs = analysis.run_passes(d, feed_names=["a", "b"],
+                             fetch_names=["c"])
+    errs = _by_pass(_errors(fs), "shape_dtype")
+    assert errs and errs[0].op_type == "matmul"
+
+
+def test_dead_op_caught():
+    main, _s, x, y, loss = _tiny_program()
+    d = main.desc.clone()
+    d.block(0).ops.append(OpDesc(type="relu", inputs={"X": [y.name]},
+                                 outputs={"Out": ["nobody_reads_me"]}))
+    fs = analysis.run_passes(d, feed_names=["x"],
+                             fetch_names=[loss.name])
+    dead = _by_pass(fs, "dead_op")
+    assert any(f.op_idx == len(d.block(0).ops) - 1
+               and f.severity == analysis.WARNING for f in dead), fs
+
+
+def test_alias_hazards_caught():
+    main, _s, x, y, loss = _tiny_program()
+    d = main.desc.clone()
+    # duplicate output name within one op → error
+    d.block(0).ops.append(OpDesc(
+        type="unstack", inputs={"X": [y.name]},
+        outputs={"Out": ["dup", "dup"]}, attrs={"axis": 0}))
+    # write-after-write with no read between → warning
+    d.block(0).ops.append(OpDesc(type="relu", inputs={"X": [x.name]},
+                                 outputs={"Out": ["w1"]}))
+    d.block(0).ops.append(OpDesc(type="sigmoid",
+                                 inputs={"X": [x.name]},
+                                 outputs={"Out": ["w1"]}))
+    d.block(0).ops.append(OpDesc(type="exp", inputs={"X": ["w1"]},
+                                 outputs={"Out": ["w2"]}))
+    fs = analysis.run_passes(d, feed_names=["x"],
+                             fetch_names=[loss.name, "dup", "w2"])
+    alias = _by_pass(fs, "alias")
+    assert any(f.severity == analysis.ERROR and f.var == "dup"
+               for f in alias), fs
+    assert any(f.severity == analysis.WARNING and f.var == "w1"
+               and "write-after-write" in f.message for f in alias), fs
+
+
+def test_precision_policy_violation_caught():
+    main, _s, x, y, loss = _tiny_program()
+    d = main.desc.clone()
+    d.block(0).ops.append(OpDesc(type="softmax",
+                                 inputs={"X": [y.name]},
+                                 outputs={"Out": ["sm"]}))
+    d.block(0).vars["sm"] = VarDesc(name="sm", shape=(-1, 3),
+                                    dtype="bfloat16")
+    fs = analysis.run_passes(d, feed_names=["x"], fetch_names=["sm"],
+                             policy="mixed_bf16")
+    errs = _by_pass(_errors(fs), "precision")
+    assert errs and errs[0].var == "sm" \
+        and "black-list" in errs[0].message
+    # pure-bf16: black-list ops present → warning, not error
+    fs = analysis.run_passes(d, feed_names=["x"], fetch_names=["sm"],
+                             policy="bf16")
+    prec = _by_pass(fs, "precision")
+    assert prec and all(f.severity == analysis.WARNING for f in prec)
+    # f32: the audit is a no-op
+    fs = analysis.run_passes(d, feed_names=["x"], fetch_names=["sm"])
+    assert not _by_pass(fs, "precision")
+
+
+# ---------------------------------------------------------------------------
+# executor wiring: env gate, raise semantics, per-version caching
+# ---------------------------------------------------------------------------
+
+
+def test_validate_off_by_default_no_walk(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_VALIDATE", raising=False)
+    main, startup, x, y, loss = _tiny_program()
+    exe = pt.Executor()
+    before = analysis.walk_count()
+    exe.run(startup)
+    exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+            fetch_list=[loss])
+    assert analysis.walk_count() == before
+
+
+def test_validate_2_blocks_bad_program_every_run(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "2")
+    main, startup, x, y, loss = _tiny_program()
+    main.desc.block(0).ops.append(OpDesc(type="nosuch_op",
+                                         inputs={"X": ["ghost"]},
+                                         outputs={"Out": ["z"]}))
+    main._bump_version()
+    exe = pt.Executor()
+    feed = {"x": np.ones((2, 4), np.float32)}
+    before = analysis.walk_count()
+    with pytest.raises(analysis.AnalysisError) as ei:
+        exe.run(main, feed=feed, fetch_list=[loss])
+    assert "nosuch_op" in str(ei.value) and "ghost" in str(ei.value)
+    # the raise repeats on every run, from the CACHE (no second walk)
+    with pytest.raises(analysis.AnalysisError):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    assert analysis.walk_count() == before + 1
+
+
+def test_validation_cached_per_program_version(monkeypatch):
+    """The acceptance bar: after the first validated run, later steps
+    pay ZERO analysis overhead — the walker runs once per program
+    version, not per step."""
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "2")
+    main, startup, x, y, loss = _tiny_program()
+    exe = pt.Executor()
+    feed = {"x": np.ones((2, 4), np.float32)}
+    before = analysis.walk_count()
+    exe.run(startup)
+    for _ in range(3):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    # exactly 2 walks: one for startup, one for main — not one per step
+    assert analysis.walk_count() == before + 2
+    # run_chained shares the cache (same program version + signature)
+    exe.run_chained(main, feed=feed, fetch_list=[loss], n_steps=2)
+    assert analysis.walk_count() == before + 2
+    # a program mutation re-validates exactly once
+    main._bump_version()
+    exe.run(main, feed=feed, fetch_list=[loss])
+    exe.run(main, feed=feed, fetch_list=[loss])
+    assert analysis.walk_count() == before + 3
+
+
+def test_run_stream_validates_through_chained(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "2")
+    main, startup, x, y, loss = _tiny_program()
+    exe = pt.Executor()
+    exe.run(startup)
+    before = analysis.walk_count()
+    feeds = ({"x": np.full((2, 4), i, np.float32)} for i in range(6))
+    for h in exe.run_stream(main, feed_iter=feeds,
+                            fetch_list=[loss], window=3):
+        h.result()
+    assert analysis.walk_count() == before + 1
+
+
+def test_validate_1_warns_but_runs(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "1")
+    main, startup, x, y, loss = _tiny_program()
+    # dead op: warning-severity finding only — runs silently
+    exe = pt.Executor()
+    exe.run(startup)
+    out = exe.run(main, feed={"x": np.ones((2, 4), np.float32)},
+                  fetch_list=[loss])
+    assert np.asarray(out[0]).size == 1
+    # error finding at level 1: warns, still runs
+    bad = main.clone()
+    bad.desc.block(0).ops.append(OpDesc(
+        type="relu", inputs={"X": ["ghost"]},
+        outputs={"Out": ["ghost_out"]}))
+    bad._bump_version()
+    from paddle_tpu.core.lowering import LoweringError
+
+    with pytest.warns(UserWarning, match="static analysis"), \
+            pytest.raises(LoweringError):
+        # warn level doesn't block: the program runs anyway and dies
+        # where it always did — the warning is the early signal
+        exe.run(bad, feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+
+
+# ---------------------------------------------------------------------------
+# observability + serving + CLI
+# ---------------------------------------------------------------------------
+
+
+def test_analysis_metrics_and_event():
+    from paddle_tpu import observability
+    from paddle_tpu.observability import events
+
+    main, *_rest = _tiny_program()
+    fs = analysis.run_passes(main.desc, feed_names=["x"],
+                             fetch_names=["never_made"])
+    assert _errors(fs)
+    snap = observability.snapshot()
+    series = snap["paddle_tpu_analysis_findings_total"]["series"]
+    assert any(s["labels"].get("pass") == "def_use"
+               and s["labels"].get("severity") == "error"
+               for s in series)
+    assert snap["paddle_tpu_analysis_runs_total"]["series"]
+    evs = events.recent(10, kind="analysis")
+    assert evs and evs[-1]["errors"] >= 1
+
+
+def test_engine_boot_validation(tmp_path, monkeypatch):
+    from paddle_tpu.serving.engine import Engine, ServingConfig
+
+    monkeypatch.delenv("PADDLE_TPU_VALIDATE", raising=False)
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), \
+            pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[8], dtype="float32")
+        y = pt.layers.fc(x, size=4, act="relu")
+    exe = pt.Executor()
+    exe.run(startup)
+    d = str(tmp_path / "m")
+    pt.io.save_inference_model(d, ["x"], [y], exe, main_program=main)
+
+    eng = Engine(ServingConfig(d, buckets=(1, 2), use_tpu=False,
+                               warmup=False))
+    assert eng.status()["analysis"] == {"errors": 0, "warnings": 0,
+                                        "infos": 0}
+
+    # corrupt the saved program with an unknown op: default boot
+    # records the errors; VALIDATE=2 refuses to serve
+    with open(os.path.join(d, "__model__")) as f:
+        payload = json.load(f)
+    payload["program"]["blocks"][0]["ops"].append(
+        {"type": "nosuch_op", "inputs": {"X": [["x"]][0]},
+         "outputs": {"Out": ["z"]}, "attrs": {}})
+    from paddle_tpu.resilience.atomic import json_dump
+    json_dump(payload, os.path.join(d, "__model__"))
+
+    eng2 = Engine(ServingConfig(d, buckets=(1,), use_tpu=False,
+                                warmup=False))
+    assert eng2.status()["analysis"]["errors"] >= 1
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "2")
+    with pytest.raises(analysis.AnalysisError):
+        Engine(ServingConfig(d, buckets=(1,), use_tpu=False,
+                             warmup=False))
+
+
+def test_analyze_cli_roundtrip(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "analyze.py"),
+         "--model", "lenet", "--json"],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert not [ln for ln in out.stdout.splitlines() if ln.strip()
+                and json.loads(ln)["severity"] == "error"]
+
+    main, *_rest = _tiny_program()
+    main.desc.block(0).ops.append(OpDesc(type="nosuch_op",
+                                         inputs={"X": ["ghost"]},
+                                         outputs={"Out": ["z"]}))
+    prog_path = tmp_path / "bad.json"
+    prog_path.write_text(json.dumps(
+        {"program": main.desc.to_dict(), "feed_names": ["x"],
+         "fetch_names": ["z"]}))
+    dot_path = tmp_path / "bad.dot"
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "analyze.py"),
+         "--program", str(prog_path), "--json", "--dot",
+         str(dot_path)],
+        capture_output=True, text=True, env=env, timeout=300)
+    assert out.returncode == 1, out.stderr[-2000:]
+    findings = [json.loads(ln) for ln in out.stdout.splitlines()
+                if ln.strip()]
+    assert any(f["pass"] == "unsupported_op" for f in findings)
+    assert dot_path.read_text().startswith("digraph")
+
+
+def test_crashing_pass_warns_but_never_blocks():
+    """A bug in the VALIDATOR must not refuse a valid program at level
+    2: a raising pass becomes a WARNING finding, not an error."""
+    from paddle_tpu.analysis import _ORDER, _PASSES, AnalysisPass, \
+        register_pass
+
+    @register_pass
+    class _Boom(AnalysisPass):
+        name = "boom_test"
+
+        def run(self, ctx):
+            raise RuntimeError("validator bug")
+
+    try:
+        main, *_rest, loss = _tiny_program()
+        fs = analysis.validate_program(  # must NOT raise
+            main.desc, feed_names=["x"], fetch_names=[loss.name],
+            level=2)
+        crash = _by_pass(fs, "boom_test")
+        assert crash and crash[0].severity == analysis.WARNING
+        assert "validator bug" in crash[0].message
+    finally:
+        _PASSES.pop("boom_test", None)
+        _ORDER.remove("boom_test")
+
+
+def test_subblock_attr_bindings_not_dead_or_aliased():
+    """Vars consumed only inside a control-flow sub-block (bound via
+    string attrs, not input slots) keep their producers live and count
+    as reads for the alias pass."""
+    import paddle_tpu.layers as L
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), \
+            pt.program_guard(main, startup):
+        x = L.data(name="x", shape=[4], dtype="float32")
+        pred = L.data(name="pred", shape=[], dtype="bool")
+        a = L.relu(x)           # consumed ONLY via cond branches
+        b = L.sigmoid(x)
+        out = L.cond(pred, lambda: a * 2.0, lambda: b + 1.0)
+        total = L.reduce_mean(out)
+    fs = analysis.run_passes(main.desc, feed_names=["x", "pred"],
+                             fetch_names=[total.name])
+    assert not _errors(fs), "\n".join(map(str, fs))
+    assert not _by_pass(fs, "dead_op"), "\n".join(map(str, fs))
+    assert not _by_pass(fs, "alias"), "\n".join(map(str, fs))
+
+
+def test_pass_registry_and_json():
+    names = analysis.pass_names()
+    for expect in ("def_use", "unsupported_op", "shape_dtype",
+                   "dead_op", "alias", "precision"):
+        assert expect in names
+    main, *_rest = _tiny_program()
+    fs = analysis.run_passes(main.desc, feed_names=["x"],
+                             fetch_names=["never_made"],
+                             passes=["def_use"])
+    assert fs and all(f.pass_name == "def_use" for f in fs)
+    d = analysis.findings_to_json(fs)[0]
+    assert d["pass"] == "def_use" and d["severity"] == "error"
+    with pytest.raises(KeyError):
+        analysis.run_passes(main.desc, passes=["nope"])

@@ -1,24 +1,29 @@
-"""Static repo-hygiene lints in CI.
+"""Static repo-hygiene lints in CI — thin wrapper over tools/lint.py.
 
 1. Evidence claims (VERDICT r4 item 9): PARITY.md/PROFILE.md may only
    cite driver artifacts (BENCH_rNN/MULTICHIP_rNN) whose committed JSON
    exists and recorded success — a claim against a failed or absent
    driver file is overclaiming and fails the suite.
-2. Durable writes (RESILIENCE.md): bare `open(..., "w")` / `np.save` /
-   `json.dump` calls inside paddle_tpu/ bypass the crash-safe
-   tmp+os.replace helpers in resilience/atomic.py and can leave
-   truncated artifacts behind a kill. Every such call must go through
-   the helpers or carry an explicit `# atomic-exempt: <why>` comment
-   (log streams, tmp files that are os.replace'd manually, ...).
+2. Codebase lints: tools/lint.py runs its full pass suite (atomic
+   durable-writes — migrated from this file's PR 4 version — plus
+   thread-lifetime, swallowed-exception, and lock-held-across-blocking
+   passes) over all of paddle_tpu/. Intentional sites carry
+   `# lint-exempt:<pass>: <why>` annotations (the atomic pass also
+   honors the legacy `# atomic-exempt`).
+3. Cache-writer positive check (ISSUE 6): the persistent compile cache
+   and the serving warmstart artifact must publish via
+   resilience.atomic.write_bytes.
 """
 
 import os
-import re
 import sys
+
+import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(_REPO, "tools"))
 
+from lint import WRITE_PATTERNS, lint_paths, pass_names  # noqa: E402
 from refresh_evidence import lint_evidence_claims  # noqa: E402
 
 
@@ -27,63 +32,19 @@ def test_driver_citations_are_valid():
     assert not errors, "\n".join(errors)
 
 
-# -- durable-write lint ------------------------------------------------------
+# -- codebase lint passes (tools/lint.py) ------------------------------------
 
-# `(?<![\w.])` keeps atomic_open/gzip.open/os.fdopen out of the `open`
-# match; modes are matched literally, so an `open(path, mode)` stream
-# helper with a variable mode is out of scope (it writes on the
-# caller's behalf, the caller owns durability). The open() pattern
-# allows anything (including nested calls' parens) between `open(` and
-# the quoted mode, which must be followed by `,` or `)` — so
-# `open(os.path.join(d, f), "w")` is caught, at the cost of a rare
-# false positive when a line happens to contain both `open(` and a
-# stray `"w")` (annotate those `# atomic-exempt:`).
-_WRITE_PATTERNS = (
-    (re.compile(r"(?<![\w.])np\.(save|savez|savez_compressed)\s*\("),
-     "np.save/np.savez"),
-    (re.compile(r"(?<![\w.])json\.dump\s*\("), "json.dump"),
-    # pickle.dump (not .dumps) streams into an already-open handle —
-    # the compile-cache/warmstart writers must pickle.dumps into
-    # atomic.write_bytes instead
-    (re.compile(r"(?<![\w.])pickle\.dump\s*\("), "pickle.dump"),
-    (re.compile(
-        r"(?<![\w.])open\s*\(.*[\"'](w|wb|w\+|wb\+|x|xb)[\"']\s*[,)]"),
-     'open(..., "w")'),
-)
 
-# The helper module itself is the one place allowed to open durable
-# files for write.
-_ALLOWED_FILES = ("resilience/atomic.py",)
+@pytest.mark.parametrize("pass_name", pass_names())
+def test_lint_pass_clean(pass_name):
+    findings = lint_paths(passes=[pass_name])
+    assert not findings, "\n".join(str(f) for f in findings)
 
 
 def lint_durable_writes():
-    errors = []
-    pkg = os.path.join(_REPO, "paddle_tpu")
-    for dirpath, _dirnames, filenames in os.walk(pkg):
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, _REPO)
-            if rel.replace(os.sep, "/").endswith(_ALLOWED_FILES):
-                continue
-            with open(path) as f:
-                for lineno, line in enumerate(f, 1):
-                    if "atomic-exempt" in line:
-                        continue
-                    for pat, what in _WRITE_PATTERNS:
-                        if pat.search(line):
-                            errors.append(
-                                f"{rel}:{lineno}: bare {what} write — "
-                                f"use paddle_tpu.resilience.atomic or "
-                                f"add '# atomic-exempt: <why>': "
-                                f"{line.strip()}")
-    return errors
-
-
-def test_no_bare_durable_writes():
-    errors = lint_durable_writes()
-    assert not errors, "\n".join(errors)
+    """Back-compat shim: PR 4 callers (and docs) reach the atomic pass
+    through this name."""
+    return [str(f) for f in lint_paths(passes=["atomic"])]
 
 
 # -- compile-cache writer lint (ISSUE 6) -------------------------------------
@@ -105,9 +66,9 @@ def test_cache_writers_route_through_atomic():
             f"{rel}: cache writer must publish via " \
             f"resilience.atomic.write_bytes"
         for lineno, line in enumerate(src.splitlines(), 1):
-            if "atomic-exempt" in line:
+            if "atomic-exempt" in line or "lint-exempt:atomic" in line:
                 continue
-            for pat, what in _WRITE_PATTERNS:
+            for pat, what in WRITE_PATTERNS:
                 assert not pat.search(line), (
                     f"{rel}:{lineno}: cache writer uses bare {what} — "
                     f"publish through resilience.atomic.write_bytes: "

@@ -1,0 +1,147 @@
+"""tools/lint.py pass behavior on seeded defects (the repo-wide clean
+runs live in test_evidence_lint.py)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+from lint import lint_paths, pass_names  # noqa: E402
+
+
+def _lint_src(tmp_path, src, passes=None):
+    p = tmp_path / "case.py"
+    p.write_text(textwrap.dedent(src))
+    return lint_paths([str(p)], passes=passes)
+
+
+def test_atomic_pass_flags_and_exempts(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import json
+        def save(path, obj):
+            with open(path, "w") as f:
+                json.dump(obj, f)
+    """, passes=["atomic"])
+    assert len(fs) == 2
+    assert all(f.pass_name == "atomic" for f in fs)
+    fs = _lint_src(tmp_path, """\
+        import json
+        def save(path, obj):
+            with open(path, "w") as f:  # atomic-exempt: test stream
+                json.dump(obj, f)  # lint-exempt:atomic: test stream
+    """, passes=["atomic"])
+    assert not fs
+
+
+def test_thread_pass(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import threading
+        def go(fn):
+            threading.Thread(target=fn).start()
+    """, passes=["thread"])
+    assert len(fs) == 1 and fs[0].pass_name == "thread"
+    # daemon kwarg, joined thread, or an exemption are all compliant
+    fs = _lint_src(tmp_path, """\
+        import threading
+        def go(fn):
+            threading.Thread(target=fn, daemon=True).start()
+        def go2(fn):
+            t = threading.Thread(target=fn)
+            t.start()
+            t.join()
+        def go3(fn):
+            threading.Thread(target=fn).start()  # lint-exempt:thread: test
+    """, passes=["thread"])
+    assert not fs
+
+
+def test_swallow_pass(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        def f():
+            try:
+                risky()
+            except Exception:
+                pass
+        def g():
+            try:
+                risky()
+            except:
+                pass
+    """, passes=["swallow"])
+    assert len(fs) == 2
+    fs = _lint_src(tmp_path, """\
+        def ok1():
+            try:
+                risky()
+            except OSError:
+                pass
+        def ok2():
+            try:
+                risky()
+            except Exception:
+                handle()
+        def ok3():
+            try:
+                risky()
+            except Exception:  # lint-exempt:swallow: test
+                pass
+    """, passes=["swallow"])
+    assert not fs
+
+
+def test_lockblock_pass(tmp_path):
+    fs = _lint_src(tmp_path, """\
+        import time, threading
+        _lock = threading.Lock()
+        def f():
+            with _lock:
+                time.sleep(5)
+    """, passes=["lockblock"])
+    assert len(fs) == 1 and "time.sleep" in fs[0].message
+    fs = _lint_src(tmp_path, """\
+        import time, threading
+        _lock = threading.Lock()
+        _cv = threading.Condition()
+        def ok_outside():
+            with _lock:
+                x = 1
+            time.sleep(5)
+        def ok_cv_wait():
+            with _cv:
+                _cv.wait()  # waiting ON the held condvar releases it
+        def ok_deferred():
+            with _lock:
+                def later():
+                    time.sleep(5)  # runs off the lock
+                return later
+    """, passes=["lockblock"])
+    assert not fs
+
+
+def test_unparseable_file_is_a_finding_not_a_crash(tmp_path):
+    fs = _lint_src(tmp_path, "def broken(:\n")
+    assert len(fs) == 1 and fs[0].pass_name == "parse"
+
+
+def test_cli(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import json\njson.dump({}, open('x', 'w'))\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "lint.py"),
+         str(bad)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 1 and "atomic" in out.stdout
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "lint.py"),
+         "--list"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0
+    assert set(out.stdout.split()) == set(pass_names())
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "lint.py"),
+         "--pass", "nope", str(bad)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 2

@@ -1,0 +1,425 @@
+#!/usr/bin/env python
+"""lint — multi-pass static lints over the paddle_tpu codebase.
+
+Grown out of the single-purpose durable-write check in
+tests/test_evidence_lint.py (PR 4): one framework, several passes, all
+run in tier-1 CI over every `.py` file under paddle_tpu/. A finding
+fails the suite unless the line (or the line above it) carries an
+explicit escape hatch:
+
+    # lint-exempt:<pass>[: reason]
+
+(the atomic pass also honors the legacy `# atomic-exempt: <why>`
+annotation it migrated from).
+
+Passes:
+  atomic    — bare `open(..., "w")` / np.save / json.dump / pickle.dump
+              inside paddle_tpu/ bypass the crash-safe tmp+fsync+
+              os.replace helpers (resilience/atomic.py) and can leave
+              truncated artifacts behind a kill.
+  thread    — `threading.Thread(...)` without a `daemon=` decision and
+              with no visible `.join()` of the created thread: such a
+              thread silently blocks interpreter exit (non-daemon) or
+              dies un-reaped — either way the lifetime is accidental.
+  swallow   — `except:` / `except Exception:` / `except BaseException:`
+              whose body is only `pass`: the one shape of handler that
+              hides real bugs (typed narrow catches are fine).
+  lockblock — blocking calls (sleep, subprocess, socket accept/recv/
+              connect, serve_forever, Event.wait, thread join) made
+              while holding a lock: every other thread touching that
+              lock stalls for the duration. Heuristic: the with-item
+              must look like a lock (name contains "lock"/"_cv"/"_mu");
+              nested function bodies are skipped (they run later, off
+              the lock) and waiting ON the held condition variable is
+              fine (wait releases it).
+
+Usage:
+  lint.py [paths...] [--json] [--pass NAME] [--list]
+Exit code: 0 clean, 1 findings, 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DEFAULT_TARGET = os.path.join(_REPO, "paddle_tpu")
+
+_EXEMPT_RE = re.compile(r"lint-exempt:\s*([A-Za-z0-9_-]+)")
+
+
+@dataclass(frozen=True)
+class LintFinding:
+    path: str  # repo-relative
+    lineno: int
+    pass_name: str
+    message: str
+    line: str = ""
+
+    def __str__(self):
+        return (f"{self.path}:{self.lineno}: [{self.pass_name}] "
+                f"{self.message}: {self.line.strip()}")
+
+    def to_dict(self):
+        return {"path": self.path, "lineno": self.lineno,
+                "pass": self.pass_name, "message": self.message,
+                "line": self.line.strip()}
+
+
+class _File:
+    """One parsed source file handed to every pass."""
+
+    def __init__(self, path: str, rel: str, src: str):
+        self.path = path
+        self.rel = rel
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = ast.parse(src, filename=path)
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def exempt(self, lineno: int, pass_name: str,
+               extra_markers: Sequence[str] = ()) -> bool:
+        """Is `lineno` exempted from `pass_name`? The annotation may sit
+        on the line itself or the line above (long statements put it
+        above)."""
+        for ln in (lineno, lineno - 1):
+            text = self.line(ln)
+            for m in _EXEMPT_RE.finditer(text):
+                if m.group(1) == pass_name:
+                    return True
+            for marker in extra_markers:
+                if marker in text:
+                    return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# pass registry
+# ---------------------------------------------------------------------------
+
+_PASSES: Dict[str, Callable[[_File], List[LintFinding]]] = {}
+
+
+def lint_pass(name: str):
+    def deco(fn):
+        _PASSES[name] = fn
+        fn.pass_name = name
+        return fn
+
+    return deco
+
+
+def pass_names() -> List[str]:
+    return list(_PASSES)
+
+
+# ---------------------------------------------------------------------------
+# atomic: durable writes must route through resilience/atomic.py
+# (migrated verbatim from tests/test_evidence_lint.py; that test now
+# wraps this pass)
+# ---------------------------------------------------------------------------
+
+# `(?<![\w.])` keeps atomic_open/gzip.open/os.fdopen out of the `open`
+# match; modes are matched literally, so an `open(path, mode)` stream
+# helper with a variable mode is out of scope (it writes on the
+# caller's behalf, the caller owns durability). The open() pattern
+# allows anything (including nested calls' parens) between `open(` and
+# the quoted mode, which must be followed by `,` or `)` — so
+# `open(os.path.join(d, f), "w")` is caught, at the cost of a rare
+# false positive when a line happens to contain both `open(` and a
+# stray `"w")` (annotate those).
+WRITE_PATTERNS = (
+    (re.compile(r"(?<![\w.])np\.(save|savez|savez_compressed)\s*\("),
+     "np.save/np.savez"),
+    (re.compile(r"(?<![\w.])json\.dump\s*\("), "json.dump"),
+    # pickle.dump (not .dumps) streams into an already-open handle —
+    # the compile-cache/warmstart writers must pickle.dumps into
+    # atomic.write_bytes instead
+    (re.compile(r"(?<![\w.])pickle\.dump\s*\("), "pickle.dump"),
+    (re.compile(
+        r"(?<![\w.])open\s*\(.*[\"'](w|wb|w\+|wb\+|x|xb)[\"']\s*[,)]"),
+     'open(..., "w")'),
+)
+
+# The helper module itself is the one place allowed to open durable
+# files for write.
+_ATOMIC_ALLOWED = ("resilience/atomic.py",)
+
+
+@lint_pass("atomic")
+def _atomic_pass(f: _File) -> List[LintFinding]:
+    if f.rel.replace(os.sep, "/").endswith(_ATOMIC_ALLOWED):
+        return []
+    out = []
+    for lineno, line in enumerate(f.lines, 1):
+        if f.exempt(lineno, "atomic", extra_markers=("atomic-exempt",)):
+            continue
+        for pat, what in WRITE_PATTERNS:
+            if pat.search(line):
+                out.append(LintFinding(
+                    f.rel, lineno, "atomic",
+                    f"bare {what} write — use paddle_tpu.resilience."
+                    f"atomic or add '# lint-exempt:atomic: <why>'",
+                    line))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# thread: Thread() must pick daemon= or be join()ed
+# ---------------------------------------------------------------------------
+
+
+def _call_name(node: ast.Call) -> str:
+    try:
+        return ast.unparse(node.func)
+    except Exception:
+        return ""
+
+
+@lint_pass("thread")
+def _thread_pass(f: _File) -> List[LintFinding]:
+    out = []
+    # names (last attribute component) that get .join()ed anywhere in
+    # the file — `self._thread.join(...)` joins the thread bound to
+    # `self._thread = threading.Thread(...)`
+    joined = set(re.findall(r"(\w+)\s*\.\s*join\s*\(", f.src))
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if not (name == "threading.Thread" or name.endswith(".Thread")
+                or name == "Thread"):
+            continue
+        if any(k.arg == "daemon" for k in node.keywords):
+            continue
+        if f.exempt(node.lineno, "thread"):
+            continue
+        # assigned target later join()ed? walk up is hard without
+        # parents; approximate by the assignment on the same statement
+        line = f.line(node.lineno)
+        target = re.match(r"\s*([\w.]+)\s*=", line)
+        tname = target.group(1).split(".")[-1] if target else None
+        if tname and tname in joined:
+            continue
+        out.append(LintFinding(
+            f.rel, node.lineno, "thread",
+            "Thread() without an explicit daemon= decision and no "
+            "visible .join() — thread lifetime is accidental "
+            "(add daemon=True/False, join it, or "
+            "'# lint-exempt:thread: <why>')",
+            line))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# swallow: broad except with a pass-only body
+# ---------------------------------------------------------------------------
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name) and t.id in ("Exception", "BaseException"):
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name)
+                   and e.id in ("Exception", "BaseException")
+                   for e in t.elts)
+    return False
+
+
+@lint_pass("swallow")
+def _swallow_pass(f: _File) -> List[LintFinding]:
+    out = []
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node):
+            continue
+        if not (len(node.body) == 1 and isinstance(node.body[0], ast.Pass)):
+            continue
+        lineno = node.lineno
+        if f.exempt(lineno, "swallow") \
+                or f.exempt(node.body[0].lineno, "swallow"):
+            continue
+        out.append(LintFinding(
+            f.rel, lineno, "swallow",
+            "broad except swallows every error with `pass` — catch the "
+            "specific exception, handle it, or add "
+            "'# lint-exempt:swallow: <why>'",
+            f.line(lineno)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# lockblock: blocking call while holding a lock
+# ---------------------------------------------------------------------------
+
+_LOCKISH_RE = re.compile(r"lock|_cv\b|_mu\b|mutex", re.IGNORECASE)
+
+# call names that block for unbounded/long time
+_BLOCKING_EXACT = {
+    "time.sleep", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "urllib.request.urlopen", "urlopen",
+}
+_BLOCKING_ATTRS = {"serve_forever", "accept", "recv", "recv_into",
+                   "connect", "wait"}
+
+
+def _lock_exprs(node: ast.With) -> List[str]:
+    out = []
+    for item in node.items:
+        try:
+            s = ast.unparse(item.context_expr)
+        except Exception:
+            continue
+        # `lock.acquire()`-style context exprs don't occur with `with`;
+        # strip a trailing call like `self._lock` vs `get_lock()`
+        if _LOCKISH_RE.search(s):
+            out.append(s.split("(")[0])
+    return out
+
+
+def _iter_body_calls(node: ast.With):
+    """Calls lexically under the with-body that execute WHILE the lock
+    is held: nested function/class bodies are skipped — they run later,
+    typically on another thread."""
+    stack = list(node.body)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+@lint_pass("lockblock")
+def _lockblock_pass(f: _File) -> List[LintFinding]:
+    out = []
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.With):
+            continue
+        locks = _lock_exprs(node)
+        if not locks:
+            continue
+        for call in _iter_body_calls(node):
+            name = _call_name(call)
+            blocking = name in _BLOCKING_EXACT
+            recv = None
+            if not blocking and "." in name:
+                recv, attr = name.rsplit(".", 1)
+                if attr in _BLOCKING_ATTRS:
+                    # waiting ON the held lock/condvar is the one
+                    # legitimate shape: Condition.wait releases it
+                    blocking = recv not in locks
+                elif attr == "join" and "thread" in recv.lower():
+                    blocking = True
+            if not blocking:
+                continue
+            if f.exempt(call.lineno, "lockblock"):
+                continue
+            out.append(LintFinding(
+                f.rel, call.lineno, "lockblock",
+                f"blocking call `{name}(...)` while holding "
+                f"`{locks[0]}` — every thread contending on that lock "
+                f"stalls for the duration (move it outside the lock or "
+                f"add '# lint-exempt:lockblock: <why>')",
+                f.line(call.lineno)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def iter_py_files(paths: Sequence[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__",)]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths: Optional[Sequence[str]] = None,
+               passes: Optional[Sequence[str]] = None
+               ) -> List[LintFinding]:
+    """Run the (selected) passes over every .py file under `paths`
+    (default: the paddle_tpu package). Unparseable files produce a
+    finding instead of crashing the linter."""
+    paths = list(paths) if paths else [_DEFAULT_TARGET]
+    selected = list(passes) if passes else pass_names()
+    for name in selected:
+        if name not in _PASSES:
+            raise KeyError(f"unknown lint pass {name!r}; choose from "
+                           f"{pass_names()}")
+    findings: List[LintFinding] = []
+    for path in iter_py_files(paths):
+        rel = os.path.relpath(path, _REPO)
+        try:
+            with open(path) as fh:
+                src = fh.read()
+            f = _File(path, rel, src)
+        except (OSError, SyntaxError) as e:
+            findings.append(LintFinding(
+                rel, getattr(e, "lineno", 0) or 0, "parse",
+                f"could not lint: {type(e).__name__}: {e}"))
+            continue
+        for name in selected:
+            findings.extend(_PASSES[name](f))
+    findings.sort(key=lambda x: (x.path, x.lineno, x.pass_name))
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="lint", description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: paddle_tpu/)")
+    ap.add_argument("--pass", dest="passes", action="append",
+                    help="run only this pass (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="findings as JSON lines")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered passes and exit")
+    args = ap.parse_args(argv)
+    if args.list:
+        for n in pass_names():
+            print(n)
+        return 0
+    try:
+        findings = lint_paths(args.paths or None, args.passes)
+    except KeyError as e:
+        print(f"lint: {e.args[0]}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(json.dumps(f.to_dict()) if args.json else str(f))
+    if findings:
+        print(f"{len(findings)} finding(s) across "
+              f"{len({f.path for f in findings})} file(s)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

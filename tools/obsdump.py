@@ -22,6 +22,9 @@ Usage:
                                             # compile-cache hit/miss/
                                             # bytes table (--live,
                                             # --json)
+  obsdump.py analysis METRICS.json          # static-analysis findings
+                                            # per pass/severity + walk
+                                            # counts (--live, --json)
 
 Mixed-precision runs: `snapshot` surfaces the dynamic loss-scaling
 counters (paddle_tpu_amp_total{event=overflow|growth|skip}, the
@@ -101,18 +104,11 @@ def print_snapshot(snap, out=sys.stdout):
 
 
 def cmd_snapshot(args) -> int:
-    if args.live:
-        import paddle_tpu  # noqa: F401 — registers all telemetry metrics
-
-        from paddle_tpu import observability
-        snap = observability.snapshot()
-    else:
-        if not args.path:
-            print("snapshot: need a metrics.json path or --live",
-                  file=sys.stderr)
-            return 2
-        with open(args.path) as f:
-            snap = json.load(f)
+    snap = _load_snap(args)
+    if snap is None:
+        print("snapshot: need a metrics.json path or --live",
+              file=sys.stderr)
+        return 2
     if args.prom:
         sys.stdout.write(
             _load_obs_module("metrics").render_prometheus_snapshot(snap))
@@ -223,18 +219,11 @@ def cmd_cache(args) -> int:
     snapshot: hit/miss/corrupt/store/evict counts and the bytes moved,
     i.e. the restart-storm story of PADDLE_TPU_COMPILE_CACHE
     (PROFILE.md §Compile-cache) in one table."""
-    if args.live:
-        import paddle_tpu  # noqa: F401 — registers all telemetry metrics
-
-        from paddle_tpu import observability
-        snap = observability.snapshot()
-    else:
-        if not args.path:
-            print("cache: need a metrics.json path or --live",
-                  file=sys.stderr)
-            return 2
-        with open(args.path) as f:
-            snap = json.load(f)
+    snap = _load_snap(args)
+    if snap is None:
+        print("cache: need a metrics.json path or --live",
+              file=sys.stderr)
+        return 2
 
     counts = {}  # (kind, event) -> count
     nbytes = {}  # (kind, event) -> bytes
@@ -265,14 +254,74 @@ def cmd_cache(args) -> int:
     if args.json:
         print(json.dumps(rows, indent=2))
         return 0
-    cols = ("kind", "hit", "miss", "corrupt", "store", "store_error",
-            "evict", "hit_rate", "hit_bytes", "store_bytes",
-            "evict_bytes")
+    _print_aligned(rows, ("kind", "hit", "miss", "corrupt", "store",
+                          "store_error", "evict", "hit_rate",
+                          "hit_bytes", "store_bytes", "evict_bytes"))
+    return 0
+
+
+def _print_aligned(rows, cols):
+    """Right-aligned table shared by the cache/analysis summaries."""
     widths = {c: max(len(c), *(len(str(r[c])) for r in rows))
               for c in cols}
     print("  ".join(f"{c:>{widths[c]}}" for c in cols))
     for r in rows:
         print("  ".join(f"{str(r[c]):>{widths[c]}}" for c in cols))
+
+
+def _load_snap(args):
+    """Shared --live/path snapshot loader for summary subcommands."""
+    if args.live:
+        import paddle_tpu  # noqa: F401 — registers all telemetry metrics
+
+        from paddle_tpu import observability
+        return observability.snapshot()
+    if not args.path:
+        return None
+    with open(args.path) as f:
+        return json.load(f)
+
+
+def cmd_analysis(args) -> int:
+    """Static-analysis story from a metrics snapshot: how many pass
+    walks ran (by wiring site) and the findings per pass/severity
+    (paddle_tpu/analysis, PADDLE_TPU_VALIDATE — ANALYSIS.md)."""
+    snap = _load_snap(args)
+    if snap is None:
+        print("analysis: need a metrics.json path or --live",
+              file=sys.stderr)
+        return 2
+    runs = {}
+    for s in (snap.get("paddle_tpu_analysis_runs_total") or {}) \
+            .get("series", []):
+        runs[s.get("labels", {}).get("where", "?")] = int(s["value"])
+    counts = {}  # (pass, severity) -> n
+    for s in (snap.get("paddle_tpu_analysis_findings_total") or {}) \
+            .get("series", []):
+        labels = s.get("labels", {})
+        key = (labels.get("pass", "?"), labels.get("severity", "?"))
+        counts[key] = counts.get(key, 0) + int(s["value"])
+    if not runs and not counts:
+        print("no analysis samples in this snapshot (is "
+              "PADDLE_TPU_VALIDATE set, or did tools/analyze.py run?)")
+        return 0
+    severities = ("error", "warning", "info")
+    rows = []
+    for pass_name in sorted({p for p, _ in counts}):
+        row = {"pass": pass_name}
+        for sev in severities:
+            row[sev] = counts.get((pass_name, sev), 0)
+        rows.append(row)
+    if args.json:
+        print(json.dumps({"walks": runs, "findings": rows}, indent=2))
+        return 0
+    print("walks: " + (", ".join(f"{k}={v}"
+                                 for k, v in sorted(runs.items()))
+                       or "none"))
+    if rows:
+        _print_aligned(rows, ("pass",) + severities)
+    else:
+        print("no findings recorded")
     return 0
 
 
@@ -317,6 +366,18 @@ def main(argv=None) -> int:
     cp.add_argument("--json", action="store_true",
                     help="rows as JSON instead of the aligned table")
     cp.set_defaults(fn=cmd_cache)
+
+    anp = sub.add_parser("analysis", help="static-analysis walks + "
+                         "findings per pass/severity from a metrics "
+                         "snapshot")
+    anp.add_argument("path", nargs="?", help="metrics.json from "
+                     "PADDLE_TPU_METRICS_DIR (omit with --live)")
+    anp.add_argument("--live", action="store_true",
+                     help="read this process's registry instead of a "
+                     "file")
+    anp.add_argument("--json", action="store_true",
+                     help="JSON instead of the aligned table")
+    anp.set_defaults(fn=cmd_analysis)
 
     # unknown/missing subcommands exit nonzero via argparse itself
     # (required=True subparsers error out with status 2)
