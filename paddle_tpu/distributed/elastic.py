@@ -1,0 +1,154 @@
+"""Elastic SPMD training driver: survive scale-in/out without a full
+restart.
+
+Glues the pieces the tentpole built into one loop (ROADMAP item 3):
+
+  rendezvous.FileRendezvous     who is alive, as sealed generations
+  parallel.mesh.resize_mesh     the SPMD mesh for the new world size
+  parallel.checkpoint           mesh-N checkpoint -> mesh-M TrainState
+  parallel.train.train_loop     resize_check at checkpoint boundaries
+
+The protocol per membership change: the loop's `resize_check` fires
+right after a periodic checkpoint commits (the one boundary where the
+surviving state is durable and consistent), train_loop returns
+stop="resize", and this driver re-rendezvouses, re-forms the mesh for
+the new world size, rebuilds the jitted step (compile-cache-aware: a
+RETURN to a previous world size pays PR 6 cache I/O, not fresh XLA),
+and restores the just-committed checkpoint onto the new mesh — the
+`restore_resharded` path. No surviving worker restarts; the cost of a
+world-size change is one rendezvous + one resharding restore.
+
+Data is consumed by GLOBAL step (`batches` must be the callable form,
+exactly like a resumable train_loop) and split across members with
+reader.ElasticShardPlan, whose assignment is keyed on
+(epoch, global step, world size) only — so a membership change can
+neither lose nor double-deliver an example.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..observability import events as _events
+from .rendezvous import FileRendezvous, RendezvousInfo, RESIZES
+
+__all__ = ["elastic_train_loop", "default_mesh_factory"]
+
+
+def default_mesh_factory(devices_per_member: int = 1):
+    """Mesh for a generation: data-parallel over the first
+    world_size * devices_per_member local devices — the single-host
+    simulation shape (each member contributes devices_per_member
+    chips). Multi-host deployments supply their own factory."""
+    import jax
+
+    from ..parallel.mesh import MeshConfig, make_mesh
+
+    def factory(info: RendezvousInfo):
+        need = info.world_size * devices_per_member
+        devs = jax.devices()
+        if need > len(devs):
+            raise ValueError(
+                f"generation {info.generation} needs {need} devices "
+                f"({info.world_size} members x {devices_per_member}) "
+                f"but only {len(devs)} exist — cap the group with "
+                f"FileRendezvous(max_workers=...)")
+        return make_mesh(MeshConfig(dp=-1), devices=devs[:need])
+
+    return factory
+
+
+def elastic_train_loop(
+    build: Callable[[Any], Tuple[Callable, Callable]],
+    make_params: Callable[[], Any],
+    batches: Callable[[int], Optional[Dict]],
+    *,
+    rdzv: FileRendezvous,
+    manager,
+    save_every: int,
+    rng=None,
+    mesh_factory: Optional[Callable[[RendezvousInfo], Any]] = None,
+    devices_per_member: int = 1,
+):
+    """Run `train_loop` elastically: re-form the mesh at checkpoint
+    boundaries whenever rendezvous membership changes.
+
+    `build(mesh) -> (init_state, step_fn)` is the per-generation step
+    builder (make_train_step partial); `make_params()` must return
+    FRESH params each call (init_state donates them). `batches` must be
+    the callable global-step-keyed form — that is what makes the
+    trajectory invariant across resizes and resumes. Requires `manager`
+    + `save_every`: the checkpoint boundary IS the re-rendezvous
+    boundary.
+
+    Returns (state, losses, stop, history): `losses` spans every
+    generation, `stop` is train_loop's final verdict
+    ("completed" | "preempted" | "exhausted"), and `history` is the
+    list of RendezvousInfo generations this worker trained under.
+    """
+    if manager is None or not save_every:
+        raise ValueError(
+            "elastic_train_loop requires manager + save_every — without "
+            "periodic checkpoints there is no safe resize boundary")
+    if not callable(batches):
+        raise ValueError(
+            "elastic_train_loop requires the callable batch_fn(step) "
+            "form — an iterator cannot be re-keyed across a resize")
+    from ..parallel import checkpoint as _ckpt
+    from ..parallel.mesh import mesh_guard
+    from ..parallel.train import train_loop
+
+    if mesh_factory is None:
+        mesh_factory = default_mesh_factory(devices_per_member)
+
+    info = rdzv.rendezvous(reason="start")
+    rdzv.start_heartbeat()
+    history: List[RendezvousInfo] = [info]
+    losses: Dict[int, float] = {}
+    state = None
+    stop = "completed"
+    try:
+        while True:
+            mesh = mesh_factory(info)
+            with mesh_guard(mesh):
+                init_state, step_fn = build(mesh)
+                template = init_state(make_params())
+                restored = manager.restore_latest(template)
+                if restored is not None:
+                    # covers both the resume-after-crash path and the
+                    # post-resize path: the newest committed checkpoint
+                    # (possibly written on a different mesh) lands on
+                    # THIS generation's shardings
+                    state = restored
+                elif state is not None:
+                    # no checkpoint yet but live state from a previous
+                    # generation: per-leaf in-process reshard
+                    state = _ckpt.reshard_train_state(state, template)
+                else:
+                    state = template
+                current = info  # pin: the closure must test THIS gen
+
+                state, seg_losses, stop = train_loop(
+                    step_fn, state, batches, rng=rng, manager=manager,
+                    save_every=save_every,
+                    resize_check=lambda: rdzv.membership_changed(current))
+            losses.update(seg_losses)
+            if stop != "resize":
+                rdzv.leave()  # graceful exit: survivors reseal without
+                # waiting out our heartbeat staleness window
+                break
+            prev = info
+            info = rdzv.rendezvous(reason="membership_change")
+            history.append(info)
+            direction = ("same" if info.world_size == prev.world_size
+                         else "in" if info.world_size < prev.world_size
+                         else "out")
+            RESIZES.inc(direction=direction)
+            _events.emit("resize", generation=info.generation,
+                         from_world=prev.world_size,
+                         to_world=info.world_size,
+                         step=int(state.step), direction=direction)
+    finally:
+        rdzv.stop_heartbeat()  # idempotent; leave() already stopped it
+        # on the graceful paths — this covers exceptions mid-segment
+    return state, losses, stop, history

@@ -22,8 +22,26 @@ live ring. Workers resume from their last committed checkpoint
 (resilience.CheckpointManager), so a restart costs only the steps since
 it. `--max_restarts 0` restores fail-fast.
 
+Elastic mode (RESILIENCE.md §Elasticity): with `--elastic`, membership
+is versioned by a file-store rendezvous (distributed/rendezvous.py,
+root exported as PADDLE_TPU_RDZV_DIR) and a SINGLE rank's exit never
+tears down the survivors — they re-form the group at their next
+checkpoint boundary:
+
+  * one rank exits 75 (preempted): it already left the rendezvous
+    gracefully; the launcher respawns ONLY that slot after a capped
+    backoff (it rejoins at the next generation). A slot whose respawn
+    budget is spent leaves the job for good — scale-in, not failure.
+  * one rank crashes: that slot alone is respawned while the global
+    `--max_restarts` crash budget lasts; only an unrecoverable crash
+    STORM (budget exhausted) still drains the full gang.
+  * the launcher exits 0 when every slot finished cleanly, 75 when the
+    job ended by preemption(s), or the crash code on a drained storm.
+
 Usage:
     python -m paddle_tpu.distributed.launch --nproc_per_node 2 train.py ...
+    python -m paddle_tpu.distributed.launch --nproc_per_node 4 --elastic \
+        --rdzv_dir /ckpt/rdzv --min_workers 2 train.py ...
 """
 
 from __future__ import annotations
@@ -34,6 +52,7 @@ import signal
 import socket
 import subprocess
 import sys
+import tempfile
 import time
 from typing import List
 
@@ -71,6 +90,19 @@ def launch_main(argv=None):
     parser.add_argument("--restart_backoff_s", type=float, default=1.0,
                         help="base of the capped exponential restart "
                         "backoff (base, 2x, 4x, ... capped at 30s)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="per-rank supervision over a file-store "
+                        "rendezvous: a single crash/preempt respawns "
+                        "only that slot; survivors re-form at their "
+                        "next checkpoint boundary (RESILIENCE.md "
+                        "§Elasticity)")
+    parser.add_argument("--rdzv_dir", type=str, default="",
+                        help="rendezvous store root for --elastic "
+                        "(shared filesystem on multi-host); default: "
+                        "<log_dir>/rdzv or a fresh temp dir")
+    parser.add_argument("--min_workers", type=int, default=1,
+                        help="--elastic: smallest world size a "
+                        "generation may seal with")
     parser.add_argument("training_script")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -87,6 +119,20 @@ def launch_main(argv=None):
         ports = _free_ports(nproc)
     endpoints = [f"{ip}:{port}" for ip in ips for port in ports]
 
+    rdzv_dir = ""
+    if args.elastic:
+        if len(ips) > 1 and not args.rdzv_dir:
+            # a defaulted node-LOCAL store would silently split the job
+            # into independent per-node rendezvous groups, each happily
+            # sealing its own world and double-consuming the data
+            parser.error("--elastic with multiple --ips requires an "
+                         "explicit --rdzv_dir on a filesystem shared "
+                         "by every node")
+        rdzv_dir = args.rdzv_dir or (
+            os.path.join(args.log_dir, "rdzv") if args.log_dir
+            else tempfile.mkdtemp(prefix="paddle_tpu_rdzv_"))
+        os.makedirs(rdzv_dir, exist_ok=True)
+
     ranks = []
     base = args.node_rank * nproc
     for local_rank in range(nproc):
@@ -99,6 +145,12 @@ def launch_main(argv=None):
             "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
             "FLAGS_selected_tpus": str(local_rank),
         })
+        if args.elastic:
+            env.update({
+                "PADDLE_TPU_ELASTIC": "1",
+                "PADDLE_TPU_RDZV_DIR": rdzv_dir,
+                "PADDLE_TPU_MIN_WORKERS": str(max(1, args.min_workers)),
+            })
         if args.backend == "cpu":
             env["JAX_PLATFORMS"] = "cpu"
             env["PADDLE_TPU_FORCE_CPU"] = "1"
@@ -115,6 +167,10 @@ def launch_main(argv=None):
 
     for r in ranks:
         r.spawn()
+    if args.elastic:
+        return _supervise_elastic(ranks,
+                                  max_restarts=max(0, args.max_restarts),
+                                  backoff_s=args.restart_backoff_s)
     return _supervise(ranks, max_restarts=max(0, args.max_restarts),
                       backoff_s=args.restart_backoff_s)
 
@@ -240,6 +296,111 @@ def _supervise(ranks: List["_Rank"], max_restarts: int,
         for r in ranks:
             r.close_out()
     return code
+
+
+def _supervise_elastic(ranks: List["_Rank"], max_restarts: int,
+                       backoff_s: float) -> int:
+    """Per-rank supervision (elastic mode). One rank's exit never
+    touches the survivors — they notice the membership change through
+    the rendezvous store at their next checkpoint boundary:
+
+      * preempt (rc 75): respawn ONLY that slot after capped backoff,
+        at most `max_restarts` respawns per slot; past the budget the
+        slot leaves the job permanently (scale-in, not failure).
+      * crash: respawn only that slot while the GLOBAL `max_restarts`
+        crash budget lasts; an exhausted budget is a crash storm — the
+        whole gang drains and the crash code propagates.
+
+    Exit code: 0 when every slot finished cleanly, PREEMPT_EXIT_CODE
+    when the job ended by unrespawnable preemption(s), crash rc on a
+    drained storm."""
+    code = 0
+    crash_restarts = 0
+    preempt_left = False
+    pending = {}  # rank id -> wall time its respawn becomes due
+    try:
+        while True:
+            now = time.time()
+            for r in ranks:
+                if r.done or r.proc is None or r.rank in pending:
+                    continue
+                rc = r.proc.poll()
+                if rc is None:
+                    continue
+                if rc == 0:
+                    r.done = True
+                    continue
+                from ..observability import events as _events
+
+                # preempt respawns budgeted PER SLOT and separately
+                # from crashes — a crash respawn (global budget) must
+                # not silently consume a slot's preempt budget
+                respawns = getattr(r, "preempt_respawns", 0)
+                if rc == PREEMPT_EXIT_CODE:
+                    if respawns >= max_restarts:
+                        r.done = True
+                        preempt_left = True
+                        print(f"launch[elastic]: rank {r.rank} preempted "
+                              f"(rc=75), respawn budget spent — slot "
+                              f"leaves the job", file=sys.stderr,
+                              flush=True)
+                        _events.emit("rank_restart", scope="rank",
+                                     cause="preempt_leave", rank=r.rank,
+                                     respawns=respawns)
+                        continue
+                    delay = min(30.0, backoff_s * (2 ** respawns))
+                    r.preempt_respawns = respawns + 1
+                    pending[r.rank] = now + delay
+                    print(f"launch[elastic]: rank {r.rank} preempted "
+                          f"(rc=75); elastic respawn rank {r.rank} in "
+                          f"{delay:.1f}s (survivors untouched)",
+                          file=sys.stderr, flush=True)
+                    _events.emit("rank_restart", scope="rank",
+                                 cause="preempt", rank=r.rank, rc=rc,
+                                 delay_s=round(delay, 3))
+                    continue
+                # crash
+                if crash_restarts >= max_restarts:
+                    code = rc
+                    print(f"launch[elastic]: rank {r.rank} crashed "
+                          f"rc={rc}; crash budget "
+                          f"{crash_restarts}/{max_restarts} exhausted — "
+                          f"draining the gang", file=sys.stderr,
+                          flush=True)
+                    _events.emit("rank_restart", scope="gang",
+                                 cause="crash_storm", rank=r.rank, rc=rc)
+                    _drain_group(ranks)
+                    return code
+                crash_restarts += 1
+                delay = min(30.0, backoff_s * (2 ** (crash_restarts - 1)))
+                pending[r.rank] = now + delay
+                print(f"launch[elastic]: rank {r.rank} crashed rc={rc}; "
+                      f"elastic respawn rank {r.rank} "
+                      f"{crash_restarts}/{max_restarts} in {delay:.1f}s "
+                      f"(survivors untouched)", file=sys.stderr,
+                      flush=True)
+                _events.emit("rank_restart", scope="rank", cause="crash",
+                             rank=r.rank, rc=rc, restart=crash_restarts,
+                             max_restarts=max_restarts,
+                             delay_s=round(delay, 3))
+            due = [rk for rk, t in pending.items() if t <= time.time()]
+            for rk in due:
+                del pending[rk]
+                for r in ranks:
+                    if r.rank == rk:
+                        r.spawn()
+            if not pending and all(r.done for r in ranks):
+                break
+            time.sleep(0.1)
+        return PREEMPT_EXIT_CODE if preempt_left and code == 0 else code
+    except KeyboardInterrupt:
+        for r in ranks:
+            if r.proc is not None and r.proc.poll() is None:
+                r.proc.send_signal(signal.SIGTERM)
+        return 1
+    finally:
+        for r in ranks:
+            r.close_out()
 
 
 if __name__ == "__main__":

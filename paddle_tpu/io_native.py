@@ -109,6 +109,24 @@ class NativeDataset:
     def set_filelist(self, files: Sequence[str]):
         self._files = list(files)
 
+    def reassign(self, trainer_id: int, num_trainers: int):
+        """Elastic data-shard reassignment (RESILIENCE.md §Elasticity):
+        point this dataset at a new (trainer_id, num_trainers) after a
+        world-size change. Takes effect at the NEXT epoch — `__iter__`
+        builds a fresh native handle per epoch, so the C++ file-shard
+        split (ptio_set_trainer) re-keys on (epoch, new world size) and
+        every file lands on exactly one trainer of the new world.
+        File-granular by construction; MID-epoch example-exact
+        reassignment is `reader.ElasticShardPlan`'s job (index-level,
+        keyed on epoch + global step + world size)."""
+        trainer_id, num_trainers = int(trainer_id), int(num_trainers)
+        if not 0 <= trainer_id < num_trainers:
+            raise ValueError(
+                f"trainer_id {trainer_id} out of range for "
+                f"{num_trainers} trainers")
+        self._cfg["trainer_id"] = trainer_id
+        self._cfg["num_trainers"] = num_trainers
+
     def _new_handle(self):
         h = self._lib.ptio_create()
         arr = (ctypes.c_int64 * len(self._sizes))(*self._sizes)
@@ -201,6 +219,22 @@ class InMemoryNativeDataset(NativeDataset):
         if self._h is None:
             self._h = self._new_handle()
         return self._h
+
+    def reassign(self, trainer_id: int, num_trainers: int):
+        """In-memory datasets hold their shard in a live native handle
+        built under the OLD world, so reassignment is only legal before
+        `load_into_memory()` (or after `release_memory()`): the next
+        load/global_shuffle then re-keys on the new world."""
+        if self._loaded:
+            raise RuntimeError(
+                "cannot reassign a loaded in-memory dataset — its "
+                "native container was sharded under the old world; "
+                "call release_memory() first, then reload/reshuffle")
+        super().reassign(trainer_id, num_trainers)
+        if self._h is not None:
+            # unloaded handle built with the old trainer split: rebuild
+            self._lib.ptio_destroy(self._h)
+            self._h = None
 
     def load_into_memory(self) -> int:
         """Read this trainer's file shard into native memory; returns the

@@ -42,10 +42,14 @@ __all__ = ["emit", "recent", "clear", "log_path", "read_jsonl",
 # story of a faulty run (RESILIENCE.md): checkpoint restores (incl.
 # corrupt-fallback skips), graceful-stop requests, injected faults,
 # recovery-policy actions, and launcher rank restarts.
+# rendezvous/resize/restore_resharded are the elastic layer's story of a
+# world-size change (RESILIENCE.md §Elasticity): sealed generations,
+# mesh re-formations, and cross-mesh checkpoint restores.
 KINDS = ("compile", "compile_cache", "step_summary", "anomaly",
          "checkpoint", "serve_start", "serve_stop", "restore", "preempt",
          "fault", "recovery", "rank_restart", "pipeline_stall",
-         "warmstart", "amp_overflow", "quantize", "analysis")
+         "warmstart", "amp_overflow", "quantize", "analysis",
+         "rendezvous", "resize", "restore_resharded")
 
 # Ring bound: a week-long run emitting a compile+summary event per minute
 # stays far under this; anomaly storms get truncated to the latest window.

@@ -17,7 +17,7 @@ incubate/fleet/) — on top of jax.sharding:
 
 from .mesh import (  # noqa: F401
     MeshConfig, auto_mesh, current_mesh, get_mesh, make_hybrid_mesh,
-    mesh_guard, make_mesh,
+    mesh_guard, make_mesh, resize_mesh,
 )
 from .sharding import (  # noqa: F401
     LogicalRules, NO_SHARD, in_manual_region, logical_to_mesh, shard,

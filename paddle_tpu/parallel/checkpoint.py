@@ -28,12 +28,32 @@ from .train import TrainState
 # the mismatch list. Pre-manifest checkpoints restore as before.
 DTYPES_FILE = "_DTYPES.json"
 
+# mesh manifest written next to the payload: world size + axis sizes of
+# the mesh the state was sharded over at save time. Restore compares it
+# against the template's mesh to detect a CROSS-WORLD-SIZE restore (a
+# mesh-4 checkpoint onto a mesh-3 job after elastic scale-in) — orbax
+# lays shards out into the template's NamedShardings either way, but
+# the reshard is surfaced as a `restore_resharded` event + elastic
+# resharding metrics, and genuinely incompatible layouts (leaf shapes
+# that differ) are refused with ReshardError before orbax dies with an
+# opaque per-array error. Pre-manifest checkpoints restore as before.
+MESH_FILE = "_MESH.json"
+
 
 class PrecisionMismatchError(ValueError):
     """Checkpoint leaf dtypes disagree with the restore template's —
     e.g. a bf16-policy checkpoint restored into an f32-policy run.
     Re-restore with cast_dtypes=True to convert explicitly, or rebuild
     the template under the checkpoint's policy."""
+
+
+class ReshardError(ValueError):
+    """Checkpoint cannot be resharded onto the restore template: leaf
+    global SHAPES disagree (a different model, layer width, or a
+    world-size-dependent layout), as opposed to the same logical arrays
+    merely sharded over a different mesh — that case reshards fine.
+    Raised by `restore_train_state` / `reshard_train_state` so an
+    elastic resize fails loudly instead of restoring garbage."""
 
 
 def _payload(state: TrainState) -> Dict:
@@ -53,6 +73,26 @@ def _dtype_manifest(tree) -> Dict[str, str]:
         if dt is not None:
             out[jax.tree_util.keystr(path)] = str(dt)
     return out
+
+
+def _tree_mesh(tree):
+    """The Mesh the first NamedSharding-carrying leaf lives on, or
+    None for host-only trees (numpy payload tests)."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        sh = getattr(leaf, "sharding", None)
+        mesh = getattr(sh, "mesh", None)
+        if mesh is not None and getattr(mesh, "devices", None) is not None:
+            return mesh
+    return None
+
+
+def _mesh_manifest(tree) -> Optional[Dict]:
+    mesh = _tree_mesh(tree)
+    if mesh is None:
+        return None
+    return {"world_size": int(mesh.devices.size),
+            "axes": {str(a): int(s)
+                     for a, s in dict(mesh.shape).items()}}
 
 
 def save_train_state(path: str, state: TrainState, force: bool = False):
@@ -76,6 +116,9 @@ def save_train_state(path: str, state: TrainState, force: bool = False):
     from ..resilience.atomic import json_dump
 
     json_dump(_dtype_manifest(payload), os.path.join(path, DTYPES_FILE))
+    mesh_meta = _mesh_manifest(payload)
+    if mesh_meta is not None:
+        json_dump(mesh_meta, os.path.join(path, MESH_FILE))
     _events.emit("checkpoint", site="save_train_state", dir=path,
                  step=int(state.step))
 
@@ -114,6 +157,25 @@ def restore_train_state(path: str, template: TrainState,
     if os.path.exists(manifest_path):
         with open(manifest_path) as f:
             saved_dtypes = json.load(f)
+
+    # cross-world-size detection: a mesh manifest that disagrees with
+    # the template's mesh means this restore is an elastic RESHARD —
+    # refuse incompatible layouts up front, surface the reshard in
+    # events/metrics, and let orbax lay the shards out into the
+    # template's shardings (the actual data movement).
+    saved_mesh: Optional[Dict] = None
+    mesh_path = os.path.join(path, MESH_FILE)
+    if os.path.exists(mesh_path):
+        with open(mesh_path) as f:
+            saved_mesh = json.load(f)
+    tmpl_mesh = _mesh_manifest(target)
+    resharding = (saved_mesh is not None and tmpl_mesh is not None
+                  and saved_mesh != tmpl_mesh)
+    if resharding:
+        _check_reshardable(path, target)
+    import time as _time
+
+    t0 = _time.perf_counter()
 
     # structure guard BEFORE the per-leaf dtype loop (which only sees
     # keys present on both sides): loss-scale presence differing would
@@ -209,8 +271,88 @@ def restore_train_state(path: str, template: TrainState,
         # explicit cross-precision reshard into a mixed template: the
         # checkpoint had no loss-scale state, keep the fresh init
         loss_scale = template.loss_scale
+    if resharding:
+        from ..distributed.rendezvous import RESHARD_SECONDS
+        from ..observability import events as _events
+
+        seconds = _time.perf_counter() - t0
+        RESHARD_SECONDS.observe(seconds)
+        _events.emit("restore_resharded", dir=path,
+                     from_world=saved_mesh["world_size"],
+                     to_world=tmpl_mesh["world_size"],
+                     from_axes=saved_mesh["axes"],
+                     to_axes=tmpl_mesh["axes"],
+                     seconds=round(seconds, 6))
     return TrainState(restored["params"], restored["opt_state"],
                       restored["step"], loss_scale)
+
+
+def _check_reshardable(path: str, target) -> None:
+    """Refusal path for cross-mesh restores: every leaf's GLOBAL shape
+    in the checkpoint must match the template's. Sharding may differ
+    arbitrarily (that's the reshard); shapes may not — a shape mismatch
+    means a different model or a world-size-dependent layout, and orbax
+    would otherwise fail per-array with no layout diagnosis."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        meta = ckptr.metadata(path)
+    bad = []
+    tgt_leaves = {jax.tree_util.keystr(p): l for p, l in
+                  jax.tree_util.tree_flatten_with_path(target)[0]}
+    for p, m in jax.tree_util.tree_flatten_with_path(dict(meta))[0]:
+        key = jax.tree_util.keystr(p)
+        tl = tgt_leaves.get(key)
+        if tl is None or not hasattr(tl, "shape") \
+                or not hasattr(m, "shape"):
+            continue
+        if tuple(m.shape) != tuple(tl.shape):
+            bad.append((key, tuple(m.shape), tuple(tl.shape)))
+    if bad:
+        head = ", ".join(f"{k}: checkpoint {s} vs template {t}"
+                         for k, s, t in bad[:8])
+        raise ReshardError(
+            f"checkpoint at {path} cannot be resharded onto this "
+            f"template: {len(bad)} leaf shape mismatches ({head}"
+            f"{', ...' if len(bad) > 8 else ''}) — resharding moves "
+            f"the SAME logical arrays onto a different mesh; it cannot "
+            f"reconcile different shapes")
+
+
+def reshard_train_state(state: TrainState, template: TrainState) -> TrainState:
+    """In-process cross-mesh reshard: lay every leaf of `state` out on
+    `template`'s shardings (per-leaf `jax.device_put`; a transfer the
+    runtime refuses — e.g. source buffers on devices the new mesh no
+    longer includes — falls back to gather-to-host + re-put). The
+    no-checkpoint-round-trip path for an elastic resize when the state
+    is already in memory; the checkpoint path is `restore_train_state`
+    with a template built on the new mesh. Values are moved, never
+    recomputed — leaves stay bit-identical. Shape disagreements raise
+    ReshardError (same refusal contract as the checkpoint path)."""
+    import numpy as np
+
+    from ..distributed.rendezvous import RESHARD_SECONDS
+    import time as _time
+
+    t0 = _time.perf_counter()
+
+    def move(kpath, leaf, tleaf):
+        sh = getattr(tleaf, "sharding", None)
+        if sh is None:
+            return leaf
+        if hasattr(leaf, "shape") and tuple(leaf.shape) != tuple(tleaf.shape):
+            raise ReshardError(
+                f"cannot reshard leaf {jax.tree_util.keystr(kpath)}: "
+                f"state shape {tuple(leaf.shape)} vs template "
+                f"{tuple(tleaf.shape)}")
+        try:
+            return jax.device_put(leaf, sh)
+        except Exception:  # lint-exempt:swallow: jax raises several types for cross-mesh puts; gather fallback below is the contract
+            return jax.device_put(np.asarray(leaf), sh)
+
+    out = jax.tree_util.tree_map_with_path(move, state, template)
+    RESHARD_SECONDS.observe(_time.perf_counter() - t0)
+    return out
 
 
 def latest_step_dir(root: str, committed_only: bool = False) -> Optional[str]:

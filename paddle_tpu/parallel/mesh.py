@@ -116,6 +116,36 @@ def make_hybrid_mesh(config: Optional[MeshConfig] = None, **axis_sizes) -> Mesh:
     return Mesh(devices, AXIS_ORDER)
 
 
+def resize_mesh(mesh: Mesh, n_devices: int,
+                devices: Optional[Sequence] = None,
+                absorb: str = "dp") -> Mesh:
+    """Re-form `mesh` for a new world size (elastic scale-in/out,
+    ROADMAP item 3): every axis keeps its size except `absorb` (default
+    'dp'), which expands or shrinks to cover `n_devices`. Raises
+    ValueError when the fixed axes cannot divide the new world — e.g.
+    a tp=2 mesh cannot re-form on 3 devices; the elastic driver
+    surfaces that as a refusal instead of building a broken mesh.
+
+    Executables compiled against the old mesh are world-size-keyed
+    (SPMDRunner caches, _JitDispatch signatures, the PR 6 persistent
+    compile cache), so nothing stale can run on the new mesh — callers
+    drop/rebuild their step functions after a resize
+    (`SPMDRunner.resize`, `distributed.elastic.elastic_train_loop`)."""
+    if n_devices < 1:
+        raise ValueError(f"cannot resize mesh to {n_devices} devices")
+    if absorb not in mesh.axis_names:
+        raise ValueError(f"absorb axis {absorb!r} not in {mesh.axis_names}")
+    sizes = {a: (-1 if a == absorb else int(mesh.shape[a]))
+             for a in mesh.axis_names}
+    config = MeshConfig(**{a: sizes.get(a, 1) for a in AXIS_ORDER})
+    devices = list(devices) if devices is not None else jax.devices()
+    if len(devices) < n_devices:
+        raise ValueError(
+            f"resize to {n_devices} devices but only {len(devices)} "
+            f"are available")
+    return make_mesh(config, devices=devices[:n_devices])
+
+
 def auto_mesh(n_devices: Optional[int] = None, model_parallel: int = 1) -> Mesh:
     """Data-parallel mesh with optional inner tensor-parallel axis —
     the default the reference's ParallelExecutor gives you."""

@@ -35,6 +35,33 @@ from ..core.executor import (RNG_STATE_VAR, Scope, _as_fetch_name,
 from ..core.framework import Program
 
 
+def _repatriate(v, mesh, mesh_devs):
+    """Move a value committed to devices OUTSIDE `mesh` back under it.
+    After an elastic resize (SPMDRunner.resize), persistable state and
+    the rng var in the scope were written by the old-mesh executable
+    and live on the old device set — dispatching them into the new
+    mesh's shard_map would fail with an incompatible-devices error.
+    Replicated re-placement is correct here because SPMD state vars and
+    the rng are replicated by construction (in_specs P()).
+
+    Only values carrying a NamedSharding on a DIFFERENT mesh move:
+    single-device/default placements were always accepted by jit (the
+    pre-elastic behavior, kept untouched and transfer-free), while an
+    old-mesh NamedSharding fails jit's committed-device consistency
+    check in BOTH directions — scale-in (old set ⊃ new) and scale-out
+    (old set ⊂ new) alike, hence mesh equality, not subset. `mesh_devs`
+    is the mesh's frozenset of devices, precomputed by the caller; the
+    `sharding.mesh is mesh` fast path is the common case (state written
+    back by THIS mesh's executable) and runs per state var per step."""
+    sharding = getattr(v, "sharding", None)
+    if not isinstance(sharding, NamedSharding):
+        return v
+    if sharding.mesh is mesh or \
+            frozenset(sharding.mesh.devices.flat) == mesh_devs:
+        return v
+    return jax.device_put(v, NamedSharding(mesh, P()))
+
+
 def _shard_map(f, mesh, in_specs, out_specs, axis_names, check_vma):
     """jax.shard_map with a fallback to the pre-0.5 experimental API
     (jax 0.4.x ships it as jax.experimental.shard_map without the
@@ -64,6 +91,20 @@ class SPMDRunner:
         self.axis = axis
         self.reduce = reduce
         self._cache: Dict[Any, Any] = {}
+        self._mesh_devs = frozenset(mesh.devices.flat)
+
+    def resize(self, mesh: Mesh) -> "SPMDRunner":
+        """Point the runner at a re-formed mesh (elastic scale-in/out).
+        Compiled steps capture the mesh at build time, so the step
+        cache is dropped whenever the mesh object changes; returning to
+        a PREVIOUS world size re-pays only compile-cache I/O, not a
+        fresh XLA compile (PR 6's persistent cache keys on the lowered
+        module, which embeds the mesh shape)."""
+        if mesh is not self.mesh:
+            self.mesh = mesh
+            self._mesh_devs = frozenset(mesh.devices.flat)
+            self._cache.clear()
+        return self
 
     def run(self, executor, feed=None, fetch_list=None, scope: Optional[Scope] = None,
             return_numpy: bool = True, sync: bool = True):
@@ -85,7 +126,8 @@ class SPMDRunner:
             step = self._build(tuple(norm_feed), fetch_names, policy)
             self._cache[key] = step
 
-        rng = executor._get_rng(scope, program)
+        rng = _repatriate(executor._get_rng(scope, program), self.mesh,
+                          self._mesh_devs)
         with _tracing.span("spmd.step", cat="step", axis=self.axis):
             fetches, new_states, new_rng = step(scope, norm_feed, rng)
         for n, v in new_states.items():
@@ -192,6 +234,10 @@ class SPMDRunner:
                                         mesh_device_kind(self.mesh)},
                               policy=policy.name)
 
+        mesh = self.mesh  # pinned: resize() clears the cache, so a step
+        # never outlives the mesh it was built for
+        mesh_devs = self._mesh_devs
+
         def step(scope: Scope, feed, rng):
             def _state(n):
                 v = scope.find_var(n)
@@ -199,7 +245,7 @@ class SPMDRunner:
                     raise RuntimeError(
                         f"variable '{n}' missing from scope — run the "
                         f"startup program first")
-                return v
+                return _repatriate(v, mesh, mesh_devs)
 
             const_states = {n: _state(n) for n in const_reads}
             mut_states = {n: _state(n) for n in mut_reads}

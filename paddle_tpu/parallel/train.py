@@ -401,7 +401,8 @@ def sync_loss_scale_metrics(state: TrainState,
 def train_loop(step_fn, state: TrainState, batches, *, rng=None,
                manager=None, save_every: Optional[int] = None,
                controller=None, max_steps: Optional[int] = None,
-               fetch_window: Optional[int] = None):
+               fetch_window: Optional[int] = None,
+               resize_check: Optional[Callable[[], bool]] = None):
     """Fault-tolerance-aware driver for a `make_train_step` step_fn.
 
     The step boundary is the only safe interruption point (no donated
@@ -432,7 +433,17 @@ def train_loop(step_fn, state: TrainState, batches, *, rng=None,
     Per-step randomness is `jax.random.fold_in(rng, step)` for the same
     reason. Returns (state, losses, stop) where `losses` maps executed
     step number -> float loss and `stop` is
-    "completed" | "preempted" | "exhausted".
+    "completed" | "preempted" | "exhausted" | "resize".
+
+    `resize_check` is the elastic-membership hook
+    (distributed.elastic): it is consulted immediately AFTER each
+    periodic checkpoint commits — the only boundary where every
+    surviving worker has identical durable state — and a True return
+    stops the loop with stop="resize" so the driver can re-rendezvous,
+    re-form the mesh for the new world size, and reshard the
+    just-committed checkpoint onto it. It requires `manager` +
+    `save_every`; without periodic checkpoints there is no safe
+    boundary to re-form at.
 
     Loss fetching is ASYNC by default: `float(loss)` every step is a
     full host round trip that serializes the device on the host loop,
@@ -456,6 +467,11 @@ def train_loop(step_fn, state: TrainState, batches, *, rng=None,
     from ..resilience import preemption as _preempt
 
     _preempt.maybe_install_from_env()
+    if resize_check is not None and (manager is None or not save_every):
+        raise ValueError(
+            "resize_check requires manager + save_every — without "
+            "periodic checkpoints there is no boundary at which it is "
+            "ever consulted")
     if controller is not None:
         controller.attach()
     if rng is None:
@@ -551,6 +567,13 @@ def train_loop(step_fn, state: TrainState, batches, *, rng=None,
             if (manager is not None and save_every
                     and completed % save_every == 0):
                 manager.save(state)
+                if resize_check is not None and resize_check():
+                    # elastic membership changed: the checkpoint just
+                    # committed IS the re-rendezvous boundary — hand
+                    # control back so the driver can re-form the mesh
+                    # and reshard (distributed.elastic)
+                    stop = "resize"
+                    break
     finally:
         while pending:  # drain: every executed step's loss lands
             _resolve_oldest()

@@ -23,12 +23,110 @@ from .core.async_exec import (DevicePrefetcher, Prefetcher,
                               device_prefetch_wanted)
 from .core.framework import Variable
 
-__all__ = ["DataLoader", "PyReader", "GeneratorLoader"]
+__all__ = ["DataLoader", "PyReader", "GeneratorLoader",
+           "ElasticShardPlan", "elastic_epoch_permutation"]
 
 # reuse the reference's decorator library semantics
 from .reader_decorators import (  # noqa: F401,E402
     batch, buffered, cache, chain, compose, firstn, map_readers,
     multiprocess_reader, shuffle, xmap_readers)
+
+
+# ---------------------------------------------------------------------------
+# Elastic data sharding (RESILIENCE.md §Elasticity)
+# ---------------------------------------------------------------------------
+
+
+def elastic_epoch_permutation(n_examples: int, epoch: int,
+                              seed: int = 0) -> np.ndarray:
+    """Per-epoch example shuffle that is WORLD-SIZE-INDEPENDENT: the
+    permutation is keyed on (seed, epoch) only, so every worker — and a
+    worker that joins mid-epoch — derives the identical global order.
+    That independence is what lets a membership change re-split the
+    stream without moving, losing, or double-seeing any example."""
+    rs = np.random.RandomState(
+        (int(seed) * 1_000_003 + int(epoch) * 7_919 + 1) & 0x7FFFFFFF)
+    return rs.permutation(int(n_examples))
+
+
+class ElasticShardPlan:
+    """Deterministic assignment of the global example stream to workers,
+    keyed on (epoch, global step, world size) — nothing else.
+
+    The global stream is consumed `global_batch` examples per global
+    step: step s covers epoch positions [p, p + global_batch) where
+    p = (s % steps_per_epoch) * global_batch, mapped through the
+    world-size-independent `elastic_epoch_permutation` for that epoch
+    (trailing examples that don't fill a batch are dropped, the
+    reference's drop_last semantics). Within a step the batch is split
+    contiguously across the `world_size` workers in rank order, rank
+    r taking `global_batch // W` examples (+1 for the first
+    `global_batch % W` ranks).
+
+    Invariant (the elastic contract): for EVERY world size W,
+    `⋃_r worker_indices(s, r, W) == batch_indices(s)` — exactly, in
+    order. A membership change between steps therefore re-splits the
+    stream with no example lost or double-seen, and the concatenated
+    global batch is bit-identical to the fixed-membership run, which is
+    what makes the loss trajectory comparable across resizes
+    (tools/chaos_bench.py --elastic proves it end to end).
+    """
+
+    def __init__(self, n_examples: int, global_batch: int, *,
+                 seed: int = 0, shuffle_each_epoch: bool = True):
+        if global_batch < 1 or n_examples < global_batch:
+            raise ValueError(
+                f"need n_examples >= global_batch >= 1, got "
+                f"{n_examples} / {global_batch}")
+        self.n_examples = int(n_examples)
+        self.global_batch = int(global_batch)
+        self.seed = int(seed)
+        self.shuffle_each_epoch = bool(shuffle_each_epoch)
+        self.steps_per_epoch = self.n_examples // self.global_batch
+        self._perm_cache = {}
+        # identity order shared across epochs — built once, not one
+        # fresh n_examples-long arange per step on the hot data path
+        self._identity = None if shuffle_each_epoch \
+            else np.arange(self.n_examples)
+
+    def epoch_of(self, step: int) -> int:
+        return int(step) // self.steps_per_epoch
+
+    def _perm(self, epoch: int) -> np.ndarray:
+        if not self.shuffle_each_epoch:
+            return self._identity
+        if epoch not in self._perm_cache:
+            # tiny cache: an elastic resize replays at most the current
+            # and neighbouring epochs
+            if len(self._perm_cache) > 4:
+                self._perm_cache.clear()
+            self._perm_cache[epoch] = elastic_epoch_permutation(
+                self.n_examples, epoch, self.seed)
+        return self._perm_cache[epoch]
+
+    def batch_indices(self, step: int) -> np.ndarray:
+        """Global example indices consumed at `step` — identical for
+        every world size by construction."""
+        step = int(step)
+        pos = (step % self.steps_per_epoch) * self.global_batch
+        return self._perm(self.epoch_of(step))[pos:pos + self.global_batch]
+
+    def worker_counts(self, world_size: int) -> List[int]:
+        if world_size < 1:
+            raise ValueError("world_size must be >= 1")
+        q, rem = divmod(self.global_batch, int(world_size))
+        return [q + (1 if r < rem else 0) for r in range(int(world_size))]
+
+    def worker_indices(self, step: int, rank: int,
+                       world_size: int) -> np.ndarray:
+        """`rank`'s slice of the step's global batch under `world_size`
+        live workers: the contiguous split of batch_indices(step)."""
+        counts = self.worker_counts(world_size)
+        if not 0 <= int(rank) < len(counts):
+            raise ValueError(f"rank {rank} out of range for world "
+                             f"{world_size}")
+        start = sum(counts[:int(rank)])
+        return self.batch_indices(step)[start:start + counts[int(rank)]]
 
 
 class GeneratorLoader:

@@ -88,10 +88,10 @@ def _default_save(path: str, state) -> None:
     save_train_state(path, state)
 
 
-def _default_restore(path: str, template):
+def _default_restore(path: str, template, **kwargs):
     from ..parallel.checkpoint import restore_train_state
 
-    return restore_train_state(path, template)
+    return restore_train_state(path, template, **kwargs)
 
 
 class CheckpointManager:
@@ -248,9 +248,17 @@ class CheckpointManager:
 
     # -- restore ------------------------------------------------------------
 
-    def restore_latest(self, template):
+    def restore_latest(self, template, **restore_kwargs):
         """Restore the newest *complete* checkpoint into `template`'s
-        structure/shardings. Skips uncommitted directories outright;
+        structure/shardings. Extra keyword arguments are forwarded to
+        the restore_fn (the orbax default accepts `cast_dtypes=True`
+        for explicit cross-precision resharding); note that a template
+        built on a DIFFERENT mesh than the checkpoint's is itself the
+        elastic cross-world-size reshard path — the restore lands on
+        the template's shardings, emits a `restore_resharded` event and
+        ticks paddle_tpu_elastic_resharding_seconds, and refuses
+        incompatible layouts with parallel.checkpoint.ReshardError.
+        Skips uncommitted directories outright;
         a committed-but-unreadable (corrupt) checkpoint is skipped with
         a `restore` event and the next older one is tried. Returns the
         restored state, or None when no committed checkpoint exists.
@@ -280,7 +288,7 @@ class CheckpointManager:
 
             def attempt():
                 _faults.check("restore", step=step)
-                return self._restore_fn(d, template)
+                return self._restore_fn(d, template, **restore_kwargs)
 
             try:
                 state = retry_io(attempt, site="checkpoint_restore",
@@ -288,6 +296,15 @@ class CheckpointManager:
             except Exception as e:  # noqa: BLE001 — any persistent
                 # failure means "this checkpoint is unusable"; the whole
                 # point of fallback is surviving unforeseen corruption
+                from ..parallel.checkpoint import (PrecisionMismatchError,
+                                                   ReshardError)
+
+                if isinstance(e, (PrecisionMismatchError, ReshardError)):
+                    # template-side contract errors, not data corruption:
+                    # every older checkpoint would refuse identically, so
+                    # falling back would burn the whole root and then
+                    # mislabel the failure as corruption
+                    raise
                 RESTORES.inc(outcome="corrupt")
                 _events.emit("restore", dir=d, step=step, ok=False,
                              reason="corrupt",

@@ -1,0 +1,609 @@
+"""Elastic membership tests (RESILIENCE.md §Elasticity).
+
+Ladder, mirroring the subsystem's layers:
+  1. FileRendezvous protocol units — generations, heartbeats, stale
+     pruning, timeouts (pure file store, no jax).
+  2. ElasticShardPlan — the no-example-lost-or-double-seen invariant
+     across every world size and mid-run resizes.
+  3. Mesh re-formation — resize_mesh, SPMDRunner.resize, in-process
+     TrainState resharding + refusal.
+  4. train_loop resize boundary + elastic_train_loop end to end
+     (membership change mid-run re-forms the mesh, restore path
+     reshards the checkpoint).
+  5. Elastic launcher supervision (subprocess) — a single preempt or
+     crash respawns ONLY that slot; storms still drain.
+  6. (slow) the chaos_bench --elastic scenario: kill one member of
+     four, re-form on 3, scale back to 4, loss trajectory equivalent.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.rendezvous import (FileRendezvous,
+                                               RendezvousTimeout)
+from paddle_tpu.observability import events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# 1. Rendezvous protocol
+# ---------------------------------------------------------------------------
+
+
+def _rdzv(root, wid, **kw):
+    kw.setdefault("settle_s", 0.05)
+    kw.setdefault("heartbeat_s", 0.05)
+    kw.setdefault("dead_after_s", 0.4)
+    kw.setdefault("timeout_s", 10.0)
+    return FileRendezvous(str(root), wid, **kw)
+
+
+def test_single_worker_seals_generation_one(tmp_path):
+    a = _rdzv(tmp_path, "a")
+    info = a.rendezvous()
+    assert (info.generation, info.rank, info.world_size) == (1, 0, 1)
+    assert info.members == ("a",)
+    ev = [e for e in events.recent(kind="rendezvous")
+          if e.get("action") == "sealed"]
+    assert ev and ev[-1]["generation"] == 1
+
+
+def _rendezvous_in_thread(rdzv, reason="start"):
+    """The join barrier makes rendezvous() block until every member
+    adopts the generation, so a joiner and an incumbent must run
+    concurrently — exactly the real deployment shape."""
+    import threading
+
+    box = {}
+
+    def run():
+        try:
+            box["info"] = rdzv.rendezvous(reason=reason)
+        except Exception as e:  # pragma: no cover - surfaced by caller
+            box["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, box
+
+
+def test_join_and_leave_bump_generations(tmp_path):
+    a = _rdzv(tmp_path, "a")
+    ia = a.rendezvous()
+    a.start_heartbeat()
+    try:
+        # b joins: BLOCKS on the join barrier until a adopts the new
+        # generation too — run b in a thread, then a re-rendezvouses
+        b = _rdzv(tmp_path, "b")
+        t, box = _rendezvous_in_thread(b)
+        deadline = time.time() + 8
+        while not a.membership_changed(ia) and time.time() < deadline:
+            time.sleep(0.02)
+        ia2 = a.rendezvous(reason="membership_change")
+        t.join(timeout=8)
+        assert "info" in box, box.get("error")
+        ib = box["info"]
+        assert ib.generation > ia.generation
+        assert ib.members == ("a", "b") and ib.rank == 1
+        assert ia2.generation == ib.generation and ia2.rank == 0
+        b.leave()
+        assert a.membership_changed(ia2)
+        ia3 = a.rendezvous(reason="membership_change")
+        assert ia3.world_size == 1 and ia3.generation > ia2.generation
+    finally:
+        a.stop_heartbeat()
+
+
+def test_join_barrier_blocks_until_incumbent_adopts(tmp_path):
+    """A sealed generation is not joined until every member acks it:
+    the joiner must NOT proceed (and restore a stale checkpoint) while
+    the incumbent is still training the old generation."""
+    a = _rdzv(tmp_path, "a")
+    ia = a.rendezvous()
+    a.start_heartbeat()
+    try:
+        b = _rdzv(tmp_path, "b")
+        t, box = _rendezvous_in_thread(b)
+        time.sleep(0.6)  # well past seal+settle time
+        assert "info" not in box  # still barriered on a's adoption
+        a.rendezvous(reason="membership_change")  # incumbent boundary
+        t.join(timeout=8)
+        assert box["info"].members == ("a", "b")
+    finally:
+        a.stop_heartbeat()
+
+
+def test_stale_heartbeat_counts_as_lost_worker(tmp_path):
+    from paddle_tpu.resilience.atomic import json_dump
+
+    a = _rdzv(tmp_path, "a")
+    # a "dead" member: registered long ago, heartbeat never refreshed
+    json_dump({"worker_id": "zombie", "pid": 0,
+               "heartbeat_ts": time.time() - 60.0},
+              os.path.join(str(tmp_path), "members", "zombie.json"))
+    info = a.rendezvous()
+    assert info.members == ("a",)  # zombie excluded and pruned
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), "members", "zombie.json"))
+
+
+def test_rendezvous_times_out_below_min_workers(tmp_path):
+    a = _rdzv(tmp_path, "a", min_workers=2, timeout_s=0.5)
+    with pytest.raises(RendezvousTimeout):
+        a.rendezvous()
+    ev = [e for e in events.recent(kind="rendezvous")
+          if e.get("action") == "timeout"]
+    assert ev
+
+
+def test_max_workers_over_quota_joiner_neither_churns_nor_evicts(tmp_path):
+    a = _rdzv(tmp_path, "a", max_workers=2)
+    a.rendezvous()
+    a.start_heartbeat()
+    b = _rdzv(tmp_path, "b", max_workers=2)
+    tb, boxb = _rendezvous_in_thread(b)
+    deadline = time.time() + 8
+    while not a.membership_changed(a.current()) and \
+            time.time() < deadline:
+        time.sleep(0.02)
+    ia = a.rendezvous(reason="membership_change")
+    tb.join(timeout=8)
+    assert set(ia.members) == {"a", "b"}
+    b.start_heartbeat()
+    try:
+        # an over-quota joiner whose id sorts FIRST: must neither evict
+        # an incumbent nor make boundaries churn with spurious resizes
+        extra = _rdzv(tmp_path, "0-early", max_workers=2, timeout_s=0.5)
+        extra.register()
+        assert not a.membership_changed(ia)
+        assert not b.membership_changed(boxb["info"])
+        with pytest.raises(RendezvousTimeout):
+            extra.rendezvous()  # waits for a slot, never steals one
+        # a slot frees -> the waiter's membership is next
+        b.leave()
+        extra2 = _rdzv(tmp_path, "0-early", max_workers=2, timeout_s=10)
+        te, boxe = _rendezvous_in_thread(extra2)
+        deadline = time.time() + 8
+        while not a.membership_changed(ia) and time.time() < deadline:
+            time.sleep(0.02)
+        a.rendezvous(reason="membership_change")
+        te.join(timeout=8)
+        assert set(boxe["info"].members) == {"0-early", "a"}
+    finally:
+        a.stop_heartbeat()
+        b.stop_heartbeat()
+
+
+def test_heartbeat_thread_keeps_membership_fresh(tmp_path):
+    a = _rdzv(tmp_path, "a", dead_after_s=0.3)
+    a.register()
+    a.start_heartbeat()
+    try:
+        time.sleep(0.6)  # > dead_after: only the thread keeps us alive
+        assert a.live_members() == ["a"]
+    finally:
+        a.stop_heartbeat()
+
+
+def test_from_env_contract(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_RDZV_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "3")
+    monkeypatch.setenv("PADDLE_TPU_MIN_WORKERS", "2")
+    r = FileRendezvous.from_env(timeout_s=1.0)
+    assert r.worker_id == "rank-3" and r.min_workers == 2
+    monkeypatch.delenv("PADDLE_TPU_RDZV_DIR")
+    from paddle_tpu.distributed.rendezvous import RendezvousError
+
+    with pytest.raises(RendezvousError):
+        FileRendezvous.from_env()
+
+
+# ---------------------------------------------------------------------------
+# 2. Elastic data-shard plan
+# ---------------------------------------------------------------------------
+
+
+def test_shard_plan_union_is_exact_for_every_world_size():
+    from paddle_tpu.reader import ElasticShardPlan
+
+    plan = ElasticShardPlan(60, 12, seed=3)
+    for step in range(10):  # spans 2 epochs (5 steps each)
+        ref = plan.batch_indices(step)
+        assert len(ref) == 12
+        for world in (1, 2, 3, 4, 5, 12):
+            got = np.concatenate([plan.worker_indices(step, r, world)
+                                  for r in range(world)])
+            np.testing.assert_array_equal(ref, got)
+            counts = plan.worker_counts(world)
+            assert sum(counts) == 12 and max(counts) - min(counts) <= 1
+
+
+def test_shard_plan_resize_mid_run_loses_nothing():
+    """The acceptance invariant: consume steps under a CHANGING world
+    (4 -> 3 -> 4); the union of every worker's slices must be exactly
+    the global stream, each example once."""
+    from paddle_tpu.reader import ElasticShardPlan
+
+    plan = ElasticShardPlan(96, 12, seed=0)
+    world_at = lambda s: 4 if s < 3 else (3 if s < 6 else 4)
+    consumed = []
+    for step in range(8):
+        w = world_at(step)
+        for r in range(w):
+            consumed.extend(int(i) for i in plan.worker_indices(step, r, w))
+    expected = []
+    for step in range(8):
+        expected.extend(int(i) for i in plan.batch_indices(step))
+    assert sorted(consumed) == sorted(expected)
+    assert len(set(consumed)) == len(consumed)  # no double-seen
+
+
+def test_epoch_permutation_is_world_independent_and_epoch_keyed():
+    from paddle_tpu.reader import elastic_epoch_permutation
+
+    p0 = elastic_epoch_permutation(32, epoch=0, seed=1)
+    np.testing.assert_array_equal(
+        p0, elastic_epoch_permutation(32, epoch=0, seed=1))
+    assert not np.array_equal(
+        p0, elastic_epoch_permutation(32, epoch=1, seed=1))
+    assert sorted(p0) == list(range(32))
+
+
+def test_native_dataset_reassign_rekeys_next_epoch(tmp_path):
+    from paddle_tpu.io_native import NativeDataset
+
+    files = []
+    for t in range(2):
+        p = tmp_path / f"part-{t}.txt"
+        p.write_text("".join(f"{v} {v}\n" for v in
+                             (t * 10 + i for i in range(4))))
+        files.append(str(p))
+    ds = NativeDataset([("a", (1,)), ("b", (1,))], batch_size=2,
+                       trainer_id=0, num_trainers=1)
+    ds.set_filelist(files)
+    n_all = sum(b["a"].shape[0] for b in ds)
+    assert n_all == 8  # world 1: every record
+    ds.reassign(1, 2)  # elastic scale-out: this trainer is now rank 1/2
+    n_half = sum(b["a"].shape[0] for b in ds)
+    assert n_half == 4  # next epoch reads only this trainer's file shard
+    with pytest.raises(ValueError):
+        ds.reassign(2, 2)
+
+
+# ---------------------------------------------------------------------------
+# 3. Mesh re-formation + state resharding
+# ---------------------------------------------------------------------------
+
+
+def _tiny_setup(n_devices):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from paddle_tpu.models.common import ParamStore, dense
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.mesh import MeshConfig
+    from paddle_tpu.parallel.train import make_train_step
+
+    def make_params():
+        s = ParamStore(jax.random.key(0))
+        s.dense("fc", 8, 4)
+        return s.params
+
+    store = ParamStore(jax.random.key(0))
+    store.dense("fc", 8, 4)
+
+    def loss_fn(params, batch, rng):
+        out = dense(params, "fc", batch["x"]).astype(jnp.float32)
+        return jnp.mean((out - batch["y"]) ** 2)
+
+    mesh = make_mesh(MeshConfig(dp=-1),
+                     devices=jax.devices()[:n_devices])
+    init_state, step_fn = make_train_step(
+        loss_fn, optax.adam(1e-2), mesh, store.axes)
+    return mesh, make_params, init_state, step_fn
+
+
+def test_resize_mesh_keeps_fixed_axes_and_refuses_indivisible():
+    import jax
+
+    from paddle_tpu.parallel.mesh import (MeshConfig, make_mesh,
+                                          resize_mesh)
+
+    m4 = make_mesh(MeshConfig(dp=-1, tp=2), devices=jax.devices()[:4])
+    m2 = resize_mesh(m4, 2)
+    assert dict(m2.shape)["tp"] == 2 and dict(m2.shape)["dp"] == 1
+    assert m2.devices.size == 2
+    with pytest.raises(ValueError):
+        resize_mesh(m4, 3)  # tp=2 cannot divide 3 devices
+    with pytest.raises(ValueError):
+        resize_mesh(m4, 0)
+
+
+def test_spmd_runner_resize_drops_world_keyed_cache():
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.parallel import SPMDRunner, make_mesh, MeshConfig
+    from paddle_tpu.parallel.mesh import resize_mesh
+
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[4], dtype="float32")
+        y = pt.layers.fc(input=x, size=2)
+        loss = pt.layers.mean(y)
+    exe = pt.Executor(pt.CPUPlace())
+    mesh4 = make_mesh(MeshConfig(dp=4), devices=jax.devices()[:4])
+    runner = SPMDRunner(main, mesh4)
+    X = np.ones((8, 4), np.float32)
+    with pt.scope_guard(pt.Scope()):
+        exe.run(startup)
+        l4 = runner.run(exe, feed={"x": X}, fetch_list=[loss])[0]
+        assert len(runner._cache) == 1
+        runner.resize(resize_mesh(mesh4, 2))  # scale-in
+        l2 = runner.run(exe, feed={"x": X}, fetch_list=[loss])[0]
+        assert len(runner._cache) == 1  # old world dropped, new built
+        runner.resize(resize_mesh(mesh4, 4))  # scale back OUT: state is
+        # now committed to the 2-device mesh, a proper SUBSET of the new
+        # one — must be repatriated, not dispatched unmoved
+        l4b = runner.run(exe, feed={"x": X}, fetch_list=[loss])[0]
+    np.testing.assert_allclose(np.asarray(l4), np.asarray(l2),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(l4), np.asarray(l4b),
+                               rtol=1e-6)
+
+
+def test_reshard_train_state_moves_bits_and_refuses_shapes():
+    import jax
+
+    from paddle_tpu.parallel import checkpoint as ck
+    from paddle_tpu.parallel.mesh import mesh_guard
+
+    mesh4, make_params, init4, step4 = _tiny_setup(4)
+    with mesh_guard(mesh4):
+        state = init4(make_params())
+        batch = {"x": np.ones((8, 8), np.float32),
+                 "y": np.zeros((8, 4), np.float32)}
+        state, _ = step4(state, batch, jax.random.key(1))
+    mesh2, _, init2, _ = _tiny_setup(2)
+    with mesh_guard(mesh2):
+        template = init2(make_params())
+        moved = ck.reshard_train_state(state, template)
+    assert moved.params["fc.w"].sharding.mesh.devices.size == 2
+    np.testing.assert_array_equal(np.asarray(state.params["fc.w"]),
+                                  np.asarray(moved.params["fc.w"]))
+    # refusal: a template with different leaf shapes
+    bad = jax.tree.map(lambda x: x, template)
+    bad.params = dict(bad.params)
+    bad.params["fc.w"] = np.zeros((8, 6), np.float32)
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    bad.params["fc.w"] = jax.device_put(
+        jnp.zeros((8, 6)), NamedSharding(mesh2, P()))
+    with pytest.raises(ck.ReshardError):
+        ck.reshard_train_state(state, bad)
+
+
+# ---------------------------------------------------------------------------
+# 4. Elastic training loop
+# ---------------------------------------------------------------------------
+
+
+class _NpState:
+    def __init__(self, step, w):
+        self.step = np.int64(step)
+        self.w = w
+
+
+def _np_manager(root):
+    from paddle_tpu.resilience import CheckpointManager
+    from paddle_tpu.resilience.atomic import np_savez
+
+    def save(path, state):
+        os.makedirs(path, exist_ok=True)
+        np_savez(os.path.join(path, "s.npz"), step=state.step, w=state.w)
+
+    def restore(path, template, **kw):
+        z = np.load(os.path.join(path, "s.npz"))
+        return _NpState(int(z["step"]), z["w"])
+
+    return CheckpointManager(str(root), save_fn=save, restore_fn=restore,
+                             retry_base_s=0.01)
+
+
+def test_train_loop_resize_check_stops_at_checkpoint_boundary(tmp_path):
+    from paddle_tpu.parallel.train import train_loop
+
+    def step_fn(state, batch, rng):
+        return _NpState(int(state.step) + 1, state.w), np.float32(0.5)
+
+    def batch_fn(step):
+        return {} if step < 10 else None
+
+    calls = []
+
+    def resize_check():
+        calls.append(True)
+        return len(calls) >= 2  # first boundary: stable; second: change
+
+    mgr = _np_manager(tmp_path)
+    state, losses, stop = train_loop(
+        step_fn, _NpState(0, np.zeros(2)), batch_fn, manager=mgr,
+        save_every=2, resize_check=resize_check)
+    assert stop == "resize"
+    assert int(state.step) == 4  # stopped at the SECOND boundary
+    assert mgr.committed_steps() == [2, 4]  # boundary checkpoint committed
+    assert sorted(losses) == [0, 1, 2, 3]  # drained before returning
+
+
+def test_elastic_train_loop_resizes_on_midrun_join(tmp_path):
+    import jax
+
+    from paddle_tpu.distributed.elastic import elastic_train_loop
+    from paddle_tpu.resilience import CheckpointManager
+
+    _, make_params, _, _ = _tiny_setup(1)
+    import jax.numpy as jnp
+    import optax
+
+    from paddle_tpu.models.common import ParamStore, dense
+    from paddle_tpu.parallel.train import make_train_step
+
+    store = ParamStore(jax.random.key(0))
+    store.dense("fc", 8, 4)
+
+    def loss_fn(params, batch, rng):
+        out = dense(params, "fc", batch["x"]).astype(jnp.float32)
+        return jnp.mean((out - batch["y"]) ** 2)
+
+    def build(mesh):
+        return make_train_step(loss_fn, optax.adam(1e-2), mesh,
+                               store.axes)
+
+    chief = _rdzv(tmp_path / "rdzv", "chief", timeout_s=15.0)
+    joiner = _rdzv(tmp_path / "rdzv", "joiner", timeout_s=15.0)
+    joined = []
+
+    def batch_fn(step):
+        if step >= 8:
+            return None
+        if step >= 4 and not joined:
+            joiner.register()
+            # liveness stub: acks sealed generations from the heartbeat
+            # thread so the chief's join barrier completes
+            joiner.start_heartbeat(auto_ack=True)
+            joined.append(step)
+        k = jax.random.fold_in(jax.random.key(99), step)
+        return {"x": np.asarray(jax.random.normal(k, (8, 8))),
+                "y": np.asarray(jax.random.normal(
+                    jax.random.fold_in(k, 1), (8, 4)))}
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), retry_base_s=0.01)
+    try:
+        state, losses, stop, history = elastic_train_loop(
+            build, make_params, batch_fn, rdzv=chief, manager=mgr,
+            save_every=2, rng=jax.random.key(7))
+    finally:
+        joiner.stop_heartbeat()
+    assert stop == "completed" and sorted(losses) == list(range(8))
+    worlds = [h.world_size for h in history]
+    assert worlds[0] == 1 and 2 in worlds, worlds
+    # the resize restored the boundary checkpoint onto the new mesh
+    resharded = events.recent(kind="restore_resharded")
+    assert any(e["from_world"] == 1 and e["to_world"] == 2
+               for e in resharded)
+    assert int(state.step) == 8
+    # final state actually lives on the 2-device mesh
+    assert state.params["fc.w"].sharding.mesh.devices.size == 2
+
+
+def test_elastic_train_loop_requires_boundaries(tmp_path):
+    from paddle_tpu.distributed.elastic import elastic_train_loop
+
+    with pytest.raises(ValueError):
+        elastic_train_loop(lambda mesh: (None, None), lambda: {},
+                           lambda s: None, rdzv=None, manager=None,
+                           save_every=0)
+
+
+# ---------------------------------------------------------------------------
+# 5. Elastic launcher supervision (subprocess)
+# ---------------------------------------------------------------------------
+
+
+def _run_elastic_launch(tmp_path, script_body, script_args=(), nproc=2,
+                        extra=(), timeout=240):
+    script = tmp_path / "worker.py"
+    script.write_text(script_body)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", str(nproc), "--elastic",
+         "--restart_backoff_s", "0.05",
+         "--rdzv_dir", str(tmp_path / "rdzv"), *extra,
+         str(script), *[str(a) for a in script_args]],
+        env=env, capture_output=True, text=True, timeout=timeout,
+        cwd=REPO)
+
+
+def test_elastic_launch_preempt_respawns_only_that_rank(tmp_path):
+    body = (
+        "import os, sys, time\n"
+        "rank = os.environ['PADDLE_TRAINER_ID']\n"
+        "assert os.environ.get('PADDLE_TPU_ELASTIC') == '1'\n"
+        "assert os.environ.get('PADDLE_TPU_RDZV_DIR')\n"
+        "sentinel = sys.argv[1] + rank\n"
+        "with open(sentinel, 'a') as f:\n"
+        "    f.write(str(os.getpid()) + chr(10))\n"
+        "if rank == '0' and sum(1 for _ in open(sentinel)) == 1:\n"
+        "    sys.exit(75)\n"
+        "time.sleep(0.3)\n")
+    out = _run_elastic_launch(tmp_path, body,
+                              script_args=[tmp_path / "s"],
+                              extra=["--max_restarts", "2"])
+    assert out.returncode == 0, out.stdout + out.stderr
+    launches = [sum(1 for _ in open(tmp_path / f"s{r}"))
+                for r in (0, 1)]
+    assert launches == [2, 1], launches  # rank 1 NEVER respawned
+    assert "elastic respawn rank 0" in out.stderr
+    assert "draining" not in out.stderr
+
+
+def test_elastic_launch_crash_storm_drains_gang(tmp_path):
+    out = _run_elastic_launch(tmp_path, "import sys; sys.exit(3)\n",
+                              extra=["--max_restarts", "1"])
+    assert out.returncode == 3, out.stdout + out.stderr
+    assert "crash budget 1/1 exhausted" in out.stderr
+
+
+def test_elastic_launch_unrespawnable_preempt_propagates_75(tmp_path):
+    out = _run_elastic_launch(tmp_path, "import sys; sys.exit(75)\n",
+                              nproc=1, extra=["--max_restarts", "0"])
+    assert out.returncode == 75, out.stdout + out.stderr
+    assert "slot leaves the job" in out.stderr
+
+
+# ---------------------------------------------------------------------------
+# 6. The chaos elastic scenario (slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_chaos_bench_elastic_smoke():
+    """Acceptance scenario end to end: a 4-member run loses one member
+    mid-training, re-rendezvouses on 3 at the next checkpoint boundary
+    (no process restarts), reshards the mesh-4 checkpoint onto mesh-3,
+    scales back out to 4, and the loss trajectory matches an
+    uninterrupted fixed-world baseline within tolerance."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_bench.py"),
+         "--elastic", "--smoke"],
+        capture_output=True, text=True, timeout=540,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [json.loads(l) for l in proc.stdout.splitlines()
+             if l.startswith("{")]
+    metrics = {l["metric"]: l for l in lines}
+    for name in ("elastic_rendezvous_seconds_p50",
+                 "elastic_resharding_seconds_p50",
+                 "elastic_resize_count",
+                 "elastic_recovered_steps_mean",
+                 "elastic_equivalence_ok"):
+        assert name in metrics, proc.stdout
+    assert metrics["elastic_equivalence_ok"]["value"] == 1.0
+    detail = metrics["elastic_equivalence_ok"]["detail"]
+    assert detail["failures"] == []
+    assert detail["plan_ok"] is True
+    worlds = detail["worlds"]
+    assert 3 in worlds and 4 in worlds[worlds.index(3):], worlds
+    assert metrics["elastic_resharding_seconds_p50"]["value"] > 0

@@ -645,6 +645,154 @@ def test_corrupt_newest_checkpoint_falls_back(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Cross-world-size checkpoint restore (elastic resharding,
+# RESILIENCE.md §Elasticity)
+# ---------------------------------------------------------------------------
+
+
+def _world_setup(n_devices, precision=None):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from paddle_tpu.models.common import ParamStore, dense
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.mesh import MeshConfig
+    from paddle_tpu.parallel.train import make_train_step
+
+    def make_params():
+        s = ParamStore(jax.random.key(0))
+        s.dense("fc", 8, 4)
+        return s.params
+
+    store = ParamStore(jax.random.key(0))
+    store.dense("fc", 8, 4)
+
+    def loss_fn(params, batch, rng):
+        out = dense(params, "fc", batch["x"]).astype(jnp.float32)
+        return jnp.mean((out - batch["y"]) ** 2)
+
+    mesh = make_mesh(MeshConfig(dp=-1),
+                     devices=jax.devices()[:n_devices])
+    init_state, step_fn = make_train_step(
+        loss_fn, optax.adam(1e-2), mesh, store.axes,
+        precision=precision)
+    return mesh, make_params, init_state, step_fn
+
+
+def _tree_equal(a, b):
+    import jax
+
+    for (pa, la), (pb, lb) in zip(
+            jax.tree_util.tree_flatten_with_path(a)[0],
+            jax.tree_util.tree_flatten_with_path(b)[0]):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb),
+                                      err_msg=str(pa))
+
+
+@pytest.mark.parametrize("target_world", [2, 1])
+def test_cross_world_restore_is_bit_identical(tmp_path, target_world):
+    """A mesh-4 checkpoint restored onto a mesh-2/mesh-1 template:
+    values (params, opt state, step) bit-identical after gather, the
+    reshard recorded as a restore_resharded event + elastic metric."""
+    import jax
+
+    mesh4, make_params, init4, step4 = _world_setup(4)
+    state = init4(make_params())
+    batch = {"x": np.ones((8, 8), np.float32),
+             "y": np.zeros((8, 4), np.float32)}
+    state, _ = step4(state, batch, jax.random.key(1))
+    mgr = CheckpointManager(str(tmp_path), retry_base_s=0.01)
+    mgr.save(state, step=1)
+
+    _, _, init_t, _ = _world_setup(target_world)
+    restored = mgr.restore_latest(init_t(make_params()))
+    assert restored.params["fc.w"].sharding.mesh.devices.size \
+        == target_world
+    _tree_equal(state.params, restored.params)
+    _tree_equal(state.opt_state, restored.opt_state)
+    assert int(restored.step) == int(state.step)
+    ev = events.recent(kind="restore_resharded")
+    assert any(e["from_world"] == 4 and e["to_world"] == target_world
+               for e in ev)
+
+
+def test_cross_world_restore_honors_dtype_manifest(tmp_path):
+    """The PR 7 precision rules survive resharding: a mixed_bf16
+    mesh-2 checkpoint restores its loss-scale state bit-identically
+    onto a mesh-1 mixed template, and REFUSES an f32 mesh-1 template
+    (manifest + loss-scale-presence mismatch) unless cast_dtypes."""
+    import jax
+
+    from paddle_tpu.parallel.checkpoint import PrecisionMismatchError
+
+    mesh2, make_params, init_m, step_m = _world_setup(
+        2, precision="mixed_bf16")
+    state = init_m(make_params())
+    batch = {"x": np.ones((8, 8), np.float32),
+             "y": np.zeros((8, 4), np.float32)}
+    state, _ = step_m(state, batch, jax.random.key(1))
+    assert state.loss_scale is not None
+    mgr = CheckpointManager(str(tmp_path), retry_base_s=0.01)
+    mgr.save(state, step=1)
+
+    _, _, init_m1, _ = _world_setup(1, precision="mixed_bf16")
+    restored = mgr.restore_latest(init_m1(make_params()))
+    _tree_equal(state.loss_scale, restored.loss_scale)
+    _tree_equal(state.params, restored.params)
+
+    _, _, init_f32, _ = _world_setup(1)
+    with pytest.raises(PrecisionMismatchError):
+        mgr.restore_latest(init_f32(make_params()))
+    # explicit reshard: saved widths read + cast, checkpoint-side loss
+    # scale dropped per the PR 7 structure rules
+    casted = mgr.restore_latest(init_f32(make_params()),
+                                cast_dtypes=True)
+    assert casted.loss_scale is None
+    assert int(casted.step) == 1
+
+
+def test_cross_world_restore_refuses_incompatible_layout(tmp_path):
+    """The refusal path: same mesh-size change but DIFFERENT leaf
+    shapes (another model width) must raise ReshardError naming the
+    offending leaves, and must NOT be demoted to corrupt-fallback."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from paddle_tpu.models.common import ParamStore, dense
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.checkpoint import ReshardError
+    from paddle_tpu.parallel.mesh import MeshConfig
+    from paddle_tpu.parallel.train import make_train_step
+
+    mesh4, make_params, init4, _ = _world_setup(4)
+    state = init4(make_params())
+    mgr = CheckpointManager(str(tmp_path), retry_base_s=0.01)
+    mgr.save(state, step=1)
+
+    wide = ParamStore(jax.random.key(0))
+    wide.dense("fc", 8, 6)  # 6-wide head: incompatible layout
+
+    def loss_w(params, batch, rng):
+        return jnp.mean(dense(params, "fc", batch["x"]) ** 2)
+
+    mesh2 = make_mesh(MeshConfig(dp=-1),
+                      devices=jax.devices()[:2])
+    init_w, _ = make_train_step(loss_w, optax.adam(1e-2), mesh2,
+                                wide.axes)
+    wp = ParamStore(jax.random.key(0))
+    wp.dense("fc", 8, 6)
+    with pytest.raises(ReshardError, match="fc.w"):
+        mgr.restore_latest(init_w(wp.params))
+    # the checkpoint was NOT demoted: still committed, still restorable
+    assert mgr.committed_steps() == [1]
+    restored = mgr.restore_latest(init4(make_params()))
+    _tree_equal(state.params, restored.params)
+
+
+# ---------------------------------------------------------------------------
 # Launcher: restart budget + preemption exit code (subprocess)
 # ---------------------------------------------------------------------------
 

@@ -20,6 +20,22 @@ Run:  python tools/chaos_bench.py [--steps 24] [--save-every 4]
 --smoke is the tier-1-safe mode the test suite invokes (CPU backend,
 one short cycle) — it validates the whole kill/resume machinery and
 the report schema, not absolute numbers.
+
+Elastic mode (--elastic, RESILIENCE.md §Elasticity): instead of
+kill-the-whole-process cycles, this drives a MEMBERSHIP chaos scenario
+through the rendezvous store: a chief trainer plus world-1 member
+processes rendezvous at world W; mid-training the orchestrator
+SIGKILLs one member (its heartbeat goes stale → the chief re-forms on
+W-1 survivors at the next checkpoint boundary, resharding the mesh-W
+checkpoint onto mesh-(W-1) — NO process restarts), then spawns a
+replacement (scale back out to W). The chief's loss trajectory must
+match an uninterrupted fixed-world baseline within --tol, and the
+report carries rendezvous seconds, resharding seconds, the generation
+history, and the data-shard ledger check (no example lost or
+double-seen across either membership change).
+
+Run:  python tools/chaos_bench.py --elastic [--smoke]
+      [--world 4] [--kill-at 2] [--join-at 8] [--tol 1e-3]
 """
 
 from __future__ import annotations
@@ -48,10 +64,47 @@ def _build_args():
     ap.add_argument("--timeout-s", type=float, default=300.0)
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU run for CI (overrides steps/kills)")
+    # elastic membership chaos (see module docstring)
+    ap.add_argument("--elastic", action="store_true",
+                    help="membership chaos: kill/join members through "
+                    "the rendezvous store instead of killing the "
+                    "training process")
+    ap.add_argument("--world", type=int, default=4,
+                    help="elastic: starting world size")
+    ap.add_argument("--kill-at", type=int, default=4,
+                    help="elastic: SIGKILL one member once the chief "
+                    "reports this step")
+    ap.add_argument("--join-at", type=int, default=12,
+                    help="elastic: spawn a replacement member once the "
+                    "chief reports this step (after the scale-in)")
+    ap.add_argument("--tol", type=float, default=1e-3,
+                    help="elastic: relative per-step loss tolerance "
+                    "vs the fixed-world baseline (cross-world float "
+                    "reduction order differs)")
+    ap.add_argument("--step-delay", type=float, default=0.15,
+                    help="elastic: host-side seconds per step, so "
+                    "membership changes land mid-run deterministically")
     # internal: run one training process instead of orchestrating
     ap.add_argument("--worker", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--ckpt-dir", type=str, default="",
+                    help=argparse.SUPPRESS)
+    # internal elastic roles
+    ap.add_argument("--elastic-worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--member", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--member-id", type=str, default="",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--wait-file", type=str, default="",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--rdzv-dir", type=str, default="",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--progress-file", type=str, default="",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--static-world", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--min-world", type=int, default=2,
                     help=argparse.SUPPRESS)
     return ap.parse_args()
 
@@ -114,6 +167,390 @@ def run_worker(args) -> int:
         "save_seconds": save_s, "restore_seconds": restore_s,
     }), flush=True)
     return PREEMPT_EXIT_CODE if stop == "preempted" else 0
+
+
+# ---------------------------------------------------------------------------
+# Elastic roles
+# ---------------------------------------------------------------------------
+
+# heartbeat cadence shared by every elastic role: a member is declared
+# dead after missing ~4 beats, fast enough that a kill lands within a
+# couple of (step-delayed) training steps
+_HB_S, _DEAD_S = 0.15, 0.6
+
+
+def _example(i):
+    """Global example `i` of the synthetic regression stream —
+    derived from the INDEX alone, so every process (baseline, chief,
+    any world size) sees the identical example for the same index."""
+    import numpy as np
+
+    rs = np.random.RandomState((1_000_003 * (int(i) + 1)) & 0x7FFFFFFF)
+    return (rs.randn(8).astype(np.float32),
+            rs.randn(4).astype(np.float32))
+
+
+def _elastic_model():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from paddle_tpu.models.common import ParamStore, dense
+    from paddle_tpu.parallel.train import make_train_step
+
+    def make_params():
+        # fresh arrays per call: init_state donates its params
+        s = ParamStore(jax.random.key(0))
+        s.dense("fc", 8, 4)
+        return s.params
+
+    store = ParamStore(jax.random.key(0))
+    store.dense("fc", 8, 4)
+    axes = store.axes
+
+    def loss_fn(params, batch, rng):
+        out = dense(params, "fc", batch["x"]).astype(jnp.float32)
+        return jnp.mean((out - batch["y"]) ** 2)
+
+    def build(mesh):
+        return make_train_step(loss_fn, optax.adam(1e-2), mesh, axes)
+
+    return build, make_params
+
+
+def run_member(args) -> int:
+    """A rendezvous member that holds a slot and heartbeats until
+    killed — it models a slice host's liveness, nothing else (the
+    single-host chief owns the actual devices). Deliberately does NOT
+    import jax: members must be cheap to spawn and kill."""
+    import time
+
+    from paddle_tpu.distributed.rendezvous import FileRendezvous
+
+    if args.wait_file:
+        # pre-spawned joiner: interpreter+imports are already paid, so
+        # the orchestrator can release the join with file latency, not
+        # process-startup latency (keeps the smoke scenario's scale-out
+        # inside its step budget)
+        while not os.path.exists(args.wait_file):
+            time.sleep(0.05)
+    rdzv = FileRendezvous(args.rdzv_dir, args.member_id,
+                          heartbeat_s=_HB_S, dead_after_s=_DEAD_S)
+    rdzv.register()
+    print(json.dumps({"member": args.member_id, "pid": os.getpid()}),
+          flush=True)
+    while True:  # until SIGKILLed by the orchestrator
+        time.sleep(_HB_S)
+        rdzv.register()
+        # liveness stubs ack sealed generations so the chief's join
+        # barrier completes (a real training member acks by
+        # participating in rendezvous() itself)
+        rdzv.ack_current()
+
+
+def run_elastic_worker(args) -> int:
+    """The chief trainer: elastic_train_loop over the rendezvous store,
+    global batch split per step across live members by
+    reader.ElasticShardPlan. Also the fixed-world baseline
+    (--static-world N skips the store entirely)."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from paddle_tpu.observability import events
+    from paddle_tpu.parallel import make_mesh
+    from paddle_tpu.parallel.mesh import MeshConfig, mesh_guard
+    from paddle_tpu.parallel.train import train_loop
+    from paddle_tpu.reader import ElasticShardPlan
+    from paddle_tpu.resilience.atomic import json_dump
+
+    build, make_params = _elastic_model()
+    gb = args.batch
+    plan = ElasticShardPlan(n_examples=args.steps * gb, global_batch=gb,
+                            seed=5)
+    consumed = []  # (step, world) ledger for the no-loss/no-dup check
+
+    rdzv = None
+    if not args.static_world:
+        from paddle_tpu.distributed.rendezvous import FileRendezvous
+
+        rdzv = FileRendezvous(args.rdzv_dir, "chief",
+                              min_workers=args.min_world,
+                              heartbeat_s=_HB_S, dead_after_s=_DEAD_S,
+                              settle_s=0.3, timeout_s=60.0)
+
+    def batch_fn(step):
+        if step >= args.steps:
+            return None
+        if args.step_delay:
+            time.sleep(args.step_delay)
+        if rdzv is not None:
+            info = rdzv.current()
+            world = info.world_size if info is not None else 1
+        else:
+            world = args.static_world
+        consumed.append((int(step), int(world)))
+        if args.progress_file:
+            json_dump({"step": int(step), "world": int(world)},
+                      args.progress_file)
+        # assemble the global batch the way the fleet would feed it:
+        # each live member's plan slice, concatenated in rank order
+        idx = np.concatenate([plan.worker_indices(step, r, world)
+                              for r in range(world)])
+        xs, ys = zip(*(_example(i) for i in idx))
+        return {"x": np.stack(xs), "y": np.stack(ys)}
+
+    if args.static_world:
+        mesh = make_mesh(MeshConfig(dp=-1),
+                         devices=jax.devices()[:args.static_world])
+        with mesh_guard(mesh):
+            init_state, step_fn = build(mesh)
+            state, losses, stop = train_loop(
+                step_fn, init_state(make_params()), batch_fn,
+                rng=jax.random.key(7))
+        history = []
+    else:
+        from paddle_tpu.distributed.elastic import elastic_train_loop
+        from paddle_tpu.resilience import CheckpointManager
+
+        mgr = CheckpointManager(args.ckpt_dir, keep_last_n=args.keep_last)
+        state, losses, stop, history = elastic_train_loop(
+            build, make_params, batch_fn, rdzv=rdzv, manager=mgr,
+            save_every=args.save_every, rng=jax.random.key(7))
+
+    # ledger check: with the worlds ACTUALLY used per step, the plan
+    # must have assigned every consumed example exactly once
+    ledger = []
+    for step, world in consumed:
+        if step in losses:  # executed steps only
+            for r in range(world):
+                ledger.extend(int(i) for i in
+                              plan.worker_indices(step, r, world))
+    expected = []
+    for step in sorted(losses):
+        expected.extend(int(i) for i in plan.batch_indices(step))
+    plan_ok = sorted(ledger) == sorted(expected) and \
+        len(set(ledger)) == len(ledger)
+
+    rdzv_s = [e["seconds"] for e in events.recent(n=None, kind="rendezvous")
+              if e.get("action") == "sealed" and "seconds" in e]
+    reshard_s = [e["seconds"] for e in
+                 events.recent(n=None, kind="restore_resharded")]
+    lost = sorted({w for e in events.recent(n=None, kind="rendezvous")
+                   for w in e.get("lost", [])})
+    print(json.dumps({
+        "worker": "elastic", "stop": stop, "pid": os.getpid(),
+        "losses": {str(k): float(v) for k, v in losses.items()},
+        "generations": [{"generation": h.generation,
+                         "world": h.world_size} for h in history],
+        "plan_ok": plan_ok,
+        "rendezvous_seconds": rdzv_s, "resharding_seconds": reshard_s,
+        "lost_members": lost,
+    }), flush=True)
+    return 0 if stop == "completed" else 1
+
+
+def _read_progress(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def run_elastic_bench(args) -> int:
+    """Orchestrate the elastic scenario: world W chief+members, kill one
+    member mid-training (scale-in to W-1 at the next checkpoint
+    boundary, no process restarts), spawn a replacement (scale-out back
+    to W), and compare the chief's full loss trajectory against an
+    uninterrupted fixed-world-W baseline."""
+    import subprocess
+    import time
+
+    work = tempfile.mkdtemp(prefix="chaos_elastic_")
+    rdzv_dir = os.path.join(work, "rdzv")
+    progress = os.path.join(work, "progress.json")
+    os.makedirs(rdzv_dir, exist_ok=True)
+    failures = []
+    members = {}
+
+    def env_for(n_devices):
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.pop("PADDLE_TPU_FAULT_SPEC", None)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{n_devices}").strip()
+        return env
+
+    def spawn_member(mid, wait_file=""):
+        cmd = [sys.executable, os.path.abspath(__file__), "--member",
+               "--member-id", mid, "--rdzv-dir", rdzv_dir]
+        if wait_file:
+            cmd += ["--wait-file", wait_file]
+        p = subprocess.Popen(
+            cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            cwd=_REPO, env=dict(os.environ))
+        members[mid] = p
+        return p
+
+    def wait_for(pred, timeout, what):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if pred():
+                return True
+            time.sleep(0.05)
+        failures.append(f"timeout waiting for {what}")
+        return False
+
+    chief_cmd = [sys.executable, os.path.abspath(__file__),
+                 "--elastic-worker", "--steps", str(args.steps),
+                 "--save-every", str(args.save_every),
+                 "--batch", str(args.batch),
+                 "--keep-last", str(args.keep_last),
+                 "--step-delay", str(args.step_delay),
+                 "--min-world", str(args.min_world)]
+    try:
+        # -- baseline: uninterrupted fixed world W ------------------------
+        base = subprocess.run(
+            chief_cmd + ["--static-world", str(args.world)],
+            capture_output=True, text=True, timeout=args.timeout_s,
+            cwd=_REPO, env=env_for(args.world))
+        base_rep = _elastic_report(base)
+        if base.returncode != 0 or base_rep is None:
+            print(base.stdout + base.stderr, file=sys.stderr)
+            raise SystemExit("chaos_bench --elastic: baseline failed")
+        base_losses = base_rep["losses"]
+
+        # -- elastic run --------------------------------------------------
+        members_dir = os.path.join(rdzv_dir, "members")
+
+        def members_registered():
+            return (os.path.isdir(members_dir)
+                    and len(os.listdir(members_dir)) >= args.world - 1)
+
+        join_gate = os.path.join(work, "join_gate")
+        for i in range(1, args.world):
+            spawn_member(f"m{i}")
+        # the replacement is pre-spawned behind a file gate so the
+        # scale-out lands with file latency, not interpreter startup
+        spawn_member("m-replacement", wait_file=join_gate)
+        if not wait_for(members_registered, 30, "members to register"):
+            raise SystemExit("chaos_bench --elastic: members never joined")
+        chief = subprocess.Popen(
+            chief_cmd + ["--rdzv-dir", rdzv_dir, "--ckpt-dir",
+                         os.path.join(work, "ckpt"),
+                         "--progress-file", progress],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=_REPO, env=env_for(args.world))
+
+        def chief_wait(pred, what):
+            # a dead chief can never satisfy pred — fail fast with its
+            # stderr instead of burning the whole timeout
+            ok = wait_for(lambda: chief.poll() is not None or pred(),
+                          args.timeout_s, what)
+            if chief.poll() is not None and not pred():
+                return False
+            return ok
+
+        victim = f"m{(args.world - 1) // 2 + 1}"
+        alive = chief_wait(lambda: _read_progress(progress).get("step", -1)
+                           >= args.kill_at, "kill step")
+        if alive:
+            members[victim].kill()
+            chief_wait(lambda: _read_progress(progress).get("world")
+                       == args.world - 1, "scale-in")
+            chief_wait(lambda: _read_progress(progress).get("step", -1)
+                       >= args.join_at, "join step")
+            with open(join_gate, "w"):  # atomic-exempt: empty gate file, existence is the signal
+                pass
+            chief_wait(lambda: _read_progress(progress).get("world")
+                       == args.world, "scale-out")
+
+        try:
+            out, err = chief.communicate(timeout=args.timeout_s)
+        except subprocess.TimeoutExpired:
+            chief.kill()
+            out, err = chief.communicate()
+            failures.append("chief timed out")
+        rep = _elastic_report_text(out)
+        if chief.returncode != 0 or rep is None:
+            failures.append(f"chief rc={chief.returncode}: {err[-500:]}")
+            rep = rep or {}
+    finally:
+        for p in members.values():
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(work, ignore_errors=True)
+
+    worlds = [g["world"] for g in rep.get("generations", [])]
+    if rep:
+        if rep.get("stop") != "completed":
+            failures.append(f"chief stop={rep.get('stop')}")
+        if not rep.get("plan_ok"):
+            failures.append("data-shard ledger check failed: an example "
+                            "was lost or double-seen across a resize")
+        # the scenario itself: W -> W-1 (scale-in) -> W (scale-out)
+        if args.world - 1 not in worlds:
+            failures.append(f"never re-formed at world {args.world - 1}: "
+                            f"{worlds}")
+        elif args.world not in worlds[worlds.index(args.world - 1) + 1:]:
+            failures.append(f"never scaled back out to {args.world}: "
+                            f"{worlds}")
+        if not rep.get("resharding_seconds"):
+            failures.append("no restore_resharded event recorded")
+        for step, loss in rep.get("losses", {}).items():
+            ref = base_losses.get(step)
+            if ref is None or abs(loss - ref) > \
+                    args.tol * max(1.0, abs(ref)):
+                failures.append(f"step {step}: elastic loss {loss} vs "
+                                f"baseline {ref} beyond tol {args.tol}")
+                break
+
+    detail = {
+        "steps": args.steps, "save_every": args.save_every,
+        "world": args.world, "kill_at": args.kill_at,
+        "join_at": args.join_at, "worlds": worlds,
+        "generations": rep.get("generations", []),
+        "lost_members": rep.get("lost_members", []),
+        "plan_ok": rep.get("plan_ok"), "tol": args.tol,
+        "failures": failures, "smoke": bool(args.smoke),
+    }
+    for metric, value, unit in (
+            ("elastic_rendezvous_seconds_p50",
+             _percentile(rep.get("rendezvous_seconds", []), 50), "s"),
+            ("elastic_resharding_seconds_p50",
+             _percentile(rep.get("resharding_seconds", []), 50), "s"),
+            ("elastic_resize_count",
+             max(0, len(worlds) - 1) if worlds else None, "resizes"),
+            ("elastic_recovered_steps_mean", 0.0 if rep else None,
+             "steps"),  # the chief never restarts in this scenario
+            ("elastic_equivalence_ok", 0.0 if failures else 1.0, "bool")):
+        print(json.dumps({
+            "metric": metric,
+            "value": round(value, 6) if isinstance(value, float) else value,
+            "unit": unit, "detail": detail}), flush=True)
+    if failures:
+        print("\n".join(failures), file=sys.stderr)
+    return 1 if failures else 0
+
+
+def _elastic_report(proc):
+    return _elastic_report_text(proc.stdout)
+
+
+def _elastic_report_text(text):
+    for line in reversed((text or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rep = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rep.get("worker") == "elastic":
+                return rep
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -226,10 +663,42 @@ def run_bench(args) -> int:
 def main() -> int:
     args = _build_args()
     sys.path.insert(0, _REPO)
+    if args.member:
+        if not (args.member_id and args.rdzv_dir):
+            raise SystemExit("--member needs --member-id and --rdzv-dir")
+        return run_member(args)
+    if args.elastic_worker:
+        return run_elastic_worker(args)
     if args.worker:
         if not args.ckpt_dir:
             raise SystemExit("--worker needs --ckpt-dir")
         return run_worker(args)
+    if args.elastic:
+        if args.world < 3:
+            raise SystemExit(
+                "--elastic needs --world >= 3: the scenario kills one "
+                "member and must keep world-1 at or above quorum")
+        if args.min_world > args.world - 1:
+            raise SystemExit(
+                f"--min-world {args.min_world} would deadlock the "
+                f"scale-in to {args.world - 1}")
+        if args.smoke:
+            # tier-1 safety: tiny CPU scenario, one kill + one join
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            args.steps, args.save_every = 18, 2
+            args.kill_at, args.join_at = 2, 8
+            args.world = min(args.world, 4)
+        else:
+            args.steps = max(args.steps, args.join_at + 8)
+        if args.batch % args.world or args.batch % (args.world - 1):
+            # global batch divisible by both worlds keeps the batch
+            # dp-sharded through the scale-in, not silently replicated
+            args.batch = args.world * (args.world - 1) \
+                * max(1, args.batch // (args.world * (args.world - 1)))
+        from paddle_tpu.core.tpu_lock import tpu_singleflight
+
+        with tpu_singleflight():
+            return run_elastic_bench(args)
     if args.smoke:
         # tier-1 safety: tiny, CPU-only, a single kill/resume cycle
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
