@@ -843,13 +843,16 @@ class Executor:
         # loop, blocking like the reference (listen_and_serv_op.cc)
         ops0 = program.desc.block(0).ops
         if len(ops0) == 1 and ops0[0].type == "listen_and_serv":
-            from ..ps.server import ParameterServer
+            from ..ps.server import ParameterServer, snapshot_config_from_env
 
             a = ops0[0].attrs
             server = ParameterServer(
                 a["endpoint"], int(a["num_trainers"]),
                 mode=a.get("mode", "sync"),
-                dc_asgd_lambda=float(a.get("dc_asgd_lambda", 0.0)))
+                dc_asgd_lambda=float(a.get("dc_asgd_lambda", 0.0)),
+                # PADDLE_TPU_PS_SNAPSHOT_DIR et al: a respawned server
+                # restores its committed tables instead of reinitializing
+                **snapshot_config_from_env(a["endpoint"]))
             server.serve_forever()  # blocks until shutdown request
             return []
 

@@ -7,9 +7,25 @@ TRAINING_ROLE=PSERVER|TRAINER, PADDLE_PSERVERS_IP_PORT_LIST,
 PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM, PS_CURRENT_ENDPOINT (and
 PS_SYNC_MODE for this framework's sync toggle).
 
+Fault tolerance (RESILIENCE.md §Parameter-server fault tolerance): with
+`--ps_supervise`, each pserver slot is supervised individually — the
+PR 9 per-slot pattern applied to the PS tier. A crashed server is
+respawned on the SAME endpoint after a capped exponential backoff while
+its `--ps_max_restarts` budget lasts; `--ps_snapshot_dir` is exported as
+PADDLE_TPU_PS_SNAPSHOT_DIR (+ per-slot PADDLE_TPU_PS_SERVER_INDEX and
+PADDLE_TPU_PS_SNAPSHOT_EVERY_S), so the respawned server restores its
+committed sparse+dense tables at boot instead of reinitializing, and the
+trainers ride through the outage on the resilient client (reconnect +
+retry + circuit breaker) — no trainer restarts. An exhausted server
+budget tears the whole cluster down (trainers cannot make progress
+against a permanently dead shard).
+
 Usage:
     python -m paddle_tpu.distributed.launch_ps \
         --worker_num 2 --server_num 2 train.py ...
+    python -m paddle_tpu.distributed.launch_ps \
+        --worker_num 2 --server_num 2 --ps_supervise \
+        --ps_snapshot_dir /ckpt/ps --ps_snapshot_every_s 30 train.py ...
 """
 
 from __future__ import annotations
@@ -36,9 +52,36 @@ def launch_ps_main(argv=None):
     parser.add_argument("--backend", type=str, default="cpu",
                         help="cpu forces JAX_PLATFORMS=cpu in every proc "
                              "(pservers are host-side either way)")
+    parser.add_argument("--ps_supervise", action="store_true",
+                        help="respawn a crashed pserver slot with capped "
+                             "backoff instead of failing the job "
+                             "(RESILIENCE.md §Parameter-server fault "
+                             "tolerance)")
+    parser.add_argument("--ps_max_restarts", type=int, default=2,
+                        help="per-server-slot crash respawn budget under "
+                             "--ps_supervise")
+    parser.add_argument("--ps_restart_backoff_s", type=float, default=1.0,
+                        help="base of the capped exponential server "
+                             "respawn backoff (base, 2x, ... capped 30s)")
+    parser.add_argument("--ps_snapshot_dir", type=str, default="",
+                        help="export PADDLE_TPU_PS_SNAPSHOT_DIR so each "
+                             "server keeps committed snapshots and a "
+                             "respawn resumes its tables")
+    parser.add_argument("--ps_snapshot_every_s", type=float, default=0.0,
+                        help="periodic server snapshot cadence (0: "
+                             "on-demand snapshot RPCs only)")
     parser.add_argument("training_script")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
+
+    if args.ps_supervise and not args.ps_snapshot_dir:
+        # a respawned server WITHOUT a snapshot dir boots with empty
+        # tables: the trainers' next pull hits "unknown var" — a plain
+        # RuntimeError outside the recovery path, strictly worse than
+        # failing the job outright
+        parser.error("--ps_supervise requires --ps_snapshot_dir: a "
+                     "respawned server must restore its committed "
+                     "tables, not reinitialize empty")
 
     if args.servers:
         endpoints = args.servers.split(",")
@@ -47,59 +90,139 @@ def launch_ps_main(argv=None):
                      for p in _free_ports(args.server_num)]
     ep_list = ",".join(endpoints)
 
-    def spawn(role, idx, endpoint=""):
-        env = dict(os.environ)
-        env.update({
-            "TRAINING_ROLE": role,
-            "PADDLE_PSERVERS_IP_PORT_LIST": ep_list,
-            "PADDLE_TRAINERS_NUM": str(args.worker_num),
-            "PADDLE_TRAINER_ID": str(idx),
-            "PS_SYNC_MODE": str(args.sync_mode),
-            "PS_CURRENT_ENDPOINT": endpoint,
-        })
-        if args.backend == "cpu":
-            env["JAX_PLATFORMS"] = "cpu"
-            env["PADDLE_TPU_FORCE_CPU"] = "1"
-        out = None
-        if args.log_dir:
-            os.makedirs(args.log_dir, exist_ok=True)
-            tag = f"{role.lower()}.{endpoint or idx}".replace(":", "_")
-            out = open(os.path.join(args.log_dir, tag + ".log"), "w")  # atomic-exempt: live log stream
-        cmd = [sys.executable, "-u", args.training_script] + \
-            args.training_script_args
-        return subprocess.Popen(cmd, env=env, stdout=out, stderr=out), out
+    class _Slot:
+        """One process slot (server or trainer), respawnable."""
 
-    procs = []
-    for ep in endpoints:
-        procs.append(spawn("PSERVER", 0, endpoint=ep))
-    for i in range(args.worker_num):
-        procs.append(spawn("TRAINER", i))
+        def __init__(self, role, idx, endpoint=""):
+            self.role, self.idx, self.endpoint = role, idx, endpoint
+            self.proc = None
+            self.out = None
+            self.launches = 0
 
-    # supervise: trainers finishing is success; a nonzero exit anywhere
-    # tears the cluster down (reference launch_ps waits on workers, then
-    # kills servers)
-    trainer_procs = procs[len(endpoints):]
-    server_procs = procs[:len(endpoints)]
+        def env(self):
+            env = dict(os.environ)
+            env.update({
+                "TRAINING_ROLE": self.role,
+                "PADDLE_PSERVERS_IP_PORT_LIST": ep_list,
+                "PADDLE_TRAINERS_NUM": str(args.worker_num),
+                "PADDLE_TRAINER_ID": str(self.idx),
+                "PS_SYNC_MODE": str(args.sync_mode),
+                "PS_CURRENT_ENDPOINT": self.endpoint,
+            })
+            if self.role == "PSERVER" and args.ps_snapshot_dir:
+                env["PADDLE_TPU_PS_SNAPSHOT_DIR"] = args.ps_snapshot_dir
+                env["PADDLE_TPU_PS_SERVER_INDEX"] = str(self.idx)
+                if args.ps_snapshot_every_s:
+                    env["PADDLE_TPU_PS_SNAPSHOT_EVERY_S"] = \
+                        str(args.ps_snapshot_every_s)
+            if args.backend == "cpu":
+                env["JAX_PLATFORMS"] = "cpu"
+                env["PADDLE_TPU_FORCE_CPU"] = "1"
+            return env
+
+        def spawn(self):
+            if args.log_dir:
+                os.makedirs(args.log_dir, exist_ok=True)
+                tag = f"{self.role.lower()}.{self.endpoint or self.idx}" \
+                    .replace(":", "_")
+                # first launch truncates; respawns append so the crash
+                # output that justified the respawn survives
+                mode = "w" if self.launches == 0 else "a"
+                if self.out:
+                    try:
+                        self.out.close()
+                    except OSError:
+                        pass  # lint-exempt:swallow: stale log handle
+                self.out = open(os.path.join(args.log_dir, tag + ".log"),  # atomic-exempt: live log stream
+                                mode)
+            cmd = [sys.executable, "-u", args.training_script] + \
+                args.training_script_args
+            self.proc = subprocess.Popen(cmd, env=self.env(),
+                                         stdout=self.out, stderr=self.out)
+            self.launches += 1
+
+    server_slots = [_Slot("PSERVER", i, endpoint=ep)
+                    for i, ep in enumerate(endpoints)]
+    trainer_slots = [_Slot("TRAINER", i) for i in range(args.worker_num)]
+    for s in server_slots:
+        s.spawn()
+    for s in trainer_slots:
+        s.spawn()
+
     code = 0
+    pending = {}   # server slot idx -> respawn due time
+    respawns = {}  # server slot idx -> respawns used
     try:
-        for p, _ in trainer_procs:
-            rc = p.wait()
-            code = code or rc
+        while True:
+            # trainers: all done cleanly = success; any nonzero = failure
+            trainer_rcs = [s.proc.poll() for s in trainer_slots]
+            bad = [rc for rc in trainer_rcs if rc not in (None, 0)]
+            if bad:
+                code = bad[0]
+                break
+            if all(rc == 0 for rc in trainer_rcs):
+                break
+            # servers: a server exiting while trainers still run is a
+            # crash (clean server exits only happen after shutdown RPCs,
+            # i.e. after the trainers finished)
+            for s in server_slots:
+                if s.proc.poll() is None or s.idx in pending:
+                    continue
+                rc = s.proc.poll()
+                if rc == 0:
+                    # deliberate shutdown (the trainers' shutdown RPC
+                    # lands before the trainer processes themselves
+                    # exit) — never a crash
+                    continue
+                if not args.ps_supervise:
+                    print(f"launch_ps: pserver {s.endpoint} exited rc="
+                          f"{rc} mid-run (no --ps_supervise) — failing "
+                          f"the job", file=sys.stderr, flush=True)
+                    code = rc or 1
+                    raise KeyboardInterrupt  # reuse the teardown path
+                used = respawns.get(s.idx, 0)
+                if used >= args.ps_max_restarts:
+                    print(f"launch_ps: pserver {s.endpoint} crashed rc="
+                          f"{rc}; respawn budget {used}/"
+                          f"{args.ps_max_restarts} exhausted — draining "
+                          f"the cluster", file=sys.stderr, flush=True)
+                    code = rc or 1
+                    raise KeyboardInterrupt
+                delay = min(30.0,
+                            args.ps_restart_backoff_s * (2 ** used))
+                respawns[s.idx] = used + 1
+                pending[s.idx] = time.time() + delay
+                print(f"launch_ps: pserver {s.endpoint} crashed rc={rc}; "
+                      f"respawn {used + 1}/{args.ps_max_restarts} in "
+                      f"{delay:.1f}s (trainers ride through via "
+                      f"retry/buffering)", file=sys.stderr, flush=True)
+                from ..observability import events as _events
+
+                _events.emit("ps_failover", action="respawn",
+                             endpoint=s.endpoint, rc=rc,
+                             respawn=used + 1,
+                             max_restarts=args.ps_max_restarts,
+                             delay_s=round(delay, 3))
+            for idx in [i for i, t in pending.items() if t <= time.time()]:
+                del pending[idx]
+                server_slots[idx].spawn()
+            time.sleep(0.2)
     except KeyboardInterrupt:
-        code = 1
+        code = code or 1
     finally:
-        for p, _ in server_procs + trainer_procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
+        for s in server_slots + trainer_slots:
+            if s.proc is not None and s.proc.poll() is None:
+                s.proc.send_signal(signal.SIGTERM)
         deadline = time.time() + 10
-        for p, _ in server_procs + trainer_procs:
-            while p.poll() is None and time.time() < deadline:
+        for s in server_slots + trainer_slots:
+            while s.proc is not None and s.proc.poll() is None \
+                    and time.time() < deadline:
                 time.sleep(0.1)
-            if p.poll() is None:
-                p.kill()
-        for _, out in procs:
-            if out:
-                out.close()
+            if s.proc is not None and s.proc.poll() is None:
+                s.proc.kill()
+        for s in server_slots + trainer_slots:
+            if s.out:
+                s.out.close()
     return code
 
 

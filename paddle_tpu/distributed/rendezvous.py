@@ -428,6 +428,16 @@ class FileRendezvous:
             missing = set(info.members) - self.acked(info.generation)
             if not missing:
                 return True
+            if self.current_generation() > info.generation:
+                # superseded: a peer (who transiently judged someone
+                # here heartbeat-stale) already sealed a NEWER
+                # generation. Waiting out this one's acks would
+                # cross-generation deadlock — it waits for a member
+                # that is itself blocked in the old barrier — until
+                # both sides burn their full timeout. Bail; the caller
+                # re-loops and adopts the newer generation, whose own
+                # ack barrier preserves the join guarantee.
+                return False
             if missing - set(self.live_members()):
                 return False  # a member died before adopting
             if time.perf_counter() > deadline:

@@ -45,11 +45,15 @@ __all__ = ["emit", "recent", "clear", "log_path", "read_jsonl",
 # rendezvous/resize/restore_resharded are the elastic layer's story of a
 # world-size change (RESILIENCE.md §Elasticity): sealed generations,
 # mesh re-formations, and cross-mesh checkpoint restores.
+# ps_failover is the parameter-server tier's story of an outage
+# (RESILIENCE.md §Parameter-server fault tolerance): breaker
+# transitions, reconnects, snapshot restores at server boot, supervisor
+# respawns, and counted gradient drops.
 KINDS = ("compile", "compile_cache", "step_summary", "anomaly",
          "checkpoint", "serve_start", "serve_stop", "restore", "preempt",
          "fault", "recovery", "rank_restart", "pipeline_stall",
          "warmstart", "amp_overflow", "quantize", "analysis",
-         "rendezvous", "resize", "restore_resharded")
+         "rendezvous", "resize", "restore_resharded", "ps_failover")
 
 # Ring bound: a week-long run emitting a compile+summary event per minute
 # stays far under this; anomaly storms get truncated to the latest window.

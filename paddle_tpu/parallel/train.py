@@ -463,6 +463,7 @@ def train_loop(step_fn, state: TrainState, batches, *, rng=None,
     from ..core import async_exec as _async
     from ..observability import events as _events
     from ..observability import health as _health
+    from ..ps import errors as _ps_errors
     from ..resilience import faults as _faults
     from ..resilience import preemption as _preempt
 
@@ -554,7 +555,15 @@ def train_loop(step_fn, state: TrainState, batches, *, rng=None,
                         # overflow events carry exact step attribution
                         amp_seen = sync_loss_scale_metrics(state,
                                                            amp_seen)
-            except _health.NumericsError as e:
+            except (_health.NumericsError, _ps_errors.PSUnavailableError) \
+                    as e:
+                # PSUnavailableError: a PS pull/push exhausted its
+                # reconnect+retry budget mid-step (the resilient client
+                # already rode out anything shorter). Routed through the
+                # same RecoveryPolicy as a numerics anomaly: skip_batch
+                # retries against the (possibly respawned) server next
+                # step, rollback rewinds past any half-applied pushes,
+                # abort propagates.
                 if controller is None:
                     raise
                 action, state = controller.handle(e, state, step=step_no)

@@ -7,7 +7,12 @@ TPU-native shape of the same capability:
 - server.py   : var store + sync/async/GEO apply loops + heartbeat monitor
                 (listen_and_serv_op.cc RunSyncLoop/RunAsyncLoop,
                  heart_beat_monitor.h)
-- client.py   : trainer-side client incl. the merging AsyncCommunicator
+- client.py   : trainer-side client incl. the merging AsyncCommunicator;
+                reconnect/backoff/deadline + per-server circuit breaker +
+                (cid, seq) idempotent-retry envelope (RESILIENCE.md
+                §Parameter-server fault tolerance)
+- errors.py   : typed PSUnavailableError / PSTimeoutError the training
+                loops and RecoveryPolicy route on
 - transpiler.py: DistributeTranspiler — splits optimize ops onto pservers,
                 rewrites the trainer program with send/recv ops
 - ops (ops/distributed.py): send/recv lower to jax io_callbacks so RPC
@@ -15,5 +20,6 @@ TPU-native shape of the same capability:
 """
 
 from .client import PSClient  # noqa: F401
+from .errors import PSError, PSTimeoutError, PSUnavailableError  # noqa: F401
 from .server import ParameterServer  # noqa: F401
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401

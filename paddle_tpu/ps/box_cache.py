@@ -39,7 +39,8 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-from .client import PSClient
+from ..observability import events as _events
+from .client import GRAD_DROPS, PSClient
 from .sparse_table import pull_rows, push_row_grads
 
 
@@ -71,6 +72,13 @@ class BoxSparseCache:
         self._flushq: "queue.Queue" = queue.Queue(maxsize=flush_queue_size)
         self._stop = threading.Event()
         self._flusher: Optional[threading.Thread] = None
+        # flusher health: an RPC failure drops that batch (counted in
+        # paddle_tpu_ps_grad_drops_total + a ps_failover event, never
+        # silent); anything ELSE kills the flusher and is re-raised to
+        # the owner at the next end_pass()/close() — a background thread
+        # must not die with the error only on stderr
+        self._flusher_exc: Optional[BaseException] = None
+        self.flush_drops = 0    # rows whose flush RPC failed
         self.hits = 0
         self.misses = 0
 
@@ -97,7 +105,10 @@ class BoxSparseCache:
             self._fetch_dirty.clear()
 
     def end_pass(self):
-        """Drain pending gradient flushes synchronously."""
+        """Drain pending gradient flushes synchronously — and surface a
+        dead flusher: if the background thread died on an unexpected
+        exception since the last pass boundary, it is re-raised HERE,
+        on the owner's thread (join-and-reraise)."""
         self._stop.set()
         try:
             if self._flusher is not None:
@@ -122,16 +133,34 @@ class BoxSparseCache:
                     # batches and let begin_pass still invalidate — an
                     # aborted drain would leave ids uncacheable and skip
                     # the cache clear (same policy as _flush_loop)
-                    warnings.warn(f"box-cache end_pass flush RPC failed "
-                                  f"({type(e).__name__}: {str(e)[:120]}); "
-                                  f"gradient batch dropped")
+                    self._count_flush_drop(name, ids, e, site="end_pass")
                 finally:
                     # even on RPC failure: counts must drop or the ids
                     # stay uncacheable/unevictable forever (the lost
                     # gradient is the PS contract's async-push risk)
                     self._mark_flushed(name, ids)
+            if self._flusher_exc is not None:
+                exc, self._flusher_exc = self._flusher_exc, None
+                raise RuntimeError(
+                    "box-cache flusher thread died on an unexpected "
+                    "error (re-raised at the pass boundary)") from exc
         finally:
             self._stop.clear()  # a raised drain must not brick pushes
+
+    def close(self):
+        """Final drain + join-and-reraise — call at trainer shutdown."""
+        self.end_pass()
+
+    def _count_flush_drop(self, name, ids, e, site: str):
+        n = int(np.asarray(ids).size)
+        self.flush_drops += n
+        GRAD_DROPS.inc(n, var=name)
+        _events.emit("ps_failover", action="flush_drop", var=name,
+                     rows=n, site=site,
+                     error=f"{type(e).__name__}: {str(e)[:120]}")
+        warnings.warn(f"box-cache {site} flush RPC failed "
+                      f"({type(e).__name__}: {str(e)[:120]}); "
+                      f"{n} row gradient(s) dropped")
 
     # -- pull / push ---------------------------------------------------------
 
@@ -250,19 +279,28 @@ class BoxSparseCache:
                     self._pending[key] = n
 
     def _flush_loop(self):
-        while not self._stop.is_set():
-            try:
-                name, ids, grads, lr = self._flushq.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            try:
-                push_row_grads(self.client, name, ids, grads, lr)
-            except Exception as e:  # keep the flusher alive; drop marks
-                warnings.warn(f"box-cache flush RPC failed "
-                              f"({type(e).__name__}: {str(e)[:120]}); "
-                              f"gradient batch dropped")
-            finally:
-                self._mark_flushed(name, ids)
+        try:
+            while not self._stop.is_set():
+                try:
+                    name, ids, grads, lr = self._flushq.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                try:
+                    push_row_grads(self.client, name, ids, grads, lr)
+                except Exception as e:  # keep the flusher alive; count
+                    # the dropped batch — never a silent loss
+                    self._count_flush_drop(name, ids, e, site="flusher")
+                finally:
+                    self._mark_flushed(name, ids)
+        except BaseException as e:  # noqa: BLE001 — anything that
+            # escapes the per-batch handling (a bug in the bookkeeping,
+            # MemoryError, ...) must reach the owner, not die with the
+            # thread: recorded + evented here, re-raised on the OWNER'S
+            # thread by the next end_pass()/close() (raising here would
+            # only spam stderr from a thread nobody joins on error)
+            self._flusher_exc = e
+            _events.emit("ps_failover", action="flusher_error",
+                         error=f"{type(e).__name__}: {str(e)[:200]}")
 
 
 _BOX: Optional[BoxSparseCache] = None

@@ -4,51 +4,280 @@ Reference: operators/distributed/grpc/grpc_client.h (AsyncSendVar/
 AsyncGetVar), communicator.h:166/276 (AsyncCommunicator merges up to
 max_merge_var_num gradients in background send threads),
 parameter_send/recv.cc (rows-split send).
+
+RPC resilience (RESILIENCE.md §Parameter-server fault tolerance): every
+connection reconnects with capped backoff on broken sockets, bounds each
+call by a deadline, stamps requests with a (cid, seq) envelope so a
+retried non-idempotent call is deduplicated server-side, and shares a
+per-endpoint circuit breaker (resilience.retry.CircuitBreaker) so a dead
+server costs one state check instead of a connect storm. A call whose
+budget is exhausted raises the typed `PSUnavailableError`; bounded waits
+(`wait_var`/`wait_all_completed`) raise `PSTimeoutError` by default
+instead of returning a droppable False.
+
+Env knobs (read at client construction):
+  PADDLE_TPU_PS_RPC_DEADLINE_S   total retry budget per call (default
+                                 150 — above the server's 120 s sync
+                                 get-barrier wait, far below the old
+                                 180 s per-chunk socket stall)
+  PADDLE_TPU_PS_RPC_TIMEOUT_S    per-attempt reply wait (default 150)
+  PADDLE_TPU_PS_CONNECT_TIMEOUT_S  per-attempt connect wait (default 5)
+  PADDLE_TPU_PS_BREAKER_THRESHOLD  consecutive failures that open the
+                                 breaker (default 3)
+  PADDLE_TPU_PS_BREAKER_RESET_S  open-state cooldown before the
+                                 half-open probe (default 1.0)
 """
 
 from __future__ import annotations
 
+import logging
+import os
+import pickle
 import queue
 import socket
+import struct
 import threading
+import time
+import uuid
 from typing import Dict, List, Optional
 
 import numpy as np
 
-from .protocol import place_endpoint, recv_msg, send_msg
+from ..observability import events as _events
+from ..observability import metrics as _m
+from ..resilience import faults as _faults
+from ..resilience.retry import CircuitBreaker
+from .errors import PSTimeoutError, PSUnavailableError
+from .protocol import (CID_FIELD, SEQ_FIELD, place_endpoint, recv_msg,
+                       send_msg)
+
+_log = logging.getLogger("paddle_tpu.ps")
+
+RPCS = _m.counter(
+    "paddle_tpu_ps_rpc_total",
+    "PS RPC attempts by op and outcome (ok|error|retry|unavailable)",
+    labelnames=("op", "outcome"))
+RECONNECTS = _m.counter(
+    "paddle_tpu_ps_reconnects_total",
+    "PS sockets re-established after a wire failure",
+    labelnames=("endpoint",))
+BREAKER_STATE = _m.gauge(
+    "paddle_tpu_ps_breaker_state",
+    "Per-endpoint circuit-breaker state (0 closed, 1 half-open, 2 open)",
+    labelnames=("endpoint",))
+DEGRADED_SECONDS = _m.counter(
+    "paddle_tpu_ps_degraded_seconds_total",
+    "Wall seconds calls spent riding out an unreachable PS endpoint "
+    "(reconnect backoff + open-breaker waits)", labelnames=("endpoint",))
+GRAD_DROPS = _m.counter(
+    "paddle_tpu_ps_grad_drops_total",
+    "Async gradient pushes dropped (bounded buffering while a server "
+    "is down, or a failed flush)", labelnames=("var",))
+
+_STATE_CODE = {CircuitBreaker.CLOSED: 0, CircuitBreaker.HALF_OPEN: 1,
+               CircuitBreaker.OPEN: 2}
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _breaker_for(endpoint: str) -> CircuitBreaker:
+    def hook(old, new):
+        BREAKER_STATE.set(_STATE_CODE[new], endpoint=endpoint)
+        _events.emit("ps_failover", action=f"breaker_{new}",
+                     endpoint=endpoint)
+        # warn on the closed->open EDGE only: during a long outage the
+        # breaker re-trips once per cooldown (failed half-open probe),
+        # which would otherwise log once a second per endpoint
+        if new == CircuitBreaker.OPEN and old == CircuitBreaker.CLOSED:
+            _log.warning("ps: circuit breaker OPEN for %s — failing fast "
+                         "until the half-open probe succeeds", endpoint)
+        elif new == CircuitBreaker.CLOSED:
+            _log.info("ps: circuit breaker closed for %s (probe "
+                      "succeeded)", endpoint)
+
+    return CircuitBreaker(
+        failure_threshold=int(_env_f("PADDLE_TPU_PS_BREAKER_THRESHOLD", 3)),
+        reset_timeout_s=_env_f("PADDLE_TPU_PS_BREAKER_RESET_S", 1.0),
+        on_transition=hook)
 
 
 class _Conn:
-    def __init__(self, endpoint: str):
+    """One resilient connection: lazy connect, reconnect-with-capped-
+    backoff, per-call deadline, (cid, seq) retry envelope. The lock
+    serializes whole calls (send through recv *and* any retries), which
+    is what licenses the server's last-reply-per-cid dedupe cache."""
+
+    def __init__(self, endpoint: str, breaker: Optional[CircuitBreaker] = None,
+                 deadline_s: Optional[float] = None,
+                 attempt_timeout_s: Optional[float] = None,
+                 connect_timeout_s: Optional[float] = None):
         if ":" not in endpoint:
             raise ValueError(
                 f"malformed pserver endpoint '{endpoint}' — expected "
                 f"host:port (check PADDLE_PSERVERS_IP_PORT_LIST)")
         host, port = endpoint.rsplit(":", 1)
-        self.sock = socket.create_connection((host, int(port)))
-        # Bound every recv: the longest legitimate server-side wait is
-        # the 120 s sync get-/shuffle-barrier, so 180 s means "server
-        # wedged", turning a would-be infinite hang (e.g. end_pass
-        # draining into a dead server) into a ConnectionError the
-        # callers' error paths already handle. Per-chunk, so slow bulk
-        # transfers that keep making progress never trip it.
-        self.sock.settimeout(180.0)
+        self.endpoint = endpoint
+        self.host, self.port = host, int(port)
+        self.sock: Optional[socket.socket] = None
         self.lock = threading.Lock()
+        # cid is per-CONNECTION-OBJECT, not per-socket: a reconnect keeps
+        # the cid so a pre-reconnect retry still dedupes server-side
+        self.cid = uuid.uuid4().hex
+        self._seq = 0
+        self.breaker = breaker or _breaker_for(endpoint)
+        self.deadline_s = (deadline_s if deadline_s is not None
+                           else _env_f("PADDLE_TPU_PS_RPC_DEADLINE_S", 150.0))
+        self.attempt_timeout_s = (
+            attempt_timeout_s if attempt_timeout_s is not None
+            else _env_f("PADDLE_TPU_PS_RPC_TIMEOUT_S", 150.0))
+        self.connect_timeout_s = (
+            connect_timeout_s if connect_timeout_s is not None
+            else _env_f("PADDLE_TPU_PS_CONNECT_TIMEOUT_S", 5.0))
+        self._ever_connected = False
 
-    def call(self, msg) -> dict:
+    # -- socket lifecycle (all under self.lock) -----------------------------
+
+    def _ensure_connected(self, timeout: float):
+        if self.sock is not None:
+            return
+        self.sock = socket.create_connection(
+            (self.host, self.port), timeout=min(self.connect_timeout_s,
+                                                max(timeout, 0.05)))
+        if self._ever_connected:
+            RECONNECTS.inc(endpoint=self.endpoint)
+            _events.emit("ps_failover", action="reconnected",
+                         endpoint=self.endpoint)
+        self._ever_connected = True
+
+    def _close_sock(self):
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass  # lint-exempt:swallow: already tearing the socket down
+            self.sock = None
+
+    def _roundtrip(self, msg, timeout: float) -> dict:
+        """One wire attempt: send the frame, wait for the reply. Split
+        out so tests can interpose (e.g. drop a reply to force the
+        retry+dedupe path)."""
+        self.sock.settimeout(max(timeout, 0.05))
+        send_msg(self.sock, msg)
+        return recv_msg(self.sock)
+
+    def close(self):
         with self.lock:
-            send_msg(self.sock, msg)
-            return recv_msg(self.sock)
+            self._close_sock()
+
+    # -- the call -----------------------------------------------------------
+
+    def call(self, msg, deadline_s: Optional[float] = None,
+             fail_fast: bool = False) -> dict:
+        """Send `msg`, return the reply dict. Retries wire failures
+        (reconnect + resend with the SAME seq → server dedupes) until
+        `deadline_s` (default: the conn's budget) is exhausted, then
+        raises PSUnavailableError. With fail_fast=True the first wire
+        failure or an open breaker raises immediately (background
+        senders use this to switch to buffering instead of blocking)."""
+        op = str(msg.get("op", "?"))
+        budget = self.deadline_s if deadline_s is None else float(deadline_s)
+        with self.lock:
+            self._seq += 1
+            wire = dict(msg)
+            wire[CID_FIELD] = self.cid
+            wire[SEQ_FIELD] = self._seq
+            t0 = time.monotonic()
+            first_failure_at: Optional[float] = None
+            attempt = 0
+            last_err: Optional[BaseException] = None
+            while True:
+                remaining = budget - (time.monotonic() - t0)
+                if remaining <= 0 or (fail_fast and attempt > 0):
+                    break
+                if not self.breaker.allow():
+                    if fail_fast:
+                        break
+                    if first_failure_at is None:
+                        first_failure_at = time.monotonic()
+                    # open breaker: wait out a slice of the cooldown
+                    # instead of hammering connect()
+                    time.sleep(min(0.05, max(remaining, 0.0)))  # lint-exempt:lockblock: per-conn lock is this call's serialization, held across the whole retried call by design
+                    continue
+                try:
+                    try:
+                        _faults.check("ps_rpc")
+                        self._ensure_connected(remaining)
+                        out = self._roundtrip(
+                            wire, min(self.attempt_timeout_s, remaining))
+                    except (OSError, EOFError, pickle.UnpicklingError,
+                            struct.error):
+                        raise
+                    except BaseException:
+                        # anything else (an injected FaultInjected, a
+                        # MemoryError materializing a huge reply,
+                        # KeyboardInterrupt): the breaker MUST still be
+                        # notified — allow() may have admitted us as the
+                        # single half-open probe, and an unnotified
+                        # probe slot wedges the breaker open forever
+                        self.breaker.record_failure()
+                        self._close_sock()
+                        raise
+                    self.breaker.record_success()
+                    if first_failure_at is not None:
+                        DEGRADED_SECONDS.inc(
+                            time.monotonic() - first_failure_at,
+                            endpoint=self.endpoint)
+                    RPCS.inc(op=op,
+                             outcome="error" if "error" in out else "ok")
+                    return out
+                except (OSError, EOFError, pickle.UnpicklingError,
+                        struct.error) as e:
+                    # InjectedIOError (faults site ps_rpc) is an OSError:
+                    # it rides the same reconnect/retry path a real wire
+                    # failure does. A server dying mid-frame can also
+                    # surface as a truncated/garbled pickle — same
+                    # treatment: drop the socket, retry with the same seq
+                    last_err = e
+                    self.breaker.record_failure()
+                    self._close_sock()
+                    if first_failure_at is None:
+                        first_failure_at = time.monotonic()
+                    attempt += 1
+                    if fail_fast:
+                        break
+                    RPCS.inc(op=op, outcome="retry")
+                    delay = min(1.0, 0.05 * (2 ** min(attempt, 6)))
+                    time.sleep(min(delay, max(remaining, 0.0)))  # lint-exempt:lockblock: see above — retry backoff is part of the serialized call
+            if first_failure_at is not None:
+                DEGRADED_SECONDS.inc(time.monotonic() - first_failure_at,
+                                     endpoint=self.endpoint)
+            RPCS.inc(op=op, outcome="unavailable")
+            raise PSUnavailableError(
+                f"pserver {self.endpoint} unavailable for op '{op}' "
+                f"(budget {budget:.1f}s, {attempt} wire failures, "
+                f"breaker {self.breaker.state}"
+                + (f", last error {type(last_err).__name__}: {last_err}"
+                   if last_err is not None else "") + ")",
+                endpoint=self.endpoint, op=op)
 
 
 class PSClient:
     """Connects to every pserver; vars are placed by the transpiler's
     dispatcher (name -> endpoint)."""
 
-    def __init__(self, endpoints: List[str], trainer_id: int = 0):
+    def __init__(self, endpoints: List[str], trainer_id: int = 0,
+                 rpc_deadline_s: Optional[float] = None):
         self.endpoints = list(endpoints)
         self.trainer_id = trainer_id
-        self._conns = {ep: _Conn(ep) for ep in self.endpoints}
+        self._breakers = {ep: _breaker_for(ep) for ep in self.endpoints}
+        self._conns = {ep: _Conn(ep, breaker=self._breakers[ep],
+                                 deadline_s=rpc_deadline_s)
+                       for ep in self.endpoints}
         self.placement: Dict[str, str] = {}
         self.generation = 0
 
@@ -58,6 +287,17 @@ class PSClient:
             ep = place_endpoint(self.endpoints, name)
             self.placement[name] = ep
         return ep
+
+    def degraded(self, name: str) -> bool:
+        """True while the server owning `name` has an OPEN breaker —
+        async senders switch from backpressure to bounded drop-oldest
+        buffering so the TPU step never blocks on a dead server."""
+        return (self._breakers[self.place(name)].state
+                == CircuitBreaker.OPEN)
+
+    def degraded_endpoints(self) -> List[str]:
+        return [ep for ep, b in self._breakers.items()
+                if b.state == CircuitBreaker.OPEN]
 
     def _call(self, name, msg) -> dict:
         out = self._conns[self.place(name)].call(msg)
@@ -185,41 +425,69 @@ class PSClient:
         for c in self._conns.values():
             c.call(msg)
 
-    def wait_var(self, name: str, timeout: float = 60.0) -> bool:
-        """Poll until a var exists on its owner (trainer-0 publish sync)."""
-        import time
-
+    def wait_var(self, name: str, timeout: float = 60.0,
+                 raise_on_timeout: bool = True) -> bool:
+        """Poll until a var exists on its owner (trainer-0 publish sync).
+        Raises PSTimeoutError on expiry unless raise_on_timeout=False
+        (legacy polling callers that genuinely branch on the bool)."""
         deadline = time.time() + timeout
         while time.time() < deadline:
+            # per-probe RPC budget bounded by the wait's own remainder:
+            # a down server must expire THIS wait, not the conn's much
+            # larger default call deadline
             out = self._conns[self.place(name)].call(
-                {"op": "has_var", "name": name})
+                {"op": "has_var", "name": name},
+                deadline_s=max(0.5, deadline - time.time()))
             if out.get("ok"):
                 return True
             time.sleep(0.1)
+        if raise_on_timeout:
+            raise PSTimeoutError(
+                f"wait_var('{name}'): not published on "
+                f"{self.place(name)} within {timeout}s (is worker 0's "
+                f"publish step running?)")
         return False
 
-    def wait_all_completed(self, timeout: float = 120.0) -> bool:
-        import time
-
+    def wait_all_completed(self, timeout: float = 120.0,
+                           raise_on_timeout: bool = True) -> bool:
         deadline = time.time() + timeout
         while time.time() < deadline:
-            if all(c.call({"op": "all_completed"}).get("ok")
-                   for c in self._conns.values()):
+            if all(c.call({"op": "all_completed"},
+                          deadline_s=max(0.5, deadline - time.time()))
+                   .get("ok") for c in self._conns.values()):
                 return True
             time.sleep(0.1)
+        if raise_on_timeout:
+            raise PSTimeoutError(
+                f"wait_all_completed: a peer trainer never reported "
+                f"COMPLETED within {timeout}s (likely crashed)")
         return False
 
-    def heartbeat(self, state: Optional[int] = None):
+    def heartbeat(self, state: Optional[int] = None,
+                  fail_fast: bool = False):
+        """Beat every server. With fail_fast=True a dead endpoint costs
+        one wire attempt instead of the full retry budget — the
+        completion/shutdown path uses this so a trainer that finished
+        successfully never hangs on a server that died underneath it."""
         for c in self._conns.values():
             c.call({"op": "heartbeat", "trainer_id": self.trainer_id,
-                    "state": state})
+                    "state": state}, fail_fast=fail_fast)
+
+    def snapshot_servers(self) -> Dict[str, dict]:
+        """Ask every pserver for an immediate committed snapshot (no-op
+        {"ok": False} reply on servers launched without a snapshot dir).
+        The durable-state analogue of checkpoint_notify: the server
+        persists through its own CheckpointManager (commit marker,
+        retention, restore-at-boot) instead of shipping bare .npy."""
+        out = {}
+        for ep, c in self._conns.items():
+            out[ep] = c.call({"op": "snapshot"})
+        return out
 
     def checkpoint_notify(self, dirname: str):
         """reference: distributed_ops/checkpoint_notify_op.cc — ask every
         pserver to persist its resident vars (per-server subdirectories
         keep the shards separate)."""
-        import os
-
         saved = {}
         for i, (ep, c) in enumerate(self._conns.items()):
             out = c.call({"op": "checkpoint_notify",
@@ -233,9 +501,15 @@ class PSClient:
     def shutdown_servers(self):
         for c in self._conns.values():
             try:
-                c.call({"op": "shutdown"})
+                # fail_fast: a dying/dead server must not make shutdown
+                # ride the full reconnect budget per endpoint
+                c.call({"op": "shutdown"}, fail_fast=True)
             except Exception:  # lint-exempt:swallow: best-effort shutdown fanout to dying servers
                 pass
+
+    def close(self):
+        for c in self._conns.values():
+            c.close()
 
 
 class AsyncCommunicator:
@@ -247,7 +521,17 @@ class AsyncCommunicator:
     fresh params into the bound scope every
     FLAGS_communicator_min_send_grad_num_before_recv sent gradients
     (communicator.cc:34-46 flags). Defaults come from those FLAGS_* so
-    env tuning works like the reference's gflags."""
+    env tuning works like the reference's gflags.
+
+    Degraded mode (server down → its circuit breaker OPEN): `push` stops
+    back-pressuring and instead drops the OLDEST queued gradient to make
+    room — the TPU step never blocks on a dead server; every drop is
+    counted in paddle_tpu_ps_grad_drops_total{var} and logged once per
+    `_DROP_LOG_EVERY`. Sender threads hold the in-flight merged gradient
+    across PSUnavailableError and retry it once the server returns, so
+    an outage shorter than the queue's depth loses nothing."""
+
+    _DROP_LOG_EVERY = 100
 
     def __init__(self, client: PSClient, max_merge_var_num: Optional[int] = None,
                  send_wait_times: Optional[float] = None,
@@ -285,6 +569,11 @@ class AsyncCommunicator:
         self._recv_scope = None
         self._recv_params: List[str] = []
         self._recv_thread: Optional[threading.Thread] = None
+        # staleness accounting: per-var count of gradients dropped while
+        # the owning server was unreachable (mirrors the registry
+        # counter, readable without a metrics snapshot)
+        self.stale_drops: Dict[str, int] = {}
+        self.last_send_error: Optional[BaseException] = None
         # host-side numpy copies of the last-received params. ps_recv's
         # do_not_run callback reads THIS, never the scope: scope entries
         # may be device arrays, and np.asarray(device_array) inside an XLA
@@ -316,6 +605,24 @@ class AsyncCommunicator:
         t.start()
         self._threads[name] = t
 
+    def _degraded(self, name: str) -> bool:
+        probe = getattr(self.client, "degraded", None)
+        return bool(probe(name)) if callable(probe) else False
+
+    def _count_drops(self, name: str, n: int):
+        GRAD_DROPS.inc(n, var=name)
+        before = self.stale_drops.get(name, 0)
+        self.stale_drops[name] = before + n
+        # log the first drop, then once per _DROP_LOG_EVERY — NEVER
+        # silently (the satellite contract): a steady drop rate is an
+        # outage outlasting the buffer, which the operator must see
+        if before == 0 or (before + n) // self._DROP_LOG_EVERY \
+                > before // self._DROP_LOG_EVERY:
+            _log.warning(
+                "ps: dropped %d gradient(s) for '%s' (%d total) — "
+                "bounded buffering while its server is unreachable",
+                n, name, before + n)
+
     def push(self, name: str, grad: np.ndarray):
         if self._stop.is_set():
             raise RuntimeError(
@@ -336,12 +643,23 @@ class AsyncCommunicator:
                     raise RuntimeError(
                         "AsyncCommunicator stopped while push was "
                         "blocked on a full queue") from None
+                if self._degraded(name):
+                    # server down: drop the OLDEST queued gradient to
+                    # make room instead of blocking the trainer step
+                    try:
+                        q.get_nowait()
+                        self._count_drops(name, 1)
+                    except queue.Empty:
+                        pass  # lint-exempt:swallow: sender drained it first — retry the put
         if self._stop.is_set():
             # raced stop()'s drain: flush what we just enqueued ourselves
             try:
                 self.client.push_grad(name, q.get_nowait())
             except queue.Empty:
                 pass
+            except Exception as e:  # noqa: BLE001 — shutdown path
+                self.last_send_error = e
+                self._count_drops(name, 1)
 
     def recv_all(self):
         """Pull every bound param into the recv scope (RecvAll) — merged:
@@ -359,24 +677,56 @@ class AsyncCommunicator:
                 if due:
                     self._grad_num = 0
             if due:
-                self.recv_all()
+                try:
+                    self.recv_all()
+                except PSUnavailableError as e:
+                    # background refresh rides out the outage on the
+                    # last-received params; the next due recv retries
+                    self.last_send_error = e
             else:
                 self._stop.wait(self.wait * 10)
 
     def _sender(self, name: str, q: "queue.Queue"):
+        pending: Optional[np.ndarray] = None   # merged, awaiting a live server
+        pending_count = 0
+        pending_dtype = None
         while not self._stop.is_set():
-            try:
-                g = q.get(timeout=self.wait * 10)
-            except queue.Empty:
-                continue
-            merged, count = g.astype(np.float64), 1
+            if pending is None:
+                try:
+                    g = q.get(timeout=self.wait * 10)
+                except queue.Empty:
+                    continue
+                merged, count = g.astype(np.float64), 1
+                pending_dtype = g.dtype
+            else:
+                merged, count = pending, pending_count
+                pending = None
             while count < self.max_merge:
                 try:
                     merged += q.get_nowait()
                     count += 1
                 except queue.Empty:
                     break
-            self.client.push_grad(name, (merged / count).astype(g.dtype))
+            try:
+                self.client.push_grad(
+                    name, (merged / count).astype(pending_dtype))
+            except PSUnavailableError as e:
+                # hold the merged gradient and retry once the server is
+                # back — meanwhile push() keeps the queue bounded via
+                # drop-oldest, so memory stays capped at queue+1 batches
+                self.last_send_error = e
+                pending, pending_count = merged, count
+                self._stop.wait(min(1.0, self.wait * 10))
+                continue
+            except Exception as e:  # noqa: BLE001 — a server-side apply
+                # error must not kill the sender thread silently: count
+                # the lost batch, remember the error, keep serving
+                self.last_send_error = e
+                self._count_drops(name, count)
+                _log.warning("ps: push_grad('%s') failed (%s: %s) — "
+                             "merged batch of %d dropped", name,
+                             type(e).__name__, e, count)
+                continue
             with self._grad_lock:
                 self._grad_num += count
                 due = (not self.independent_recv
@@ -387,7 +737,18 @@ class AsyncCommunicator:
                 # no independent recv thread: recv from the send path
                 # (the reference's fallback when
                 # communicator_independent_recv_thread is false)
-                self.recv_all()
+                try:
+                    self.recv_all()
+                except PSUnavailableError as e:
+                    self.last_send_error = e
+        if pending is not None:
+            # stop() raced a held batch: one last best-effort flush
+            try:
+                self.client.push_grad(
+                    name, (pending / pending_count).astype(pending_dtype))
+            except Exception as e:  # noqa: BLE001 — shutdown path
+                self.last_send_error = e
+                self._count_drops(name, pending_count)
 
     def stop(self):
         self._stop.set()
@@ -404,5 +765,17 @@ class AsyncCommunicator:
                     g = q.get_nowait()
                 except queue.Empty:
                     break
-                self.client.push_grad(name, g)
-
+                if self._degraded(name):
+                    # known-dead server: don't ride the retry deadline
+                    # on the shutdown path — count the losses and move on
+                    self._count_drops(name, 1 + q.qsize())
+                    break
+                try:
+                    self.client.push_grad(name, g)
+                except Exception as e:  # noqa: BLE001 — shutdown drain
+                    # must not hang/raise on a dead server; the loss —
+                    # this grad AND whatever else is still queued — is
+                    # counted, never silent
+                    self.last_send_error = e
+                    self._count_drops(name, 1 + q.qsize())
+                    break

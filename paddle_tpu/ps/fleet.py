@@ -184,12 +184,10 @@ class PSFleet:
             # wait for worker 0's publish, then PULL the published values
             # into the local scope — every worker must start step 1 from
             # the SAME parameters (the reference's init_worker sync),
-            # not its own local startup init
+            # not its own local startup init. wait_var raises a typed
+            # PSTimeoutError naming the unpublished var on expiry.
             for n in pnames:
-                if not self._client.wait_var(n, timeout=publish_timeout):
-                    raise RuntimeError(
-                        f"init_worker: param '{n}' was never published by "
-                        f"worker 0 (timeout {publish_timeout}s)")
+                self._client.wait_var(n, timeout=publish_timeout)
             # merged pull: one RPC per server for the whole param set
             for n, v in self._client.pull_many(pnames).items():
                 scope.set_var(n, np.asarray(v))
@@ -200,14 +198,25 @@ class PSFleet:
         then shuts the servers down (reference fleet.stop_worker)."""
         if self._client is None:
             return
-        self._client.heartbeat(state=2)  # COMPLETED
+        from .errors import PSUnavailableError
+
+        try:
+            # fail fast per endpoint: a trainer that finished its work
+            # must not ride the full retry budget (then die) because a
+            # server is down at shutdown — the beat is best-effort, the
+            # job's success was already decided by the training loop
+            self._client.heartbeat(state=2, fail_fast=True)  # COMPLETED
+        except PSUnavailableError as e:
+            import logging
+
+            logging.getLogger("paddle_tpu.ps").warning(
+                "stop_worker: COMPLETED heartbeat undeliverable (%s) — "
+                "continuing shutdown", e)
         if self.is_first_worker():
-            if not self._client.wait_all_completed(
-                    timeout=shutdown_timeout):
-                raise RuntimeError(
-                    f"stop_worker: not every trainer reported COMPLETED "
-                    f"within {shutdown_timeout}s (a peer likely crashed) "
-                    f"— pservers were NOT shut down")
+            # raises PSTimeoutError when a peer never reports COMPLETED
+            # — the pservers are then deliberately left running (a live
+            # peer may still be training against them)
+            self._client.wait_all_completed(timeout=shutdown_timeout)
             self._client.shutdown_servers()
 
     def save_persistables(self, executor, dirname, main_program=None):
@@ -223,6 +232,21 @@ class PSFleet:
                 "save_persistables before init_worker(): no PS connection")
         if self.is_first_worker():
             self._client.checkpoint_notify(dirname)
+
+    def snapshot_servers(self):
+        """Ask every pserver for an immediate COMMITTED snapshot through
+        its own CheckpointManager (RESILIENCE.md §Parameter-server fault
+        tolerance) — the durable counterpart of save_persistables: a
+        server respawned by the supervisor restores these tables at
+        boot. Only worker 0 triggers (first-worker-saves semantic);
+        servers launched without PADDLE_TPU_PS_SNAPSHOT_DIR reply
+        {"ok": False}."""
+        if self._client is None:
+            raise RuntimeError(
+                "snapshot_servers before init_worker(): no PS connection")
+        if self.is_first_worker():
+            return self._client.snapshot_servers()
+        return {}
 
 
 class TranspilerOptimizer:

@@ -5,7 +5,21 @@ Reference: operators/distributed/send_recv.proto + grpc_serde.cc. Pickle of
 ride pickle's buffer protocol (no copy on the hot path). Deserialization
 uses a restricted unpickler (ndarray/dtype/scalars only) — raw pickle would
 hand any peer on the socket arbitrary code execution, which is why the
-reference speaks protobuf."""
+reference speaks protobuf.
+
+Idempotent-retry envelope (RESILIENCE.md §Parameter-server fault
+tolerance): the resilient client stamps every request with a connection
+id (`CID_FIELD`, unique per client connection) and a per-connection
+monotonically increasing sequence number (`SEQ_FIELD`). Calls on one
+connection are serialized (the client holds a per-conn lock across
+send+recv), so at most one request per cid is ever outstanding — the
+server therefore needs to remember only the LAST (seq, reply) per cid
+to deduplicate: a retried frame (same cid+seq, resent after a lost
+reply) gets the cached reply back instead of a second application of a
+non-idempotent op (send_grad / push_sparse_grad / send_barrier /
+send_delta). A *new* seq on the same cid overwrites the cache slot.
+Requests without the envelope (in-process tests, legacy peers) bypass
+the cache entirely."""
 
 from __future__ import annotations
 
@@ -16,6 +30,11 @@ import struct
 from typing import Any, Dict
 
 _LEN = struct.Struct("<Q")
+
+# idempotent-retry envelope keys (see module docstring). Underscored so
+# they can never collide with an op's own payload fields.
+CID_FIELD = "_cid"
+SEQ_FIELD = "_seq"
 
 _ALLOWED = {
     ("numpy.core.multiarray", "_reconstruct"),

@@ -15,15 +15,29 @@ eagerly on CPU via the shared registry. A HeartBeatMonitor
 
 from __future__ import annotations
 
+import json
+import logging
+import os
 import socket
 import socketserver
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from .protocol import recv_msg, send_msg
+from ..observability import events as _events
+from ..observability import metrics as _m
+from ..resilience import faults as _faults
+from .protocol import CID_FIELD, SEQ_FIELD, recv_msg, send_msg
+
+_log = logging.getLogger("paddle_tpu.ps")
+
+DEDUP_REPLIES = _m.counter(
+    "paddle_tpu_ps_dedup_replies_total",
+    "Retried requests answered from the reply cache instead of "
+    "re-applying (idempotent-retry envelope)", labelnames=("op",))
 
 
 class HeartBeatMonitor:
@@ -65,6 +79,73 @@ class HeartBeatMonitor:
         self._stop.set()
 
 
+def snapshot_config_from_env(endpoint: str) -> Dict[str, Any]:
+    """ParameterServer durability kwargs from the launcher env contract:
+
+      PADDLE_TPU_PS_SNAPSHOT_DIR      root; each server snapshots into
+                                      <root>/server_<index> (or a
+                                      sanitized endpoint when no index
+                                      is exported)
+      PADDLE_TPU_PS_SERVER_INDEX      this server's slot number (also
+                                      the `ps_server=N` fault-site id)
+      PADDLE_TPU_PS_SNAPSHOT_EVERY_S  periodic-snapshot cadence
+                                      (unset/0: on-demand `snapshot`
+                                      RPCs only)
+
+    Empty dict when PADDLE_TPU_PS_SNAPSHOT_DIR is unset — a server
+    without the env runs exactly as before (no durability)."""
+    root = os.environ.get("PADDLE_TPU_PS_SNAPSHOT_DIR")
+    if not root:
+        return {}
+    idx = os.environ.get("PADDLE_TPU_PS_SERVER_INDEX")
+    sub = (f"server_{int(idx)}" if idx not in (None, "")
+           else endpoint.replace(":", "_").replace("/", "_"))
+    every = os.environ.get("PADDLE_TPU_PS_SNAPSHOT_EVERY_S")
+    out: Dict[str, Any] = {"snapshot_dir": os.path.join(root, sub)}
+    if every:
+        try:
+            out["snapshot_every_s"] = float(every) or None
+        except ValueError:
+            pass  # lint-exempt:swallow: malformed cadence env falls back to on-demand snapshots
+    if idx not in (None, ""):
+        out["server_index"] = int(idx)
+    return out
+
+
+def _np_to_py(o):
+    """json default= hook: numpy scalars in shipped opt-desc attrs."""
+    if hasattr(o, "item"):
+        return o.item()
+    raise TypeError(f"not JSON-serializable: {type(o).__name__}")
+
+
+def _snapshot_save(path: str, state: dict) -> None:
+    """CheckpointManager save_fn: the server's whole state as atomic
+    npz payloads (dense values + sparse shards in vars.npz, optimizer
+    accumulators in aux.npz) plus a JSON meta (opt descs, grad names,
+    aux ownership, sync generation, snapshot counter). The manager's
+    commit marker is written only after all three land."""
+    from ..resilience import atomic as _atomic
+
+    os.makedirs(path, exist_ok=True)
+    _atomic.np_savez(os.path.join(path, "vars.npz"), **state["values"])
+    _atomic.np_savez(os.path.join(path, "aux.npz"), **state["aux"])
+    _atomic.json_dump(state["meta"], os.path.join(path, "meta.json"),
+                      default=_np_to_py)
+
+
+def _snapshot_restore(path: str, template) -> dict:
+    """CheckpointManager restore_fn: inverse of _snapshot_save.
+    `template` is unused (the server repopulates its own dicts)."""
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    with np.load(os.path.join(path, "vars.npz"), allow_pickle=False) as z:
+        values = {k: z[k] for k in z.files}
+    with np.load(os.path.join(path, "aux.npz"), allow_pickle=False) as z:
+        aux = {k: z[k] for k in z.files}
+    return {"values": values, "aux": aux, "meta": meta}
+
+
 class _VarState:
     __slots__ = ("value", "recv", "opt_descs", "grad_name", "lock")
 
@@ -84,14 +165,42 @@ class _VarState:
 
 
 class ParameterServer:
-    """One endpoint's server. mode: 'sync' | 'async' | 'geo'."""
+    """One endpoint's server. mode: 'sync' | 'async' | 'geo'.
+
+    Durability (RESILIENCE.md §Parameter-server fault tolerance): with
+    `snapshot_dir` set, the server owns a resilience.CheckpointManager
+    over its whole state — dense var values, sparse-table shards,
+    optimizer aux, opt descs and the sync generation — and (a) restores
+    the newest committed snapshot at construction, so a respawned
+    server RESUMES its tables instead of reinitializing, (b) snapshots
+    periodically every `snapshot_every_s` seconds when state changed,
+    and (c) snapshots on demand via the `snapshot` RPC (the trainer's
+    checkpoint cadence). Commit markers, retention and corrupt-fallback
+    come from the manager; payloads are atomic npz/json writes.
+
+    Retried-request dedupe: requests carrying the (cid, seq) envelope
+    (ps/protocol.py) are answered from a bounded last-reply-per-cid
+    cache when the seq repeats — a resent push/barrier whose reply was
+    lost on the wire is never applied twice within one server
+    incarnation."""
+
+    _REPLY_CACHE_CIDS = 512
+    _MUTATING_OPS = frozenset((
+        "init_var", "init_aux", "init_aux_many", "send_grad",
+        "send_grads", "send_delta", "send_barrier", "push_sparse_grad",
+        "rejoin"))
 
     def __init__(self, endpoint: str, num_trainers: int, mode: str = "sync",
-                 dc_asgd_lambda: float = 0.0):
+                 dc_asgd_lambda: float = 0.0,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_every_s: Optional[float] = None,
+                 snapshot_keep_last: int = 3,
+                 server_index: int = 0):
         self.host, port = endpoint.rsplit(":", 1)
         self.port = int(port)
         self.num_trainers = num_trainers
         self.mode = mode
+        self.server_index = int(server_index)
         # DC-ASGD (reference: distribute_transpiler.py:2050
         # _append_dc_asgd_ops): async staleness compensation
         # g' = g + λ·g⊙g⊙(w_now - w_at_pull); per-trainer pull snapshots
@@ -119,6 +228,107 @@ class ParameterServer:
         self._shuf_taken: set = set()
         self._shuf_buf: Dict[int, list] = {}
         self._server: Optional[socketserver.ThreadingTCPServer] = None
+        # retried-request dedupe: cid -> (seq, reply), bounded LRU
+        self._reply_cache: "OrderedDict[str, tuple]" = OrderedDict()
+        self._reply_lock = threading.Lock()
+        # durable snapshots
+        self._snap_mgr = None
+        self._snap_lock = threading.Lock()
+        self._snap_step = 0
+        self._dirty = threading.Event()
+        self._snap_stop = threading.Event()
+        self._snap_thread: Optional[threading.Thread] = None
+        if snapshot_dir:
+            from ..resilience.checkpoint_manager import CheckpointManager
+
+            self._snap_mgr = CheckpointManager(
+                snapshot_dir, keep_last_n=max(1, int(snapshot_keep_last)),
+                save_fn=_snapshot_save, restore_fn=_snapshot_restore)
+            self._restore_from_snapshot()
+            if snapshot_every_s:
+                self._snap_thread = threading.Thread(
+                    target=self._snapshot_loop, args=(float(snapshot_every_s),),
+                    daemon=True)
+                self._snap_thread.start()
+
+    # -- durable snapshots (resilience.CheckpointManager) -------------------
+
+    def _collect_state(self) -> dict:
+        """Copy-out of everything a respawn needs. Values are copied
+        under each var's lock (per-var consistent; in sync mode a
+        snapshot between barriers is globally consistent, in async mode
+        per-var is the strongest consistency the mode itself offers)."""
+        values: Dict[str, np.ndarray] = {}
+        var_meta: Dict[str, dict] = {}
+        for name, vs in list(self.vars.items()):
+            with vs.lock:
+                values[name] = np.array(vs.value, copy=True)
+            var_meta[name] = {"opt_descs": vs.opt_descs,
+                              "grad_name": vs.grad_name}
+        aux = {n: np.array(v, copy=True)
+               for n, v in list(self.aux.items())}
+        with self._barrier_lock:
+            generation = self._generation
+        return {"values": values, "aux": aux,
+                "meta": {"vars": var_meta,
+                         "aux_owner": dict(self.aux_owner),
+                         "generation": int(generation),
+                         "snap_step": int(self._snap_step),
+                         "mode": self.mode,
+                         "server_index": self.server_index}}
+
+    def snapshot(self) -> Optional[str]:
+        """Write one committed snapshot now (no-op without a snapshot
+        dir). Serialized so the periodic thread and the `snapshot` RPC
+        can't interleave step numbers."""
+        if self._snap_mgr is None:
+            return None
+        with self._snap_lock:
+            self._dirty.clear()     # mutations during collect re-set it
+            state = self._collect_state()
+            d = self._snap_mgr.save(state, step=self._snap_step)
+            self._snap_step += 1
+            return d
+
+    def _restore_from_snapshot(self):
+        """Boot-time resume: repopulate vars/aux/generation from the
+        newest committed snapshot. Corrupt snapshots fall back to older
+        ones inside the manager; no snapshot at all means a genuinely
+        fresh server (trainer init_var repopulates it)."""
+        restored = self._snap_mgr.restore_latest(None)
+        if restored is None:
+            return
+        meta = restored["meta"]
+        for name, value in restored["values"].items():
+            vm = meta["vars"].get(name, {})
+            self.vars[name] = _VarState(np.asarray(value),
+                                        vm.get("opt_descs", []),
+                                        vm.get("grad_name"))
+        self.aux = {n: np.asarray(v) for n, v in restored["aux"].items()}
+        self.aux_owner = dict(meta.get("aux_owner", {}))
+        self._generation = int(meta.get("generation", 0))
+        self._snap_step = int(meta.get("snap_step", 0)) + 1
+        _events.emit("ps_failover", action="restored",
+                     endpoint=f"{self.host}:{self.port}",
+                     vars=len(self.vars), aux=len(self.aux),
+                     generation=self._generation,
+                     snap_step=self._snap_step - 1)
+        _log.info("ps[%s:%d]: restored %d vars + %d aux from committed "
+                  "snapshot (generation %d)", self.host, self.port,
+                  len(self.vars), len(self.aux), self._generation)
+
+    def _snapshot_loop(self, every_s: float):
+        while not self._snap_stop.wait(every_s):
+            if not self._dirty.is_set():
+                continue
+            try:
+                self.snapshot()
+            except Exception as e:  # noqa: BLE001 — a failed periodic
+                # snapshot must not kill the serving thread; the manager
+                # already counted/evented the failure path
+                _log.warning("ps[%s:%d]: periodic snapshot failed "
+                             "(%s: %s)", self.host, self.port,
+                             type(e).__name__, e)
 
     # -- optimize-block execution (shared op registry) ---------------------
 
@@ -256,6 +466,46 @@ class ParameterServer:
     # -- request handlers (reference: request_handler_impl.cc) -------------
 
     def handle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Envelope wrapper around `_handle`: chaos injection point
+        (`ps_server[=index]:crash` fires here, modeling a server dying
+        mid-service), retried-request dedupe for (cid, seq)-stamped
+        frames, and dirty tracking for the periodic snapshot thread."""
+        _faults.check("ps_server", step=self.server_index)
+        cid = msg.get(CID_FIELD)
+        if cid is None:
+            out = self._handle(msg)
+            if msg.get("op") in self._MUTATING_OPS and "error" not in out:
+                self._dirty.set()
+            return out
+        seq = msg.get(SEQ_FIELD)
+        op = str(msg.get("op", "?"))
+        with self._reply_lock:
+            cached = self._reply_cache.get(cid)
+            if cached is not None and cached[0] == seq:
+                # a retry of the call whose reply was lost: answer from
+                # the cache, do NOT re-apply
+                self._reply_cache.move_to_end(cid)
+                DEDUP_REPLIES.inc(op=op)
+                return cached[1]
+        inner = {k: v for k, v in msg.items()
+                 if k not in (CID_FIELD, SEQ_FIELD)}
+        out = self._handle(inner)
+        if op in self._MUTATING_OPS and "error" not in out:
+            self._dirty.set()
+            # only MUTATING replies enter the cache: re-executing a
+            # retried pull is safe (idempotent) and caching it would
+            # pin the last multi-MB parameter reply per connection in
+            # server memory. Leaving the previous mutating entry in
+            # place is also safe — calls per conn are serialized, so a
+            # retry of seq N can only arrive before seq N+1 was issued.
+            with self._reply_lock:
+                self._reply_cache[cid] = (seq, out)
+                self._reply_cache.move_to_end(cid)
+                while len(self._reply_cache) > self._REPLY_CACHE_CIDS:
+                    self._reply_cache.popitem(last=False)
+        return out
+
+    def _handle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         op = msg["op"]
         if op == "init_var":
             name = msg["name"]
@@ -546,6 +796,17 @@ class ParameterServer:
                     self._shuf_taken.clear()
                     self._shuf_cv.notify_all()
             return {"records": out, "pass_id": self._shuf_pass}
+        if op == "snapshot":
+            # on-demand committed snapshot (the trainer's checkpoint
+            # cadence rides this; see PSClient.snapshot_servers)
+            if self._snap_mgr is None:
+                return {"ok": False, "reason": "no snapshot dir"}
+            try:
+                d = self.snapshot()
+                return {"ok": True, "dir": d, "step": self._snap_step - 1}
+            except (OSError, ValueError) as e:
+                return {"error": f"snapshot failed: "
+                                 f"{type(e).__name__}: {e}"}
         if op == "shutdown":
             threading.Thread(target=self.stop, daemon=True).start()
             return {"ok": True}
@@ -593,5 +854,13 @@ class ParameterServer:
 
     def stop(self):
         self.monitor.stop()
+        self._snap_stop.set()
+        if self._snap_thread is not None:
+            self._snap_thread.join(timeout=10)
+            self._snap_thread = None
         if self._server is not None:
             self._server.shutdown()
+            # release the listening socket too: a respawned server (the
+            # failover path) must be able to rebind this endpoint
+            self._server.server_close()
+            self._server = None

@@ -14,9 +14,20 @@ Spec grammar (comma-separated clauses, each colon-separated):
     PADDLE_TPU_FAULT_SPEC="step=50:crash"
     PADDLE_TPU_FAULT_SPEC="save:io_error:p=0.3:seed=7"
     PADDLE_TPU_FAULT_SPEC="step=10:preempt,restore:io_error:times=2"
+    PADDLE_TPU_FAULT_SPEC="ps_rpc:io_error:p=0.2:seed=3"
+    PADDLE_TPU_FAULT_SPEC="ps_server=1:crash"
 
     clause  := site['=' step] ':' action (':' option)*
     site    := 'step' | 'save' | 'restore' | <any site name>
+               PS-tier sites (RESILIENCE.md §Parameter-server fault
+               tolerance): 'ps_rpc' fires in the trainer-side client
+               before each wire attempt — an io_error there rides the
+               reconnect/retry/dedupe path exactly like a real broken
+               socket; 'ps_server' fires in the server's request
+               handler, with the clause's =N matched against the
+               server's slot index (PADDLE_TPU_PS_SERVER_INDEX), so
+               `ps_server=1:crash` hard-kills exactly server 1 at its
+               next request.
     action  := 'crash'     — os._exit(CRASH_EXIT_CODE): simulates a
                              kill -9 / machine preemption with no
                              chance to clean up

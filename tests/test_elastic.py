@@ -26,6 +26,7 @@ import numpy as np
 import pytest
 
 from paddle_tpu.distributed.rendezvous import (FileRendezvous,
+                                               RendezvousInfo,
                                                RendezvousTimeout)
 from paddle_tpu.observability import events
 
@@ -144,10 +145,16 @@ def test_rendezvous_times_out_below_min_workers(tmp_path):
 
 
 def test_max_workers_over_quota_joiner_neither_churns_nor_evicts(tmp_path):
-    a = _rdzv(tmp_path, "a", max_workers=2)
+    # dead_after generous on purpose: nothing here relies on staleness
+    # pruning (the slot frees via an explicit b.leave()), and the tight
+    # default (0.4s vs 0.05s heartbeats) let a loaded CI box mark a
+    # LIVE incumbent stale mid-scenario — a pre-existing flake, not a
+    # quota-logic failure
+    dead = {"dead_after_s": 2.0}
+    a = _rdzv(tmp_path, "a", max_workers=2, **dead)
     a.rendezvous()
     a.start_heartbeat()
-    b = _rdzv(tmp_path, "b", max_workers=2)
+    b = _rdzv(tmp_path, "b", max_workers=2, **dead)
     tb, boxb = _rendezvous_in_thread(b)
     deadline = time.time() + 8
     while not a.membership_changed(a.current()) and \
@@ -160,7 +167,8 @@ def test_max_workers_over_quota_joiner_neither_churns_nor_evicts(tmp_path):
     try:
         # an over-quota joiner whose id sorts FIRST: must neither evict
         # an incumbent nor make boundaries churn with spurious resizes
-        extra = _rdzv(tmp_path, "0-early", max_workers=2, timeout_s=0.5)
+        extra = _rdzv(tmp_path, "0-early", max_workers=2, timeout_s=0.5,
+                      **dead)
         extra.register()
         assert not a.membership_changed(ia)
         assert not b.membership_changed(boxb["info"])
@@ -168,7 +176,8 @@ def test_max_workers_over_quota_joiner_neither_churns_nor_evicts(tmp_path):
             extra.rendezvous()  # waits for a slot, never steals one
         # a slot frees -> the waiter's membership is next
         b.leave()
-        extra2 = _rdzv(tmp_path, "0-early", max_workers=2, timeout_s=10)
+        extra2 = _rdzv(tmp_path, "0-early", max_workers=2, timeout_s=10,
+                       **dead)
         te, boxe = _rendezvous_in_thread(extra2)
         deadline = time.time() + 8
         while not a.membership_changed(ia) and time.time() < deadline:
@@ -179,6 +188,40 @@ def test_max_workers_over_quota_joiner_neither_churns_nor_evicts(tmp_path):
     finally:
         a.stop_heartbeat()
         b.stop_heartbeat()
+
+
+def test_await_adoption_bails_onto_newer_generation(tmp_path):
+    """Cross-generation deadlock regression: a member blocked in the
+    ack barrier of generation N must bail (and re-loop) as soon as a
+    peer seals N+1 — waiting out N's acks would deadlock against a
+    member that is itself blocked in the OLD barrier, burning both
+    sides' full timeout (this was the mechanism behind the flaky
+    over-quota scenario under CI load)."""
+    import threading
+
+    # dead_after huge: the pre-existing member-died bail path must not
+    # fire — only the superseded-generation bail can end the wait early
+    a = _rdzv(tmp_path, "a", dead_after_s=60.0, timeout_s=10.0)
+    a.rendezvous()                         # gen 1 {a}
+    ghost = _rdzv(tmp_path, "zz-ghost", dead_after_s=60.0)
+    ghost.register()                       # live member, never acks
+    assert a._seal(2, ["a", "zz-ghost"]) is not None
+    info2 = RendezvousInfo(generation=2, rank=0, world_size=2,
+                           members=("a", "zz-ghost"))
+
+    def supersede():
+        time.sleep(0.3)
+        a._seal(3, ["a"])
+
+    t = threading.Thread(target=supersede, daemon=True)
+    t.start()
+    t0 = time.perf_counter()
+    ok = a._await_adoption(info2, deadline=time.perf_counter() + 10.0)
+    elapsed = time.perf_counter() - t0
+    t.join(timeout=5)
+    assert ok is False, "superseded barrier must hand back to the caller"
+    assert elapsed < 5.0, \
+        f"bail took {elapsed:.1f}s — it waited out the old barrier"
 
 
 def test_heartbeat_thread_keeps_membership_fresh(tmp_path):

@@ -1,0 +1,744 @@
+"""Parameter-server fault tolerance (RESILIENCE.md §Parameter-server
+fault tolerance): circuit breaker transitions, reconnect-with-backoff,
+per-call deadlines, idempotent-retry dedupe, degraded-mode bounded
+buffering, durable server snapshots + restore-at-boot, and the
+SIGKILL-mid-training resume contract (subprocess). The full CTR failover
+scenario is tools/chaos_bench.py --ps, wired below as a slow test."""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import events, health
+from paddle_tpu.ps import (ParameterServer, PSClient, PSTimeoutError,
+                           PSUnavailableError)
+from paddle_tpu.ps import client as ps_client_mod
+from paddle_tpu.ps.protocol import CID_FIELD, SEQ_FIELD
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.retry import CircuitBreaker
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_state(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_FAULT_SPEC", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_PS_SNAPSHOT_DIR", raising=False)
+    faults.reset()
+    health.reset()
+    events.clear()
+    yield
+    faults.reset()
+    health.reset()
+    events.clear()
+
+
+def _free_eps(n):
+    socks, eps = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        eps.append(f"127.0.0.1:{s.getsockname()[1]}")
+    for s in socks:
+        s.close()
+    return eps
+
+
+_SGD_DESC = [{"type": "sgd",
+              "inputs": {"Param": ["w"], "Grad": ["w@GRAD"],
+                         "LearningRate": ["lr"]},
+              "outputs": {"ParamOut": ["w"]}, "attrs": {}}]
+
+
+def _init_w(client, dim=3):
+    client.init_var("w", np.zeros(dim, np.float32), _SGD_DESC)
+    client.init_aux("lr", np.array([1.0], np.float32), owner="w")
+
+
+# ---------------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_breaker_transitions():
+    now = [0.0]
+    seen = []
+    br = CircuitBreaker(failure_threshold=3, reset_timeout_s=5.0,
+                        clock=lambda: now[0],
+                        on_transition=lambda o, n: seen.append((o, n)))
+    assert br.state == CircuitBreaker.CLOSED
+    for _ in range(2):
+        assert br.allow()
+        br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED  # below threshold
+    assert br.allow()
+    br.record_failure()                        # third consecutive: OPEN
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow(), "open breaker must fail fast"
+    now[0] = 4.9
+    assert not br.allow(), "cooldown not elapsed yet"
+    now[0] = 5.1
+    assert br.allow(), "cooldown elapsed: exactly one half-open probe"
+    assert br.state == CircuitBreaker.HALF_OPEN
+    assert not br.allow(), "second caller during the probe is rejected"
+    br.record_failure()                        # failed probe: OPEN again
+    assert br.state == CircuitBreaker.OPEN
+    now[0] = 10.2
+    assert br.allow()
+    br.record_success()                        # probe succeeded: CLOSED
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow() and br.allow(), "closed again: calls flow"
+    assert ("closed", "open") in seen and ("open", "half_open") in seen \
+        and ("half_open", "open") in seen and ("half_open", "closed") in seen
+    # success resets the consecutive-failure count
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED
+
+
+# ---------------------------------------------------------------------------
+# Reconnect / deadline / typed errors
+# ---------------------------------------------------------------------------
+
+
+def test_call_raises_unavailable_within_deadline_budget():
+    (ep,) = _free_eps(1)   # nothing listening
+    client = PSClient([ep], rpc_deadline_s=1.0)
+    t0 = time.monotonic()
+    with pytest.raises(PSUnavailableError) as ei:
+        client.pull("w")
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0, f"deadline 1.0s but blocked {elapsed:.1f}s"
+    assert ei.value.endpoint == ep and ei.value.op == "get"
+
+
+def test_client_rides_through_server_restart():
+    (ep,) = _free_eps(1)
+    srv = ParameterServer(ep, num_trainers=1, mode="async")
+    srv.start_background()
+    client = PSClient([ep], rpc_deadline_s=30.0)
+    _init_w(client)
+    reconnects0 = ps_client_mod.RECONNECTS.value(endpoint=ep)
+    srv.stop()
+    # in-proc stop() doesn't sever the already-open handler socket the
+    # way a process death does — close the client side so the next call
+    # exercises the real reconnect path against a dead endpoint
+    client._conns[ep].close()
+
+    restarted = {}
+
+    def restart():
+        time.sleep(0.7)
+        srv2 = ParameterServer(ep, num_trainers=1, mode="async")
+        srv2.start_background()
+        restarted["srv"] = srv2
+
+    t = threading.Thread(target=restart, daemon=True)
+    t.start()
+    # blocks through the outage (retry + reconnect), then succeeds
+    # against the restarted server — no 180 s stall, no ConnectionError
+    out = client._conns[ep].call({"op": "has_var", "name": "w"})
+    t.join(timeout=10)
+    assert out == {"ok": False}    # fresh server: no snapshot dir
+    assert ps_client_mod.RECONNECTS.value(endpoint=ep) > reconnects0
+    restarted["srv"].stop()
+
+
+def test_wait_var_timeout_is_typed_and_legacy_bool_still_works():
+    (ep,) = _free_eps(1)
+    srv = ParameterServer(ep, num_trainers=1, mode="async")
+    srv.start_background()
+    client = PSClient([ep])
+    with pytest.raises(PSTimeoutError, match="never_published"):
+        client.wait_var("never_published", timeout=0.4)
+    assert client.wait_var("never_published", timeout=0.4,
+                           raise_on_timeout=False) is False
+    client.init_var("published", np.zeros(1, np.float32))
+    assert client.wait_var("published", timeout=5.0) is True
+    srv.stop()
+
+
+def test_ps_rpc_fault_injection_rides_retry_path(monkeypatch):
+    (ep,) = _free_eps(1)
+    srv = ParameterServer(ep, num_trainers=1, mode="async")
+    srv.start_background()
+    client = PSClient([ep], rpc_deadline_s=30.0)
+    _init_w(client)
+    monkeypatch.setenv("PADDLE_TPU_FAULT_SPEC", "ps_rpc:io_error:times=2")
+    faults.reset()
+    retries0 = ps_client_mod.RPCS.value(op="get", outcome="retry")
+    out = client.pull("w")   # two injected wire errors, then success
+    np.testing.assert_array_equal(out, np.zeros(3, np.float32))
+    assert ps_client_mod.RPCS.value(op="get", outcome="retry") \
+        >= retries0 + 2
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Idempotent-retry dedupe
+# ---------------------------------------------------------------------------
+
+
+def test_lost_reply_retry_is_deduped_server_side(monkeypatch):
+    """The classic at-most-once failure: the server applied the push but
+    the reply died on the wire. The client resends with the SAME seq;
+    the server must answer from its reply cache, not re-apply."""
+    from paddle_tpu.ps.client import _Conn
+    from paddle_tpu.ps.server import DEDUP_REPLIES
+
+    (ep,) = _free_eps(1)
+    srv = ParameterServer(ep, num_trainers=1, mode="async")
+    srv.start_background()
+    client = PSClient([ep], rpc_deadline_s=30.0)
+    _init_w(client)
+
+    conn = client._conns[ep]
+    real_roundtrip = _Conn._roundtrip
+    dropped = {"n": 0}
+
+    def lossy_roundtrip(self, msg, timeout):
+        out = real_roundtrip(self, msg, timeout)
+        if msg.get("op") == "send_grad" and dropped["n"] == 0:
+            dropped["n"] += 1
+            raise ConnectionResetError("reply lost on the wire")
+        return out
+
+    dedup0 = DEDUP_REPLIES.value(op="send_grad")
+    monkeypatch.setattr(_Conn, "_roundtrip", lossy_roundtrip)
+    client.push_grad("w", np.ones(3, np.float32))
+    monkeypatch.setattr(_Conn, "_roundtrip", real_roundtrip)
+    assert dropped["n"] == 1, "the lossy path never triggered"
+    # applied EXACTLY once despite two wire deliveries
+    np.testing.assert_allclose(client.pull("w"), -np.ones(3), rtol=1e-6)
+    assert DEDUP_REPLIES.value(op="send_grad") == dedup0 + 1
+    assert conn.cid  # the envelope was actually in play
+    srv.stop()
+
+
+def test_send_barrier_reentry_same_seq_is_cached():
+    """The sync-mode barrier contract under retry: a RESENT barrier
+    (same cid+seq — the reply was lost) must not advance the generation
+    twice; a genuinely new barrier (new seq) must."""
+    (ep,) = _free_eps(1)
+    srv = ParameterServer(ep, num_trainers=1, mode="sync")
+    srv.start_background()
+    client = PSClient([ep])
+    _init_w(client)
+    client.push_grad("w", np.ones(3, np.float32))
+    msg = {"op": "send_barrier", "trainer_id": 0,
+           CID_FIELD: "t0", SEQ_FIELD: 41}
+    r1 = srv.handle(dict(msg))
+    r2 = srv.handle(dict(msg))          # retry: cached reply
+    assert r1 == r2 and r1["generation"] == 1
+    assert srv._generation == 1
+    np.testing.assert_allclose(srv.vars["w"].value, -np.ones(3))
+    # a NEW barrier (new seq) is a new step
+    client.push_grad("w", np.ones(3, np.float32))
+    r3 = srv.handle({**msg, SEQ_FIELD: 42})
+    assert r3["generation"] == 2
+    srv.stop()
+
+
+def test_non_wire_exception_notifies_breaker_no_probe_leak(monkeypatch):
+    """Review regression: an exception OUTSIDE the wire tuple (injected
+    FaultInjected, MemoryError, ...) thrown between allow() and the
+    record_* calls must still notify the breaker — an unnotified
+    half-open probe slot would wedge the breaker open forever."""
+    from paddle_tpu.ps.client import _Conn
+
+    (ep,) = _free_eps(1)
+    srv = ParameterServer(ep, num_trainers=1, mode="async")
+    srv.start_background()
+    client = PSClient([ep], rpc_deadline_s=2.0)
+    _init_w(client)
+    breaker = client._breakers[ep]
+    breaker.reset_timeout_s = 0.2
+
+    real_roundtrip = _Conn._roundtrip
+
+    def broken_wire(self, msg, timeout):
+        raise ConnectionResetError("wire down")
+
+    monkeypatch.setattr(_Conn, "_roundtrip", broken_wire)
+    with pytest.raises(PSUnavailableError):
+        client.pull("w")
+    assert breaker.state == CircuitBreaker.OPEN
+    time.sleep(0.3)   # past cooldown: next attempt is THE probe
+
+    def non_wire_bomb(self, msg, timeout):
+        raise RuntimeError("non-wire failure mid-probe")
+
+    monkeypatch.setattr(_Conn, "_roundtrip", non_wire_bomb)
+    with pytest.raises(RuntimeError, match="non-wire"):
+        client._conns[ep].call({"op": "has_var", "name": "w"},
+                               deadline_s=5.0)
+    # the probe slot was released: with the wire healthy again the
+    # breaker recovers instead of staying wedged half-open
+    monkeypatch.setattr(_Conn, "_roundtrip", real_roundtrip)
+    time.sleep(0.3)
+    np.testing.assert_array_equal(client.pull("w"),
+                                  np.zeros(3, np.float32))
+    assert breaker.state == CircuitBreaker.CLOSED
+    srv.stop()
+
+
+def test_reply_cache_only_stores_mutating_ops():
+    """Review regression: pull replies (potentially multi-MB) must not
+    be pinned in the reply cache — reads are idempotent; only mutating
+    ops need at-most-once protection."""
+    (ep,) = _free_eps(1)
+    srv = ParameterServer(ep, num_trainers=1, mode="async")
+    srv.start_background()
+    client = PSClient([ep])
+    _init_w(client)
+    srv._reply_cache.clear()
+    srv.handle({"op": "has_var", "name": "w", CID_FIELD: "c1",
+                SEQ_FIELD: 1})
+    srv.handle({"op": "get", "name": "w", CID_FIELD: "c1", SEQ_FIELD: 2})
+    assert "c1" not in srv._reply_cache, "read reply was cached"
+    srv.handle({"op": "send_grad", "name": "w",
+                "grad": np.ones(3, np.float32), "trainer_id": 0,
+                CID_FIELD: "c1", SEQ_FIELD: 3})
+    assert srv._reply_cache["c1"][0] == 3, "mutating reply not cached"
+    srv.stop()
+
+
+def test_heartbeat_fail_fast_and_launch_ps_supervise_validation():
+    """Review regressions: (a) the completion-path heartbeat with
+    fail_fast never rides the full retry budget on a dead server;
+    (b) --ps_supervise without --ps_snapshot_dir is refused (a blind
+    respawn would boot empty tables)."""
+    from paddle_tpu.distributed.launch_ps import launch_ps_main
+
+    (ep,) = _free_eps(1)   # dead endpoint
+    client = PSClient([ep], rpc_deadline_s=30.0)
+    t0 = time.monotonic()
+    with pytest.raises(PSUnavailableError):
+        client.heartbeat(state=2, fail_fast=True)
+    assert time.monotonic() - t0 < 10.0, "fail_fast heartbeat blocked"
+
+    with pytest.raises(SystemExit):
+        launch_ps_main(["--ps_supervise", "--server_num", "1",
+                        "--worker_num", "1", "dummy.py"])
+
+
+# ---------------------------------------------------------------------------
+# Degraded mode: bounded buffering, drop-oldest, never block
+# ---------------------------------------------------------------------------
+
+
+class _FlakyClient:
+    """PSClient stand-in whose server is 'down' until told otherwise."""
+
+    def __init__(self):
+        self.down = True
+        self.applied = []
+
+    def degraded(self, name):
+        return self.down
+
+    def push_grad(self, name, grad):
+        if self.down:
+            raise PSUnavailableError("down", endpoint="fake", op="send")
+        self.applied.append(np.asarray(grad).copy())
+
+
+def test_degraded_push_drops_oldest_and_never_blocks():
+    from paddle_tpu.ps.client import AsyncCommunicator
+
+    cl = _FlakyClient()
+    comm = AsyncCommunicator(cl, max_merge_var_num=1, send_queue_size=2,
+                             independent_recv_thread=False,
+                             min_send_grad_num_before_recv=10**9)
+    comm.start()
+    drops0 = ps_client_mod.GRAD_DROPS.value(var="w")
+    t0 = time.monotonic()
+    for i in range(8):
+        comm.push("w", np.full(2, float(i), np.float32))
+    blocked = time.monotonic() - t0
+    # the old behavior was backpressure-block (forever, server down);
+    # degraded mode must drop-oldest instead — 8 pushes into a 2-deep
+    # queue return promptly
+    assert blocked < 5.0, f"push blocked {blocked:.1f}s while degraded"
+    assert comm.stale_drops.get("w", 0) >= 1
+    assert ps_client_mod.GRAD_DROPS.value(var="w") > drops0
+    # server comes back: the held + queued gradients flush
+    cl.down = False
+    deadline = time.monotonic() + 10
+    while not cl.applied and time.monotonic() < deadline:
+        time.sleep(0.05)
+    comm.stop()
+    assert cl.applied, "nothing flushed after the server returned"
+    # accounting closes: every push either landed or was counted dropped
+    landed = len(cl.applied)
+    dropped = comm.stale_drops.get("w", 0)
+    assert landed + dropped == 8, (landed, dropped)
+
+
+def test_healthy_push_still_backpressures():
+    """The degraded drop-oldest must NOT change the healthy contract:
+    a full queue with a live (slow) server blocks the pusher."""
+    from paddle_tpu.ps.client import AsyncCommunicator
+
+    class _SlowClient:
+        def push_grad(self, name, grad):
+            time.sleep(0.2)
+
+    comm = AsyncCommunicator(_SlowClient(), max_merge_var_num=1,
+                             send_queue_size=3,
+                             independent_recv_thread=False)
+    comm.start()
+    t0 = time.monotonic()
+    for _ in range(8):
+        comm.push("w", np.ones(2, np.float32))
+    assert time.monotonic() - t0 > 0.15, "full queue must block (healthy)"
+    comm.stop()
+
+
+# ---------------------------------------------------------------------------
+# Box cache: flusher errors surface to the owner
+# ---------------------------------------------------------------------------
+
+
+def _box_over_server():
+    from paddle_tpu.ps.box_cache import BoxSparseCache
+    from paddle_tpu.ps.sparse_table import init_sparse_table
+
+    (ep,) = _free_eps(1)
+    srv = ParameterServer(ep, num_trainers=1, mode="async")
+    srv.start_background()
+    client = PSClient([ep])
+    init_sparse_table(client, "t", np.zeros((8, 4), np.float32))
+    return srv, client, BoxSparseCache(client, capacity_rows=8)
+
+
+def test_box_flush_rpc_failure_counted_never_silent(monkeypatch):
+    from paddle_tpu.ps import box_cache as bc
+
+    srv, client, box = _box_over_server()
+
+    def broken_push(cl, name, ids, grads, lr):
+        raise RuntimeError("server rejected the push")
+
+    monkeypatch.setattr(bc, "push_row_grads", broken_push)
+    drops0 = ps_client_mod.GRAD_DROPS.value(var="t")
+    box.push_sparse_grad("t", np.array([1, 2]),
+                         np.ones((2, 4), np.float32), lr=0.5)
+    box.end_pass()        # drains; RPC failures drop WITH accounting
+    assert box.flush_drops == 2
+    assert ps_client_mod.GRAD_DROPS.value(var="t") == drops0 + 2
+    assert any(e.get("action") == "flush_drop"
+               for e in events.recent(kind="ps_failover"))
+    assert not box._pending, "drop must still release the pending marks"
+    srv.stop()
+
+
+def test_box_flusher_death_reraised_at_pass_boundary(monkeypatch):
+    from paddle_tpu.ps import box_cache as bc
+
+    srv, client, box = _box_over_server()
+
+    class _Doom(BaseException):
+        """Escapes the per-batch except Exception — models a flusher
+        bug, not an RPC failure."""
+
+    def doomed_push(cl, name, ids, grads, lr):
+        raise _Doom("flusher bug")
+
+    monkeypatch.setattr(bc, "push_row_grads", doomed_push)
+    box.push_sparse_grad("t", np.array([3]), np.ones((1, 4), np.float32))
+    deadline = time.monotonic() + 10
+    while box._flusher_exc is None and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert box._flusher_exc is not None, "flusher death went unrecorded"
+    assert any(e.get("action") == "flusher_error"
+               for e in events.recent(kind="ps_failover"))
+    with pytest.raises(RuntimeError, match="flusher thread died"):
+        box.close()       # join-and-reraise on the owner's thread
+    # the error is consumed: the next pass is usable again
+    monkeypatch.setattr(bc, "push_row_grads",
+                        lambda *a, **k: None)
+    box.begin_pass()
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Recovery routing
+# ---------------------------------------------------------------------------
+
+
+def test_train_loop_routes_ps_unavailable_through_policy():
+    from paddle_tpu.parallel.train import train_loop
+    from paddle_tpu.resilience import RecoveryController, RecoveryPolicy
+
+    class _State:
+        def __init__(self, step):
+            self.step = step
+            self.opt_state = None
+
+    fired = {"n": 0}
+
+    def flaky_step(state, batch, rng):
+        # one transient outage at step 2: the step fails WITHOUT
+        # advancing the state (the pull never completed), exactly what
+        # an exhausted PS retry budget looks like to the loop
+        if state.step == 2 and fired["n"] == 0:
+            fired["n"] += 1
+            raise PSUnavailableError("ps down mid-step",
+                                     endpoint="e", op="get_many")
+        return _State(state.step + 1), 0.5
+
+    # no controller: the typed error propagates
+    with pytest.raises(PSUnavailableError):
+        train_loop(flaky_step, _State(0), [{} for _ in range(5)])
+    # skip_batch policy: the outage batch is skipped, the step retries
+    # on the next batch (against the recovered server), training ends
+    fired["n"] = 0
+    ctl = RecoveryController(RecoveryPolicy(on_numerics="skip_batch"))
+    state, losses, stop = train_loop(
+        flaky_step, _State(0), [{} for _ in range(5)], controller=ctl)
+    assert stop == "completed" and ctl.skips == 1
+    # 5 batches, one burned by the outage: steps 0..3 executed
+    assert state.step == 4 and sorted(losses) == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# Durable server snapshots
+# ---------------------------------------------------------------------------
+
+
+def test_server_snapshot_restore_resumes_tables(tmp_path):
+    (ep,) = _free_eps(1)
+    srv = ParameterServer(ep, num_trainers=1, mode="async",
+                          snapshot_dir=str(tmp_path))
+    srv.start_background()
+    client = PSClient([ep])
+    _init_w(client)
+    client.init_var("emb", np.arange(20, dtype=np.float32).reshape(4, 5))
+    client.push_grad("w", np.ones(3, np.float32))       # w -> -1
+    out = client.snapshot_servers()
+    assert out[ep]["ok"] and os.path.isdir(out[ep]["dir"])
+    assert os.path.exists(os.path.join(out[ep]["dir"], "_COMMITTED.json"))
+    client.push_grad("w", np.ones(3, np.float32))       # w -> -2, NOT saved
+    client.push_sparse_grad("emb", np.array([1]),
+                            np.ones((1, 5), np.float32), lr=1.0)
+    srv.stop()
+
+    srv2 = ParameterServer(ep, num_trainers=1, mode="async",
+                           snapshot_dir=str(tmp_path))
+    # restored to the COMMITTED snapshot: post-snapshot pushes are gone
+    np.testing.assert_allclose(srv2.vars["w"].value, -np.ones(3))
+    np.testing.assert_allclose(
+        srv2.vars["emb"].value,
+        np.arange(20, dtype=np.float32).reshape(4, 5))
+    assert float(srv2.aux["lr"][0]) == 1.0
+    assert srv2.aux_owner == {"lr": "w"}
+    assert any(e.get("action") == "restored"
+               for e in events.recent(kind="ps_failover"))
+    # the restored opt descs still apply: training continues
+    srv2.start_background()
+    client2 = PSClient([ep])
+    client2.push_grad("w", np.ones(3, np.float32))
+    np.testing.assert_allclose(client2.pull("w"), -2 * np.ones(3))
+    srv2.stop()
+
+
+def test_server_snapshot_retention(tmp_path):
+    (ep,) = _free_eps(1)
+    srv = ParameterServer(ep, num_trainers=1, mode="async",
+                          snapshot_dir=str(tmp_path), snapshot_keep_last=2)
+    srv.vars["w"] = __import__(
+        "paddle_tpu.ps.server", fromlist=["_VarState"])._VarState(
+        np.zeros(2, np.float32), [])
+    for _ in range(4):
+        srv.vars["w"].value = srv.vars["w"].value + 1
+        srv.snapshot()
+    kept = sorted(d for d in os.listdir(tmp_path) if d.startswith("step_"))
+    assert kept == ["step_2", "step_3"], kept
+    srv.stop()
+
+
+def test_periodic_snapshot_thread_skips_when_clean(tmp_path):
+    (ep,) = _free_eps(1)
+    srv = ParameterServer(ep, num_trainers=1, mode="async",
+                          snapshot_dir=str(tmp_path),
+                          snapshot_every_s=0.1)
+    srv.start_background()
+    client = PSClient([ep])
+    _init_w(client)
+    deadline = time.monotonic() + 10
+    while not srv._snap_mgr.committed_steps() and \
+            time.monotonic() < deadline:
+        time.sleep(0.05)
+    n1 = len(srv._snap_mgr.committed_steps())
+    assert n1 >= 1, "dirty state never snapshotted"
+    time.sleep(0.4)       # no mutations: no new snapshots
+    n2 = len(srv._snap_mgr.committed_steps())
+    assert n2 <= n1 + 1, "clean server kept snapshotting"
+    client.push_grad("w", np.ones(3, np.float32))
+    deadline = time.monotonic() + 10
+    while len(srv._snap_mgr.committed_steps()) <= n2 and \
+            time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert len(srv._snap_mgr.committed_steps()) > n2, \
+        "mutation never triggered a periodic snapshot"
+    srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Subprocess: SIGKILL a live server mid-training, resume from snapshot
+# ---------------------------------------------------------------------------
+
+
+def _spawn_ps_server(ep, snap_dir, extra_env=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get(
+                   "PYTHONPATH", ""))
+    env.pop("PADDLE_TPU_FAULT_SPEC", None)
+    env.update(extra_env or {})
+    p = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_bench.py"),
+         "--ps-server", "--endpoint", ep, "--snapshot-dir", snap_dir],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=REPO)
+    host, port = ep.rsplit(":", 1)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if p.poll() is not None:
+            return p
+        try:
+            socket.create_connection((host, int(port)), 0.2).close()
+            return p
+        except OSError:
+            time.sleep(0.05)
+    raise RuntimeError("ps server subprocess never bound")
+
+
+def test_sigkill_server_resumes_from_committed_snapshot(tmp_path):
+    """A live PS server SIGKILLed mid-training: the respawn restores
+    the committed snapshot, a push that was retrying THROUGH the outage
+    applies exactly once, and no gradient is double-applied."""
+    (ep,) = _free_eps(1)
+    snap = str(tmp_path / "snap")
+    p1 = _spawn_ps_server(ep, snap)
+    try:
+        client = PSClient([ep], rpc_deadline_s=60.0)
+        _init_w(client)
+        client.push_grad("w", np.ones(3, np.float32))      # w -> -1
+        assert client.snapshot_servers()[ep]["ok"]
+        client.push_grad("w", np.ones(3, np.float32))      # w -> -2 (lost)
+        p1.kill()
+        p1.wait(timeout=10)
+
+        got = {}
+
+        def pending_push():
+            # issued while the server is DOWN: retries until the
+            # respawn, then must apply exactly once
+            client.push_grad("w", np.full(3, 2.0, np.float32))
+            got["pushed"] = True
+
+        t = threading.Thread(target=pending_push, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        assert "pushed" not in got, "push completed against a dead server"
+        p2 = _spawn_ps_server(ep, snap)
+        try:
+            t.join(timeout=60)
+            assert got.get("pushed"), "push never landed after respawn"
+            # restored -1 (committed), NOT -2 (post-snapshot push died
+            # with the server); the retried push applied exactly once
+            np.testing.assert_allclose(client.pull("w"),
+                                       np.full(3, -3.0), rtol=1e-6)
+        finally:
+            p2.kill()
+            p2.wait(timeout=10)
+    finally:
+        if p1.poll() is None:
+            p1.kill()
+            p1.wait(timeout=10)
+
+
+def test_ps_server_crash_fault_clause(tmp_path):
+    """`ps_server=N:crash` kills exactly the server whose slot index
+    matches, with the crash exit code — the deterministic chaos lever
+    for the PS tier."""
+    from paddle_tpu.resilience.faults import CRASH_EXIT_CODE
+
+    (ep,) = _free_eps(1)
+    p = _spawn_ps_server(ep, str(tmp_path / "s0"),
+                         extra_env={"PADDLE_TPU_FAULT_SPEC":
+                                    "ps_server=0:crash"})
+    try:
+        client = PSClient([ep], rpc_deadline_s=2.0)
+        with pytest.raises(PSUnavailableError):
+            client.init_var("w", np.zeros(2, np.float32))
+        assert p.wait(timeout=30) == CRASH_EXIT_CODE
+    finally:
+        if p.poll() is None:
+            p.kill()
+
+
+# ---------------------------------------------------------------------------
+# Tooling
+# ---------------------------------------------------------------------------
+
+
+def test_obsdump_ps_subcommand(tmp_path):
+    from paddle_tpu.observability import metrics as m
+
+    (ep,) = _free_eps(1)
+    srv = ParameterServer(ep, num_trainers=1, mode="async")
+    srv.start_background()
+    client = PSClient([ep])
+    _init_w(client)
+    client.push_grad("w", np.ones(3, np.float32))
+    srv.stop()
+    m.dump(str(tmp_path))
+
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obsdump.py"), "ps",
+         str(tmp_path / "metrics.json"), "--json"],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    rep = json.loads(out.stdout)
+    ops = {r["op"] for r in rep["rpc"]}
+    assert {"init_var", "send_grad"} <= ops
+    assert all(r["ok"] >= 1 for r in rep["rpc"]
+               if r["op"] in ("init_var", "send_grad"))
+
+
+@pytest.mark.slow
+def test_chaos_bench_ps_smoke():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_bench.py"),
+         "--ps", "--smoke"],
+        capture_output=True, text=True, timeout=500, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    metrics = {}
+    for line in out.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            rec = json.loads(line)
+            metrics[rec["metric"]] = rec
+    for name in ("ps_outage_seconds", "ps_degraded_seconds",
+                 "ps_rpc_retries", "ps_reconnects",
+                 "ps_max_step_seconds", "ps_equivalence_ok"):
+        assert name in metrics, sorted(metrics)
+    assert metrics["ps_equivalence_ok"]["value"] == 1.0, \
+        metrics["ps_equivalence_ok"]["detail"]
+    assert metrics["ps_rpc_retries"]["value"] >= 1
+    # the no-180s-stall acceptance: the worst step cost is bounded by
+    # the outage plus retry slack
+    assert metrics["ps_max_step_seconds"]["value"] < 60.0

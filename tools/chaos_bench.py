@@ -36,6 +36,22 @@ double-seen across either membership change).
 
 Run:  python tools/chaos_bench.py --elastic [--smoke]
       [--world 4] [--kill-at 2] [--join-at 8] [--tol 1e-3]
+
+PS mode (--ps, RESILIENCE.md §Parameter-server fault tolerance): a CTR
+workload (PS-sharded embedding + transpiled dense params, async mode)
+trains against S pserver processes snapshotting through their own
+CheckpointManager. Mid-run the orchestrator SIGKILLs one server and
+respawns it on the same endpoint after --outage seconds; the respawn
+restores its committed sparse+dense snapshot, and the single trainer
+process rides the outage on the resilient client (reconnect + capped
+backoff + idempotent retry + circuit breaker) with ZERO trainer
+restarts. The report carries the loss-trajectory delta vs an
+uninterrupted baseline (--tol), plus the degraded-seconds / rpc-retry /
+reconnect metrics that prove the outage cost bounded step time (no
+180 s socket stall).
+
+Run:  python tools/chaos_bench.py --ps [--smoke]
+      [--ps-servers 2] [--kill-at 4] [--outage 0.5] [--tol 0.05]
 """
 
 from __future__ import annotations
@@ -84,6 +100,32 @@ def _build_args():
     ap.add_argument("--step-delay", type=float, default=0.15,
                     help="elastic: host-side seconds per step, so "
                     "membership changes land mid-run deterministically")
+    # PS failover chaos (see module docstring)
+    ap.add_argument("--ps", action="store_true",
+                    help="parameter-server failover chaos: SIGKILL one "
+                    "pserver mid-CTR-run, respawn it from its committed "
+                    "snapshot, trainers ride through")
+    ap.add_argument("--ps-servers", type=int, default=2,
+                    help="ps: number of pserver processes")
+    ap.add_argument("--outage", type=float, default=0.5,
+                    help="ps: seconds between the SIGKILL and the "
+                    "respawn")
+    ap.add_argument("--rpc-deadline", type=float, default=60.0,
+                    help="ps: trainer-side per-call retry budget "
+                    "(PADDLE_TPU_PS_RPC_DEADLINE_S)")
+    # internal PS roles
+    ap.add_argument("--ps-server", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--ps-trainer", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--endpoint", type=str, default="",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--snapshot-dir", type=str, default="",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--server-index", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--ps-endpoints", type=str, default="",
+                    help=argparse.SUPPRESS)
     # internal: run one training process instead of orchestrating
     ap.add_argument("--worker", action="store_true",
                     help=argparse.SUPPRESS)
@@ -554,6 +596,348 @@ def _elastic_report_text(text):
 
 
 # ---------------------------------------------------------------------------
+# PS failover roles (see module docstring: --ps)
+# ---------------------------------------------------------------------------
+
+
+def run_ps_server(args) -> int:
+    """One pserver process (async mode, single trainer) with durable
+    snapshots through its own CheckpointManager; serves until killed or
+    shut down by the trainer. A respawn on the same endpoint +
+    snapshot dir restores the committed tables at construction."""
+    from paddle_tpu.ps.server import ParameterServer
+
+    srv = ParameterServer(args.endpoint, num_trainers=1, mode="async",
+                          snapshot_dir=args.snapshot_dir or None,
+                          server_index=args.server_index)
+    print(json.dumps({"ps_server": args.endpoint, "pid": os.getpid(),
+                      "restored_vars": len(srv.vars),
+                      "generation": srv._generation}), flush=True)
+    srv.serve_forever()
+    return 0
+
+
+def run_ps_trainer(args) -> int:
+    """The CTR trainer: PS-sharded embedding (distributed_lookup_table)
+    + transpiled dense params, async mode, deterministic per-step
+    batches. Snapshots every server each --save-every steps (the
+    durable-state cadence), writes per-step progress for the
+    orchestrator, and reports losses + the resilience metrics that
+    prove a mid-run server kill cost bounded step time."""
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.observability import metrics as _m
+    from paddle_tpu.ops.distributed import bind_client
+    from paddle_tpu.ps import (DistributeTranspiler,
+                               DistributeTranspilerConfig, PSClient)
+    from paddle_tpu.ps.sparse_table import init_sparse_table
+    from paddle_tpu.resilience.atomic import json_dump
+
+    eps = args.ps_endpoints.split(",")
+    V, D = 40, 8
+    rng = np.random.RandomState(0)
+    table = rng.rand(V, D).astype("float32") * 0.1
+
+    main, startup = pt.Program(), pt.Program()
+    main.random_seed = startup.random_seed = 7
+    with pt.framework.unique_name.guard(), pt.program_guard(main, startup):
+        wf = pt.layers.data(name="wf", shape=[1], dtype="float32")
+        label = pt.layers.data(name="label", shape=[1], dtype="float32")
+        ids64 = pt.layers.cast(wf, "int64")
+        emb = pt.layers.distributed_embedding(ids64, (V, D), "ctr_table",
+                                              sparse_lr=0.3)
+        emb = pt.layers.reshape(emb, shape=[-1, D])
+        pred = pt.layers.fc(input=emb, size=1, act="sigmoid")
+        loss = pt.layers.mean(pt.layers.log_loss(pred, label))
+        pt.optimizer.SGD(0.05).minimize(loss)
+
+    cfg = DistributeTranspilerConfig()
+    cfg.sync_mode = False
+    t = DistributeTranspiler(cfg)
+    t.transpile(0, program=main, pservers=args.ps_endpoints, trainers=1,
+                sync_mode=False)
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    client = PSClient(eps, rpc_deadline_s=args.rpc_deadline)
+    bind_client(client)
+    t.publish_params(pt.global_scope(), client)
+    init_sparse_table(client, "ctr_table", table)
+    client.snapshot_servers()   # snapshot 0: the post-init state
+    prog = t.get_trainer_program()
+
+    def batch(step):
+        rs = np.random.RandomState((step + 1) * 7919)
+        ids = rs.randint(0, V, (16, 1))
+        return {"wf": ids.astype(np.float32),
+                "label": (ids % 3 == 0).astype(np.float32)}
+
+    losses = {}
+    step_secs = []
+    snap_latest = -1
+    for step in range(args.steps):
+        if args.step_delay:
+            time.sleep(args.step_delay)
+        fd = batch(step)
+        t0 = time.perf_counter()
+        val = exe.run(prog, feed=fd, fetch_list=[loss])[0]
+        step_secs.append(time.perf_counter() - t0)
+        losses[step] = float(np.asarray(val).reshape(()))
+        if args.save_every and (step + 1) % args.save_every == 0:
+            client.snapshot_servers()
+            snap_latest = step
+        if args.progress_file:
+            json_dump({"step": step, "snapshotted": snap_latest},
+                      args.progress_file)
+
+    snap = _m.snapshot()
+
+    def total(name, outcome=None):
+        out = 0.0
+        for s in (snap.get(name) or {}).get("series", []):
+            if outcome is None or \
+                    s.get("labels", {}).get("outcome") == outcome:
+                out += s.get("value", 0)
+        return out
+
+    print(json.dumps({
+        "worker": "ps", "pid": os.getpid(),
+        "losses": {str(k): v for k, v in losses.items()},
+        "steps_done": len(losses),
+        "max_step_s": round(max(step_secs), 4),
+        "degraded_s": round(total("paddle_tpu_ps_degraded_seconds_total"),
+                            4),
+        "retries": int(total("paddle_tpu_ps_rpc_total", "retry")),
+        "unavailable": int(total("paddle_tpu_ps_rpc_total",
+                                 "unavailable")),
+        "reconnects": int(total("paddle_tpu_ps_reconnects_total")),
+    }), flush=True)
+    client.shutdown_servers()
+    return 0
+
+
+def _ps_report(text):
+    for line in reversed((text or "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rep = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rep.get("worker") == "ps":
+                return rep
+    return None
+
+
+def run_ps_bench(args) -> int:
+    """Orchestrate the PS failover scenario: S servers + 1 CTR trainer;
+    SIGKILL server 0 right after a committed snapshot, respawn it on
+    the same endpoint after --outage seconds (it restores the
+    snapshot), and require (a) the trainer rides through with ZERO
+    restarts, (b) the full loss trajectory within --tol of an
+    uninterrupted baseline, (c) the outage cost bounded step time,
+    evidenced by the degraded-seconds / retry / reconnect metrics."""
+    import socket as _socket
+    import time
+
+    work = tempfile.mkdtemp(prefix="chaos_ps_")
+    failures = []
+    procs = []
+
+    def env_for():
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PADDLE_TPU_FAULT_SPEC", None)
+        return env
+
+    def free_eps(n):
+        socks, eps = [], []
+        for _ in range(n):
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+            eps.append(f"127.0.0.1:{s.getsockname()[1]}")
+        for s in socks:
+            s.close()
+        return eps
+
+    def spawn_server(i, ep, snap_dir, log):
+        cmd = [sys.executable, os.path.abspath(__file__), "--ps-server",
+               "--endpoint", ep, "--snapshot-dir", snap_dir,
+               "--server-index", str(i)]
+        p = subprocess.Popen(cmd, stdout=open(log, "a"),  # atomic-exempt: live log stream
+                             stderr=subprocess.STDOUT, cwd=_REPO,
+                             env=env_for())
+        procs.append(p)
+        return p
+
+    def wait_ep(ep, timeout=20.0):
+        host, port = ep.rsplit(":", 1)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                _socket.create_connection((host, int(port)), 0.2).close()
+                return True
+            except OSError:
+                time.sleep(0.05)
+        return False
+
+    def trainer_cmd(eps, progress=""):
+        cmd = [sys.executable, os.path.abspath(__file__), "--ps-trainer",
+               "--ps-endpoints", ",".join(eps),
+               "--steps", str(args.steps),
+               "--save-every", str(args.save_every),
+               "--step-delay", str(args.step_delay),
+               "--rpc-deadline", str(args.rpc_deadline)]
+        if progress:
+            cmd += ["--progress-file", progress]
+        return cmd
+
+    outage_s = None
+    rep = {}
+    try:
+        # -- baseline: no faults ------------------------------------------
+        base_eps = free_eps(args.ps_servers)
+        for i, ep in enumerate(base_eps):
+            spawn_server(i, ep, os.path.join(work, f"base_snap_{i}"),
+                         os.path.join(work, f"base_server_{i}.log"))
+        for ep in base_eps:
+            if not wait_ep(ep):
+                raise SystemExit(f"chaos --ps: baseline server {ep} "
+                                 f"never bound")
+        base = subprocess.run(trainer_cmd(base_eps), capture_output=True,
+                              text=True, timeout=args.timeout_s,
+                              cwd=_REPO, env=env_for())
+        base_rep = _ps_report(base.stdout)
+        if base.returncode != 0 or base_rep is None:
+            print(base.stdout + base.stderr, file=sys.stderr)
+            raise SystemExit("chaos --ps: baseline run failed")
+
+        # -- chaos run ----------------------------------------------------
+        eps = free_eps(args.ps_servers)
+        servers = {}
+        for i, ep in enumerate(eps):
+            servers[i] = spawn_server(
+                i, ep, os.path.join(work, f"snap_{i}"),
+                os.path.join(work, f"server_{i}.log"))
+        for ep in eps:
+            if not wait_ep(ep):
+                raise SystemExit(f"chaos --ps: server {ep} never bound")
+        progress = os.path.join(work, "progress.json")
+        trainer = subprocess.Popen(
+            trainer_cmd(eps, progress), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, cwd=_REPO, env=env_for())
+
+        def wait_progress(pred, what, timeout):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if trainer.poll() is not None:
+                    return False  # dead trainer can't satisfy pred
+                if pred(_read_progress(progress)):
+                    return True
+                time.sleep(0.05)
+            failures.append(f"timeout waiting for {what}")
+            return False
+
+        # kill server 0 right AFTER a committed snapshot at/after
+        # --kill-at: the restored state then trails the live state by at
+        # most the couple of steps the kill latency admits (--tol
+        # absorbs those lost updates)
+        if wait_progress(lambda p: p.get("snapshotted", -1) >= args.kill_at,
+                         "kill snapshot", args.timeout_s):
+            victim = servers[0]
+            victim.kill()
+            victim.wait(timeout=10)
+            t_kill = time.time()
+            time.sleep(args.outage)
+            servers[0] = spawn_server(
+                0, eps[0], os.path.join(work, "snap_0"),
+                os.path.join(work, "server_0.log"))
+            if not wait_ep(eps[0]):
+                failures.append("respawned server 0 never bound")
+            outage_s = time.time() - t_kill
+        try:
+            out, err = trainer.communicate(timeout=args.timeout_s)
+        except subprocess.TimeoutExpired:
+            trainer.kill()
+            out, err = trainer.communicate()
+            failures.append("trainer timed out (outage not survived)")
+        rep = _ps_report(out) or {}
+        if trainer.returncode != 0 or not rep:
+            failures.append(f"trainer rc={trainer.returncode}: "
+                            f"{(err or '')[-500:]}")
+        # -- acceptance ---------------------------------------------------
+        if rep:
+            if rep.get("steps_done") != args.steps:
+                failures.append(f"trainer finished {rep.get('steps_done')}"
+                                f"/{args.steps} steps")
+            for step, loss in rep.get("losses", {}).items():
+                ref = base_rep["losses"].get(step)
+                if ref is None or abs(loss - ref) > \
+                        args.tol * max(1.0, abs(ref)):
+                    failures.append(
+                        f"step {step}: chaos loss {loss} vs baseline "
+                        f"{ref} beyond tol {args.tol}")
+                    break
+            if rep.get("reconnects", 0) < 1:
+                failures.append("trainer never reconnected — did the "
+                                "kill land?")
+            if rep.get("retries", 0) < 1:
+                failures.append("no rpc retries recorded during the "
+                                "outage")
+            if rep.get("degraded_s", 0.0) <= 0.0:
+                failures.append("degraded-seconds metric stayed zero")
+            # the no-180s-stall bound: the worst step costs at most the
+            # outage plus breaker/backoff slack, never a socket timeout
+            bound = (outage_s or args.outage) + 30.0
+            if rep.get("max_step_s", 0.0) > bound:
+                failures.append(f"max step {rep['max_step_s']}s exceeds "
+                                f"outage+slack bound {bound:.1f}s")
+        # respawned server restored its snapshot?
+        try:
+            with open(os.path.join(work, "server_0.log")) as f:
+                boots = [json.loads(l) for l in f
+                         if l.strip().startswith("{")]
+            if len(boots) >= 2 and boots[-1].get("restored_vars", 0) < 1:
+                failures.append("respawned server 0 restored no vars "
+                                "(snapshot not found?)")
+        except (OSError, ValueError) as e:
+            failures.append(f"cannot verify respawn restore: {e}")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(work, ignore_errors=True)
+
+    detail = {
+        "steps": args.steps, "save_every": args.save_every,
+        "servers": args.ps_servers, "kill_at": args.kill_at,
+        "outage_requested_s": args.outage, "tol": args.tol,
+        "trainer_restarts": 0,   # by construction: one trainer process
+        "retries": rep.get("retries"), "reconnects": rep.get("reconnects"),
+        "unavailable": rep.get("unavailable"),
+        "failures": failures, "smoke": bool(args.smoke),
+    }
+    for metric, value, unit in (
+            ("ps_outage_seconds",
+             round(outage_s, 3) if outage_s else None, "s"),
+            ("ps_degraded_seconds", rep.get("degraded_s"), "s"),
+            ("ps_rpc_retries", rep.get("retries"), "count"),
+            ("ps_reconnects", rep.get("reconnects"), "count"),
+            ("ps_max_step_seconds", rep.get("max_step_s"), "s"),
+            ("ps_equivalence_ok", 0.0 if failures else 1.0, "bool")):
+        print(json.dumps({
+            "metric": metric,
+            "value": round(value, 6) if isinstance(value, float) else value,
+            "unit": unit, "detail": detail}), flush=True)
+    if failures:
+        print("\n".join(failures), file=sys.stderr)
+    return 1 if failures else 0
+
+
+# ---------------------------------------------------------------------------
 # Orchestrator mode
 # ---------------------------------------------------------------------------
 
@@ -663,6 +1047,29 @@ def run_bench(args) -> int:
 def main() -> int:
     args = _build_args()
     sys.path.insert(0, _REPO)
+    if args.ps_server:
+        if not args.endpoint:
+            raise SystemExit("--ps-server needs --endpoint")
+        return run_ps_server(args)
+    if args.ps_trainer:
+        if not args.ps_endpoints:
+            raise SystemExit("--ps-trainer needs --ps-endpoints")
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        return run_ps_trainer(args)
+    if args.ps:
+        # host-side CPU scenario end to end (pservers are host processes,
+        # the trainer is forced to CPU): no TPU singleflight needed
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        if args.smoke:
+            args.steps, args.save_every = 12, 2
+            args.kill_at, args.outage = 4, 0.5
+            args.ps_servers = min(args.ps_servers, 2)
+        if args.tol == 1e-3:
+            # the elastic default is bit-tight; a PS kill legitimately
+            # loses the couple of steps between the last snapshot and
+            # the SIGKILL landing — 5% relative absorbs them
+            args.tol = 0.05
+        return run_ps_bench(args)
     if args.member:
         if not (args.member_id and args.rdzv_dir):
             raise SystemExit("--member needs --member-id and --rdzv-dir")

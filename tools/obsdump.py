@@ -325,6 +325,84 @@ def cmd_analysis(args) -> int:
     return 0
 
 
+def cmd_ps(args) -> int:
+    """Parameter-server resilience story from a metrics snapshot
+    (RESILIENCE.md §Parameter-server fault tolerance): RPC outcomes per
+    op, reconnects + breaker state per endpoint, degraded seconds,
+    gradient drops per var, and dedup-served retries. With --events it
+    also tails the ps_failover events from a JSONL log."""
+    snap = _load_snap(args)
+    if snap is None:
+        print("ps: need a metrics.json path or --live", file=sys.stderr)
+        return 2
+
+    def series(name):
+        return (snap.get(name) or {}).get("series", [])
+
+    rpc = {}  # (op, outcome) -> count
+    for s in series("paddle_tpu_ps_rpc_total"):
+        labels = s.get("labels", {})
+        key = (labels.get("op", "?"), labels.get("outcome", "?"))
+        rpc[key] = rpc.get(key, 0) + int(s["value"])
+    endpoints = {}  # ep -> {reconnects, degraded_s, breaker}
+    for s in series("paddle_tpu_ps_reconnects_total"):
+        ep = s.get("labels", {}).get("endpoint", "?")
+        endpoints.setdefault(ep, {})["reconnects"] = int(s["value"])
+    for s in series("paddle_tpu_ps_degraded_seconds_total"):
+        ep = s.get("labels", {}).get("endpoint", "?")
+        endpoints.setdefault(ep, {})["degraded_s"] = round(
+            float(s["value"]), 3)
+    state_names = {0: "closed", 1: "half_open", 2: "open"}
+    for s in series("paddle_tpu_ps_breaker_state"):
+        ep = s.get("labels", {}).get("endpoint", "?")
+        endpoints.setdefault(ep, {})["breaker"] = state_names.get(
+            int(s.get("value", 0)), "?")
+    drops = {s.get("labels", {}).get("var", "?"): int(s["value"])
+             for s in series("paddle_tpu_ps_grad_drops_total")}
+    dedups = sum(int(s["value"])
+                 for s in series("paddle_tpu_ps_dedup_replies_total"))
+
+    if not rpc and not endpoints and not drops:
+        print("no ps_* samples in this snapshot (did a PS client/server "
+              "run in this process?)")
+        return 0
+
+    outcomes = ("ok", "error", "retry", "unavailable")
+    rpc_rows = []
+    for op in sorted({o for o, _ in rpc}):
+        row = {"op": op}
+        for oc in outcomes:
+            row[oc] = rpc.get((op, oc), 0)
+        rpc_rows.append(row)
+    ep_rows = [{"endpoint": ep,
+                "breaker": info.get("breaker", "closed"),
+                "reconnects": info.get("reconnects", 0),
+                "degraded_s": info.get("degraded_s", 0.0)}
+               for ep, info in sorted(endpoints.items())]
+    out = {"rpc": rpc_rows, "endpoints": ep_rows,
+           "grad_drops": drops, "dedup_replies": dedups}
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    if rpc_rows:
+        _print_aligned(rpc_rows, ("op",) + outcomes)
+    if ep_rows:
+        print()
+        _print_aligned(ep_rows, ("endpoint", "breaker", "reconnects",
+                                 "degraded_s"))
+    print(f"\ndedup-served retries: {dedups}")
+    if drops:
+        print("grad drops: " + ", ".join(f"{k}={v}"
+                                         for k, v in sorted(drops.items())))
+    if args.events:
+        evs = _load_obs_module("events").read_jsonl(args.events, n=args.n,
+                                                    kind="ps_failover")
+        print(f"\nlast {len(evs)} ps_failover events:")
+        for ev in evs:
+            print("  " + _fmt_event(ev))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="obsdump", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -378,6 +456,22 @@ def main(argv=None) -> int:
     anp.add_argument("--json", action="store_true",
                      help="JSON instead of the aligned table")
     anp.set_defaults(fn=cmd_analysis)
+
+    pp = sub.add_parser("ps", help="parameter-server resilience summary "
+                        "(RPC outcomes, breakers, reconnects, drops) "
+                        "from a metrics snapshot")
+    pp.add_argument("path", nargs="?", help="metrics.json from "
+                    "PADDLE_TPU_METRICS_DIR (omit with --live)")
+    pp.add_argument("--live", action="store_true",
+                    help="read this process's registry instead of a file")
+    pp.add_argument("--json", action="store_true",
+                    help="JSON instead of the aligned tables")
+    pp.add_argument("--events", default=None, metavar="JSONL",
+                    help="also tail ps_failover events from this event "
+                    "log")
+    pp.add_argument("-n", type=int, default=20,
+                    help="with --events: last N events (default 20)")
+    pp.set_defaults(fn=cmd_ps)
 
     # unknown/missing subcommands exit nonzero via argparse itself
     # (required=True subparsers error out with status 2)
