@@ -657,7 +657,7 @@ class _CompiledStep:
             OrderedDict()
 
     def chained_fn(self, n_steps: int, per_step_feeds: bool = False,
-                   unroll: bool = False):
+                   unroll="auto", platform: Optional[str] = None):
         """n_steps program iterations scan-chained in ONE executable.
         Amortizes the fixed per-invocation dispatch/host-tunnel cost
         (~100 ms on tunneled backends, PROFILE.md) so repeated-step
@@ -673,8 +673,19 @@ class _CompiledStep:
         straight-line code (on CPU a conv inside the rolled while-loop
         runs ~2x slower than the same conv inlined), trading compile
         time proportional to n_steps. The streaming driver uses it for
-        its small windows; leave it off for big n_steps."""
-        key = (n_steps, per_step_feeds, unroll)
+        its small windows. "auto" resolves per backend: unrolled on CPU
+        (up to _UNROLL_WINDOW_MAX — the rolled while-loop is the
+        BENCH_r05 2.6x per-step regression, reproduced by a pure-jax
+        control, so it is opt-in there), rolled elsewhere (one bounded
+        compile, no CPU penalty applies)."""
+        if unroll == "auto":
+            # resolve against the EXECUTING device's platform when the
+            # caller supplies it (run_chained passes the place's) — a
+            # CPUPlace executor on a TPU-default host must still get
+            # the unrolled CPU path
+            unroll = ((platform or jax.default_backend()) == "cpu"
+                      and n_steps <= _UNROLL_WINDOW_MAX)
+        key = (n_steps, per_step_feeds, bool(unroll))
         fn = self._chained.get(key)
         if fn is not None:
             self._chained.move_to_end(key)
@@ -724,8 +735,11 @@ class _CompiledStep:
             new_states.update(rest_f)
             return stacked, new_states, rng_f
 
+        # donate mut_states AND the rng key: together with `rest`
+        # (created inside) that is the whole scan carry, so XLA can
+        # alias every carry component in place of an input buffer
         fn = _JitDispatch(
-            jax.jit(chained, donate_argnums=(2,)), "chained",
+            jax.jit(chained, donate_argnums=(2, 3)), "chained",
             meta={"n_steps": int(n_steps),
                   "per_step_feeds": bool(per_step_feeds),
                   "unroll": bool(unroll)},
@@ -739,18 +753,57 @@ class _CompiledStep:
 
     def run_chained(self, scope: Scope, feed: Dict[str, Any], rng,
                     n_steps: int, per_step_feeds: bool = False,
-                    unroll: bool = False):
+                    unroll=False, platform: Optional[str] = None):
         """Like __call__ but n_steps scan-chained; fetches come back
         stacked along a leading [n_steps] axis. With per_step_feeds,
         each feed value carries its own leading [n_steps] axis and step
-        i consumes slice i."""
+        i consumes slice i. unroll="auto" picks per backend (see
+        chained_fn); on CPU with n_steps beyond the unroll cap the run
+        is split into unrolled windows instead of rolling the scan."""
+        plat = platform or jax.default_backend()
+        if unroll == "auto" and plat == "cpu" \
+                and n_steps > _UNROLL_WINDOW_MAX:
+            return self._run_chained_windowed(scope, feed, rng, n_steps,
+                                              per_step_feeds)
         const_states, mut_states = self._gather_states(scope)
         fetches, new_states, new_rng = self.chained_fn(
-            n_steps, per_step_feeds, unroll)(feed, const_states,
-                                             mut_states, rng)
+            n_steps, per_step_feeds, unroll,
+            platform=plat)(feed, const_states, mut_states, rng)
         for n, v in new_states.items():
             scope.set_var(n, v)
         return fetches, new_rng
+
+    def _run_chained_windowed(self, scope: Scope, feed, rng,
+                              n_steps: int, per_step_feeds: bool):
+        """CPU fallback for big chained runs: XLA-CPU executes convs
+        inside a rolled while-loop ~2.6x slower than straight-line
+        code (BENCH_r05's scan-chained regression; a pure-jax
+        loop-vs-scan control reproduces it, so it is the backend, not
+        lost donation), so n_steps is split into <=_UNROLL_WINDOW_MAX
+        unrolled windows — identical sequential semantics and rng
+        stream, a handful of dispatches instead of one (dispatch
+        overhead on CPU is microseconds, not the tunnel's ~100ms)."""
+        out_chunks: Optional[List[List[Any]]] = None
+        done = 0
+        while done < n_steps:
+            n = min(_UNROLL_WINDOW_MAX, n_steps - done)
+            chunk = feed if not per_step_feeds else \
+                {k: v[done:done + n] for k, v in feed.items()}
+            const_states, mut_states = self._gather_states(scope)
+            fetches, new_states, rng = self.chained_fn(
+                n, per_step_feeds, True)(chunk, const_states,
+                                         mut_states, rng)
+            for name, v in new_states.items():
+                scope.set_var(name, v)
+            if out_chunks is None:
+                out_chunks = [[f] for f in fetches]
+            else:
+                for lst, f in zip(out_chunks, fetches):
+                    lst.append(f)
+            done += n
+        fetches = [jnp.concatenate(ch) if len(ch) > 1 else ch[0]
+                   for ch in (out_chunks or [])]
+        return fetches, rng
 
     def _gather_states(self, scope: Scope):
         const_states = {}
@@ -912,7 +965,7 @@ class Executor:
 
     def run_chained(self, program=None, feed=None, fetch_list=None,
                     n_steps=1, scope=None, return_numpy=True,
-                    per_step_feeds=False, sync=True, unroll=False):
+                    per_step_feeds=False, sync=True, unroll="auto"):
         """Run `program` n_steps times inside one jitted lax.scan — the
         cached-executable fast path: a single dispatch covers n_steps
         iterations, so per-step overhead is framework+compute time
@@ -922,7 +975,14 @@ class Executor:
         (a whole data chunk per dispatch — the fast path under a batch
         loop); otherwise the same feeds repeat. Scope state afterwards
         matches n_steps sequential `run` calls; each fetch comes back
-        stacked with a leading [n_steps] axis."""
+        stacked with a leading [n_steps] axis.
+
+        `unroll` defaults to "auto": on CPU the scan body is unrolled
+        (or, past _UNROLL_WINDOW_MAX steps, windowed into unrolled
+        chunks) because XLA-CPU runs the rolled while-loop ~2.6x slower
+        per step (BENCH_r05); on TPU/GPU it stays a rolled scan — ONE
+        dispatch, bounded compile time. Pass unroll=False explicitly to
+        opt back into the rolled scan everywhere."""
         if int(n_steps) < 1:
             raise ValueError(f"run_chained needs n_steps >= 1, got "
                              f"{n_steps}")
@@ -954,7 +1014,9 @@ class Executor:
                     fetches, new_rng = step.run_chained(
                         scope, norm_feed, rng, int(n_steps),
                         per_step_feeds=bool(per_step_feeds),
-                        unroll=bool(unroll))
+                        unroll=unroll,
+                        platform=getattr(self.place.jax_device(),
+                                         "platform", None))
             scope.set_var(RNG_STATE_VAR, new_rng)
             _post_step_health(step.writes, fetch_names, fetches, scope)
             return _finish_fetches(fetches, return_numpy, sync,

@@ -215,6 +215,162 @@ def apply(params: Params, cfg: GPTConfig, ids: jax.Array,
     return shard(logits, ("batch", "seq", "vocab"))
 
 
+# ---------------------------------------------------------------------------
+# Decode path (serving/decode.py): paged-KV prefill + single-token steps.
+#
+# `apply` above recomputes the full [B, T] forward per call — fine for
+# training/scoring, quadratic waste for token-by-token generation. The
+# decode path splits generation into the two serving phases:
+#
+#   apply_prefill      one prompt ([1, T_bucket]) through full causal
+#                      attention, writing every position's K/V into the
+#                      sequence's pool blocks and sampling the first
+#                      new token from the last real position;
+#   apply_decode_step  one token per resident sequence ([S] slots),
+#                      position-indexed attention over each sequence's
+#                      own blocks via its block table — the executable
+#                      every generated token after the first rides.
+#
+# Both take and return the pool arrays (donated at the jit boundary by
+# the engine) and sample through ops/beam.beam_search with beam_size=1:
+# greedy selection with the beam op's finished-freeze semantics, so a
+# slot whose previous token is end_id keeps emitting end_id without any
+# host-side branching. MoE configs are refused by the engine (expert
+# dispatch needs its own decode kernel — ROADMAP item 4).
+# ---------------------------------------------------------------------------
+
+
+def _beam_top1(prev_ids: jax.Array, logits: jax.Array,
+               eos_id: int) -> jax.Array:
+    """Greedy next-token selection through the beam_search op (K=1).
+    prev_ids [S] int32, logits [S, vocab] → [S] int32."""
+    from ..ops.beam import beam_search
+
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    out = beam_search(
+        {"pre_ids": [prev_ids[:, None].astype(jnp.int32)],
+         "pre_scores": [jnp.zeros((logp.shape[0], 1), jnp.float32)],
+         "scores": [logp[:, None, :]]},
+        {"beam_size": 1, "end_id": int(eos_id), "is_accumulated": True},
+        None)
+    return out["selected_ids"][:, 0].astype(jnp.int32)
+
+
+def _decode_mlp(lp, x):
+    h = gelu(x @ lp["blk.w1"].astype(x.dtype) + lp["blk.b1"].astype(x.dtype))
+    return h @ lp["blk.w2"].astype(x.dtype) + lp["blk.b2"].astype(x.dtype)
+
+
+def apply_prefill(params: Params, cfg: GPTConfig, ids: jax.Array,
+                  length: jax.Array, k_pool: jax.Array, v_pool: jax.Array,
+                  block_table: jax.Array, *, block_size: int,
+                  eos_id: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One prompt through the stack, filling its KV blocks.
+
+    ids [1, T] (edge-padded to the prefill bucket T), length = true
+    prompt length, block_table [MB] (the sequence's row). Returns
+    (first sampled token [1], k_pool, v_pool). Padded tail positions
+    write to the null block / soon-overwritten slots (see
+    kv_cache.write_prefill_kv) and, being causally AFTER every real
+    position, never contribute to the last real position's logits.
+    """
+    from ..ops.pallas import attention as pa
+    from ..serving import kv_cache as kvc
+
+    B, T = ids.shape
+    nh, hd = cfg.heads, cfg.head_dim
+    adt = k_pool.dtype
+    x = (params["wte.w"][ids] + params["wpe.w"][:T][None]).astype(adt)
+
+    lp_stacked = _layer_params(params)
+
+    def layer_body(h, per_layer):
+        lp, kp, vp = per_layer
+        y = _ln(h, lp["blk.ln1.scale"], lp["blk.ln1.bias"])
+        qkv = y @ lp["blk.wqkv"].astype(y.dtype) + \
+            lp["blk.bqkv"].astype(y.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, nh, hd)
+        k = k.reshape(B, T, nh, hd)
+        v = v.reshape(B, T, nh, hd)
+        kp = kvc.write_prefill_kv(kp, k[0], block_table, block_size)
+        vp = kvc.write_prefill_kv(vp, v[0], block_table, block_size)
+        ctx = pa.mha(q, k, v, causal=True, scale=1.0 / math.sqrt(hd))
+        ctx = ctx.reshape(B, T, cfg.hidden)
+        h = h + ctx @ lp["blk.wo"].astype(h.dtype) + \
+            lp["blk.bo"].astype(h.dtype)
+        y = _ln(h, lp["blk.ln2.scale"], lp["blk.ln2.bias"])
+        h = h + _decode_mlp(lp, y)
+        return h, (kp, vp)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        layer_body, x, (lp_stacked, k_pool, v_pool))
+    x = _ln_named(params, "ln_f", x)
+    last = jnp.maximum(length, 1) - 1
+    x_last = x[0, last]                                   # [H]
+    logits = (x_last @ params["wte.w"].T.astype(x.dtype))[None]
+    prev = ids[0, last][None].astype(jnp.int32)
+    tok = _beam_top1(prev, logits, eos_id)
+    return tok, k_pool, v_pool
+
+
+def apply_decode_step(params: Params, cfg: GPTConfig, ids: jax.Array,
+                      positions: jax.Array, k_pool: jax.Array,
+                      v_pool: jax.Array, block_tables: jax.Array, *,
+                      block_size: int, eos_id: int
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step for S resident slots.
+
+    ids [S] (each slot's previous token), positions [S] (where this
+    token's K/V lands = current sequence length), block_tables [S, MB].
+    Every row's math touches only that row's activations and its own
+    blocks, so a slot's tokens are bit-identical whatever else shares
+    the batch — the property test_decode's admit-mid-decode test pins.
+    Returns (next tokens [S], k_pool, v_pool)."""
+    from ..serving import kv_cache as kvc
+
+    S = ids.shape[0]
+    nh, hd = cfg.heads, cfg.head_dim
+    adt = k_pool.dtype
+    x = (params["wte.w"][ids] + params["wpe.w"][positions]).astype(adt)
+
+    lp_stacked = _layer_params(params)
+    scale = 1.0 / math.sqrt(hd)
+
+    def layer_body(h, per_layer):
+        lp, kp, vp = per_layer
+        y = _ln(h, lp["blk.ln1.scale"], lp["blk.ln1.bias"])
+        qkv = y @ lp["blk.wqkv"].astype(y.dtype) + \
+            lp["blk.bqkv"].astype(y.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(S, nh, hd)
+        k = k.reshape(S, nh, hd)
+        v = v.reshape(S, nh, hd)
+        kp = kvc.write_token_kv(kp, k, block_tables, positions, block_size)
+        vp = kvc.write_token_kv(vp, v, block_tables, positions, block_size)
+        keys = kvc.gather_kv(kp, block_tables)        # [S, M, nh, hd]
+        vals = kvc.gather_kv(vp, block_tables)
+        scores = jnp.einsum("snd,smnd->snm", q, keys) * scale
+        m = keys.shape[1]
+        mask = jnp.arange(m, dtype=jnp.int32)[None, :] <= positions[:, None]
+        scores = jnp.where(mask[:, None, :], scores, -1e9)
+        att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        ctx = jnp.einsum("snm,smnd->snd", att.astype(adt), vals)
+        ctx = ctx.reshape(S, cfg.hidden)
+        h = h + ctx @ lp["blk.wo"].astype(h.dtype) + \
+            lp["blk.bo"].astype(h.dtype)
+        y = _ln(h, lp["blk.ln2.scale"], lp["blk.ln2.bias"])
+        h = h + _decode_mlp(lp, y)
+        return h, (kp, vp)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        layer_body, x, (lp_stacked, k_pool, v_pool))
+    x = _ln_named(params, "ln_f", x)
+    logits = x @ params["wte.w"].T.astype(x.dtype)         # [S, vocab]
+    tok = _beam_top1(ids.astype(jnp.int32), logits, eos_id)
+    return tok, k_pool, v_pool
+
+
 def lm_loss(params: Params, cfg: GPTConfig, batch: Dict[str, jax.Array],
             rng=None, n_microbatches: int = 0) -> jax.Array:
     """Next-token cross entropy; batch = {"ids": [B, T+1]}."""

@@ -53,7 +53,8 @@ KINDS = ("compile", "compile_cache", "step_summary", "anomaly",
          "checkpoint", "serve_start", "serve_stop", "restore", "preempt",
          "fault", "recovery", "rank_restart", "pipeline_stall",
          "warmstart", "amp_overflow", "quantize", "analysis",
-         "rendezvous", "resize", "restore_resharded", "ps_failover")
+         "rendezvous", "resize", "restore_resharded", "ps_failover",
+         "decode")
 
 # Ring bound: a week-long run emitting a compile+summary event per minute
 # stays far under this; anomaly storms get truncated to the latest window.

@@ -14,8 +14,16 @@ deployment surface in front of it:
 - engine.py    — Predictor wrapped with bucket-aware dispatch, AOT
                  warmup of every bucket at startup, per-bucket
                  latency/count accounting.
-- httpd.py     — JSON-over-HTTP frontend (POST /v1/predict,
-                 GET /v1/status) on the shared observability HTTP base.
+- kv_cache.py  — paged/blocked KV cache: preallocated device block
+                 pool + host-side allocator + per-sequence block
+                 tables, so decode memory scales with live tokens.
+- decode.py    — continuous-batching autoregressive decode engine:
+                 prefill/decode phase split, in-flight batching,
+                 streaming token handles, warmstart phase-grid bake
+                 (SERVING.md §Continuous batching).
+- httpd.py     — JSON-over-HTTP frontend (POST /v1/predict, chunked
+                 POST /v1/generate token streaming, GET /v1/status)
+                 on the shared observability HTTP base.
 
 Telemetry flows through the PR 1/2 observability stack: queue depth,
 batch-size/queue-wait/end-to-end histograms, reject/timeout counters,
@@ -28,6 +36,8 @@ from .batcher import (  # noqa: F401
     Batcher, EngineError, QueueFullError, RequestTimeout, ServerClosed,
 )
 from .engine import Engine, ServingConfig  # noqa: F401
+from .kv_cache import BlockAllocator, KVCacheConfig, NoBlocksError  # noqa: F401
+from .decode import DecodeConfig, DecodeEngine, DecodeHandle  # noqa: F401
 from .httpd import Server  # noqa: F401
 
 __all__ = [
@@ -35,4 +45,6 @@ __all__ = [
     "Batcher", "EngineError", "QueueFullError", "RequestTimeout",
     "ServerClosed",
     "Engine", "ServingConfig", "Server",
+    "BlockAllocator", "KVCacheConfig", "NoBlocksError",
+    "DecodeConfig", "DecodeEngine", "DecodeHandle",
 ]

@@ -1,0 +1,935 @@
+"""Continuous-batching autoregressive decode engine.
+
+The PR 3 serving stack buckets fixed-shape one-shot predicts; token
+generation through it would re-run the full forward per token. This
+module is the token-serving half the ROADMAP calls the flagship
+workload: a decode engine that composes the substrate the repo already
+owns —
+
+- **paged KV cache** (kv_cache.py): fixed-size blocks in ONE
+  preallocated device pool, per-sequence block tables, blocks
+  allocated on admit / freed on finish, so HBM scales with live
+  tokens, not max_seq_len × batch;
+- **continuous (in-flight) batching** (ORCA OSDI'22): the scheduler
+  admits new requests into the RUNNING decode batch every step and
+  retires finished ones without draining it;
+- **prefill/decode phase split**: prompts run through per-length
+  prefill buckets (the existing BucketPolicy idea applied to sequence
+  length), decode always runs at one of a few fixed slot counts — so
+  the whole phase grid is a small closed signature set that is
+  AOT-warmed once, pre-baked into a PR 6 warmstart artifact
+  (`export_warmstart`/`load_warmstart`, `tools/warmstart.py
+  bake-decode`), and replayed at boot with zero fresh compiles;
+- **lazy token fetches** (PR 5 FetchHandle): each decode step's
+  sampled tokens resolve one step LATE — step N dispatches with step
+  N-1's tokens still device-resident, so the host never blocks the
+  device between steps while the batch composition is stable;
+- **PR 7 precision policies**: bf16 decode by default (pools + compute
+  dtype), f32 opt-in for exactness; the policy is part of every
+  executable's signature and persistent-cache fingerprint;
+- **PR 8 boot validation**: config + trace findings in the analysis
+  Finding shape, PADDLE_TPU_VALIDATE=2 refuses to serve a broken grid.
+
+Sampling is greedy (beam_size=1) through `ops/beam.beam_search`, whose
+finished-freeze semantics keep an ended slot emitting eos without
+host-side branching. When the pool runs dry mid-decode, the youngest
+active sequence is preempted vLLM-style: blocks freed, request
+re-queued with prompt+generated-so-far, re-prefilled later (already
+streamed tokens are not re-emitted).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import pickle
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..core import compile_cache as _cc
+from ..core import precision as _precision
+from ..core.async_exec import FetchHandle
+from ..core.executor import _JitDispatch
+from ..observability import events as _events
+from ..observability import metrics as _m
+from ..observability import telemetry as _telemetry
+from .batcher import QueueFullError, ServerClosed
+from .kv_cache import (BlockAllocator, KVCacheConfig, NoBlocksError,
+                       build_block_table, init_pools)
+
+__all__ = ["DecodeConfig", "DecodeEngine", "DecodeHandle",
+           "DECODE_WARMSTART_FORMAT"]
+
+DECODE_WARMSTART_FORMAT = "paddle_tpu-decode-warmstart-v1"
+
+QUEUE_DEPTH = _m.gauge(
+    "paddle_tpu_decode_queue_depth",
+    "Requests waiting for a decode slot")
+SLOTS = _m.gauge(
+    "paddle_tpu_decode_slots",
+    "Decode slots (state=active|configured)", labelnames=("state",))
+KV_BLOCKS = _m.gauge(
+    "paddle_tpu_decode_kv_blocks",
+    "KV-cache pool blocks (state=used|free)", labelnames=("state",))
+TTFT_SECONDS = _m.histogram(
+    "paddle_tpu_decode_ttft_seconds",
+    "Submit-to-first-token latency (prefill completion)")
+STEP_SECONDS = _m.histogram(
+    "paddle_tpu_decode_step_seconds",
+    "Wall seconds per decode step (dispatch N to dispatch N+1)")
+TOKENS = _m.counter(
+    "paddle_tpu_decode_tokens_total",
+    "Tokens sampled (phase=prefill|decode)", labelnames=("phase",))
+STEPS = _m.counter(
+    "paddle_tpu_decode_steps_total",
+    "Phase executions (phase=prefill|decode)", labelnames=("phase",))
+REQUESTS = _m.counter(
+    "paddle_tpu_decode_requests_total",
+    "Finished requests by outcome (eos|length|rejected|cancelled|error)",
+    labelnames=("outcome",))
+PREEMPTIONS = _m.counter(
+    "paddle_tpu_decode_preemptions_total",
+    "Sequences preempted back to the queue on KV-pool pressure")
+OCCUPANCY = _m.histogram(
+    "paddle_tpu_decode_slot_occupancy",
+    "Active slots / compiled slot count per decode step",
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+
+
+def _pow2_lengths(lo: int, hi: int) -> Tuple[int, ...]:
+    out, b = [], int(lo)
+    while b < hi:
+        out.append(b)
+        b *= 2
+    out.append(int(hi))
+    return tuple(sorted(set(out)))
+
+
+class DecodeConfig:
+    """Knobs for the decode engine (SERVING.md §Continuous batching).
+
+    decode_slots: the fixed slot counts decode executables exist for;
+    each step runs at the smallest config >= live sequences.
+    prefill_buckets: prompt-length buckets (pow2 from 8 up to max_len
+    by default); a prompt pads to the smallest bucket that fits.
+    num_blocks/block_size: the KV pool (block 0 is the null block).
+    static_batching=True turns the scheduler into the drain-between-
+    batches baseline (admit only into an EMPTY batch) — the A/B
+    `tools/serve_bench.py --tokens` measures against."""
+
+    def __init__(self, *, block_size: int = 16, num_blocks: int = 64,
+                 decode_slots: Sequence[int] = (4, 8),
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 max_len: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 max_queue: int = 64,
+                 precision: str = "bf16",
+                 static_batching: bool = False,
+                 warmstart: Optional[str] = None):
+        self.block_size = int(block_size)
+        self.num_blocks = int(num_blocks)
+        self.decode_slots = tuple(sorted({int(s) for s in decode_slots}))
+        self.prefill_buckets = tuple(sorted({int(b) for b in
+                                             prefill_buckets})) \
+            if prefill_buckets is not None else None
+        self.max_len = max_len
+        self.eos_id = eos_id
+        self.max_queue = int(max_queue)
+        self.precision = str(precision)
+        self.static_batching = bool(static_batching)
+        self.warmstart = warmstart
+
+
+class DecodeHandle:
+    """Client side of one generation: a thread-safe token stream.
+
+    `tokens()` yields token ids as the scheduler emits them and ends
+    when the request finishes; `result(timeout_s)` collects them all.
+    `info` fills in as generation progresses (ttft_s, finish_reason,
+    n_tokens)."""
+
+    def __init__(self, req: "_Request"):
+        self._req = req
+
+    @property
+    def info(self) -> Dict:
+        r = self._req
+        return {
+            "prompt_len": int(r.prompt_len0),
+            "n_tokens": len(r.generated),
+            "ttft_s": (r.t_first - r.t_submit) if r.t_first else None,
+            "finish_reason": r.finish_reason,
+        }
+
+    def tokens(self, timeout_s: Optional[float] = None):
+        deadline = (time.monotonic() + timeout_s) if timeout_s else None
+        while True:
+            left = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            try:
+                item = self._req.events.get(timeout=left)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"generation produced no token within {timeout_s}s")
+            if item is None:
+                if self._req.error is not None:
+                    raise self._req.error
+                return
+            yield item
+
+    def result(self, timeout_s: Optional[float] = None) -> List[int]:
+        return list(self.tokens(timeout_s=timeout_s))
+
+
+class _Request:
+    __slots__ = ("rid", "prompt", "prompt_len0", "max_new", "generated",
+                 "events", "t_submit", "t_first", "finish_reason",
+                 "error", "cancelled", "last_token", "pos", "blocks",
+                 "admitted_at")
+
+    def __init__(self, rid: int, prompt: np.ndarray, max_new: int):
+        self.rid = rid
+        self.prompt = prompt                   # grows on preempt-replay
+        self.prompt_len0 = len(prompt)         # original, for reporting
+        self.max_new = int(max_new)
+        self.generated: List[int] = []
+        self.events: "queue.Queue" = queue.Queue()
+        self.t_submit = time.monotonic()
+        self.t_first: Optional[float] = None
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self.cancelled = False
+        # slot state (meaningful while active)
+        self.last_token = 0
+        self.pos = 0                           # next KV write position
+        self.blocks: List[int] = []
+        self.admitted_at = 0.0
+
+
+class _Pending:
+    """One in-flight decode step: the lazy token fetch plus the exact
+    batch composition it was dispatched with."""
+
+    __slots__ = ("handle", "tok_dev", "snapshot", "slots", "t_dispatch")
+
+    def __init__(self, handle, tok_dev, snapshot, slots):
+        self.handle = handle
+        self.tok_dev = tok_dev
+        self.snapshot = snapshot               # tuple of rids (padded -1)
+        self.slots = slots                     # list of Optional[_Request]
+        self.t_dispatch = time.perf_counter()
+
+
+class DecodeEngine:
+    """Continuous-batching token generation over a paged KV cache.
+
+    Built from in-memory model state: `params`/`model_cfg` from
+    `models.gpt` (dense configs only). `submit()` is thread-safe and
+    reject-not-block (QueueFullError when `max_queue` prompts wait);
+    one scheduler thread owns the device pools, the allocator, and
+    every phase dispatch."""
+
+    def __init__(self, params, model_cfg, config: Optional[DecodeConfig]
+                 = None):
+        from ..models import gpt as _gpt
+
+        self.config = config or DecodeConfig()
+        self.model_cfg = model_cfg
+        if self.config.precision not in ("f32", "bf16"):
+            _precision.get_policy(self.config.precision)  # typo => full msg
+            raise ValueError(
+                f"unsupported decode precision "
+                f"{self.config.precision!r}; choose from ['f32', 'bf16']")
+        policy = _precision.get_policy(
+            "bf16" if self.config.precision == "bf16" else "f32")
+        self._compute_dtype = policy.compute_dtype or np.dtype("float32")
+        self.params = {
+            k: _precision.cast_floating(v, self._compute_dtype)
+            for k, v in params.items()}
+        max_len = int(self.config.max_len or model_cfg.max_len)
+        self.kv_cfg = KVCacheConfig(
+            layers=model_cfg.layers, kv_heads=model_cfg.heads,
+            head_dim=model_cfg.head_dim, max_len=max_len,
+            block_size=self.config.block_size,
+            num_blocks=self.config.num_blocks,
+            dtype=str(np.dtype(self._compute_dtype)))
+        # resolved grid lives on the ENGINE, never written back into
+        # the caller's config (a DecodeConfig reused across engines
+        # must not carry the first engine's derived bucket set)
+        self.prefill_buckets = self.config.prefill_buckets \
+            if self.config.prefill_buckets is not None \
+            else _pow2_lengths(min(8, max_len), max_len)
+        self.decode_slots = self.config.decode_slots
+        self.eos_id = -1 if self.config.eos_id is None \
+            else int(self.config.eos_id)
+
+        # -- phase grid: one dispatcher per (phase, size) -------------
+        bs = self.kv_cfg.block_size
+        pol = None if self.config.precision == "f32" \
+            else self.config.precision
+
+        def _prefill_fn(p, ids, length, kp, vp, bt):
+            return _gpt.apply_prefill(p, model_cfg, ids, length, kp, vp,
+                                      bt, block_size=bs,
+                                      eos_id=self.eos_id)
+
+        def _decode_fn(p, ids, positions, kp, vp, bts):
+            return _gpt.apply_decode_step(p, model_cfg, ids, positions,
+                                          kp, vp, bts, block_size=bs,
+                                          eos_id=self.eos_id)
+
+        self._prefill: Dict[int, _JitDispatch] = {
+            t: _JitDispatch(jax.jit(_prefill_fn, donate_argnums=(3, 4)),
+                            "prefill", meta={"bucket": int(t)},
+                            policy=pol)
+            for t in self.prefill_buckets}
+        self._decode: Dict[int, _JitDispatch] = {
+            s: _JitDispatch(jax.jit(_decode_fn, donate_argnums=(3, 4)),
+                            "decode", meta={"slots": int(s)}, policy=pol)
+            for s in self.decode_slots}
+
+        self.analysis = self._validate_boot()
+
+        self._pools = init_pools(self.kv_cfg)
+        self._alloc = BlockAllocator(self.kv_cfg)
+        self._cv = threading.Condition()
+        self._waiting: "collections.deque[_Request]" = collections.deque()
+        self._active: List[_Request] = []
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._rid = 0
+        self._last_slot_config: Optional[int] = None
+        self._counts = {k: 0 for k in
+                        ("eos", "length", "rejected", "cancelled",
+                         "error", "preempted")}
+        self.warmed = False
+        self.warmstart_adopted = 0
+        SLOTS.set(max(self.decode_slots), state="configured")
+        if self.config.warmstart:
+            self.load_warmstart(self.config.warmstart)
+
+    # -- boot validation (PR 8 shape) ----------------------------------
+
+    def _validate_boot(self):
+        """Config + trace findings in the analysis Finding shape. Like
+        the serving Engine's boot walk: always runs (boot is one-time),
+        raises AnalysisError only at PADDLE_TPU_VALIDATE=2, and lands
+        in the analysis metrics under where="decode"."""
+        from .. import analysis as _an
+
+        t0 = time.perf_counter()
+        findings: List[_an.Finding] = []
+
+        def add(sev, msg, var=None):
+            findings.append(_an.Finding(
+                severity=sev, pass_name="decode_config", message=msg,
+                var=var))
+
+        kv, mc = self.kv_cfg, self.model_cfg
+        if getattr(mc, "n_experts", 0):
+            add(_an.ERROR, "MoE decode is unsupported: the paged decode "
+                "step has no expert-dispatch path (ROADMAP item 4) — "
+                "serve a dense config")
+        if kv.usable_blocks < kv.max_blocks_per_seq:
+            add(_an.ERROR,
+                f"KV pool cannot hold ONE full sequence: "
+                f"{kv.usable_blocks} usable blocks < "
+                f"{kv.max_blocks_per_seq} blocks for max_len "
+                f"{kv.max_len}", var="num_blocks")
+        worst = max(self.decode_slots) * kv.max_blocks_per_seq
+        if kv.usable_blocks < worst:
+            add(_an.WARNING,
+                f"KV pool oversubscribed: {kv.usable_blocks} usable "
+                f"blocks < {worst} worst-case ({max(self.decode_slots)} "
+                f"slots x {kv.max_blocks_per_seq} blocks) — expect "
+                "preemptions under full-length load", var="num_blocks")
+        if kv.max_len > mc.max_len:
+            add(_an.ERROR,
+                f"max_len {kv.max_len} exceeds the model's positional "
+                f"table ({mc.max_len})", var="max_len")
+        if not (-1 <= self.eos_id < mc.vocab_size):
+            add(_an.ERROR,
+                f"eos_id {self.eos_id} outside vocab [0, "
+                f"{mc.vocab_size})", var="eos_id")
+        for t in self.prefill_buckets:
+            if t > kv.max_len:
+                add(_an.ERROR, f"prefill bucket {t} exceeds max_len "
+                    f"{kv.max_len}", var="prefill_buckets")
+        if max(self.prefill_buckets) < kv.max_len:
+            add(_an.WARNING,
+                f"largest prefill bucket "
+                f"{max(self.prefill_buckets)} < max_len "
+                f"{kv.max_len}: a pool-pressure preemption whose "
+                "replay prompt (original + generated) outgrows the "
+                "bucket set fails that request — extend "
+                "prefill_buckets to max_len if preemptions are "
+                "expected", var="prefill_buckets")
+        for s in self.decode_slots:
+            if s < 1:
+                add(_an.ERROR, f"decode slot count {s} < 1",
+                    var="decode_slots")
+        if not any(f.severity == _an.ERROR for f in findings):
+            # shape-trace every phase executable (no XLA, milliseconds):
+            # a shape bug fails boot with a structured finding instead
+            # of an opaque trace error inside the first live request
+            for key in self._phase_keys():
+                try:
+                    disp = (self._prefill if key[0] == "prefill"
+                            else self._decode)[key[1]]
+                    jax.eval_shape(disp._jit, *self._phase_avals(key))
+                except Exception as e:
+                    findings.append(_an.Finding(
+                        severity=_an.ERROR, pass_name="decode_trace",
+                        message=f"{key[0]}@{key[1]} fails to trace: "
+                                f"{type(e).__name__}: {str(e)[:200]}"))
+        _telemetry.record_analysis(
+            findings, n_ops=len(self._prefill) + len(self._decode),
+            where="decode", seconds=time.perf_counter() - t0)
+        out = {"errors": 0, "warnings": 0, "infos": 0}
+        for f in findings:
+            out[f.severity + "s"] = out.get(f.severity + "s", 0) + 1
+        if any(f.severity == _an.ERROR for f in findings) \
+                and _an.validate_level() >= 2:
+            raise _an.AnalysisError(findings)
+        return out
+
+    # -- phase grid / warmstart ----------------------------------------
+
+    def _phase_keys(self) -> List[Tuple[str, int]]:
+        return ([("prefill", t) for t in self.prefill_buckets] +
+                [("decode", s) for s in self.decode_slots])
+
+    def _phase_avals(self, key):
+        sds = jax.ShapeDtypeStruct
+        p_sds = jax.tree_util.tree_map(
+            lambda a: sds(a.shape, a.dtype), self.params)
+        kv = self.kv_cfg
+        pool = sds((kv.layers, kv.num_blocks, kv.block_size,
+                    kv.kv_heads, kv.head_dim), np.dtype(kv.dtype))
+        mb = kv.max_blocks_per_seq
+        kind, n = key
+        if kind == "prefill":
+            return (p_sds, sds((1, n), np.int32), sds((), np.int32),
+                    pool, pool, sds((mb,), np.int32))
+        return (p_sds, sds((n,), np.int32), sds((n,), np.int32),
+                pool, pool, sds((n, mb), np.int32))
+
+    def warmup(self) -> int:
+        """AOT-compile (or adopt from the persistent compile cache /
+        a loaded warmstart artifact) every phase-grid executable.
+        Returns how many phases are ready. Idempotent."""
+        ready = 0
+        for key in self._phase_keys():
+            disp = (self._prefill if key[0] == "prefill"
+                    else self._decode)[key[1]]
+            if disp.warm(*self._phase_avals(key)):
+                ready += 1
+        self.warmed = True
+        return ready
+
+    def _model_digest(self) -> str:
+        """Binds warmstart artifacts to THIS model + grid: params
+        content, model config, and the kv/pool geometry that shapes
+        every executable."""
+        h = hashlib.sha256()
+        h.update(repr((self.model_cfg, self.kv_cfg,
+                       self.decode_slots,
+                       self.prefill_buckets,
+                       self.config.precision,
+                       self.eos_id)).encode())
+        for name in sorted(self.params):
+            a = np.ascontiguousarray(np.asarray(self.params[name]))
+            h.update(f"{name}:{a.dtype}:{a.shape}".encode())
+            h.update(a.tobytes())
+        return h.hexdigest()
+
+    def export_warmstart(self, path: str) -> int:
+        """Serialize every warmed phase executable into ONE artifact
+        (the PR 6 pattern, keyed by phase instead of batch bucket).
+        Call after warmup(); returns how many phases it carries."""
+        entries = {}
+        for key in self._phase_keys():
+            disp = (self._prefill if key[0] == "prefill"
+                    else self._decode)[key[1]]
+            exe = disp._aot
+            if exe is None:
+                continue
+            try:
+                avals = self._phase_avals(key)
+                fp = disp.cache_fingerprint(disp.lower(*avals))
+                entries[key] = {
+                    "blob": _cc.serialize_executable(exe),
+                    "fingerprint": fp}
+            except Exception:
+                continue  # backend refused: artifact covers fewer phases
+        art = dict(_cc.environment_meta(),
+                   format=DECODE_WARMSTART_FORMAT,
+                   model_digest=self._model_digest(),
+                   grid={"prefill": list(self.prefill_buckets),
+                         "decode": list(self.decode_slots)},
+                   created_at=time.time(),
+                   entries=entries)
+        from ..resilience.atomic import write_bytes
+
+        write_bytes(path, pickle.dumps(art,
+                                       protocol=pickle.HIGHEST_PROTOCOL))
+        _events.emit("warmstart", action="export_decode", path=path,
+                     entries=len(entries))
+        return len(entries)
+
+    def load_warmstart(self, path: str) -> int:
+        """Adopt the phase executables from a decode warmstart
+        artifact; same degradation contract as the serving engine's:
+        any mismatch (environment, model digest, per-entry lowering
+        fingerprint) costs a reject event + a cold phase, never a
+        boot failure."""
+        try:
+            with open(path, "rb") as f:
+                art = pickle.loads(f.read())
+            if not isinstance(art, dict) or \
+                    art.get("format") != DECODE_WARMSTART_FORMAT:
+                raise ValueError("not a decode warmstart artifact")
+        except Exception as e:
+            _events.emit("warmstart", action="reject", path=path,
+                         reason=f"unreadable: {str(e)[:200]}")
+            self.warmstart_adopted = 0
+            return 0
+        env = _cc.environment_meta()
+        stored = {k: art.get(k) for k in env}
+        if stored != env:
+            _events.emit("warmstart", action="reject", path=path,
+                         reason=f"environment mismatch: artifact "
+                                f"{stored} vs process {env}")
+            self.warmstart_adopted = 0
+            return 0
+        if art.get("model_digest") != self._model_digest():
+            _events.emit("warmstart", action="reject", path=path,
+                         reason="model digest mismatch — artifact baked "
+                                "from a different model/grid")
+            self.warmstart_adopted = 0
+            return 0
+        adopted = 0
+        for key, entry in (art.get("entries") or {}).items():
+            try:
+                kind, n = key
+                disp = (self._prefill if kind == "prefill"
+                        else self._decode).get(n)
+                if disp is None:
+                    continue
+                avals = self._phase_avals((kind, n))
+                fp = disp.cache_fingerprint(disp.lower(*avals))
+                if fp is None or fp != entry["fingerprint"]:
+                    continue  # lowering/flags drifted since the bake
+                exe = _cc.deserialize_executable(entry["blob"])
+                disp.adopt(exe, *avals)
+                adopted += 1
+            except Exception:
+                continue
+        self.warmstart_adopted = adopted
+        _events.emit("warmstart", action="load_decode", path=path,
+                     adopted=adopted)
+        return adopted
+
+    # -- client API ----------------------------------------------------
+
+    def start(self):
+        """Start the scheduler thread (idempotent; submit() calls it)."""
+        with self._cv:
+            if self._thread is not None or self._closed:
+                return
+            self._thread = threading.Thread(
+                target=self._loop, name="paddle-tpu-decode", daemon=True)
+            self._thread.start()
+            _events.emit("decode", action="start",
+                         slots=list(self.decode_slots),
+                         prefill_buckets=list(self.prefill_buckets),
+                         blocks=self.kv_cfg.usable_blocks)
+
+    def submit(self, prompt_ids, max_new_tokens: int = 16) -> DecodeHandle:
+        """Enqueue one generation; returns its token-stream handle.
+        Reject-not-block: QueueFullError (HTTP 503) when max_queue
+        prompts already wait, ServerClosed after stop()."""
+        prompt = np.asarray(prompt_ids, np.int32).ravel()
+        if prompt.size < 1:
+            raise ValueError("prompt must carry at least one token id")
+        if prompt.size > self.prefill_buckets[-1]:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds the largest "
+                f"prefill bucket {self.prefill_buckets[-1]}")
+        if int(prompt.min()) < 0 or \
+                int(prompt.max()) >= self.model_cfg.vocab_size:
+            raise ValueError(
+                f"prompt token ids must be in [0, "
+                f"{self.model_cfg.vocab_size})")
+        room = self.kv_cfg.max_len - int(prompt.size)
+        if room < 1:
+            raise ValueError(
+                f"prompt length {prompt.size} leaves no room to "
+                f"generate under max_len {self.kv_cfg.max_len}")
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        max_new = min(int(max_new_tokens), room)
+        with self._cv:
+            if self._closed:
+                self._count("rejected")
+                raise ServerClosed("decode engine is stopped")
+            if len(self._waiting) >= self.config.max_queue:
+                self._count("rejected")
+                raise QueueFullError(
+                    f"decode queue full ({self.config.max_queue} "
+                    "waiting); request rejected")
+            self._rid += 1
+            req = _Request(self._rid, prompt, max_new)
+            self._waiting.append(req)
+            QUEUE_DEPTH.set(len(self._waiting))
+            self._cv.notify_all()
+        self.start()
+        return DecodeHandle(req)
+
+    def cancel(self, handle: DecodeHandle):
+        """Abandon one generation (the HTTP frontend calls this when a
+        streaming client disconnects): the scheduler retires the
+        request at its next iteration, freeing its slot and KV blocks
+        instead of generating the full max_new_tokens into an unread
+        queue. Idempotent; a no-op once the request finished."""
+        with self._cv:
+            handle._req.cancelled = True
+            self._cv.notify_all()
+
+    def stop(self):
+        """Stop the scheduler: waiting and active requests are
+        cancelled (their streams end with finish_reason='cancelled').
+        Idempotent; joins the thread. Requests enqueued before any
+        scheduler thread existed are drained HERE — _loop's finally
+        (the usual cleanup) never runs for a thread never started, and
+        a submit racing this stop must not strand its caller blocking
+        on a stream that nothing will ever terminate."""
+        with self._cv:
+            if not self._closed:
+                self._closed = True
+                self._cv.notify_all()
+            t = self._thread
+            stranded = [] if t is not None else list(self._waiting)
+            if t is None and stranded:
+                self._waiting.clear()
+                QUEUE_DEPTH.set(0)
+        for req in stranded:
+            self._finish(req, "cancelled")
+        if t is not None:
+            t.join(timeout=30.0)
+        _events.emit("decode", action="stop")
+
+    def status(self) -> Dict:
+        with self._cv:
+            waiting = len(self._waiting)
+            active = len(self._active)
+            live_tokens = sum(r.pos for r in self._active)
+            counts = dict(self._counts)
+        return {
+            "phase_grid": {
+                "prefill_buckets": list(self.prefill_buckets),
+                "decode_slots": list(self.decode_slots)},
+            "queue_depth": waiting,
+            "active": active,
+            "slot_config": self._last_slot_config,
+            "static_batching": self.config.static_batching,
+            "precision": self.config.precision,
+            "eos_id": self.eos_id,
+            "warmed": self.warmed,
+            "warmstart_adopted": self.warmstart_adopted,
+            "analysis": self.analysis,
+            "kv": self._alloc.stats(live_tokens=live_tokens),
+            "requests": counts,
+        }
+
+    # -- scheduler internals (single thread owns everything below) -----
+
+    def _count(self, outcome: str):
+        REQUESTS.inc(outcome=outcome)
+        self._counts[outcome] = self._counts.get(outcome, 0) + 1
+
+    def _emit_token(self, req: _Request, tok: int, phase: str):
+        req.last_token = int(tok)
+        req.generated.append(int(tok))
+        TOKENS.inc(phase=phase)
+        if req.t_first is None:
+            req.t_first = time.monotonic()
+            TTFT_SECONDS.observe(req.t_first - req.t_submit)
+        req.events.put(int(tok))
+
+    def _finished_reason(self, req: _Request) -> Optional[str]:
+        if req.generated and req.generated[-1] == self.eos_id:
+            return "eos"
+        if len(req.generated) >= req.max_new:
+            return "length"
+        return None
+
+    def _finish(self, req: _Request, reason: str):
+        req.finish_reason = reason
+        if req.blocks:
+            self._alloc.free(req.blocks)
+            req.blocks = []
+        if req in self._active:
+            self._active.remove(req)
+        self._count(reason)
+        req.events.put(None)
+        self._kv_gauges()
+
+    def _kv_gauges(self):
+        KV_BLOCKS.set(self._alloc.used_blocks(), state="used")
+        KV_BLOCKS.set(self._alloc.free_blocks(), state="free")
+        SLOTS.set(len(self._active), state="active")
+
+    def _bucket_for_len(self, n: int) -> Optional[int]:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return None
+
+    def _slot_config(self) -> int:
+        n = max(1, len(self._active))
+        for s in self.decode_slots:
+            if n <= s:
+                return s
+        return self.decode_slots[-1]
+
+    def _sweep_cancelled(self):
+        """Retire requests whose clients abandoned them (cancel()):
+        waiting ones leave the queue, active ones free their slot and
+        blocks. Runs at the top of every scheduler iteration; a
+        cancelled request with a token still in flight is skipped by
+        _resolve's not-in-active check."""
+        with self._cv:
+            gone_waiting = [r for r in self._waiting if r.cancelled]
+            for r in gone_waiting:
+                self._waiting.remove(r)
+            if gone_waiting:
+                QUEUE_DEPTH.set(len(self._waiting))
+        for r in gone_waiting:
+            self._finish(r, "cancelled")
+        for r in [r for r in self._active if r.cancelled]:
+            self._finish(r, "cancelled")
+
+    def _admit(self) -> bool:
+        """Move waiting requests into free slots while blocks last;
+        each admission runs its prefill (the admission boundary is the
+        one place the scheduler syncs with the device). Returns whether
+        the batch composition changed."""
+        changed = False
+        max_slots = self.decode_slots[-1]
+        while True:
+            with self._cv:
+                if not self._waiting or self._closed:
+                    break
+                if self.config.static_batching and self._active:
+                    break  # drain-between-batches baseline
+                if len(self._active) >= max_slots:
+                    break
+                req = self._waiting[0]
+                need = -(-len(req.prompt) // self.kv_cfg.block_size)
+                if not self._alloc.can_alloc(need):
+                    break  # blocks scale with live tokens: defer
+                self._waiting.popleft()
+                QUEUE_DEPTH.set(len(self._waiting))
+            self._prefill_one(req)
+            changed = True
+        return changed
+
+    def _prefill_one(self, req: _Request):
+        plen = len(req.prompt)
+        bucket = self._bucket_for_len(plen)
+        if bucket is None:  # replay grew past the largest bucket
+            req.error = RuntimeError(
+                f"prompt+generated length {plen} exceeds the largest "
+                f"prefill bucket {self.prefill_buckets[-1]}")
+            self._finish(req, "error")
+            return
+        need = -(-plen // self.kv_cfg.block_size)
+        req.blocks = self._alloc.alloc(need)
+        bt = build_block_table(req.blocks, self.kv_cfg.max_blocks_per_seq)
+        ids = np.empty((1, bucket), np.int32)
+        ids[0, :plen] = req.prompt
+        ids[0, plen:] = req.prompt[-1]         # edge-pad (in-distribution)
+        kp, vp = self._pools
+        t0 = time.perf_counter()
+        tok, kp, vp = self._prefill[bucket](
+            self.params, ids, np.int32(plen), kp, vp, bt)
+        self._pools = (kp, vp)
+        tok0 = int(np.asarray(tok)[0])         # admission-boundary sync
+        STEPS.inc(phase="prefill")
+        _telemetry.record_dispatch_ready(
+            "decode:prefill", time.perf_counter() - t0)
+        req.pos = plen
+        req.admitted_at = time.monotonic()
+        self._active.append(req)
+        self._emit_token(req, tok0, phase="prefill")
+        reason = self._finished_reason(req)
+        if reason:
+            self._finish(req, reason)
+        self._kv_gauges()
+
+    def _grow_blocks(self, pending: Optional[_Pending]
+                     ) -> Optional[_Pending]:
+        """Ensure every active slot owns the block its next write
+        lands in. On pool exhaustion: resolve the in-flight step (its
+        finishes may free blocks), retry, then preempt the youngest
+        active sequence until the step fits."""
+        while True:
+            short = None
+            for req in self._active:
+                bi = req.pos // self.kv_cfg.block_size
+                while bi >= len(req.blocks):
+                    try:
+                        req.blocks.extend(self._alloc.alloc(1))
+                    except NoBlocksError:
+                        short = req
+                        break
+                if short is not None:
+                    break
+            if short is None:
+                return pending
+            if pending is not None:
+                pending = self._resolve(pending)
+                continue  # finishes may have freed enough
+            victim = max(self._active, key=lambda r: r.admitted_at)
+            self._preempt(victim)
+
+    def _preempt(self, req: _Request):
+        """vLLM-style recompute preemption: free the victim's blocks
+        and requeue it (front) with prompt = original + generated; the
+        replay prefill regenerates its KV and its NEXT token — tokens
+        already streamed are not re-emitted."""
+        self._active.remove(req)
+        self._alloc.free(req.blocks)
+        req.blocks = []
+        # replay prompt: original prompt + everything generated so far
+        req.prompt = np.concatenate(
+            [req.prompt[:req.prompt_len0],
+             np.asarray(req.generated, np.int32)])
+        with self._cv:
+            self._waiting.appendleft(req)
+            QUEUE_DEPTH.set(len(self._waiting))
+        PREEMPTIONS.inc()
+        self._counts["preempted"] = self._counts.get("preempted", 0) + 1
+        _events.emit("decode", action="preempt", rid=req.rid,
+                     generated=len(req.generated))
+        self._kv_gauges()
+
+    def _snapshot(self, C: int) -> Tuple[Tuple[int, ...],
+                                         List[Optional[_Request]]]:
+        slots: List[Optional[_Request]] = list(self._active[:C])
+        while len(slots) < C:
+            slots.append(None)
+        return tuple(r.rid if r else -1 for r in slots), slots
+
+    def _dispatch(self, ids_arg, C: int) -> _Pending:
+        kp, vp = self._pools
+        positions = np.zeros((C,), np.int32)
+        bts = np.zeros((C, self.kv_cfg.max_blocks_per_seq), np.int32)
+        sig, slots = self._snapshot(C)
+        for i, req in enumerate(slots):
+            if req is None:
+                continue
+            positions[i] = req.pos
+            bts[i] = build_block_table(req.blocks,
+                                       self.kv_cfg.max_blocks_per_seq)
+        tok, kp, vp = self._decode[C](self.params, ids_arg, positions,
+                                      kp, vp, bts)
+        self._pools = (kp, vp)
+        for req in slots:
+            if req is not None:
+                req.pos += 1
+        STEPS.inc(phase="decode")
+        OCCUPANCY.observe(sum(1 for r in slots if r is not None) / C)
+        self._last_slot_config = C
+        return _Pending(FetchHandle([tok], site="decode"), tok, sig, slots)
+
+    def _resolve(self, pending: _Pending) -> None:
+        """Consume one in-flight step's tokens: stream them, detect
+        finishes, retire (freeing blocks). Tokens for slots that were
+        already retired/preempted after dispatch are discarded."""
+        toks = np.asarray(pending.handle.result()[0])
+        STEP_SECONDS.observe(time.perf_counter() - pending.t_dispatch)
+        for i, req in enumerate(pending.slots):
+            if req is None or req not in self._active:
+                continue
+            self._emit_token(req, int(toks[i]), phase="decode")
+            reason = self._finished_reason(req)
+            if reason:
+                self._finish(req, reason)
+        return None
+
+    def _loop(self):
+        pending: Optional[_Pending] = None
+        try:
+            while True:
+                with self._cv:
+                    while not self._closed and not self._waiting \
+                            and not self._active and pending is None:
+                        self._cv.wait(timeout=0.5)
+                    if self._closed:
+                        break
+                self._sweep_cancelled()
+                self._admit()
+                if not self._active:
+                    if pending is not None:
+                        pending = self._resolve(pending)
+                    continue
+                pending = self._grow_blocks(pending)
+                if not self._active:  # growth preempted everything
+                    continue
+                C = self._slot_config()
+                sig, slots = self._snapshot(C)
+                if pending is not None and pending.snapshot == sig:
+                    # steady state: feed the previous step's tokens
+                    # back on DEVICE — the host never touched them
+                    ids_arg = pending.tok_dev
+                else:
+                    if pending is not None:
+                        pending = self._resolve(pending)
+                        self._admit()  # retirements freed slots
+                        # a request admitted HERE whose prompt length
+                        # is an exact block multiple needs its next
+                        # block before this dispatch, or its first
+                        # decode write lands in the null block
+                        self._grow_blocks(None)
+                        if not self._active:
+                            continue
+                        C = self._slot_config()
+                        sig, slots = self._snapshot(C)
+                    ids_arg = np.zeros((C,), np.int32)
+                    for i, req in enumerate(slots):
+                        if req is not None:
+                            ids_arg[i] = req.last_token
+                new_pending = self._dispatch(ids_arg, C)
+                if pending is not None:
+                    # overlap: resolve step N-1 while step N runs
+                    pending = self._resolve(pending)
+                pending = new_pending
+        except BaseException as e:  # scheduler death must not hang clients
+            with self._cv:
+                reqs = list(self._active) + list(self._waiting)
+                self._waiting.clear()
+            for req in reqs:
+                req.error = RuntimeError(
+                    f"decode scheduler failed: {type(e).__name__}: {e}")
+                req.error.__cause__ = e
+                self._finish(req, "error")
+            raise
+        finally:
+            if pending is not None:
+                try:
+                    self._resolve(pending)
+                except Exception:  # lint-exempt:swallow: shutdown path; clients are cancelled below
+                    pass
+            with self._cv:
+                reqs = list(self._active) + list(self._waiting)
+                self._waiting.clear()
+                QUEUE_DEPTH.set(0)
+            for req in reqs:
+                self._finish(req, "cancelled")

@@ -10,8 +10,21 @@ Routes (schema documented in SERVING.md §HTTP API):
                        the client should back off or retry elsewhere)
                      → 504 request missed its deadline
                      → 500 engine error
+  POST /v1/generate  {"ids": [tok,...], "max_new_tokens": N,
+                      "stream": true|false, "timeout_s": opt}
+                     token generation on the continuous-batching decode
+                     engine (SERVING.md §Continuous batching). With
+                     stream=true (default): a chunked
+                     application/x-ndjson body, one {"token": t} line
+                     per generated token as the scheduler emits it,
+                     closed by {"done": true, "finish_reason": ...,
+                     "tokens": n, "ttft_ms": x}. With stream=false: one
+                     JSON reply carrying the full token list. 503 when
+                     the decode queue is full, 404 when the server has
+                     no decode engine attached.
   GET  /v1/status    queue depth, buckets, request/batch counters,
-                     uptime — the operator's one-look view
+                     decode queue/slot-occupancy/TTFT block, uptime —
+                     the operator's one-look view
   GET  /v1/healthz   liveness: 200 once started (the process-wide
                      anomaly-aware probe stays on the observability
                      server, PADDLE_TPU_METRICS_PORT)
@@ -45,6 +58,10 @@ __all__ = ["Server"]
 
 class _ServingHandler(_base.QuietHandler):
     server_version = "paddle-tpu-serving"
+    # chunked transfer (the /v1/generate stream) needs HTTP/1.1; all
+    # non-chunked replies already send explicit Content-Length, which
+    # 1.1 keep-alive requires
+    protocol_version = "HTTP/1.1"
     serving: "Server" = None  # bound per-Server via a subclass
 
     def _json_reply(self, code: int, payload: Dict):
@@ -69,18 +86,113 @@ class _ServingHandler(_base.QuietHandler):
         except _base.CLIENT_GONE:
             pass
 
+    # -- token streaming (/v1/generate) --------------------------------
+
+    def _chunk(self, line: str):
+        data = line.encode("utf-8")
+        self.wfile.write(f"{len(data):x}\r\n".encode())
+        self.wfile.write(data)
+        self.wfile.write(b"\r\n")
+        self.wfile.flush()
+
+    def _do_generate(self, payload: Dict):
+        from .batcher import QueueFullError, ServerClosed
+
+        decode = self.serving._decode
+        if decode is None:
+            self._json_reply(404, {"error": "no decode engine attached "
+                                            "to this server"})
+            return
+        ids = payload.get("ids")
+        if not isinstance(ids, (list, tuple)) or not ids:
+            self._json_reply(400, {"error": 'missing/empty "ids" list'})
+            return
+        max_new = payload.get("max_new_tokens", 16)
+        stream = bool(payload.get("stream", True))
+        timeout = payload.get("timeout_s")
+        try:
+            handle = decode.submit(ids, max_new_tokens=int(max_new))
+        except (QueueFullError, ServerClosed) as e:
+            self._json_reply(503, {"error": str(e)})
+            return
+        except (ValueError, TypeError) as e:
+            self._json_reply(400, {"error": str(e)})
+            return
+        if not stream:
+            try:
+                toks = handle.result(timeout_s=timeout)
+            except Exception as e:
+                # the reply is an error, so nobody will ever read the
+                # rest of this generation — free its slot/blocks now
+                decode.cancel(handle)
+                self._json_reply(500, {"error": f"{type(e).__name__}: "
+                                                f"{e}"})
+                return
+            info = handle.info
+            self._json_reply(200, {
+                "tokens": toks, "finish_reason": info["finish_reason"],
+                "ttft_ms": round(info["ttft_s"] * 1000, 3)
+                if info["ttft_s"] is not None else None})
+            return
+        # streaming: chunked ndjson, one line per token as it lands
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        n = 0
+        try:
+            for tok in handle.tokens(timeout_s=timeout):
+                self._chunk(json.dumps({"token": int(tok)}) + "\n")
+                n += 1
+            info = handle.info
+            self._chunk(json.dumps(_json_safe({
+                "done": True, "tokens": n,
+                "finish_reason": info["finish_reason"],
+                "ttft_ms": round(info["ttft_s"] * 1000, 3)
+                if info["ttft_s"] is not None else None})) + "\n")
+        except _base.CLIENT_GONE:
+            # the reader hung up mid-stream: abandon the generation so
+            # its decode slot and KV blocks free NOW instead of after
+            # max_new_tokens of unread work
+            decode.cancel(handle)
+            return
+        except Exception as e:
+            decode.cancel(handle)
+            # headers are gone; the error must travel in-band
+            try:
+                self._chunk(json.dumps({
+                    "done": True, "error": f"{type(e).__name__}: {e}",
+                    "tokens": n}) + "\n")
+            except _base.CLIENT_GONE:
+                return
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
+        # one generation per connection: chunked keep-alive reuse buys
+        # nothing here and a half-read stream must not poison the next
+        # request on the socket
+        self.close_connection = True
+
     def do_POST(self):  # noqa: N802 - stdlib naming
         try:
             path = urlparse(self.path).path
-            if path != "/v1/predict":
+            if path not in ("/v1/predict", "/v1/generate"):
                 self._reply(404, "text/plain",
-                            "not found; POST route: /v1/predict\n")
+                            "not found; POST routes: /v1/predict, "
+                            "/v1/generate\n")
                 return
             try:
                 length = int(self.headers.get("Content-Length", "0"))
                 payload = json.loads(self.rfile.read(length))
             except (ValueError, TypeError):
                 self._json_reply(400, {"error": "body must be JSON"})
+                return
+            if path == "/v1/generate":
+                if not isinstance(payload, dict):
+                    self._json_reply(400, {"error": "body must be a "
+                                                    "JSON object"})
+                    return
+                self._do_generate(payload)
                 return
             feeds = payload.get("feeds") if isinstance(payload, dict) \
                 else None
@@ -133,9 +245,18 @@ class Server:
     crashing deployments never leak the listener or batcher thread."""
 
     def __init__(self, config: ServingConfig,
-                 predictor=None):
+                 predictor=None, decode=None):
+        """`decode`, when given, is a `decode.DecodeEngine`; the server
+        then also answers POST /v1/generate and folds the decode block
+        into /v1/status. A decode-only server (no model_dir, no
+        predictor) skips the predict engine entirely — /v1/predict
+        answers 503."""
         self.config = config
-        self._engine = Engine(config, predictor=predictor)
+        self._decode = decode
+        self._engine = None \
+            if (decode is not None and config.model_dir is None
+                and predictor is None) \
+            else Engine(config, predictor=predictor)
         self._batcher: Optional[Batcher] = None
         handler = type("_BoundServingHandler", (_ServingHandler,),
                        {"serving": self})
@@ -152,28 +273,46 @@ class Server:
         with self._lock:
             if self._started_t is not None:
                 return self._http.port()
-            if self.config.warmup:
-                self._engine.warmup()
-            batcher = Batcher(
-                self._engine.run_batch, self._engine.policy,
-                max_queue=self.config.max_queue,
-                max_wait_ms=self.config.max_wait_ms,
-                timeout_s=self.config.timeout_s,
-                output_batched=self._engine.output_batched)
+            # thread-spawn ordering is the leak discipline: everything
+            # that can FAIL (warmups, the bind) happens before anything
+            # that starts a thread, except the batcher — whose
+            # constructor spawns — which is therefore created last
+            # before the bind and stopped if the bind raises. The
+            # decode scheduler starts only after the bind succeeds, so
+            # a failed start never leaves it running (and never kills
+            # the caller's engine, whose stop() is terminal).
+            if self._decode is not None and self.config.warmup \
+                    and not self._decode.warmed:
+                self._decode.warmup()
+            batcher = None
+            if self._engine is not None:
+                if self.config.warmup:
+                    self._engine.warmup()
+                batcher = Batcher(
+                    self._engine.run_batch, self._engine.policy,
+                    max_queue=self.config.max_queue,
+                    max_wait_ms=self.config.max_wait_ms,
+                    timeout_s=self.config.timeout_s,
+                    output_batched=self._engine.output_batched)
             try:
                 bound = self._http.start(
                     self.config.port if port is None else port,
                     host=self.config.host)
             except BaseException:
-                batcher.stop()  # a failed bind must not leak the thread
+                if batcher is not None:
+                    batcher.stop()  # failed bind must not leak the thread
                 raise
+            if self._decode is not None:
+                self._decode.start()
             self._batcher = batcher
             self._started_t = time.monotonic()
             import atexit
 
             atexit.register(self.stop)
             _events.emit("serve_start", port=bound,
-                         buckets=list(self._engine.policy.buckets),
+                         buckets=list(self._engine.policy.buckets)
+                         if self._engine is not None else [],
+                         decode=self._decode is not None,
                          max_queue=self.config.max_queue,
                          max_wait_ms=self.config.max_wait_ms)
             return bound
@@ -194,6 +333,8 @@ class Server:
             self._http.stop()
             if self._batcher is not None:
                 self._batcher.stop()
+            if self._decode is not None:
+                self._decode.stop()
             if not started:
                 return  # safety path: a start() that raised mid-way
             counts = self._counts()
@@ -219,7 +360,10 @@ class Server:
         embedded deployments share it)."""
         batcher = self._batcher
         if batcher is None:
-            raise ServerClosed("server not started")
+            raise ServerClosed("server not started"
+                               if self._engine is not None else
+                               "no predict engine on this server "
+                               "(decode-only deployment)")
         return batcher.submit(feeds, timeout_s=timeout_s)
 
     def status(self) -> Dict:
@@ -235,5 +379,8 @@ class Server:
             "timeout_s": self.config.timeout_s,
             "requests": self._counts(),
         }
-        st.update(self._engine.status())
+        if self._engine is not None:
+            st.update(self._engine.status())
+        if self._decode is not None:
+            st["decode"] = self._decode.status()
         return st

@@ -1,0 +1,212 @@
+"""Paged (blocked) KV cache for autoregressive decode.
+
+The classic serving memory problem (vLLM SOSP'23): a contiguous
+per-sequence KV buffer must be sized for max_seq_len, so HBM scales
+with max_len × batch even when most sequences are short — and XLA's
+static shapes make "grow the buffer" a recompile. The paged design
+keeps ONE preallocated device pool of fixed-size blocks per layer
+(`[L, num_blocks, block_size, kv_heads, head_dim]`) plus a tiny
+per-sequence *block table* mapping logical positions to pool blocks.
+Memory then scales with LIVE TOKENS (rounded up to the block size),
+sequences grow by appending a block id to their table — a host-side
+int, never a new executable — and the decode executable's shapes stay
+fixed no matter which sequences are resident.
+
+Layering: this module owns the host-side `BlockAllocator` (free-list,
+alloc/free, fragmentation accounting) and the pure jnp pool helpers
+(`init_pools`, `write_token_kv`, `write_prefill_kv`, `gather_kv`)
+that `models/gpt.py` composes into its decode-step attention. The
+scheduler that decides WHICH sequences own which blocks lives in
+`serving/decode.py`.
+
+Block 0 is reserved as the *null block*: padded/inactive decode slots
+and out-of-range table entries all read and write it, so a fixed-shape
+executable needs no validity branches — the attention length mask
+already guarantees nothing read from the null block ever contributes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["KVCacheConfig", "BlockAllocator", "NoBlocksError",
+           "init_pools", "write_token_kv", "write_prefill_kv",
+           "gather_kv", "NULL_BLOCK"]
+
+NULL_BLOCK = 0
+
+
+class NoBlocksError(RuntimeError):
+    """The pool has fewer free blocks than the allocation needs (the
+    scheduler reacts by deferring admission or preempting a sequence —
+    never by growing the pool, whose size is baked into executables)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    """Shape of the device pool. `max_len` bounds any single sequence
+    (prompt + generated) and fixes the block-table width every decode
+    executable is compiled against."""
+
+    layers: int
+    kv_heads: int
+    head_dim: int
+    max_len: int
+    block_size: int = 16
+    num_blocks: int = 64
+    dtype: str = "bfloat16"
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return -(-int(self.max_len) // int(self.block_size))
+
+    @property
+    def usable_blocks(self) -> int:
+        return int(self.num_blocks) - 1  # block 0 is the null block
+
+    def pool_bytes(self) -> int:
+        """Device bytes of BOTH pools (K and V)."""
+        per = (self.layers * self.num_blocks * self.block_size *
+               self.kv_heads * self.head_dim)
+        return 2 * per * jnp.dtype(self.dtype).itemsize
+
+
+class BlockAllocator:
+    """Host-side free-list over the pool's block ids (1..num_blocks-1;
+    block 0 is never handed out). Single-owner by design — the decode
+    scheduler thread is the only caller — so no locking here.
+
+    Fragmentation accounting: paged allocation has no *external*
+    fragmentation (any free block serves any sequence), so the number
+    reported is *internal* waste — slots allocated but not (yet)
+    holding a live token — which `waste_fraction` reports against the
+    allocated capacity."""
+
+    def __init__(self, cfg: KVCacheConfig):
+        self.cfg = cfg
+        if cfg.num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is reserved), got "
+                f"{cfg.num_blocks}")
+        self._free: List[int] = list(range(cfg.num_blocks - 1, 0, -1))
+        self._owned: Dict[int, bool] = {}
+
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def used_blocks(self) -> int:
+        return len(self._owned)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take n blocks off the free list; raises NoBlocksError
+        without allocating anything when fewer than n are free (a
+        partial grant would leak on the caller's error path)."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        if n > len(self._free):
+            raise NoBlocksError(
+                f"need {n} blocks, only {len(self._free)} of "
+                f"{self.cfg.usable_blocks} free")
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._owned[b] = True
+        return out
+
+    def free(self, blocks: Sequence[int]):
+        """Return blocks to the pool. Double-free and foreign ids are
+        programming errors and raise — silently re-listing a block
+        would hand the same block to two sequences."""
+        for b in blocks:
+            if b == NULL_BLOCK:
+                raise ValueError("block 0 (null block) is never "
+                                 "allocated and cannot be freed")
+            if b not in self._owned:
+                raise ValueError(f"block {b} is not allocated "
+                                 "(double free?)")
+            del self._owned[b]
+            self._free.append(int(b))
+
+    def stats(self, live_tokens: int = 0) -> Dict[str, float]:
+        used = self.used_blocks()
+        cap = used * self.cfg.block_size
+        waste = max(0, cap - int(live_tokens))
+        return {
+            "blocks_total": self.cfg.usable_blocks,
+            "blocks_free": self.free_blocks(),
+            "blocks_used": used,
+            "block_size": self.cfg.block_size,
+            "live_tokens": int(live_tokens),
+            "allocated_token_capacity": cap,
+            "internal_waste_tokens": waste,
+            "waste_fraction": round(waste / cap, 4) if cap else 0.0,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Pure pool helpers (traced into the decode/prefill executables)
+# ---------------------------------------------------------------------------
+
+
+def init_pools(cfg: KVCacheConfig) -> Tuple[jax.Array, jax.Array]:
+    """Zeroed K and V pools, `[L, NB, BS, kv_heads, head_dim]`."""
+    shape = (cfg.layers, cfg.num_blocks, cfg.block_size, cfg.kv_heads,
+             cfg.head_dim)
+    dt = jnp.dtype(cfg.dtype)
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def write_token_kv(pool_l: jax.Array, kv: jax.Array,
+                   block_tables: jax.Array, positions: jax.Array,
+                   block_size: int) -> jax.Array:
+    """Scatter one token's K (or V) per slot into a single layer's pool
+    slice. pool_l `[NB, BS, H, D]`, kv `[S, H, D]`, block_tables
+    `[S, MB]`, positions `[S]`. Inactive slots carry all-zero tables,
+    so their writes land in the null block."""
+    blk = jnp.take_along_axis(
+        block_tables, (positions // block_size)[:, None], axis=1)[:, 0]
+    slot = positions % block_size
+    return pool_l.at[blk, slot].set(kv)
+
+
+def write_prefill_kv(pool_l: jax.Array, kv: jax.Array,
+                     block_table: jax.Array, block_size: int) -> jax.Array:
+    """Scatter a whole prompt's K (or V) into one layer's pool slice.
+    pool_l `[NB, BS, H, D]`, kv `[T, H, D]` (positions 0..T-1),
+    block_table `[MB]`. Positions past the sequence's allocated blocks
+    hit table entries that are still 0 and land in the null block;
+    positions inside the last allocated block but past the true length
+    write garbage slots that the decode step overwrites before any
+    mask ever lets them be read."""
+    t = jnp.arange(kv.shape[0], dtype=jnp.int32)
+    blk = block_table[t // block_size]
+    slot = t % block_size
+    return pool_l.at[blk, slot].set(kv)
+
+
+def gather_kv(pool_l: jax.Array, block_tables: jax.Array) -> jax.Array:
+    """Gather every slot's full (padded) context from one layer's pool
+    slice: `[NB, BS, H, D]` × `[S, MB]` → `[S, MB*BS, H, D]`. The
+    caller masks positions `> position` (unwritten tail + null-block
+    reads of inactive slots)."""
+    s, mb = block_tables.shape
+    ctx = pool_l[block_tables]                       # [S, MB, BS, H, D]
+    return ctx.reshape(s, mb * pool_l.shape[1], *pool_l.shape[2:])
+
+
+def build_block_table(blocks: Sequence[int], max_blocks: int) -> np.ndarray:
+    """Host helper: a sequence's padded table row (unused tail = null
+    block)."""
+    row = np.zeros((max_blocks,), np.int32)
+    n = len(blocks)
+    if n > max_blocks:
+        raise ValueError(f"{n} blocks exceed table width {max_blocks}")
+    row[:n] = np.asarray(list(blocks), np.int32)
+    return row

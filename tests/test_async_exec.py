@@ -342,13 +342,14 @@ def test_chained_cache_lru_bounded(rng, monkeypatch):
             exe.run_chained(main, feed=feeds, fetch_list=[loss], n_steps=n)
         (step,) = [s for s in exe._cache.values() if s.fetch_names]
         assert len(step._chained) == 2
-        assert (5, False, False) in step._chained
+        # unroll="auto" resolves to unrolled windows on the CPU backend
+        assert (5, False, True) in step._chained
         assert telemetry.CHAINED_EVICTIONS.value() - ev0 == 2
         # reuse refreshes recency: 5 survives another insertion
         exe.run_chained(main, feed=feeds, fetch_list=[loss], n_steps=5)
         exe.run_chained(main, feed=feeds, fetch_list=[loss], n_steps=6)
-        assert (5, False, False) in step._chained
-        assert (6, False, False) in step._chained
+        assert (5, False, True) in step._chained
+        assert (6, False, True) in step._chained
 
 
 def test_run_sync_false_and_return_numpy_false(rng):

@@ -1,0 +1,662 @@
+"""Continuous-batching decode engine (ISSUE 12, SERVING.md
+§Continuous batching): paged KV block allocator, prefill/decode phase
+split, in-flight batching semantics, streaming HTTP, warmstart grid
+replay, and the serve_bench token-mode smoke.
+
+The load-bearing correctness claims pinned here:
+
+- the paged decode step computes EXACTLY what the full-context forward
+  computes (block-table attention == causal attention over the grown
+  sequence);
+- decode math is row-isolated, so a sequence's tokens are bit-identical
+  whatever else shares the batch (admit-mid-decode == solo decode) —
+  the property that makes continuous batching transparent to clients;
+- blocks scale with live tokens: finished sequences return every block,
+  pool pressure preempts-and-replays without changing emitted tokens;
+- a warmstart-booted engine replays the whole phase grid with ZERO
+  fresh compile events and bit-identical first tokens vs a cold boot.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu  # noqa: F401 — package init registers telemetry
+from paddle_tpu import observability
+from paddle_tpu.models import gpt
+from paddle_tpu.observability import events
+from paddle_tpu.serving import (DecodeConfig, DecodeEngine, QueueFullError,
+                                Server, ServingConfig)
+from paddle_tpu.serving.kv_cache import (BlockAllocator, KVCacheConfig,
+                                         NoBlocksError, build_block_table,
+                                         gather_kv, init_pools,
+                                         write_prefill_kv, write_token_kv)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = gpt.GPTConfig.tiny()
+    cfg.dtype = "float32"  # exactness vs the full-forward reference
+    params, _ = gpt.init(jax.random.key(0), cfg)
+    return params, cfg
+
+
+def make_engine(model, **kw):
+    params, cfg = model
+    base = dict(block_size=8, num_blocks=64, decode_slots=(4,),
+                prefill_buckets=(8,), precision="f32", max_len=64)
+    base.update(kw)
+    return DecodeEngine(params, cfg, DecodeConfig(**base))
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    eng = make_engine(model)
+    eng.warmup()
+    yield eng
+    eng.stop()
+
+
+def _compile_counts():
+    snap = observability.snapshot()
+    comp = snap.get("paddle_tpu_compile_seconds") or {"series": []}
+    out = {}
+    for s in comp["series"]:
+        k = s["labels"].get("kind", "?")
+        out[k] = out.get(k, 0) + s["count"]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Block allocator + pool helpers
+# ---------------------------------------------------------------------------
+
+
+def test_block_allocator_units():
+    cfg = KVCacheConfig(layers=2, kv_heads=2, head_dim=4, max_len=32,
+                        block_size=8, num_blocks=6)
+    al = BlockAllocator(cfg)
+    assert al.free_blocks() == 5          # block 0 reserved (null)
+    got = al.alloc(3)
+    assert len(got) == 3 and 0 not in got
+    assert al.used_blocks() == 3 and al.free_blocks() == 2
+    # exhaustion refuses WITHOUT a partial grant
+    with pytest.raises(NoBlocksError):
+        al.alloc(3)
+    assert al.free_blocks() == 2
+    al.free(got[:1])
+    assert al.free_blocks() == 3
+    # double free and null-block free are programming errors
+    with pytest.raises(ValueError):
+        al.free(got[:1])
+    with pytest.raises(ValueError):
+        al.free([0])
+    # fragmentation accounting: 2 blocks allocated, 9 live tokens ->
+    # capacity 16, waste 7
+    al2 = BlockAllocator(cfg)
+    al2.alloc(2)
+    st = al2.stats(live_tokens=9)
+    assert st["allocated_token_capacity"] == 16
+    assert st["internal_waste_tokens"] == 7
+    assert st["waste_fraction"] == round(7 / 16, 4)
+
+
+def test_allocator_rejects_degenerate_pool():
+    with pytest.raises(ValueError):
+        BlockAllocator(KVCacheConfig(layers=1, kv_heads=1, head_dim=2,
+                                     max_len=8, block_size=8,
+                                     num_blocks=1))
+
+
+def test_kv_pool_write_gather_roundtrip():
+    cfg = KVCacheConfig(layers=1, kv_heads=2, head_dim=3, max_len=16,
+                        block_size=4, num_blocks=5, dtype="float32")
+    kp, _ = init_pools(cfg)
+    pool = kp[0]                                   # one layer's slice
+    # prefill a 6-token sequence into blocks [1, 2]
+    kv = np.arange(6 * 2 * 3, dtype=np.float32).reshape(6, 2, 3)
+    bt = build_block_table([1, 2], cfg.max_blocks_per_seq)
+    pool = write_prefill_kv(pool, kv, bt, cfg.block_size)
+    ctx = gather_kv(pool, bt[None])                # [1, MB*BS, H, D]
+    np.testing.assert_array_equal(np.asarray(ctx)[0, :6], kv)
+    # decode-step write at position 6 (block 1 of the table, slot 2)
+    tok = np.full((1, 2, 3), 7.0, np.float32)
+    pool = write_token_kv(pool, tok, bt[None],
+                          np.array([6], np.int32), cfg.block_size)
+    ctx = gather_kv(pool, bt[None])
+    np.testing.assert_array_equal(np.asarray(ctx)[0, 6], tok[0])
+    # untouched tail stays zero
+    assert float(np.abs(np.asarray(ctx)[0, 7:8]).sum()) == 0.0
+
+
+def test_build_block_table_bounds():
+    row = build_block_table([3, 4], 4)
+    np.testing.assert_array_equal(row, [3, 4, 0, 0])
+    with pytest.raises(ValueError):
+        build_block_table([1, 2, 3], 2)
+
+
+# ---------------------------------------------------------------------------
+# Decode correctness
+# ---------------------------------------------------------------------------
+
+
+def test_decode_matches_full_forward(model, engine):
+    """The paged decode path (prefill + block-table attention steps)
+    must produce exactly the greedy tokens of the naive recompute-
+    everything forward — same floats, same argmax, every step."""
+    params, cfg = model
+    prompt = [1, 2, 3, 4, 5]
+    got = engine.submit(prompt, max_new_tokens=6).result(timeout_s=120)
+    seq = list(prompt)
+    want = []
+    for _ in range(6):
+        ids = np.asarray(np.array(seq, np.int32)[None])
+        logits = gpt.apply(params, cfg, ids)
+        t = int(np.argmax(np.asarray(logits[0, -1])))
+        want.append(t)
+        seq.append(t)
+    assert got == want
+
+
+def test_admit_mid_decode_bit_identical(engine):
+    """Continuous batching is transparent: sequence A's tokens are
+    bit-identical whether it decodes alone or a second request is
+    admitted into the running batch mid-generation (row-isolated
+    math + same slot-config executable)."""
+    solo = engine.submit([1, 2, 3, 4],
+                         max_new_tokens=12).result(timeout_s=120)
+    hA = engine.submit([1, 2, 3, 4], max_new_tokens=12)
+    time.sleep(0.02)  # let A's decode get going before B arrives
+    hB = engine.submit([9, 9], max_new_tokens=6)
+    assert hA.result(timeout_s=120) == solo
+    assert len(hB.result(timeout_s=120)) == 6
+
+
+def test_retirement_frees_blocks(engine):
+    """Blocks scale with live tokens: they are held while a sequence
+    decodes and ALL return to the pool at retirement."""
+    total = engine.kv_cfg.usable_blocks
+    h = engine.submit([1, 2, 3], max_new_tokens=30)
+    deadline = time.monotonic() + 60
+    seen_used = 0
+    while time.monotonic() < deadline:
+        st = engine.status()
+        seen_used = max(seen_used, st["kv"]["blocks_used"])
+        if st["kv"]["blocks_used"] and st["active"]:
+            break
+        time.sleep(0.002)
+    h.result(timeout_s=120)
+    assert seen_used > 0, "allocation never observed while decoding"
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if engine.status()["kv"]["blocks_free"] == total:
+            break
+        time.sleep(0.01)
+    st = engine.status()
+    assert st["kv"]["blocks_free"] == total
+    assert st["kv"]["blocks_used"] == 0
+
+
+def test_finish_reasons(model):
+    """max_new_tokens exhaustion reports "length"; sampling the
+    configured eos id reports "eos" and stops immediately (the beam
+    op's finished-freeze keeps the slot inert afterwards)."""
+    probe = make_engine(model)
+    probe.warmup()
+    toks = probe.submit([1, 2, 3], max_new_tokens=3).result(timeout_s=120)
+    h = probe.submit([1, 2, 3], max_new_tokens=3)
+    assert h.result(timeout_s=120) == toks
+    assert h.info["finish_reason"] == "length"
+    probe.stop()
+    eos_eng = make_engine(model, eos_id=toks[0])
+    eos_eng.warmup()
+    h = eos_eng.submit([1, 2, 3], max_new_tokens=10)
+    assert h.result(timeout_s=120) == [toks[0]]
+    assert h.info["finish_reason"] == "eos"
+    eos_eng.stop()
+
+
+def test_submit_validation(engine):
+    with pytest.raises(ValueError):
+        engine.submit([], max_new_tokens=4)
+    with pytest.raises(ValueError):
+        engine.submit([1] * 9, max_new_tokens=4)     # > largest bucket
+    with pytest.raises(ValueError):
+        engine.submit([999999], max_new_tokens=4)    # out of vocab
+    with pytest.raises(ValueError):
+        engine.submit([1, 2], max_new_tokens=0)
+
+
+# ---------------------------------------------------------------------------
+# Admission control / preemption
+# ---------------------------------------------------------------------------
+
+
+def _wait_active(eng, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if eng.status()["active"]:
+            return
+        time.sleep(0.002)
+    raise AssertionError("engine never admitted the request")
+
+
+def test_queue_full_rejects(model):
+    """Reject-not-block admission: with the drain-between-batches
+    scheduler holding one long generation active, the bounded waiting
+    queue fills and the next submit raises QueueFullError."""
+    eng = make_engine(model, static_batching=True, decode_slots=(1,),
+                      max_queue=1, max_len=64)
+    eng.warmup()
+    a = eng.submit([1, 2, 3], max_new_tokens=50)     # long generation
+    _wait_active(eng)                                # A holds the slot
+    eng.submit([4, 5], max_new_tokens=2)             # waits (static)
+    with pytest.raises(QueueFullError):
+        eng.submit([6, 7], max_new_tokens=2)
+    assert a.result(timeout_s=120)
+    assert eng.status()["requests"]["rejected"] == 1
+    eng.stop()
+
+
+def test_preemption_recompute_is_transparent(model):
+    """When the pool runs dry mid-decode, the youngest sequence is
+    preempted (blocks freed, re-queued with prompt+generated) and
+    re-prefilled later — emitted tokens are exactly the no-pressure
+    run's, with no duplicates and no gaps."""
+    # prefill buckets reach max_len so the preempt replay (original
+    # prompt + generated tokens) always has a bucket to land in
+    kw = dict(block_size=4, num_blocks=12, decode_slots=(2,),
+              prefill_buckets=(8, 40), max_len=40)
+    eng = make_engine(model, **kw)
+    eng.warmup()
+    # reference: each sequence alone (no pool pressure)
+    ref_a = eng.submit([1, 2, 3, 4], max_new_tokens=24).result(
+        timeout_s=120)
+    ref_b = eng.submit([5, 6, 7], max_new_tokens=24).result(timeout_s=120)
+    # concurrent: 2 growing sequences need 2*ceil(28/4)=14 > 11 blocks
+    hA = eng.submit([1, 2, 3, 4], max_new_tokens=24)
+    hB = eng.submit([5, 6, 7], max_new_tokens=24)
+    got_a = hA.result(timeout_s=180)
+    got_b = hB.result(timeout_s=180)
+    assert got_a == ref_a
+    assert got_b == ref_b
+    assert eng.status()["requests"].get("preempted", 0) > 0
+    eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Boot validation (PR 8 shape)
+# ---------------------------------------------------------------------------
+
+
+def test_boot_validation_findings_and_refusal(model, monkeypatch):
+    from paddle_tpu.analysis import AnalysisError
+
+    params, cfg = model
+    # level unset: errors are recorded, boot proceeds (serving Engine
+    # parity — only level 2 refuses)
+    monkeypatch.delenv("PADDLE_TPU_VALIDATE", raising=False)
+    eng = DecodeEngine(params, cfg, DecodeConfig(
+        block_size=8, num_blocks=4, decode_slots=(2,),
+        prefill_buckets=(8,), precision="f32", max_len=64))
+    assert eng.analysis["errors"] >= 1  # pool can't hold one sequence
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE", "2")
+    with pytest.raises(AnalysisError):
+        DecodeEngine(params, cfg, DecodeConfig(
+            block_size=8, num_blocks=4, decode_slots=(2,),
+            prefill_buckets=(8,), precision="f32", max_len=64))
+    with pytest.raises(AnalysisError, match="eos_id"):
+        DecodeEngine(params, cfg, DecodeConfig(
+            block_size=8, num_blocks=64, decode_slots=(2,),
+            prefill_buckets=(8,), precision="f32", max_len=64,
+            eos_id=10 ** 6))
+    # MoE configs are refused: no expert-dispatch decode path
+    moe_cfg = gpt.GPTConfig.tiny(n_experts=2)
+    moe_params, _ = gpt.init(jax.random.key(0), moe_cfg)
+    with pytest.raises(AnalysisError, match="MoE"):
+        DecodeEngine(moe_params, moe_cfg, DecodeConfig(
+            block_size=8, num_blocks=64, decode_slots=(2,),
+            prefill_buckets=(8,), precision="f32", max_len=64))
+
+
+def test_unknown_precision_fails_fast(model):
+    params, cfg = model
+    with pytest.raises(ValueError):
+        DecodeEngine(params, cfg, DecodeConfig(precision="mixed_f16"))
+    with pytest.raises(ValueError):
+        DecodeEngine(params, cfg, DecodeConfig(precision="int7"))
+
+
+def test_bf16_default_policy(model):
+    """bf16 is the decode default (PR 7): pools and params ride the
+    compute dtype, and generation works end to end."""
+    params, cfg = model
+    eng = DecodeEngine(params, cfg, DecodeConfig(
+        block_size=8, num_blocks=32, decode_slots=(2,),
+        prefill_buckets=(8,), max_len=48))
+    assert eng.config.precision == "bf16"
+    assert str(eng._pools[0].dtype) == "bfloat16"
+    eng.warmup()
+    toks = eng.submit([1, 2, 3], max_new_tokens=4).result(timeout_s=120)
+    assert len(toks) == 4
+    assert eng.status()["precision"] == "bf16"
+    eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# Warmstart phase grid
+# ---------------------------------------------------------------------------
+
+
+def test_warmstart_roundtrip_zero_compile(model, tmp_path):
+    """The PR 6 coldstart contract for the phase grid: a warm-booted
+    engine adopts every phase executable, pays ZERO fresh compile
+    events, and generates bit-identically to the cold engine."""
+    kw = dict(decode_slots=(2, 4), prefill_buckets=(8, 16))
+    cold = make_engine(model, **kw)
+    ready = cold.warmup()
+    assert ready == 4                     # 2 buckets + 2 slot configs
+    art = str(tmp_path / "decode.warmstart")
+    assert cold.export_warmstart(art) == 4
+    prompt = [3, 1, 4, 1, 5]
+    cold_toks = cold.submit(prompt, max_new_tokens=6).result(
+        timeout_s=120)
+    cold.stop()
+
+    before = _compile_counts()
+    warm = make_engine(model, warmstart=art, **kw)
+    assert warm.warmstart_adopted == 4
+    assert warm.warmup() == 4
+    warm_toks = warm.submit(prompt, max_new_tokens=6).result(
+        timeout_s=120)
+    warm.stop()
+    after = _compile_counts()
+    fresh = {k: after.get(k, 0) - before.get(k, 0)
+             for k in ("prefill", "decode")}
+    assert fresh == {"prefill": 0, "decode": 0}, fresh
+    assert warm_toks == cold_toks
+
+
+def test_warmstart_digest_reject(model, tmp_path):
+    """An artifact baked from different params (or grid) is rejected
+    whole with a warmstart reject event — cold boot, never wrong
+    tokens."""
+    cold = make_engine(model)
+    cold.warmup()
+    art = str(tmp_path / "decode.warmstart")
+    cold.export_warmstart(art)
+    cold.stop()
+    params2, _ = gpt.init(jax.random.key(1), gpt.GPTConfig.tiny())
+    cfg2 = gpt.GPTConfig.tiny()
+    cfg2.dtype = "float32"
+    seq0 = events.recent()[-1]["seq"] if events.recent() else -1
+    other = DecodeEngine(params2, cfg2, DecodeConfig(
+        block_size=8, num_blocks=64, decode_slots=(4,),
+        prefill_buckets=(8,), precision="f32", max_len=64,
+        warmstart=art))
+    assert other.warmstart_adopted == 0
+    rejects = [e for e in events.recent(kind="warmstart")
+               if e["seq"] > seq0 and e.get("action") == "reject"]
+    assert rejects and "digest" in rejects[0]["reason"]
+    # garbage artifact: same degradation, no crash
+    bad = str(tmp_path / "garbage")
+    with open(bad, "wb") as f:  # atomic-exempt: test fixture artifact
+        f.write(b"not a pickle")
+    assert other.load_warmstart(bad) == 0
+    other.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP streaming frontend
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_http_e2e(model):
+    eng = make_engine(model, max_queue=8)
+    eng.warmup()
+    srv = Server(ServingConfig(warmup=False), decode=eng)
+    port = srv.start(0)
+    url = f"http://127.0.0.1:{port}/v1/generate"
+
+    def post(payload, timeout=60):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        return urllib.request.urlopen(req, timeout=timeout)
+
+    try:
+        # chunked stream: tokens arrive as ndjson lines, closed by a
+        # done record carrying finish_reason + ttft
+        with post({"ids": [1, 2, 3], "max_new_tokens": 5}) as r:
+            assert r.headers.get("Transfer-Encoding") == "chunked"
+            recs = [json.loads(ln) for ln in r if ln.strip()]
+        toks = [rec["token"] for rec in recs if "token" in rec]
+        done = recs[-1]
+        assert len(toks) == 5
+        assert done["done"] and done["tokens"] == 5
+        assert done["finish_reason"] == "length"
+        assert done["ttft_ms"] > 0
+        # non-stream reply carries the same tokens (deterministic)
+        with post({"ids": [1, 2, 3], "max_new_tokens": 5,
+                   "stream": False}) as r:
+            body = json.loads(r.read())
+        assert body["tokens"] == toks
+        # status carries the decode block
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/status", timeout=30) as r:
+            st = json.loads(r.read())
+        assert st["decode"]["phase_grid"]["decode_slots"] == [4]
+        assert st["decode"]["requests"]["length"] >= 2
+        # malformed requests are 400s
+        for bad in ({"max_new_tokens": 4}, {"ids": []},
+                    {"ids": [10 ** 9]}):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                post(bad)
+            assert ei.value.code == 400
+        # /v1/predict on a decode-only server: 503, not a crash
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/predict",
+            data=json.dumps({"feeds": {"x": [[1.0]]}}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+    finally:
+        srv.stop()
+
+
+def test_http_queue_full_503(model):
+    eng = make_engine(model, static_batching=True, decode_slots=(1,),
+                      max_queue=1, max_len=64)
+    eng.warmup()
+    srv = Server(ServingConfig(warmup=False), decode=eng)
+    port = srv.start(0)
+    url = f"http://127.0.0.1:{port}/v1/generate"
+    try:
+        # long active generation + one waiting fills the queue
+        eng.submit([1, 2, 3], max_new_tokens=50)
+        _wait_active(eng)
+        eng.submit([4, 5], max_new_tokens=2)
+        req = urllib.request.Request(
+            url, data=json.dumps({"ids": [6, 7],
+                                  "max_new_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+    finally:
+        srv.stop()
+
+
+def test_block_boundary_admit_after_retire(model):
+    """Regression: a request admitted on the retire path (the mid-loop
+    _admit after a finished sequence frees its slot) whose prompt
+    length is an EXACT block multiple must get its next block before
+    the dispatch — without the _grow_blocks call there, its first
+    decode token's K/V landed in the null block and its attention was
+    silently corrupted from that step on."""
+    kw = dict(decode_slots=(1,), prefill_buckets=(8,), block_size=8,
+              num_blocks=32, max_len=64)
+    eng = make_engine(model, **kw)
+    eng.warmup()
+    prompt_b = [7, 1, 3, 5, 2, 6, 4, 1]        # len == block_size
+    solo = eng.submit(prompt_b, max_new_tokens=10).result(timeout_s=120)
+    # occupy the single slot, queue B behind it: B is admitted by the
+    # mid-loop _admit the moment A retires
+    hA = eng.submit([1, 2, 3], max_new_tokens=20)
+    _wait_active(eng)
+    hB = eng.submit(prompt_b, max_new_tokens=10)
+    assert len(hA.result(timeout_s=120)) == 20
+    assert hB.result(timeout_s=120) == solo
+    eng.stop()
+
+
+def test_stop_drains_preenqueued_requests(model):
+    """A request enqueued while no scheduler thread exists is drained
+    by stop() itself (the _loop finally never runs for a thread never
+    started) — its stream terminates with finish_reason='cancelled'
+    instead of blocking its caller forever."""
+    eng = make_engine(model)
+    with eng._cv:                     # enqueue without starting
+        eng._rid += 1
+        from paddle_tpu.serving.decode import _Request
+        req = _Request(eng._rid, np.array([1, 2], np.int32), 4)
+        eng._waiting.append(req)
+    eng.stop()
+    from paddle_tpu.serving.decode import DecodeHandle
+    assert DecodeHandle(req).result(timeout_s=10) == []
+    assert req.finish_reason == "cancelled"
+
+
+def test_client_disconnect_cancels_generation(model):
+    """A streaming client that hangs up mid-generation must not keep
+    its slot/KV blocks for the full max_new_tokens: the frontend
+    cancels the handle and the scheduler retires it, freeing the
+    pool."""
+    import http.client
+
+    eng = make_engine(model, max_len=64)
+    eng.warmup()
+    srv = Server(ServingConfig(warmup=False), decode=eng)
+    port = srv.start(0)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        conn.request("POST", "/v1/generate",
+                     body=json.dumps({"ids": [1, 2, 3],
+                                      "max_new_tokens": 55}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.readline()           # first token arrived → mid-stream
+        conn.close()              # hang up
+        deadline = time.monotonic() + 30
+        st = eng.status()
+        while time.monotonic() < deadline:
+            st = eng.status()
+            if st["requests"].get("cancelled", 0) >= 1 \
+                    and st["kv"]["blocks_used"] == 0 \
+                    and st["active"] == 0:
+                break
+            time.sleep(0.01)
+        assert st["requests"].get("cancelled", 0) >= 1, st
+        assert st["kv"]["blocks_used"] == 0
+    finally:
+        srv.stop()
+
+
+def test_engine_cancel_api(model, engine):
+    """DecodeEngine.cancel retires a live generation early; the
+    abandoned stream ends (finish_reason='cancelled') instead of
+    running to max_new_tokens."""
+    h = engine.submit([2, 3, 4], max_new_tokens=58)
+    _wait_active(engine)
+    engine.cancel(h)
+    toks = h.result(timeout_s=60)
+    assert len(toks) < 58
+    assert h.info["finish_reason"] == "cancelled"
+
+
+# ---------------------------------------------------------------------------
+# Observability
+# ---------------------------------------------------------------------------
+
+
+def test_decode_metrics_and_obsdump(model, engine, tmp_path, capsys):
+    engine.submit([2, 4, 6], max_new_tokens=4).result(timeout_s=120)
+    snap = observability.snapshot()
+    assert snap["paddle_tpu_decode_tokens_total"]["series"]
+    assert snap["paddle_tpu_decode_ttft_seconds"]["series"][0]["count"] \
+        >= 1
+    path = tmp_path / "metrics.json"
+    path.write_text(json.dumps(snap))
+    sys.path.insert(0, os.path.join(_REPO, "tools"))
+    try:
+        import obsdump
+    finally:
+        sys.path.pop(0)
+    assert obsdump.main(["decode", str(path)]) == 0
+    out = capsys.readouterr().out
+    assert "tokens:" in out and "kv blocks:" in out and "ttft:" in out
+    assert obsdump.main(["decode", str(path), "--json"]) == 0
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["tokens"].get("decode", 0) >= 1
+    assert rec["ttft"]["count"] >= 1
+
+
+def test_slot_config_grid_warmed(model):
+    eng = make_engine(model, decode_slots=(2, 4))
+    assert eng.warmup() == 3              # 1 bucket + 2 slot configs
+    assert all(d._aot is not None for d in eng._decode.values())
+    assert all(d._aot is not None for d in eng._prefill.values())
+    hs = [eng.submit([i + 1, i + 2], max_new_tokens=3) for i in range(3)]
+    assert all(len(h.result(timeout_s=120)) == 3 for h in hs)
+    assert eng.status()["slot_config"] in (2, 4)
+    eng.stop()
+
+
+# ---------------------------------------------------------------------------
+# serve_bench token mode (slow: subprocess, full A/B + grid replay)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_serve_bench_token_smoke():
+    """The ISSUE 12 acceptance, end to end in a fresh process:
+    continuous batching sustains >=2x tokens/s over the static
+    drain-between-batches baseline at equal-or-better p99, and the
+    warmstart-booted engine replays the phase grid with zero fresh
+    compiles and bit-identical tokens (serve_bench gates all of that
+    in its rc)."""
+    for attempt in (1, 2):
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "tools", "serve_bench.py"),
+             "--tokens", "--smoke"],
+            capture_output=True, text=True, timeout=560,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        if proc.returncode == 0:
+            break
+        # one retry: the speedup gate is a wall-clock measurement and a
+        # noisy-neighbor CI container can steal either phase's timing
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    recs = [json.loads(ln) for ln in proc.stdout.splitlines()
+            if ln.strip().startswith("{")]
+    by_metric = {r["metric"]: r for r in recs}
+    assert by_metric["decode_continuous_speedup"]["value"] >= 2.0
+    assert by_metric["decode_continuous_speedup"]["detail"][
+        "equal_p99_ok"]
+    replay = by_metric["decode_warm_replay_fresh_compiles"]
+    assert replay["value"] == 0
+    assert replay["detail"]["bit_identical"]
+    assert by_metric["decode_tokens_per_sec_continuous"]["value"] > 0

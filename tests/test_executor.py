@@ -223,3 +223,47 @@ def test_run_chained_per_step_feeds_matches_sequential(rng):
             exe.run_chained(main, feed={"x": Xs[0], "y": Ys[0]},
                             fetch_list=[loss], n_steps=n,
                             per_step_feeds=True)
+
+
+def test_run_chained_windowed_matches_sequential(rng):
+    """unroll="auto" past _UNROLL_WINDOW_MAX on CPU splits the run into
+    unrolled windows (the BENCH_r05 rolled-scan regression demotion):
+    per-step losses, final params, AND the rng stream must match n
+    sequential run() calls exactly — windowing is an execution detail,
+    not a semantic."""
+    from paddle_tpu.core.executor import _UNROLL_WINDOW_MAX
+
+    n_steps = _UNROLL_WINDOW_MAX + 3        # forces 2 windows
+    X = rng.rand(16, 13).astype("float32")
+    Y = (X @ rng.rand(13, 1)).astype("float32")
+
+    def train(chained):
+        pt.framework.unique_name.generator = \
+            pt.framework.UniqueNameGenerator()
+        main, startup, loss = _linreg_program()
+        exe = pt.Executor(pt.CPUPlace())
+        scope = pt.Scope()
+        with pt.scope_guard(scope):
+            exe.run(startup)
+            if chained:
+                losses = exe.run_chained(main, feed={"x": X, "y": Y},
+                                         fetch_list=[loss],
+                                         n_steps=n_steps)[0]
+                losses = [float(v) for v in np.asarray(losses).ravel()]
+            else:
+                losses = [float(np.asarray(
+                    exe.run(main, feed={"x": X, "y": Y},
+                            fetch_list=[loss])[0]).reshape(()))
+                    for _ in range(n_steps)]
+            params = {v.name: np.array(scope.get(v.name))
+                      for v in main.list_vars()
+                      if isinstance(v, pt.Parameter)}
+        return losses, params
+
+    seq_losses, seq_params = train(False)
+    ch_losses, ch_params = train(True)
+    assert len(ch_losses) == n_steps
+    np.testing.assert_allclose(ch_losses, seq_losses, rtol=1e-6)
+    for name in seq_params:
+        np.testing.assert_allclose(ch_params[name], seq_params[name],
+                                   rtol=1e-5, atol=1e-7)

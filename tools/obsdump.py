@@ -403,6 +403,120 @@ def cmd_ps(args) -> int:
     return 0
 
 
+def _hist_summary(snap, name):
+    """count / avg / estimated p50+p99 for an (unlabeled) histogram in
+    a snapshot. Percentiles interpolate within the cumulative `le`
+    buckets — an estimate, clearly better than nothing for a one-look
+    operator view."""
+    series = (snap.get(name) or {}).get("series", [])
+    if not series:
+        return None
+    s = series[0]
+    count, total = int(s.get("count", 0)), float(s.get("sum", 0.0))
+    if not count:
+        return {"count": 0}
+
+    def pct(q):
+        target = q * count
+        prev_le, cum = 0.0, 0
+        for b in s.get("buckets", []):
+            le, n = float(b["le"]), int(b["count"])  # per-bin count
+            if cum + n >= target:
+                frac = (target - cum) / max(1, n)
+                return prev_le + frac * (le - prev_le)
+            prev_le, cum = le, cum + n
+        return prev_le
+
+    return {"count": count, "avg_ms": round(1000 * total / count, 3),
+            "p50_ms": round(1000 * pct(0.50), 3),
+            "p99_ms": round(1000 * pct(0.99), 3)}
+
+
+def cmd_decode(args) -> int:
+    """Continuous-batching decode story from a metrics snapshot
+    (SERVING.md §Continuous batching): queue depth, slot occupancy,
+    KV-block accounting, token/step counters per phase, request
+    outcomes, preemptions, and the TTFT / per-step latency histograms.
+    With --events it also tails the decode events from a JSONL log."""
+    snap = _load_snap(args)
+    if snap is None:
+        print("decode: need a metrics.json path or --live",
+              file=sys.stderr)
+        return 2
+
+    def series(name):
+        return (snap.get(name) or {}).get("series", [])
+
+    def labeled(name, label):
+        return {s.get("labels", {}).get(label, "?"): s["value"]
+                for s in series(name)}
+
+    gauges = {
+        "queue_depth": next((int(s["value"]) for s in
+                             series("paddle_tpu_decode_queue_depth")),
+                            None),
+        "slots": {k: int(v) for k, v in
+                  labeled("paddle_tpu_decode_slots", "state").items()},
+        "kv_blocks": {k: int(v) for k, v in
+                      labeled("paddle_tpu_decode_kv_blocks",
+                              "state").items()},
+    }
+    tokens = {k: int(v) for k, v in
+              labeled("paddle_tpu_decode_tokens_total", "phase").items()}
+    steps = {k: int(v) for k, v in
+             labeled("paddle_tpu_decode_steps_total", "phase").items()}
+    outcomes = {k: int(v) for k, v in
+                labeled("paddle_tpu_decode_requests_total",
+                        "outcome").items()}
+    preempt = sum(int(s["value"]) for s in
+                  series("paddle_tpu_decode_preemptions_total"))
+    occ = (snap.get("paddle_tpu_decode_slot_occupancy") or {}) \
+        .get("series", [])
+    occ_avg = None
+    if occ and occ[0].get("count"):
+        occ_avg = round(float(occ[0]["sum"]) / occ[0]["count"], 3)
+    ttft = _hist_summary(snap, "paddle_tpu_decode_ttft_seconds")
+    step_h = _hist_summary(snap, "paddle_tpu_decode_step_seconds")
+
+    if not tokens and not steps and gauges["queue_depth"] is None:
+        print("no decode_* samples in this snapshot (did a DecodeEngine "
+              "run in this process?)")
+        return 0
+    out = dict(gauges, tokens=tokens, steps=steps, requests=outcomes,
+               preemptions=preempt, slot_occupancy_avg=occ_avg,
+               ttft=ttft, step_seconds=step_h)
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    print(f"queue depth: {gauges['queue_depth']}")
+    print("slots: " + (", ".join(f"{k}={v}" for k, v in
+                                 sorted(gauges["slots"].items()))
+                       or "none") +
+          (f"  (occupancy avg {occ_avg})" if occ_avg is not None else ""))
+    print("kv blocks: " + (", ".join(f"{k}={v}" for k, v in
+                                     sorted(gauges["kv_blocks"].items()))
+                           or "none"))
+    print("tokens: " + (", ".join(f"{k}={v}" for k, v in
+                                  sorted(tokens.items())) or "none"))
+    print("steps: " + (", ".join(f"{k}={v}" for k, v in
+                                 sorted(steps.items())) or "none"))
+    print("requests: " + (", ".join(f"{k}={v}" for k, v in
+                                    sorted(outcomes.items()) if v)
+                          or "none"))
+    print(f"preemptions: {preempt}")
+    for label, h in (("ttft", ttft), ("step", step_h)):
+        if h and h.get("count"):
+            print(f"{label}: n={h['count']} avg={h['avg_ms']}ms "
+                  f"p50~{h['p50_ms']}ms p99~{h['p99_ms']}ms")
+    if args.events:
+        evs = _load_obs_module("events").read_jsonl(args.events, n=args.n,
+                                                    kind="decode")
+        print(f"\nlast {len(evs)} decode events:")
+        for ev in evs:
+            print("  " + _fmt_event(ev))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="obsdump", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -472,6 +586,21 @@ def main(argv=None) -> int:
     pp.add_argument("-n", type=int, default=20,
                     help="with --events: last N events (default 20)")
     pp.set_defaults(fn=cmd_ps)
+
+    dp = sub.add_parser("decode", help="continuous-batching decode "
+                        "summary (queue, slots, KV blocks, TTFT, "
+                        "per-step latency) from a metrics snapshot")
+    dp.add_argument("path", nargs="?", help="metrics.json from "
+                    "PADDLE_TPU_METRICS_DIR (omit with --live)")
+    dp.add_argument("--live", action="store_true",
+                    help="read this process's registry instead of a file")
+    dp.add_argument("--json", action="store_true",
+                    help="JSON instead of the summary lines")
+    dp.add_argument("--events", default=None, metavar="JSONL",
+                    help="also tail decode events from this event log")
+    dp.add_argument("-n", type=int, default=20,
+                    help="with --events: last N events (default 20)")
+    dp.set_defaults(fn=cmd_decode)
 
     # unknown/missing subcommands exit nonzero via argparse itself
     # (required=True subparsers error out with status 2)

@@ -79,6 +79,34 @@ def refresh_parity_op_count():
         print(f"PARITY.md op count already {live}")
 
 
+def bench_fallback_recorded(data) -> bool:
+    """Distinguish "chip wedged, CPU fallback recorded" from "harness
+    crashed" for a BENCH driver file with rc != 0 (VERDICT weak #7 /
+    ROADMAP item 5). True when the recorded metric lines carry the
+    structured top-level `env` block bench.py now attaches and at
+    least one of them records an actual TPU→CPU fallback
+    (tpu_reachable false + a fallback_reason): the harness ran to
+    completion and said so, which is citable as CPU evidence. A file
+    whose lines carry no env blocks (pre-env bench, or a crash before
+    any line was written) stays an error under rc != 0."""
+    recs = []
+    parsed = data.get("parsed")
+    if isinstance(parsed, list):
+        recs.extend(r for r in parsed if isinstance(r, dict))
+    elif isinstance(parsed, dict):
+        recs.append(parsed)
+    for line in (data.get("tail") or "").splitlines():
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            recs.append(rec)
+    envs = [r.get("env") for r in recs if isinstance(r.get("env"), dict)]
+    return any(e.get("tpu_reachable") is False and e.get("fallback_reason")
+               for e in envs)
+
+
 def lint_evidence_claims():
     """Claims may only cite driver evidence that exists AND recorded ok
     (VERDICT r4 item 9: round 4 claimed a flagship number against a
@@ -108,9 +136,14 @@ def lint_evidence_claims():
                 errors.append(f"{doc} cites {name}, but {name}.json is "
                               "not valid JSON")
                 continue
-            if name.startswith("BENCH_") and data.get("rc") != 0:
-                errors.append(f"{doc} cites {name}, but its recorded "
-                              f"rc={data.get('rc')} (driver run failed)")
+            if name.startswith("BENCH_") and data.get("rc") != 0 \
+                    and not bench_fallback_recorded(data):
+                errors.append(
+                    f"{doc} cites {name}, but its recorded "
+                    f"rc={data.get('rc')} with no structured env "
+                    "fallback on its metric lines (harness crash, not "
+                    "a recorded CPU fallback — see "
+                    "bench_fallback_recorded)")
             if name.startswith("MULTICHIP_") and not data.get("ok"):
                 errors.append(f"{doc} cites {name}, but its recorded "
                               f"ok={data.get('ok')} (driver run failed)")

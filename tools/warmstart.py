@@ -11,7 +11,20 @@ once, and serialize the executables into one artifact the engine (or
 Usage:
   warmstart.py bake --model-dir DIR --out ART [--buckets 1,2,4,8]
                     [--max-batch N] [--cpu]
+  warmstart.py bake-decode --out ART [--preset tiny] [--seed 0]
+                    [--slots 4,8] [--prefill-buckets 8,16,32]
+                    [--block-size 16] [--num-blocks N]
+                    [--precision bf16] [--cpu]
   warmstart.py inspect ART
+
+`bake-decode` (ISSUE 12) pre-bakes the decode engine's whole PHASE
+GRID — every prefill-length bucket plus every decode slot-count
+executable — so a decode serving boot replays the grid from I/O with
+zero fresh compiles (`DecodeConfig(warmstart=...)`). The model is
+rebuilt deterministically from --preset/--seed (jax PRNG is
+reproducible across processes for a fixed jax version), and the
+artifact is bound to the params digest + grid geometry, so a drifted
+model or config is rejected at adoption, never silently served.
 
 `bake` prints one JSON line: buckets warmed, entries serialized,
 warmup seconds, artifact size. `inspect` reads only the artifact
@@ -91,6 +104,65 @@ def cmd_bake(args) -> int:
     return 0 if n else 1
 
 
+def cmd_bake_decode(args) -> int:
+    import contextlib
+
+    sys.path.insert(0, _REPO)
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving.decode import DecodeConfig, DecodeEngine
+
+    if args.preset != "tiny":
+        print(f"bake-decode: unknown --preset {args.preset!r} (only "
+              "'tiny' is shipped; build bigger grids through the "
+              "DecodeEngine API)", file=sys.stderr)
+        return 2
+    try:
+        slots = sorted({int(s) for s in args.slots.split(",")})
+        buckets = sorted({int(b) for b in
+                          args.prefill_buckets.split(",")})
+    except ValueError:
+        print(f"bake-decode: bad --slots/--prefill-buckets (want e.g. "
+              f"4,8)", file=sys.stderr)
+        return 2
+    cfg = gpt.GPTConfig.tiny()
+    params, _ = gpt.init(jax.random.key(args.seed), cfg)
+    max_len = args.max_len or cfg.max_len
+    blocks_per_seq = -(-max_len // args.block_size)
+    num_blocks = args.num_blocks or \
+        (1 + max(slots) * blocks_per_seq)
+    dc = DecodeConfig(block_size=args.block_size, num_blocks=num_blocks,
+                      decode_slots=slots, prefill_buckets=buckets,
+                      max_len=max_len, precision=args.precision)
+    if args.cpu:
+        guard = contextlib.nullcontext()
+    else:
+        from paddle_tpu.core.tpu_lock import tpu_singleflight
+
+        guard = tpu_singleflight(timeout=600.0)
+    with guard:
+        t0 = time.perf_counter()
+        engine = DecodeEngine(params, cfg, dc)
+        ready = engine.warmup()
+        warm_s = time.perf_counter() - t0
+        n = engine.export_warmstart(args.out)
+    print(json.dumps({
+        "artifact": args.out,
+        "preset": args.preset, "seed": args.seed,
+        "phase_grid": {"prefill_buckets": buckets,
+                       "decode_slots": slots},
+        "phases_ready": ready,
+        "entries": n,
+        "precision": args.precision,
+        "warmup_seconds": round(warm_s, 3),
+        "artifact_bytes": os.path.getsize(args.out),
+    }), flush=True)
+    return 0 if n else 1
+
+
 def cmd_inspect(args) -> int:
     try:
         with open(args.artifact, "rb") as f:
@@ -113,6 +185,20 @@ def cmd_inspect(args) -> int:
     # as to unpickling errors
     try:
         entries = art["entries"]
+        if art.get("format") == "paddle_tpu-decode-warmstart-v1":
+            # decode artifacts key entries by phase ("prefill", T) /
+            # ("decode", S), not by feed signature
+            signatures = [
+                {"phase": f"{kind}@{n}",
+                 "blob_bytes": len(e["blob"]),
+                 "fingerprint": (e.get("fingerprint") or "")[:16]}
+                for (kind, n), e in sorted(entries.items())]
+        else:
+            signatures = [
+                {"feeds": [f"{n}:{list(s)}:{d}" for n, s, d in sig],
+                 "blob_bytes": len(e["blob"]),
+                 "fingerprint": (e.get("fingerprint") or "")[:16]}
+                for sig, e in sorted(entries.items())]
         report = {
             "format": art.get("format"),
             "jax_version": art.get("jax_version"),
@@ -120,14 +206,10 @@ def cmd_inspect(args) -> int:
             "device_kind": art.get("device_kind"),
             "model_digest": art.get("model_digest"),
             "buckets": art.get("buckets"),
+            "phase_grid": art.get("grid"),
             "created_at": art.get("created_at"),
             "entries": len(entries),
-            "signatures": [
-                {"feeds": [f"{n}:{list(s)}:{d}" for n, s, d in sig],
-                 "blob_bytes": len(e["blob"]),
-                 "fingerprint": (e.get("fingerprint") or "")[:16]}
-                for sig, e in sorted(entries.items())
-            ],
+            "signatures": signatures,
         }
     except Exception as e:
         print(f"inspect: {args.artifact} has malformed entries: {e!r}",
@@ -154,6 +236,29 @@ def main(argv=None) -> int:
                     help="bake for the CPU backend (artifacts are "
                     "backend-bound)")
     bp.set_defaults(fn=cmd_bake)
+
+    dp = sub.add_parser("bake-decode", help="pre-bake a decode "
+                        "engine's full phase grid (prefill buckets + "
+                        "decode slot configs) into one artifact")
+    dp.add_argument("--out", required=True, help="artifact path")
+    dp.add_argument("--preset", default="tiny",
+                    help="model preset (deterministic from --seed)")
+    dp.add_argument("--seed", type=int, default=0)
+    dp.add_argument("--slots", default="4,8",
+                    help="comma-separated decode slot counts")
+    dp.add_argument("--prefill-buckets", default="8,16,32",
+                    help="comma-separated prompt-length buckets")
+    dp.add_argument("--block-size", type=int, default=16)
+    dp.add_argument("--num-blocks", type=int, default=None,
+                    help="KV pool blocks (default: worst-case for the "
+                    "slot count)")
+    dp.add_argument("--max-len", type=int, default=None)
+    dp.add_argument("--precision", default="bf16",
+                    choices=("f32", "bf16"))
+    dp.add_argument("--cpu", action="store_true",
+                    help="bake for the CPU backend (artifacts are "
+                    "backend-bound)")
+    dp.set_defaults(fn=cmd_bake_decode)
 
     ip = sub.add_parser("inspect", help="print an artifact's metadata "
                         "(no jax import)")
