@@ -359,3 +359,9 @@ def maybe_validate(program, feed_names=(), fetch_names=(), policy=None,
 
 
 from . import passes  # noqa: E402,F401  (self-registers the suite)
+# The runtime concurrency sanitizer (PADDLE_TPU_LOCKCHECK instrumented
+# lock factories + deadlock detection) lives beside the program passes:
+# same package, same observability contract, different substrate
+# (threads instead of ProgramDescs). Stdlib-only, so importing it here
+# costs nothing.
+from . import lockcheck  # noqa: E402,F401

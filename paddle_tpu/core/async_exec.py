@@ -131,7 +131,9 @@ class FetchHandle:
         self._site = site
         self._transform = transform
         self._dispatch_t = time.perf_counter()
-        self._lock = threading.Lock()
+        from ..analysis import lockcheck as _lockcheck  # deferred
+
+        self._lock = _lockcheck.Lock("core.async_exec.FetchHandle._lock")
         # run_stream stamps these so drivers can map a window handle
         # back to global step numbers without side tables
         self.n_steps: Optional[int] = None

@@ -384,7 +384,9 @@ def tpu_singleflight(timeout=600.0, lease_s=DEFAULT_LEASE_S,
     from a daemon thread every lease_s/3 — so a long-but-healthy run
     keeps its lease, while a wedged process (renew thread starved or
     dead) expires and gets reaped by the next waiter."""
+    t_wait = time.monotonic()
     fd = acquire(timeout=timeout, lease_s=lease_s, lock_path=lock_path)
+    t_held = time.monotonic()
     stop = threading.Event()
 
     def _renewer():
@@ -403,3 +405,13 @@ def tpu_singleflight(timeout=600.0, lease_s=DEFAULT_LEASE_S,
         stop.set()
         thread.join(timeout=5)  # don't close fd under a mid-renew write
         release(fd)
+        # the cross-process single-flight lease rides the same held-
+        # seconds/contention table as the in-process locks (the acquire
+        # poll is 2s, so a wait of >=1s means another holder was inside)
+        from ..analysis import lockcheck as _lockcheck  # deferred
+
+        if _lockcheck.level() >= 1:
+            _lockcheck.note_held(
+                "core.tpu_lock.singleflight",
+                time.monotonic() - t_held,
+                contended=(t_held - t_wait) >= 1.0)

@@ -67,7 +67,21 @@ class HTTPServerHandle:
         self._port_env = port_env
         self._host_env = host_env
         self._default_host = default_host
+        # This module is imported (and the observability handle
+        # INSTANTIATED) while the package is still bootstrapping, so the
+        # sanitizer factory is best-effort AND gated on the raw env var:
+        # at level 0 (the default) nothing beyond stdlib is imported,
+        # and during early init a failing analysis import degrades to
+        # the raw primitive (the stdlib-only contract holds either way).
         self._lock = threading.Lock()
+        if os.environ.get("PADDLE_TPU_LOCKCHECK", "0") not in ("", "0"):
+            try:
+                from ..analysis import lockcheck as _lockcheck
+
+                self._lock = _lockcheck.Lock(
+                    "observability.httpbase.HTTPServerHandle._lock")
+            except ImportError:  # mid-bootstrap: plain primitive stays
+                pass  # lint-exempt:swallow: best-effort instrumentation
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._atexit_registered = False

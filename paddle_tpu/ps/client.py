@@ -124,7 +124,11 @@ class _Conn:
         self.endpoint = endpoint
         self.host, self.port = host, int(port)
         self.sock: Optional[socket.socket] = None
-        self.lock = threading.Lock()
+        # deferred import: the analysis package must not load during
+        # package bootstrap; constructors only run after it
+        from ..analysis import lockcheck as _lockcheck
+
+        self.lock = _lockcheck.Lock("ps.client._Conn.lock")
         # cid is per-CONNECTION-OBJECT, not per-socket: a reconnect keeps
         # the cid so a pre-reconnect retry still dedupes server-side
         self.cid = uuid.uuid4().hex
@@ -565,7 +569,10 @@ class AsyncCommunicator:
         self._stop = threading.Event()
         self._threads: Dict[str, threading.Thread] = {}
         self._grad_num = 0              # grads sent since last recv
-        self._grad_lock = threading.Lock()
+        from ..analysis import lockcheck as _lockcheck  # deferred
+
+        self._grad_lock = _lockcheck.Lock(
+            "ps.client.AsyncCommunicator._grad_lock")
         self._recv_scope = None
         self._recv_params: List[str] = []
         self._recv_thread: Optional[threading.Thread] = None

@@ -52,7 +52,12 @@ class HeartBeatMonitor:
         self.last_beat = {i: 0.0 for i in range(num_trainers)}
         self.timeout_s = timeout_s
         self.lost: List[int] = []
-        self._lock = threading.Lock()
+        # deferred import: the analysis package must not load during
+        # package bootstrap; constructors only run after it
+        from ..analysis import lockcheck as _lockcheck
+
+        self._lock = _lockcheck.Lock(
+            "ps.server.HeartBeatMonitor._lock")
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._watch, daemon=True)
         self._thread.start()
@@ -77,6 +82,10 @@ class HeartBeatMonitor:
 
     def stop(self):
         self._stop.set()
+        # the watcher wakes from its Event.wait on set(); join so stop()
+        # returning means the thread is actually gone (stopjoin pass)
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
 
 def snapshot_config_from_env(endpoint: str) -> Dict[str, Any]:
@@ -161,7 +170,9 @@ class _VarState:
         # actual grad var name the descs reference (clipping and other
         # grad-rewriting passes rename it away from <param>@GRAD)
         self.grad_name = grad_name or None
-        self.lock = threading.Lock()
+        from ..analysis import lockcheck as _lockcheck  # deferred
+
+        self.lock = _lockcheck.Lock("ps.server._VarState.lock")
 
 
 class ParameterServer:
@@ -210,17 +221,24 @@ class ParameterServer:
         self.aux: Dict[str, np.ndarray] = {}   # optimizer accumulators
         self.aux_owner: Dict[str, str] = {}    # aux name -> owning param
         self.monitor = HeartBeatMonitor(num_trainers)
-        self._barrier_lock = threading.Lock()
+        from ..analysis import lockcheck as _lockcheck  # deferred
+
+        self._barrier_lock = _lockcheck.Lock(
+            "ps.server.ParameterServer._barrier_lock")
         self._send_barrier: set = set()
-        self._step_done = threading.Condition(self._barrier_lock)
+        self._step_done = _lockcheck.Condition(
+            self._barrier_lock,
+            name="ps.server.ParameterServer._step_done")
         self._generation = 0
         # global-shuffle exchange plane (reference:
         # DatasetImpl::GlobalShuffle, data_set.cc:295 — records re-routed
         # across trainers through the fleet RPC; here the PS coordinates
         # the pass seed, buffers per-target record batches, and barriers
         # until every trainer has routed before handing shards back)
-        self._shuf_lock = threading.Lock()
-        self._shuf_cv = threading.Condition(self._shuf_lock)
+        self._shuf_lock = _lockcheck.Lock(
+            "ps.server.ParameterServer._shuf_lock")
+        self._shuf_cv = _lockcheck.Condition(
+            self._shuf_lock, name="ps.server.ParameterServer._shuf_cv")
         self._shuf_pass = 0
         self._shuf_seed = 0
         self._shuf_begun: set = set()
@@ -230,10 +248,12 @@ class ParameterServer:
         self._server: Optional[socketserver.ThreadingTCPServer] = None
         # retried-request dedupe: cid -> (seq, reply), bounded LRU
         self._reply_cache: "OrderedDict[str, tuple]" = OrderedDict()
-        self._reply_lock = threading.Lock()
+        self._reply_lock = _lockcheck.Lock(
+            "ps.server.ParameterServer._reply_lock")
         # durable snapshots
         self._snap_mgr = None
-        self._snap_lock = threading.Lock()
+        self._snap_lock = _lockcheck.Lock(
+            "ps.server.ParameterServer._snap_lock")
         self._snap_step = 0
         self._dirty = threading.Event()
         self._snap_stop = threading.Event()
@@ -261,6 +281,7 @@ class ParameterServer:
         values: Dict[str, np.ndarray] = {}
         var_meta: Dict[str, dict] = {}
         for name, vs in list(self.vars.items()):
+            # lock-id: ps.server._VarState.lock
             with vs.lock:
                 values[name] = np.array(vs.value, copy=True)
             var_meta[name] = {"opt_descs": vs.opt_descs,
@@ -537,6 +558,7 @@ class ParameterServer:
                             f"sync get-barrier timeout: generation "
                             f"{self._generation} < requested {gen} (a peer "
                             f"trainer is likely dead or wedged)"}
+            # lock-id: ps.server._VarState.lock
             with vs.lock:
                 if self.mode == "async" and self.dc_lambda > 0.0:
                     self._pull_snapshots[(msg.get("trainer_id", 0),
@@ -551,6 +573,7 @@ class ParameterServer:
                 return {"error": f"unknown var {name}"}
             grad = np.asarray(msg["grad"])
             if self.mode == "async":
+                # lock-id: ps.server._VarState.lock
                 with vs.lock:
                     if self.dc_lambda > 0.0:
                         bak = self._pull_snapshots.get((tid, name))
@@ -559,6 +582,7 @@ class ParameterServer:
                                 (vs.value - bak)
                     self._run_opt(vs, name, grad)
             else:  # sync: hold per-trainer until barrier (resend replaces)
+                # lock-id: ps.server._VarState.lock
                 with vs.lock:
                     vs.recv[tid] = grad
             return {"ok": True}
@@ -591,6 +615,7 @@ class ParameterServer:
             vs = self.vars.get(name)
             if vs is None:
                 return {"error": f"unknown var {name}"}
+            # lock-id: ps.server._VarState.lock
             with vs.lock:
                 vs.value = vs.value + np.asarray(msg["delta"])
             return {"ok": True}
@@ -605,6 +630,7 @@ class ParameterServer:
                 if len(self._send_barrier) >= self.num_trainers:
                     self._send_barrier.clear()
                     for name, vs in self.vars.items():
+                        # lock-id: ps.server._VarState.lock
                         with vs.lock:
                             if vs.recv:
                                 g = (sum(vs.recv.values())
@@ -623,6 +649,7 @@ class ParameterServer:
                 return {"error": f"sparse id out of range for "
                                  f"{msg['name']}: [{ids.min()}, {ids.max()}] "
                                  f"vs {len(vs.value)} local rows"}
+            # lock-id: ps.server._VarState.lock
             with vs.lock:  # torn reads vs concurrent push_sparse_grad
                 return {"rows": vs.value[ids].copy()}
         if op == "push_sparse_grad":
@@ -636,6 +663,7 @@ class ParameterServer:
                                  f"vs {len(vs.value)} local rows"}
             grads = np.asarray(msg["grads"])
             lr = float(msg.get("lr", 0.01))
+            # lock-id: ps.server._VarState.lock
             with vs.lock:
                 np.subtract.at(vs.value, ids, lr * grads)
             return {"ok": True}
@@ -659,6 +687,7 @@ class ParameterServer:
             with self._barrier_lock:
                 self._send_barrier.discard(tid)
             for vname, vs in list(self.vars.items()):
+                # lock-id: ps.server._VarState.lock
                 with vs.lock:
                     vs.recv.pop(tid, None)
                     # drop the dead incarnation's DC-ASGD pull snapshot:
@@ -696,6 +725,7 @@ class ParameterServer:
                 from ..resilience import atomic as _atomic
 
                 for name, vs in list(self.vars.items()):
+                    # lock-id: ps.server._VarState.lock
                     with vs.lock:
                         _atomic.np_save(
                             os.path.join(dirname, var_filename(name)),

@@ -98,7 +98,12 @@ class CircuitBreaker:
         self.reset_timeout_s = float(reset_timeout_s)
         self._clock = clock
         self._on_transition = on_transition
-        self._lock = threading.Lock()
+        # deferred import: the analysis package must not load during
+        # package bootstrap; constructors only run after it
+        from ..analysis import lockcheck as _lockcheck
+
+        self._lock = _lockcheck.Lock(
+            "resilience.retry.CircuitBreaker._lock")
         self._state = self.CLOSED
         self._failures = 0          # consecutive, in closed state
         self._opened_at = 0.0

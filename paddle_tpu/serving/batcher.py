@@ -121,7 +121,12 @@ class Batcher:
         self._max_queue = int(max_queue)
         self._max_wait = float(max_wait_ms) / 1000.0
         self._timeout_s = float(timeout_s)
-        self._cv = threading.Condition()
+        # deferred import: the analysis package must not load during
+        # package bootstrap; constructors only run after it
+        from ..analysis import lockcheck as _lockcheck
+
+        self._cv = _lockcheck.Condition(
+            name="serving.batcher.Batcher._cv")
         self._pending: List[_Request] = []
         self._closed = False
         # per-instance outcome counts (the REQUESTS metric is process-

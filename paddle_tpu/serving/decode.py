@@ -297,7 +297,12 @@ class DecodeEngine:
 
         self._pools = init_pools(self.kv_cfg)
         self._alloc = BlockAllocator(self.kv_cfg)
-        self._cv = threading.Condition()
+        # deferred import: the analysis package must not load during
+        # package bootstrap; constructors only run after it
+        from ..analysis import lockcheck as _lockcheck
+
+        self._cv = _lockcheck.Condition(
+            name="serving.decode.DecodeEngine._cv")
         self._waiting: "collections.deque[_Request]" = collections.deque()
         self._active: List[_Request] = []
         self._closed = False

@@ -49,6 +49,7 @@ import numpy as np
 from ..observability import events as _events
 from ..observability import httpbase as _base
 from ..observability.metrics import _json_safe
+from .decode import DecodeEngine
 from .batcher import (Batcher, EngineError, QueueFullError,
                       RequestTimeout, ServerClosed)
 from .engine import Engine, ServingConfig
@@ -252,7 +253,9 @@ class Server:
         predictor) skips the predict engine entirely — /v1/predict
         answers 503."""
         self.config = config
-        self._decode = decode
+        # annotated so tools/lockgraph.py can type the attribute (the
+        # value is a constructor parameter it cannot infer from)
+        self._decode: Optional[DecodeEngine] = decode
         self._engine = None \
             if (decode is not None and config.model_dir is None
                 and predictor is None) \
@@ -262,7 +265,11 @@ class Server:
                        {"serving": self})
         self._http = _base.HTTPServerHandle(
             handler, thread_name="paddle-tpu-serving-http")
-        self._lock = threading.Lock()
+        # deferred import: the analysis package must not load during
+        # package bootstrap; constructors only run after it
+        from ..analysis import lockcheck as _lockcheck
+
+        self._lock = _lockcheck.Lock("serving.httpd.Server._lock")
         self._started_t: Optional[float] = None
 
     # -- lifecycle -----------------------------------------------------

@@ -155,6 +155,14 @@ class ControllerServer:
             self._socket.close()
         except OSError:
             pass
+        # join the accept loop so close() returning means the port is
+        # actually released — EXCEPT when close() is called from the
+        # serve thread itself (the "close" request arrives through
+        # _handle, which runs ON self._thread; joining would self-wait)
+        t = self._thread
+        if t is not None and t is not threading.current_thread() \
+                and t.is_alive():
+            t.join(timeout=5.0)
 
 
 class SearchAgent:

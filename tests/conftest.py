@@ -34,6 +34,9 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running multi-process tests")
+    config.addinivalue_line(
+        "markers", "thread_leak_ok: this test intentionally leaves "
+        "threads behind (exempt from the thread-leak sentinel)")
 
 
 # ---------------------------------------------------------------------------
@@ -134,6 +137,72 @@ def pytest_sessionfinish(session, exitstatus):
         _kill_wait(proc)
     _live_procs.clear()
     reap_stray_workers()
+    # Concurrency-sanitizer verdict line: when this session ran under
+    # PADDLE_TPU_LOCKCHECK, print the deadlock/inversion totals so a
+    # wrapper (test_lockcheck's slow family run) can assert on them
+    # without needing a metrics dump to have fired.
+    if os.environ.get("PADDLE_TPU_LOCKCHECK", "0") not in ("", "0"):
+        try:
+            from paddle_tpu.analysis import lockcheck
+        except ImportError:
+            return
+        inversions = lockcheck.observed_inversions()
+        print(f"\nLOCKCHECK deadlocks={lockcheck.deadlock_count()} "
+              f"inversions={len(inversions)}")
+        for inv in inversions:
+            print(f"LOCKCHECK-INVERSION {inv['first']} -> "
+                  f"{inv['second']} x{inv['count']}")
+
+
+# ---------------------------------------------------------------------------
+# Thread hygiene (ISSUE 13): every Batcher/DecodeEngine/heartbeat/PS-sender
+# thread a test starts must be gone when the test ends — the "thread
+# hygiene" review class from PR 3/11, now an automatic gate. Non-daemon
+# leaks block interpreter exit; they fail (or warn) the leaking test
+# itself, with @pytest.mark.thread_leak_ok as the explicit escape.
+#   PADDLE_TPU_THREADLEAK=warn (default) | error | off
+# ---------------------------------------------------------------------------
+
+import threading as _threading  # noqa: E402
+import time as _time  # noqa: E402
+import warnings as _warnings  # noqa: E402
+
+
+def _leaked_threads(before, grace_s: float = 1.0):
+    """Live non-daemon threads that were not running at test entry.
+    Threads mid-exit get `grace_s` to finish (a stop() that just
+    returned may leave its worker one scheduler slice from death)."""
+    deadline = _time.monotonic() + grace_s
+    while True:
+        leaked = [t for t in _threading.enumerate()
+                  if t not in before and t.is_alive() and not t.daemon
+                  and t is not _threading.current_thread()]
+        if not leaked or _time.monotonic() >= deadline:
+            return leaked
+        _time.sleep(0.05)
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_sentinel(request):
+    mode = os.environ.get("PADDLE_TPU_THREADLEAK", "warn").lower()
+    if mode in ("off", "0", ""):
+        yield
+        return
+    if request.node.get_closest_marker("thread_leak_ok"):
+        yield
+        return
+    before = set(_threading.enumerate())
+    yield
+    leaked = _leaked_threads(before)
+    if not leaked:
+        return
+    names = ", ".join(f"{t.name} (ident={t.ident})" for t in leaked)
+    msg = (f"test leaked {len(leaked)} non-daemon thread(s): {names} — "
+           f"join them in the test/fixture teardown, or mark the test "
+           f"@pytest.mark.thread_leak_ok")
+    if mode == "error":
+        pytest.fail(msg)
+    _warnings.warn(msg, stacklevel=1)
 
 
 @pytest.fixture(autouse=True)

@@ -71,6 +71,23 @@ def test_lint_pass_clean(pass_name):
     assert not findings, "\n".join(str(f) for f in findings)
 
 
+# -- lock-order analysis (tools/lockgraph.py, ISSUE 13) ----------------------
+
+
+def test_lockgraph_clean():
+    """The interprocedural held->acquired graph over paddle_tpu/ has no
+    unexempted cycles and no edges contradicting the committed
+    tools/lock_order.json ledger. A failure here means a change
+    introduced a potential lock-order inversion: fix the acquisition
+    order, or justify it ('# lock-order-exempt: <why>' /
+    a ledger exempt_edges entry) and regenerate the ledger with
+    `tools/lockgraph.py --write-ledger`."""
+    import lockgraph
+
+    findings = lockgraph.analyze()
+    assert not findings, "\n".join(str(f) for f in findings)
+
+
 def lint_durable_writes():
     """Back-compat shim: PR 4 callers (and docs) reach the atomic pass
     through this name."""

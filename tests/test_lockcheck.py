@@ -1,0 +1,532 @@
+"""Concurrency sanitizer (ISSUE 13): runtime lockcheck + static
+lockgraph/condwait/stopjoin, each proven against a seeded defect.
+
+The acceptance contract: a two-lock ABBA deadlock under
+PADDLE_TPU_LOCKCHECK=2 raises DeadlockError naming the cycle instead of
+hanging; an observed ledger inversion is counted at level 1; each new
+static pass fires exactly once on its fixture; and the real tree is
+clean (zero unexempted lock-order cycles)."""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+import lockgraph  # noqa: E402
+from lint import lint_paths  # noqa: E402
+
+from paddle_tpu.analysis import lockcheck  # noqa: E402
+
+
+@pytest.fixture
+def level2(monkeypatch):
+    monkeypatch.setenv(lockcheck.ENV_VAR, "2")
+    lockcheck.reset()
+    yield
+    lockcheck.reset()
+
+
+@pytest.fixture
+def level1(monkeypatch):
+    monkeypatch.setenv(lockcheck.ENV_VAR, "1")
+    lockcheck.reset()
+    yield
+    lockcheck.reset()
+    lockcheck.set_ledger(None)
+
+
+# ---------------------------------------------------------------------------
+# runtime prong
+# ---------------------------------------------------------------------------
+
+
+def test_level0_returns_raw_primitives(monkeypatch):
+    monkeypatch.delenv(lockcheck.ENV_VAR, raising=False)
+    assert isinstance(lockcheck.Lock("x"), type(threading.Lock()))
+    assert isinstance(lockcheck.Condition(name="x"), threading.Condition)
+
+
+def test_abba_deadlock_raises_instead_of_hanging(level2):
+    """The acceptance scenario: two threads taking A/B in opposite
+    orders deadlock for real; level 2 breaks it with DeadlockError
+    naming every thread and lock in the cycle."""
+    A = lockcheck.Lock("abba.A")
+    B = lockcheck.Lock("abba.B")
+    barrier = threading.Barrier(2)
+    errors = {}
+
+    def worker(first, second, key):
+        try:
+            with first:
+                barrier.wait(timeout=5)
+                time.sleep(0.05)
+                with second:
+                    pass
+        except lockcheck.DeadlockError as e:
+            errors[key] = e
+
+    t1 = threading.Thread(target=worker, args=(A, B, "t1"),
+                          name="abba-t1", daemon=True)
+    t2 = threading.Thread(target=worker, args=(B, A, "t2"),
+                          name="abba-t2", daemon=True)
+    t1.start()
+    t2.start()
+    t1.join(timeout=15)
+    t2.join(timeout=15)
+    assert not t1.is_alive() and not t2.is_alive(), \
+        "deadlock was NOT broken — threads still hung"
+    assert errors, "no DeadlockError raised"
+    msg = str(next(iter(errors.values())))
+    # the error names both locks and at least one thread of the cycle
+    assert "abba.A" in msg and "abba.B" in msg
+    assert "abba-t" in msg
+    assert lockcheck.deadlock_count() >= 1
+
+
+def test_inversion_counted_against_ledger(level1):
+    lockcheck.set_ledger(["inv.A", "inv.B"])
+    A = lockcheck.Lock("inv.A")
+    B = lockcheck.Lock("inv.B")
+    with A:
+        with B:
+            pass  # ledger order: fine
+    assert lockcheck.observed_inversions() == []
+    with B:
+        with A:
+            pass  # contradicts the ledger
+    inv = lockcheck.observed_inversions()
+    assert len(inv) == 1
+    assert inv[0]["first"] == "inv.B" and inv[0]["second"] == "inv.A"
+    from paddle_tpu.observability import metrics as _m
+
+    c = _m.counter("paddle_tpu_lock_inversions_total",
+                   labelnames=("first", "second"))
+    assert c.value(first="inv.B", second="inv.A") >= 1
+
+
+def test_ledger_exempt_edges_suppress_runtime_inversions(level1):
+    """exempt_edges bless an edge for BOTH prongs: an exempted pair
+    must not count as a runtime inversion either."""
+    lockcheck.set_ledger(
+        ["ex.A", "ex.B"],
+        exempt_edges=[{"first": "ex.B", "second": "ex.A",
+                       "why": "blessed for the test"}])
+    A = lockcheck.Lock("ex.A")
+    B = lockcheck.Lock("ex.B")
+    with B:
+        with A:
+            pass
+    assert lockcheck.observed_inversions() == []
+    assert ("ex.B", "ex.A") in lockcheck.observed_edges()
+
+
+def test_contention_and_held_metrics(level1):
+    from paddle_tpu.observability import metrics as _m
+
+    L = lockcheck.Lock("contend.L")
+    entered = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with L:
+            entered.set()
+            release.wait(timeout=5)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert entered.wait(timeout=5)
+    got = L.acquire(timeout=0.2)    # contends, times out
+    assert not got
+    release.set()
+    t.join(timeout=5)
+    assert _m.counter("paddle_tpu_lock_contention_total",
+                      labelnames=("site",)).value(site="contend.L") >= 1
+    h = _m.histogram("paddle_tpu_lock_held_seconds",
+                     labelnames=("site",))
+    assert h.stats(site="contend.L")["count"] >= 1
+
+
+def test_condition_wrapper_wait_notify(level2):
+    cv = lockcheck.Condition(name="cv.test")
+    ready = []
+
+    def consumer():
+        with cv:
+            while not ready:
+                cv.wait(timeout=5)
+
+    t = threading.Thread(target=consumer, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        ready.append(1)
+        cv.notify_all()
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+
+def test_condition_wait_for_predicate(level2):
+    cv = lockcheck.Condition(name="cv.waitfor")
+    state = {"n": 0}
+
+    def bump():
+        time.sleep(0.05)
+        with cv:
+            state["n"] = 3
+            cv.notify_all()
+
+    t = threading.Thread(target=bump, daemon=True)
+    t.start()
+    with cv:
+        ok = cv.wait_for(lambda: state["n"] >= 3, timeout=5)
+    assert ok
+    t.join(timeout=5)
+
+
+def test_rlock_reentry(level2):
+    R = lockcheck.RLock("re.R")
+    with R:
+        with R:  # re-entry must not self-report a deadlock
+            assert True
+
+
+# ---------------------------------------------------------------------------
+# static prong: seeded-defect fixtures (exactly one finding each)
+# ---------------------------------------------------------------------------
+
+_CONDWAIT_BAD = '''\
+import threading
+
+
+class Q:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def get(self):
+        with self._cv:
+            if not self._items:
+                self._cv.wait()
+            return self._items.pop()
+'''
+
+_CONDWAIT_OK = '''\
+import threading
+
+
+class Q:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+
+    def get(self):
+        with self._cv:
+            while not self._items:
+                self._cv.wait()
+            return self._items.pop()
+
+    def get2(self):
+        with self._cv:
+            self._cv.wait_for(lambda: self._items)
+            return self._items.pop()
+
+    def poke(self, ev):
+        ev.wait(1.0)  # Event.wait needs no predicate loop
+'''
+
+_STOPJOIN_BAD = '''\
+import threading
+
+
+class Worker:
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        self._running = False
+'''
+
+_STOPJOIN_OK = '''\
+import threading
+
+
+class Worker:
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        self._thread.join(timeout=5)
+'''
+
+_LOCKGRAPH_ABBA = '''\
+import threading
+
+
+class S:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._b:
+            with self._a:
+                pass
+'''
+
+_LOCKGRAPH_CALL_CYCLE = '''\
+import threading
+
+_x = threading.Lock()
+_y = threading.Lock()
+
+
+def takes_y():
+    with _y:
+        pass
+
+
+def takes_x():
+    with _x:
+        pass
+
+
+def path_one():
+    with _x:
+        takes_y()
+
+
+def path_two():
+    with _y:
+        takes_x()
+'''
+
+
+def _write(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(src)
+    return str(p)
+
+
+def test_condwait_fixture_fires_once(tmp_path):
+    findings = lint_paths([_write(tmp_path, "bad.py", _CONDWAIT_BAD)],
+                          passes=["condwait"])
+    assert len(findings) == 1
+    assert findings[0].pass_name == "condwait"
+    assert "while" in findings[0].message
+
+
+def test_condwait_clean_shapes_pass(tmp_path):
+    findings = lint_paths([_write(tmp_path, "ok.py", _CONDWAIT_OK)],
+                          passes=["condwait"])
+    assert findings == []
+
+
+def test_stopjoin_fixture_fires_once(tmp_path):
+    findings = lint_paths([_write(tmp_path, "bad.py", _STOPJOIN_BAD)],
+                          passes=["stopjoin"])
+    assert len(findings) == 1
+    assert findings[0].pass_name == "stopjoin"
+    assert "_thread" in findings[0].message
+
+
+def test_stopjoin_joined_class_passes(tmp_path):
+    findings = lint_paths([_write(tmp_path, "ok.py", _STOPJOIN_OK)],
+                          passes=["stopjoin"])
+    assert findings == []
+
+
+def test_stopjoin_alias_join_and_str_join(tmp_path):
+    """A stop() joining through a local alias passes; str.join /
+    os.path.join never count as thread joins; and joining only ONE of
+    two spawned threads still flags the other."""
+    src = '''\
+import os
+import threading
+
+
+class TwoThreads:
+    def start(self):
+        self._a = threading.Thread(target=self._run, daemon=True)
+        self._b = threading.Thread(target=self._run, daemon=True)
+        self._a.start()
+        self._b.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        msg = ", ".join(["x"])          # string join: not a thread join
+        p = os.path.join("/tmp", "y")   # path join: not a thread join
+        t = self._a
+        t.join(timeout=5)               # alias join covers _a only
+'''
+    findings = lint_paths([_write(tmp_path, "two.py", src)],
+                          passes=["stopjoin"])
+    assert len(findings) == 1, findings
+    assert "_b" in findings[0].message
+
+
+def test_lockgraph_detects_abba_cycle(tmp_path):
+    findings = lockgraph.analyze(
+        [_write(tmp_path, "abba.py", _LOCKGRAPH_ABBA)],
+        ledger_path=None)
+    cycles = [f for f in findings if f.pass_name == "lock-cycle"]
+    assert len(cycles) == 1
+    # both acquisition sites are named
+    assert "abba.py" in cycles[0].message
+    assert "S._a" in cycles[0].message and "S._b" in cycles[0].message
+
+
+def test_lockgraph_detects_interprocedural_cycle(tmp_path):
+    findings = lockgraph.analyze(
+        [_write(tmp_path, "callcyc.py", _LOCKGRAPH_CALL_CYCLE)],
+        ledger_path=None)
+    cycles = [f for f in findings if f.pass_name == "lock-cycle"]
+    assert len(cycles) == 1
+    assert "via call" in cycles[0].message
+
+
+def test_lockgraph_exempt_comment_breaks_cycle(tmp_path):
+    src = _LOCKGRAPH_ABBA.replace(
+        "        with self._b:\n            with self._a:",
+        "        with self._b:\n            # lock-order-exempt: test escape\n"
+        "            with self._a:")
+    findings = lockgraph.analyze(
+        [_write(tmp_path, "abba2.py", src)], ledger_path=None)
+    assert [f for f in findings if f.pass_name == "lock-cycle"] == []
+
+
+def test_lockgraph_ledger_violation(tmp_path):
+    src = '''\
+import threading
+
+_p = threading.Lock()
+_q = threading.Lock()
+
+
+def f():
+    with _q:
+        with _p:
+            pass
+'''
+    mod = _write(tmp_path, "ledgered.py", src)
+    ledger = tmp_path / "lock_order.json"
+    ledger.write_text(
+        '{"order": ["ledgered._p", "ledgered._q"], "exempt_edges": []}')
+    findings = lockgraph.analyze([mod], ledger_path=str(ledger))
+    viol = [f for f in findings if f.pass_name == "lock-ledger"]
+    assert len(viol) == 1
+    assert "ledgered._q" in viol[0].message
+
+
+def test_ledger_is_well_formed():
+    """The committed ledger parses, blesses a duplicate-free order, and
+    every exempt edge is justified. (The full clean-tree gate — zero
+    unexempted cycles over paddle_tpu/ — runs once per tier-1 in
+    tests/test_evidence_lint.py::test_lockgraph_clean; duplicating the
+    whole-corpus walk here would pay it twice.)"""
+    import json
+
+    with open(lockgraph.DEFAULT_LEDGER) as f:
+        ledger = json.load(f)
+    order = ledger["order"]
+    assert order, "ledger order must not be empty"
+    assert len(order) == len(set(order)), "duplicate ids in ledger order"
+    for e in ledger.get("exempt_edges", []):
+        assert e.get("first") and e.get("second") and e.get("why"), \
+            f"exempt edge must carry first/second/why: {e}"
+
+
+# ---------------------------------------------------------------------------
+# thread-leak sentinel (conftest helper)
+# ---------------------------------------------------------------------------
+
+
+def test_leak_helper_catches_nondaemon_thread():
+    from conftest import _leaked_threads
+
+    before = set(threading.enumerate())
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, args=(10,), daemon=False,
+                         name="leak-victim")
+    t.start()
+    try:
+        leaked = _leaked_threads(before, grace_s=0.1)
+        assert t in leaked
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert _leaked_threads(before, grace_s=0.5) == []
+
+
+@pytest.mark.thread_leak_ok
+def test_thread_leak_ok_marker_is_honored():
+    """With the marker, a (short-lived) leak does not fail the test —
+    the thread parks briefly past teardown, then exits on its own."""
+    t = threading.Thread(target=time.sleep, args=(0.2,), daemon=False,
+                         name="marked-leak")
+    t.start()
+
+
+# ---------------------------------------------------------------------------
+# the instrumented serving path + slow whole-family gate
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_under_lockcheck2(level2):
+    from paddle_tpu.serving.batcher import Batcher
+    from paddle_tpu.serving.bucketing import BucketPolicy
+
+    b = Batcher(lambda feeds: {"y": feeds["x"] * 2}, BucketPolicy(4))
+    try:
+        out = b.submit({"x": np.ones((2, 3), np.float32)})
+        assert (out["y"] == 2).all()
+    finally:
+        b.stop()
+    assert lockcheck.deadlock_count() == 0
+    from paddle_tpu.observability import metrics as _m
+
+    h = _m.histogram("paddle_tpu_lock_held_seconds", labelnames=("site",))
+    assert h.stats(site="serving.batcher.Batcher._cv")["count"] > 0
+
+
+@pytest.mark.slow
+def test_threaded_families_clean_under_lockcheck2(tmp_path):
+    """Run the threaded test families once with the sanitizer armed:
+    zero deadlocks, zero unledgered inversions (the conftest
+    sessionfinish line carries the verdict)."""
+    env = dict(os.environ)
+    env["PADDLE_TPU_LOCKCHECK"] = "2"
+    env.pop("PADDLE_TPU_METRICS_DIR", None)
+    families = ["tests/test_serving.py", "tests/test_decode.py",
+                "tests/test_elastic.py", "tests/test_ps_resilience.py"]
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
+         "-p", "no:cacheprovider", *families],
+        cwd=_REPO, env=env, capture_output=True, text=True, timeout=1200)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"families failed under LOCKCHECK=2:\n{out[-4000:]}"
+    verdicts = [ln for ln in out.splitlines()
+                if ln.startswith("LOCKCHECK ")]
+    assert verdicts, f"no LOCKCHECK verdict line in output:\n{out[-2000:]}"
+    assert verdicts[-1] == "LOCKCHECK deadlocks=0 inversions=0", verdicts

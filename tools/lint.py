@@ -32,6 +32,21 @@ Passes:
               nested function bodies are skipped (they run later, off
               the lock) and waiting ON the held condition variable is
               fine (wait releases it).
+  condwait  — a bare `Condition.wait()` not lexically inside a `while`
+              loop: condition waits are subject to spurious wakeups and
+              stolen wakeups, so the predicate must be re-checked in a
+              loop (`while not pred: cv.wait()`) or the wait written as
+              `cv.wait_for(pred)`, which loops internally and is never
+              flagged. Only receivers assigned `threading.Condition`/
+              `lockcheck.Condition` in the same file are considered —
+              `Event.wait` needs no predicate loop.
+  stopjoin  — a class that spawns a `threading.Thread` bound to a self
+              attribute in a start-like method (`__init__`/`start*`/
+              `open*`) where no stop-like method (`stop*`/`close*`/
+              `shutdown*`/`terminate*`/`__exit__`) joins THAT attr
+              (directly or through a local alias; str.join/os.path.join
+              never count): shutdown returns while the worker still
+              runs, the PR 3/11 review class this pass automates.
 
 Usage:
   lint.py [paths...] [--json] [--pass NAME] [--list]
@@ -340,6 +355,181 @@ def _lockblock_pass(f: _File) -> List[LintFinding]:
                 f"stalls for the duration (move it outside the lock or "
                 f"add '# lint-exempt:lockblock: <why>')",
                 f.line(call.lineno)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# condwait: Condition.wait() must sit in a while-predicate loop
+# ---------------------------------------------------------------------------
+
+
+def _condition_names(f: _File) -> set:
+    """Attribute/variable names bound to a Condition factory anywhere
+    in the file (threading.Condition or the lockcheck factory)."""
+    names = set()
+    for node in ast.walk(f.tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        if _call_name(node.value).split(".")[-1] != "Condition":
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Attribute):
+                names.add(t.attr)
+            elif isinstance(t, ast.Name):
+                names.add(t.id)
+    return names
+
+
+@lint_pass("condwait")
+def _condwait_pass(f: _File) -> List[LintFinding]:
+    cond_names = _condition_names(f)
+    if not cond_names:
+        return []
+    out = []
+
+    def visit(node, in_while):
+        for child in ast.iter_child_nodes(node):
+            child_in_while = in_while
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                child_in_while = False  # a nested body runs elsewhere
+            elif isinstance(child, ast.While):
+                child_in_while = True
+            if isinstance(child, ast.Call):
+                name = _call_name(child)
+                recv, _, attr = name.rpartition(".")
+                if attr == "wait" \
+                        and recv.split(".")[-1] in cond_names \
+                        and not in_while \
+                        and not f.exempt(child.lineno, "condwait"):
+                    out.append(LintFinding(
+                        f.rel, child.lineno, "condwait",
+                        f"`{name}()` outside a while loop — condition "
+                        f"waits wake spuriously and lose races; re-check "
+                        f"the predicate in a loop, use "
+                        f"`{recv}.wait_for(pred)`, or add "
+                        f"'# lint-exempt:condwait: <why>'",
+                        f.line(child.lineno)))
+            visit(child, child_in_while)
+
+    visit(f.tree, False)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# stopjoin: a stop/close path must join the threads start spawned
+# ---------------------------------------------------------------------------
+
+_STARTISH = ("start", "open")
+_STOPPISH = ("stop", "close", "shutdown", "terminate")
+
+
+def _is_startish(name: str) -> bool:
+    return name == "__init__" or name.startswith(_STARTISH)
+
+
+def _is_stoppish(name: str) -> bool:
+    return name == "__exit__" or name.startswith(_STOPPISH)
+
+
+def _thread_join_receivers(method) -> set:
+    """Local/attr names whose `.join()` plausibly joins a thread in
+    this method. `", ".join(parts)` and `os.path.join(...)` must NOT
+    count — they would silently exempt spawned threads."""
+    names = set()
+    for node in ast.walk(method):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"):
+            continue
+        recv = node.func.value
+        if isinstance(recv, ast.Constant):
+            continue  # ", ".join(...) — a string join
+        parts = _call_name(node).rsplit(".", 2)
+        if len(parts) >= 2 and parts[-2] in ("path", "os"):
+            continue  # os.path.join(...)
+        if isinstance(recv, ast.Name):
+            names.add(recv.id)
+        elif isinstance(recv, ast.Attribute):
+            names.add(recv.attr)
+    return names
+
+
+def _alias_joined_attrs(method) -> set:
+    """Thread attrs joined through a local alias in this method —
+    `t = self._thread` (or `t, self._thread = self._thread, None`)
+    followed by `t.join(...)`. Resolved PER ATTRIBUTE so a class that
+    spawns two threads but joins only one is still flagged for the
+    other."""
+    aliases = {}  # local name -> self attr it was read from
+    for node in ast.walk(method):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt, val = node.targets[0], node.value
+        pairs = []
+        if isinstance(tgt, ast.Tuple) and isinstance(val, ast.Tuple) \
+                and len(tgt.elts) == len(val.elts):
+            pairs = list(zip(tgt.elts, val.elts))
+        else:
+            pairs = [(tgt, val)]
+        for t, v in pairs:
+            if isinstance(t, ast.Name) and isinstance(v, ast.Attribute) \
+                    and isinstance(v.value, ast.Name) \
+                    and v.value.id == "self":
+                aliases[t.id] = v.attr
+    joined = _thread_join_receivers(method)
+    return {attr for name, attr in aliases.items() if name in joined}
+
+
+@lint_pass("stopjoin")
+def _stopjoin_pass(f: _File) -> List[LintFinding]:
+    out = []
+    for cls in (n for n in ast.walk(f.tree)
+                if isinstance(n, ast.ClassDef)):
+        methods = [m for m in cls.body
+                   if isinstance(m, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        stoppers = [m for m in methods if _is_stoppish(m.name)]
+        if not stoppers:
+            continue  # no shutdown surface to hold accountable
+        spawns = []  # (attr, assign lineno) in start-like methods
+        for m in methods:
+            if not _is_startish(m.name):
+                continue
+            for node in ast.walk(m):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                cname = _call_name(node.value)
+                if not (cname == "Thread" or cname.endswith(".Thread")):
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and isinstance(t.value, ast.Name) \
+                            and t.value.id == "self":
+                        spawns.append((t.attr, node.lineno))
+        if not spawns:
+            continue
+        cls_src = ast.get_source_segment(f.src, cls) or ""
+        joined = set(re.findall(r"(\w+)\s*\.\s*join\s*\(", cls_src))
+        for m in stoppers:
+            joined |= _alias_joined_attrs(m)
+        for attr, lineno in spawns:
+            # joined directly (self._t.join) anywhere in the class, or
+            # through a stop-method local alias (t = self._t; t.join())
+            if attr in joined:
+                continue
+            if f.exempt(lineno, "stopjoin"):
+                continue
+            out.append(LintFinding(
+                f.rel, lineno, "stopjoin",
+                f"class {cls.name} spawns thread `self.{attr}` in a "
+                f"start-like method but no stop/close path joins it — "
+                f"shutdown returns while the worker still runs (join it "
+                f"in {', '.join(m.name + '()' for m in stoppers)}, or "
+                f"add '# lint-exempt:stopjoin: <why>')",
+                f.line(lineno)))
     return out
 
 

@@ -1,0 +1,850 @@
+#!/usr/bin/env python
+"""lockgraph — static lock-order analysis over the paddle_tpu codebase.
+
+The runtime sanitizer (`paddle_tpu/analysis/lockcheck.py`,
+PADDLE_TPU_LOCKCHECK) catches the deadlock that actually forms; this
+tool PROVES the absence of the class before anything runs. It walks
+every `.py` file (reusing tools/lint.py's file walker and Finding
+shape), infers a canonical identity for each lock, builds the
+interprocedural held→acquired edge graph, and reports every cycle —
+a potential lock-order inversion — as an error naming both
+acquisition sites.
+
+Lock identities
+  `self._lock` assigned `threading.Lock()/RLock()/Condition()` (or the
+  lockcheck factories) in class C of module m  →  `m.C._lock`
+  module-level `_lock = threading.Lock()`      →  `m._lock`
+  function-local locks                         →  `m.func._lock`
+  `Condition(self._mu)` aliases to the wrapped lock's id (one
+  identity, matching the runtime wrapper); a lockcheck factory's
+  explicit `name="..."` literal wins over derivation, which is how the
+  static ids and the runtime metric sites stay one naming scheme.
+
+Edges
+  direct lexical nesting (`with a: ... with b:`), `.acquire()` spans,
+  and call-mediated acquisition: while holding `a`, calling a function
+  whose transitive closure acquires `b` adds a→b. Calls resolve
+  through self-methods (with base classes), same-module functions,
+  `self.attr` objects of known class, and paddle_tpu-internal imports.
+
+Escapes (each must carry a why)
+  `# lock-order-exempt: <why>` on an acquisition line drops every edge
+  through that site; `# lock-id: <id>` forces an unresolvable
+  expression (`vs.lock` on a duck-typed local) onto a known identity,
+  and `# lock-id: external` excludes one on purpose.
+
+The ledger (tools/lock_order.json, shared with the runtime prong)
+  {"order": [id, ...], "exempt_edges": [{"first","second","why"}]}
+  `order` is the blessed global acquisition order: an edge the ledger
+  orders the other way is an error even before it closes a cycle
+  (another call path following the ledger would complete it).
+  `--write-ledger` regenerates `order` from a topological sort of the
+  current (cycle-free) graph.
+
+Usage:
+  lockgraph.py [paths...] [--json] [--graph] [--ledger PATH]
+               [--write-ledger]
+Exit code: 0 clean, 1 findings, 2 usage/cycle-on-write.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lint import LintFinding, iter_py_files  # noqa: E402
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_DEFAULT_TARGET = os.path.join(_REPO, "paddle_tpu")
+DEFAULT_LEDGER = os.path.join(_REPO, "tools", "lock_order.json")
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_EXEMPT_RE = re.compile(r"lock-order-exempt:\s*(\S.*)")
+_LOCK_ID_RE = re.compile(r"lock-id:\s*([\w.<>\-]+)")
+
+
+def _call_name(node: ast.Call) -> str:
+    try:
+        return ast.unparse(node.func)
+    except Exception:
+        return ""
+
+
+def _module_id(rel: str) -> str:
+    p = rel.replace(os.sep, "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.startswith("paddle_tpu/"):
+        p = p[len("paddle_tpu/"):]
+    if p.startswith(".."):  # fixture files outside the repo
+        p = os.path.basename(p)
+    return p.replace("/", ".")
+
+
+def _is_lock_factory(name: str) -> Optional[str]:
+    """'threading.Lock' / 'lockcheck.Condition' / bare 'RLock' →
+    the primitive kind, else None."""
+    parts = name.split(".")
+    kind = parts[-1]
+    if kind not in _LOCK_FACTORIES:
+        return None
+    recv = ".".join(parts[:-1])
+    if recv in ("threading", "") or "lockcheck" in recv or recv == "_lc":
+        return kind
+    return None
+
+
+class _FileInfo:
+    """Everything phase A collects from one parsed source file."""
+
+    def __init__(self, path: str, rel: str, src: str, tree: ast.AST):
+        self.path = path
+        self.rel = rel
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = tree
+        self.mod = _module_id(rel)
+        self.mod_aliases: Dict[str, str] = {}      # alias -> module id
+        self.sym_imports: Dict[str, Tuple[str, str]] = {}  # name -> (mod, n)
+        self.classes: Dict[str, List[str]] = {}    # cname -> base exprs
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def marker(self, lineno: int, regex) -> Optional[str]:
+        for ln in (lineno, lineno - 1):
+            m = regex.search(self.line(ln))
+            if m:
+                return m.group(1)
+        return None
+
+
+class _Analysis:
+    """The whole-corpus index and graph builder."""
+
+    def __init__(self):
+        self.files: List[_FileInfo] = []
+        # (mod, cname or None, attr) -> lock id (pre-aliasing)
+        self.lock_defs: Dict[Tuple[str, Optional[str], str], str] = {}
+        self.lock_sites: Dict[str, Tuple[str, int]] = {}  # id -> def site
+        self.aliases: Dict[str, str] = {}          # cond id -> lock id
+        # function table: (mod, qualname) -> (ast node, class ctx, file)
+        self.funcs: Dict[Tuple[str, str], Tuple[ast.AST, Optional[str],
+                                                _FileInfo]] = {}
+        # (mod, cname, attr) -> (mod2, cname2) for self.X = ClassName()
+        self.attr_types: Dict[Tuple[str, str, str], Tuple[str, str]] = {}
+        self.exempt_sites: Dict[Tuple[str, int], str] = {}  # site -> why
+        # per-function scan results
+        self.direct_acq: Dict[Tuple[str, str],
+                              Dict[str, Tuple[str, int]]] = {}
+        self.acq_events: Dict[Tuple[str, str], List[tuple]] = {}
+        self.call_events: Dict[Tuple[str, str], List[tuple]] = {}
+
+    # -- phase A: per-file definitions ---------------------------------
+
+    def add_file(self, path: str, rel: str, src: str):
+        tree = ast.parse(src, filename=path)
+        fi = _FileInfo(path, rel, src, tree)
+        self.files.append(fi)
+        self._collect_imports(fi)
+        self._collect_defs(fi)
+
+    def _collect_imports(self, fi: _FileInfo):
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.startswith("paddle_tpu."):
+                        fi.mod_aliases[a.asname or a.name.split(".")[-1]] \
+                            = a.name[len("paddle_tpu."):]
+            elif isinstance(node, ast.ImportFrom):
+                base = fi.mod.split(".")[:-1]
+                if node.level:
+                    # from ..x import y in module a.b: level 1 stays in
+                    # a/, each extra level climbs one package
+                    if node.level - 1 <= len(base):
+                        base = base[:len(base) - (node.level - 1)]
+                    else:
+                        continue
+                elif not (node.module or "").startswith("paddle_tpu"):
+                    continue  # absolute non-internal import
+                mod = node.module or ""
+                if mod.startswith("paddle_tpu"):
+                    mod = mod[len("paddle_tpu"):].lstrip(".")
+                    base = []
+                target = ".".join([p for p in base + mod.split(".") if p])
+                for a in node.names:
+                    local = a.asname or a.name
+                    # `from ..observability import metrics` imports a
+                    # MODULE; `from .errors import PSTimeoutError` a
+                    # symbol — disambiguated in phase B once every
+                    # module id is known (store both candidates)
+                    fi.mod_aliases.setdefault(
+                        local, f"{target}.{a.name}" if target else a.name)
+                    fi.sym_imports.setdefault(local, (target, a.name))
+
+    def _collect_defs(self, fi: _FileInfo):
+        mod = fi.mod
+        for node in fi.tree.body:
+            if isinstance(node, ast.ClassDef):
+                fi.classes[node.name] = [
+                    ast.unparse(b) if not isinstance(b, ast.Name) else b.id
+                    for b in node.bases]
+                self._collect_class_defs(fi, node)
+            elif isinstance(node, ast.Assign):
+                self._maybe_lock_def(fi, node, cname=None)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs[(mod, node.name)] = (node, None, fi)
+        # nested functions (thread bodies, closures): scanned for their
+        # own direct edges, not resolvable as callees
+        parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(fi.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            qual, cls_ctx, p = [node.name], None, parents.get(node)
+            while p is not None and not isinstance(p, ast.Module):
+                if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = [p.name, "<locals>"] + qual
+                elif isinstance(p, ast.ClassDef):
+                    if cls_ctx is None:
+                        cls_ctx = p.name
+                    qual = [p.name] + qual
+                p = parents.get(p)
+            key = (mod, ".".join(qual))
+            if key not in self.funcs:
+                self.funcs[key] = (node, cls_ctx, fi)
+
+    def _collect_class_defs(self, fi: _FileInfo, cls: ast.ClassDef):
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                self._maybe_lock_def(fi, node, cname=cls.name)
+                self._maybe_attr_type(fi, node, cls.name)
+            elif isinstance(node, ast.AnnAssign):
+                self._maybe_ann_attr_type(fi, node, cls.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs.setdefault(
+                    (fi.mod, f"{cls.name}.{node.name}"),
+                    (node, cls.name, fi))
+
+    def _maybe_lock_def(self, fi: _FileInfo, node: ast.Assign,
+                        cname: Optional[str]):
+        if not isinstance(node.value, ast.Call):
+            return
+        kind = _is_lock_factory(_call_name(node.value))
+        if kind is None:
+            return
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self" and cname:
+                key = (fi.mod, cname, t.attr)
+                lid = f"{fi.mod}.{cname}.{t.attr}"
+            elif isinstance(t, ast.Name):
+                key = (fi.mod, cname, t.id)
+                lid = (f"{fi.mod}.{cname}.{t.id}" if cname
+                       else f"{fi.mod}.{t.id}")
+            else:
+                continue
+            # an explicit lockcheck name= literal IS the id
+            for kw in node.value.keywords:
+                if kw.arg == "name" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    lid = kw.value.value
+            self.lock_defs[key] = lid
+            self.lock_sites.setdefault(lid, (fi.rel, node.lineno))
+            if kind == "Condition" and node.value.args:
+                arg = node.value.args[0]
+                if isinstance(arg, ast.Attribute) \
+                        and isinstance(arg.value, ast.Name) \
+                        and arg.value.id == "self" and cname:
+                    self.aliases[lid] = (fi.mod, cname, arg.attr)
+
+    def _maybe_attr_type(self, fi: _FileInfo, node: ast.Assign,
+                         cname: str):
+        if not isinstance(node.value, ast.Call):
+            return
+        name = _call_name(node.value)
+        target_cls = self._resolve_class_name(fi, name)
+        if target_cls is None:
+            return
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                self.attr_types[(fi.mod, cname, t.attr)] = target_cls
+
+    def _maybe_ann_attr_type(self, fi: _FileInfo, node: ast.AnnAssign,
+                             cname: str):
+        """`self._decode: Optional[DecodeEngine] = decode` — the
+        annotation types an attribute the VALUE cannot (a constructor
+        parameter, a late None). Every Name / string constant inside
+        the annotation is tried against the class index; first
+        resolvable wins."""
+        t = node.target
+        if not (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                and t.value.id == "self"):
+            return
+        key = (fi.mod, cname, t.attr)
+        for n in ast.walk(node.annotation):
+            cand = None
+            if isinstance(n, ast.Name):
+                cand = n.id
+            elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+                cand = n.value.split(".")[-1]  # forward-ref string
+            if not cand or cand in ("Optional", "None", "List", "Dict",
+                                    "Tuple", "Sequence", "Callable"):
+                continue
+            resolved = self._resolve_class_name(fi, cand)
+            if resolved is not None:
+                self.attr_types.setdefault(key, resolved)
+                return
+
+    def _resolve_class_name(self, fi: _FileInfo, name: str
+                            ) -> Optional[Tuple[str, str]]:
+        parts = name.split(".")
+        if len(parts) == 1:
+            if parts[0] in fi.classes:
+                return (fi.mod, parts[0])
+            if parts[0] in fi.sym_imports:
+                m2, n2 = fi.sym_imports[parts[0]]
+                return (m2, n2)  # verified against the index in phase C
+        elif len(parts) == 2 and parts[0] in fi.mod_aliases:
+            return (fi.mod_aliases[parts[0]], parts[1])
+        return None
+
+    # -- phase B: finalize identities ----------------------------------
+
+    def finalize(self):
+        module_ids = {f.mod for f in self.files}
+        for fi in self.files:
+            # an alias that names a real module is a module alias; one
+            # that doesn't falls back to its symbol-import reading
+            fi.mod_aliases = {a: m for a, m in fi.mod_aliases.items()
+                              if m in module_ids}
+        self._class_index = {}
+        for fi in self.files:
+            for cname, bases in fi.classes.items():
+                self._class_index[(fi.mod, cname)] = (bases, fi)
+
+    def _find_method(self, mod: str, cname: str, meth: str,
+                     depth: int = 0) -> Optional[Tuple[str, str]]:
+        if depth > 5:
+            return None
+        key = (mod, f"{cname}.{meth}")
+        if key in self.funcs:
+            return key
+        entry = self._class_index.get((mod, cname))
+        if entry is None:
+            return None
+        bases, fi = entry
+        for b in bases:
+            base_cls = self._resolve_class_name(fi, b)
+            if base_cls and base_cls in self._class_index:
+                found = self._find_method(base_cls[0], base_cls[1],
+                                          meth, depth + 1)
+                if found:
+                    return found
+        return None
+
+    # -- phase C: scan function bodies ---------------------------------
+
+    def scan_all(self):
+        for key, (node, cls_ctx, fi) in self.funcs.items():
+            self._scan_func(key, node, cls_ctx, fi)
+
+    def _scan_func(self, key, node, cls_ctx, fi: _FileInfo):
+        acqs: List[tuple] = []   # (lock_id, lineno, held tuple)
+        calls: List[tuple] = []  # (callee key, lineno, held tuple)
+        local_locks: Dict[str, str] = {}
+        soft_held: List[Tuple[str, int]] = []
+
+        def resolve_lock(expr) -> Optional[str]:
+            forced = fi.marker(expr.lineno, _LOCK_ID_RE)
+            if forced:
+                return None if forced in ("external", "none") else forced
+            lid = None
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self" and cls_ctx:
+                lid = self._lookup_attr_lock(fi.mod, cls_ctx, expr.attr)
+            elif isinstance(expr, ast.Name):
+                lid = local_locks.get(expr.id) \
+                    or self.lock_defs.get((fi.mod, None, expr.id))
+            if lid is None:
+                return None
+            return self._canon_id(lid)
+
+        def record_acq(lid: str, lineno: int, held):
+            acqs.append((lid, lineno, tuple(held)))
+
+        def visit(n, held):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                return  # separate scan / separate scope
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                new_held = list(held)
+                for item in n.items:
+                    visit(item.context_expr, tuple(new_held))
+                    lid = resolve_lock(item.context_expr)
+                    if lid:
+                        record_acq(lid, item.context_expr.lineno,
+                                   tuple(new_held) + tuple(soft_held))
+                        new_held.append((lid, item.context_expr.lineno))
+                for st in n.body:
+                    visit(st, tuple(new_held))
+                return
+            if isinstance(n, ast.Assign) \
+                    and isinstance(n.value, ast.Call) \
+                    and _is_lock_factory(_call_name(n.value)):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        local_locks[t.id] = \
+                            f"{fi.mod}.{key[1]}.{t.id}"
+                        self.lock_sites.setdefault(
+                            local_locks[t.id], (fi.rel, n.lineno))
+            if isinstance(n, ast.Call):
+                name = _call_name(n)
+                recv, _, attr = name.rpartition(".")
+                if attr in ("acquire", "release") and recv:
+                    lid = resolve_lock(n.func.value) \
+                        if isinstance(n.func, ast.Attribute) else None
+                    if lid:
+                        if attr == "acquire":
+                            record_acq(lid, n.lineno,
+                                       tuple(held) + tuple(soft_held))
+                            soft_held.append((lid, n.lineno))
+                        else:
+                            for i in range(len(soft_held) - 1, -1, -1):
+                                if soft_held[i][0] == lid:
+                                    del soft_held[i]
+                                    break
+                else:
+                    callee = self._resolve_call(fi, cls_ctx, name)
+                    if callee:
+                        calls.append((callee, n.lineno,
+                                      tuple(held) + tuple(soft_held)))
+            # soft-held (.acquire() spans) merges at EVENT points only;
+            # the recursion parameter carries just the lexical with-stack
+            for child in ast.iter_child_nodes(n):
+                visit(child, held)
+
+        for st in node.body:
+            visit(st, ())
+
+        for lid, lineno, held in acqs:
+            why = fi.marker(lineno, _EXEMPT_RE)
+            if why:
+                self.exempt_sites[(fi.rel, lineno)] = why
+        self.acq_events[key] = acqs
+        self.call_events[key] = calls
+        direct = {}
+        for lid, lineno, _held in acqs:
+            direct.setdefault(lid, (fi.rel, lineno))
+        self.direct_acq[key] = direct
+
+    def _lookup_attr_lock(self, mod, cname, attr,
+                          depth: int = 0) -> Optional[str]:
+        if depth > 5:
+            return None
+        lid = self.lock_defs.get((mod, cname, attr))
+        if lid:
+            return lid
+        entry = self._class_index.get((mod, cname))
+        if entry is None:
+            return None
+        bases, fi = entry
+        for b in bases:
+            base_cls = self._resolve_class_name(fi, b)
+            if base_cls:
+                lid = self._lookup_attr_lock(base_cls[0], base_cls[1],
+                                             attr, depth + 1)
+                if lid:
+                    return lid
+        return None
+
+    def _canon_id(self, lid: str) -> str:
+        seen = set()
+        while lid in self.aliases and lid not in seen:
+            seen.add(lid)
+            target_key = self.aliases[lid]
+            resolved = self.lock_defs.get(target_key)
+            if not resolved or resolved == lid:
+                break
+            lid = resolved
+        return lid
+
+    def _resolve_call(self, fi: _FileInfo, cls_ctx, name: str
+                      ) -> Optional[Tuple[str, str]]:
+        parts = name.split(".")
+        if parts[0] == "self" and cls_ctx:
+            if len(parts) == 2:
+                return self._find_method(fi.mod, cls_ctx, parts[1])
+            if len(parts) == 3:
+                t = self.attr_types.get((fi.mod, cls_ctx, parts[1]))
+                if t and t in self._class_index:
+                    return self._find_method(t[0], t[1], parts[2])
+            return None
+        if len(parts) == 1:
+            n = parts[0]
+            if (fi.mod, n) in self.funcs:
+                return (fi.mod, n)
+            if n in fi.classes:
+                return self._find_method(fi.mod, n, "__init__")
+            if n in fi.sym_imports:
+                m2, n2 = fi.sym_imports[n]
+                if (m2, n2) in self.funcs:
+                    return (m2, n2)
+                if (m2, n2) in self._class_index:
+                    return self._find_method(m2, n2, "__init__")
+            return None
+        if len(parts) == 2:
+            m2 = fi.mod_aliases.get(parts[0])
+            if m2:
+                if (m2, parts[1]) in self.funcs:
+                    return (m2, parts[1])
+                if (m2, parts[1]) in self._class_index:
+                    return self._find_method(m2, parts[1], "__init__")
+            # ClassName.method(...) in the same module
+            if parts[0] in fi.classes:
+                return self._find_method(fi.mod, parts[0], parts[1])
+        return None
+
+    # -- phase D: transitive closure + edges ---------------------------
+
+    def build_edges(self) -> Dict[Tuple[str, str], List[dict]]:
+        trans = {k: dict(v) for k, v in self.direct_acq.items()}
+        changed = True
+        while changed:
+            changed = False
+            for k, calls in self.call_events.items():
+                mine = trans[k]
+                for callee, _ln, _held in calls:
+                    for lid, site in trans.get(callee, {}).items():
+                        if lid not in mine:
+                            mine[lid] = site
+                            changed = True
+        edges: Dict[Tuple[str, str], List[dict]] = {}
+
+        def add(a, a_site, b, b_site, via):
+            if a == b:
+                return
+            if a_site in self.exempt_sites or b_site in self.exempt_sites:
+                return
+            edges.setdefault((a, b), []).append(
+                {"from": a_site, "to": b_site, "via": via})
+
+        for key, acqs in self.acq_events.items():
+            fi = self.funcs[key][2]
+            for lid, lineno, held in acqs:
+                for h, h_ln in held:
+                    add(h, (fi.rel, h_ln), lid, (fi.rel, lineno),
+                        "nested with")
+            for callee, lineno, held in self.call_events[key]:
+                if (fi.rel, lineno) in self.exempt_sites:
+                    continue
+                for b, b_site in trans.get(callee, {}).items():
+                    for h, h_ln in held:
+                        add(h, (fi.rel, h_ln), b, b_site,
+                            f"call {callee[0]}.{callee[1]} "
+                            f"({fi.rel}:{lineno})")
+        return edges
+
+
+# ---------------------------------------------------------------------------
+# the ledger
+# ---------------------------------------------------------------------------
+
+
+def load_ledger(path: Optional[str]) -> dict:
+    if not path:
+        return {"order": [], "exempt_edges": []}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return {"order": [], "exempt_edges": []}
+    return {"order": list(data.get("order", [])),
+            "exempt_edges": list(data.get("exempt_edges", []))}
+
+
+def _site_str(site: Tuple[str, int]) -> str:
+    return f"{site[0]}:{site[1]}"
+
+
+# ---------------------------------------------------------------------------
+# cycles
+# ---------------------------------------------------------------------------
+
+
+def _find_cycles(edges: Dict[Tuple[str, str], List[dict]]
+                 ) -> List[List[str]]:
+    """Every elementary cycle's node list (Tarjan SCCs, then one DFS
+    cycle per non-trivial SCC — enough to make the report actionable
+    without enumerating the exponential set)."""
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Dict[str, bool] = {}
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v):
+        # iterative Tarjan (deep graphs must not hit the recursion cap)
+        work = [(v, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack[node] = True
+            advanced = False
+            for i in range(pi, len(adj[node])):
+                w = adj[node][i]
+                if w not in index:
+                    work[-1] = (node, i + 1)
+                    work.append((w, 0))
+                    advanced = True
+                    break
+                if on_stack.get(w):
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+
+    for v in adj:
+        if v not in index:
+            strongconnect(v)
+
+    cycles = []
+    for comp in sccs:
+        if len(comp) == 1:
+            if comp[0] in adj and comp[0] in adj.get(comp[0], []):
+                cycles.append([comp[0]])
+            continue
+        comp_set = set(comp)
+        start = sorted(comp)[0]
+        # DFS WITH BACKTRACKING for one elementary cycle through
+        # `start` (a greedy walk can dead-end on a branch whose
+        # successors are all already on the path — e.g. A->B->C with
+        # C->B only — and an SCC guarantees a cycle exists, so
+        # backtrack instead of crashing)
+        path, on_path = [start], {start}
+        iters = [iter(sorted(w for w in adj[start] if w in comp_set))]
+        found = None
+        while iters and found is None:
+            try:
+                w = next(iters[-1])
+            except StopIteration:
+                iters.pop()
+                on_path.discard(path.pop())
+                continue
+            if w == start:
+                found = list(path)
+            elif w not in on_path:
+                path.append(w)
+                on_path.add(w)
+                iters.append(iter(sorted(x for x in adj[w]
+                                         if x in comp_set)))
+        cycles.append(found if found else sorted(comp))
+    return cycles
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def build(paths: Optional[Sequence[str]] = None) -> _Analysis:
+    paths = list(paths) if paths else [_DEFAULT_TARGET]
+    an = _Analysis()
+    skipped = []
+    for path in iter_py_files(paths):
+        rel = os.path.relpath(path, _REPO)
+        try:
+            with open(path) as fh:
+                an.add_file(path, rel, fh.read())
+        except (OSError, SyntaxError) as e:
+            skipped.append((rel, e))
+    an.finalize()
+    an.scan_all()
+    an.skipped = skipped
+    return an
+
+
+def analyze(paths: Optional[Sequence[str]] = None,
+            ledger_path: Optional[str] = DEFAULT_LEDGER
+            ) -> List[LintFinding]:
+    """Run the analysis; findings are lock-order cycles (errors), edges
+    contradicting the ledger's blessed order, and files that failed to
+    parse. Empty list == provably consistent ordering (up to the
+    documented resolution limits)."""
+    an = build(paths)
+    ledger = load_ledger(ledger_path)
+    edges = an.build_edges()
+    exempt_pairs = {(e.get("first"), e.get("second"))
+                    for e in ledger["exempt_edges"]}
+    edges = {pair: occ for pair, occ in edges.items()
+             if pair not in exempt_pairs}
+
+    findings: List[LintFinding] = []
+    for rel, e in an.skipped:
+        findings.append(LintFinding(
+            rel, getattr(e, "lineno", 0) or 0, "lock-parse",
+            f"could not analyze: {type(e).__name__}: {e}"))
+
+    for cyc in _find_cycles(edges):
+        hops = []
+        anchor = None
+        for i, a in enumerate(cyc):
+            b = cyc[(i + 1) % len(cyc)]
+            occ = edges.get((a, b), [{}])[0]
+            f_site = occ.get("from", ("?", 0))
+            t_site = occ.get("to", ("?", 0))
+            if anchor is None:
+                anchor = t_site
+            hops.append(f"{a} (held at {_site_str(f_site)}) -> {b} "
+                        f"(acquired at {_site_str(t_site)}, "
+                        f"via {occ.get('via', '?')})")
+        findings.append(LintFinding(
+            anchor[0], anchor[1], "lock-cycle",
+            "potential deadlock: lock-order cycle "
+            + " ; ".join(hops)
+            + " — fix the acquisition order, or exempt one edge in "
+              "tools/lock_order.json / '# lock-order-exempt: <why>'"))
+
+    order_idx = {lid: i for i, lid in enumerate(ledger["order"])}
+    for (a, b), occ in sorted(edges.items()):
+        ia, ib = order_idx.get(a), order_idx.get(b)
+        if ia is None or ib is None or ia < ib:
+            continue
+        site = occ[0]["to"]
+        findings.append(LintFinding(
+            site[0], site[1], "lock-ledger",
+            f"acquisition order {a} -> {b} contradicts the blessed "
+            f"ledger order (lock_order.json says {b} before {a}; "
+            f"first seen held at {_site_str(occ[0]['from'])}, acquired "
+            f"at {_site_str(site)} via {occ[0]['via']})"))
+    findings.sort(key=lambda x: (x.path, x.lineno, x.pass_name))
+    return findings
+
+
+def write_ledger(paths: Optional[Sequence[str]] = None,
+                 ledger_path: str = DEFAULT_LEDGER) -> dict:
+    """Regenerate `order` from a topological sort of the current graph
+    (preserving exempt_edges). Raises on a cyclic graph — fix or
+    exempt the cycles first, the ledger blesses only a real order."""
+    an = build(paths)
+    ledger = load_ledger(ledger_path)
+    edges = an.build_edges()
+    exempt_pairs = {(e.get("first"), e.get("second"))
+                    for e in ledger["exempt_edges"]}
+    edges = {p: o for p, o in edges.items() if p not in exempt_pairs}
+    if _find_cycles(edges):
+        raise RuntimeError("graph has cycles; run `lockgraph.py` and "
+                           "fix/exempt them before --write-ledger")
+    nodes = sorted({n for pair in edges for n in pair})
+    indeg = {n: 0 for n in nodes}
+    for _a, b in edges:
+        indeg[b] += 1
+    order: List[str] = []
+    ready = sorted(n for n, d in indeg.items() if d == 0)
+    while ready:
+        n = ready.pop(0)
+        order.append(n)
+        for (a, b) in edges:
+            if a == n:
+                indeg[b] -= 1
+                if indeg[b] == 0 and b not in order and b not in ready:
+                    ready.append(b)
+        ready.sort()
+    ledger["order"] = order
+    ledger["_comment"] = (
+        "Blessed global lock-acquisition order, generated by "
+        "`tools/lockgraph.py --write-ledger` from the observed "
+        "held->acquired graph. Locks must be taken in list order; the "
+        "runtime sanitizer (PADDLE_TPU_LOCKCHECK) counts any observed "
+        "contradiction as an inversion. exempt_edges suppress "
+        "individually-justified edges from both prongs.")
+    tmp = ledger_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"_comment": ledger["_comment"],
+                   "order": ledger["order"],
+                   "exempt_edges": ledger["exempt_edges"]}, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, ledger_path)
+    return ledger
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="lockgraph", description=__doc__)
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to analyze (default: paddle_tpu/)")
+    ap.add_argument("--ledger", default=DEFAULT_LEDGER,
+                    help="lock_order.json path")
+    ap.add_argument("--json", action="store_true",
+                    help="findings as JSON lines")
+    ap.add_argument("--graph", action="store_true",
+                    help="dump every held->acquired edge and exit")
+    ap.add_argument("--write-ledger", action="store_true",
+                    help="regenerate the ledger's blessed order from "
+                         "the (cycle-free) graph")
+    args = ap.parse_args(argv)
+
+    if args.graph:
+        an = build(args.paths or None)
+        for (a, b), occ in sorted(an.build_edges().items()):
+            o = occ[0]
+            print(f"{a} -> {b}   [{_site_str(o['from'])} -> "
+                  f"{_site_str(o['to'])}; {o['via']}; "
+                  f"x{len(occ)} site(s)]")
+        return 0
+    if args.write_ledger:
+        try:
+            ledger = write_ledger(args.paths or None, args.ledger)
+        except RuntimeError as e:
+            print(f"lockgraph: {e}", file=sys.stderr)
+            return 2
+        print(f"wrote {args.ledger} ({len(ledger['order'])} locks)")
+        return 0
+
+    findings = analyze(args.paths or None, args.ledger)
+    for f in findings:
+        print(json.dumps(f.to_dict()) if args.json else str(f))
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

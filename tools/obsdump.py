@@ -25,6 +25,11 @@ Usage:
   obsdump.py analysis METRICS.json          # static-analysis findings
                                             # per pass/severity + walk
                                             # counts (--live, --json)
+  obsdump.py locks METRICS.json             # lock held-seconds/
+                                            # contention tables +
+                                            # observed order inversions
+                                            # (PADDLE_TPU_LOCKCHECK;
+                                            # --live, --json)
 
 Mixed-precision runs: `snapshot` surfaces the dynamic loss-scaling
 counters (paddle_tpu_amp_total{event=overflow|growth|skip}, the
@@ -325,6 +330,76 @@ def cmd_analysis(args) -> int:
     return 0
 
 
+def cmd_locks(args) -> int:
+    """Concurrency-sanitizer story from a metrics snapshot
+    (PADDLE_TPU_LOCKCHECK, ANALYSIS.md §Concurrency): per-site
+    held-seconds and contention table, plus the observed lock-order
+    inversions against the tools/lock_order.json ledger."""
+    snap = _load_snap(args)
+    if snap is None:
+        print("locks: need a metrics.json path or --live",
+              file=sys.stderr)
+        return 2
+
+    held = {}  # site -> {count, sum}
+    for s in (snap.get("paddle_tpu_lock_held_seconds") or {}) \
+            .get("series", []):
+        site = s.get("labels", {}).get("site", "?")
+        held[site] = {"count": int(s.get("count", 0)),
+                      "sum": float(s.get("sum", 0.0))}
+    contention = {}
+    for s in (snap.get("paddle_tpu_lock_contention_total") or {}) \
+            .get("series", []):
+        site = s.get("labels", {}).get("site", "?")
+        contention[site] = contention.get(site, 0) + int(s["value"])
+    inversions = []
+    for s in (snap.get("paddle_tpu_lock_inversions_total") or {}) \
+            .get("series", []):
+        labels = s.get("labels", {})
+        inversions.append({"first": labels.get("first", "?"),
+                           "second": labels.get("second", "?"),
+                           "count": int(s["value"])})
+    deadlocks = sum(
+        int(s["value"]) for s in
+        (snap.get("paddle_tpu_lock_deadlocks_total") or {})
+        .get("series", []))
+
+    sites = sorted(set(held) | set(contention))
+    if not sites and not inversions and not deadlocks:
+        print("no lock_* samples in this snapshot (is "
+              "PADDLE_TPU_LOCKCHECK set to 1 or 2?)")
+        return 0
+    rows = []
+    for site in sites:
+        h = held.get(site, {"count": 0, "sum": 0.0})
+        rows.append({
+            "site": site,
+            "acquires": h["count"],
+            "held_s": round(h["sum"], 4),
+            "avg_ms": round(1000.0 * h["sum"] / h["count"], 3)
+            if h["count"] else 0.0,
+            "contention": contention.get(site, 0),
+        })
+    out = {"locks": rows, "inversions": inversions,
+           "deadlocks": deadlocks}
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    if rows:
+        _print_aligned(rows, ("site", "acquires", "held_s", "avg_ms",
+                              "contention"))
+    print(f"\ndeadlocks detected: {deadlocks}")
+    if inversions:
+        print("observed inversions (held -> acquired, against "
+              "lock_order.json):")
+        for inv in inversions:
+            print(f"  {inv['first']} -> {inv['second']}  "
+                  f"x{inv['count']}")
+    else:
+        print("observed inversions: none")
+    return 0
+
+
 def cmd_ps(args) -> int:
     """Parameter-server resilience story from a metrics snapshot
     (RESILIENCE.md §Parameter-server fault tolerance): RPC outcomes per
@@ -570,6 +645,18 @@ def main(argv=None) -> int:
     anp.add_argument("--json", action="store_true",
                      help="JSON instead of the aligned table")
     anp.set_defaults(fn=cmd_analysis)
+
+    lkp = sub.add_parser("locks", help="lock held-seconds/contention "
+                         "tables + observed lock-order inversions from "
+                         "a metrics snapshot (PADDLE_TPU_LOCKCHECK)")
+    lkp.add_argument("path", nargs="?", help="metrics.json from "
+                     "PADDLE_TPU_METRICS_DIR (omit with --live)")
+    lkp.add_argument("--live", action="store_true",
+                     help="read this process's registry instead of a "
+                     "file")
+    lkp.add_argument("--json", action="store_true",
+                     help="JSON instead of the aligned tables")
+    lkp.set_defaults(fn=cmd_locks)
 
     pp = sub.add_parser("ps", help="parameter-server resilience summary "
                         "(RPC outcomes, breakers, reconnects, drops) "
