@@ -49,12 +49,17 @@ __all__ = ["emit", "recent", "clear", "log_path", "read_jsonl",
 # (RESILIENCE.md §Parameter-server fault tolerance): breaker
 # transitions, reconnects, snapshot restores at server boot, supervisor
 # respawns, and counted gradient drops.
+# fleet is the serving fleet tier's story (SERVING.md §Fleet): member
+# joins/leaves, health ejections/readmissions, retry failovers, breaker
+# transitions, autoscale decisions, replica respawns; serve_drain marks
+# a replica's graceful scale-in drain.
 KINDS = ("compile", "compile_cache", "step_summary", "anomaly",
-         "checkpoint", "serve_start", "serve_stop", "restore", "preempt",
+         "checkpoint", "serve_start", "serve_stop", "serve_drain",
+         "restore", "preempt",
          "fault", "recovery", "rank_restart", "pipeline_stall",
          "warmstart", "amp_overflow", "quantize", "analysis",
          "rendezvous", "resize", "restore_resharded", "ps_failover",
-         "decode")
+         "decode", "fleet")
 
 # Ring bound: a week-long run emitting a compile+summary event per minute
 # stays far under this; anomaly storms get truncated to the latest window.

@@ -22,8 +22,17 @@ deployment surface in front of it:
                  streaming token handles, warmstart phase-grid bake
                  (SERVING.md §Continuous batching).
 - httpd.py     — JSON-over-HTTP frontend (POST /v1/predict, chunked
-                 POST /v1/generate token streaming, GET /v1/status)
-                 on the shared observability HTTP base.
+                 POST /v1/generate token streaming, GET /v1/status,
+                 the /v1/load probe + stateful /v1/healthz) on the
+                 shared observability HTTP base.
+- router.py    — fleet front tier (SERVING.md §Fleet): power-of-two-
+                 choices load balancing over N replicas, health
+                 ejection, per-endpoint circuit breakers, idempotent
+                 retry failover, rendezvous-backed elastic membership.
+- replica.py   — one fleet replica process (warmstart boot, rendezvous
+                 heartbeat, SIGTERM → leave/drain/stop).
+- autoscale.py — queue-depth/p99 control loop moving the replica count
+                 within min/max bounds with hysteresis.
 
 Telemetry flows through the PR 1/2 observability stack: queue depth,
 batch-size/queue-wait/end-to-end histograms, reject/timeout counters,
@@ -39,6 +48,11 @@ from .engine import Engine, ServingConfig  # noqa: F401
 from .kv_cache import BlockAllocator, KVCacheConfig, NoBlocksError  # noqa: F401
 from .decode import DecodeConfig, DecodeEngine, DecodeHandle  # noqa: F401
 from .httpd import Server  # noqa: F401
+from .router import (  # noqa: F401
+    FleetError, FleetTimeout, NoReplicasError, ReplicaRejected, Router,
+    RouterServer, StreamBrokenError,
+)
+from .autoscale import Autoscaler  # noqa: F401
 
 __all__ = [
     "BucketPolicy", "common_batch",
@@ -47,4 +61,7 @@ __all__ = [
     "Engine", "ServingConfig", "Server",
     "BlockAllocator", "KVCacheConfig", "NoBlocksError",
     "DecodeConfig", "DecodeEngine", "DecodeHandle",
+    "Router", "RouterServer", "Autoscaler",
+    "FleetError", "NoReplicasError", "ReplicaRejected", "FleetTimeout",
+    "StreamBrokenError",
 ]

@@ -128,6 +128,7 @@ class Batcher:
         self._cv = _lockcheck.Condition(
             name="serving.batcher.Batcher._cv")
         self._pending: List[_Request] = []
+        self._inflight = 0  # requests inside a dispatched batch right now
         self._closed = False
         # per-instance outcome counts (the REQUESTS metric is process-
         # global: concurrent servers would cross-contaminate each
@@ -142,6 +143,17 @@ class Batcher:
     def depth(self) -> int:
         with self._cv:
             return len(self._pending)
+
+    def inflight(self) -> int:
+        """Requests currently inside a dispatched (executing) batch —
+        together with depth() this is the router's load score
+        (SERVING.md §Fleet): queued work plus work on the accelerator."""
+        with self._cv:
+            return self._inflight
+
+    def draining(self) -> bool:
+        with self._cv:
+            return self._closed
 
     def outcome_counts(self) -> Dict[str, int]:
         with self._cv:
@@ -240,6 +252,10 @@ class Batcher:
                 else:
                     rest.append(r)
             self._pending = rest
+            # claimed requests count as in-flight from the moment they
+            # leave the queue until their batch resolves — the load
+            # probe must not report an idle replica mid-dispatch
+            self._inflight = len(batch)
             QUEUE_DEPTH.set(len(self._pending))
         return batch
 
@@ -283,9 +299,14 @@ class Batcher:
         while True:
             batch = self._collect()
             if batch:
-                self._dispatch(batch)
+                try:
+                    self._dispatch(batch)
+                finally:
+                    with self._cv:
+                        self._inflight = 0
                 continue
             with self._cv:
+                self._inflight = 0
                 if self._closed and not self._pending:
                     return
 

@@ -306,6 +306,7 @@ class DecodeEngine:
         self._waiting: "collections.deque[_Request]" = collections.deque()
         self._active: List[_Request] = []
         self._closed = False
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
         self._rid = 0
         self._last_slot_config: Optional[int] = None
@@ -583,6 +584,10 @@ class DecodeEngine:
             if self._closed:
                 self._count("rejected")
                 raise ServerClosed("decode engine is stopped")
+            if self._draining:
+                self._count("rejected")
+                raise ServerClosed(
+                    "decode engine is draining; request rejected")
             if len(self._waiting) >= self.config.max_queue:
                 self._count("rejected")
                 raise QueueFullError(
@@ -605,6 +610,30 @@ class DecodeEngine:
         with self._cv:
             handle._req.cancelled = True
             self._cv.notify_all()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful drain (the fleet's scale-in path, SERVING.md
+        §Fleet): stop admitting — new submits raise ServerClosed/503 —
+        but let every waiting and active generation run to completion.
+        Returns True when the engine emptied within `timeout_s` (False:
+        caller decides whether to stop() anyway, cancelling the rest).
+        Idempotent; a later start of new traffic requires a new engine.
+        """
+        deadline = time.monotonic() + float(timeout_s)
+        with self._cv:
+            if not self._draining:
+                self._draining = True
+                _events.emit("decode", action="drain",
+                             waiting=len(self._waiting),
+                             active=len(self._active))
+        while time.monotonic() < deadline:
+            with self._cv:
+                if self._closed or (not self._waiting
+                                    and not self._active):
+                    return True
+            time.sleep(0.01)
+        with self._cv:
+            return not self._waiting and not self._active
 
     def stop(self):
         """Stop the scheduler: waiting and active requests are
@@ -629,13 +658,22 @@ class DecodeEngine:
             t.join(timeout=30.0)
         _events.emit("decode", action="stop")
 
+    def load(self) -> Tuple[int, int]:
+        """(queued, active) — the cheap pair the /v1/load probe folds
+        into its scalar load score without building the full status
+        document."""
+        with self._cv:
+            return len(self._waiting), len(self._active)
+
     def status(self) -> Dict:
         with self._cv:
             waiting = len(self._waiting)
             active = len(self._active)
             live_tokens = sum(r.pos for r in self._active)
             counts = dict(self._counts)
+            draining = self._draining
         return {
+            "draining": draining,
             "phase_grid": {
                 "prefill_buckets": list(self.prefill_buckets),
                 "decode_slots": list(self.decode_slots)},

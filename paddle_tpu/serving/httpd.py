@@ -25,9 +25,20 @@ Routes (schema documented in SERVING.md §HTTP API):
   GET  /v1/status    queue depth, buckets, request/batch counters,
                      decode queue/slot-occupancy/TTFT block, uptime —
                      the operator's one-look view
-  GET  /v1/healthz   liveness: 200 once started (the process-wide
-                     anomaly-aware probe stays on the observability
-                     server, PADDLE_TPU_METRICS_PORT)
+  GET  /v1/load      the router's cheap load probe (SERVING.md §Fleet):
+                     {"load": scalar, "inflight": n, "queue_depth": q,
+                     "state": ...} touching only the batcher/decode
+                     counters — power-of-two-choices picks must not pay
+                     a full status() walk per poll
+  GET  /v1/healthz   readiness, with a real serving-state signal for
+                     the fleet router's health ejection: 200 only while
+                     state == "serving"; 503 with {"state": "warming"}
+                     before every bucket/phase is warmed, {"state":
+                     "draining"} after drain() began (scale-in), and
+                     {"state": "stopped"} once the decode engine or
+                     batcher is gone. (The process-wide anomaly-aware
+                     probe stays on the observability server,
+                     PADDLE_TPU_METRICS_PORT.)
 
 Built on `observability.httpbase` — same silent logging, locked
 idempotent start/stop, daemon threading, and atexit discipline as the
@@ -65,25 +76,32 @@ class _ServingHandler(_base.QuietHandler):
     protocol_version = "HTTP/1.1"
     serving: "Server" = None  # bound per-Server via a subclass
 
-    def _json_reply(self, code: int, payload: Dict):
+    def _json_reply(self, code: int, payload: Dict, headers=None):
         # strict-JSON discipline (same as metrics.dump): a model output
         # containing NaN/Inf must not make json.dumps emit bare NaN
         # tokens that RFC-8259 clients reject — non-finite floats become
         # strings ("nan"/"inf"/"-inf"), documented in SERVING.md
         self._reply(code, "application/json",
-                    json.dumps(_json_safe(payload)) + "\n")
+                    json.dumps(_json_safe(payload)) + "\n",
+                    extra_headers=headers)
 
     def do_GET(self):  # noqa: N802 - stdlib naming
         try:
             path = urlparse(self.path).path
             if path == "/v1/status":
                 self._json_reply(200, self.serving.status())
+            elif path == "/v1/load":
+                self._json_reply(200, self.serving.load())
             elif path == "/v1/healthz":
-                self._json_reply(200, {"status": "ok"})
+                state = self.serving.state()
+                self._json_reply(
+                    200 if state == "serving" else 503,
+                    {"status": "ok" if state == "serving"
+                     else "unavailable", "state": state})
             else:
                 self._reply(404, "text/plain",
                             "not found; routes: POST /v1/predict, "
-                            "GET /v1/status /v1/healthz\n")
+                            "GET /v1/status /v1/load /v1/healthz\n")
         except _base.CLIENT_GONE:
             pass
 
@@ -114,7 +132,8 @@ class _ServingHandler(_base.QuietHandler):
         try:
             handle = decode.submit(ids, max_new_tokens=int(max_new))
         except (QueueFullError, ServerClosed) as e:
-            self._json_reply(503, {"error": str(e)})
+            self._json_reply(503, {"error": str(e)},
+                             headers=self.serving._retry_after())
             return
         except (ValueError, TypeError) as e:
             self._json_reply(400, {"error": str(e)})
@@ -211,7 +230,11 @@ class _ServingHandler(_base.QuietHandler):
             try:
                 outs = self.serving.submit(arrays, timeout_s=timeout)
             except (QueueFullError, ServerClosed) as e:
-                self._json_reply(503, {"error": str(e)})
+                # draining replicas add Retry-After so the fleet router
+                # (and any well-behaved client) re-sends elsewhere NOW
+                # and re-polls this replica after the drain window
+                self._json_reply(503, {"error": str(e)},
+                                 headers=self.serving._retry_after())
                 return
             except RequestTimeout as e:
                 self._json_reply(504, {"error": str(e)})
@@ -271,6 +294,7 @@ class Server:
 
         self._lock = _lockcheck.Lock("serving.httpd.Server._lock")
         self._started_t: Optional[float] = None
+        self._draining = False
 
     # -- lifecycle -----------------------------------------------------
 
@@ -280,6 +304,7 @@ class Server:
         with self._lock:
             if self._started_t is not None:
                 return self._http.port()
+            self._draining = False
             # thread-spawn ordering is the leak discipline: everything
             # that can FAIL (warmups, the bind) happens before anything
             # that starts a thread, except the batcher — whose
@@ -323,6 +348,84 @@ class Server:
                          max_queue=self.config.max_queue,
                          max_wait_ms=self.config.max_wait_ms)
             return bound
+
+    def drain(self, timeout: float = 30.0):
+        """Graceful drain, the fleet's scale-in half-step (SERVING.md
+        §Fleet): the listener STAYS UP — so the router's health probe
+        sees state "draining" (503) and in-flight streams finish — but
+        new work is rejected with 503 + Retry-After, and this call
+        blocks until pending predict batches and decode generations
+        completed (or `timeout` passed). Call stop() afterwards to tear
+        the listener down. Idempotent."""
+        with self._lock:
+            if self._draining or self._started_t is None:
+                already = True
+            else:
+                self._draining = True
+                already = False
+            batcher, decode = self._batcher, self._decode
+        if not already:
+            _events.emit("serve_drain",
+                         queue_depth=batcher.depth() if batcher else 0)
+        # ONE deadline across both engines: `timeout` bounds the whole
+        # drain, not each stage (a supervisor sizing its SIGKILL grace
+        # against drain_timeout_s must not be off by 2x)
+        deadline = time.monotonic() + float(timeout)
+        if batcher is not None:
+            # stop() is the drain: no new admissions, pending batches
+            # finish, the thread joins
+            batcher.stop(timeout=timeout)
+        if decode is not None:
+            decode.drain(timeout_s=max(0.0,
+                                       deadline - time.monotonic()))
+
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
+    def _retry_after(self) -> Optional[Dict[str, str]]:
+        """Retry-After header for 503 replies while draining (predicts
+        rejected mid-drain should be re-sent to another replica now and
+        back here only after the drain completes)."""
+        return {"Retry-After": "1"} if self.draining() else None
+
+    def state(self) -> str:
+        """One-word serving state for the health probe: "warming" until
+        every bucket/phase is warm, "serving" while traffic flows,
+        "draining" after drain() began, "stopped" before start / after
+        stop / when the decode engine was stopped underneath us."""
+        with self._lock:
+            if self._started_t is None:
+                return "stopped"
+            if self._draining:
+                return "draining"
+            batcher, decode = self._batcher, self._decode
+        if decode is not None and decode._closed:
+            return "stopped"
+        if batcher is not None and batcher.draining():
+            return "draining"
+        if self._engine is not None and not self._engine.warmed \
+                and self.config.warmup:
+            return "warming"
+        if decode is not None and not decode.warmed \
+                and self.config.warmup:
+            return "warming"
+        return "serving"
+
+    def load(self) -> Dict:
+        """The cheap load probe behind GET /v1/load: queue depth +
+        in-flight work as one scalar, touching only counters (no bucket
+        table, no KV stats — the router polls this per replica per
+        interval)."""
+        batcher, decode = self._batcher, self._decode
+        depth = batcher.depth() if batcher is not None else 0
+        inflight = batcher.inflight() if batcher is not None else 0
+        if decode is not None:
+            d_wait, d_active = decode.load()
+            depth += d_wait
+            inflight += d_active
+        return {"load": float(depth + inflight), "inflight": inflight,
+                "queue_depth": depth, "state": self.state()}
 
     def stop(self):
         """Stop accepting (listener down first), drain the batcher so
@@ -377,9 +480,13 @@ class Server:
         up = None if self._started_t is None \
             else round(time.monotonic() - self._started_t, 3)
         batcher = self._batcher
+        probe = self.load()
         st = {
             "uptime_s": up,
             "port": self._http.port(),
+            "state": probe["state"],
+            "load": probe["load"],
+            "inflight": probe["inflight"],
             "queue_depth": batcher.depth() if batcher else 0,
             "max_queue": self.config.max_queue,
             "max_wait_ms": self.config.max_wait_ms,

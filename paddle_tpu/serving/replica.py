@@ -1,0 +1,126 @@
+"""One fleet replica process: `python -m paddle_tpu.serving.replica`.
+
+The unit the ReplicaSupervisor (distributed/launch_serve.py) spawns and
+the Router (serving/router.py) discovers: boots a `serving.Server` —
+from a PR 6 warmstart artifact when one is given, so a scale-out
+replica is serving in seconds instead of paying an XLA warmup —
+registers its endpoint as a PR 9 `FileRendezvous` member (worker_id IS
+the "host:port" endpoint; the heartbeat thread keeps it live), and
+serves until SIGTERM, which triggers the graceful scale-in sequence:
+
+  1. leave the rendezvous (the router's next poll stops picking us),
+  2. drain (listener stays up: in-flight work finishes, stragglers get
+     503 + Retry-After and fail over through the router),
+  3. stop, exit 0 (rc 0 tells the supervisor the exit was deliberate —
+     anything else is a crash and respawns the slot).
+
+Serving membership needs no generations/barrier — replicas never form a
+collective — so this module uses only register/heartbeat/leave from the
+rendezvous protocol; the router reads `live_members()`.
+
+Stdout speaks one JSON "ready" line once serving (the supervisor and
+benches wait on it): {"ready": true, "endpoint": ..., "pid": ...,
+"warmstart_adopted": n, "slot": k}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+
+def _build_args(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.serving.replica", description=__doc__)
+    ap.add_argument("--model-dir", required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 binds an ephemeral port (printed in the "
+                    "ready line and registered in the rendezvous)")
+    ap.add_argument("--rdzv-dir", default="",
+                    help="fleet membership store (PADDLE_TPU_RDZV_DIR "
+                    "fallback); empty = standalone replica")
+    ap.add_argument("--warmstart", default="",
+                    help="PR 6 warmstart artifact: boot without paying "
+                    "XLA compiles")
+    ap.add_argument("--slot", type=int, default=-1,
+                    help="supervisor slot id (informational)")
+    ap.add_argument("--buckets", default="",
+                    help="comma batch buckets (default: policy pow2)")
+    ap.add_argument("--max-batch", type=int, default=64)
+    ap.add_argument("--max-queue", type=int, default=128)
+    ap.add_argument("--max-wait-ms", type=float, default=5.0)
+    ap.add_argument("--timeout-s", type=float, default=30.0)
+    ap.add_argument("--precision", default="f32")
+    ap.add_argument("--drain-timeout-s", type=float, default=30.0)
+    ap.add_argument("--heartbeat-s", type=float, default=0.5)
+    ap.add_argument("--cpu", action="store_true",
+                    help="pin JAX_PLATFORMS=cpu before jax loads "
+                    "(fleet simulation / tests)")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = _build_args(argv)
+    if args.cpu:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    from .engine import ServingConfig
+    from .httpd import Server
+
+    buckets = tuple(int(b) for b in args.buckets.split(",")) \
+        if args.buckets else None
+    cfg = ServingConfig(
+        args.model_dir, buckets=buckets, max_batch=args.max_batch,
+        max_queue=args.max_queue, max_wait_ms=args.max_wait_ms,
+        timeout_s=args.timeout_s, precision=args.precision,
+        warmstart=args.warmstart or None, use_tpu=not args.cpu,
+        host=args.host)
+    server = Server(cfg)
+    port = server.start(args.port)
+    endpoint = f"{args.host}:{port}"
+
+    rdzv = None
+    rdzv_dir = args.rdzv_dir or os.environ.get("PADDLE_TPU_RDZV_DIR", "")
+    if rdzv_dir:
+        from ..distributed.rendezvous import FileRendezvous
+
+        rdzv = FileRendezvous(rdzv_dir, worker_id=endpoint,
+                              min_workers=1,
+                              heartbeat_s=args.heartbeat_s,
+                              dead_after_s=max(2.5,
+                                               5 * args.heartbeat_s))
+        rdzv.register()
+        rdzv.start_heartbeat()
+
+    stop_ev = threading.Event()
+
+    def _on_term(signum, frame):
+        stop_ev.set()
+
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+
+    print(json.dumps({
+        "ready": True, "endpoint": endpoint, "pid": os.getpid(),
+        "slot": args.slot,
+        "warmstart_adopted":
+            server._engine.warmstart_adopted
+            if server._engine is not None else 0}), flush=True)
+
+    stop_ev.wait()
+    # graceful scale-in: stop being routable FIRST, then finish the
+    # in-flight work, then tear down (SERVING.md §Fleet drain contract)
+    if rdzv is not None:
+        rdzv.leave()
+    server.drain(timeout=args.drain_timeout_s)
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

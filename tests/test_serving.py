@@ -747,6 +747,138 @@ def test_warmstart_tool_bake_inspect(tmp_path, rng):
 
 
 # ---------------------------------------------------------------------------
+# Fleet satellites (ISSUE 14): healthz states, /v1/load probe, drain
+# ---------------------------------------------------------------------------
+
+
+def test_healthz_reports_state_and_load_probe(tmp_path, rng):
+    X, _ = _save_softmax_model(tmp_path, rng)
+    cfg = ServingConfig(str(tmp_path), buckets=(1, 2), use_tpu=False,
+                        max_wait_ms=1.0)
+    server = Server(cfg)
+    assert server.state() == "stopped"
+    port = server.start(0)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        code, body = _get(base + "/v1/healthz")
+        assert code == 200 and json.loads(body)["state"] == "serving"
+        code, body = _get(base + "/v1/load")
+        probe = json.loads(body)
+        assert code == 200
+        assert set(probe) == {"load", "inflight", "queue_depth",
+                              "state"}
+        assert probe["load"] == 0.0 and probe["state"] == "serving"
+        # /v1/status carries the same fields for the full view
+        code, body = _post(base + "/v1/predict",
+                           {"feeds": {"x": X[:1].tolist()}})
+        assert code == 200
+        code, body = _get(base + "/v1/status")
+        st = json.loads(body)
+        assert st["state"] == "serving" and "load" in st \
+            and "inflight" in st
+    finally:
+        server.stop()
+    assert server.state() == "stopped"
+
+
+def test_state_warming_until_buckets_warm(tmp_path, rng):
+    """The health probe must not admit a replica whose bucket grid is
+    still compiling: state() is 'warming' while started-but-unwarmed
+    (the fleet router treats anything but 'serving' as unhealthy)."""
+    _save_softmax_model(tmp_path, rng)
+    cfg = ServingConfig(str(tmp_path), buckets=(1,), use_tpu=False)
+    server = Server(cfg)
+    # start() warms before binding, so the warming window is normally
+    # invisible over HTTP; drive the state machine directly
+    server._started_t = time.monotonic()
+    assert server._engine.warmed is False
+    assert server.state() == "warming"
+    server._engine.warmup()
+    assert server.state() == "serving"
+    server._started_t = None
+    assert server.state() == "stopped"
+
+
+def test_drain_rejects_with_retry_after_and_finishes_inflight(
+        tmp_path, rng):
+    """Scale-in semantics: drain() keeps the listener up, finishes the
+    queued work, 503s new predicts WITH Retry-After, healthz flips to
+    503 draining — and stop() afterwards tears down cleanly."""
+    X, _ = _save_softmax_model(tmp_path, rng)
+    cfg = ServingConfig(str(tmp_path), buckets=(1, 2), use_tpu=False,
+                        max_wait_ms=20.0, timeout_s=30.0)
+    server = Server(cfg)
+    port = server.start(0)
+    base = f"http://127.0.0.1:{port}"
+    results = []
+
+    def fire():
+        results.append(_post(base + "/v1/predict",
+                             {"feeds": {"x": X[:1].tolist()}}))
+
+    # in-flight work submitted BEFORE the drain must complete (the
+    # coalescing window of max_wait_ms=20 keeps it queued long enough
+    # for drain() to start while it is pending)
+    th = threading.Thread(target=fire)
+    th.start()
+    time.sleep(0.005)
+    server.drain(timeout=30.0)
+    th.join(timeout=30)
+    assert results and results[0][0] == 200
+    assert server.state() == "draining"
+    # new predicts: 503 + Retry-After over the still-up listener
+    req = urllib.request.Request(
+        base + "/v1/predict",
+        data=json.dumps({"feeds": {"x": X[:1].tolist()}}).encode(),
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 503
+    assert ei.value.headers.get("Retry-After") == "1"
+    code, body = _get(base + "/v1/healthz")
+    assert code == 503 and json.loads(body)["state"] == "draining"
+    drains = [e for e in oe.recent(100) if e["kind"] == "serve_drain"]
+    assert len(drains) == 1
+    server.drain()  # idempotent
+    assert len([e for e in oe.recent(100)
+                if e["kind"] == "serve_drain"]) == 1
+    server.stop()
+    assert server.port() is None
+
+
+def test_batcher_inflight_counts_dispatched_requests():
+    """inflight() covers the queue→engine gap: while a batch executes,
+    the load probe must report its rows as in-flight, not zero."""
+    import queue as _q
+
+    release = threading.Event()
+    seen = _q.Queue()
+
+    def slow_engine(feeds):
+        seen.put(True)
+        release.wait(10.0)
+        return {"y": feeds["x"]}
+
+    b = Batcher(slow_engine, BucketPolicy(max_batch=4), max_wait_ms=1.0)
+    try:
+        th = threading.Thread(
+            target=lambda: b.submit({"x": np.ones((1, 2))}))
+        th.start()
+        seen.get(timeout=10)      # engine is now holding the batch
+        assert b.inflight() == 1
+        assert b.depth() == 0     # left the queue
+        release.set()
+        th.join(timeout=10)
+        deadline = time.monotonic() + 5
+        while b.inflight() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert b.inflight() == 0
+    finally:
+        release.set()
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
 # Load-generator smoke (CI satellite)
 # ---------------------------------------------------------------------------
 

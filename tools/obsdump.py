@@ -30,6 +30,12 @@ Usage:
                                             # observed order inversions
                                             # (PADDLE_TPU_LOCKCHECK;
                                             # --live, --json)
+  obsdump.py fleet METRICS.json             # serving-fleet summary:
+                                            # world size, per-replica
+                                            # ejections/retries/breaker
+                                            # states, autoscale actions
+                                            # (--live, --json,
+                                            # --events LOG)
 
 Mixed-precision runs: `snapshot` surfaces the dynamic loss-scaling
 counters (paddle_tpu_amp_total{event=overflow|growth|skip}, the
@@ -478,6 +484,106 @@ def cmd_ps(args) -> int:
     return 0
 
 
+def cmd_fleet(args) -> int:
+    """Serving-fleet story from a metrics snapshot (SERVING.md §Fleet):
+    world size + replica counts by state, per-endpoint picks/ejections/
+    readmissions/breaker state, router request outcomes + retries by
+    failure class, autoscaler actions, supervisor respawns, and the
+    router latency histogram. With --events it also tails the `fleet`
+    events from a JSONL log."""
+    snap = _load_snap(args)
+    if snap is None:
+        print("fleet: need a metrics.json path or --live",
+              file=sys.stderr)
+        return 2
+
+    def series(name):
+        return (snap.get(name) or {}).get("series", [])
+
+    def labeled(name, label):
+        out = {}
+        for s in series(name):
+            key = s.get("labels", {}).get(label, "?")
+            out[key] = out.get(key, 0) + s["value"]
+        return out
+
+    world = next((int(s["value"]) for s in
+                  series("paddle_tpu_fleet_world_size")), None)
+    replicas = {k: int(v) for k, v in
+                labeled("paddle_tpu_fleet_replicas", "state").items()}
+    requests = {k: int(v) for k, v in
+                labeled("paddle_tpu_fleet_requests_total",
+                        "outcome").items()}
+    retries = {k: int(v) for k, v in
+               labeled("paddle_tpu_fleet_retries_total",
+                       "reason").items()}
+    autoscale = {k: int(v) for k, v in
+                 labeled("paddle_tpu_fleet_autoscale_total",
+                         "direction").items()}
+    respawns = sum(int(s["value"]) for s in
+                   series("paddle_tpu_fleet_replica_respawns_total"))
+    state_names = {0: "closed", 1: "half_open", 2: "open"}
+    endpoints = {}  # ep -> {picks, ejections, readmissions, breaker}
+    for name, field in (("paddle_tpu_fleet_picks_total", "picks"),
+                        ("paddle_tpu_fleet_ejections_total",
+                         "ejections"),
+                        ("paddle_tpu_fleet_readmissions_total",
+                         "readmissions")):
+        for ep, v in labeled(name, "endpoint").items():
+            endpoints.setdefault(ep, {})[field] = int(v)
+    for s in series("paddle_tpu_fleet_breaker_state"):
+        ep = s.get("labels", {}).get("endpoint", "?")
+        endpoints.setdefault(ep, {})["breaker"] = state_names.get(
+            int(s.get("value", 0)), "?")
+    lat = _hist_summary(snap, "paddle_tpu_fleet_request_seconds")
+
+    if world is None and not endpoints and not requests:
+        print("no fleet_* samples in this snapshot (did a serving "
+              "Router run in this process?)")
+        return 0
+    ep_rows = [{"endpoint": ep,
+                "breaker": info.get("breaker", "closed"),
+                "picks": info.get("picks", 0),
+                "ejections": info.get("ejections", 0),
+                "readmissions": info.get("readmissions", 0)}
+               for ep, info in sorted(endpoints.items())]
+    out = {"world_size": world, "replicas": replicas,
+           "requests": requests, "retries": retries,
+           "autoscale": autoscale, "respawns": respawns,
+           "endpoints": ep_rows, "request_latency": lat}
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    print(f"world size: {world}  replicas: " +
+          (", ".join(f"{k}={v}" for k, v in sorted(replicas.items()))
+           or "none"))
+    print("requests: " + (", ".join(f"{k}={v}" for k, v in
+                                    sorted(requests.items()) if v)
+                          or "none"))
+    print("retries: " + (", ".join(f"{k}={v}" for k, v in
+                                   sorted(retries.items()))
+                         or "none"))
+    print("autoscale: " + (", ".join(f"{k}={v}" for k, v in
+                                     sorted(autoscale.items()))
+                           or "none") + f"  respawns: {respawns}")
+    if ep_rows:
+        print()
+        _print_aligned(ep_rows, ("endpoint", "breaker", "picks",
+                                 "ejections", "readmissions"))
+    if lat and lat.get("count"):
+        print(f"\nrouter latency: n={lat['count']} "
+              f"avg={lat['avg_ms']}ms p50~{lat['p50_ms']}ms "
+              f"p99~{lat['p99_ms']}ms")
+    if args.events:
+        evs = _load_obs_module("events").read_jsonl(args.events,
+                                                    n=args.n,
+                                                    kind="fleet")
+        print(f"\nlast {len(evs)} fleet events:")
+        for ev in evs:
+            print("  " + _fmt_event(ev))
+    return 0
+
+
 def _hist_summary(snap, name):
     """count / avg / estimated p50+p99 for an (unlabeled) histogram in
     a snapshot. Percentiles interpolate within the cumulative `le`
@@ -688,6 +794,22 @@ def main(argv=None) -> int:
     dp.add_argument("-n", type=int, default=20,
                     help="with --events: last N events (default 20)")
     dp.set_defaults(fn=cmd_decode)
+
+    fp = sub.add_parser("fleet", help="serving-fleet summary (world "
+                        "size, per-replica health/ejections/retries, "
+                        "breaker states, autoscale actions) from a "
+                        "metrics snapshot")
+    fp.add_argument("path", nargs="?", help="metrics.json from "
+                    "PADDLE_TPU_METRICS_DIR (omit with --live)")
+    fp.add_argument("--live", action="store_true",
+                    help="read this process's registry instead of a file")
+    fp.add_argument("--json", action="store_true",
+                    help="JSON instead of the summary lines")
+    fp.add_argument("--events", default=None, metavar="JSONL",
+                    help="also tail fleet events from this event log")
+    fp.add_argument("-n", type=int, default=20,
+                    help="with --events: last N events (default 20)")
+    fp.set_defaults(fn=cmd_fleet)
 
     # unknown/missing subcommands exit nonzero via argparse itself
     # (required=True subparsers error out with status 2)

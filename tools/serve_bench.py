@@ -1,6 +1,6 @@
 """Open-loop Poisson load generator for the serving subsystem.
 
-Two modes:
+Three modes:
 
 **Predict mode** (default): starts a `serving.Server` on a LeNet-sized
 MLP, fires requests with exponential inter-arrival times at a fixed
@@ -22,10 +22,31 @@ Acceptance (ISSUE 12): continuous sustains >=2x tokens/s at equal (or
 better) p99 end-to-end latency, and the warm replay is compile-free
 and bit-identical.
 
+**Fleet mode** (`--fleet`, ISSUE 14): boots a ReplicaSupervisor fleet
+(N replica subprocesses warmstart-booted from an artifact baked
+in-process, heartbeating into a shared rendezvous store) behind a
+Router, then runs the three chaos gates from the ISSUE 14 acceptance
+criteria:
+
+  1. **failover** — open-loop Poisson load through the router;
+     mid-load, SIGKILL one replica. Gate: ZERO failed client requests
+     (the router health-ejects the corpse and retries the in-flight
+     idempotent predicts on a survivor; ejection + retry recorded in
+     fleet events), and the supervisor respawns the slot.
+  2. **scale-out** — traffic steps to 2x with the Autoscaler armed.
+     Gate: a scale-out lands (warmstart-booted: the new replica's
+     /v1/status shows warmstart_adopted > 0), and the p99 of the final
+     third of the step phase recovers to <= --p99-recover-factor x the
+     phase's peak window p99.
+  3. **scale-in** — traffic drops; a graceful scale_in drains the
+     newest replica WHILE a request burst is in flight. Gate: zero
+     dropped requests (drain semantics: leave rendezvous, finish
+     in-flight, 503+Retry-After stragglers fail over).
+
 Run:  python tools/serve_bench.py [--rate 200] [--duration 10]
       [--max-batch 16] [--max-wait-ms 5] [--max-queue 128] [--batch 1]
       [--tokens] [--slots 4,8] [--prefill-buckets 8,16,32]
-      [--warmstart ART] [--smoke]
+      [--warmstart ART] [--fleet] [--replicas 2] [--smoke]
 
 --smoke is the tier-1-safe mode the test suite invokes (CPU backend,
 short traffic, small model) — it validates the full HTTP path, the A/B
@@ -67,6 +88,17 @@ def _build_args():
                     help="pre-baked decode warmstart artifact to boot "
                     "the warm-replay engine from (token mode; default: "
                     "bake in-process from the cold engine)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet chaos mode: replica kill under load, "
+                    "2x traffic step with autoscaling, graceful "
+                    "scale-in (ISSUE 14 gates)")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="initial fleet size (fleet mode)")
+    ap.add_argument("--fleet-max", type=int, default=3,
+                    help="autoscaler max replicas (fleet mode)")
+    ap.add_argument("--p99-recover-factor", type=float, default=1.0,
+                    help="scale-out gate: tail-third p99 must be <= "
+                    "this x the step phase's peak window p99")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU run for CI (overrides rate/duration)")
     return ap.parse_args()
@@ -485,13 +517,314 @@ def run_token_bench(args) -> int:
     return 0 if ok else 1
 
 
+# ---------------------------------------------------------------------------
+# Fleet chaos mode (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def _fleet_phase(url: str, rate: float, duration: float, body: bytes,
+                 timeout_s: float, on_tick=None):
+    """Open-loop Poisson load against the router; returns per-request
+    records [(arrival_s, latency_ms, outcome)] with outcome in
+    ok|rejected|timeout|error. `on_tick(elapsed_s)` runs on the arrival
+    thread (the chaos hook: kill a replica at a chosen moment)."""
+    import random
+    import urllib.error
+    import urllib.request
+
+    rng = random.Random(1234)
+    n_requests = max(4, int(rate * duration))
+    arrivals, t = [], 0.0
+    for _ in range(n_requests):
+        t += rng.expovariate(rate)
+        arrivals.append(t)
+
+    lock = threading.Lock()
+    records = []
+
+    def fire(at):
+        t0 = time.perf_counter()
+        try:
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout_s + 5):
+                pass
+            out = "ok"
+        except urllib.error.HTTPError as e:
+            out = {503: "rejected", 504: "timeout"}.get(e.code, "error")
+        except Exception:
+            out = "error"
+        with lock:
+            records.append((at, (time.perf_counter() - t0) * 1000, out))
+
+    cap = threading.Semaphore(256)
+
+    def fire_capped(at):
+        try:
+            fire(at)
+        finally:
+            cap.release()
+
+    threads = []
+    start = time.perf_counter()
+    for at in arrivals:
+        delay = at - (time.perf_counter() - start)
+        if delay > 0:
+            time.sleep(delay)
+        if on_tick is not None:
+            on_tick(time.perf_counter() - start)
+        cap.acquire()
+        th = threading.Thread(target=fire_capped, args=(at,),
+                              daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=timeout_s + 30)
+    # a thread still wedged past its join timeout produced no record;
+    # the zero-failed-requests gate must count it as a failure, not
+    # silently shrink the denominator
+    with lock:
+        lost = len(threads) - len(records)
+        for _ in range(lost):
+            records.append((float("nan"), float("nan"), "error"))
+        return list(records)
+
+
+def _outcomes(records):
+    out = {"ok": 0, "rejected": 0, "timeout": 0, "error": 0}
+    for _, _, oc in records:
+        out[oc] += 1
+    return out
+
+
+def _phase_p99s(records, tail_frac: float = 1 / 3, windows: int = 6):
+    """(peak windowed p99, tail-third p99) of the ok latencies, by
+    arrival time — the 'p99 recovers' gate compares the tail against
+    the worst window the traffic step caused."""
+    oks = sorted((at, ms) for (at, ms, oc) in records if oc == "ok")
+    if not oks:
+        return None, None
+    span = max(at for at, _ in oks) or 1e-9
+    per_win = [[] for _ in range(windows)]
+    for at, ms in oks:
+        per_win[min(windows - 1, int(windows * at / span))].append(ms)
+    win_p99 = [_percentile(w, 99) for w in per_win if w]
+    tail = [ms for at, ms in oks if at >= span * (1 - tail_frac)]
+    return (max(win_p99) if win_p99 else None,
+            _percentile(tail, 99))
+
+
+def _fleet_events(kind_action):
+    from paddle_tpu.observability import events as oe
+
+    return [e for e in oe.recent(4096, kind="fleet")
+            if e.get("action") == kind_action]
+
+
+def run_fleet_bench(args) -> int:
+    """The three ISSUE 14 acceptance gates — see module docstring."""
+    import urllib.request
+
+    import jax
+
+    from paddle_tpu.distributed.launch_serve import (ReplicaSpec,
+                                                     ReplicaSupervisor)
+    from paddle_tpu.serving import Engine, ServingConfig
+    from paddle_tpu.serving.autoscale import Autoscaler
+    from paddle_tpu.serving.router import Router, RouterServer
+
+    platform = jax.devices()[0].platform
+    tmpdir = tempfile.mkdtemp(prefix="serve_fleet_")
+    model_dir = os.path.join(tmpdir, "model")
+    os.makedirs(model_dir, exist_ok=True)
+    probe = _save_model(model_dir)
+
+    # bake the warmstart artifact every replica (incl. scale-outs)
+    # boots from — scale-out must be seconds, not an XLA warmup
+    art = os.path.join(tmpdir, "fleet.warmstart")
+    bake = Engine(ServingConfig(model_dir, max_batch=args.max_batch,
+                                use_tpu=False))
+    bake.warmup()
+    bake.export_warmstart(art)
+
+    rdzv = os.path.join(tmpdir, "rdzv")
+    spec = ReplicaSpec(model_dir, warmstart=art, cpu=True,
+                       max_batch=args.max_batch,
+                       max_queue=args.max_queue,
+                       max_wait_ms=args.max_wait_ms,
+                       timeout_s=args.timeout_s)
+    sup = ReplicaSupervisor(spec, rdzv, replicas=args.replicas,
+                            backoff_s=0.3,
+                            log_dir=os.path.join(tmpdir, "logs"))
+    router = Router(rdzv_dir=rdzv, poll_interval_s=0.1,
+                    request_timeout_s=args.timeout_s)
+    front = RouterServer(router)
+    sup.start()
+    port = front.start(0)
+    url = f"http://127.0.0.1:{port}/v1/predict"
+    body = json.dumps(
+        {"feeds": {"x": probe[:args.batch].tolist()}}).encode()
+
+    def wait_healthy(n, timeout=180.0):
+        t0 = time.time()
+        while len(router.healthy_endpoints()) < n:
+            if time.time() - t0 > timeout:
+                raise RuntimeError(
+                    f"fleet never reached {n} healthy replicas "
+                    f"(status: {router.status()})")
+            time.sleep(0.1)
+        return time.time() - t0
+
+    rc = 0
+    scaler = None
+    try:
+        boot_s = wait_healthy(args.replicas)
+
+        # ---- gate 1: SIGKILL one replica under open-loop load -------
+        kill_state = {"done": False, "endpoint": None}
+
+        def chaos(elapsed):
+            if not kill_state["done"] and elapsed >= args.duration * 0.4:
+                kill_state["done"] = True
+                live = [s for s in sup.slot_info() if s["alive"]]
+                kill_state["endpoint"] = sup.kill_slot(live[0]["slot"])
+
+        rec1 = _fleet_phase(url, args.rate, args.duration, body,
+                            args.timeout_s, on_tick=chaos)
+        oc1 = _outcomes(rec1)
+        st1 = router.status()
+        ejections = len(_fleet_events("eject"))
+        retried = sum(st1["retries"].values())
+        failover_ok = (oc1["error"] == 0 and oc1["timeout"] == 0
+                       and oc1["rejected"] == 0 and oc1["ok"] > 0
+                       and kill_state["done"] and ejections >= 1)
+        respawn_s = wait_healthy(args.replicas)  # supervisor heals it
+
+        # ---- gate 2: 2x traffic step with the autoscaler armed ------
+        scaler = Autoscaler(
+            router, sup, min_replicas=args.replicas,
+            max_replicas=args.fleet_max,
+            high_load=1.0, low_load=0.2,
+            interval_s=0.1, breach_polls=2, clear_polls=50,
+            out_cooldown_s=2.0, in_cooldown_s=3600.0)
+        known = set(sup.endpoints())
+        scaler.start()
+        rec2 = _fleet_phase(url, args.rate * 2, args.duration * 2,
+                            body, args.timeout_s)
+        scaler.stop()
+        oc2 = _outcomes(rec2)
+        scale_outs = scaler.status()["actions"]["out"]
+        new_eps = sorted(set(sup.endpoints()) - known)
+        adopted = None
+        for ep in new_eps:
+            try:
+                with urllib.request.urlopen(
+                        f"http://{ep}/v1/status", timeout=5) as r:
+                    adopted = json.loads(r.read()).get(
+                        "warmstart_adopted")
+            except Exception:
+                continue
+        peak_p99, tail_p99 = _phase_p99s(rec2)
+        p99_recovered = (peak_p99 is not None and tail_p99 is not None
+                         and tail_p99 <=
+                         peak_p99 * args.p99_recover_factor)
+        scaleout_ok = (scale_outs >= 1 and bool(new_eps)
+                       and (adopted or 0) > 0 and p99_recovered
+                       and oc2["error"] == 0)
+
+        # ---- gate 3: graceful scale-in under an in-flight burst -----
+        burst_n = 24
+        results = {"ok": 0, "fail": 0}
+        lock = threading.Lock()
+
+        def burst_fire():
+            import urllib.error
+
+            try:
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req,
+                                            timeout=args.timeout_s + 5):
+                    pass
+                with lock:
+                    results["ok"] += 1
+            except Exception:
+                with lock:
+                    results["fail"] += 1
+
+        ths = [threading.Thread(target=burst_fire, daemon=True)
+               for _ in range(burst_n)]
+        for th in ths:
+            th.start()
+        drained = sup.scale_in()
+        for th in ths:
+            th.join(timeout=args.timeout_s + 30)
+        scalein_ok = (results["fail"] == 0 and results["ok"] == burst_n
+                      and drained is not None)
+
+        detail_base = {
+            "platform": platform, "smoke": bool(args.smoke),
+            "rate_rps": args.rate, "duration_s": args.duration,
+            "replicas": args.replicas, "fleet_max": args.fleet_max,
+            "boot_s": round(boot_s, 3),
+        }
+        for metric, value, unit, detail in (
+                ("fleet_failover_failed_requests",
+                 oc1["error"] + oc1["timeout"] + oc1["rejected"],
+                 "count",
+                 dict(detail_base, **oc1, killed=kill_state["endpoint"],
+                      ejections=ejections, retries=retried,
+                      respawn_s=round(respawn_s, 3),
+                      gate_ok=failover_ok,
+                      acceptance="SIGKILL one replica under load -> "
+                                 "zero failed client requests")),
+                ("fleet_scaleout_p99_recovered",
+                 int(p99_recovered), "bool",
+                 dict(detail_base, **oc2, scale_outs=scale_outs,
+                      new_replicas=new_eps,
+                      warmstart_adopted=adopted,
+                      peak_window_p99_ms=peak_p99,
+                      tail_p99_ms=tail_p99,
+                      p99_recover_factor=args.p99_recover_factor,
+                      gate_ok=scaleout_ok,
+                      acceptance="2x step -> warmstart scale-out, "
+                                 "tail p99 <= factor x peak")),
+                ("fleet_scalein_dropped_requests", results["fail"],
+                 "count",
+                 dict(detail_base, burst=burst_n, ok=results["ok"],
+                      drained_endpoint=drained, gate_ok=scalein_ok,
+                      acceptance="graceful drain -> zero dropped "
+                                 "in-flight requests"))):
+            print(json.dumps({"metric": metric, "value": value,
+                              "unit": unit, "detail": detail}),
+                  flush=True)
+        rc = 0 if (failover_ok and scaleout_ok and scalein_ok) else 1
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        front.stop()
+        sup.stop()
+    return rc
+
+
 def main() -> int:
     args = _build_args()
+    if args.fleet:
+        # the fleet is N CPU replica subprocesses (one real chip cannot
+        # host N engines); the in-process warmstart bake must match the
+        # replicas' backend or every boot degrades to cold
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
     if args.smoke:
         # tier-1 safety: tiny, CPU-only, deterministic-ish
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         args.rate, args.duration = 80.0, 1.5
         args.max_batch, args.max_queue = 8, 64
+        if args.fleet:
+            args.rate, args.duration = 60.0, 2.5
+            args.max_wait_ms = 1.0
+            args.timeout_s = 20.0
         if args.tokens:
             # saturating burst: the A/B measures service capacity, so
             # arrivals must not be the bottleneck in either phase
@@ -503,6 +836,8 @@ def main() -> int:
     from paddle_tpu.core.tpu_lock import tpu_singleflight
 
     with tpu_singleflight():  # one real chip: serialize vs bench/tools
+        if args.fleet:
+            return run_fleet_bench(args)
         return run_token_bench(args) if args.tokens else run_bench(args)
 
 
