@@ -180,8 +180,8 @@ class CompiledProgram:
                 self._cache[key] = step
 
             rng = executor._get_rng(scope, program)
-            with _tracing.span("compiled_program.run", cat="step",
-                               fetches=len(fetch_names)):
+            with _tracing.step_span("compiled_program.run", cat="step",
+                                    fetches=len(fetch_names)):
                 fetches, new_rng = step(scope, norm_feed, rng)
             scope.set_var(RNG_STATE_VAR, new_rng)
             _post_step_health(step.writes, fetch_names, fetches, scope)

@@ -914,8 +914,12 @@ class Executor:
                                                 use_program_cache)
             rec.set_feed(norm_feed)
             rng = self._get_rng(scope, program)
-            with _tracing.span("executor.run", cat="step",
-                               fetches=len(fetch_names)):
+            # step_span: joins the ambient trace when one is active and
+            # STARTS one (head-sampled) when PADDLE_TPU_TRACE_SAMPLE is
+            # armed — the training path's trace origin, so PS RPCs
+            # issued inside the step inherit the step's trace id
+            with _tracing.step_span("executor.run", cat="step",
+                                    fetches=len(fetch_names)):
                 with jax.default_device(self.place.jax_device()):
                     fetches, new_rng = step(scope, norm_feed, rng)
             scope.set_var(RNG_STATE_VAR, new_rng)
@@ -1008,8 +1012,10 @@ class Executor:
                                                 True)
             rec.set_feed(norm_feed)
             rng = self._get_rng(scope, program)
-            with _tracing.span("executor.run_chained", cat="step",
-                               n_steps=int(n_steps)):
+            # step_span: trace origin for the chained/stream fast path
+            # (run_stream windows flush through here)
+            with _tracing.step_span("executor.run_chained", cat="step",
+                                    n_steps=int(n_steps)):
                 with jax.default_device(self.place.jax_device()):
                     fetches, new_rng = step.run_chained(
                         scope, norm_feed, rng, int(n_steps),

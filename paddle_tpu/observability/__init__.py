@@ -8,7 +8,11 @@ user-facing guide):
                  dump (PADDLE_TPU_METRICS_DIR).
 - tracing.py   — one span store for profiler.RecordEvent host spans and
                  step telemetry, merged with jax.profiler device traces
-                 into a single chrome-trace export.
+                 into a single chrome-trace export; also the distributed
+                 trace-context layer (W3C traceparent + contextvars +
+                 per-process JSONL sink, PADDLE_TPU_TRACE_DIR /
+                 PADDLE_TPU_TRACE_SAMPLE — PROFILE.md §Distributed
+                 tracing).
 - telemetry.py — the metric vocabulary + record helpers the executor,
                  trainer, and SPMD/pipeline stacks call on their hot
                  paths (step timing, cache events, compiles, device
@@ -42,10 +46,16 @@ from .metrics import (  # noqa: F401
     reset, snapshot, stop_dump_thread,
 )
 from .tracing import (  # noqa: F401
-    Span, clear_spans, export_trace, get_spans, record_span, save_spans,
-    span,
+    Span, TraceContext, begin_request, clear_spans, current_trace,
+    export_trace, flush_trace_sink, get_spans, parse_traceparent,
+    record_span, save_spans, span, start_trace, step_span, trace_headers,
+    trace_span,
 )
 from .health import NumericsError, check_numerics  # noqa: F401
+
+# the event log's trace join key: emit() asks this for the active
+# sampled trace id (injected so events.py stays file-path importable)
+events.set_trace_provider(tracing.current_trace_id)
 from .httpd import (  # noqa: F401
     maybe_start_http_server, start_http_server, stop_http_server,
 )
@@ -56,8 +66,10 @@ __all__ = [
     "default_registry", "dump", "gauge", "histogram",
     "maybe_start_dump_thread", "render_prometheus", "reset", "snapshot",
     "stop_dump_thread",
-    "Span", "clear_spans", "export_trace", "get_spans", "record_span",
-    "save_spans", "span",
+    "Span", "TraceContext", "begin_request", "clear_spans",
+    "current_trace", "export_trace", "flush_trace_sink", "get_spans",
+    "parse_traceparent", "record_span", "save_spans", "span",
+    "start_trace", "step_span", "trace_headers", "trace_span",
     "NumericsError", "check_numerics",
     "maybe_start_http_server", "start_http_server", "stop_http_server",
 ]

@@ -17,6 +17,21 @@ Sinks:
     `<dir>/events.jsonl` is used). One `json.dumps` line per event,
     append-only: `tools/obsdump.py events` tails and pretty-prints it.
 
+Rotation: with `PADDLE_TPU_EVENT_LOG_MAX_BYTES` set, the file sink
+rolls over before an append would push the file past the cap —
+events.jsonl → events.jsonl.1 (→ .2 …), keeping
+`PADDLE_TPU_EVENT_LOG_KEEP` rotated files (default 3, oldest deleted) —
+so an append-only log under fleet load stays bounded instead of growing
+without limit. `obsdump events --follow` detects the rename (inode
+change) and reopens the fresh file without dropping lines.
+
+Trace join key: when the distributed-tracing layer (tracing.py) has a
+sampled context active at emit time, the event gains a `trace_id` field
+— the JSONL event log then joins against the trace sink without every
+emitter threading ids by hand. The hook is injected via
+`set_trace_provider` (observability/__init__.py wires it) so this
+module stays stdlib-only and file-path importable.
+
 Schema (stable, documented in PROFILE.md §Health):
   {"seq": int, "ts": float unix seconds, "kind": str, ...kind fields}
 
@@ -34,7 +49,7 @@ import time
 from typing import Any, Dict, Iterable, List, Optional
 
 __all__ = ["emit", "recent", "clear", "log_path", "read_jsonl",
-           "MAX_EVENTS", "KINDS"]
+           "set_trace_provider", "MAX_EVENTS", "KINDS"]
 
 # Known event kinds (emitters may add more; these are the documented core).
 # serve_start/serve_stop bracket a serving.Server's lifetime (SERVING.md).
@@ -74,6 +89,18 @@ _ring: "collections.deque[Dict[str, Any]]" = collections.deque(
 _seq = 0
 
 
+_trace_provider = None
+
+
+def set_trace_provider(fn):
+    """Install the callable emit() asks for the active sampled trace id
+    (observability/__init__.py wires tracing.current_trace_id here;
+    None uninstalls). Kept as injection so this module never imports
+    its sibling — tools/obsdump.py loads it standalone by file path."""
+    global _trace_provider
+    _trace_provider = fn
+
+
 def log_path() -> Optional[str]:
     """Resolved JSONL sink path, or None when file logging is off.
     Re-read from the env on every call so tests can monkeypatch."""
@@ -86,6 +113,84 @@ def log_path() -> Optional[str]:
     return None
 
 
+def _rotate_cap() -> int:
+    """PADDLE_TPU_EVENT_LOG_MAX_BYTES as an int (0/unset/malformed =
+    rotation off)."""
+    raw = os.environ.get("PADDLE_TPU_EVENT_LOG_MAX_BYTES")
+    if not raw:
+        return 0
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return 0
+
+
+def _rotate_keep() -> int:
+    try:
+        return max(1, int(os.environ.get("PADDLE_TPU_EVENT_LOG_KEEP",
+                                         "3")))
+    except ValueError:
+        return 3
+
+
+def _maybe_rotate_locked(path: str, incoming: int):
+    """Under _file_lock: roll the sink over when appending `incoming`
+    bytes would push it past the cap. os.replace renames are atomic, so
+    a concurrent reader sees either the old file (under its old inode —
+    how `obsdump events --follow` finishes the tail before reopening)
+    or the fresh one, never a mix.
+
+    The sink is shared ACROSS processes in a fleet (every replica
+    inherits PADDLE_TPU_EVENT_LOG), so the keep-chain shift is guarded
+    by an OS-level flock on a sibling lockfile — two processes racing
+    the cap would otherwise both rotate, shifting a seconds-old
+    generation outward and deleting the oldest retained file early. The
+    size is re-checked under the flock: the loser of the race sees the
+    fresh (small) file and skips."""
+    cap = _rotate_cap()
+    if not cap:
+        return
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return
+    if size == 0 or size + incoming <= cap:
+        return
+    lockf = None
+    try:
+        import fcntl
+        lockf = open(path + ".rotlock", "a")
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+    except (ImportError, OSError):
+        lockf = None  # non-POSIX / unwritable dir: best-effort rotate
+    try:
+        if lockf is not None:
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                return
+            if size == 0 or size + incoming <= cap:
+                return  # a peer process rotated while we waited
+        keep = _rotate_keep()
+        try:
+            oldest = f"{path}.{keep}"
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for i in range(keep - 1, 0, -1):
+                src = f"{path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{path}.{i + 1}")
+            os.replace(path, f"{path}.1")
+        except OSError:
+            pass  # lint-exempt:swallow: rotation is best-effort; the append below still lands
+    finally:
+        if lockf is not None:
+            try:
+                lockf.close()  # releases the flock
+            except OSError:
+                pass  # lint-exempt:swallow: lockfile close on teardown
+
+
 def emit(kind: str, **fields) -> Dict[str, Any]:
     """Record one event: ring always, file when a sink is configured.
     Returns the event dict (with seq/ts filled in)."""
@@ -94,6 +199,13 @@ def emit(kind: str, **fields) -> Dict[str, Any]:
         _seq += 1
         ev: Dict[str, Any] = {"seq": _seq, "ts": time.time(), "kind": kind}
         ev.update(fields)
+        if _trace_provider is not None and "trace_id" not in ev:
+            try:
+                tid = _trace_provider()
+            except Exception:
+                tid = None
+            if tid:
+                ev["trace_id"] = tid
         _ring.append(ev)
     path = log_path()
     if path:
@@ -105,6 +217,7 @@ def emit(kind: str, **fields) -> Dict[str, Any]:
                 d = os.path.dirname(path)
                 if d:
                     os.makedirs(d, exist_ok=True)
+                _maybe_rotate_locked(path, len(line))
                 with open(path, "a") as f:
                     f.write(line)
         except OSError:

@@ -186,6 +186,17 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
+        # callables run before every snapshot/exposition: lazily-synced
+        # sources (e.g. the span-ring drop counter, whose source module
+        # is stdlib-only and cannot import this registry) publish here
+        self._collect_hooks: list = []
+
+    def add_collect_hook(self, fn):
+        """Register `fn` to run at the top of every snapshot() (and so
+        every /metrics render and file dump). Idempotent per callable."""
+        with self._lock:
+            if fn not in self._collect_hooks:
+                self._collect_hooks.append(fn)
 
     def _get_or_create(self, cls, name, help, labelnames, **kw):
         with self._lock:
@@ -225,6 +236,13 @@ class MetricsRegistry:
     def snapshot(self) -> Dict[str, dict]:
         """JSON-able view of every metric (the obsdump/dump format)."""
         out = {}
+        with self._lock:
+            hooks = list(self._collect_hooks)
+        for fn in hooks:
+            try:
+                fn()
+            except Exception:
+                pass  # lint-exempt:swallow: a broken lazy source must not poison the whole exposition
         with self._lock:
             metrics = list(self._metrics.values())
         for m in metrics:
@@ -388,6 +406,10 @@ def histogram(name, help="", labelnames=(), buckets=DEFAULT_BUCKETS):
 
 def snapshot() -> Dict[str, dict]:
     return _default.snapshot()
+
+
+def add_collect_hook(fn):
+    _default.add_collect_hook(fn)
 
 
 def render_prometheus() -> str:

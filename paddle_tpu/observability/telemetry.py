@@ -396,3 +396,33 @@ def record_pipeline_trace(axis: str, stages: int, n_micro: int):
     PIPELINE_MICROBATCHES.set(n_micro, axis=axis)
     PIPELINE_BUBBLE_FRACTION.set(
         (stages - 1) / max(1, n_micro + stages - 1), axis=axis)
+
+
+# -- span-ring drop visibility (ISSUE 15 satellite) -------------------------
+
+SPANS_DROPPED = _m.counter(
+    "paddle_tpu_spans_dropped_total",
+    "Spans evicted oldest-first from the in-memory span ring "
+    "(tracing.MAX_SPANS overflow) — a nonzero rate means exported "
+    "traces are missing their oldest window")
+
+_spans_dropped_synced = [0]
+
+
+def sync_spans_dropped():
+    """Publish tracing.dropped_spans() into the registry counter.
+    Registered as a collect hook (runs before every /metrics render and
+    snapshot), because tracing.py is stdlib-only by contract and cannot
+    push into the registry itself."""
+    from . import tracing as _tracing
+
+    d = _tracing.dropped_spans()
+    prev = _spans_dropped_synced[0]
+    if d > prev:
+        SPANS_DROPPED.inc(d - prev)
+        _spans_dropped_synced[0] = d
+    elif d < prev:
+        _spans_dropped_synced[0] = d  # clear_spans() reset the source
+
+
+_m.add_collect_hook(sync_spans_dropped)

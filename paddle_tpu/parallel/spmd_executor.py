@@ -128,7 +128,7 @@ class SPMDRunner:
 
         rng = _repatriate(executor._get_rng(scope, program), self.mesh,
                           self._mesh_devs)
-        with _tracing.span("spmd.step", cat="step", axis=self.axis):
+        with _tracing.step_span("spmd.step", cat="step", axis=self.axis):
             fetches, new_states, new_rng = step(scope, norm_feed, rng)
         for n, v in new_states.items():
             scope.set_var(n, v)

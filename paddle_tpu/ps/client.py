@@ -45,11 +45,12 @@ import numpy as np
 
 from ..observability import events as _events
 from ..observability import metrics as _m
+from ..observability import tracing as _tracing
 from ..resilience import faults as _faults
 from ..resilience.retry import CircuitBreaker
 from .errors import PSTimeoutError, PSUnavailableError
-from .protocol import (CID_FIELD, SEQ_FIELD, place_endpoint, recv_msg,
-                       send_msg)
+from .protocol import (CID_FIELD, SEQ_FIELD, TRACE_FIELD, place_endpoint,
+                       recv_msg, send_msg)
 
 _log = logging.getLogger("paddle_tpu.ps")
 
@@ -187,7 +188,31 @@ class _Conn:
         `deadline_s` (default: the conn's budget) is exhausted, then
         raises PSUnavailableError. With fail_fast=True the first wire
         failure or an open breaker raises immediately (background
-        senders use this to switch to buffering instead of blocking)."""
+        senders use this to switch to buffering instead of blocking).
+
+        Distributed tracing: when the calling thread carries a trace
+        context (the executor's step span, a serving request), the call
+        is stamped with a `traceparent` on the wire envelope and — for
+        SAMPLED traces — recorded as a `ps.rpc` span whose span id is
+        exactly what the server parents its own child span to. Untraced
+        calls pay one contextvar read."""
+        tctx = _tracing.current_trace()
+        if tctx is None:
+            return self._call_impl(msg, deadline_s, fail_fast)
+        span_ctx = tctx.child() if tctx.sampled else tctx
+        t0 = time.perf_counter()
+        try:
+            return self._call_impl(msg, deadline_s, fail_fast,
+                                   trace_header=span_ctx.header())
+        finally:
+            _tracing.record_span_ctx(
+                span_ctx, "ps.rpc", time.perf_counter() - t0, cat="ps",
+                t0_perf=t0, op=str(msg.get("op", "?")),
+                endpoint=self.endpoint)
+
+    def _call_impl(self, msg, deadline_s: Optional[float] = None,
+                   fail_fast: bool = False,
+                   trace_header: Optional[str] = None) -> dict:
         op = str(msg.get("op", "?"))
         budget = self.deadline_s if deadline_s is None else float(deadline_s)
         with self.lock:
@@ -195,6 +220,8 @@ class _Conn:
             wire = dict(msg)
             wire[CID_FIELD] = self.cid
             wire[SEQ_FIELD] = self._seq
+            if trace_header is not None:
+                wire[TRACE_FIELD] = trace_header
             t0 = time.monotonic()
             first_failure_at: Optional[float] = None
             attempt = 0

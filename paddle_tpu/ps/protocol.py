@@ -35,6 +35,12 @@ _LEN = struct.Struct("<Q")
 # they can never collide with an op's own payload fields.
 CID_FIELD = "_cid"
 SEQ_FIELD = "_seq"
+# distributed-tracing envelope key (PROFILE.md §Distributed tracing):
+# the client's per-call W3C `traceparent` string rides the same frame
+# the (cid, seq) pair does, so the server can open a child span of the
+# trainer's step trace. Absent on untraced calls (zero overhead) and
+# on legacy peers; the server strips it before dispatching the op.
+TRACE_FIELD = "_trace"
 
 _ALLOWED = {
     ("numpy.core.multiarray", "_reconstruct"),

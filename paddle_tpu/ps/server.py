@@ -29,8 +29,10 @@ import numpy as np
 
 from ..observability import events as _events
 from ..observability import metrics as _m
+from ..observability import tracing as _tracing
 from ..resilience import faults as _faults
-from .protocol import CID_FIELD, SEQ_FIELD, recv_msg, send_msg
+from .protocol import (CID_FIELD, SEQ_FIELD, TRACE_FIELD, recv_msg,
+                       send_msg)
 
 _log = logging.getLogger("paddle_tpu.ps")
 
@@ -487,10 +489,27 @@ class ParameterServer:
     # -- request handlers (reference: request_handler_impl.cc) -------------
 
     def handle(self, msg: Dict[str, Any]) -> Dict[str, Any]:
-        """Envelope wrapper around `_handle`: chaos injection point
-        (`ps_server[=index]:crash` fires here, modeling a server dying
-        mid-service), retried-request dedupe for (cid, seq)-stamped
-        frames, and dirty tracking for the periodic snapshot thread."""
+        """Envelope wrapper around `_handle_enveloped`: strips the
+        tracing envelope field and — when the client's call was part of
+        a SAMPLED trace — opens a server-side child span, so the
+        cross-process trace tree shows trainer step → ps.rpc →
+        ps.server.<op> with server-side time attributed (the role of
+        the reference's profiler events inside the RPC request
+        handlers). Untraced frames skip straight through."""
+        tp = msg.pop(TRACE_FIELD, None) if isinstance(msg, dict) else None
+        tctx = _tracing.parse_traceparent(tp) if tp else None
+        if tctx is None or not tctx.sampled:
+            return self._handle_enveloped(msg)
+        with _tracing.trace_span(
+                f"ps.server.{msg.get('op', '?')}", cat="ps", ctx=tctx,
+                endpoint=f"{self.host}:{self.port}"):
+            return self._handle_enveloped(msg)
+
+    def _handle_enveloped(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Chaos injection point (`ps_server[=index]:crash` fires here,
+        modeling a server dying mid-service), retried-request dedupe
+        for (cid, seq)-stamped frames, and dirty tracking for the
+        periodic snapshot thread."""
         _faults.check("ps_server", step=self.server_index)
         cid = msg.get(CID_FIELD)
         if cid is None:

@@ -32,6 +32,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..observability import metrics as _m
+from ..observability import tracing as _tracing
 from .bucketing import BucketPolicy, common_batch
 
 __all__ = ["Batcher", "EngineError", "QueueFullError", "RequestTimeout",
@@ -78,7 +79,7 @@ BATCH_ROWS = _m.histogram(
 
 class _Request:
     __slots__ = ("feeds", "n", "sig", "enqueue_t", "deadline",
-                 "event", "result", "error")
+                 "event", "result", "error", "tctx")
 
     def __init__(self, feeds, n, sig, deadline):
         self.feeds = feeds
@@ -89,6 +90,9 @@ class _Request:
         self.event = threading.Event()
         self.result: Optional[Dict[str, np.ndarray]] = None
         self.error: Optional[BaseException] = None
+        # captured at submit() on the CALLER's thread: the batcher
+        # thread records queue-wait/batch spans against it later
+        self.tctx = _tracing.current_trace()
 
 
 def _feed_sig(feeds: Dict[str, np.ndarray]):
@@ -134,6 +138,7 @@ class Batcher:
         # global: concurrent servers would cross-contaminate each
         # other's /v1/status and serve_stop numbers without these)
         self._counts = {"ok": 0, "rejected": 0, "timeout": 0, "error": 0}
+        self._batch_seq = 0  # links every member's batch span (tracing)
         self._thread = threading.Thread(target=self._loop,
                                         name=thread_name, daemon=True)
         self._thread.start()
@@ -261,14 +266,42 @@ class Batcher:
 
     def _dispatch(self, batch: List[_Request]):
         now = time.monotonic()
+        total = sum(r.n for r in batch)
+        self._batch_seq += 1
+        bid = self._batch_seq
         for r in batch:
             QUEUE_WAIT_SECONDS.observe(now - r.enqueue_t)
-        total = sum(r.n for r in batch)
+            # per-request queue-wait span: the router's p99 question
+            # ("did the time go to coalescing wait?") answered per trace
+            _tracing.record_trace_span(
+                "serve.queue_wait", r.tctx, now - r.enqueue_t,
+                cat="serve", rows=r.n, batch=bid)
         BATCH_ROWS.observe(total)
         feeds = {k: np.concatenate([r.feeds[k] for r in batch], axis=0)
                  for k in batch[0].feeds}
+        # the first sampled member's context becomes ambient for the
+        # engine dispatch, so engine/executor spans nest under ITS
+        # trace; every other sampled member gets a linking span carrying
+        # the same batch id (batch membership stays reconstructable)
+        lead = next((r.tctx for r in batch
+                     if r.tctx is not None and r.tctx.sampled), None)
+        t_run = time.monotonic()
         try:
-            outs = self._run(feeds)
+            with _tracing.trace_span("serve.batch", cat="serve",
+                                     ctx=lead, batch=bid, rows=total,
+                                     members=len(batch)):
+                outs = self._run(feeds)
+            run_dt = time.monotonic() - t_run
+            seen_lead = False
+            for r in batch:
+                if r.tctx is None or not r.tctx.sampled:
+                    continue
+                if not seen_lead and r.tctx is lead:
+                    seen_lead = True
+                    continue
+                _tracing.record_trace_span(
+                    "serve.batch", r.tctx, run_dt, cat="serve",
+                    batch=bid, rows=total, members=len(batch))
             # split per request; outputs that don't carry the batch dim
             # (scalars, per-class stats) are shared whole, not sliced
             def _split(v, flag, off, n):

@@ -58,6 +58,7 @@ from ..core.executor import _JitDispatch
 from ..observability import events as _events
 from ..observability import metrics as _m
 from ..observability import telemetry as _telemetry
+from ..observability import tracing as _tracing
 from .batcher import QueueFullError, ServerClosed
 from .kv_cache import (BlockAllocator, KVCacheConfig, NoBlocksError,
                        build_block_table, init_pools)
@@ -190,16 +191,20 @@ class _Request:
     __slots__ = ("rid", "prompt", "prompt_len0", "max_new", "generated",
                  "events", "t_submit", "t_first", "finish_reason",
                  "error", "cancelled", "last_token", "pos", "blocks",
-                 "admitted_at")
+                 "admitted_at", "tctx", "enqueued_at")
 
     def __init__(self, rid: int, prompt: np.ndarray, max_new: int):
         self.rid = rid
+        # captured on the submitter's thread; the scheduler thread
+        # records queue-wait/prefill/TTFT spans against it later
+        self.tctx = _tracing.current_trace()
         self.prompt = prompt                   # grows on preempt-replay
         self.prompt_len0 = len(prompt)         # original, for reporting
         self.max_new = int(max_new)
         self.generated: List[int] = []
         self.events: "queue.Queue" = queue.Queue()
         self.t_submit = time.monotonic()
+        self.enqueued_at = self.t_submit   # re-stamped on preempt requeue
         self.t_first: Optional[float] = None
         self.finish_reason: Optional[str] = None
         self.error: Optional[BaseException] = None
@@ -703,6 +708,10 @@ class DecodeEngine:
         if req.t_first is None:
             req.t_first = time.monotonic()
             TTFT_SECONDS.observe(req.t_first - req.t_submit)
+            # per-request TTFT span: submit -> first sampled token
+            _tracing.record_trace_span(
+                "decode.ttft", req.tctx, req.t_first - req.t_submit,
+                cat="decode", rid=req.rid, prompt_len=req.prompt_len0)
         req.events.put(int(tok))
 
     def _finished_reason(self, req: _Request) -> Optional[str]:
@@ -714,6 +723,18 @@ class DecodeEngine:
 
     def _finish(self, req: _Request, reason: str):
         req.finish_reason = reason
+        now = time.monotonic()
+        if req.t_first is not None and len(req.generated) > 1:
+            # decode-phase span: first token -> last token (the
+            # prefill/TTFT spans cover everything before it)
+            _tracing.record_trace_span(
+                "decode.decode", req.tctx, now - req.t_first,
+                cat="decode", rid=req.rid,
+                tokens=len(req.generated) - 1)
+        _tracing.record_trace_span(
+            "decode.generate", req.tctx, now - req.t_submit,
+            cat="decode", rid=req.rid, tokens=len(req.generated),
+            reason=reason)
         if req.blocks:
             self._alloc.free(req.blocks)
             req.blocks = []
@@ -784,6 +805,11 @@ class DecodeEngine:
         return changed
 
     def _prefill_one(self, req: _Request):
+        # the admission boundary: everything since (re-)enqueue was wait
+        _tracing.record_trace_span(
+            "decode.queue_wait", req.tctx,
+            time.monotonic() - req.enqueued_at, cat="decode",
+            rid=req.rid)
         plen = len(req.prompt)
         bucket = self._bucket_for_len(plen)
         if bucket is None:  # replay grew past the largest bucket
@@ -805,6 +831,10 @@ class DecodeEngine:
         self._pools = (kp, vp)
         tok0 = int(np.asarray(tok)[0])         # admission-boundary sync
         STEPS.inc(phase="prefill")
+        _tracing.record_trace_span(
+            "decode.prefill", req.tctx, time.perf_counter() - t0,
+            cat="decode", t0_perf=t0, rid=req.rid, bucket=int(bucket),
+            prompt_len=plen)
         _telemetry.record_dispatch_ready(
             "decode:prefill", time.perf_counter() - t0)
         req.pos = plen
@@ -854,13 +884,19 @@ class DecodeEngine:
         req.prompt = np.concatenate(
             [req.prompt[:req.prompt_len0],
              np.asarray(req.generated, np.int32)])
+        req.enqueued_at = time.monotonic()
         with self._cv:
             self._waiting.appendleft(req)
             QUEUE_DEPTH.set(len(self._waiting))
         PREEMPTIONS.inc()
         self._counts["preempted"] = self._counts.get("preempted", 0) + 1
+        extra = {"trace_id": req.tctx.trace_id} \
+            if req.tctx is not None and req.tctx.sampled else {}
         _events.emit("decode", action="preempt", rid=req.rid,
-                     generated=len(req.generated))
+                     generated=len(req.generated), **extra)
+        _tracing.record_trace_span(
+            "decode.preempt", req.tctx, 0.0, cat="decode", rid=req.rid,
+            generated=len(req.generated))
         self._kv_gauges()
 
     def _snapshot(self, C: int) -> Tuple[Tuple[int, ...],

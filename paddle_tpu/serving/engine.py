@@ -27,6 +27,7 @@ from ..core import precision as _precision
 from ..inference import AnalysisConfig, Predictor, create_paddle_predictor
 from ..observability import events as _events
 from ..observability import metrics as _m
+from ..observability import tracing as _tracing
 from .bucketing import BucketPolicy, common_batch
 
 __all__ = ["ServingConfig", "Engine", "WARMSTART_FORMAT"]
@@ -479,7 +480,12 @@ class Engine:
             raise ValueError("feeds must share a leading batch dim >= 1")
         bucket = self.policy.bucket_for(n) or n
         t0 = time.perf_counter()
-        out = self._pred.predict_handle(**feeds).result()
+        # no-op without a sampled ambient context (the batcher activates
+        # its lead request's trace around this call); when sampled, the
+        # device dispatch gets its own span with the bucket attributed
+        with _tracing.trace_span("serve.dispatch", cat="serve",
+                                 bucket=int(bucket), rows=int(n)):
+            out = self._pred.predict_handle(**feeds).result()
         BUCKET_SECONDS.observe(time.perf_counter() - t0,
                                bucket=str(bucket))
         BATCHES.inc(bucket=str(bucket))

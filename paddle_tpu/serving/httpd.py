@@ -59,6 +59,7 @@ import numpy as np
 
 from ..observability import events as _events
 from ..observability import httpbase as _base
+from ..observability import tracing as _tracing
 from ..observability.metrics import _json_safe
 from .decode import DecodeEngine
 from .batcher import (Batcher, EngineError, QueueFullError,
@@ -76,17 +77,25 @@ class _ServingHandler(_base.QuietHandler):
     protocol_version = "HTTP/1.1"
     serving: "Server" = None  # bound per-Server via a subclass
 
+    _tctx = None  # per-request TraceContext, set at the top of do_*
+
     def _json_reply(self, code: int, payload: Dict, headers=None):
         # strict-JSON discipline (same as metrics.dump): a model output
         # containing NaN/Inf must not make json.dumps emit bare NaN
         # tokens that RFC-8259 clients reject — non-finite floats become
         # strings ("nan"/"inf"/"-inf"), documented in SERVING.md
+        hdrs = dict(headers or {})
+        # every /v1/* reply carries the request id + traceparent so the
+        # caller (and the fleet router's logs) can join against the
+        # trace sink and the JSONL event log (SERVING.md §HTTP API)
+        hdrs.update(_tracing.response_headers(self._tctx))
         self._reply(code, "application/json",
                     json.dumps(_json_safe(payload)) + "\n",
-                    extra_headers=headers)
+                    extra_headers=hdrs)
 
     def do_GET(self):  # noqa: N802 - stdlib naming
         try:
+            self._tctx = _tracing.begin_request(self.headers)
             path = urlparse(self.path).path
             if path == "/v1/status":
                 self._json_reply(200, self.serving.status())
@@ -122,6 +131,14 @@ class _ServingHandler(_base.QuietHandler):
             self._json_reply(404, {"error": "no decode engine attached "
                                             "to this server"})
             return
+        # the request-root span: decode.submit below captures the child
+        # context, so queue-wait/prefill/TTFT spans recorded later by
+        # the scheduler thread land under this request's trace
+        with _tracing.trace_span("http.generate", cat="serve",
+                                 ctx=self._tctx):
+            self._generate_traced(payload, decode)
+
+    def _generate_traced(self, payload: Dict, decode):
         ids = payload.get("ids")
         if not isinstance(ids, (list, tuple)) or not ids:
             self._json_reply(400, {"error": 'missing/empty "ids" list'})
@@ -159,6 +176,8 @@ class _ServingHandler(_base.QuietHandler):
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
         self.send_header("Cache-Control", "no-cache")
+        for name, value in _tracing.response_headers(self._tctx).items():
+            self.send_header(name, value)
         self.end_headers()
         n = 0
         try:
@@ -195,6 +214,10 @@ class _ServingHandler(_base.QuietHandler):
 
     def do_POST(self):  # noqa: N802 - stdlib naming
         try:
+            # extract-or-start the request's trace context (W3C
+            # traceparent in, X-Request-Id/traceparent out); the active
+            # span threads through batcher/decode/engine spans
+            self._tctx = _tracing.begin_request(self.headers)
             path = urlparse(self.path).path
             if path not in ("/v1/predict", "/v1/generate"):
                 self._reply(404, "text/plain",
@@ -214,6 +237,14 @@ class _ServingHandler(_base.QuietHandler):
                     return
                 self._do_generate(payload)
                 return
+            with _tracing.trace_span("http.predict", cat="serve",
+                                     ctx=self._tctx):
+                self._do_predict(payload)
+        except _base.CLIENT_GONE:
+            pass
+
+    def _do_predict(self, payload):
+        try:
             feeds = payload.get("feeds") if isinstance(payload, dict) \
                 else None
             if not isinstance(feeds, dict) or not feeds:

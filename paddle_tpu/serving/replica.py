@@ -36,7 +36,16 @@ import threading
 def _build_args(argv=None):
     ap = argparse.ArgumentParser(
         prog="paddle_tpu.serving.replica", description=__doc__)
-    ap.add_argument("--model-dir", required=True)
+    ap.add_argument("--model-dir", default="",
+                    help="saved inference model for the predict path "
+                    "(optional when --decode-tiny builds a decode-only "
+                    "replica)")
+    ap.add_argument("--decode-tiny", type=int, default=None,
+                    metavar="SEED",
+                    help="attach a tiny-GPT continuous-batching decode "
+                    "engine initialized from this seed — the fleet "
+                    "bench / trace-gate shape of a token-serving "
+                    "replica (POST /v1/generate)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=0,
                     help="0 binds an ephemeral port (printed in the "
@@ -72,15 +81,34 @@ def main(argv=None) -> int:
     from .engine import ServingConfig
     from .httpd import Server
 
+    if not args.model_dir and args.decode_tiny is None:
+        print(json.dumps({"ready": False,
+                          "error": "need --model-dir and/or "
+                                   "--decode-tiny"}), flush=True)
+        return 2
+    decode = None
+    if args.decode_tiny is not None:
+        import jax
+
+        from ..models import gpt
+        from .decode import DecodeConfig, DecodeEngine
+
+        mcfg = gpt.GPTConfig.tiny()
+        mcfg.dtype = "float32"
+        params, _ = gpt.init(jax.random.key(int(args.decode_tiny)), mcfg)
+        decode = DecodeEngine(params, mcfg, DecodeConfig(
+            block_size=8, num_blocks=64, decode_slots=(4,),
+            prefill_buckets=(8, 16), precision="f32", max_len=64))
     buckets = tuple(int(b) for b in args.buckets.split(",")) \
         if args.buckets else None
     cfg = ServingConfig(
-        args.model_dir, buckets=buckets, max_batch=args.max_batch,
+        args.model_dir or None, buckets=buckets,
+        max_batch=args.max_batch,
         max_queue=args.max_queue, max_wait_ms=args.max_wait_ms,
         timeout_s=args.timeout_s, precision=args.precision,
         warmstart=args.warmstart or None, use_tpu=not args.cpu,
         host=args.host)
-    server = Server(cfg)
+    server = Server(cfg, decode=decode)
     port = server.start(args.port)
     endpoint = f"{args.host}:{port}"
 
@@ -119,6 +147,11 @@ def main(argv=None) -> int:
         rdzv.leave()
     server.drain(timeout=args.drain_timeout_s)
     server.stop()
+    # publish any buffered sampled spans before exit so the trace-dir
+    # reassembly (obsdump trace) sees this replica's half of the tree
+    from ..observability import tracing as _tracing
+
+    _tracing.flush_trace_sink()
     return 0
 
 

@@ -74,6 +74,7 @@ from urllib.parse import urlparse
 from ..observability import events as _events
 from ..observability import httpbase as _base
 from ..observability import metrics as _m
+from ..observability import tracing as _tracing
 from ..observability.metrics import _json_safe
 from ..resilience.retry import CircuitBreaker
 
@@ -439,6 +440,7 @@ class Router:
 
     @staticmethod
     def _get_json(endpoint: str, path: str, timeout: float):
+        # lint-exempt:traceheader: health/load probes are poll-loop work, not request-scoped
         req = urllib.request.Request(f"http://{endpoint}{path}")
         try:
             with urllib.request.urlopen(req, timeout=timeout) as r:
@@ -454,11 +456,14 @@ class Router:
     def _post(endpoint: str, path: str, payload: Dict, timeout: float):
         """POST JSON; returns (code, parsed-body). Wire-level failures
         (refused/reset/timeout) raise OSError/URLError for the caller's
-        retry classification."""
+        retry classification. The ambient trace context (the attempt
+        span _route_predict activates) is injected as `traceparent` so
+        the replica's spans join this request's trace."""
         body = json.dumps(_json_safe(payload)).encode()
         req = urllib.request.Request(
             f"http://{endpoint}{path}", data=body,
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json",
+                     **_tracing.trace_headers()})
         try:
             with urllib.request.urlopen(req, timeout=timeout) as r:
                 return r.status, json.loads(r.read())
@@ -503,6 +508,11 @@ class Router:
 
     def _route_predict(self, payload: Dict,
                        timeout_s: Optional[float]) -> Dict:
+        with _tracing.trace_span("router.predict", cat="fleet"):
+            return self._route_predict_traced(payload, timeout_s)
+
+    def _route_predict_traced(self, payload: Dict,
+                              timeout_s: Optional[float]) -> Dict:
         timeout = self.request_timeout_s if timeout_s is None \
             else float(timeout_s)
         t0 = time.monotonic()
@@ -514,9 +524,15 @@ class Router:
                 break
             try:
                 # wire budget slightly above the request deadline so the
-                # replica's own 504 wins the race when it can
-                code, body = self._post(rep.endpoint, "/v1/predict",
-                                        payload, timeout + 5.0)
+                # replica's own 504 wins the race when it can; the
+                # attempt span is what the replica's spans parent to —
+                # each failover attempt is its own child of
+                # router.predict, so retry time is attributed per try
+                with _tracing.trace_span("router.attempt", cat="fleet",
+                                         endpoint=rep.endpoint,
+                                         attempt=_attempt):
+                    code, body = self._post(rep.endpoint, "/v1/predict",
+                                            payload, timeout + 5.0)
             except (OSError, urllib.error.URLError, socket.timeout) as e:
                 # connect refused/reset/timeout: replica is gone or
                 # wedged — breaker failure, immediate ejection, failover
@@ -600,6 +616,11 @@ class Router:
         payload = {"ids": list(int(i) for i in ids),
                    "max_new_tokens": int(max_new_tokens),
                    "stream": True}
+        # captured ONCE: the generator body runs on the consumer's
+        # thread across yields, so the ambient contextvar must not be
+        # mutated here — per-attempt children are minted explicitly and
+        # handed to _stream_one for header injection
+        tctx = _tracing.current_trace()
         exclude: set = set()
         last: Tuple[str, str] = ("", "no replicas known")
         for _attempt in range(self.retries + 1):
@@ -607,11 +628,22 @@ class Router:
             if rep is None:
                 break
             delivered = 0
+            child = tctx.child() \
+                if tctx is not None and tctx.sampled else tctx
+            t0a = time.perf_counter()
             try:
-                for rec in self._stream_one(rep, payload, timeout):
-                    if "token" in rec:
-                        delivered += 1
-                    yield rec
+                try:
+                    for rec in self._stream_one(rep, payload, timeout,
+                                                tctx=child):
+                        if "token" in rec:
+                            delivered += 1
+                        yield rec
+                finally:
+                    _tracing.record_span_ctx(
+                        child, "router.generate", time.perf_counter() -
+                        t0a, cat="fleet", t0_perf=t0a,
+                        endpoint=rep.endpoint, attempt=_attempt,
+                        tokens=delivered)
                 rep.breaker.record_success()
                 self._release(rep)
                 self._finish("ok")
@@ -677,11 +709,12 @@ class Router:
             f"no replica could serve the generation; last {ep}: {why}")
 
     def _stream_one(self, rep: _Replica, payload: Dict,
-                    timeout: float) -> Iterator[Dict]:
+                    timeout: float, tctx=None) -> Iterator[Dict]:
         body = json.dumps(payload).encode()
         req = urllib.request.Request(
             f"http://{rep.endpoint}/v1/generate", data=body,
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json",
+                     **_tracing.trace_headers(tctx)})
         try:
             resp = urllib.request.urlopen(req, timeout=timeout)
         except urllib.error.HTTPError as e:
@@ -796,14 +829,18 @@ class _RouterHandler(_base.QuietHandler):
     server_version = "paddle-tpu-fleet-router"
     protocol_version = "HTTP/1.1"
     router_server: "RouterServer" = None  # bound per-server subclass
+    _tctx = None  # per-request TraceContext, set at the top of do_*
 
     def _json_reply(self, code: int, payload: Dict, headers=None):
+        hdrs = dict(headers or {})
+        hdrs.update(_tracing.response_headers(self._tctx))
         self._reply(code, "application/json",
                     json.dumps(_json_safe(payload)) + "\n",
-                    extra_headers=headers)
+                    extra_headers=hdrs)
 
     def do_GET(self):  # noqa: N802 - stdlib naming
         try:
+            self._tctx = _tracing.begin_request(self.headers)
             path = urlparse(self.path).path
             router = self.router_server.router
             if path == "/v1/status":
@@ -830,6 +867,14 @@ class _RouterHandler(_base.QuietHandler):
         self.wfile.flush()
 
     def _do_generate(self, payload: Dict):
+        # the trace ROOT at the fleet edge (or a child of the caller's
+        # context): router.generate children mint per-attempt spans and
+        # inject traceparent into the upstream replica call
+        with _tracing.trace_span("router.http_generate", cat="fleet",
+                                 ctx=self._tctx):
+            self._do_generate_traced(payload)
+
+    def _do_generate_traced(self, payload: Dict):
         router = self.router_server.router
         ids = payload.get("ids")
         if not isinstance(ids, (list, tuple)) or not ids:
@@ -900,6 +945,8 @@ class _RouterHandler(_base.QuietHandler):
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
         self.send_header("Cache-Control", "no-cache")
+        for name, value in _tracing.response_headers(self._tctx).items():
+            self.send_header(name, value)
         self.end_headers()
         try:
             self._chunk(json.dumps(_json_safe(first)) + "\n")
@@ -928,6 +975,10 @@ class _RouterHandler(_base.QuietHandler):
 
     def do_POST(self):  # noqa: N802 - stdlib naming
         try:
+            # trace root at the fleet edge: extract the caller's
+            # traceparent or start (head-sample) a fresh trace; every
+            # reply echoes X-Request-Id + traceparent
+            self._tctx = _tracing.begin_request(self.headers)
             path = urlparse(self.path).path
             if path not in ("/v1/predict", "/v1/generate"):
                 self._reply(404, "text/plain",
@@ -954,8 +1005,9 @@ class _RouterHandler(_base.QuietHandler):
                 return
             router = self.router_server.router
             try:
-                body = router._route_predict(payload,
-                                             payload.get("timeout_s"))
+                with _tracing.activate(self._tctx):
+                    body = router._route_predict(
+                        payload, payload.get("timeout_s"))
             except (NoReplicasError, ReplicaRejected) as e:
                 self._json_reply(503, {"error": str(e)},
                                  headers={"Retry-After": "1"})

@@ -1,0 +1,598 @@
+"""Distributed request tracing (ISSUE 15): W3C trace-context units,
+head sampling, the per-process JSONL sink, batcher/decode/PS span
+propagation, cross-process reassembly through the router + obsdump, the
+event-log rotation satellite, and the span-ring drop counter.
+
+The span ring and event ring are process-global — cleared per test; the
+sink is keyed on PADDLE_TPU_TRACE_DIR, so per-test tmp dirs isolate it.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import events as oe
+from paddle_tpu.observability import tracing as t
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_TRACE_SAMPLE", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_TRACE_DIR", raising=False)
+    t.clear_spans()
+    oe.clear()
+    yield
+    t.flush_trace_sink()
+    t.clear_spans()
+    oe.clear()
+
+
+def _sampled():
+    return t.TraceContext(t._new_trace_id(), t._new_span_id(),
+                          None, True)
+
+
+# ---------------------------------------------------------------------------
+# context units
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    ctx = _sampled()
+    h = ctx.header()
+    assert h == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+    back = t.parse_traceparent(h)
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled is True
+    un = t.TraceContext(ctx.trace_id, ctx.span_id, None, False)
+    assert t.parse_traceparent(un.header()).sampled is False
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "00-short-abc-01",
+    "00-" + "g" * 32 + "-" + "1" * 16 + "-01",     # non-hex
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",     # all-zero trace id
+    "00-" + "1" * 32 + "-" + "0" * 16 + "-01",     # all-zero span id
+    "00-" + "1" * 32 + "-" + "1" * 16,             # missing flags
+])
+def test_parse_traceparent_rejects_malformed(bad):
+    assert t.parse_traceparent(bad) is None
+
+
+def test_child_keeps_trace_sets_parent():
+    ctx = _sampled()
+    c = ctx.child()
+    assert c.trace_id == ctx.trace_id
+    assert c.parent_span_id == ctx.span_id
+    assert c.span_id != ctx.span_id
+    assert c.sampled is True
+
+
+def test_sample_rate_env(monkeypatch):
+    assert t.sample_rate() == 0.0
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "0.25")
+    assert t.sample_rate() == 0.25
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "7")
+    assert t.sample_rate() == 1.0          # clamped
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "nope")
+    assert t.sample_rate() == 0.0          # malformed = off
+
+
+def test_sampling_rate_honored(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "0")
+    assert not any(t.start_trace().sampled for _ in range(50))
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "1.0")
+    assert all(t.start_trace().sampled for _ in range(50))
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "0.5")
+    t._sample_rng.seed(7)
+    draws = [t.start_trace().sampled for _ in range(200)]
+    assert 40 < sum(draws) < 160   # head sampling actually mixes
+
+
+def test_begin_request_extract_or_start(monkeypatch):
+    ctx = _sampled()
+    got = t.begin_request({"traceparent": ctx.header()})
+    assert (got.trace_id, got.span_id, got.sampled) == \
+        (ctx.trace_id, ctx.span_id, True)
+    # absent/invalid header -> fresh root, sampled by env rate
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "1.0")
+    fresh = t.begin_request({})
+    assert fresh.trace_id != ctx.trace_id and fresh.sampled
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "0")
+    assert not t.begin_request({"traceparent": "junk"}).sampled
+
+
+def test_response_and_propagation_headers():
+    ctx = _sampled()
+    rh = t.response_headers(ctx)
+    assert rh["X-Request-Id"] == ctx.trace_id
+    assert rh["traceparent"] == ctx.header()
+    assert t.response_headers(None) == {}
+    assert t.trace_headers() == {}          # no ambient context
+    with t.activate(ctx):
+        assert t.trace_headers() == {"traceparent": ctx.header()}
+    # unsampled contexts still propagate (the head's decision rides)
+    un = t.TraceContext(ctx.trace_id, ctx.span_id, None, False)
+    assert t.trace_headers(un)["traceparent"].endswith("-00")
+
+
+# ---------------------------------------------------------------------------
+# spans: ring tagging, sink persistence, zero overhead
+# ---------------------------------------------------------------------------
+
+
+def test_trace_span_nesting_ring_and_sink(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    ctx = _sampled()
+    with t.activate(ctx):
+        with t.trace_span("outer", cat="x", k=1) as outer:
+            with t.span("inner"):          # plain span() joins the trace
+                pass
+        assert outer.trace_id == ctx.trace_id
+    # ring spans carry the ids in args
+    by_name = {s.name: s for s in t.get_spans()}
+    assert by_name["outer"].args["trace_id"] == ctx.trace_id
+    assert by_name["inner"].args["parent_span_id"] == \
+        by_name["outer"].args["span_id"]
+    # sink reassembles the same edge
+    t.flush_trace_sink()
+    recs = t.read_trace_dir(str(tmp_path))
+    tree = t.build_trace_tree(recs, ctx.trace_id)
+    assert len(tree) == 1 and tree[0]["name"] == "outer"
+    assert [c["name"] for c in tree[0]["children"]] == ["inner"]
+    # summaries + chrome conversion stay stdlib-consumable
+    rows = t.trace_summaries(recs)
+    assert rows[0]["trace_id"] == ctx.trace_id and rows[0]["spans"] == 2
+    evs = t.trace_records_to_chrome(recs)
+    assert all(e["ph"] == "X" and "trace_id" in e["args"] for e in evs)
+
+
+def test_sink_segments_roll_and_reassemble(tmp_path, monkeypatch):
+    """Past _SINK_SEGMENT_SPANS the sink seals the segment and starts a
+    fresh file — the per-flush rewrite stays bounded for long-lived
+    sampled processes, and read_trace_dir stitches every segment."""
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    monkeypatch.setattr(t, "_SINK_SEGMENT_SPANS", 20)
+    ctx = _sampled()
+    for i in range(65):
+        t.record_span_ctx(ctx.child(), f"s{i}", 0.001, i=i)
+    t.flush_trace_sink()
+    segments = [p for p in os.listdir(str(tmp_path))
+                if p.startswith("trace-")]
+    assert len(segments) >= 3                  # 65 spans / 20-span cap
+    recs = t.read_trace_dir(str(tmp_path))
+    assert len(recs) == 65                     # nothing lost across rolls
+    assert {r["args"]["i"] for r in recs} == set(range(65))
+
+
+def test_flush_failure_keeps_spans_buffered(tmp_path, monkeypatch):
+    """A failed write must NOT advance the flushed watermark — the next
+    (atexit) flush still publishes the tail spans."""
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    state = {"fail": True, "n": 0}
+    real = t._sink_write
+
+    def flaky(path, lines):
+        state["n"] += 1
+        return False if state["fail"] else real(path, lines)
+
+    monkeypatch.setattr(t, "_sink_write", flaky)
+    ctx = _sampled()
+    t.record_span_ctx(ctx.child(), "early", 0.001)
+    t.flush_trace_sink()                       # fails: nothing marked
+    assert state["n"] >= 1
+    assert t.read_trace_dir(str(tmp_path)) == []
+    state["fail"] = False
+    t.flush_trace_sink()                       # retry publishes it
+    assert [r["name"] for r in t.read_trace_dir(str(tmp_path))] == \
+        ["early"]
+
+
+def test_unsampled_request_zero_span_overhead(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    n0 = len(t.get_spans())
+    un = t.begin_request({})               # rate 0 -> unsampled
+    assert not un.sampled
+    with t.activate(un):
+        with t.trace_span("quiet"):
+            pass
+        t.record_trace_span("also_quiet", un, 0.1)
+    t.flush_trace_sink()
+    assert len(t.get_spans()) == n0
+    assert t.read_trace_dir(str(tmp_path)) == []
+
+
+def test_step_span_starts_root_when_armed(monkeypatch):
+    # unarmed: a plain step span, no trace ids
+    with t.step_span("exec.step", cat="step"):
+        assert t.current_trace() is None
+    assert "trace_id" not in (t.get_spans()[-1].args or {})
+    # armed: step_span is the training path's trace origin
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "1.0")
+    with t.step_span("exec.step", cat="step"):
+        active = t.current_trace()
+        assert active is not None and active.sampled
+    assert t.get_spans()[-1].args["trace_id"] == active.trace_id
+    assert t.current_trace() is None       # root reset on exit
+
+
+# ---------------------------------------------------------------------------
+# batcher: queue-wait + batch-membership spans
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_queue_wait_and_batch_spans():
+    from paddle_tpu.serving import Batcher, BucketPolicy
+
+    calls = []
+
+    def run_batch(feeds):
+        calls.append(next(iter(feeds.values())).shape[0])
+        return {"y": next(iter(feeds.values())) * 2.0}
+
+    b = Batcher(run_batch, BucketPolicy(max_batch=8), max_wait_ms=60,
+                timeout_s=10)
+    try:
+        ctxs = [_sampled(), _sampled()]
+        results = {}
+
+        def go(i):
+            with t.activate(ctxs[i]):
+                results[i] = b.submit(
+                    {"x": np.ones((2, 3), np.float32)}, timeout_s=10)
+
+        ths = [threading.Thread(target=go, args=(i,), daemon=True)
+               for i in range(2)]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join(20)
+        assert all(isinstance(results[i], dict) for i in range(2))
+        for ctx in ctxs:
+            mine = [s for s in t.get_spans()
+                    if (s.args or {}).get("trace_id") == ctx.trace_id]
+            names = {s.name for s in mine}
+            assert "serve.queue_wait" in names, names
+            assert "serve.batch" in names, names
+        # coalesced members share one linking batch id
+        bids = {(s.args or {}).get("batch")
+                for s in t.get_spans() if s.name == "serve.batch"}
+        if len(calls) == 1:                # both rode one dispatch
+            assert len(bids) == 1
+    finally:
+        b.stop()
+
+
+def test_batcher_unsampled_records_nothing():
+    from paddle_tpu.serving import Batcher, BucketPolicy
+
+    b = Batcher(lambda feeds: {"y": next(iter(feeds.values()))},
+                BucketPolicy(max_batch=8), max_wait_ms=1, timeout_s=10)
+    try:
+        n0 = len(t.get_spans())
+        b.submit({"x": np.ones((1, 2), np.float32)}, timeout_s=10)
+        assert len(t.get_spans()) == n0
+    finally:
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# PS tier: envelope propagation roundtrip
+# ---------------------------------------------------------------------------
+
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_ps_envelope_roundtrip(tmp_path, monkeypatch):
+    from paddle_tpu.ps.client import PSClient
+    from paddle_tpu.ps.server import ParameterServer
+
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    ep = f"127.0.0.1:{_free_port()}"
+    srv = ParameterServer(ep, 1, mode="async")
+    srv.start_background()
+    cli = PSClient([ep])
+    try:
+        # untraced call: no envelope field, no spans
+        cli.init_var("w0", np.zeros(2, np.float32))
+        assert not [s for s in t.get_spans() if s.name == "ps.rpc"]
+        ctx = _sampled()
+        with t.activate(ctx):
+            with t.trace_span("trainer.step", cat="step"):
+                cli.init_var("w", np.zeros(4, np.float32))
+                cli.pull("w")
+        t.flush_trace_sink()
+        recs = [r for r in t.read_trace_dir(str(tmp_path))
+                if r["trace_id"] == ctx.trace_id]
+        names = sorted(r["name"] for r in recs)
+        assert names.count("ps.rpc") == 2
+        assert "ps.server.init_var" in names and "ps.server.get" in names
+        # every server-side span is a child of a client ps.rpc span
+        rpc_ids = {r["span_id"] for r in recs if r["name"] == "ps.rpc"}
+        for r in recs:
+            if r["name"].startswith("ps.server."):
+                assert r["parent_span_id"] in rpc_ids
+        tree = t.build_trace_tree(recs, ctx.trace_id)
+        assert len(tree) == 1 and tree[0]["name"] == "trainer.step"
+    finally:
+        cli.close()
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# events: trace_id tagging + size-capped rotation
+# ---------------------------------------------------------------------------
+
+
+def test_events_gain_trace_id_when_sampled():
+    ctx = _sampled()
+    with t.activate(ctx):
+        ev = oe.emit("decode", action="unit_test")
+    assert ev["trace_id"] == ctx.trace_id
+    un = t.TraceContext(ctx.trace_id, ctx.span_id, None, False)
+    with t.activate(un):
+        ev = oe.emit("decode", action="unit_test")
+    assert "trace_id" not in ev
+    assert "trace_id" not in oe.emit("decode", action="unit_test")
+
+
+def test_event_log_rotation(tmp_path, monkeypatch):
+    log = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv("PADDLE_TPU_EVENT_LOG", log)
+    monkeypatch.setenv("PADDLE_TPU_EVENT_LOG_MAX_BYTES", "600")
+    monkeypatch.setenv("PADDLE_TPU_EVENT_LOG_KEEP", "2")
+    pad = "x" * 100
+    for i in range(30):
+        oe.emit("step_summary", i=i, pad=pad)
+    assert os.path.exists(log)
+    assert os.path.getsize(log) <= 600
+    assert os.path.exists(log + ".1")
+    assert os.path.exists(log + ".2")
+    assert not os.path.exists(log + ".3")      # keep-N enforced
+    # every surviving line is whole JSON; the newest event is in the
+    # live file (rotation shifts older events outward)
+    evs = oe.read_jsonl(log)
+    assert evs and evs[-1]["i"] == 29
+    rotated = oe.read_jsonl(log + ".1")
+    assert rotated and rotated[-1]["i"] < 29
+
+
+def test_event_rotation_off_by_default(tmp_path, monkeypatch):
+    log = str(tmp_path / "events.jsonl")
+    monkeypatch.setenv("PADDLE_TPU_EVENT_LOG", log)
+    for i in range(50):
+        oe.emit("step_summary", i=i, pad="y" * 100)
+    assert not os.path.exists(log + ".1")
+    assert len(oe.read_jsonl(log)) == 50
+
+
+def test_obsdump_follow_survives_rotation(tmp_path):
+    import obsdump
+
+    path = str(tmp_path / "ev.jsonl")
+    with open(path, "w") as f:  # atomic-exempt: test fixture
+        f.write('{"seq": 1}\n')
+    f = open(path)
+    assert f.read() == '{"seq": 1}\n'
+    assert obsdump._rotated_handle(f, path) is None   # nothing rotated
+    os.replace(path, path + ".1")
+    with open(path, "w") as g:  # atomic-exempt: test fixture
+        g.write('{"seq": 2}\n')
+    nf = obsdump._rotated_handle(f, path)
+    assert nf is not None
+    assert json.loads(nf.readline())["seq"] == 2      # fresh file, start
+    nf.close()
+
+
+# ---------------------------------------------------------------------------
+# span-ring drop visibility
+# ---------------------------------------------------------------------------
+
+
+def test_spans_dropped_counter_and_export_warning(tmp_path, monkeypatch,
+                                                  caplog):
+    from paddle_tpu.observability import metrics as m
+
+    monkeypatch.setattr(t, "MAX_SPANS", 10)
+    monkeypatch.setattr(t, "_warned_dropped", [False])
+    for i in range(30):
+        t.record_span(f"s{i}", 0.0, 0.001)
+    assert t.dropped_spans() == 20
+    snap = m.snapshot()    # collect hook syncs the counter
+    series = snap["paddle_tpu_spans_dropped_total"]["series"]
+    assert series and series[0]["value"] >= 20
+    import logging
+
+    with caplog.at_level(logging.WARNING,
+                         logger="paddle_tpu.observability"):
+        t.export_trace(str(tmp_path / "a.json"))
+        t.export_trace(str(tmp_path / "b.json"))
+    hits = [r for r in caplog.records if "dropped" in r.getMessage()]
+    assert len(hits) == 1                  # warn ONCE per process
+
+
+# ---------------------------------------------------------------------------
+# traceheader lint pass
+# ---------------------------------------------------------------------------
+
+
+def test_traceheader_lint_fires_and_exempts(tmp_path):
+    from lint import lint_paths
+
+    d = tmp_path / "paddle_tpu" / "serving"
+    d.mkdir(parents=True)
+    (d / "bad.py").write_text(
+        "import urllib.request\n"
+        "class H:\n"
+        "    def do_POST(self):\n"
+        "        self._go()\n"
+        "    def _go(self):\n"
+        "        return urllib.request.Request('http://x',\n"
+        "                                      headers={'a': 'b'})\n")
+    findings = lint_paths(paths=[str(tmp_path)], passes=["traceheader"])
+    msgs = [f.message for f in findings]
+    assert len(findings) == 2
+    assert any("begin_request" in m for m in msgs)
+    assert any("trace propagation" in m for m in msgs)
+    (d / "good.py").write_text(
+        "import urllib.request\n"
+        "from paddle_tpu.observability import tracing\n"
+        "class G:\n"
+        "    def do_POST(self):\n"
+        "        self._tctx = tracing.begin_request(self.headers)\n"
+        "        urllib.request.Request(\n"
+        "            'http://x', headers={**tracing.trace_headers()})\n"
+        "class E:\n"
+        "    def do_POST(self):  # lint-exempt:traceheader: fixture\n"
+        "        pass\n"
+        "def probe():\n"
+        "    # lint-exempt:traceheader: health probe fixture\n"
+        "    return urllib.request.Request('http://x/healthz')\n")
+    clean = lint_paths(paths=[str(d / "good.py")],
+                       passes=["traceheader"])
+    assert clean == []
+    # handlers outside paddle_tpu/serving/ are out of scope
+    other = tmp_path / "elsewhere.py"
+    other.write_text("class H:\n    def do_POST(self):\n        pass\n")
+    assert lint_paths(paths=[str(other)], passes=["traceheader"]) == []
+
+
+# ---------------------------------------------------------------------------
+# decode engine spans + the HTTP e2e tree through the router
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gpt_model():
+    import jax
+
+    from paddle_tpu.models import gpt
+
+    cfg = gpt.GPTConfig.tiny()
+    cfg.dtype = "float32"
+    params, _ = gpt.init(jax.random.key(0), cfg)
+    return params, cfg
+
+
+def _decode_engine(gpt_model):
+    from paddle_tpu.serving.decode import DecodeConfig, DecodeEngine
+
+    params, cfg = gpt_model
+    return DecodeEngine(params, cfg, DecodeConfig(
+        block_size=8, num_blocks=64, decode_slots=(4,),
+        prefill_buckets=(8,), precision="f32", max_len=64))
+
+
+def test_decode_request_spans(gpt_model, tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    eng = _decode_engine(gpt_model)
+    try:
+        ctx = _sampled()
+        with t.activate(ctx):
+            handle = eng.submit([1, 2, 3], max_new_tokens=3)
+        toks = handle.result(timeout_s=60)
+        assert len(toks) >= 1
+        deadline = time.time() + 10
+        needed = {"decode.queue_wait", "decode.prefill", "decode.ttft",
+                  "decode.generate"}
+        while time.time() < deadline:
+            mine = {s.name for s in t.get_spans()
+                    if (s.args or {}).get("trace_id") == ctx.trace_id}
+            if needed <= mine:
+                break
+            time.sleep(0.05)
+        assert needed <= mine, mine
+        # TTFT span duration matches the handle's reported TTFT
+        ttft = [s for s in t.get_spans() if s.name == "decode.ttft"
+                and (s.args or {}).get("trace_id") == ctx.trace_id][0]
+        assert abs(ttft.dur - handle.info["ttft_s"]) < 0.5
+    finally:
+        eng.stop()
+
+
+def test_http_e2e_router_tree_and_obsdump(gpt_model, tmp_path,
+                                          monkeypatch, capsys):
+    import obsdump
+
+    from paddle_tpu.serving.engine import ServingConfig
+    from paddle_tpu.serving.httpd import Server
+    from paddle_tpu.serving.router import Router, RouterServer
+
+    monkeypatch.setenv("PADDLE_TPU_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv("PADDLE_TPU_TRACE_SAMPLE", "1.0")
+    eng = _decode_engine(gpt_model)
+    srv = Server(ServingConfig(None, warmup=False), decode=eng)
+    front = None
+    try:
+        port = srv.start(0)
+        router = Router([f"127.0.0.1:{port}"], poll_interval_s=0.1)
+        front = RouterServer(router)
+        fport = front.start(0)
+        body = json.dumps({"ids": [1, 2, 3], "max_new_tokens": 3,
+                           "stream": False}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{fport}/v1/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            tid = r.headers["X-Request-Id"]
+            tp = r.headers["traceparent"]
+            out = json.loads(r.read())
+        assert out["tokens"] and tid and tp.endswith("-01")
+        assert t.parse_traceparent(tp).trace_id == tid
+        # the replica handler records its span just after the client's
+        # read returns — settle, then reassemble
+        needed = {"router.http_generate", "router.generate",
+                  "http.generate", "decode.queue_wait",
+                  "decode.prefill", "decode.ttft", "decode.generate"}
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            t.flush_trace_sink()
+            recs = t.read_trace_dir(str(tmp_path))
+            names = {r["name"] for r in recs if r["trace_id"] == tid}
+            if needed <= names:
+                break
+            time.sleep(0.1)
+        assert needed <= names, names
+        tree = t.build_trace_tree(recs, tid)
+        assert len(tree) == 1, [n["name"] for n in tree]
+        assert tree[0]["name"] == "router.http_generate"
+        # the obsdump CLI renders the same tree and lists the trace
+        assert obsdump.main(["trace", str(tmp_path),
+                             "--trace-id", tid]) == 0
+        out1 = capsys.readouterr().out
+        assert "decode.ttft" in out1 and "http.generate" in out1
+        assert obsdump.main(["trace", str(tmp_path),
+                             "--list-traces"]) == 0
+        assert tid in capsys.readouterr().out
+        chrome = str(tmp_path / "one.json")
+        assert obsdump.main(["trace", str(tmp_path), "--trace-id", tid,
+                             "--chrome", "-o", chrome]) == 0
+        capsys.readouterr()
+        evs = json.load(open(chrome))["traceEvents"]
+        assert evs and all(e["args"]["trace_id"] == tid for e in evs)
+        # unknown trace id is a loud nonzero, not an empty success
+        assert obsdump.main(["trace", str(tmp_path),
+                             "--trace-id", "f" * 32]) == 1
+        capsys.readouterr()
+    finally:
+        if front is not None:
+            front.stop()
+        srv.stop()

@@ -890,3 +890,12 @@ def test_serve_bench_fleet_smoke():
     d = metrics["fleet_scaleout_p99_recovered"]["detail"]
     assert d["scale_outs"] >= 1 and d["warmstart_adopted"] > 0
     assert metrics["fleet_scalein_dropped_requests"]["value"] == 0
+    # gate 4 (ISSUE 15): one sampled generate reassembles to a single
+    # cross-process tree with queue-wait/phase/TTFT attributed, and the
+    # tracing-on p50 stays inside the overhead bar
+    assert metrics["fleet_trace_reconstructed"]["value"] == 1
+    d = metrics["fleet_trace_reconstructed"]["detail"]
+    assert d["generate_processes"] >= 2 and d["generate_roots"] == 1
+    assert "decode.ttft" in d["generate_spans"]
+    assert "serve.queue_wait" in d["predict_spans"]
+    assert metrics["fleet_trace_overhead_p50"]["detail"]["gate_ok"]

@@ -47,6 +47,17 @@ Passes:
               (directly or through a local alias; str.join/os.path.join
               never count): shutdown returns while the worker still
               runs, the PR 3/11 review class this pass automates.
+  traceheader — distributed-tracing propagation in paddle_tpu/serving/
+              (PROFILE.md §Distributed tracing): (a) every `do_POST`
+              HTTP handler method must enter the trace context via
+              `tracing.begin_request` (in its own body or a self-method
+              it calls, one level deep) — a handler that forwards work
+              downstream without it silently breaks every trace at
+              that hop; (b) every `urllib.request.Request(...)` built
+              in serving code must inject the context (a `headers=`
+              expression mentioning `trace_headers`/`traceparent`).
+              Poll-loop probes and other deliberately request-unscoped
+              calls escape with '# lint-exempt:traceheader: <why>'.
 
 Usage:
   lint.py [paths...] [--json] [--pass NAME] [--list]
@@ -530,6 +541,88 @@ def _stopjoin_pass(f: _File) -> List[LintFinding]:
                 f"in {', '.join(m.name + '()' for m in stoppers)}, or "
                 f"add '# lint-exempt:stopjoin: <why>')",
                 f.line(lineno)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# traceheader: serving HTTP hops must propagate the trace context
+# ---------------------------------------------------------------------------
+
+# the canonical entry/injection helper names (observability/tracing.py);
+# mentioning `traceparent` directly (manual header plumbing) also counts
+_TRACE_ENTRY = "begin_request"
+_TRACE_INJECT = ("trace_headers", "traceparent")
+
+
+def _self_called_names(method) -> set:
+    """Names of `self.X(...)` calls made inside `method` (one level of
+    indirection for the entry-helper search)."""
+    out = set()
+    for node in ast.walk(method):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            out.add(node.func.attr)
+    return out
+
+
+@lint_pass("traceheader")
+def _traceheader_pass(f: _File) -> List[LintFinding]:
+    rel = f.rel.replace(os.sep, "/")
+    if "paddle_tpu/serving/" not in rel:
+        return []
+    out = []
+    # (a) do_POST handlers must extract-or-start the trace context
+    for cls in (n for n in ast.walk(f.tree)
+                if isinstance(n, ast.ClassDef)):
+        methods = {m.name: m for m in cls.body
+                   if isinstance(m, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+        handler = methods.get("do_POST")
+        if handler is None:
+            continue
+        sources = [ast.get_source_segment(f.src, handler) or ""]
+        for name in _self_called_names(handler):
+            m = methods.get(name)
+            if m is not None:
+                sources.append(ast.get_source_segment(f.src, m) or "")
+        if any(_TRACE_ENTRY in s for s in sources):
+            continue
+        if f.exempt(handler.lineno, "traceheader"):
+            continue
+        out.append(LintFinding(
+            f.rel, handler.lineno, "traceheader",
+            f"HTTP handler {cls.name}.do_POST never calls "
+            f"tracing.{_TRACE_ENTRY} — requests through this hop lose "
+            f"their trace context (extract-or-start it, or add "
+            f"'# lint-exempt:traceheader: <why>')",
+            f.line(handler.lineno)))
+    # (b) downstream urllib requests must inject the context
+    for node in ast.walk(f.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name.split(".")[-1] != "Request" \
+                or "urllib" not in name and name != "Request":
+            continue
+        hdr_src = ""
+        for kw in node.keywords:
+            if kw.arg == "headers":
+                try:
+                    hdr_src = ast.unparse(kw.value)
+                except Exception:
+                    hdr_src = ""
+        if any(tok in hdr_src for tok in _TRACE_INJECT):
+            continue
+        if f.exempt(node.lineno, "traceheader"):
+            continue
+        out.append(LintFinding(
+            f.rel, node.lineno, "traceheader",
+            "urllib Request built without trace propagation — pass "
+            "headers={..., **tracing.trace_headers()} (or justify with "
+            "'# lint-exempt:traceheader: <why>')",
+            f.line(node.lineno)))
     return out
 
 

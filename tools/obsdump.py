@@ -15,6 +15,17 @@ Usage:
   obsdump.py trace RUN_DIR -o out.json      # merge spans.json + jax
                                             # *.trace.json(.gz) under
                                             # RUN_DIR into ONE chrome trace
+  obsdump.py trace TRACE_DIR --list-traces  # distributed traces found
+                                            # in a PADDLE_TPU_TRACE_DIR
+                                            # (per-process trace-*.jsonl
+                                            # sinks), newest first
+  obsdump.py trace TRACE_DIR --trace-id ID  # reassemble ONE request's
+                                            # cross-process span TREE
+                                            # (router + N replicas + PS
+                                            # servers) as an indented
+                                            # table; --chrome -o out.json
+                                            # writes it as a merged
+                                            # chrome trace instead
   obsdump.py events EVENTS.jsonl            # tail the JSONL event log
                                             # (-n N, --kind K, --json,
                                             # --follow)
@@ -128,11 +139,75 @@ def cmd_snapshot(args) -> int:
     return 0
 
 
+def _print_trace_tree(tracing, records, trace_id):
+    """Indented cross-process tree: name, duration, pid, cat, args."""
+    roots = tracing.build_trace_tree(records, trace_id)
+    if not roots:
+        return False
+    import datetime
+
+    t0 = min(r.get("ts", 0.0) for r in records
+             if r.get("trace_id") == trace_id)
+    print(f"trace {trace_id}  start "
+          f"{datetime.datetime.fromtimestamp(t0).isoformat(timespec='milliseconds')}"
+          f"  ({len([r for r in records if r.get('trace_id') == trace_id])}"
+          f" spans, {len(roots)} root(s))")
+
+    def walk(node, depth):
+        args = {k: v for k, v in (node.get("args") or {}).items()}
+        detail = " ".join(f"{k}={v}" for k, v in sorted(args.items()))
+        off = (node.get("ts", 0.0) - t0) * 1000
+        print(f"  {'  ' * depth}{node['name']:<{max(1, 40 - 2 * depth)}}"
+              f" {node.get('dur', 0.0) * 1000:9.3f}ms"
+              f"  +{off:8.3f}ms  pid={node.get('pid', '?'):<7}"
+              f" [{node.get('cat', '?')}]"
+              + (f"  {detail}" if detail else ""))
+        for c in node["children"]:
+            walk(c, depth + 1)
+
+    for root in roots:
+        walk(root, 0)
+    return True
+
+
 def cmd_trace(args) -> int:
     if not os.path.isdir(args.run_dir):
         print(f"trace: not a directory: {args.run_dir}", file=sys.stderr)
         return 2
     tracing = _load_obs_module("tracing")
+    if args.list_traces or args.trace_id:
+        records = tracing.read_trace_dir(args.run_dir)
+        if not records:
+            print(f"trace: no trace-*.jsonl sinks under {args.run_dir} "
+                  f"(is PADDLE_TPU_TRACE_DIR / PADDLE_TPU_TRACE_SAMPLE "
+                  f"set on the fleet?)", file=sys.stderr)
+            return 1
+        if args.list_traces:
+            import datetime
+
+            rows = tracing.trace_summaries(records)
+            for r in rows:
+                r["start"] = datetime.datetime.fromtimestamp(
+                    r.pop("start_ts")).isoformat(timespec="milliseconds")
+            _print_aligned(rows, ("trace_id", "spans", "processes",
+                                  "root", "wall_ms", "start"))
+            return 0
+        mine = [r for r in records if r.get("trace_id") == args.trace_id]
+        if not mine:
+            print(f"trace: no spans for trace_id {args.trace_id} under "
+                  f"{args.run_dir}", file=sys.stderr)
+            return 1
+        if args.chrome:
+            trace = tracing.merge_chrome_traces(
+                [tracing.trace_records_to_chrome(mine)])
+            with open(args.output, "w") as f:
+                json.dump(trace, f)
+            print(f"wrote {args.output}: "
+                  f"{len(trace['traceEvents'])} events for trace "
+                  f"{args.trace_id}")
+            return 0
+        return 0 if _print_trace_tree(tracing, records,
+                                      args.trace_id) else 1
     lists = []
     spans_json = os.path.join(args.run_dir, "spans.json")
     if os.path.exists(spans_json):
@@ -169,10 +244,35 @@ def _fmt_event(ev) -> str:
            f"{ev.get('kind', '?'):<13} {detail}"
 
 
+def _rotated_handle(f, path):
+    """Rotation detector for --follow: when the sink was renamed away
+    (PADDLE_TPU_EVENT_LOG_MAX_BYTES rollover moved it to <path>.1) or
+    truncated, the open handle points at the OLD inode — its readline()
+    returns "" forever while fresh events land in a new file. Returns a
+    fresh handle (old one closed, reading the new file from the start)
+    or None when nothing rotated / the new file isn't there yet."""
+    try:
+        st = os.stat(path)
+        fst = os.fstat(f.fileno())
+    except OSError:
+        return None  # mid-rotation: the name will reappear next poll
+    if (st.st_ino, st.st_dev) == (fst.st_ino, fst.st_dev) \
+            and st.st_size >= f.tell():
+        return None
+    try:
+        nf = open(path)
+    except OSError:
+        return None
+    f.close()
+    return nf
+
+
 def cmd_events(args) -> int:
     """Tail/filter the observability JSONL event log (events.py emit
-    format). --follow polls for appended lines until interrupted; it is
-    OFF by default so scripted callers terminate."""
+    format). --follow polls for appended lines until interrupted (and
+    survives size-capped rotation: a renamed-away sink is detected by
+    inode and the fresh file picked up from its start); it is OFF by
+    default so scripted callers terminate."""
     if not os.path.isfile(args.path):
         print(f"events: no such file: {args.path}", file=sys.stderr)
         return 2
@@ -210,6 +310,13 @@ def cmd_events(args) -> int:
             while True:
                 chunk = f.readline()
                 if not chunk:
+                    # EOF: either idle, or the sink rotated underneath
+                    # us — finish the old inode first (we just did),
+                    # then hop onto the fresh file
+                    nf = _rotated_handle(f, args.path)
+                    if nf is not None:
+                        f, buf = nf, ""
+                        continue
                     _time.sleep(0.2)
                     continue
                 buf += chunk
@@ -222,6 +329,8 @@ def cmd_events(args) -> int:
                           flush=True)
         except KeyboardInterrupt:
             pass
+        finally:
+            f.close()  # may be the rotated-onto handle, not the with-target
     return 0
 
 
@@ -712,9 +821,20 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_snapshot)
 
     tp = sub.add_parser("trace", help="merge a run dir into one chrome "
-                        "trace")
+                        "trace, or reassemble a distributed trace tree "
+                        "from a PADDLE_TPU_TRACE_DIR")
     tp.add_argument("run_dir")
     tp.add_argument("-o", "--output", default="trace.json")
+    tp.add_argument("--trace-id", default=None,
+                    help="reassemble ONE trace's cross-process span "
+                    "tree from the dir's trace-*.jsonl sinks (the "
+                    "X-Request-Id response header is the trace id)")
+    tp.add_argument("--list-traces", action="store_true",
+                    help="list the distributed traces found in the "
+                    "dir's trace-*.jsonl sinks, newest first")
+    tp.add_argument("--chrome", action="store_true",
+                    help="with --trace-id: write the trace as a merged "
+                    "chrome trace to -o instead of printing the tree")
     tp.set_defaults(fn=cmd_trace)
 
     ep = sub.add_parser("events", help="tail/filter a JSONL event log")

@@ -42,6 +42,18 @@ criteria:
      newest replica WHILE a request burst is in flight. Gate: zero
      dropped requests (drain semantics: leave rendezvous, finish
      in-flight, 503+Retry-After stragglers fail over).
+  4. **distributed tracing** (ISSUE 15) — the fleet runs with
+     PADDLE_TPU_TRACE_DIR shared and sampling OFF for gates 1-3; gate
+     4 then (a) A/Bs a predict phase with PADDLE_TPU_TRACE_SAMPLE=0 vs
+     1.0 (gate: traced p50 <= 1.05x untraced, with a small absolute
+     floor for CPU-smoke noise), and (b) with sampling at 1.0, routes
+     one generate through a decode replica (--decode-tiny) and one
+     predict through the main fleet, then reassembles both traces from
+     the shared trace dir (the obsdump `trace --trace-id` machinery).
+     Gate: the generate trace is a SINGLE tree spanning router →
+     replica → decode with queue-wait, prefill-phase, and TTFT spans
+     attributed, crossing >= 2 processes; the predict trace carries
+     batcher queue-wait + batch spans under the router root.
 
 Run:  python tools/serve_bench.py [--rate 200] [--duration 10]
       [--max-batch 16] [--max-wait-ms 5] [--max-queue 128] [--batch 1]
@@ -640,6 +652,14 @@ def run_fleet_bench(args) -> int:
     os.makedirs(model_dir, exist_ok=True)
     probe = _save_model(model_dir)
 
+    # gate 4 (ISSUE 15): one shared trace dir for the whole fleet —
+    # replica subprocesses inherit it via the environment. Sampling
+    # stays OFF through gates 1-3 (the router is the trace head; with
+    # no traceparent inbound and rate 0, replicas never sample either).
+    trace_dir = os.path.join(tmpdir, "trace")
+    os.environ["PADDLE_TPU_TRACE_DIR"] = trace_dir
+    os.environ.pop("PADDLE_TPU_TRACE_SAMPLE", None)
+
     # bake the warmstart artifact every replica (incl. scale-outs)
     # boots from — scale-out must be seconds, not an XLA warmup
     art = os.path.join(tmpdir, "fleet.warmstart")
@@ -764,6 +784,124 @@ def run_fleet_bench(args) -> int:
         scalein_ok = (results["fail"] == 0 and results["ok"] == burst_n
                       and drained is not None)
 
+        # ---- gate 4: distributed tracing (ISSUE 15) -----------------
+        import signal as _signal
+        import subprocess
+
+        from paddle_tpu.observability import tracing as _tracing
+
+        def _one(url_, body_, extra_headers=None):
+            req = urllib.request.Request(
+                url_, data=body_,
+                headers={"Content-Type": "application/json",
+                         **(extra_headers or {})})
+            with urllib.request.urlopen(req,
+                                        timeout=args.timeout_s + 5) as r:
+                return dict(r.headers), json.loads(r.read())
+
+        ab_dur = min(args.duration, 2.0)
+        os.environ["PADDLE_TPU_TRACE_SAMPLE"] = "0"
+        rec_off = _fleet_phase(url, args.rate, ab_dur, body,
+                               args.timeout_s)
+        os.environ["PADDLE_TPU_TRACE_SAMPLE"] = "1.0"
+        # the traced predict whose tree gate 4 reassembles — fired
+        # BEFORE the sampled load phase, whose flood of sampled spans
+        # flushes every replica's sink past this request's records
+        pred_hdrs, _ = _one(url, body)
+        pred_tid = pred_hdrs.get("X-Request-Id")
+        rec_on = _fleet_phase(url, args.rate, ab_dur, body,
+                              args.timeout_s)
+        p50_off = _percentile([ms for (_, ms, oc) in rec_off
+                               if oc == "ok"], 50)
+        p50_on = _percentile([ms for (_, ms, oc) in rec_on
+                              if oc == "ok"], 50)
+        overhead = (p50_on / p50_off) if p50_off and p50_on else None
+        # the <5% acceptance bar, with a small absolute floor: at CPU
+        # smoke p50s of tens of ms, 5% is inside run-to-run noise
+        overhead_ok = overhead is not None and \
+            (overhead <= 1.05 or (p50_on - p50_off) <= 2.5)
+
+        # ...and one traced generate through a decode replica (a
+        # SEPARATE subprocess + router front, so the tree must cross
+        # process boundaries: router pid != replica pid)
+        gen_tid, gen_err = None, None
+        dec_front = None
+        proc = subprocess.Popen(
+            [sys.executable, "-u", "-m", "paddle_tpu.serving.replica",
+             "--decode-tiny", "0", "--cpu", "--port", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True)
+        try:
+            ready_line = {}
+
+            def _read_ready():
+                try:
+                    ready_line["v"] = json.loads(
+                        proc.stdout.readline() or "{}")
+                except ValueError:
+                    ready_line["v"] = {}
+
+            reader = threading.Thread(target=_read_ready, daemon=True)
+            reader.start()
+            reader.join(timeout=240.0)
+            ep = (ready_line.get("v") or {}).get("endpoint")
+            if not ep:
+                raise RuntimeError("decode replica never became ready")
+            from paddle_tpu.serving.router import Router as _Router
+            from paddle_tpu.serving.router import \
+                RouterServer as _RouterServer
+
+            dec_router = _Router([ep], poll_interval_s=0.1,
+                                 request_timeout_s=args.timeout_s)
+            dec_front = _RouterServer(dec_router)
+            dport = dec_front.start(0)
+            deadline = time.time() + 60
+            while not dec_router.healthy_endpoints():
+                if time.time() > deadline:
+                    raise RuntimeError("decode replica never healthy")
+                time.sleep(0.1)
+            gen_body = json.dumps({"ids": [3, 1, 4, 1, 5],
+                                   "max_new_tokens": 4,
+                                   "stream": False}).encode()
+            gen_hdrs, _ = _one(f"http://127.0.0.1:{dport}/v1/generate",
+                               gen_body)
+            gen_tid = gen_hdrs.get("X-Request-Id")
+        except Exception as e:
+            gen_err = f"{type(e).__name__}: {e}"
+        finally:
+            if dec_front is not None:
+                dec_front.stop()
+            if proc.poll() is None:
+                proc.send_signal(_signal.SIGTERM)  # drain + sink flush
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            if proc.stdout is not None:
+                proc.stdout.close()
+        time.sleep(0.5)                 # replica handler span settle
+        _tracing.flush_trace_sink()     # router-side (this process)
+        trace_recs = _tracing.read_trace_dir(trace_dir)
+
+        def _trace_view(tid):
+            if not tid:
+                return set(), 0, 0
+            mine = [r for r in trace_recs if r.get("trace_id") == tid]
+            tree = _tracing.build_trace_tree(trace_recs, tid)
+            return ({r["name"] for r in mine},
+                    len(tree), len({r.get("pid") for r in mine}))
+
+        gen_names, gen_roots, gen_procs = _trace_view(gen_tid)
+        pred_names, pred_roots, pred_procs = _trace_view(pred_tid)
+        gen_ok = (gen_roots == 1 and gen_procs >= 2 and
+                  {"router.http_generate", "router.generate",
+                   "http.generate", "decode.queue_wait",
+                   "decode.prefill", "decode.ttft"} <= gen_names)
+        pred_ok = (pred_roots == 1 and pred_procs >= 2 and
+                   {"router.predict", "router.attempt", "http.predict",
+                    "serve.queue_wait", "serve.batch"} <= pred_names)
+        trace_ok = gen_ok and pred_ok and overhead_ok
+
         detail_base = {
             "platform": platform, "smoke": bool(args.smoke),
             "rate_rps": args.rate, "duration_s": args.duration,
@@ -796,11 +934,38 @@ def run_fleet_bench(args) -> int:
                  dict(detail_base, burst=burst_n, ok=results["ok"],
                       drained_endpoint=drained, gate_ok=scalein_ok,
                       acceptance="graceful drain -> zero dropped "
-                                 "in-flight requests"))):
+                                 "in-flight requests")),
+                ("fleet_trace_reconstructed",
+                 int(gen_ok and pred_ok), "bool",
+                 dict(detail_base, trace_dir=trace_dir,
+                      generate_trace_id=gen_tid,
+                      generate_spans=sorted(gen_names),
+                      generate_roots=gen_roots,
+                      generate_processes=gen_procs,
+                      generate_error=gen_err,
+                      predict_trace_id=pred_tid,
+                      predict_spans=sorted(pred_names),
+                      predict_roots=pred_roots,
+                      predict_processes=pred_procs,
+                      gate_ok=gen_ok and pred_ok,
+                      acceptance="one sampled generate reassembles to "
+                                 "a single tree spanning router -> "
+                                 "replica -> decode with queue-wait, "
+                                 "phase, and TTFT spans")),
+                ("fleet_trace_overhead_p50", overhead
+                 if overhead is not None else -1.0, "ratio",
+                 dict(detail_base, p50_off_ms=p50_off, p50_on_ms=p50_on,
+                      abs_delta_ms=(p50_on - p50_off)
+                      if p50_on and p50_off else None,
+                      gate_ok=overhead_ok,
+                      acceptance="PADDLE_TPU_TRACE_SAMPLE=1.0 predict "
+                                 "p50 <= 1.05x tracing-off (or within "
+                                 "2.5ms absolute)"))):
             print(json.dumps({"metric": metric, "value": value,
                               "unit": unit, "detail": detail}),
                   flush=True)
-        rc = 0 if (failover_ok and scaleout_ok and scalein_ok) else 1
+        rc = 0 if (failover_ok and scaleout_ok and scalein_ok
+                   and trace_ok) else 1
     finally:
         if scaler is not None:
             scaler.stop()
