@@ -23,8 +23,18 @@ user-facing guide):
 - events.py    — append-only JSONL event log (compile / step_summary /
                  anomaly / checkpoint) with a bounded in-memory ring
                  (PADDLE_TPU_EVENT_LOG).
-- httpd.py     — stdlib daemon thread serving /metrics, /healthz and
-                 /events?n=K live (PADDLE_TPU_METRICS_PORT).
+- httpd.py     — stdlib daemon thread serving /metrics, /healthz,
+                 /events?n=K and /v1/slo live (PADDLE_TPU_METRICS_PORT).
+- timeseries.py — env-gated background recorder appending delta-encoded
+                 registry samples to per-process segmented JSONL sinks
+                 (PADDLE_TPU_TS_DIR / PADDLE_TPU_TS_INTERVAL_S —
+                 PROFILE.md §Time series & SLOs).
+- aggregate.py — stdlib cross-process TS reader: merge by
+                 (metric, labels), windowed rate()/increase()/quantile,
+                 fleet roll-ups.
+- slo.py       — declarative SLOs (availability / latency) evaluated by
+                 a multi-window burn-rate alert state machine; slo_alert
+                 events, burn-rate metrics, GET /v1/slo.
 - httpbase.py  — shared stdlib-HTTP lifecycle (quiet handler, locked
                  idempotent start/stop, failed-bind caching, atexit);
                  also the base of the serving frontend
@@ -40,10 +50,20 @@ from . import telemetry
 from . import events
 from . import health
 from . import httpd
+from . import timeseries
+from . import aggregate
+from . import slo
 from .metrics import (  # noqa: F401
-    Counter, Gauge, Histogram, MetricsRegistry, counter, default_registry,
-    dump, gauge, histogram, maybe_start_dump_thread, render_prometheus,
-    reset, snapshot, stop_dump_thread,
+    Counter, Gauge, Histogram, MetricsRegistry, bucket_quantile, counter,
+    default_registry, dump, gauge, histogram, maybe_start_dump_thread,
+    render_prometheus, reset, snapshot, stop_dump_thread,
+)
+from .timeseries import (  # noqa: F401
+    Recorder, maybe_start_recorder, stop_recorder,
+)
+from .aggregate import TSStore, read_ts_dir  # noqa: F401
+from .slo import (  # noqa: F401
+    SLOEngine, maybe_start_evaluator, stop_evaluator,
 )
 from .tracing import (  # noqa: F401
     Span, TraceContext, begin_request, clear_spans, current_trace,
@@ -62,10 +82,14 @@ from .httpd import (  # noqa: F401
 
 __all__ = [
     "metrics", "tracing", "telemetry", "events", "health", "httpd",
+    "timeseries", "aggregate", "slo",
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "counter",
-    "default_registry", "dump", "gauge", "histogram",
+    "bucket_quantile", "default_registry", "dump", "gauge", "histogram",
     "maybe_start_dump_thread", "render_prometheus", "reset", "snapshot",
     "stop_dump_thread",
+    "Recorder", "maybe_start_recorder", "stop_recorder",
+    "TSStore", "read_ts_dir",
+    "SLOEngine", "maybe_start_evaluator", "stop_evaluator",
     "Span", "TraceContext", "begin_request", "clear_spans",
     "current_trace", "export_trace", "flush_trace_sink", "get_spans",
     "parse_traceparent", "record_span", "save_spans", "span",

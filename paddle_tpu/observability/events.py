@@ -68,13 +68,16 @@ __all__ = ["emit", "recent", "clear", "log_path", "read_jsonl",
 # joins/leaves, health ejections/readmissions, retry failovers, breaker
 # transitions, autoscale decisions, replica respawns; serve_drain marks
 # a replica's graceful scale-in drain.
+# slo_alert is the SLO engine's story (PROFILE.md §Time series & SLOs):
+# burn-rate alert state transitions (ok ↔ fast_burn/slow_burn) with the
+# firing window's burn numbers attached.
 KINDS = ("compile", "compile_cache", "step_summary", "anomaly",
          "checkpoint", "serve_start", "serve_stop", "serve_drain",
          "restore", "preempt",
          "fault", "recovery", "rank_restart", "pipeline_stall",
          "warmstart", "amp_overflow", "quantize", "analysis",
          "rendezvous", "resize", "restore_resharded", "ps_failover",
-         "decode", "fleet")
+         "decode", "fleet", "slo_alert")
 
 # Ring bound: a week-long run emitting a compile+summary event per minute
 # stays far under this; anomaly storms get truncated to the latest window.

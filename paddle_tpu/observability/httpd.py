@@ -12,6 +12,10 @@ target. Routes:
   GET /events?n=K[&kind=X]
                     last K events from the in-memory ring, one JSON
                     object per line (newline-delimited JSON)
+  GET /v1/slo       SLO burn-rate status (PROFILE.md §Time series &
+                    SLOs): per-objective state, windows and burn rates
+                    from the background evaluator (or a transient
+                    evaluation when only the env is configured)
 
 Env gating: PADDLE_TPU_METRICS_PORT. Unset/empty → no server, no
 socket. "0" → bind an ephemeral port (tests); any other integer → that
@@ -68,10 +72,17 @@ class _Handler(_base.QuietHandler):
                          for e in _events.recent(n=n, kind=kind)]
                 self._reply(200, "application/x-ndjson",
                             "\n".join(lines) + ("\n" if lines else ""))
+            elif url.path == "/v1/slo":
+                from . import slo as _slo
+
+                st = _slo.status_snapshot()
+                self._reply(200 if "error" not in st else 503,
+                            "application/json",
+                            json.dumps(_m._json_safe(st)) + "\n")
             else:
                 self._reply(404, "text/plain",
                             "not found; routes: /metrics /healthz "
-                            "/events?n=K\n")
+                            "/events?n=K /v1/slo\n")
         except _base.CLIENT_GONE:
             pass  # scraper hung up mid-reply
 

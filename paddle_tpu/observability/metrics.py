@@ -25,7 +25,7 @@ __all__ = [
     "default_registry", "counter", "gauge", "histogram",
     "snapshot", "render_prometheus", "dump", "reset",
     "maybe_start_dump_thread", "stop_dump_thread",
-    "exponential_buckets",
+    "exponential_buckets", "bucket_quantile",
 ]
 
 # Seconds-scale latency buckets: 50us .. 60s covers a jit dispatch on a
@@ -284,6 +284,43 @@ class MetricsRegistry:
                 f.write(text)
             os.replace(tmp, path)
         return jpath
+
+
+def bucket_quantile(q: float, buckets, count: Optional[float] = None):
+    """Histogram-bucket quantile estimate with linear interpolation
+    inside the straddling bucket — THE one implementation shared by
+    tools/obsdump.py, observability/aggregate.py, and the SLO engine
+    (they all answer "what is p99 of this bucket table?" and must agree).
+
+    `buckets` is a sequence of per-bin entries, each either a
+    (le, count) pair or a {"le", "count"} dict (the snapshot() shape),
+    with PER-BIN counts (not cumulative) and finite upper bounds in
+    ascending order. `count` is the total observation count INCLUDING
+    values above the top bucket (the implicit +Inf bin); when omitted it
+    defaults to the sum of the given bins, i.e. no overflow.
+
+    Returns None for an empty histogram. Quantiles that land in the
+    +Inf overflow region clamp to the top finite bound — the honest
+    answer "at least this much" rather than an invented extrapolation.
+    """
+    bins = []
+    for b in buckets:
+        if isinstance(b, dict):
+            bins.append((float(b["le"]), float(b["count"])))
+        else:
+            bins.append((float(b[0]), float(b[1])))
+    total = float(count) if count is not None \
+        else sum(n for _, n in bins)
+    if total <= 0:
+        return None
+    target = max(0.0, min(1.0, float(q))) * total
+    prev_le, cum = 0.0, 0.0
+    for le, n in bins:
+        if cum + n >= target and n > 0:
+            frac = (target - cum) / n
+            return prev_le + frac * (le - prev_le)
+        prev_le, cum = le, cum + n
+    return prev_le  # target in the +Inf overflow: top finite bound
 
 
 def _json_safe(obj):

@@ -23,6 +23,7 @@ from typing import Dict, Optional
 from . import events as _events
 from . import httpd as _httpd
 from . import metrics as _m
+from . import timeseries as _timeseries
 
 __all__ = [
     "executor_step", "feed_nbytes",
@@ -188,6 +189,7 @@ def record_executor_step(mode: str, seconds: float, feed_bytes: int):
         EXEC_FEED_BYTES.inc(feed_bytes)
     _m.maybe_start_dump_thread()
     _httpd.maybe_start_http_server()
+    _timeseries.maybe_start_recorder()
 
 
 def feed_nbytes(feed: Dict) -> int:
@@ -243,6 +245,7 @@ def record_spmd_step(axis: str, seconds: float,
         SPMD_COLLECTIVES.inc(n, axis=axis, op=op)
     _m.maybe_start_dump_thread()
     _httpd.maybe_start_http_server()
+    _timeseries.maybe_start_recorder()
 
 
 def record_compile(kind: str, seconds: float,

@@ -8,19 +8,24 @@ ReplicaSupervisor's scale_out/scale_in (SERVING.md §Fleet): every
     depth + in-flight) per healthy replica, i.e. the /v1/load scalar
     the router already polls, and
   * **latency** — `router.recent_p99(window_s)`: trailing p99 of
-    successful predicts,
+    successful predicts, and
+  * **SLO burn** (optional) — `burn_rate_fn`, typically
+    `observability.slo.SLOEngine.max_burn_rate`: the worst confirmed
+    fast-window burn rate across declared objectives (PROFILE.md §Time
+    series & SLOs), so the fleet grows when the error budget is being
+    SPENT too fast, not only when queues are visibly deep,
 
 and moves the replica count within `[min_replicas, max_replicas]` with
 classic hysteresis so noise cannot flap the fleet:
 
-  * scale OUT when load > `high_load` (or p99 > `p99_high_ms`) for
-    `breach_polls` CONSECUTIVE polls AND `out_cooldown_s` has passed
-    since the last scaling action;
+  * scale OUT when load > `high_load` (or p99 > `p99_high_ms`, or burn
+    ≥ `burn_high`) for `breach_polls` CONSECUTIVE polls AND
+    `out_cooldown_s` has passed since the last scaling action;
   * scale IN when load < `low_load` AND p99 is under any configured
-    bound for `clear_polls` consecutive polls AND `in_cooldown_s`
-    passed — deliberately slower than scale-out (capacity mistakes in
-    the down direction hurt users; in the up direction they only cost
-    a replica).
+    bound AND burn is under `burn_high` for `clear_polls` consecutive
+    polls AND `in_cooldown_s` passed — deliberately slower than
+    scale-out (capacity mistakes in the down direction hurt users; in
+    the up direction they only cost a replica).
 
 The gap between `high_load` and `low_load` is the hysteresis band: a
 fleet sitting anywhere inside it is left alone. Scale-out lands within
@@ -60,6 +65,7 @@ class Autoscaler:
                  min_replicas: int = 1, max_replicas: int = 4,
                  high_load: float = 4.0, low_load: float = 0.5,
                  p99_high_ms: Optional[float] = None,
+                 burn_rate_fn=None, burn_high: float = 14.4,
                  interval_s: float = 0.5,
                  breach_polls: int = 3, clear_polls: int = 6,
                  out_cooldown_s: float = 5.0,
@@ -80,6 +86,10 @@ class Autoscaler:
         self.high_load = float(high_load)
         self.low_load = float(low_load)
         self.p99_high_ms = p99_high_ms
+        # optional SLO input: a zero-arg callable returning the current
+        # worst fast-window burn rate (0.0 = budget-neutral traffic)
+        self.burn_rate_fn = burn_rate_fn
+        self.burn_high = float(burn_high)
         self.interval_s = float(interval_s)
         self.breach_polls = int(breach_polls)
         self.clear_polls = int(clear_polls)
@@ -142,12 +152,21 @@ class Autoscaler:
             self._high_streak = self._low_streak = 0
             return None
 
+        burn = None
+        if self.burn_rate_fn is not None:
+            try:
+                burn = float(self.burn_rate_fn())
+            except Exception:
+                burn = None  # lint-exempt:swallow: a broken SLO feed must not stop load/p99 scaling
+
         high = load > self.high_load or (
             self.p99_high_ms is not None and p99_ms is not None
-            and p99_ms > self.p99_high_ms)
+            and p99_ms > self.p99_high_ms) or (
+            burn is not None and burn >= self.burn_high)
         low = load < self.low_load and (
             self.p99_high_ms is None or p99_ms is None
-            or p99_ms <= self.p99_high_ms)
+            or p99_ms <= self.p99_high_ms) and (
+            burn is None or burn < self.burn_high)
         self._high_streak = self._high_streak + 1 if high else 0
         self._low_streak = self._low_streak + 1 if low else 0
 
@@ -185,6 +204,8 @@ class Autoscaler:
             "min": self.min_replicas, "max": self.max_replicas,
             "high_load": self.high_load, "low_load": self.low_load,
             "p99_high_ms": self.p99_high_ms,
+            "burn_high": self.burn_high
+            if self.burn_rate_fn is not None else None,
             "high_streak": self._high_streak,
             "low_streak": self._low_streak,
             "actions": dict(self._actions),

@@ -79,7 +79,8 @@ class ServingConfig:
                  port: int = 0,
                  precision: str = "f32",
                  calibration=None,
-                 accuracy_check_batches: int = 4):
+                 accuracy_check_batches: int = 4,
+                 slo_spec=None):
         self.model_dir = model_dir
         self.buckets = tuple(buckets) if buckets is not None else None
         self.max_batch = int(max_batch)
@@ -113,6 +114,11 @@ class ServingConfig:
         self.precision = str(precision)
         self.calibration = calibration
         self.accuracy_check_batches = int(accuracy_check_batches)
+        # slo_spec: path to a JSON objectives file (or a spec dict) —
+        # Server.start() hands it to observability.slo's background
+        # evaluator; recording (PADDLE_TPU_TS_DIR) must be on for the
+        # burn rates to have data (PROFILE.md §Time series & SLOs)
+        self.slo_spec = slo_spec
 
 
 class Engine:

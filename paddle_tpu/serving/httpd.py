@@ -50,6 +50,7 @@ numbers round-trip.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import Dict, Optional
@@ -59,6 +60,8 @@ import numpy as np
 
 from ..observability import events as _events
 from ..observability import httpbase as _base
+from ..observability import slo as _slo
+from ..observability import timeseries as _timeseries
 from ..observability import tracing as _tracing
 from ..observability.metrics import _json_safe
 from .decode import DecodeEngine
@@ -245,6 +248,20 @@ class _ServingHandler(_base.QuietHandler):
 
     def _do_predict(self, payload):
         try:
+            # chaos hook for latency-SLO testing (serve_bench --fleet
+            # gate 5): when PADDLE_TPU_SLOW_SHIM_FILE names an existing
+            # file, every predict sleeps the float it contains — a slow
+            # replica that can be injected and lifted mid-life by
+            # creating/removing the file, no restart needed
+            shim = os.environ.get("PADDLE_TPU_SLOW_SHIM_FILE")
+            if shim:
+                try:
+                    with open(shim) as f:
+                        delay = float(f.read().strip() or 0.0)
+                except (OSError, ValueError):
+                    delay = 0.0
+                if delay > 0:
+                    time.sleep(delay)
             feeds = payload.get("feeds") if isinstance(payload, dict) \
                 else None
             if not isinstance(feeds, dict) or not feeds:
@@ -372,6 +389,12 @@ class Server:
             import atexit
 
             atexit.register(self.stop)
+            # telemetry pipeline: the env-gated TS recorder plus the
+            # SLO evaluator when the config declares objectives (both
+            # no-ops without PADDLE_TPU_TS_DIR)
+            _timeseries.maybe_start_recorder()
+            _slo.maybe_start_evaluator(
+                spec_path=getattr(self.config, "slo_spec", None))
             _events.emit("serve_start", port=bound,
                          buckets=list(self._engine.policy.buckets)
                          if self._engine is not None else [],

@@ -111,6 +111,12 @@ def main(argv=None) -> int:
     server = Server(cfg, decode=decode)
     port = server.start(args.port)
     endpoint = f"{args.host}:{port}"
+    # env-gated time-series recording (PADDLE_TPU_TS_DIR): Server.start
+    # already tried; call again explicitly so a replica records even
+    # when the supervisor flips the env on between respawns
+    from ..observability import timeseries as _timeseries
+
+    _timeseries.maybe_start_recorder()
 
     rdzv = None
     rdzv_dir = args.rdzv_dir or os.environ.get("PADDLE_TPU_RDZV_DIR", "")
@@ -148,10 +154,13 @@ def main(argv=None) -> int:
     server.drain(timeout=args.drain_timeout_s)
     server.stop()
     # publish any buffered sampled spans before exit so the trace-dir
-    # reassembly (obsdump trace) sees this replica's half of the tree
+    # reassembly (obsdump trace) sees this replica's half of the tree,
+    # and take the recorder's final time-series sample for the same
+    # reason (a replica shorter than the interval must still record)
     from ..observability import tracing as _tracing
 
     _tracing.flush_trace_sink()
+    _timeseries.stop_recorder()
     return 0
 
 

@@ -105,6 +105,75 @@ _CACHE_WRITERS = ("paddle_tpu/core/compile_cache.py",
                   "paddle_tpu/serving/decode.py")
 
 
+# -- metric-name drift (ISSUE 16) --------------------------------------------
+
+# Docs whose `paddle_tpu_*` mentions are treated as metric-name claims.
+_METRIC_DOCS = ("PROFILE.md", "SERVING.md")
+
+# Every module that registers metrics at import time — importing these
+# populates the default registry with the full live metric surface.
+_INSTRUMENTED_MODULES = (
+    "paddle_tpu.observability.telemetry",
+    "paddle_tpu.observability.health",
+    "paddle_tpu.observability.tracing",
+    "paddle_tpu.observability.timeseries",
+    "paddle_tpu.observability.slo",
+    "paddle_tpu.core.compile_cache",
+    "paddle_tpu.serving.engine",
+    "paddle_tpu.serving.router",
+    "paddle_tpu.serving.decode",
+    "paddle_tpu.serving.autoscale",
+    "paddle_tpu.serving.httpd",
+    "paddle_tpu.distributed.launch_serve",
+)
+
+# Metrics this PR introduced: documentation is part of their contract.
+_MUST_BE_DOCUMENTED = (
+    "paddle_tpu_slo_burn_rate",
+    "paddle_tpu_slo_alerts_total",
+    "paddle_tpu_ts_samples_total",
+)
+
+
+def test_documented_metric_names_match_registry():
+    """A renamed metric silently orphans every dashboard/SLO built on
+    the documented name: any `paddle_tpu_*` name PROFILE.md/SERVING.md
+    mention must exist in the live registry after importing the
+    instrumented modules, and the new time-series/SLO metrics must be
+    documented."""
+    import importlib
+    import re
+
+    for mod in _INSTRUMENTED_MODULES:
+        importlib.import_module(mod)
+    from paddle_tpu.observability import metrics as om
+
+    live = set(om.snapshot())
+    documented = set()
+    for doc in _METRIC_DOCS:
+        with open(os.path.join(_REPO, doc)) as f:
+            documented |= set(re.findall(
+                r"paddle_tpu_[a-z0-9_]*[a-z0-9]", f.read()))
+
+    def base(name):
+        # Prometheus exposition suffixes document the histogram itself
+        for suf in ("_bucket", "_sum", "_count"):
+            if name.endswith(suf) and name[:-len(suf)] in live:
+                return name[:-len(suf)]
+        return name
+
+    documented = {base(n) for n in documented}
+    missing = sorted(documented - live)
+    assert not missing, (
+        f"documented metric names missing from the live registry "
+        f"(renamed without updating {'/'.join(_METRIC_DOCS)}?): "
+        f"{missing}")
+    undocumented = sorted(set(_MUST_BE_DOCUMENTED) - documented)
+    assert not undocumented, (
+        f"new telemetry metrics missing from {'/'.join(_METRIC_DOCS)}: "
+        f"{undocumented}")
+
+
 def test_cache_writers_route_through_atomic():
     for rel in _CACHE_WRITERS:
         path = os.path.join(_REPO, *rel.split("/"))

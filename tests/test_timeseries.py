@@ -1,0 +1,502 @@
+"""Telemetry pipeline tests (tier-1, fast): the delta-encoding time-
+series recorder, cross-process aggregation expressions, the shared
+bucket-quantile interpolation, the SLO burn-rate state machine, the
+/v1/slo endpoint, the autoscaler burn hook, and obsdump top/slo CLI
+smoke — ISSUE 16.
+
+Recorder/engine tests inject clocks and private registries and write
+TS records by hand, so nothing here sleeps on a real interval; the two
+subprocess tests cover what only an interpreter exit can prove (the
+atexit final metrics dump / final time-series sample)."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu.observability import aggregate as agg
+from paddle_tpu.observability import events as oe
+from paddle_tpu.observability import httpd as ohttpd
+from paddle_tpu.observability import metrics as om
+from paddle_tpu.observability import slo as oslo
+from paddle_tpu.observability import timeseries as ots
+from paddle_tpu.serving.autoscale import Autoscaler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OBSDUMP = os.path.join(REPO, "tools", "obsdump.py")
+METRICS_PY = os.path.join(REPO, "paddle_tpu", "observability",
+                          "metrics.py")
+
+
+# ---------------------------------------------------------------------------
+# Shared bucket-quantile interpolation (satellite: dedup from obsdump)
+# ---------------------------------------------------------------------------
+
+
+def test_bucket_quantile_edges():
+    bq = om.bucket_quantile
+    assert bq(0.5, []) is None                       # empty histogram
+    assert bq(0.5, [(0.5, 0)]) is None               # zero observations
+    # single bucket: linear interpolation from the previous bound (0)
+    assert bq(0.5, [(2.0, 4)]) == pytest.approx(1.0)
+    assert bq(0.25, [(2.0, 4)]) == pytest.approx(0.5)
+    # target beyond every finite bucket (+Inf overflow): count says 4
+    # observations but only 2 landed under a finite bound — report the
+    # top finite bound rather than inventing a value
+    assert bq(0.9, [(1.0, 2)], count=4) == pytest.approx(1.0)
+    assert bq(0.25, [(1.0, 2)], count=4) == pytest.approx(0.5)
+    # q clamps; dict-shaped rows (the registry snapshot form) accepted
+    assert bq(1.5, [(2.0, 4)]) == pytest.approx(2.0)
+    assert bq(0.5, [{"le": 2.0, "count": 4}]) == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# Recorder: delta encoding against hand-computed diffs
+# ---------------------------------------------------------------------------
+
+
+def _kinds(rec, kind):
+    return [s for s in rec["samples"] if s["kind"] == kind]
+
+
+def test_recorder_delta_encoding(tmp_path):
+    reg = om.MetricsRegistry()
+    c = reg.counter("tt_req_total", "", labelnames=("outcome",))
+    g = reg.gauge("tt_depth", "")
+    h = reg.histogram("tt_lat_seconds", "", buckets=(0.1, 0.5, 1.0))
+    r = ots.Recorder(str(tmp_path), registry=reg)
+
+    g.set(3)
+    c.inc(5, outcome="ok")     # accrued BEFORE recording started
+    r.sample_once(now=1000.0)  # baseline
+
+    c.inc(2, outcome="ok")
+    c.inc(1, outcome="error")  # brand-new series mid-recording
+    h.observe(0.2)
+    h.observe(0.7)
+    g.set(7)
+    r.sample_once(now=1005.0)
+    r.sample_once(now=1010.0)  # idle interval
+
+    recs = agg.read_ts_dir(str(tmp_path))
+    assert [rec["seq"] for rec in recs] == [0, 1, 2]
+    assert recs[0].get("baseline") is True
+    # baseline carries gauges only: pre-recording counts are not
+    # attributed to the first interval
+    assert _kinds(recs[0], "counter") == [] \
+        and _kinds(recs[0], "histogram") == []
+    assert _kinds(recs[0], "gauge")[0]["value"] == 3
+
+    deltas = {s["labels"]["outcome"]: s["delta"]
+              for s in _kinds(recs[1], "counter")}
+    assert deltas == {"ok": 2.0, "error": 1.0}
+    (hs,) = _kinds(recs[1], "histogram")
+    assert hs["count_delta"] == 2
+    assert hs["sum_delta"] == pytest.approx(0.9)
+    # per-bin deltas, zero bins omitted: 0.2 -> le 0.5, 0.7 -> le 1.0
+    assert sorted(map(tuple, hs["bucket_deltas"])) == [(0.5, 1), (1.0, 1)]
+    assert _kinds(recs[1], "gauge")[0]["value"] == 7
+
+    # idle interval: gauges re-emitted, no zero-delta counter/histogram
+    assert _kinds(recs[2], "counter") == [] \
+        and _kinds(recs[2], "histogram") == []
+    assert _kinds(recs[2], "gauge")[0]["value"] == 7
+
+    # a counter that goes BACKWARDS (process-internal reset) re-enters
+    # as delta = current, Prometheus-rate style
+    reg2 = om.MetricsRegistry()
+    c2 = reg2.counter("tt_req_total", "", labelnames=("outcome",))
+    c2.inc(1, outcome="ok")
+    r.registry = reg2
+    r.sample_once(now=1015.0)
+    recs = agg.read_ts_dir(str(tmp_path))
+    deltas = {s["labels"]["outcome"]: s["delta"]
+              for s in _kinds(recs[3], "counter")}
+    assert deltas == {"ok": 1.0}
+
+    # window math over the recorded history matches the hand-sum
+    store = agg.TSStore.load(str(tmp_path))
+    assert store.increase("tt_req_total", 20, now=1015.0) == 4.0
+    assert store.increase("tt_req_total", 20, now=1015.0,
+                          by="outcome") == {"ok": 3.0, "error": 1.0}
+    assert store.rate("tt_req_total", 20, now=1015.0) \
+        == pytest.approx(0.2)
+    assert store.quantile(0.5, "tt_lat_seconds", 20, now=1010.0) \
+        == pytest.approx(0.5)
+    assert store.gauge_latest("tt_depth") == 7.0
+
+
+def test_recorder_segment_sealing_and_retention(tmp_path):
+    reg = om.MetricsRegistry()
+    g = reg.gauge("tt_seal", "")
+    r = ots.Recorder(str(tmp_path), registry=reg,
+                     segment_samples=2, keep_segments=2)
+    for i in range(10):
+        g.set(i)
+        r.sample_once(now=float(i))
+    files = [f for f in os.listdir(str(tmp_path))
+             if f.startswith("ts-")]
+    # 5 segments sealed, keep-2 retention: only the newest survive
+    assert len(files) == 2
+    recs = agg.read_ts_dir(str(tmp_path))
+    assert [rec["seq"] for rec in recs] == [6, 7, 8, 9]
+    assert agg.TSStore(recs).latest_ts() == 9.0
+
+    # total-byte cap: oldest sealed segments deleted until under it,
+    # and the recorder keeps sampling afterwards
+    tight = tmp_path / "tight"
+    r2 = ots.Recorder(str(tight), registry=reg,
+                      segment_samples=1, keep_segments=100, max_bytes=1)
+    for i in range(5):
+        r2.sample_once(now=float(i))
+    assert len([f for f in os.listdir(str(tight))
+                if f.startswith("ts-")]) <= 1
+    assert r2.sample_once(now=5.0) >= 0
+
+
+def test_multi_process_merge(tmp_path):
+    def w(fname, recs):
+        with open(tmp_path / fname, "w") as f:
+            f.write("".join(json.dumps(r) + "\n" for r in recs))
+
+    def cs(outcome, delta):
+        return {"name": "m_total", "kind": "counter",
+                "labels": {"outcome": outcome}, "delta": delta}
+
+    w("ts-1-aa.jsonl", [
+        {"ts": 10.0, "pid": 1, "seq": 0, "samples": [cs("ok", 5)]},
+        {"ts": 20.0, "pid": 1, "seq": 1, "samples": [
+            cs("ok", 5), {"name": "q", "kind": "gauge", "labels": {},
+                          "value": 2.0}]}])
+    w("ts-2-bb.jsonl", [
+        {"ts": 20.0, "pid": 2, "seq": 0, "samples": [
+            cs("ok", 10), cs("error", 2),
+            {"name": "q", "kind": "gauge", "labels": {}, "value": 3.0}]}])
+
+    store = agg.TSStore.load(str(tmp_path))
+    assert store.pids() == [1, 2]
+    assert store.names() == ["m_total", "q"]
+    assert store.increase("m_total", 15, now=20.0) == 22.0
+    assert store.increase("m_total", 15, now=20.0, by="outcome") \
+        == {"ok": 20.0, "error": 2.0}
+    assert store.increase("m_total", 15, now=20.0,
+                          labels={"outcome": "error"}) == 2.0
+    # tighter window excludes the t=10 record (now - w < ts <= now)
+    assert store.increase("m_total", 5, now=20.0) == 17.0
+    # gauges roll up as latest-per-pid, summed across the fleet
+    assert store.gauge_latest("q") == 5.0
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate state machine (fake clock, hand-written timeline)
+# ---------------------------------------------------------------------------
+
+_WINDOWS = [
+    {"name": "fast", "short_s": 10, "long_s": 30, "burn": 14.4},
+    {"name": "slow", "short_s": 30, "long_s": 90, "burn": 6.0},
+]
+
+
+def _availability_dir(tmp_path):
+    """One record per 10s: clean [0,100), 50% errors [100,200), clean
+    [200,300]."""
+    recs = []
+    for t in range(10, 310, 10):
+        errs = 50 if 100 < t <= 200 else 0
+        samples = [{"name": "paddle_tpu_fleet_requests_total",
+                    "kind": "counter", "labels": {"outcome": "ok"},
+                    "delta": 100 - errs}]
+        if errs:
+            samples.append({"name": "paddle_tpu_fleet_requests_total",
+                            "kind": "counter",
+                            "labels": {"outcome": "error"},
+                            "delta": errs})
+        recs.append({"ts": float(t), "pid": 7, "seq": t // 10,
+                     "samples": samples})
+    with open(tmp_path / "ts-7-slo.jsonl", "w") as f:
+        f.write("".join(json.dumps(r) + "\n" for r in recs))
+    return {"slos": [{
+        "name": "avail", "type": "availability", "target": 0.99,
+        "errors": {"metric": "paddle_tpu_fleet_requests_total",
+                   "labels": {"outcome": "error"}},
+        "total": {"metric": "paddle_tpu_fleet_requests_total"},
+        "windows": _WINDOWS}]}
+
+
+def test_slo_state_machine_breach_fire_clear(tmp_path):
+    spec = _availability_dir(tmp_path)
+    eng = oslo.SLOEngine(spec, str(tmp_path))
+    before = len(oe.recent(4096, kind="slo_alert"))
+
+    (row,) = eng.evaluate(now=95.0)           # clean traffic
+    assert row["state"] == "ok" and eng.state("avail") == "ok"
+    assert row["current"] == pytest.approx(1.0)
+    assert eng.max_burn_rate() == 0.0
+
+    (row,) = eng.evaluate(now=135.0)          # deep inside the breach
+    # 50% bad on a 1% budget: burn 50 on both fast windows -> page
+    assert row["state"] == "fast_burn"
+    fast = next(w for w in row["windows"] if w["window"] == "fast")
+    assert fast["firing"] \
+        and fast["burn_short"] == pytest.approx(50.0) \
+        and fast["burn_long"] == pytest.approx(50.0)
+    assert row["current"] == pytest.approx(0.5)
+    assert eng.max_burn_rate() == pytest.approx(50.0)
+
+    (row,) = eng.evaluate(now=215.0)          # fast windows drained,
+    assert row["state"] == "slow_burn"        # long tail still burning
+
+    (row,) = eng.evaluate(now=295.0)          # fully recovered
+    assert row["state"] == "ok"
+
+    states = [e["state"] for e in oe.recent(4096, kind="slo_alert")
+              [before:] if e["slo"] == "avail"]
+    assert states == ["fast_burn", "slow_burn", "ok"]
+    # transitions counted; fast-window burn exported as a gauge
+    snap = om.snapshot()
+    assert any(s["labels"] == {"slo": "avail", "state": "fast_burn"}
+               and s["value"] >= 1
+               for s in snap["paddle_tpu_slo_alerts_total"]["series"])
+    assert "paddle_tpu_slo_burn_rate" in snap
+
+
+def test_slo_latency_threshold_interpolation(tmp_path):
+    # 8 obs in (0, 0.1], 2 in (0.1, 0.5]; threshold 0.3 splits the
+    # straddling bucket linearly: good = 8 + 2*(0.3-0.1)/(0.5-0.1) = 9
+    with open(tmp_path / "ts-9-lat.jsonl", "w") as f:
+        f.write(json.dumps({
+            "ts": 10.0, "pid": 9, "seq": 0, "samples": [
+                {"name": "lat_seconds", "kind": "histogram",
+                 "labels": {}, "count_delta": 10, "sum_delta": 1.4,
+                 "bucket_deltas": [[0.1, 8], [0.5, 2]]}]}) + "\n")
+    spec = {"slos": [{"name": "lat", "type": "latency", "target": 0.95,
+                      "metric": "lat_seconds", "threshold_s": 0.3,
+                      "windows": [{"name": "fast", "short_s": 20,
+                                   "long_s": 20, "burn": 1.5}]}]}
+    eng = oslo.SLOEngine(spec, str(tmp_path))
+    (row,) = eng.evaluate(now=10.0)
+    fast = row["windows"][0]
+    # bad fraction 0.1 on a 5% budget -> burn 2.0 >= 1.5: fires
+    assert fast["burn_short"] == pytest.approx(2.0)
+    assert row["state"] == "fast_burn"
+    # no traffic in the window is NOT an outage: burn stays 0
+    (row,) = eng.evaluate(now=100.0)
+    assert fast is not None and row["state"] == "ok"
+    assert row["windows"][0]["burn_short"] == 0.0
+
+
+def test_slo_spec_validation():
+    with pytest.raises(ValueError):
+        oslo.load_spec({"nope": []})
+    with pytest.raises(ValueError):
+        oslo.load_spec({"slos": [{"name": "x", "type": "latency",
+                                  "target": 1.5, "metric": "m",
+                                  "threshold_s": 1}]})
+    with pytest.raises(ValueError):
+        oslo.load_spec({"slos": [{"name": "x", "type": "availability",
+                                  "target": 0.9,
+                                  "errors": {"metric": "e"}}]})
+    with pytest.raises(ValueError):
+        oslo.load_spec({"slos": [{"name": "x", "type": "weird",
+                                  "target": 0.9}]})
+    ok = oslo.load_spec({"slos": [{"name": "x", "type": "latency",
+                                   "target": "0.9", "metric": "m",
+                                   "threshold_s": 0.5}]})
+    assert ok[0]["target"] == 0.9
+
+
+# ---------------------------------------------------------------------------
+# /v1/slo endpoint + env-gated recorder lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_v1_slo_endpoint(tmp_path, monkeypatch):
+    spec = _availability_dir(tmp_path)
+    spec_path = tmp_path / "slos.json"
+    spec_path.write_text(json.dumps(spec))
+    monkeypatch.setenv(oslo.SLO_SPEC_ENV, str(spec_path))
+    monkeypatch.setenv(oslo.TS_DIR_ENV, str(tmp_path))
+    try:
+        port = ohttpd.start_http_server(0)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/slo", timeout=10) as resp:
+            assert resp.status == 200
+            payload = json.loads(resp.read())
+        assert payload["slos"][0]["name"] == "avail"
+        assert payload["slos"][0]["state"] == "ok"   # clean tail
+        assert payload["ts_dir"] == str(tmp_path)
+
+        # unconfigured process: explanatory 503, not a crash
+        monkeypatch.delenv(oslo.SLO_SPEC_ENV)
+        monkeypatch.delenv(oslo.TS_DIR_ENV)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/slo", timeout=10)
+        assert ei.value.code == 503
+        assert "error" in json.loads(ei.value.read())
+    finally:
+        oslo.stop_evaluator()
+        ohttpd.stop_http_server()
+
+
+def test_env_gated_recorder_final_flush(tmp_path, monkeypatch):
+    # interval far beyond the test: only the stop-path final sample
+    # can write anything — the guarantee short processes rely on
+    monkeypatch.setenv(ots.TS_DIR_ENV, str(tmp_path))
+    monkeypatch.setenv(ots.TS_INTERVAL_ENV, "3600")
+    c = om.counter("tt_short_lived_total", "")
+    try:
+        assert ots.maybe_start_recorder()
+        assert ots.maybe_start_recorder()       # idempotent
+        assert ots.current_recorder() is not None
+        c.inc(3)
+    finally:
+        ots.stop_recorder()
+    assert ots.current_recorder() is None
+    store = agg.TSStore.load(str(tmp_path))
+    assert store.records[0].get("baseline") is True
+    assert store.increase("tt_short_lived_total", float("inf")) == 3.0
+    # unset env: recording stays off
+    monkeypatch.delenv(ots.TS_DIR_ENV)
+    assert not ots.maybe_start_recorder()
+
+
+def test_metrics_dump_thread_final_snapshot_subprocess(tmp_path):
+    # Satellite 1: a process shorter than the dump interval must still
+    # leave metrics.json behind (atexit final dump). File-path load of
+    # metrics.py keeps the child import-light.
+    code = (
+        "import importlib.util\n"
+        f"spec = importlib.util.spec_from_file_location("
+        f"'m', {METRICS_PY!r})\n"
+        "m = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(m)\n"
+        "m.counter('tt_short_run_total', '').inc(3)\n"
+        "assert m.maybe_start_dump_thread()\n"
+    )
+    env = dict(os.environ,
+               PADDLE_TPU_METRICS_DIR=str(tmp_path),
+               PADDLE_TPU_METRICS_INTERVAL_S="3600")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    with open(tmp_path / "metrics.json") as f:
+        snap = json.load(f)
+    assert snap["tt_short_run_total"]["series"][0]["value"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler SLO burn hook
+# ---------------------------------------------------------------------------
+
+
+class _FakeRouterGauges:
+    def __init__(self):
+        self.load = 0.0
+        self.p99 = None
+
+    def mean_load_per_healthy(self):
+        return self.load
+
+    def recent_p99(self, window_s=30.0):
+        return self.p99
+
+
+class _FakeSupervisor:
+    def __init__(self, n=1):
+        self.n = n
+
+    def replica_count(self):
+        return self.n
+
+    def scale_out(self):
+        self.n += 1
+        return f"ep{self.n}"
+
+    def scale_in(self, endpoint=None):
+        self.n -= 1
+        return f"ep{self.n + 1}"
+
+
+def test_autoscaler_burn_rate_hook():
+    burn = [50.0]
+    router, sup = _FakeRouterGauges(), _FakeSupervisor(1)
+    clk = [100.0]
+    sc = Autoscaler(router, sup, min_replicas=1, max_replicas=3,
+                    high_load=4.0, low_load=0.5, breach_polls=3,
+                    clear_polls=3, out_cooldown_s=0.0,
+                    in_cooldown_s=0.0, burn_rate_fn=lambda: burn[0],
+                    burn_high=14.4, clock=lambda: clk[0])
+    assert sc.status()["burn_high"] == 14.4
+    # load alone says "fine" — the burning SLO forces the scale-out
+    router.load = 1.0
+    assert [sc.tick() for _ in range(3)] == [None, None, "out"]
+    assert sup.n == 2
+    # recovery needs the burn BELOW threshold, not just low load: a
+    # still-burning SLO at idle load keeps scaling OUT
+    burn[0] = 50.0
+    router.load = 0.1
+    assert [sc.tick() for _ in range(3)] == [None, None, "out"]
+    assert sup.n == 3
+    burn[0] = 0.2
+    clk[0] += 100.0
+    assert [sc.tick() for _ in range(3)] == [None, None, "in"]
+    assert sup.n == 2
+
+
+def test_autoscaler_broken_burn_feed_is_ignored():
+    router, sup = _FakeRouterGauges(), _FakeSupervisor(1)
+    sc = Autoscaler(router, sup, min_replicas=1, max_replicas=3,
+                    high_load=4.0, low_load=0.5, breach_polls=1,
+                    out_cooldown_s=0.0,
+                    burn_rate_fn=lambda: 1 / 0, burn_high=14.4)
+    router.load = 1.0
+    assert sc.tick() is None and sup.n == 1   # no crash, no action
+    assert Autoscaler(router, sup).status()["burn_high"] is None
+
+
+# ---------------------------------------------------------------------------
+# obsdump top / slo CLI
+# ---------------------------------------------------------------------------
+
+
+def _run_obsdump(*argv):
+    return subprocess.run([sys.executable, OBSDUMP] + list(argv),
+                          capture_output=True, text=True, timeout=120)
+
+
+def test_obsdump_top_and_slo_cli(tmp_path):
+    spec = _availability_dir(tmp_path)
+    spec_path = tmp_path / "slos.json"
+    spec_path.write_text(json.dumps(spec))
+
+    out = _run_obsdump("top", str(tmp_path), "--json")
+    assert out.returncode == 0, out.stderr
+    view = json.loads(out.stdout)
+    assert view["pids"] == [7]
+    assert view["fleet"]["req_per_s"] > 0
+
+    out = _run_obsdump("top", str(tmp_path))
+    assert out.returncode == 0 and "fleet top" in out.stdout \
+        and "router:" in out.stdout
+
+    out = _run_obsdump("slo", str(tmp_path), "--spec", str(spec_path),
+                       "--json")
+    assert out.returncode == 0, out.stderr
+    (row,) = json.loads(out.stdout)
+    assert row["name"] == "avail" and row["state"] == "ok"
+
+    out = _run_obsdump("slo", str(tmp_path), "--spec", str(spec_path))
+    assert out.returncode == 0 and "avail" in out.stdout
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert _run_obsdump("top", str(empty)).returncode == 2
+    bad_spec = tmp_path / "bad.json"
+    bad_spec.write_text("{}")
+    assert _run_obsdump("slo", str(tmp_path), "--spec",
+                        str(bad_spec)).returncode == 2

@@ -47,6 +47,16 @@ Usage:
                                             # states, autoscale actions
                                             # (--live, --json,
                                             # --events LOG)
+  obsdump.py top TS_DIR                     # fleet dashboard from a
+                                            # PADDLE_TPU_TS_DIR: rates,
+                                            # error %, p50/p99, token
+                                            # throughput merged across
+                                            # recording pids (--window,
+                                            # --watch S, --json)
+  obsdump.py slo TS_DIR --spec SLOS.json    # SLO objective table:
+                                            # target, current, fast/slow
+                                            # burn rates, alert state
+                                            # (--window-scale, --json)
 
 Mixed-precision runs: `snapshot` surfaces the dynamic loss-scaling
 counters (paddle_tpu_amp_total{event=overflow|growth|skip}, the
@@ -74,14 +84,22 @@ _OBS_DIR = os.path.join(
     "paddle_tpu", "observability")
 
 
+_OBS_CACHE = {}
+
+
 def _load_obs_module(name: str):
     """Import observability/<name>.py by file path, bypassing the
-    paddle_tpu package __init__ (which drags in jax). metrics.py and
-    tracing.py are stdlib-only by contract (their module docstrings)."""
-    spec = importlib.util.spec_from_file_location(
-        f"_obsdump_{name}", os.path.join(_OBS_DIR, f"{name}.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    paddle_tpu package __init__ (which drags in jax). metrics.py,
+    tracing.py, aggregate.py and slo.py are stdlib-only by contract
+    (their module docstrings). Memoized: repeated loads (a --watch
+    refresh loop) must not re-exec the module each frame."""
+    mod = _OBS_CACHE.get(name)
+    if mod is None:
+        spec = importlib.util.spec_from_file_location(
+            f"_obsdump_{name}", os.path.join(_OBS_DIR, f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _OBS_CACHE[name] = mod
     return mod
 
 
@@ -695,9 +713,9 @@ def cmd_fleet(args) -> int:
 
 def _hist_summary(snap, name):
     """count / avg / estimated p50+p99 for an (unlabeled) histogram in
-    a snapshot. Percentiles interpolate within the cumulative `le`
-    buckets — an estimate, clearly better than nothing for a one-look
-    operator view."""
+    a snapshot, via the ONE shared bucket-interpolation implementation
+    (observability.metrics.bucket_quantile — aggregate.py and the SLO
+    engine use the same one, so every tool agrees on what p99 means)."""
     series = (snap.get(name) or {}).get("series", [])
     if not series:
         return None
@@ -705,21 +723,11 @@ def _hist_summary(snap, name):
     count, total = int(s.get("count", 0)), float(s.get("sum", 0.0))
     if not count:
         return {"count": 0}
-
-    def pct(q):
-        target = q * count
-        prev_le, cum = 0.0, 0
-        for b in s.get("buckets", []):
-            le, n = float(b["le"]), int(b["count"])  # per-bin count
-            if cum + n >= target:
-                frac = (target - cum) / max(1, n)
-                return prev_le + frac * (le - prev_le)
-            prev_le, cum = le, cum + n
-        return prev_le
-
+    bq = _load_obs_module("metrics").bucket_quantile
+    buckets = s.get("buckets", [])
     return {"count": count, "avg_ms": round(1000 * total / count, 3),
-            "p50_ms": round(1000 * pct(0.50), 3),
-            "p99_ms": round(1000 * pct(0.99), 3)}
+            "p50_ms": round(1000 * (bq(0.50, buckets, count) or 0.0), 3),
+            "p99_ms": round(1000 * (bq(0.99, buckets, count) or 0.0), 3)}
 
 
 def cmd_decode(args) -> int:
@@ -804,6 +812,149 @@ def cmd_decode(args) -> int:
         print(f"\nlast {len(evs)} decode events:")
         for ev in evs:
             print("  " + _fmt_event(ev))
+    return 0
+
+
+def _top_view(store, window):
+    """One frame of the fleet dashboard: windowed rates/quantiles merged
+    across every recording pid in the TS dir."""
+    req = store.rate("paddle_tpu_fleet_requests_total", window,
+                     by="outcome")
+    total = sum(req.values())
+    bad = sum(v for k, v in req.items() if k != "ok")
+    serv = store.rate("paddle_tpu_serving_requests_total", window,
+                      by="outcome")
+    toks = store.rate("paddle_tpu_decode_tokens_total", window,
+                      by="phase")
+    ms = 1000.0
+
+    def q(name, p):
+        v = store.quantile(p, name, window)
+        return None if v is None else round(v * ms, 3)
+
+    return {
+        "window_s": window,
+        "now": store.latest_ts(),
+        "pids": store.pids(),
+        "fleet": {
+            "req_per_s": round(total, 3),
+            "error_rate": round(bad / total, 4) if total else 0.0,
+            "outcomes_per_s": {k: round(v, 3) for k, v in
+                               sorted(req.items())},
+            "retries_per_s": round(store.rate(
+                "paddle_tpu_fleet_retries_total", window), 3),
+            "p50_ms": q("paddle_tpu_fleet_request_seconds", 0.50),
+            "p99_ms": q("paddle_tpu_fleet_request_seconds", 0.99),
+            "picks_per_s": {k: round(v, 3) for k, v in sorted(
+                store.rate("paddle_tpu_fleet_picks_total", window,
+                           by="endpoint").items())},
+        },
+        "serving": {
+            "req_per_s": {k: round(v, 3) for k, v in
+                          sorted(serv.items())},
+            "p50_ms": q("paddle_tpu_serving_request_seconds", 0.50),
+            "p99_ms": q("paddle_tpu_serving_request_seconds", 0.99),
+            "queue_depth": store.gauge_latest(
+                "paddle_tpu_serving_queue_depth"),
+        },
+        "decode": {
+            "tokens_per_s": {k: round(v, 3) for k, v in
+                             sorted(toks.items())},
+            "ttft_p50_ms": q("paddle_tpu_decode_ttft_seconds", 0.50),
+            "ttft_p99_ms": q("paddle_tpu_decode_ttft_seconds", 0.99),
+        },
+    }
+
+
+def _render_top(view):
+    f, s, d = view["fleet"], view["serving"], view["decode"]
+    print(f"fleet top — window {view['window_s']}s, "
+          f"{len(view['pids'])} recording pid(s): "
+          f"{','.join(str(p) for p in view['pids'])}")
+    print(f"  router: {f['req_per_s']}/s "
+          f"(err {100 * f['error_rate']:.2f}%, "
+          f"retries {f['retries_per_s']}/s) "
+          f"p50~{f['p50_ms']}ms p99~{f['p99_ms']}ms")
+    if f["outcomes_per_s"]:
+        print("    outcomes: " + ", ".join(
+            f"{k}={v}/s" for k, v in f["outcomes_per_s"].items()))
+    if f["picks_per_s"]:
+        rows = [{"endpoint": k, "picks/s": v}
+                for k, v in f["picks_per_s"].items()]
+        _print_aligned(rows, ("endpoint", "picks/s"))
+    if s["req_per_s"] or s["p99_ms"] is not None:
+        print(f"  serving: " + (", ".join(
+            f"{k}={v}/s" for k, v in s["req_per_s"].items()) or "idle")
+            + f"  p50~{s['p50_ms']}ms p99~{s['p99_ms']}ms "
+            f"queue={s['queue_depth']}")
+    if d["tokens_per_s"]:
+        print("  decode: " + ", ".join(
+            f"{k}={v} tok/s" for k, v in d["tokens_per_s"].items())
+            + f"  ttft p50~{d['ttft_p50_ms']}ms "
+            f"p99~{d['ttft_p99_ms']}ms")
+
+
+def cmd_top(args) -> int:
+    """Terminal fleet dashboard from a PADDLE_TPU_TS_DIR: per-endpoint
+    request rates, error rates, latency quantiles and token throughput,
+    merged across every recording process; --watch refreshes live."""
+    import time as _time
+
+    agg = _load_obs_module("aggregate")
+    frames = 0
+    while True:
+        store = agg.TSStore.load(args.ts_dir)
+        if not store.records:
+            print(f"top: no ts-*.jsonl records under {args.ts_dir} "
+                  f"(is PADDLE_TPU_TS_DIR recording?)", file=sys.stderr)
+            return 2
+        view = _top_view(store, args.window)
+        if frames and not args.json:
+            print()
+        if args.json:
+            print(json.dumps(view))
+        else:
+            _render_top(view)
+        frames += 1
+        if not args.watch or (args.frames and frames >= args.frames):
+            return 0
+        _time.sleep(args.watch)
+
+
+def cmd_slo(args) -> int:
+    """Objective table for a TS dir + SLO spec: target, current good
+    fraction, fast/slow burn rates, alert state — the offline view of
+    what the in-process evaluator serves at GET /v1/slo."""
+    slo = _load_obs_module("slo")
+    try:
+        slos = slo.load_spec(args.spec)
+    except (OSError, ValueError) as e:
+        print(f"slo: bad spec: {e}", file=sys.stderr)
+        return 2
+    eng = slo.SLOEngine(slos, args.ts_dir,
+                        window_scale=args.window_scale)
+    rows = eng.evaluate()
+    if args.json:
+        print(json.dumps(rows, indent=2))
+        return 0
+    table = []
+    for r in rows:
+        wins = {w["window"]: w for w in r["windows"]}
+        fast, slow = wins.get("fast", {}), wins.get("slow", {})
+
+        def burn(w):
+            return (f"{w.get('burn_short', 0):.2f}/"
+                    f"{w.get('burn_long', 0):.2f}") if w else "-"
+
+        table.append({
+            "slo": r["name"], "type": r["type"],
+            "target": f"{100 * r['target']:g}%",
+            "current": "-" if r["current"] is None
+            else f"{100 * r['current']:.3f}%",
+            "burn fast": burn(fast), "burn slow": burn(slow),
+            "state": r["state"]})
+    _print_aligned(table, ("slo", "type", "target", "current",
+                           "burn fast", "burn slow", "state"))
     return 0
 
 
@@ -930,6 +1081,41 @@ def main(argv=None) -> int:
     fp.add_argument("-n", type=int, default=20,
                     help="with --events: last N events (default 20)")
     fp.set_defaults(fn=cmd_fleet)
+
+    top = sub.add_parser("top", help="live fleet dashboard from a "
+                         "PADDLE_TPU_TS_DIR time-series dir: request/"
+                         "error rates, latency quantiles, token "
+                         "throughput merged across recording pids")
+    top.add_argument("ts_dir", help="PADDLE_TPU_TS_DIR with ts-*.jsonl "
+                     "recorder segments")
+    top.add_argument("--window", type=float, default=60.0,
+                     help="trailing window seconds for rates/quantiles "
+                     "(default 60)")
+    top.add_argument("--watch", type=float, default=0.0, metavar="S",
+                     help="refresh every S seconds (0 = render once)")
+    top.add_argument("--frames", type=int, default=0,
+                     help="with --watch: stop after N frames (0 = "
+                     "until interrupted)")
+    top.add_argument("--json", action="store_true",
+                     help="one JSON object per frame instead of the "
+                     "dashboard")
+    top.set_defaults(fn=cmd_top)
+
+    slp = sub.add_parser("slo", help="SLO objective table (target, "
+                         "current, burn rates, alert state) from a "
+                         "time-series dir + JSON spec")
+    slp.add_argument("ts_dir", help="PADDLE_TPU_TS_DIR with ts-*.jsonl "
+                     "recorder segments")
+    slp.add_argument("--spec", required=True,
+                     help="SLO spec JSON file (PROFILE.md §Time series "
+                     "& SLOs)")
+    slp.add_argument("--window-scale", type=float, default=1.0,
+                     help="shrink every burn window uniformly "
+                     "(PADDLE_TPU_SLO_WINDOW_SCALE equivalent; bench "
+                     "dirs need ~0.001)")
+    slp.add_argument("--json", action="store_true",
+                     help="rows as JSON instead of the aligned table")
+    slp.set_defaults(fn=cmd_slo)
 
     # unknown/missing subcommands exit nonzero via argparse itself
     # (required=True subparsers error out with status 2)

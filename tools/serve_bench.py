@@ -54,6 +54,16 @@ criteria:
      replica → decode with queue-wait, prefill-phase, and TTFT spans
      attributed, crossing >= 2 processes; the predict trace carries
      batcher queue-wait + batch spans under the router root.
+  5. **telemetry + SLO burn-rate** (ISSUE 16) — recycles the fleet
+     with PADDLE_TPU_TS_DIR set so router + every replica pid records
+     metric time series, A/Bs recorder-on vs -off predict p50 (same
+     <= 1.05x / 2.5ms gate as tracing), then arms a sleep shim
+     (PADDLE_TPU_SLOW_SHIM_FILE) on the replicas and declares a tight
+     latency SLO over paddle_tpu_fleet_request_seconds: the slow
+     replica breaches the fast burn pair → `slo_alert` fires
+     (fast_burn), the shim lifts → the alert clears to ok, and
+     `obsdump slo` / `obsdump top` against the shared TS dir reflect
+     both states with >= 3 recording pids fleet-merged.
 
 Run:  python tools/serve_bench.py [--rate 200] [--duration 10]
       [--max-batch 16] [--max-wait-ms 5] [--max-queue 128] [--batch 1]
@@ -902,6 +912,157 @@ def run_fleet_bench(args) -> int:
                     "serve.queue_wait", "serve.batch"} <= pred_names)
         trace_ok = gen_ok and pred_ok and overhead_ok
 
+        # ---- gate 5: telemetry pipeline + SLO burn-rate (ISSUE 16) --
+        # recorder on across router + every replica pid, a tight
+        # latency SLO, one slow replica (sleep shim) breaching the fast
+        # burn window -> slo_alert fires, lifts -> clears; recorder p50
+        # overhead <= 1.05x like the trace gate.
+        from paddle_tpu.observability import events as _oevents
+        from paddle_tpu.observability import slo as _slo_mod
+        from paddle_tpu.observability import timeseries as _ts_mod
+
+        ts_dir = os.path.join(tmpdir, "ts")
+        shim_file = os.path.join(tmpdir, "slow_shim")
+        slo_name = "predict-latency"
+        # freeze the fleet: this gate measures recorder overhead and
+        # drives a deliberate latency brownout — autoscale reactions
+        # would fight both
+        if scaler is not None:
+            scaler.stop()
+        # sampling off again: this A/B isolates the RECORDER's cost
+        os.environ["PADDLE_TPU_TRACE_SAMPLE"] = "0"
+        ts_rec_off = _fleet_phase(url, args.rate, ab_dur, body,
+                                  args.timeout_s)
+
+        def _live_slots():
+            # a retired slot may still show alive while its graceful
+            # drain finishes — it is not coming back, don't count it
+            return [s for s in sup.slot_info()
+                    if s["alive"] and not s["retired"]]
+
+        def _respawn_fleet(n_live):
+            """Recycle every live slot so respawned replicas inherit
+            the env flipped since boot (TS recording, shim arming)."""
+            for s in _live_slots():
+                sup.kill_slot(s["slot"])
+                deadline = time.time() + 180
+                while len(_live_slots()) < n_live:
+                    if time.time() > deadline:
+                        raise RuntimeError("slot never respawned")
+                    time.sleep(0.2)
+            time.sleep(1.0)   # let the rendezvous drop the dead member
+            wait_healthy(n_live)
+
+        n_live = len(_live_slots())
+        os.environ["PADDLE_TPU_TS_DIR"] = ts_dir
+        os.environ["PADDLE_TPU_TS_INTERVAL_S"] = "0.5"
+        # arm the sleep shim for respawns too (inert until the file
+        # exists); every replica recycled below records AND can be
+        # slowed later by just creating shim_file
+        os.environ["PADDLE_TPU_SLOW_SHIM_FILE"] = shim_file
+        _respawn_fleet(n_live)
+        _ts_mod.maybe_start_recorder()  # the router side of the fleet
+        # settle phase (discarded): the just-respawned fleet pays cold
+        # sockets / first-batch costs that are respawn artifacts, not
+        # recorder overhead — don't bill them to the A/B
+        _fleet_phase(url, args.rate, ab_dur, body, args.timeout_s)
+        ts_rec_on = _fleet_phase(url, args.rate, ab_dur, body,
+                                 args.timeout_s)
+        ts_p50_off = _percentile([ms for (_, ms, oc) in ts_rec_off
+                                  if oc == "ok"], 50)
+        ts_p50_on = _percentile([ms for (_, ms, oc) in ts_rec_on
+                                 if oc == "ok"], 50)
+        ts_overhead = (ts_p50_on / ts_p50_off) \
+            if ts_p50_off and ts_p50_on else None
+        ts_overhead_ok = ts_overhead is not None and \
+            (ts_overhead <= 1.05 or (ts_p50_on - ts_p50_off) <= 2.5)
+
+        # a tight latency objective with bench-scale burn windows: one
+        # slow replica pushes well past 1% of requests over 0.5s (burn
+        # >> 14.4 on a 99% target), healthy traffic stays far under
+        slo_spec_path = os.path.join(tmpdir, "slos.json")
+        with open(slo_spec_path, "w") as f:  # atomic-exempt: bench-local scratch file, single writer
+            json.dump({"slos": [{
+                "name": slo_name, "type": "latency", "target": 0.99,
+                "metric": "paddle_tpu_fleet_request_seconds",
+                "threshold_s": 0.5,
+                "windows": [
+                    {"name": "fast", "short_s": 2.0, "long_s": 6.0,
+                     "burn": 14.4},
+                    {"name": "slow", "short_s": 6.0, "long_s": 18.0,
+                     "burn": 6.0}]}]}, f)
+        slo_engine = _slo_mod.SLOEngine(
+            _slo_mod.load_spec(slo_spec_path), ts_dir)
+        slo_engine.evaluate()
+
+        def _obsdump(*cmd):
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "obsdump.py")] + list(cmd),
+                capture_output=True, text=True, timeout=120)
+            return out.returncode, out.stdout
+
+        # breach: the shim file makes every armed replica sleep 0.75s
+        # per predict — drive load until the fast pair confirms
+        with open(shim_file, "w") as f:  # atomic-exempt: chaos trigger file, presence is the signal
+            f.write("0.75")
+        breach_t0 = time.time()
+        fired = False
+        while time.time() - breach_t0 < 60.0:
+            _fleet_phase(url, args.rate, 1.0, body, args.timeout_s + 5)
+            slo_engine.evaluate()
+            if slo_engine.state(slo_name) == "fast_burn":
+                fired = True
+                break
+        breach_s = round(time.time() - breach_t0, 3)
+        dump_rc_b, dump_out_b = _obsdump("slo", ts_dir, "--spec",
+                                         slo_spec_path)
+        obsdump_breach_ok = dump_rc_b == 0 and "fast_burn" in dump_out_b
+
+        # recovery: lift the shim, keep traffic flowing until the
+        # short windows drain and the alert clears
+        os.unlink(shim_file)
+        clear_t0 = time.time()
+        cleared = False
+        while fired and time.time() - clear_t0 < 60.0:
+            _fleet_phase(url, args.rate, 1.0, body, args.timeout_s)
+            slo_engine.evaluate()
+            if slo_engine.state(slo_name) == "ok":
+                cleared = True
+                break
+        clear_s = round(time.time() - clear_t0, 3)
+        dump_rc_c, dump_out_c = _obsdump("slo", ts_dir, "--spec",
+                                         slo_spec_path, "--json")
+        try:
+            clear_rows = json.loads(dump_out_c)
+        except ValueError:
+            clear_rows = []
+        obsdump_clear_ok = dump_rc_c == 0 and any(
+            r.get("name") == slo_name and r.get("state") == "ok"
+            for r in clear_rows)
+
+        slo_events = [e for e in _oevents.recent(4096, kind="slo_alert")
+                      if e.get("slo") == slo_name]
+        slo_states = [e.get("state") for e in slo_events]
+        alert_ok = fired and cleared and "fast_burn" in slo_states \
+            and "ok" in slo_states
+
+        # fleet-merged dashboard: router + >= 2 replica pids recording
+        top_rc, top_out = _obsdump("top", ts_dir, "--window", "30",
+                                   "--json")
+        try:
+            top_view = json.loads(top_out)
+        except ValueError:
+            top_view = {}
+        ts_pids = (top_view.get("pids") or [])
+        top_ok = (top_rc == 0 and len(ts_pids) >= 3
+                  and top_view.get("fleet", {}).get("req_per_s", 0) > 0
+                  and top_view.get("fleet", {}).get("p99_ms") is not None)
+
+        slo_ok = (alert_ok and obsdump_breach_ok and obsdump_clear_ok
+                  and top_ok and ts_overhead_ok)
+
         detail_base = {
             "platform": platform, "smoke": bool(args.smoke),
             "rate_rps": args.rate, "duration_s": args.duration,
@@ -960,12 +1121,43 @@ def run_fleet_bench(args) -> int:
                       gate_ok=overhead_ok,
                       acceptance="PADDLE_TPU_TRACE_SAMPLE=1.0 predict "
                                  "p50 <= 1.05x tracing-off (or within "
+                                 "2.5ms absolute)")),
+                ("fleet_slo_alert_fired", int(alert_ok), "bool",
+                 dict(detail_base, slo=slo_name,
+                      states_seen=slo_states,
+                      breach_detect_s=breach_s, clear_s=clear_s,
+                      obsdump_breach_ok=obsdump_breach_ok,
+                      obsdump_clear_ok=obsdump_clear_ok,
+                      gate_ok=alert_ok,
+                      acceptance="slow-replica shim breaches the fast "
+                                 "burn pair -> slo_alert fast_burn, "
+                                 "shim lifted -> clears to ok, obsdump "
+                                 "slo reflects both states")),
+                ("fleet_ts_recording_pids", len(ts_pids), "count",
+                 dict(detail_base, ts_dir=ts_dir, pids=ts_pids,
+                      fleet_req_per_s=top_view.get(
+                          "fleet", {}).get("req_per_s"),
+                      fleet_p99_ms=top_view.get(
+                          "fleet", {}).get("p99_ms"),
+                      gate_ok=top_ok,
+                      acceptance="obsdump top merges the TS dir across "
+                                 "router + >= 2 replica pids into one "
+                                 "fleet dashboard")),
+                ("fleet_ts_overhead_p50", ts_overhead
+                 if ts_overhead is not None else -1.0, "ratio",
+                 dict(detail_base, p50_off_ms=ts_p50_off,
+                      p50_on_ms=ts_p50_on,
+                      abs_delta_ms=(ts_p50_on - ts_p50_off)
+                      if ts_p50_on and ts_p50_off else None,
+                      gate_ok=ts_overhead_ok,
+                      acceptance="PADDLE_TPU_TS_DIR recording predict "
+                                 "p50 <= 1.05x recorder-off (or within "
                                  "2.5ms absolute)"))):
             print(json.dumps({"metric": metric, "value": value,
                               "unit": unit, "detail": detail}),
                   flush=True)
         rc = 0 if (failover_ok and scaleout_ok and scalein_ok
-                   and trace_ok) else 1
+                   and trace_ok and slo_ok) else 1
     finally:
         if scaler is not None:
             scaler.stop()
