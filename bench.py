@@ -29,8 +29,12 @@ jax.config.update("jax_default_prng_impl", "unsafe_rbg")
 
 import jax.numpy as jnp  # noqa: E402
 
-# v5e (v5 lite) peak bf16 matmul throughput per chip.
-PEAK_FLOPS = {"tpu": 197e12, "cpu": 1e12, "gpu": 100e12}
+from paddle_tpu.observability import device_peaks as _peaks  # noqa: E402
+
+# Per-platform peak bf16 matmul throughput per chip — the shared table
+# (observability/device_peaks.py) the live MFU gauge uses too, so the
+# offline bench MFU and paddle_tpu_mfu agree by construction.
+PEAK_FLOPS = _peaks.PLATFORM_PEAK_FLOPS
 
 
 def _measure(step, state, batch, n_steps):
@@ -97,9 +101,25 @@ def _run_ladder(metric, batch_sizes, build, flops_per_sample, n_steps,
             sps = bs * n_steps / dt
             mfu = sps * flops_per_sample / (
                 n_chips * PEAK_FLOPS.get(platform, 1e12))
+            # feed the continuous-attribution layer with the measured
+            # window so the LIVE gauge (paddle_tpu_mfu{kind="bench"})
+            # and this offline number come from the same sample — the
+            # within-10% cross-check PROFILE.md documents
+            from paddle_tpu.observability import memwatch as _memwatch
+            from paddle_tpu.observability import perfwatch as _perfwatch
+
+            _perfwatch.record_step(
+                "bench", dt, flops=bs * n_steps * flops_per_sample,
+                n_devices=n_chips,
+                device_kind=getattr(jax.devices()[0], "device_kind",
+                                    platform))
+            mem = _memwatch.sweep(force=True) or {}
             _emit(metric, sps / n_chips, mfu, {
                 "batch_size": bs, "chips": n_chips, "platform": platform,
                 "mfu": round(mfu, 4),
+                "mfu_live": round(_perfwatch.mfu("bench"), 4),
+                "hbm_peak_bytes": int(_memwatch.watermark_bytes()),
+                "hbm_live_bytes": int(mem.get("total_bytes", 0)),
                 "step_ms": round(1000 * dt / n_steps, 2),
                 "final_loss": final_loss, **extra_detail,
             })

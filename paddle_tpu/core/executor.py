@@ -26,6 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..observability import health as _health
+from ..observability import memwatch as _memwatch
+from ..observability import perfwatch as _perfwatch
 from ..observability import telemetry as _telemetry
 from ..observability import tracing as _tracing
 from . import async_exec, compile_cache, framework, lowering
@@ -60,6 +62,51 @@ def _compile_cost(compiled) -> Tuple[Optional[float], Optional[int]]:
     except Exception:  # lint-exempt:swallow: memory_analysis is backend-optional introspection
         pass
     return flops, out_bytes
+
+
+def _executable_cost(compiled) -> Dict[str, Optional[float]]:
+    """Retained per-signature cost/memory analysis of an AOT
+    executable — the live-MFU numerator (observability/perfwatch.py)
+    and the executables line of the HBM attribution
+    (observability/memwatch.py). Works on deserialized compile-cache /
+    warmstart executables too, so adopted executables are not blind
+    spots. Missing fields are None (backend-optional introspection)."""
+    flops, out_bytes = _compile_cost(compiled)
+    cost: Dict[str, Optional[float]] = {
+        "flops": flops, "out_bytes": out_bytes,
+        "temp_bytes": None, "code_bytes": None, "arg_bytes": None}
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            cost["temp_bytes"] = int(getattr(
+                ma, "temp_size_in_bytes", 0))
+            cost["code_bytes"] = int(getattr(
+                ma, "generated_code_size_in_bytes", 0))
+            cost["arg_bytes"] = int(getattr(
+                ma, "argument_size_in_bytes", 0))
+    except Exception:  # lint-exempt:swallow: memory_analysis is backend-optional introspection
+        pass
+    return cost
+
+
+# every _JitDispatch alive in the process: memwatch sums their retained
+# generated-code bytes into the `executables` HBM line at sweep time
+_live_dispatches: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _live_executable_bytes() -> Tuple[int, int]:
+    """(generated-code bytes, executable count) over live dispatch
+    wrappers' retained signatures — the memwatch executables
+    provider."""
+    total = count = 0
+    for disp in list(_live_dispatches):
+        for cost in list(disp._cost_by_sig.values()):
+            count += 1
+            total += int(cost.get("code_bytes") or 0)
+    return total, count
+
+
+_memwatch.set_executables_provider(_live_executable_bytes)
 
 
 _JIT_FALLBACK = object()  # sentinel: AOT redispatch failed, use plain jit
@@ -115,8 +162,14 @@ class _JitDispatch:
         self._tried = False
         self._tried_sig = None
         self._aot_by_sig: "OrderedDict[Tuple, Any]" = OrderedDict()
+        # retained cost/memory analysis per compiled signature: the
+        # live-MFU numerator reads the INSTALLED signature's FLOPs on
+        # every recorded step without touching the executable again
+        self._cost_by_sig: Dict[Tuple, Dict] = {}
+        self._cost_current: Optional[Dict] = None
         self._compile_lock = threading.Lock()
         self._recorded_jit_compiles = 0
+        _live_dispatches.add(self)
 
     def _aval_sig(self, args) -> Tuple:
         """Hashable shape/dtype signature of a warm()/call argument
@@ -183,6 +236,7 @@ class _JitDispatch:
                 # away and came back): swap executables, no XLA
                 self._aot_by_sig.move_to_end(sig)
                 self._aot = remembered
+                self._cost_current = self._cost_by_sig.get(sig)
                 self._tried, self._tried_sig = True, sig
                 return True
             t0 = time.perf_counter()
@@ -212,11 +266,25 @@ class _JitDispatch:
         return self._aot is not None
 
     def _remember_locked(self, sig, executable):
-        """Record sig -> executable (caller holds _compile_lock)."""
+        """Record sig -> executable + its retained cost/memory analysis
+        (caller holds _compile_lock). Cost retention covers every
+        install path — fresh compile, persistent-cache hit, warmstart
+        adopt — so the live-MFU numerator never goes dark on a path
+        that skipped XLA."""
         self._aot_by_sig[sig] = executable
         self._aot_by_sig.move_to_end(sig)
+        self._cost_by_sig[sig] = _executable_cost(executable)
+        self._cost_current = self._cost_by_sig[sig]
         while len(self._aot_by_sig) > self._AOT_SIG_CAP:
-            self._aot_by_sig.popitem(last=False)
+            old, _ = self._aot_by_sig.popitem(last=False)
+            self._cost_by_sig.pop(old, None)
+
+    def current_cost(self) -> Optional[Dict]:
+        """Cost/memory analysis of the currently installed executable
+        (None on the plain-jit fallback path): flops, out_bytes,
+        temp_bytes, code_bytes, arg_bytes — fields None when the
+        backend doesn't report them."""
+        return self._cost_current
 
     def adopt(self, executable, *args) -> bool:
         """Install a pre-built executable (deserialized from a
@@ -257,6 +325,7 @@ class _JitDispatch:
             if exe is not None:
                 self._aot_by_sig.move_to_end(sig)
                 self._aot = exe
+                self._cost_current = self._cost_by_sig.get(sig)
                 self._tried, self._tried_sig = True, sig
         if exe is None and self.warm(*args):
             with self._compile_lock:
@@ -273,6 +342,17 @@ class _JitDispatch:
         return _JIT_FALLBACK
 
     def __call__(self, *args):
+        # OOM interceptor: a RESOURCE_EXHAUSTED raised by any dispatch
+        # path (AOT, drift re-resolve, plain-jit fallback) dumps the
+        # ranked per-owner HBM report + `oom` event before re-raising —
+        # free on the happy path (one try frame, no work)
+        try:
+            return self._dispatch(*args)
+        except Exception as e:
+            _memwatch.maybe_handle_oom(self._kind, e)
+            raise
+
+    def _dispatch(self, *args):
         if not self._tried:
             self.warm(*args)
         elif self._aot is None and self._aval_sig(args) != self._tried_sig:
@@ -363,23 +443,19 @@ _last_mem_sweep = [0.0]  # monotonic seconds of the last live_arrays walk
 
 
 def _record_live_device_memory():
-    """Gauge live device-buffer bytes via jax.live_arrays(). Only called
-    when observability is enabled (health.introspection_enabled), and
-    rate-limited: the sweep walks every live jax.Array, which on a big
-    model costs more per step than any scraper can use — gauges are
-    sampled on seconds-scale intervals anyway."""
+    """Gauge live device-buffer bytes. Only called when observability
+    is enabled (health.introspection_enabled), and rate-limited: the
+    sweep walks every live jax.Array, which on a big model costs more
+    per step than any scraper can use — gauges are sampled on
+    seconds-scale intervals anyway. The walk itself lives in
+    observability/memwatch.py, which attributes each buffer to its
+    registered owner (KV pool, params, optimizer state, other) and
+    keeps the legacy paddle_tpu_device_live_bytes totals in sync."""
     now = time.monotonic()
     if now - _last_mem_sweep[0] < _MEM_SWEEP_MIN_INTERVAL_S:
         return
     _last_mem_sweep[0] = now
-    try:
-        nbytes = nbufs = 0
-        for a in jax.live_arrays():
-            nbytes += int(getattr(a, "nbytes", 0))
-            nbufs += 1
-    except Exception:
-        return
-    _telemetry.record_device_memory(nbytes, nbufs)
+    _memwatch.sweep(force=True)
 
 
 class Scope:
@@ -581,8 +657,10 @@ def _finish_fetches(fetches, return_numpy: bool, sync: bool,
     t0 = time.perf_counter()
     try:
         jax.block_until_ready(fetches)
-    except Exception:  # lint-exempt:swallow: non-array fetches (rare lowering paths) convert below
-        pass  # non-array fetches (rare lowering paths) convert below
+    except Exception as e:  # lint-exempt:swallow: non-array fetches (rare lowering paths) convert below
+        # an async device OOM surfaces HERE, not at dispatch: dump the
+        # forensics before the conversion below re-raises it
+        _memwatch.maybe_handle_oom(site, e)
     out = [np.asarray(f) for f in fetches]
     _telemetry.record_host_blocked("executor_sync",
                                    time.perf_counter() - t0, stall=False)
@@ -655,6 +733,14 @@ class _CompiledStep:
         # Key: (n_steps, per_step_feeds, unroll).
         self._chained: "OrderedDict[Tuple[int, bool, bool], Any]" = \
             OrderedDict()
+        self._last_chained_fn: Optional[_JitDispatch] = None
+
+    def chained_cost(self) -> Optional[Dict]:
+        """Retained cost analysis of the last chained dispatch used by
+        run_chained — note its FLOPs cover the WHOLE n_steps window,
+        matching the one wall-time window run_chained records."""
+        fn = self._last_chained_fn
+        return fn.current_cost() if fn is not None else None
 
     def chained_fn(self, n_steps: int, per_step_feeds: bool = False,
                    unroll="auto", platform: Optional[str] = None):
@@ -766,9 +852,11 @@ class _CompiledStep:
             return self._run_chained_windowed(scope, feed, rng, n_steps,
                                               per_step_feeds)
         const_states, mut_states = self._gather_states(scope)
-        fetches, new_states, new_rng = self.chained_fn(
-            n_steps, per_step_feeds, unroll,
-            platform=plat)(feed, const_states, mut_states, rng)
+        fn = self.chained_fn(n_steps, per_step_feeds, unroll,
+                             platform=plat)
+        self._last_chained_fn = fn
+        fetches, new_states, new_rng = fn(feed, const_states,
+                                          mut_states, rng)
         for n, v in new_states.items():
             scope.set_var(n, v)
         return fetches, new_rng
@@ -790,9 +878,10 @@ class _CompiledStep:
             chunk = feed if not per_step_feeds else \
                 {k: v[done:done + n] for k, v in feed.items()}
             const_states, mut_states = self._gather_states(scope)
-            fetches, new_states, rng = self.chained_fn(
-                n, per_step_feeds, True)(chunk, const_states,
-                                         mut_states, rng)
+            fn = self.chained_fn(n, per_step_feeds, True)
+            self._last_chained_fn = fn
+            fetches, new_states, rng = fn(chunk, const_states,
+                                          mut_states, rng)
             for name, v in new_states.items():
                 scope.set_var(name, v)
             if out_chunks is None:
@@ -847,8 +936,18 @@ class Executor:
         self._cache: Dict[Any, _CompiledStep] = {}
         self._cache_hits = 0
         self._cache_misses = 0
+        self._dev_kind: Optional[str] = None
         with _live_executors_lock:
             _live_executors.add(self)
+
+    def _device_kind(self) -> str:
+        """device_kind of this executor's place — the live-MFU peak
+        lookup key (observability/device_peaks.py). Cached: the place
+        never changes after construction."""
+        if self._dev_kind is None:
+            self._dev_kind = getattr(self.place.jax_device(),
+                                     "device_kind", "unknown")
+        return self._dev_kind
 
     def close(self):
         self._cache.clear()
@@ -923,6 +1022,10 @@ class Executor:
                 with jax.default_device(self.place.jax_device()):
                     fetches, new_rng = step(scope, norm_feed, rng)
             scope.set_var(RNG_STATE_VAR, new_rng)
+            # after execution: the dispatch wrapper has compiled by now,
+            # so current_cost() carries this signature's retained FLOPs
+            rec.set_perf("step", step.fn.current_cost(),
+                         device_kind=self._device_kind())
 
             # reference: FLAGS_check_nan_inf (flags.cc:44). The legacy
             # flag forces raise-level checking; PADDLE_TPU_CHECK_NUMERICS
@@ -1024,6 +1127,8 @@ class Executor:
                         platform=getattr(self.place.jax_device(),
                                          "platform", None))
             scope.set_var(RNG_STATE_VAR, new_rng)
+            rec.set_perf("chained", step.chained_cost(),
+                         device_kind=self._device_kind())
             _post_step_health(step.writes, fetch_names, fetches, scope)
             return _finish_fetches(fetches, return_numpy, sync,
                                    site="chained")

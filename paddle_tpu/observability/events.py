@@ -71,13 +71,20 @@ __all__ = ["emit", "recent", "clear", "log_path", "read_jsonl",
 # slo_alert is the SLO engine's story (PROFILE.md §Time series & SLOs):
 # burn-rate alert state transitions (ok ↔ fast_burn/slow_burn) with the
 # firing window's burn numbers attached.
+# oom is the memory tier's post-mortem (PROFILE.md §Continuous
+# profiling): a RESOURCE_EXHAUSTED intercepted on a dispatch path with
+# the ranked per-owner live-buffer attribution attached; hbm_budget
+# marks PADDLE_TPU_HBM_BUDGET_BYTES state transitions (warn/error);
+# profile marks an on-demand /v1/profile capture window with its
+# artifact dir.
 KINDS = ("compile", "compile_cache", "step_summary", "anomaly",
          "checkpoint", "serve_start", "serve_stop", "serve_drain",
          "restore", "preempt",
          "fault", "recovery", "rank_restart", "pipeline_stall",
          "warmstart", "amp_overflow", "quantize", "analysis",
          "rendezvous", "resize", "restore_resharded", "ps_failover",
-         "decode", "fleet", "slo_alert")
+         "decode", "fleet", "slo_alert",
+         "oom", "hbm_budget", "profile")
 
 # Ring bound: a week-long run emitting a compile+summary event per minute
 # stays far under this; anomaly storms get truncated to the latest window.

@@ -42,7 +42,7 @@ from . import httpbase as _base
 from . import metrics as _m
 
 __all__ = ["start_http_server", "maybe_start_http_server",
-           "stop_http_server", "server_port"]
+           "stop_http_server", "server_port", "handle_profile_request"]
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -82,9 +82,51 @@ class _Handler(_base.QuietHandler):
             else:
                 self._reply(404, "text/plain",
                             "not found; routes: /metrics /healthz "
-                            "/events?n=K /v1/slo\n")
+                            "/events?n=K /v1/slo "
+                            "POST /v1/profile\n")
         except _base.CLIENT_GONE:
             pass  # scraper hung up mid-reply
+
+    def do_POST(self):  # noqa: N802 - stdlib naming
+        try:
+            if urlparse(self.path).path != "/v1/profile":
+                self._reply(404, "text/plain",
+                            "not found; POST routes: /v1/profile\n")
+                return
+            code, body = handle_profile_request(self)
+            self._reply(code, "application/json", body)
+        except _base.CLIENT_GONE:
+            pass  # caller hung up mid-capture
+
+
+def handle_profile_request(handler) -> tuple:
+    """Shared POST /v1/profile implementation: parse {"seconds": N}
+    from the request body, run one bounded capture, reply with the
+    artifact paths. Returns (http_code, json_body). Used by this
+    metrics server AND the serving frontend (serving/httpd.py), so a
+    fleet router can profile a replica through the same port it routes
+    inference to. The handler thread blocks for the window —
+    ThreadingHTTPServer keeps every other route live meanwhile."""
+    try:
+        n = int(handler.headers.get("Content-Length") or 0)
+        req = json.loads(handler.rfile.read(n) or b"{}") if n else {}
+        if not isinstance(req, dict):
+            raise ValueError("body must be a JSON object")
+        seconds = float(req.get("seconds", 1.0))
+    except (ValueError, TypeError) as e:
+        return 400, json.dumps(
+            {"error": f"bad request: {e}"}) + "\n"
+    # deferred: profiler pulls in jax; this module stays import-light
+    from .. import profiler as _profiler
+
+    try:
+        out = _profiler.capture_profile(seconds)
+    except _profiler.ProfilerBusyError as e:
+        return 409, json.dumps({"error": str(e)}) + "\n"
+    except Exception as e:
+        return 500, json.dumps(
+            {"error": f"capture failed: {e}"}) + "\n"
+    return 200, json.dumps(out, default=str) + "\n"
 
 
 _handle = _base.HTTPServerHandle(

@@ -1,0 +1,357 @@
+"""Owner-tagged HBM accounting + OOM forensics.
+
+The PR 2 live-memory gauge (paddle_tpu_device_live_bytes) answers "how
+much" but not "whose": before a model can safely exceed one chip
+(ROADMAP item 1) the KV pool, parameters and optimizer state each need
+their own budget line. This module attributes the existing rate-limited
+`jax.live_arrays()` sweep to registered owners:
+
+  - the decode engine registers its KV pools and params
+    (serving/decode.py), TrainState instances register params/optimizer
+    state (parallel/train.py) — registration is a PROVIDER callable
+    returning the owner's current arrays, so donated buffers that are
+    replaced every step stay correctly attributed;
+  - compiled executables report their memory_analysis() generated-code
+    bytes through core/executor's dispatch registry (device-resident
+    but not jax arrays, so they ride alongside the live-array total
+    rather than inside it);
+  - everything unmatched lands in owner="other".
+
+Gauges: paddle_tpu_hbm_bytes{owner} / paddle_tpu_hbm_buffers{owner},
+paddle_tpu_hbm_watermark_bytes (high watermark of the live total),
+paddle_tpu_executable_bytes, paddle_tpu_hbm_budget_bytes.
+
+Budget: PADDLE_TPU_HBM_BUDGET_BYTES (int; unset = no budget). Crossing
+85% logs a warning + `hbm_budget` event (level=warn); crossing 100%
+logs an error + event (level=error). Transitions only — a sweep per
+step must not spam the log.
+
+OOM forensics: `oom_guard(kind)` / `maybe_handle_oom` wrap the dispatch
+paths (core/executor._JitDispatch, the fetch epilogue, the decode
+scheduler). A RESOURCE_EXHAUSTED escaping the device turns into a
+ranked per-owner live-buffer report in the log + an `oom` event before
+re-raising — a post-mortem instead of a bare stack trace.
+
+Import-light by contract (stdlib at import; jax deferred into the
+sweep): core/executor.py imports this at module load.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+from . import events as _events
+from . import metrics as _m
+
+__all__ = ["register_provider", "unregister_provider",
+           "set_executables_provider", "sweep", "report", "last_report",
+           "status_block", "budget_bytes", "watermark_bytes",
+           "is_oom", "maybe_handle_oom", "oom_guard", "reset"]
+
+log = logging.getLogger("paddle_tpu.observability.memwatch")
+
+BUDGET_ENV = "PADDLE_TPU_HBM_BUDGET_BYTES"
+WARN_FRACTION = 0.85
+# sweeps triggered through status endpoints / forced paths still walk
+# every live array; keep an internal floor so a tight status-poll loop
+# cannot turn the walk into a per-request cost
+_MIN_INTERVAL_S = 1.0
+
+HBM_BYTES = _m.gauge(
+    "paddle_tpu_hbm_bytes",
+    "Live device-buffer bytes attributed to their owner (kv_pool | "
+    "params | optimizer | other) by the rate-limited jax.live_arrays "
+    "sweep; owners sum to paddle_tpu_device_live_bytes",
+    labelnames=("owner",))
+HBM_BUFFERS = _m.gauge(
+    "paddle_tpu_hbm_buffers",
+    "Live device-array count per owner", labelnames=("owner",))
+HBM_WATERMARK = _m.gauge(
+    "paddle_tpu_hbm_watermark_bytes",
+    "High watermark of total live device-buffer bytes since process "
+    "start (ratchet; never decreases)")
+HBM_BUDGET = _m.gauge(
+    "paddle_tpu_hbm_budget_bytes",
+    "Configured HBM budget (PADDLE_TPU_HBM_BUDGET_BYTES); 0 = no "
+    "budget")
+EXECUTABLE_BYTES = _m.gauge(
+    "paddle_tpu_executable_bytes",
+    "memory_analysis() generated-code bytes summed over live compiled "
+    "executables (device-resident, outside the live-array total)")
+OOMS = _m.counter(
+    "paddle_tpu_oom_total",
+    "RESOURCE_EXHAUSTED errors intercepted on a dispatch path, by "
+    "dispatch kind — each also dumps a ranked per-owner report and an "
+    "`oom` event", labelnames=("kind",))
+
+_lock = threading.Lock()
+# insertion-ordered: attribution precedence when providers overlap
+_providers: "Dict[int, tuple]" = {}   # handle -> (owner, fn)
+_next_handle = [0]
+_exec_provider: List[Optional[Callable[[], tuple]]] = [None]
+_watermark = [0.0]
+_budget_state = ["ok"]                # ok | warn | error
+_last_sweep_t = [0.0]
+_last: List[Optional[Dict[str, Any]]] = [None]
+
+TOP_N = 12
+
+
+def register_provider(owner: str, fn: Callable[[], Iterable]) -> int:
+    """Register a callable returning the owner's CURRENT arrays (called
+    at sweep time, so buffers replaced by donation stay attributed).
+    Returns a handle for unregister_provider. Providers must be cheap
+    and exception-safe is not required — a raising provider is skipped
+    for that sweep."""
+    with _lock:
+        _next_handle[0] += 1
+        h = _next_handle[0]
+        _providers[h] = (owner, fn)
+    return h
+
+
+def unregister_provider(handle: int):
+    with _lock:
+        _providers.pop(handle, None)
+
+
+def set_executables_provider(fn: Callable[[], tuple]):
+    """Install the callable returning (code_bytes_total, n_executables)
+    for live compiled executables. Injection (not an import) so this
+    module never imports core/executor — which imports IT at load."""
+    _exec_provider[0] = fn
+
+
+def budget_bytes() -> Optional[int]:
+    raw = os.environ.get(BUDGET_ENV)
+    if not raw:
+        return None
+    try:
+        v = int(float(raw))
+    except ValueError:
+        return None
+    return v if v > 0 else None
+
+
+def watermark_bytes() -> int:
+    return int(_watermark[0])
+
+
+def reset():
+    """Tests: drop providers, watermark and budget state."""
+    with _lock:
+        _providers.clear()
+    _watermark[0] = 0.0
+    _budget_state[0] = "ok"
+    _last_sweep_t[0] = 0.0
+    _last[0] = None
+
+
+def _owned_ids() -> Dict[int, str]:
+    """id(array) -> owner, from every registered provider. First
+    registration wins on overlap."""
+    with _lock:
+        provs = list(_providers.values())
+    owned: Dict[int, str] = {}
+    for owner, fn in provs:
+        try:
+            arrays = fn()
+        except Exception:  # lint-exempt:swallow: a dead provider (engine stopped mid-sweep) skips one sweep
+            continue
+        for a in arrays or ():
+            owned.setdefault(id(a), owner)
+    return owned
+
+
+def sweep(force: bool = False, top: bool = False
+          ) -> Optional[Dict[str, Any]]:
+    """Walk jax.live_arrays(), attribute to owners, refresh the gauges
+    and budget state. Rate-limited unless `force`; returns the report
+    dict (None when rate-limited or jax is unusable). With `top`, the
+    report carries the TOP_N largest buffers ranked."""
+    now = time.monotonic()
+    if not force and now - _last_sweep_t[0] < _MIN_INTERVAL_S:
+        return _last[0]
+    _last_sweep_t[0] = now
+    try:
+        import jax
+
+        live = jax.live_arrays()
+    except Exception:
+        return None
+    owned = _owned_ids()
+    owners: Dict[str, int] = {}
+    counts: Dict[str, int] = {}
+    total = nbufs = 0
+    top_rows: List[Dict[str, Any]] = []
+    for a in live:
+        nb = int(getattr(a, "nbytes", 0))
+        owner = owned.get(id(a), "other")
+        owners[owner] = owners.get(owner, 0) + nb
+        counts[owner] = counts.get(owner, 0) + 1
+        total += nb
+        nbufs += 1
+        if top:
+            top_rows.append({
+                "owner": owner, "nbytes": nb,
+                "shape": list(getattr(a, "shape", ()) or ()),
+                "dtype": str(getattr(a, "dtype", "?"))})
+    exec_bytes = n_exec = 0
+    if _exec_provider[0] is not None:
+        try:
+            exec_bytes, n_exec = _exec_provider[0]()
+        except Exception:  # lint-exempt:swallow: executable introspection is optional
+            pass
+    if total > _watermark[0]:
+        _watermark[0] = float(total)
+    for owner in set(owners) | {"kv_pool", "params", "optimizer",
+                                "other"}:
+        HBM_BYTES.set(owners.get(owner, 0), owner=owner)
+        HBM_BUFFERS.set(counts.get(owner, 0), owner=owner)
+    HBM_WATERMARK.set_max(total)
+    EXECUTABLE_BYTES.set(exec_bytes)
+    # keep the PR 2 totals in lockstep with the attributed sweep
+    from . import telemetry as _telemetry
+
+    _telemetry.record_device_memory(total, nbufs)
+    budget = budget_bytes()
+    HBM_BUDGET.set(budget or 0)
+    _check_budget(total, budget)
+    rep: Dict[str, Any] = {
+        "total_bytes": total, "buffers": nbufs,
+        "owners": dict(sorted(owners.items(),
+                              key=lambda kv: -kv[1])),
+        "watermark_bytes": int(_watermark[0]),
+        "budget_bytes": budget,
+        "budget_state": _budget_state[0],
+        "executable_bytes": int(exec_bytes),
+        "executables": int(n_exec),
+    }
+    if top:
+        top_rows.sort(key=lambda r: -r["nbytes"])
+        rep["top"] = top_rows[:TOP_N]
+    _last[0] = {k: v for k, v in rep.items() if k != "top"}
+    return rep
+
+
+def _check_budget(total: int, budget: Optional[int]):
+    if not budget:
+        _budget_state[0] = "ok"
+        return
+    frac = total / budget
+    state = "error" if frac >= 1.0 else \
+        "warn" if frac >= WARN_FRACTION else "ok"
+    prev = _budget_state[0]
+    if state == prev:
+        return
+    _budget_state[0] = state
+    if state == "ok":
+        return  # recovery: gauge readers see it; no log line needed
+    word = "exceeded" if state == "error" else "nearly exhausted"
+    msg = (f"HBM budget {word}: {total} live bytes vs budget {budget} "
+           f"({frac:.0%})")
+    (log.error if state == "error" else log.warning)("%s", msg)
+    _events.emit("hbm_budget", level=state, total_bytes=int(total),
+                 budget_bytes=int(budget), fraction=round(frac, 4))
+
+
+def report(top: bool = True) -> Optional[Dict[str, Any]]:
+    """Fresh forced sweep with the ranked buffer list."""
+    return sweep(force=True, top=top)
+
+
+def last_report() -> Optional[Dict[str, Any]]:
+    return _last[0]
+
+
+def status_block() -> Dict[str, Any]:
+    """The /v1/status `memory` block: per-owner bytes, watermark,
+    budget. Sweeps through the internal rate limit, so a status poll
+    is a dict copy in the common case and a live walk at most once a
+    second."""
+    rep = sweep(force=False)
+    if rep is None:
+        rep = _last[0] or {"total_bytes": 0, "buffers": 0, "owners": {},
+                           "watermark_bytes": int(_watermark[0]),
+                           "budget_bytes": budget_bytes(),
+                           "budget_state": _budget_state[0],
+                           "executable_bytes": 0, "executables": 0}
+    return dict(rep)
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "RESOURCE EXHAUSTED",
+                "Out of memory", "out of memory", "OOM")
+
+
+def is_oom(exc: BaseException) -> bool:
+    """True for device allocation failures: jax surfaces them as
+    XlaRuntimeError with a RESOURCE_EXHAUSTED status (message text is
+    the stable part of that contract across jax versions)."""
+    if isinstance(exc, MemoryError):
+        return True
+    s = f"{type(exc).__name__}: {exc}"
+    return any(m in s for m in _OOM_MARKERS)
+
+
+def _format_report(rep: Dict[str, Any]) -> str:
+    lines = [f"  total {rep['total_bytes']} bytes in "
+             f"{rep['buffers']} buffers; watermark "
+             f"{rep['watermark_bytes']}; budget "
+             f"{rep['budget_bytes'] or 'none'}; executables "
+             f"{rep['executable_bytes']} bytes"]
+    for owner, nb in rep["owners"].items():
+        pct = 100.0 * nb / max(1, rep["total_bytes"])
+        lines.append(f"  {owner:<12s} {nb:>16d} bytes  {pct:5.1f}%")
+    for row in rep.get("top", ()):
+        lines.append(f"    {row['owner']:<10s} {row['nbytes']:>14d}  "
+                     f"{row['dtype']} {row['shape']}")
+    return "\n".join(lines)
+
+
+def maybe_handle_oom(kind: str, exc: BaseException) -> bool:
+    """If `exc` is a device OOM: count it, force an attributed sweep,
+    log the ranked per-owner report and emit an `oom` event. The caller
+    re-raises either way; returns whether it was handled."""
+    if not is_oom(exc):
+        return False
+    OOMS.inc(kind=kind)
+    rep = sweep(force=True, top=True)
+    fields: Dict[str, Any] = {"dispatch_kind": kind,
+                              "error": str(exc)[:300]}
+    if rep is not None:
+        log.error("RESOURCE_EXHAUSTED on dispatch kind=%s — live-buffer "
+                  "forensics:\n%s", kind, _format_report(rep))
+        fields.update(
+            total_bytes=rep["total_bytes"], buffers=rep["buffers"],
+            owners=rep["owners"],
+            watermark_bytes=rep["watermark_bytes"],
+            budget_bytes=rep["budget_bytes"],
+            top=[{"owner": r["owner"], "nbytes": r["nbytes"],
+                  "shape": r["shape"], "dtype": r["dtype"]}
+                 for r in rep.get("top", ())[:5]])
+    else:
+        log.error("RESOURCE_EXHAUSTED on dispatch kind=%s (live-array "
+                  "walk unavailable): %s", kind, exc)
+    _events.emit("oom", **fields)
+    return True
+
+
+@contextlib.contextmanager
+def oom_guard(kind: str):
+    """Wrap a dispatch path: a RESOURCE_EXHAUSTED escaping the body is
+    dumped as forensics (ranked owner report + `oom` event) and
+    re-raised unchanged."""
+    try:
+        yield
+    except BaseException as e:
+        maybe_handle_oom(kind, e)
+        raise

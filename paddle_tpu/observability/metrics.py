@@ -118,6 +118,16 @@ class Gauge(_Metric):
     def dec(self, amount: float = 1, **labels):
         self.inc(-amount, **labels)
 
+    def set_max(self, value: float, **labels):
+        """Ratchet: keep the larger of the stored and offered value —
+        the high-watermark pattern (HBM peak bytes) without a
+        read-modify-write race at the call sites."""
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            cur = self._values.get(key, float("-inf"))
+            if value > cur:
+                self._values[key] = float(value)
+
     def value(self, **labels) -> float:
         key = _label_key(self.labelnames, labels)
         with self._lock:

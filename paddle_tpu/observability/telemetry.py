@@ -23,6 +23,7 @@ from typing import Dict, Optional
 from . import events as _events
 from . import httpd as _httpd
 from . import metrics as _m
+from . import perfwatch as _perfwatch
 from . import timeseries as _timeseries
 
 __all__ = [
@@ -197,13 +198,30 @@ def feed_nbytes(feed: Dict) -> int:
 
 
 class _StepRecord:
-    __slots__ = ("feed_bytes",)
+    __slots__ = ("feed_bytes", "perf_kind", "flops", "device_kind",
+                 "n_devices", "_host0")
 
     def __init__(self):
         self.feed_bytes = 0
+        self.perf_kind: Optional[str] = None
+        self.flops: Optional[float] = None
+        self.device_kind: Optional[str] = None
+        self.n_devices = 1
+        self._host0 = HOST_BLOCKED_SECONDS.total()
 
     def set_feed(self, feed: Dict):
         self.feed_bytes = feed_nbytes(feed)
+
+    def set_perf(self, kind: str, cost: Optional[Dict] = None,
+                 device_kind: Optional[str] = None, n_devices: int = 1):
+        """Arm the live-utilization record for this step: `kind` labels
+        the paddle_tpu_mfu gauge; `cost` is the dispatch wrapper's
+        retained cost_analysis dict (current_cost()). Without this call
+        the step records wall time only, no MFU sample."""
+        self.perf_kind = kind
+        self.flops = (cost or {}).get("flops")
+        self.device_kind = device_kind
+        self.n_devices = max(1, int(n_devices))
 
 
 @contextlib.contextmanager
@@ -212,11 +230,22 @@ def executor_step(mode: str):
     run_chained, and CompiledProgram._run so the timing boundary and byte
     accounting cannot drift apart). Records only on clean exit — a step
     that raises is not a completed step. Call `set_feed(norm_feed)` once
-    feeds are normalized."""
+    feeds are normalized; `set_perf(...)` once the compiled step is
+    resolved to also land a live-MFU sample (perfwatch)."""
     rec = _StepRecord()
     t0 = time.perf_counter()
     yield rec
-    record_executor_step(mode, time.perf_counter() - t0, rec.feed_bytes)
+    seconds = time.perf_counter() - t0
+    record_executor_step(mode, seconds, rec.feed_bytes)
+    if rec.perf_kind is not None:
+        # host-blocked attribution: the process-wide counter's delta
+        # across this step — exact for the common single-executor
+        # process, an upper-bound estimate under concurrent executors
+        host = max(0.0, HOST_BLOCKED_SECONDS.total() - rec._host0)
+        _perfwatch.record_step(
+            rec.perf_kind, seconds, flops=rec.flops,
+            host_blocked=min(host, seconds),
+            device_kind=rec.device_kind, n_devices=rec.n_devices)
 
 
 def record_cache_event(hit: bool, entries: int):

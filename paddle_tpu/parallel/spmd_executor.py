@@ -24,6 +24,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..observability import health as _health
+from ..observability import perfwatch as _perfwatch
 from ..observability import telemetry as _telemetry
 from ..observability import tracing as _tracing
 from ..core import framework, lowering
@@ -111,6 +112,7 @@ class SPMDRunner:
         # timer covers feed normalization + cache lookup + dispatch,
         # matching Executor.run's span
         t0 = time.perf_counter()
+        host0 = _telemetry.host_blocked_total()
         program = self.program
         scope = scope if scope is not None else global_scope()
         feed = dict(feed or {})
@@ -144,8 +146,24 @@ class SPMDRunner:
             # live-bytes gauge must not go dark on the SPMD-only path
             _record_live_device_memory()
         out = _finish_fetches(fetches, return_numpy, sync, site="spmd")
-        _telemetry.record_spmd_step(self.axis, time.perf_counter() - t0,
+        wall = time.perf_counter() - t0
+        _telemetry.record_spmd_step(self.axis, wall,
                                     step.collective_counts)
+        # live-MFU sample: retained cost_analysis FLOPs of the SPMD
+        # executable over this step's wall window, plus the step-time
+        # breakdown (measured host-blocked delta; ring-allreduce
+        # collective ESTIMATE from the mutable-state payload)
+        n_dev = self.mesh.size
+        dev_kind = mesh_device_kind(self.mesh)
+        cost = step.dispatch.current_cost() or {}
+        host = max(0.0, _telemetry.host_blocked_total() - host0)
+        coll = _perfwatch.estimate_collective_seconds(
+            dev_kind, n_dev, getattr(step, "payload_bytes", 0),
+            sum(step.collective_counts.values()))
+        _perfwatch.record_step(
+            "spmd", wall, flops=cost.get("flops"),
+            host_blocked=min(host, wall), collective_seconds=coll,
+            device_kind=dev_kind, n_devices=n_dev)
         return out
 
     def _build(self, feed_names: Tuple[str, ...],
@@ -254,6 +272,11 @@ class SPMDRunner:
                     raise ValueError(
                         f"feed '{n}' batch {v.shape[0]} not divisible by "
                         f"{n_dev} devices on axis '{axis}'")
+            # allreduce payload ≈ the mutable (gradient-updated) state:
+            # what run()'s collective-time estimate is grounded on
+            step.payload_bytes = sum(
+                int(getattr(v, "nbytes", 0))
+                for v in mut_states.values())
             return jitted(feed, const_states, mut_states, rng)
 
         # static per-program collective census: the c_* ops the transpiler
@@ -264,4 +287,6 @@ class SPMDRunner:
                 if op.type.startswith("c_"):
                     counts[op.type] = counts.get(op.type, 0) + 1
         step.collective_counts = counts
+        step.dispatch = jitted  # cost_analysis access for the MFU gauge
+        step.payload_bytes = 0
         return step

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import weakref
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -27,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core import precision as _precision
 from ..models.common import Params, ParamAxes, is_trainable
+from ..observability import memwatch as _memwatch
 from .sharding import LogicalRules, current_rules, named_sharding_tree
 
 
@@ -66,6 +68,7 @@ class TrainState:
         self.opt_state = opt_state
         self.step = step
         self.loss_scale = loss_scale
+        _live_states.add(self)
 
     def tree_flatten(self):
         return (self.params, self.opt_state, self.step,
@@ -78,6 +81,29 @@ class TrainState:
 
 jax.tree_util.register_pytree_node(
     TrainState, TrainState.tree_flatten, TrainState.tree_unflatten)
+
+# HBM owner attribution (memwatch): every live TrainState volunteers its
+# param and optimizer trees. Provider callables (not a one-time array
+# registration) because the donated update loop replaces every buffer
+# each step; registered once at import — memwatch rebuilds the id→owner
+# map per sweep, so tree_unflatten'd tracer instances that land in the
+# WeakSet during jit tracing are harmless (their leaf ids never match a
+# live device array).
+_live_states: "weakref.WeakSet[TrainState]" = weakref.WeakSet()
+
+
+def _live_param_arrays():
+    for st in list(_live_states):
+        yield from jax.tree_util.tree_leaves(st.params)
+
+
+def _live_opt_arrays():
+    for st in list(_live_states):
+        yield from jax.tree_util.tree_leaves(st.opt_state)
+
+
+_memwatch.register_provider("params", _live_param_arrays)
+_memwatch.register_provider("optimizer", _live_opt_arrays)
 
 
 def param_shardings(mesh: Mesh, axes: ParamAxes,

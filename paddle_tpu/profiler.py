@@ -12,10 +12,13 @@ and the jax device timeline."""
 from __future__ import annotations
 
 import contextlib
+import json
 import os
+import tempfile
+import threading
 import time
 from collections import defaultdict
-from typing import Optional
+from typing import Dict, Optional
 
 import jax
 
@@ -23,7 +26,8 @@ from .observability import tracing as _tracing
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
            "RecordEvent", "cuda_profiler", "npu_profiler",
-           "export_chrome_tracing"]
+           "export_chrome_tracing", "capture_profile", "ProfilerBusyError",
+           "PROFILE_DIR_ENV", "MAX_CAPTURE_SECONDS"]
 
 _trace_dir: Optional[str] = None
 _host_events = defaultdict(list)
@@ -128,6 +132,93 @@ def cuda_profiler(output_file=None, output_mode=None, config=None):
 
 
 npu_profiler = cuda_profiler
+
+
+# ---------------------------------------------------------------------------
+# On-demand bounded capture (the POST /v1/profile backend)
+# ---------------------------------------------------------------------------
+
+PROFILE_DIR_ENV = "PADDLE_TPU_PROFILE_DIR"
+MAX_CAPTURE_SECONDS = 120.0
+MIN_CAPTURE_SECONDS = 0.05
+
+_capture_lock = threading.Lock()
+
+
+class ProfilerBusyError(RuntimeError):
+    """A capture (or a manually started trace) is already running.
+    The jax profiler supports exactly one active trace per process, so
+    concurrent /v1/profile requests must 409, not queue — a queued
+    capture would measure a different window than the caller asked
+    about."""
+
+
+def capture_profile(seconds: float,
+                    out_dir: Optional[str] = None) -> Dict[str, object]:
+    """One bounded profiling window: jax host+device trace for
+    `seconds`, then a merged chrome trace plus the live perf/memory
+    attribution snapshot, written into a fresh artifact directory.
+
+    Returns {"dir", "trace", "perf", "seconds"} — `trace` is the merged
+    chrome://tracing JSON (unified span store + jax device timeline),
+    `perf` a JSON sidecar holding the perfwatch MFU/step-time snapshot
+    and the memwatch owner table taken at window close.
+
+    Raises ProfilerBusyError when a capture or a user-started
+    start_profiler() trace is active. Blocks the calling thread for the
+    window — HTTP servers routing here are threaded, so the process
+    keeps serving while the trace runs.
+    """
+    global _active
+    seconds = min(max(float(seconds), MIN_CAPTURE_SECONDS),
+                  MAX_CAPTURE_SECONDS)
+    if not _capture_lock.acquire(blocking=False):
+        raise ProfilerBusyError("a profile capture is already running")
+    try:
+        base = os.environ.get(PROFILE_DIR_ENV)
+        if out_dir is None:
+            if base:
+                os.makedirs(base, exist_ok=True)
+            out_dir = tempfile.mkdtemp(prefix="paddle-tpu-profile-",
+                                       dir=base or None)
+        try:
+            start_profiler(profile_path=out_dir)
+        except RuntimeError as e:
+            raise ProfilerBusyError(str(e)) from e
+        t0 = time.time()
+        try:
+            time.sleep(seconds)
+        finally:
+            # stop directly rather than via stop_profiler(): the
+            # aggregate host-event table printing belongs to the
+            # interactive API, not an HTTP handler's stdout
+            jax.profiler.stop_trace()
+            _active = False
+        trace_path = _tracing.export_trace(
+            os.path.join(out_dir, "trace.json"), trace_dir=out_dir)
+        perf_path = os.path.join(out_dir, "perf.json")
+        from .observability import events as _events
+        from .observability import memwatch as _memwatch
+        from .observability import perfwatch as _perfwatch
+        from .observability import telemetry as _telemetry
+
+        perf = {
+            "window_seconds": seconds,
+            "started_at": t0,
+            "perfwatch": _perfwatch.snapshot(),
+            "memory": _memwatch.status_block(),
+            "host_blocked_seconds_total":
+                _telemetry.host_blocked_total(),
+        }
+        from .resilience.atomic import json_dump as _json_dump
+        _json_dump(perf, perf_path, indent=2, sort_keys=True,
+                   default=str)
+        _events.emit("profile", dir=out_dir, seconds=seconds,
+                     trace=trace_path)
+        return {"dir": out_dir, "trace": trace_path, "perf": perf_path,
+                "seconds": seconds}
+    finally:
+        _capture_lock.release()
 
 
 def export_chrome_tracing(path, events=None):

@@ -46,6 +46,7 @@ import pickle
 import queue
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -56,7 +57,9 @@ from ..core import precision as _precision
 from ..core.async_exec import FetchHandle
 from ..core.executor import _JitDispatch
 from ..observability import events as _events
+from ..observability import memwatch as _memwatch
 from ..observability import metrics as _m
+from ..observability import perfwatch as _perfwatch
 from ..observability import telemetry as _telemetry
 from ..observability import tracing as _tracing
 from .batcher import QueueFullError, ServerClosed
@@ -302,6 +305,26 @@ class DecodeEngine:
 
         self._pools = init_pools(self.kv_cfg)
         self._alloc = BlockAllocator(self.kv_cfg)
+        self._device_kind = getattr(jax.devices()[0], "device_kind",
+                                    "unknown")
+        # HBM owner attribution: providers hand memwatch the CURRENT
+        # pool/param arrays on every sweep — donation replaces the pool
+        # buffers each step, so a one-time registration of the arrays
+        # themselves would go stale immediately. Weakref'd so a dropped
+        # engine (tests build many) never pins its pools alive.
+        ref = weakref.ref(self)
+
+        def _kv_arrays():
+            eng = ref()
+            return eng._pools if eng is not None else ()
+
+        def _param_arrays():
+            eng = ref()
+            return eng.params.values() if eng is not None else ()
+
+        self._mem_handles = [
+            _memwatch.register_provider("kv_pool", _kv_arrays),
+            _memwatch.register_provider("params", _param_arrays)]
         # deferred import: the analysis package must not load during
         # package bootstrap; constructors only run after it
         from ..analysis import lockcheck as _lockcheck
@@ -661,6 +684,9 @@ class DecodeEngine:
             self._finish(req, "cancelled")
         if t is not None:
             t.join(timeout=30.0)
+        for h in getattr(self, "_mem_handles", ()):
+            _memwatch.unregister_provider(h)
+        self._mem_handles = []
         _events.emit("decode", action="stop")
 
     def load(self) -> Tuple[int, int]:
@@ -837,6 +863,14 @@ class DecodeEngine:
             prompt_len=plen)
         _telemetry.record_dispatch_ready(
             "decode:prefill", time.perf_counter() - t0)
+        # live-MFU sample: the bucket executable's retained
+        # cost_analysis FLOPs over this prefill's wall window (one
+        # token emitted — the TTFT token)
+        _perfwatch.record_step(
+            "prefill", time.perf_counter() - t0,
+            flops=(self._prefill[bucket].current_cost() or {})
+            .get("flops"),
+            tokens=1, device_kind=self._device_kind)
         req.pos = plen
         req.admitted_at = time.monotonic()
         self._active.append(req)
@@ -932,8 +966,21 @@ class DecodeEngine:
         """Consume one in-flight step's tokens: stream them, detect
         finishes, retire (freeing blocks). Tokens for slots that were
         already retired/preempted after dispatch are discarded."""
+        t_wait = time.perf_counter()
         toks = np.asarray(pending.handle.result()[0])
-        STEP_SECONDS.observe(time.perf_counter() - pending.t_dispatch)
+        now = time.perf_counter()
+        wall = now - pending.t_dispatch
+        STEP_SECONDS.observe(wall)
+        # live-MFU sample: the slot-config executable's retained FLOPs
+        # over the dispatch→resolve window; the result() wait is the
+        # host-blocked share, occupied slots are the tokens produced
+        C = len(pending.slots)
+        _perfwatch.record_step(
+            "decode", wall,
+            flops=(self._decode[C].current_cost() or {}).get("flops"),
+            tokens=sum(1 for r in pending.slots if r is not None),
+            host_blocked=min(now - t_wait, wall),
+            device_kind=self._device_kind)
         for i, req in enumerate(pending.slots):
             if req is None or req not in self._active:
                 continue

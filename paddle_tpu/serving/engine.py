@@ -26,6 +26,7 @@ from ..core import compile_cache as _cc
 from ..core import precision as _precision
 from ..inference import AnalysisConfig, Predictor, create_paddle_predictor
 from ..observability import events as _events
+from ..observability import memwatch as _memwatch
 from ..observability import metrics as _m
 from ..observability import tracing as _tracing
 from .bucketing import BucketPolicy, common_batch
@@ -490,7 +491,8 @@ class Engine:
         # its lead request's trace around this call); when sampled, the
         # device dispatch gets its own span with the bucket attributed
         with _tracing.trace_span("serve.dispatch", cat="serve",
-                                 bucket=int(bucket), rows=int(n)):
+                                 bucket=int(bucket), rows=int(n)), \
+                _memwatch.oom_guard("serving"):
             out = self._pred.predict_handle(**feeds).result()
         BUCKET_SECONDS.observe(time.perf_counter() - t0,
                                bucket=str(bucket))

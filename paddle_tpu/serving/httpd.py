@@ -60,6 +60,7 @@ import numpy as np
 
 from ..observability import events as _events
 from ..observability import httpbase as _base
+from ..observability import memwatch as _memwatch
 from ..observability import slo as _slo
 from ..observability import timeseries as _timeseries
 from ..observability import tracing as _tracing
@@ -222,10 +223,21 @@ class _ServingHandler(_base.QuietHandler):
             # span threads through batcher/decode/engine spans
             self._tctx = _tracing.begin_request(self.headers)
             path = urlparse(self.path).path
+            if path == "/v1/profile":
+                # on-demand capture on the SERVING port: the fleet
+                # router can profile a replica under live traffic
+                # through the same address it routes inference to.
+                # This handler thread blocks for the window; the
+                # ThreadingHTTPServer keeps /v1/predict flowing.
+                from ..observability.httpd import handle_profile_request
+
+                code, body = handle_profile_request(self)
+                self._reply(code, "application/json", body)
+                return
             if path not in ("/v1/predict", "/v1/generate"):
                 self._reply(404, "text/plain",
                             "not found; POST routes: /v1/predict, "
-                            "/v1/generate\n")
+                            "/v1/generate, /v1/profile\n")
                 return
             try:
                 length = int(self.headers.get("Content-Length", "0"))
@@ -546,6 +558,7 @@ class Server:
             "max_wait_ms": self.config.max_wait_ms,
             "timeout_s": self.config.timeout_s,
             "requests": self._counts(),
+            "memory": _memwatch.status_block(),
         }
         if self._engine is not None:
             st.update(self._engine.status())

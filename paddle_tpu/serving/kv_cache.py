@@ -147,6 +147,7 @@ class BlockAllocator:
             "allocated_token_capacity": cap,
             "internal_waste_tokens": waste,
             "waste_fraction": round(waste / cap, 4) if cap else 0.0,
+            "pool_bytes": self.cfg.pool_bytes(),
         }
 
 

@@ -69,7 +69,7 @@ import urllib.error
 import urllib.request
 from collections import deque
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
-from urllib.parse import urlparse
+from urllib.parse import parse_qs, urlparse
 
 from ..observability import events as _events
 from ..observability import httpbase as _base
@@ -775,6 +775,55 @@ class Router:
             return None
         return xs[min(len(xs) - 1, int(round(0.99 * (len(xs) - 1))))]
 
+    def profile(self, seconds: float = 1.0,
+                replica: Optional[str] = None,
+                timeout_s: Optional[float] = None) -> Dict:
+        """Fan POST /v1/profile across the healthy fleet — or at ONE
+        member when `replica` names an endpoint — and collect each
+        capture's artifact paths. Replicas trace concurrently (one
+        thread per target), so a fleet-wide capture covers the same
+        wall window on every member; per-replica wire failures land in
+        that replica's entry instead of failing the whole fan-out.
+        Raises NoReplicasError when nothing is targetable."""
+        if replica is not None:
+            if replica not in self.endpoints():
+                raise NoReplicasError(
+                    f"unknown replica {replica!r}; members: "
+                    f"{self.endpoints()}")
+            targets = [replica]
+        else:
+            targets = self.healthy_endpoints()
+            if not targets:
+                raise NoReplicasError("no healthy replicas to profile")
+        # the reply can only come back after the capture window closes,
+        # so the per-replica HTTP timeout must cover window + export
+        timeout = float(timeout_s) if timeout_s is not None \
+            else float(seconds) + 30.0
+        results: Dict[str, Dict] = {}
+
+        def one(ep):
+            try:
+                code, body = self._post(
+                    ep, "/v1/profile", {"seconds": float(seconds)},
+                    timeout)
+            except (OSError, urllib.error.URLError) as e:
+                code, body = None, {"error": str(e)}
+            if not isinstance(body, dict):
+                body = {"body": body}
+            results[ep] = {"code": code, **body}
+
+        threads = [threading.Thread(target=one, args=(ep,),
+                                    daemon=True) for ep in targets]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=timeout + 10.0)
+        ok = sum(1 for r in results.values() if r.get("code") == 200)
+        _events.emit("profile", action="fleet", seconds=float(seconds),
+                     targets=len(targets), ok=ok)
+        return {"seconds": float(seconds), "targets": len(targets),
+                "ok": ok, "replicas": results}
+
     def status(self) -> Dict:
         with self._lock:
             reps = [{
@@ -973,27 +1022,57 @@ class _RouterHandler(_base.QuietHandler):
         self.wfile.flush()
         self.close_connection = True
 
+    def _do_profile(self, query: str, payload: Dict):
+        """POST /v1/profile[?replica=host:port] — proxy the capture to
+        one replica or fan it across the healthy fleet. The reply
+        aggregates each member's artifact paths (or its failure)."""
+        try:
+            seconds = float(payload.get("seconds", 1.0))
+        except (TypeError, ValueError):
+            self._json_reply(400, {"error": '"seconds" must be a '
+                                            'number'})
+            return
+        replica = parse_qs(query).get("replica", [None])[0] \
+            or payload.get("replica")
+        router = self.router_server.router
+        try:
+            body = router.profile(seconds, replica=replica,
+                                  timeout_s=payload.get("timeout_s"))
+        except NoReplicasError as e:
+            self._json_reply(503, {"error": str(e)})
+            return
+        except FleetError as e:
+            self._json_reply(502, {"error": str(e)})
+            return
+        self._json_reply(200, body)
+
     def do_POST(self):  # noqa: N802 - stdlib naming
         try:
             # trace root at the fleet edge: extract the caller's
             # traceparent or start (head-sample) a fresh trace; every
             # reply echoes X-Request-Id + traceparent
             self._tctx = _tracing.begin_request(self.headers)
-            path = urlparse(self.path).path
-            if path not in ("/v1/predict", "/v1/generate"):
+            url = urlparse(self.path)
+            path = url.path
+            if path not in ("/v1/predict", "/v1/generate",
+                            "/v1/profile"):
                 self._reply(404, "text/plain",
                             "not found; POST routes: /v1/predict, "
-                            "/v1/generate\n")
+                            "/v1/generate, /v1/profile\n")
                 return
             try:
                 length = int(self.headers.get("Content-Length", "0"))
-                payload = json.loads(self.rfile.read(length))
+                payload = json.loads(self.rfile.read(length) or b"{}") \
+                    if length else {}
             except (ValueError, TypeError):
                 self._json_reply(400, {"error": "body must be JSON"})
                 return
             if not isinstance(payload, dict):
                 self._json_reply(400, {"error": "body must be a JSON "
                                                 "object"})
+                return
+            if path == "/v1/profile":
+                self._do_profile(url.query, payload)
                 return
             if path == "/v1/generate":
                 self._do_generate(payload)

@@ -125,6 +125,8 @@ _INSTRUMENTED_MODULES = (
     "paddle_tpu.serving.autoscale",
     "paddle_tpu.serving.httpd",
     "paddle_tpu.distributed.launch_serve",
+    "paddle_tpu.observability.perfwatch",
+    "paddle_tpu.observability.memwatch",
 )
 
 # Metrics this PR introduced: documentation is part of their contract.
@@ -132,6 +134,17 @@ _MUST_BE_DOCUMENTED = (
     "paddle_tpu_slo_burn_rate",
     "paddle_tpu_slo_alerts_total",
     "paddle_tpu_ts_samples_total",
+    "paddle_tpu_mfu",
+    "paddle_tpu_flops_per_sec",
+    "paddle_tpu_steps_per_sec",
+    "paddle_tpu_tokens_per_sec_per_chip",
+    "paddle_tpu_step_time_seconds_total",
+    "paddle_tpu_hbm_bytes",
+    "paddle_tpu_hbm_buffers",
+    "paddle_tpu_hbm_watermark_bytes",
+    "paddle_tpu_hbm_budget_bytes",
+    "paddle_tpu_executable_bytes",
+    "paddle_tpu_oom_total",
 )
 
 

@@ -1,0 +1,649 @@
+"""Performance flight recorder (ISSUE 17, PROFILE.md §Continuous
+profiling): live MFU attribution math, owner-tagged HBM accounting,
+OOM forensics, budget gating, and the on-demand /v1/profile capture.
+
+The load-bearing claims pinned here:
+
+- the windowed MFU is exactly window-FLOPs / elapsed / (n_devices x
+  per-device-kind peak) and decays toward zero when steps stop;
+- step-time attribution conserves wall time (device + host_blocked +
+  collective == recorded seconds);
+- executor dispatches retain their executable's cost_analysis() FLOPs
+  and feed the live gauge without any bench harness in the loop;
+- owner attribution sums exactly to the jax.live_arrays() total, and a
+  decode engine's KV pool/params register themselves;
+- an intercepted RESOURCE_EXHAUSTED dumps a ranked per-owner report
+  naming the KV pool as top consumer and emits an `oom` event before
+  re-raising unchanged;
+- POST /v1/profile on a live server returns a well-formed merged
+  chrome trace while concurrent scrapes see zero failures.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import profiler
+from paddle_tpu.core.executor import _JitDispatch
+from paddle_tpu.observability import events
+from paddle_tpu.observability import device_peaks
+from paddle_tpu.observability import httpd as obs_httpd
+from paddle_tpu.observability import memwatch
+from paddle_tpu.observability import metrics as om
+from paddle_tpu.observability import perfwatch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _counter_value(snap, name, **labels):
+    for s in snap.get(name, {}).get("series", []):
+        if s["labels"] == {k: str(v) for k, v in labels.items()}:
+            return s.get("value", s.get("count"))
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# MFU math (deterministic: injected `now`)
+# ---------------------------------------------------------------------------
+
+
+def test_mfu_math_vs_fake_cost_analysis():
+    perfwatch.reset()
+    # 10 steps of 1e12 FLOPs each over a 10 s window on one v5e chip
+    t0 = 1000.0
+    for i in range(10):
+        perfwatch.record_step("step", 0.5, flops=1e12,
+                              device_kind="TPU v5 lite e", n_devices=1,
+                              now=t0 + i)
+    snap = perfwatch.snapshot(now=t0 + 10.0)["step"]
+    peak = device_peaks.lookup("TPU v5 lite e").flops
+    assert peak == 197e12
+    assert snap["peak_flops"] == peak
+    # elapsed = now - oldest entry = 10 s -> 1e13/10 FLOP/s
+    assert snap["flops_per_sec"] == pytest.approx(1e12, rel=1e-6)
+    assert snap["mfu"] == pytest.approx(1e12 / peak, rel=1e-6)
+    assert snap["steps_per_sec"] == pytest.approx(1.0, rel=1e-6)
+    # idle decay: the same window read 40 s later is 5x dilated
+    later = perfwatch.snapshot(now=t0 + 50.0)["step"]
+    assert later["mfu"] == pytest.approx(snap["mfu"] / 5, rel=1e-6)
+    # ... and past the 60 s horizon the window empties to exactly 0
+    gone = perfwatch.snapshot(now=t0 + 100.0)["step"]
+    assert gone["mfu"] == 0.0 and gone["steps"] == 0
+    perfwatch.reset()
+
+
+def test_mfu_multi_device_normalization_and_tokens():
+    perfwatch.reset()
+    t0 = 2000.0
+    perfwatch.record_step("spmd", 1.0, flops=8e12, tokens=0,
+                          device_kind="TPU v5 lite", n_devices=8,
+                          now=t0)
+    snap = perfwatch.snapshot(now=t0 + 1.0)["spmd"]
+    assert snap["mfu"] == pytest.approx(8e12 / (8 * 197e12), rel=1e-6)
+    perfwatch.record_step("decode", 0.5, flops=1e9, tokens=6,
+                          device_kind="TPU v5 lite", n_devices=2,
+                          now=t0 + 1.0)
+    d = perfwatch.snapshot(now=t0 + 2.0)["decode"]
+    assert d["tokens_per_sec_per_chip"] == pytest.approx(3.0, rel=1e-6)
+    perfwatch.reset()
+
+
+def test_step_time_attribution_conserves_wall():
+    before = om.snapshot()
+    perfwatch.record_step("spmd", 1.0, flops=1.0, host_blocked=0.25,
+                          collective_seconds=0.15, n_devices=4,
+                          now=3000.0)
+    after = om.snapshot()
+
+    def delta(component):
+        return (_counter_value(after, "paddle_tpu_step_time_seconds_total",
+                               kind="spmd", component=component)
+                - _counter_value(before,
+                                 "paddle_tpu_step_time_seconds_total",
+                                 kind="spmd", component=component))
+
+    assert delta("host_blocked") == pytest.approx(0.25)
+    assert delta("collective") == pytest.approx(0.15)
+    assert delta("device") == pytest.approx(0.60)
+    # clamping: host+collective can never exceed wall
+    perfwatch.record_step("spmd", 1.0, host_blocked=5.0,
+                          collective_seconds=5.0, now=3001.0)
+    clamped = om.snapshot()
+    assert (_counter_value(clamped, "paddle_tpu_step_time_seconds_total",
+                           kind="spmd", component="host_blocked")
+            - _counter_value(after, "paddle_tpu_step_time_seconds_total",
+                             kind="spmd", component="host_blocked")
+            ) == pytest.approx(1.0)
+    perfwatch.reset()
+
+
+def test_collective_estimate_ring_allreduce():
+    bw = device_peaks.lookup("TPU v5 lite").ici_bytes_per_s
+    est = perfwatch.estimate_collective_seconds("TPU v5 lite", 4,
+                                                1 << 30, 2)
+    assert est == pytest.approx(2 * 3 / 4 * (1 << 30) / bw)
+    # ungroundable estimates are 0, not a guess
+    assert perfwatch.estimate_collective_seconds("TPU v5 lite", 1,
+                                                 1 << 30, 2) == 0.0
+    assert perfwatch.estimate_collective_seconds("TPU v5 lite", 4,
+                                                 0, 2) == 0.0
+    assert perfwatch.estimate_collective_seconds("TPU v5 lite", 4,
+                                                 1 << 30, 0) == 0.0
+
+
+def test_mfu_gauge_published_at_scrape_time():
+    perfwatch.reset()
+    perfwatch.record_step("step", 0.1, flops=5e9,
+                          device_kind="cpu", n_devices=1)
+    snap = om.snapshot()  # collect hook runs here
+    val = _counter_value(snap, "paddle_tpu_mfu", kind="step")
+    assert val > 0
+    perfwatch.reset()
+
+
+# ---------------------------------------------------------------------------
+# Executor integration: retained cost_analysis feeds the live gauge
+# ---------------------------------------------------------------------------
+
+
+def _linreg_program(n_features=4):
+    main, startup = pt.Program(), pt.Program()
+    with pt.framework.unique_name.guard(), \
+            pt.program_guard(main, startup):
+        x = pt.layers.data(name="x", shape=[n_features], dtype="float32")
+        y = pt.layers.data(name="y", shape=[1], dtype="float32")
+        pred = pt.layers.fc(x, size=1)
+        loss = pt.layers.mean(pt.layers.square_error_cost(pred, y))
+        pt.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return main, startup, loss
+
+
+def test_executor_steps_feed_live_mfu():
+    perfwatch.reset()
+    main, startup, loss = _linreg_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    perfwatch.reset()  # drop the startup-program dispatch
+    feed = {"x": np.random.rand(8, 4).astype(np.float32),
+            "y": np.random.rand(8, 1).astype(np.float32)}
+    for _ in range(3):
+        exe.run(main, feed=feed, fetch_list=[loss])
+    snap = perfwatch.snapshot()
+    assert "step" in snap
+    st = snap["step"]
+    assert st["steps"] == 3
+    assert st["device_kind"] == "cpu"
+    # the XLA cost model reports real FLOPs for the fc+loss+sgd step
+    assert st["flops_per_sec"] > 0
+    # the dispatch retained its compiled cost by signature
+    step = next(iter(exe._cache.values()))
+    cost = step.fn.current_cost()
+    assert cost is not None and cost["flops"] > 0
+    assert cost["code_bytes"] >= 0
+    perfwatch.reset()
+
+
+def test_executable_bytes_gauge_tracks_live_dispatches():
+    main, startup, loss = _linreg_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.zeros((4, 4), np.float32),
+            "y": np.zeros((4, 1), np.float32)}
+    exe.run(main, feed=feed, fetch_list=[loss])
+    rep = memwatch.report(top=False)
+    assert rep is not None
+    assert rep["executables"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# HBM owner attribution
+# ---------------------------------------------------------------------------
+
+
+def test_owner_attribution_sums_to_live_total():
+    a = jnp.zeros((128, 128), jnp.float32)
+    b = jnp.zeros((64,), jnp.float32)
+    h1 = memwatch.register_provider("kv_pool", lambda: [a])
+    h2 = memwatch.register_provider("params", lambda: [b])
+    try:
+        rep = memwatch.report(top=True)
+        assert rep is not None
+        # conservation: every owner's bytes sum to the live total
+        assert sum(rep["owners"].values()) == rep["total_bytes"]
+        assert rep["owners"]["kv_pool"] >= a.nbytes
+        assert rep["owners"]["params"] >= b.nbytes
+        # the ranked list is sorted descending
+        tops = [r["nbytes"] for r in rep["top"]]
+        assert tops == sorted(tops, reverse=True)
+        assert rep["watermark_bytes"] >= rep["total_bytes"]
+    finally:
+        memwatch.unregister_provider(h1)
+        memwatch.unregister_provider(h2)
+    # unregistered: the same arrays fall back to "other"
+    rep = memwatch.report(top=False)
+    assert rep["owners"].get("kv_pool", 0) < a.nbytes + b.nbytes \
+        or rep["owners"].get("params", 0) == 0
+
+
+def test_first_provider_registration_wins_on_overlap():
+    a = jnp.ones((32,), jnp.float32)
+    h1 = memwatch.register_provider("kv_pool", lambda: [a])
+    h2 = memwatch.register_provider("params", lambda: [a])
+    try:
+        rep = memwatch.report(top=False)
+        assert rep["owners"].get("kv_pool", 0) >= a.nbytes
+    finally:
+        memwatch.unregister_provider(h1)
+        memwatch.unregister_provider(h2)
+
+
+def test_trainstate_registers_param_and_optimizer_owners():
+    from paddle_tpu.parallel.train import TrainState
+
+    st = TrainState(params={"w": jnp.ones((256, 256), jnp.float32)},
+                    opt_state={"m": jnp.zeros((256, 256), jnp.float32)},
+                    step=jnp.zeros((), jnp.int32))
+    rep = memwatch.report(top=False)
+    assert rep["owners"].get("params", 0) >= st.params["w"].nbytes
+    assert rep["owners"].get("optimizer", 0) >= \
+        st.opt_state["m"].nbytes
+    del st
+
+
+# ---------------------------------------------------------------------------
+# Budget gating (PADDLE_TPU_HBM_BUDGET_BYTES)
+# ---------------------------------------------------------------------------
+
+
+def test_budget_warn_error_gating(monkeypatch):
+    keep = jnp.zeros((1024,), jnp.float32)  # >=4 KiB live
+    base = memwatch.report(top=False)["total_bytes"]
+    assert base >= keep.nbytes
+    events.clear()
+    # budget far above live bytes: ok, no event
+    monkeypatch.setenv(memwatch.BUDGET_ENV, str(base * 100))
+    rep = memwatch.report(top=False)
+    assert rep["budget_state"] == "ok"
+    assert events.recent(kind="hbm_budget") == []
+    # warn band: live/budget in [0.85, 1.0)
+    monkeypatch.setenv(memwatch.BUDGET_ENV, str(int(base / 0.9)))
+    rep = memwatch.report(top=False)
+    assert rep["budget_state"] == "warn"
+    evs = events.recent(kind="hbm_budget")
+    assert evs and evs[-1]["level"] == "warn"
+    # transition-only: a second sweep in the same state stays quiet
+    memwatch.report(top=False)
+    assert len(events.recent(kind="hbm_budget")) == len(evs)
+    # error band: budget below live bytes
+    monkeypatch.setenv(memwatch.BUDGET_ENV, str(max(1, base // 2)))
+    rep = memwatch.report(top=False)
+    assert rep["budget_state"] == "error"
+    assert events.recent(kind="hbm_budget")[-1]["level"] == "error"
+    # recovery: removing the budget returns to ok silently
+    monkeypatch.delenv(memwatch.BUDGET_ENV)
+    rep = memwatch.report(top=False)
+    assert rep["budget_state"] == "ok"
+    snap = om.snapshot()
+    assert _counter_value(snap, "paddle_tpu_hbm_budget_bytes") == 0
+    del keep
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+
+def _fake_oom():
+    return RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating 17179869184 "
+        "bytes (XlaRuntimeError)")
+
+
+def test_oom_forensics_ranks_kv_pool_top(model=None):
+    """The acceptance post-mortem: under a decode engine, an injected
+    RESOURCE_EXHAUSTED names the KV pool as top consumer."""
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import DecodeConfig, DecodeEngine
+
+    cfg = gpt.GPTConfig.tiny()
+    cfg.dtype = "float32"
+    params, _ = gpt.init(jax.random.key(0), cfg)
+    eng = DecodeEngine(params, cfg, DecodeConfig(
+        block_size=8, num_blocks=512, decode_slots=(4,),
+        prefill_buckets=(8,), precision="f32", max_len=64))
+    try:
+        events.clear()
+        exc = _fake_oom()
+        assert memwatch.is_oom(exc)
+        before = om.snapshot()
+        assert memwatch.maybe_handle_oom("decode", exc) is True
+        after = om.snapshot()
+        assert (_counter_value(after, "paddle_tpu_oom_total",
+                               kind="decode")
+                - _counter_value(before, "paddle_tpu_oom_total",
+                                 kind="decode")) == 1
+        evs = events.recent(kind="oom")
+        assert len(evs) == 1
+        ev = evs[0]
+        assert ev["dispatch_kind"] == "decode"
+        assert "RESOURCE_EXHAUSTED" in ev["error"]
+        # ranked attribution attached, KV pool on top (2 pools of
+        # 512 blocks dwarf the tiny params)
+        assert ev["owners"]["kv_pool"] >= ev["owners"].get("params", 0)
+        assert ev["top"][0]["owner"] == "kv_pool"
+        assert ev["total_bytes"] == sum(ev["owners"].values())
+    finally:
+        eng.stop()
+    # stop() unregistered the providers: pools may still be live via
+    # eng, but no longer attributed
+    del eng
+
+
+def test_oom_not_triggered_by_ordinary_errors():
+    events.clear()
+    assert memwatch.maybe_handle_oom("step", ValueError("shape")) is False
+    assert events.recent(kind="oom") == []
+
+
+def test_jit_dispatch_intercepts_oom_and_reraises():
+    disp = _JitDispatch(jax.jit(lambda x: x + 1), "step")
+    x = np.zeros((2,), np.float32)
+    assert np.allclose(np.asarray(disp(x)), 1.0)  # warm path intact
+
+    def _boom(*a):
+        raise _fake_oom()
+
+    disp._dispatch = _boom  # instance attr shadows the bound method
+    events.clear()
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        disp(x)
+    evs = events.recent(kind="oom")
+    assert len(evs) == 1 and evs[0]["dispatch_kind"] == "step"
+
+
+def test_oom_guard_reraises_unchanged():
+    events.clear()
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        with memwatch.oom_guard("serving"):
+            raise _fake_oom()
+    assert events.recent(kind="oom")[0]["dispatch_kind"] == "serving"
+    # non-OOM errors pass through without an event
+    events.clear()
+    with pytest.raises(KeyError):
+        with memwatch.oom_guard("serving"):
+            raise KeyError("feed")
+    assert events.recent(kind="oom") == []
+
+
+# ---------------------------------------------------------------------------
+# On-demand capture: POST /v1/profile
+# ---------------------------------------------------------------------------
+
+
+def test_profile_endpoint_live_zero_failed_requests(tmp_path,
+                                                    monkeypatch):
+    monkeypatch.setenv(profiler.PROFILE_DIR_ENV, str(tmp_path))
+    main, startup, loss = _linreg_program()
+    exe = pt.Executor(pt.CPUPlace())
+    exe.run(startup)
+    feed = {"x": np.random.rand(8, 4).astype(np.float32),
+            "y": np.random.rand(8, 1).astype(np.float32)}
+    port = obs_httpd.start_http_server(0)
+    stop = threading.Event()
+    failures = []
+
+    def drive_steps():
+        # throttled: an unthrottled loop on CPU floods the jax trace
+        # with thousands of dispatches and stop/export dominates
+        while not stop.is_set():
+            exe.run(main, feed=feed, fetch_list=[loss])
+            time.sleep(0.02)
+
+    def scrape():
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics",
+                        timeout=10) as r:
+                    if r.status != 200:
+                        failures.append(r.status)
+            except Exception as e:
+                failures.append(repr(e))
+            time.sleep(0.02)
+
+    threads = [threading.Thread(target=drive_steps, daemon=True),
+               threading.Thread(target=scrape, daemon=True)]
+    for t in threads:
+        t.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/profile",
+            data=json.dumps({"seconds": 0.4}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200
+            out = json.loads(r.read())
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        obs_httpd.stop_http_server()
+    assert failures == []  # the capture never blocked the scraper
+    assert out["dir"].startswith(str(tmp_path))
+    # well-formed merged chrome trace
+    with open(out["trace"]) as f:
+        trace = json.load(f)
+    assert isinstance(trace["traceEvents"], list)
+    # the merged timeline carries complete spans (metadata stubs from
+    # the jax device trace may omit ph/name, but real spans must not)
+    assert any(ev.get("ph") == "X" and "name" in ev
+               for ev in trace["traceEvents"]), "no complete spans"
+    # the perf sidecar carries the live attribution at window close
+    with open(out["perf"]) as f:
+        perf = json.load(f)
+    assert "step" in perf["perfwatch"]
+    assert perf["perfwatch"]["step"]["flops_per_sec"] >= 0
+    assert "owners" in perf["memory"]
+    evs = events.recent(kind="profile")
+    assert evs and evs[-1]["dir"] == out["dir"]
+
+
+def test_profile_endpoint_busy_409_and_bad_request_400():
+    port = obs_httpd.start_http_server(0)
+    url = f"http://127.0.0.1:{port}/v1/profile"
+    try:
+        t = threading.Thread(
+            target=lambda: profiler.capture_profile(1.0), daemon=True)
+        t.start()
+        time.sleep(0.2)  # let the capture take the lock
+        req = urllib.request.Request(
+            url, data=json.dumps({"seconds": 0.1}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 409
+        t.join(timeout=30)
+        # malformed bodies are 400, not 500
+        for bad in (b"[1, 2]", b'{"seconds": "soon"}'):
+            req = urllib.request.Request(
+                url, data=bad,
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 400
+    finally:
+        obs_httpd.stop_http_server()
+
+
+def test_capture_clamps_window_and_single_flight():
+    out = profiler.capture_profile(0.0)  # clamped up to the minimum
+    assert out["seconds"] == profiler.MIN_CAPTURE_SECONDS
+    with pytest.raises(profiler.ProfilerBusyError):
+        t = threading.Thread(
+            target=lambda: profiler.capture_profile(0.8), daemon=True)
+        t.start()
+        time.sleep(0.2)
+        try:
+            profiler.capture_profile(0.1)
+        finally:
+            t.join(timeout=30)
+
+
+def test_obsdump_profile_renders_capture(tmp_path, monkeypatch):
+    import subprocess
+    import sys
+
+    monkeypatch.setenv(profiler.PROFILE_DIR_ENV, str(tmp_path))
+    out = profiler.capture_profile(0.1)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obsdump.py"),
+         "profile", out["dir"], "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    summary = json.loads(proc.stdout)
+    assert summary["trace_events"] >= 1
+    assert "perf" in summary
+
+
+def test_obsdump_mem_renders_snapshot(tmp_path):
+    import subprocess
+    import sys
+
+    memwatch.report(top=False)  # ensure the gauges carry a sweep
+    snap_path = tmp_path / "metrics.json"
+    snap_path.write_text(json.dumps(om.snapshot(), default=str))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "obsdump.py"),
+         "mem", str(snap_path), "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    out = json.loads(proc.stdout)
+    assert "owners" in out and "watermark_bytes" in out
+
+
+# ---------------------------------------------------------------------------
+# Serving surface: /v1/status memory block + router fan-out (slow)
+# ---------------------------------------------------------------------------
+
+
+def test_status_memory_block():
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import (DecodeConfig, DecodeEngine, Server,
+                                    ServingConfig)
+
+    cfg = gpt.GPTConfig.tiny()
+    cfg.dtype = "float32"
+    params, _ = gpt.init(jax.random.key(0), cfg)
+    eng = DecodeEngine(params, cfg, DecodeConfig(
+        block_size=8, num_blocks=64, decode_slots=(4,),
+        prefill_buckets=(8,), precision="f32", max_len=64))
+    try:
+        srv = Server(ServingConfig(warmup=False), decode=eng)
+        # status_block() is rate-limited (1 s min sweep interval); a
+        # forced sweep makes the engine's pools visible immediately
+        memwatch.report(top=False)
+        mem = srv.status()["memory"]
+        assert set(mem) >= {"total_bytes", "owners", "watermark_bytes",
+                            "budget_bytes", "budget_state"}
+        assert mem["budget_state"] in ("ok", "warn", "error")
+        assert mem["owners"].get("kv_pool", 0) > 0
+    finally:
+        eng.stop()
+
+
+@pytest.mark.slow
+def test_router_profiles_replica_under_load(tmp_path, monkeypatch):
+    """The fleet acceptance path: a live replica serving generate
+    traffic is profiled THROUGH the router with zero failed requests."""
+    from paddle_tpu.models import gpt
+    from paddle_tpu.serving import (DecodeConfig, DecodeEngine, Server,
+                                    ServingConfig)
+    from paddle_tpu.serving.router import Router, RouterServer
+
+    monkeypatch.setenv(profiler.PROFILE_DIR_ENV, str(tmp_path))
+    cfg = gpt.GPTConfig.tiny()
+    cfg.dtype = "float32"
+    params, _ = gpt.init(jax.random.key(0), cfg)
+    eng = DecodeEngine(params, cfg, DecodeConfig(
+        block_size=8, num_blocks=64, decode_slots=(4,),
+        prefill_buckets=(8,), precision="f32", max_len=64,
+        max_queue=32))
+    eng.warmup()
+    srv = Server(ServingConfig(warmup=False), decode=eng)
+    rep_port = srv.start(0)
+    router = Router([f"127.0.0.1:{rep_port}"], poll_interval_s=0.05)
+    front = RouterServer(router)
+    port = front.start(0)
+    stop = threading.Event()
+    failures = []
+
+    def gen_load():
+        # throttled: back-to-back generates on CPU make the 0.5 s
+        # trace window so dense that stop/export outlives the
+        # router's post timeout
+        url = f"http://127.0.0.1:{port}/v1/generate"
+        while not stop.is_set():
+            try:
+                req = urllib.request.Request(
+                    url, data=json.dumps(
+                        {"ids": [1, 2, 3], "max_new_tokens": 4,
+                         "stream": False}).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    if r.status != 200:
+                        failures.append(r.status)
+            except Exception as e:
+                failures.append(repr(e))
+            time.sleep(0.1)
+
+    try:
+        router.poll_once()
+        workers = [threading.Thread(target=gen_load, daemon=True)
+                   for _ in range(2)]
+        for w in workers:
+            w.start()
+        time.sleep(0.3)  # traffic flowing
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/profile",
+            data=json.dumps({"seconds": 0.5}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+            out = json.loads(r.read())
+        stop.set()
+        for w in workers:
+            w.join(timeout=30)
+        assert failures == []  # profiling never broke serving
+        assert out["targets"] == 1 and out["ok"] == 1
+        rep = out["replicas"][f"127.0.0.1:{rep_port}"]
+        assert rep["code"] == 200
+        with open(rep["trace"]) as f:
+            trace = json.load(f)
+        assert trace["traceEvents"]
+        # the capture window saw live decode steps
+        with open(rep["perf"]) as f:
+            perf = json.load(f)
+        assert "decode" in perf["perfwatch"] \
+            or "prefill" in perf["perfwatch"]
+        # targeting an unknown replica is a clean 503, not a hang
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/profile?replica=10.0.0.1:1",
+            data=b'{"seconds": 0.1}',
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 503
+    finally:
+        stop.set()
+        front.stop()
+        srv.stop()

@@ -57,6 +57,20 @@ Usage:
                                             # target, current, fast/slow
                                             # burn rates, alert state
                                             # (--window-scale, --json)
+  obsdump.py mem METRICS.json               # per-owner HBM attribution
+                                            # (kv_pool/params/optimizer/
+                                            # other), watermark, budget
+                                            # state (--live forces a
+                                            # fresh sweep + top-buffer
+                                            # ranking, --json)
+  obsdump.py profile DIR                    # render a /v1/profile
+                                            # capture dir: merged
+                                            # chrome-trace summary +
+                                            # MFU/memory attribution
+                                            # tables (--url URL triggers
+                                            # a capture on a live
+                                            # server first, --seconds,
+                                            # --json)
 
 Mixed-precision runs: `snapshot` surfaces the dynamic loss-scaling
 counters (paddle_tpu_amp_total{event=overflow|growth|skip}, the
@@ -958,6 +972,181 @@ def cmd_slo(args) -> int:
     return 0
 
 
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def cmd_mem(args) -> int:
+    """Per-owner HBM attribution: live bytes/buffers by owner
+    (kv_pool/params/optimizer/executables/other), the process
+    high-watermark, and the PADDLE_TPU_HBM_BUDGET_BYTES state —
+    PROFILE.md §Continuous profiling. With --live a fresh forced sweep
+    runs (including the ranked top-buffer list); from a snapshot file
+    the owner gauges of the LAST sweep are tabled."""
+    if args.live:
+        import paddle_tpu  # noqa: F401 — registers providers
+
+        from paddle_tpu.observability import memwatch
+        rep = memwatch.report(top=True) or {}
+        if args.json:
+            print(json.dumps(rep, indent=2, default=str))
+            return 0
+        owners = rep.get("owners") or {}
+        rows = [{"owner": o, "bytes": _fmt_bytes(b),
+                 "raw_bytes": int(b)} for o, b in owners.items()]
+        if rows:
+            _print_aligned(rows, ("owner", "bytes", "raw_bytes"))
+        else:
+            print("no live device buffers")
+        print(f"total     {_fmt_bytes(rep.get('total_bytes', 0))} "
+              f"in {rep.get('buffers', 0)} buffer(s)")
+        print(f"watermark {_fmt_bytes(rep.get('watermark_bytes', 0))}")
+        budget = rep.get("budget_bytes") or 0
+        print(f"budget    "
+              f"{_fmt_bytes(budget) if budget else 'unset'} "
+              f"({rep.get('budget_state', 'ok')})")
+        print(f"executables {_fmt_bytes(rep.get('executable_bytes', 0))}"
+              f" in {rep.get('executables', 0)} executable(s)")
+        top = rep.get("top") or []
+        if top:
+            print("top buffers:")
+            _print_aligned(
+                [{"owner": t.get("owner", "?"),
+                  "bytes": _fmt_bytes(t.get("nbytes", 0)),
+                  "shape": str(t.get("shape", "?")),
+                  "dtype": str(t.get("dtype", "?"))} for t in top],
+                ("owner", "bytes", "shape", "dtype"))
+        return 0
+    snap = _load_snap(args)
+    if snap is None:
+        print("mem: need a metrics.json path or --live",
+              file=sys.stderr)
+        return 2
+    owners = {}
+    for s in (snap.get("paddle_tpu_hbm_bytes") or {}).get("series", []):
+        owners[s.get("labels", {}).get("owner", "?")] = int(s["value"])
+
+    def scalar(name):
+        series = (snap.get(name) or {}).get("series", [])
+        return int(series[0]["value"]) if series else 0
+
+    rows = [{"owner": o, "bytes": _fmt_bytes(b), "raw_bytes": b}
+            for o, b in sorted(owners.items(), key=lambda kv: -kv[1])]
+    out = {"owners": owners,
+           "watermark_bytes":
+               scalar("paddle_tpu_hbm_watermark_bytes"),
+           "budget_bytes": scalar("paddle_tpu_hbm_budget_bytes"),
+           "executable_bytes": scalar("paddle_tpu_executable_bytes")}
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    if rows:
+        _print_aligned(rows, ("owner", "bytes", "raw_bytes"))
+    else:
+        print("no paddle_tpu_hbm_bytes samples in this snapshot (no "
+              "sweep ran, or introspection was off)")
+    print(f"watermark {_fmt_bytes(out['watermark_bytes'])}")
+    print(f"budget    "
+          f"{_fmt_bytes(out['budget_bytes']) if out['budget_bytes'] else 'unset'}")
+    print(f"executables {_fmt_bytes(out['executable_bytes'])}")
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """Render a /v1/profile capture: the merged chrome trace summary
+    plus the attribution tables (per-kind MFU/step rates, per-owner
+    HBM) the capture wrote alongside it. With --url, first trigger one
+    bounded capture on a live server (a replica's serving port, the
+    observability port, or the fleet router — the router reply
+    aggregates per-replica artifacts and is printed as JSON)."""
+    d = args.dir
+    if args.url:
+        import urllib.request
+        body = json.dumps({"seconds": args.seconds}).encode()
+        url = args.url.rstrip("/") + "/v1/profile"
+        req = urllib.request.Request(
+            url, data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=args.seconds + 60) as r:
+                out = json.loads(r.read())
+        except OSError as e:
+            print(f"profile: POST {url} failed: {e}", file=sys.stderr)
+            return 1
+        if "replicas" in out:  # router fan-out reply
+            print(json.dumps(out, indent=2))
+            return 0
+        d = out.get("dir")
+        print(f"captured {out.get('seconds')}s -> {d}")
+    if not d:
+        print("profile: need a capture dir or --url", file=sys.stderr)
+        return 2
+    trace_path = os.path.join(d, "trace.json")
+    perf_path = os.path.join(d, "perf.json")
+    summary = {"dir": d}
+    try:
+        with open(trace_path) as f:
+            evs = json.load(f).get("traceEvents", [])
+        by_name = {}
+        for e in evs:
+            if e.get("ph") == "X":
+                by_name.setdefault(e.get("name", "?"), [0, 0.0])
+                by_name[e["name"]][0] += 1
+                by_name[e["name"]][1] += float(e.get("dur", 0)) / 1e3
+        summary["trace_events"] = len(evs)
+        summary["spans_by_name"] = {
+            n: {"count": c, "total_ms": round(ms, 3)}
+            for n, (c, ms) in sorted(by_name.items(),
+                                     key=lambda kv: -kv[1][1])[:15]}
+    except (OSError, ValueError) as e:
+        summary["trace_error"] = str(e)
+    try:
+        with open(perf_path) as f:
+            summary["perf"] = json.load(f)
+    except (OSError, ValueError) as e:
+        summary["perf_error"] = str(e)
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+        return 0
+    print(f"capture dir: {d}")
+    print(f"trace: {summary.get('trace_events', '?')} event(s) "
+          f"({trace_path})")
+    spans = summary.get("spans_by_name") or {}
+    if spans:
+        _print_aligned(
+            [{"span": n, "count": v["count"],
+              "total_ms": v["total_ms"]} for n, v in spans.items()],
+            ("span", "count", "total_ms"))
+    perf = summary.get("perf") or {}
+    kinds = perf.get("perfwatch") or {}
+    if kinds:
+        print("attribution (window at capture close):")
+        _print_aligned(
+            [{"kind": k, "mfu": round(v.get("mfu", 0.0), 6),
+              "steps/s": round(v.get("steps_per_sec", 0.0), 2),
+              "tok/s/chip":
+                  round(v.get("tokens_per_sec_per_chip", 0.0), 2),
+              "device": v.get("device_kind") or "?"}
+             for k, v in sorted(kinds.items())],
+            ("kind", "mfu", "steps/s", "tok/s/chip", "device"))
+    mem = perf.get("memory") or {}
+    owners = mem.get("owners") or {}
+    if owners:
+        print("memory owners:")
+        _print_aligned(
+            [{"owner": o, "bytes": _fmt_bytes(b)}
+             for o, b in sorted(owners.items(), key=lambda kv: -kv[1])],
+            ("owner", "bytes"))
+        print(f"watermark {_fmt_bytes(mem.get('watermark_bytes', 0))}")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="obsdump", description=__doc__)
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -1116,6 +1305,31 @@ def main(argv=None) -> int:
     slp.add_argument("--json", action="store_true",
                      help="rows as JSON instead of the aligned table")
     slp.set_defaults(fn=cmd_slo)
+
+    mp = sub.add_parser("mem", help="per-owner HBM attribution table "
+                        "(watermark, budget state, top buffers)")
+    mp.add_argument("path", nargs="?", help="metrics.json from "
+                    "PADDLE_TPU_METRICS_DIR (omit with --live)")
+    mp.add_argument("--live", action="store_true",
+                    help="force a fresh sweep in this process (adds "
+                    "the ranked top-buffer list)")
+    mp.add_argument("--json", action="store_true",
+                    help="report as JSON instead of the aligned table")
+    mp.set_defaults(fn=cmd_mem)
+
+    prp = sub.add_parser("profile", help="render a /v1/profile capture "
+                         "dir (trace summary + attribution tables); "
+                         "--url triggers a capture first")
+    prp.add_argument("dir", nargs="?", help="capture artifact dir "
+                     "(holds trace.json + perf.json)")
+    prp.add_argument("--url", help="base URL of a live server "
+                     "(replica, metrics port, or fleet router) to POST "
+                     "/v1/profile at before rendering")
+    prp.add_argument("--seconds", type=float, default=2.0,
+                     help="capture window for --url (default 2s)")
+    prp.add_argument("--json", action="store_true",
+                     help="summary as JSON instead of tables")
+    prp.set_defaults(fn=cmd_profile)
 
     # unknown/missing subcommands exit nonzero via argparse itself
     # (required=True subparsers error out with status 2)

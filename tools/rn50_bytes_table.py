@@ -27,13 +27,30 @@ Categories:
   optimizer f32 master+momentum read/write + f32 grad + bf16 cast
 """
 
+import importlib.util
 import json
+import os
 
 BS = 256
 BF = 2           # bf16 activation/weight bytes
 F32 = 4
-PEAK_BW = 819e9
-PEAK_TF = 197e12
+
+
+def _load_device_peaks():
+    """File-path import of the shared per-device-kind peak table
+    (stdlib-only by contract) — this tool must run without the
+    paddle_tpu package (and its jax import) on sys.path."""
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                     "paddle_tpu", "observability", "device_peaks.py")
+    spec = importlib.util.spec_from_file_location("_rn50_device_peaks", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_V5E = _load_device_peaks().lookup("TPU v5 lite")
+PEAK_BW = _V5E.hbm_bytes_per_s
+PEAK_TF = _V5E.flops
 STEP_FLOPS = 6.281e12       # exact conv sum, tools/rn50_roofline.py (bs=256)
 MEASURED_MS = 103.0          # BENCH_r03 step (one-pass BN, NHWC)
 

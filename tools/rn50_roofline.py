@@ -25,8 +25,25 @@ jax.config.update("jax_default_prng_impl", "unsafe_rbg")
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
-PEAK_TF = 197e12
-PEAK_BW = 819e9
+import importlib.util  # noqa: E402
+import os  # noqa: E402
+
+
+def _load_device_peaks():
+    """File-path import of the shared peak table (stdlib-only) — keeps
+    this tool runnable as `python tools/rn50_roofline.py` with no
+    paddle_tpu on sys.path."""
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                     "paddle_tpu", "observability", "device_peaks.py")
+    spec = importlib.util.spec_from_file_location("_rn50_device_peaks", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_V5E = _load_device_peaks().lookup("TPU v5 lite")
+PEAK_TF = _V5E.flops
+PEAK_BW = _V5E.hbm_bytes_per_s
 BS = 256
 BF = 2  # bytes bf16
 

@@ -509,12 +509,22 @@ def run_token_bench(args) -> int:
                 for k in ("prefill", "decode"))
     bit_identical = warm_tokens == cold_tokens
 
+    from paddle_tpu.observability import memwatch as _memwatch
+    from paddle_tpu.observability import perfwatch as _perfwatch
+
     detail_base = {
         "platform": platform, "smoke": bool(args.smoke),
         "rate_offered_rps": args.rate, "duration_s": args.duration,
         "requests": n_requests, "slots": list(slots),
         "prefill_buckets": list(buckets), "gen_lengths":
         list(_GEN_LENGTHS), "precision": "bf16",
+        # live-attribution view of the same run: chip-normalized decode
+        # MFU from retained cost_analysis FLOPs, plus the HBM
+        # high-watermark the KV pools + params drove
+        "mfu": round(_perfwatch.mfu("decode"), 6),
+        "tokens_per_sec_per_chip_live":
+            round(_perfwatch.tokens_per_sec_per_chip("decode"), 2),
+        "hbm_peak_bytes": int(_memwatch.watermark_bytes()),
     }
     for metric, value, unit, detail in (
             ("decode_tokens_per_sec_continuous",
