@@ -371,6 +371,150 @@ def apply_decode_step(params: Params, cfg: GPTConfig, ids: jax.Array,
     return tok, k_pool, v_pool
 
 
+def apply_prefill_chunk(params: Params, cfg: GPTConfig, ids: jax.Array,
+                        start: jax.Array, length: jax.Array,
+                        k_pool: jax.Array, v_pool: jax.Array,
+                        block_table: jax.Array, *, block_size: int,
+                        eos_id: int
+                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One fixed-size SLICE of a prompt through the stack (chunked
+    prefill — serving/kv_reuse.py).
+
+    ids [1, C] = the tokens at positions start..start+C-1 (edge-padded
+    past `length`), start = the slice's first position, length = the
+    true prompt length. Writes the slice's K/V into the sequence's
+    blocks and attends gather-style over the block table with mask
+    `key_pos <= start + i`, so earlier slices' — and prefix-cache
+    reused blocks' — K/V participate exactly as in a whole-prompt
+    prefill. Per-position results are independent of where the chunk
+    boundaries fall (each row's math reads only pool state + its own
+    activations), which is what makes chunked == whole prefill and
+    reused == recomputed prefixes hold at the token level. Returns
+    (tok [1], k_pool, v_pool); tok is meaningful only on the slice
+    containing position length-1 (the scheduler ignores it earlier).
+    """
+    from ..serving import kv_cache as kvc
+
+    _, C = ids.shape
+    nh, hd = cfg.heads, cfg.head_dim
+    adt = k_pool.dtype
+    pos = start + jnp.arange(C, dtype=jnp.int32)
+    # the final slice's padded tail can run past the positional table;
+    # clamp (those rows' outputs are never consumed, their KV lands in
+    # the null block / overwritten slots)
+    x = (params["wte.w"][ids[0]] +
+         params["wpe.w"][jnp.minimum(pos, cfg.max_len - 1)]).astype(adt)
+
+    lp_stacked = _layer_params(params)
+    scale = 1.0 / math.sqrt(hd)
+
+    def layer_body(h, per_layer):
+        lp, kp, vp = per_layer
+        y = _ln(h, lp["blk.ln1.scale"], lp["blk.ln1.bias"])
+        qkv = y @ lp["blk.wqkv"].astype(y.dtype) + \
+            lp["blk.bqkv"].astype(y.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(C, nh, hd)
+        k = k.reshape(C, nh, hd)
+        v = v.reshape(C, nh, hd)
+        kp = kvc.write_chunk_kv(kp, k, block_table, start, block_size)
+        vp = kvc.write_chunk_kv(vp, v, block_table, start, block_size)
+        keys = kvc.gather_kv(kp, block_table[None])[0]  # [M, nh, hd]
+        vals = kvc.gather_kv(vp, block_table[None])[0]
+        scores = jnp.einsum("cnd,mnd->cnm", q, keys) * scale
+        m = keys.shape[0]
+        mask = jnp.arange(m, dtype=jnp.int32)[None, :] <= pos[:, None]
+        scores = jnp.where(mask[:, None, :], scores, -1e9)
+        att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        ctx = jnp.einsum("cnm,mnd->cnd", att.astype(adt), vals)
+        ctx = ctx.reshape(C, cfg.hidden)
+        h = h + ctx @ lp["blk.wo"].astype(h.dtype) + \
+            lp["blk.bo"].astype(h.dtype)
+        y = _ln(h, lp["blk.ln2.scale"], lp["blk.ln2.bias"])
+        h = h + _decode_mlp(lp, y)
+        return h, (kp, vp)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        layer_body, x, (lp_stacked, k_pool, v_pool))
+    x = _ln_named(params, "ln_f", x)
+    last = jnp.clip(length - 1 - start, 0, C - 1)
+    logits = (x[last] @ params["wte.w"].T.astype(x.dtype))[None]
+    prev = ids[0, last][None].astype(jnp.int32)
+    tok = _beam_top1(prev, logits, eos_id)
+    return tok, k_pool, v_pool
+
+
+def apply_verify_step(params: Params, cfg: GPTConfig, ids: jax.Array,
+                      positions: jax.Array, k_pool: jax.Array,
+                      v_pool: jax.Array, block_tables: jax.Array, *,
+                      block_size: int, eos_id: int
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Speculative verification: W = k+1 tokens per slot in ONE step
+    (serving/kv_reuse.py).
+
+    ids [S, W] = each slot's [last_token, d_1..d_k] (the previous real
+    token followed by the draft model's k proposals), positions [S] =
+    each slot's next KV write position. Row j writes its K/V at
+    position positions+j and attends `key_pos <= positions + j`, so
+    output j is bit-identical to the token a plain apply_decode_step
+    sequence would produce after feeding ids[:, :j+1] one at a time —
+    the exact greedy accept/reject in kv_reuse.accept_length compares
+    drafts against these outputs. Rejected positions' K/V stays in the
+    pool but is overwritten by the next real write before any mask
+    lets it be read (the standard paged-decode invariant). Sampling
+    routes through the same beam_search op as decode, so an eos in the
+    fed window freezes the remaining outputs to eos. Returns
+    (tokens [S, W], k_pool, v_pool)."""
+    from ..serving import kv_cache as kvc
+
+    S, W = ids.shape
+    nh, hd = cfg.heads, cfg.head_dim
+    adt = k_pool.dtype
+    pos = positions[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+    x = (params["wte.w"][ids] +
+         params["wpe.w"][jnp.minimum(pos, cfg.max_len - 1)]).astype(adt)
+
+    lp_stacked = _layer_params(params)
+    scale = 1.0 / math.sqrt(hd)
+
+    def layer_body(h, per_layer):
+        lp, kp, vp = per_layer
+        y = _ln(h, lp["blk.ln1.scale"], lp["blk.ln1.bias"])
+        qkv = y @ lp["blk.wqkv"].astype(y.dtype) + \
+            lp["blk.bqkv"].astype(y.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(S, W, nh, hd)
+        k = k.reshape(S, W, nh, hd)
+        v = v.reshape(S, W, nh, hd)
+        kp = kvc.write_span_kv(kp, k, block_tables, positions,
+                               block_size)
+        vp = kvc.write_span_kv(vp, v, block_tables, positions,
+                               block_size)
+        keys = kvc.gather_kv(kp, block_tables)        # [S, M, nh, hd]
+        vals = kvc.gather_kv(vp, block_tables)
+        scores = jnp.einsum("swnd,smnd->swnm", q, keys) * scale
+        m = keys.shape[1]
+        mask = jnp.arange(m, dtype=jnp.int32)[None, None, :] \
+            <= pos[:, :, None]
+        scores = jnp.where(mask[:, :, None, :], scores, -1e9)
+        att = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        ctx = jnp.einsum("swnm,smnd->swnd", att.astype(adt), vals)
+        ctx = ctx.reshape(S, W, cfg.hidden)
+        h = h + ctx @ lp["blk.wo"].astype(h.dtype) + \
+            lp["blk.bo"].astype(h.dtype)
+        y = _ln(h, lp["blk.ln2.scale"], lp["blk.ln2.bias"])
+        h = h + _decode_mlp(lp, y)
+        return h, (kp, vp)
+
+    x, (k_pool, v_pool) = jax.lax.scan(
+        layer_body, x, (lp_stacked, k_pool, v_pool))
+    x = _ln_named(params, "ln_f", x)
+    logits = x @ params["wte.w"].T.astype(x.dtype)     # [S, W, vocab]
+    tok = _beam_top1(ids.reshape(S * W).astype(jnp.int32),
+                     logits.reshape(S * W, -1), eos_id).reshape(S, W)
+    return tok, k_pool, v_pool
+
+
 def lm_loss(params: Params, cfg: GPTConfig, batch: Dict[str, jax.Array],
             rng=None, n_microbatches: int = 0) -> jax.Array:
     """Next-token cross entropy; batch = {"ids": [B, T+1]}."""

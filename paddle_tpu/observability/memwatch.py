@@ -48,7 +48,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 from . import events as _events
 from . import metrics as _m
 
-__all__ = ["register_provider", "unregister_provider",
+__all__ = ["register_provider", "register_bytes_provider",
+           "unregister_provider",
            "set_executables_provider", "sweep", "report", "last_report",
            "status_block", "budget_bytes", "watermark_bytes",
            "is_oom", "maybe_handle_oom", "oom_guard", "reset"]
@@ -92,6 +93,10 @@ OOMS = _m.counter(
 _lock = threading.Lock()
 # insertion-ordered: attribution precedence when providers overlap
 _providers: "Dict[int, tuple]" = {}   # handle -> (owner, fn)
+# byte-providers: owners whose bytes live INSIDE other owners' arrays
+# (e.g. prefix_cache blocks inside the kv_pool buffers) — reported as
+# their own row but NOT added to the live-array total
+_bytes_providers: "Dict[int, tuple]" = {}   # handle -> (owner, fn)
 _next_handle = [0]
 _exec_provider: List[Optional[Callable[[], tuple]]] = [None]
 _watermark = [0.0]
@@ -115,9 +120,25 @@ def register_provider(owner: str, fn: Callable[[], Iterable]) -> int:
     return h
 
 
+def register_bytes_provider(owner: str,
+                            fn: Callable[[], tuple]) -> int:
+    """Register a callable returning `(bytes, count)` for an owner
+    whose footprint is a SLICE of arrays someone else already owns —
+    the prefix cache's retained blocks live inside the kv_pool
+    buffers. The owner gets its own gauge/report row (like
+    executable_bytes it rides ALONGSIDE the live-array total, never
+    summed into it). Returns a handle for unregister_provider."""
+    with _lock:
+        _next_handle[0] += 1
+        h = _next_handle[0]
+        _bytes_providers[h] = (owner, fn)
+    return h
+
+
 def unregister_provider(handle: int):
     with _lock:
         _providers.pop(handle, None)
+        _bytes_providers.pop(handle, None)
 
 
 def set_executables_provider(fn: Callable[[], tuple]):
@@ -146,6 +167,7 @@ def reset():
     """Tests: drop providers, watermark and budget state."""
     with _lock:
         _providers.clear()
+        _bytes_providers.clear()
     _watermark[0] = 0.0
     _budget_state[0] = "ok"
     _last_sweep_t[0] = 0.0
@@ -201,6 +223,17 @@ def sweep(force: bool = False, top: bool = False
                 "owner": owner, "nbytes": nb,
                 "shape": list(getattr(a, "shape", ()) or ()),
                 "dtype": str(getattr(a, "dtype", "?"))})
+    # byte-providers: rows whose bytes live inside arrays counted
+    # above (prefix_cache ⊂ kv_pool) — attributed, never re-totalled
+    with _lock:
+        bprovs = list(_bytes_providers.values())
+    for owner, fn in bprovs:
+        try:
+            nb, cnt = fn()
+        except Exception:  # lint-exempt:swallow: a dead provider (engine stopped mid-sweep) skips one sweep
+            continue
+        owners[owner] = owners.get(owner, 0) + int(nb)
+        counts[owner] = counts.get(owner, 0) + int(cnt)
     exec_bytes = n_exec = 0
     if _exec_provider[0] is not None:
         try:

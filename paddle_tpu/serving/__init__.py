@@ -17,10 +17,16 @@ deployment surface in front of it:
 - kv_cache.py  — paged/blocked KV cache: preallocated device block
                  pool + host-side allocator + per-sequence block
                  tables, so decode memory scales with live tokens.
+- kv_reuse.py  — block-level KV reuse: ref-counted allocator with a
+                 content-hash prefix index (LRU retention, COW) and
+                 the speculative-decoding accept rule (SERVING.md
+                 §KV reuse).
 - decode.py    — continuous-batching autoregressive decode engine:
                  prefill/decode phase split, in-flight batching,
-                 streaming token handles, warmstart phase-grid bake
-                 (SERVING.md §Continuous batching).
+                 streaming token handles, warmstart phase-grid bake,
+                 chunked prefill + prefix caching + speculative
+                 decoding (SERVING.md §Continuous batching, §KV
+                 reuse).
 - httpd.py     — JSON-over-HTTP frontend (POST /v1/predict, chunked
                  POST /v1/generate token streaming, GET /v1/status,
                  the /v1/load probe + stateful /v1/healthz) on the
@@ -46,6 +52,7 @@ from .batcher import (  # noqa: F401
 )
 from .engine import Engine, ServingConfig  # noqa: F401
 from .kv_cache import BlockAllocator, KVCacheConfig, NoBlocksError  # noqa: F401
+from .kv_reuse import ReuseBlockAllocator, accept_length, hash_blocks  # noqa: F401
 from .decode import DecodeConfig, DecodeEngine, DecodeHandle  # noqa: F401
 from .httpd import Server  # noqa: F401
 from .router import (  # noqa: F401
@@ -60,6 +67,7 @@ __all__ = [
     "ServerClosed",
     "Engine", "ServingConfig", "Server",
     "BlockAllocator", "KVCacheConfig", "NoBlocksError",
+    "ReuseBlockAllocator", "accept_length", "hash_blocks",
     "DecodeConfig", "DecodeEngine", "DecodeHandle",
     "Router", "RouterServer", "Autoscaler",
     "FleetError", "NoReplicasError", "ReplicaRejected", "FleetTimeout",

@@ -65,6 +65,8 @@ from ..observability import tracing as _tracing
 from .batcher import QueueFullError, ServerClosed
 from .kv_cache import (BlockAllocator, KVCacheConfig, NoBlocksError,
                        build_block_table, init_pools)
+from . import kv_reuse as _kvr
+from .kv_reuse import ReuseBlockAllocator
 
 __all__ = ["DecodeConfig", "DecodeEngine", "DecodeHandle",
            "DECODE_WARMSTART_FORMAT"]
@@ -91,7 +93,8 @@ TOKENS = _m.counter(
     "Tokens sampled (phase=prefill|decode)", labelnames=("phase",))
 STEPS = _m.counter(
     "paddle_tpu_decode_steps_total",
-    "Phase executions (phase=prefill|decode)", labelnames=("phase",))
+    "Phase executions (phase=prefill|decode|draft|verify)",
+    labelnames=("phase",))
 REQUESTS = _m.counter(
     "paddle_tpu_decode_requests_total",
     "Finished requests by outcome (eos|length|rejected|cancelled|error)",
@@ -124,7 +127,18 @@ class DecodeConfig:
     num_blocks/block_size: the KV pool (block 0 is the null block).
     static_batching=True turns the scheduler into the drain-between-
     batches baseline (admit only into an EMPTY batch) — the A/B
-    `tools/serve_bench.py --tokens` measures against."""
+    `tools/serve_bench.py --tokens` measures against.
+
+    KV-reuse knobs (SERVING.md §KV reuse): prefill_chunk > 0 replaces
+    the prefill-bucket grid with ONE fixed-size chunk executable —
+    prompts prefill in slices interleaved with decode steps;
+    prefix_cache=True (requires prefill_chunk) makes the allocator
+    ref-counted with a content-hash index so shared prompt prefixes
+    resolve to live pool blocks; spec_k > 0 (requires a draft model
+    passed to DecodeEngine) proposes k tokens per step through the
+    draft and verifies them in one batched target step with exact
+    greedy accept/reject. Any of these switches the engine onto the
+    synchronous reuse scheduler (no lazy-fetch overlap)."""
 
     def __init__(self, *, block_size: int = 16, num_blocks: int = 64,
                  decode_slots: Sequence[int] = (4, 8),
@@ -134,7 +148,10 @@ class DecodeConfig:
                  max_queue: int = 64,
                  precision: str = "bf16",
                  static_batching: bool = False,
-                 warmstart: Optional[str] = None):
+                 warmstart: Optional[str] = None,
+                 prefix_cache: bool = False,
+                 prefill_chunk: int = 0,
+                 spec_k: int = 0):
         self.block_size = int(block_size)
         self.num_blocks = int(num_blocks)
         self.decode_slots = tuple(sorted({int(s) for s in decode_slots}))
@@ -147,6 +164,20 @@ class DecodeConfig:
         self.precision = str(precision)
         self.static_batching = bool(static_batching)
         self.warmstart = warmstart
+        self.prefix_cache = bool(prefix_cache)
+        self.prefill_chunk = int(prefill_chunk)
+        self.spec_k = int(spec_k)
+        if self.prefill_chunk < 0:
+            raise ValueError(f"prefill_chunk must be >= 0, got "
+                             f"{self.prefill_chunk}")
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {self.spec_k}")
+        if self.prefix_cache and not self.prefill_chunk:
+            raise ValueError(
+                "prefix_cache=True requires prefill_chunk > 0: reused "
+                "prefixes start the computed suffix mid-prompt, which "
+                "only the chunked (gather-attention) prefill program "
+                "supports")
 
 
 class DecodeHandle:
@@ -194,7 +225,8 @@ class _Request:
     __slots__ = ("rid", "prompt", "prompt_len0", "max_new", "generated",
                  "events", "t_submit", "t_first", "finish_reason",
                  "error", "cancelled", "last_token", "pos", "blocks",
-                 "admitted_at", "tctx", "enqueued_at")
+                 "admitted_at", "tctx", "enqueued_at",
+                 "prefill_pos", "draft_pos", "n_reused", "hashes")
 
     def __init__(self, rid: int, prompt: np.ndarray, max_new: int):
         self.rid = rid
@@ -217,6 +249,11 @@ class _Request:
         self.pos = 0                           # next KV write position
         self.blocks: List[int] = []
         self.admitted_at = 0.0
+        # KV-reuse state (chunked prefill / prefix cache / speculation)
+        self.prefill_pos = 0     # next prompt position to chunk-prefill
+        self.draft_pos = 0       # next DRAFT KV write position
+        self.n_reused = 0        # prefix blocks resolved from the cache
+        self.hashes = None       # chain hashes of the prompt's blocks
 
 
 class _Pending:
@@ -243,11 +280,24 @@ class DecodeEngine:
     every phase dispatch."""
 
     def __init__(self, params, model_cfg, config: Optional[DecodeConfig]
-                 = None):
+                 = None, draft=None):
         from ..models import gpt as _gpt
 
         self.config = config or DecodeConfig()
         self.model_cfg = model_cfg
+        self.prefill_chunk = int(getattr(self.config, "prefill_chunk",
+                                         0))
+        self.spec_k = int(getattr(self.config, "spec_k", 0))
+        if self.spec_k and draft is None:
+            raise ValueError(
+                "spec_k > 0 requires a draft model: pass "
+                "DecodeEngine(..., draft=(draft_params, draft_cfg))")
+        if draft is not None and not self.spec_k:
+            raise ValueError(
+                "a draft model was passed but spec_k == 0; set "
+                "DecodeConfig(spec_k=k) to enable speculation")
+        # any reuse feature runs the synchronous scheduler (_loop_sync)
+        self._sync = bool(self.prefill_chunk or self.spec_k)
         if self.config.precision not in ("f32", "bf16"):
             _precision.get_policy(self.config.precision)  # typo => full msg
             raise ValueError(
@@ -276,6 +326,27 @@ class DecodeEngine:
         self.eos_id = -1 if self.config.eos_id is None \
             else int(self.config.eos_id)
 
+        # -- draft model (speculative decoding) -----------------------
+        # its pools share num_blocks/block_size/max_len with the target
+        # so BLOCK TABLES ARE SHARED: one allocation covers both models
+        # and prefix-cache hits resolve both models' prompt KV at once
+        self._draft = draft
+        self._draft_params = None
+        self._draft_cfg = None
+        self._draft_kv_cfg = None
+        if draft is not None:
+            draft_params, draft_cfg = draft
+            self._draft_cfg = draft_cfg
+            self._draft_params = {
+                k: _precision.cast_floating(v, self._compute_dtype)
+                for k, v in draft_params.items()}
+            self._draft_kv_cfg = KVCacheConfig(
+                layers=draft_cfg.layers, kv_heads=draft_cfg.heads,
+                head_dim=draft_cfg.head_dim, max_len=max_len,
+                block_size=self.config.block_size,
+                num_blocks=self.config.num_blocks,
+                dtype=str(np.dtype(self._compute_dtype)))
+
         # -- phase grid: one dispatcher per (phase, size) -------------
         bs = self.kv_cfg.block_size
         pol = None if self.config.precision == "f32" \
@@ -291,20 +362,104 @@ class DecodeEngine:
                                           kp, vp, bts, block_size=bs,
                                           eos_id=self.eos_id)
 
-        self._prefill: Dict[int, _JitDispatch] = {
-            t: _JitDispatch(jax.jit(_prefill_fn, donate_argnums=(3, 4)),
-                            "prefill", meta={"bucket": int(t)},
-                            policy=pol)
-            for t in self.prefill_buckets}
+        def _chunk_fn(p, ids, start, length, kp, vp, bt):
+            return _gpt.apply_prefill_chunk(
+                p, model_cfg, ids, start, length, kp, vp, bt,
+                block_size=bs, eos_id=self.eos_id)
+
+        # chunked prefill COLLAPSES the prompt-length bucket dimension:
+        # the grid carries one chunk executable instead of one program
+        # per bucket (warmstart artifacts re-key accordingly)
+        self._chunk: Dict[int, _JitDispatch] = {}
+        self._prefill: Dict[int, _JitDispatch] = {}
+        if self.prefill_chunk:
+            self._chunk = {
+                self.prefill_chunk: _JitDispatch(
+                    jax.jit(_chunk_fn, donate_argnums=(4, 5)),
+                    "prefill", meta={"chunk": self.prefill_chunk},
+                    policy=pol)}
+        else:
+            self._prefill = {
+                t: _JitDispatch(jax.jit(_prefill_fn,
+                                        donate_argnums=(3, 4)),
+                                "prefill", meta={"bucket": int(t)},
+                                policy=pol)
+                for t in self.prefill_buckets}
         self._decode: Dict[int, _JitDispatch] = {
             s: _JitDispatch(jax.jit(_decode_fn, donate_argnums=(3, 4)),
                             "decode", meta={"slots": int(s)}, policy=pol)
             for s in self.decode_slots}
 
+        self._draft_prefill: Dict[int, _JitDispatch] = {}
+        self._draft_chunk: Dict[int, _JitDispatch] = {}
+        self._draft_decode: Dict[int, _JitDispatch] = {}
+        self._verify: Dict[int, _JitDispatch] = {}
+        if draft is not None:
+            dcfg = self._draft_cfg
+
+            def _dprefill_fn(p, ids, length, kp, vp, bt):
+                return _gpt.apply_prefill(p, dcfg, ids, length, kp, vp,
+                                          bt, block_size=bs,
+                                          eos_id=self.eos_id)
+
+            def _ddecode_fn(p, ids, positions, kp, vp, bts):
+                return _gpt.apply_decode_step(
+                    p, dcfg, ids, positions, kp, vp, bts, block_size=bs,
+                    eos_id=self.eos_id)
+
+            def _dchunk_fn(p, ids, start, length, kp, vp, bt):
+                return _gpt.apply_prefill_chunk(
+                    p, dcfg, ids, start, length, kp, vp, bt,
+                    block_size=bs, eos_id=self.eos_id)
+
+            def _verify_fn(p, ids, positions, kp, vp, bts):
+                return _gpt.apply_verify_step(
+                    p, model_cfg, ids, positions, kp, vp, bts,
+                    block_size=bs, eos_id=self.eos_id)
+
+            if self.prefill_chunk:
+                self._draft_chunk = {
+                    self.prefill_chunk: _JitDispatch(
+                        jax.jit(_dchunk_fn, donate_argnums=(4, 5)),
+                        "prefill",
+                        meta={"chunk": self.prefill_chunk,
+                              "draft": True}, policy=pol)}
+            else:
+                self._draft_prefill = {
+                    t: _JitDispatch(
+                        jax.jit(_dprefill_fn, donate_argnums=(3, 4)),
+                        "prefill", meta={"bucket": int(t),
+                                         "draft": True}, policy=pol)
+                    for t in self.prefill_buckets}
+            self._draft_decode = {
+                s: _JitDispatch(
+                    jax.jit(_ddecode_fn, donate_argnums=(3, 4)),
+                    "decode", meta={"slots": int(s), "draft": True},
+                    policy=pol)
+                for s in self.decode_slots}
+            self._verify = {
+                s: _JitDispatch(
+                    jax.jit(_verify_fn, donate_argnums=(3, 4)),
+                    "decode", meta={"verify": int(s), "k": self.spec_k},
+                    policy=pol)
+                for s in self.decode_slots}
+
         self.analysis = self._validate_boot()
 
         self._pools = init_pools(self.kv_cfg)
-        self._alloc = BlockAllocator(self.kv_cfg)
+        self._draft_pools = init_pools(self._draft_kv_cfg) \
+            if draft is not None else None
+        # annotated with the reuse subtype so the lock-order analyzer
+        # (tools/lockgraph.py) sees its leaf lock acquired under _cv
+        self._alloc: "ReuseBlockAllocator" = \
+            ReuseBlockAllocator(self.kv_cfg) \
+            if self.config.prefix_cache else BlockAllocator(self.kv_cfg)
+        # COW device copy: src block's contents into dst across both
+        # pools (shape-cached jit; src/dst are traced scalars so every
+        # copy reuses one executable per pool geometry)
+        self._copy_block_fn = jax.jit(
+            lambda kp, vp, src, dst: (kp.at[:, dst].set(kp[:, src]),
+                                      vp.at[:, dst].set(vp[:, src])))
         self._device_kind = getattr(jax.devices()[0], "device_kind",
                                     "unknown")
         # HBM owner attribution: providers hand memwatch the CURRENT
@@ -316,15 +471,42 @@ class DecodeEngine:
 
         def _kv_arrays():
             eng = ref()
-            return eng._pools if eng is not None else ()
+            if eng is None:
+                return ()
+            out = list(eng._pools)
+            if eng._draft_pools is not None:
+                out.extend(eng._draft_pools)
+            return out
 
         def _param_arrays():
             eng = ref()
-            return eng.params.values() if eng is not None else ()
+            if eng is None:
+                return ()
+            out = list(eng.params.values())
+            if eng._draft_params is not None:
+                out.extend(eng._draft_params.values())
+            return out
 
         self._mem_handles = [
             _memwatch.register_provider("kv_pool", _kv_arrays),
             _memwatch.register_provider("params", _param_arrays)]
+        if self.config.prefix_cache:
+            # retained-prefix accounting: bytes of cached (unreferenced
+            # but evictable) blocks across BOTH models' pools. These
+            # bytes live INSIDE the kv_pool arrays — memwatch reports
+            # them alongside, like executable_bytes, without double-
+            # counting them into the live-array total.
+            per_block = self._prefix_block_bytes()
+
+            def _prefix_bytes():
+                eng = ref()
+                if eng is None:
+                    return (0, 0)
+                n = eng._alloc.cached_blocks()
+                return (n * per_block, n)
+
+            self._mem_handles.append(_memwatch.register_bytes_provider(
+                "prefix_cache", _prefix_bytes))
         # deferred import: the analysis package must not load during
         # package bootstrap; constructors only run after it
         from ..analysis import lockcheck as _lockcheck
@@ -333,6 +515,13 @@ class DecodeEngine:
             name="serving.decode.DecodeEngine._cv")
         self._waiting: "collections.deque[_Request]" = collections.deque()
         self._active: List[_Request] = []
+        # chunked-prefill stage: admitted (blocks reserved) but not yet
+        # fully prefilled; the sync loop advances the FRONT request one
+        # chunk per iteration, interleaved with decode steps
+        self._prefilling: "collections.deque[_Request]" = \
+            collections.deque()
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self._closed = False
         self._draining = False
         self._thread: Optional[threading.Thread] = None
@@ -390,19 +579,48 @@ class DecodeEngine:
             add(_an.ERROR,
                 f"eos_id {self.eos_id} outside vocab [0, "
                 f"{mc.vocab_size})", var="eos_id")
-        for t in self.prefill_buckets:
-            if t > kv.max_len:
-                add(_an.ERROR, f"prefill bucket {t} exceeds max_len "
-                    f"{kv.max_len}", var="prefill_buckets")
-        if max(self.prefill_buckets) < kv.max_len:
-            add(_an.WARNING,
-                f"largest prefill bucket "
-                f"{max(self.prefill_buckets)} < max_len "
-                f"{kv.max_len}: a pool-pressure preemption whose "
-                "replay prompt (original + generated) outgrows the "
-                "bucket set fails that request — extend "
-                "prefill_buckets to max_len if preemptions are "
-                "expected", var="prefill_buckets")
+        if self.prefill_chunk:
+            # the chunked program covers ANY prompt length under
+            # max_len, so the bucket-coverage checks (including the
+            # "largest prefill bucket < max_len" preemption-replay
+            # warning) are retired on this path: preempt replays
+            # re-chunk at any length
+            if self.prefill_chunk > kv.max_len:
+                add(_an.ERROR,
+                    f"prefill_chunk {self.prefill_chunk} exceeds "
+                    f"max_len {kv.max_len}", var="prefill_chunk")
+        else:
+            for t in self.prefill_buckets:
+                if t > kv.max_len:
+                    add(_an.ERROR, f"prefill bucket {t} exceeds max_len "
+                        f"{kv.max_len}", var="prefill_buckets")
+            if max(self.prefill_buckets) < kv.max_len:
+                add(_an.WARNING,
+                    f"largest prefill bucket "
+                    f"{max(self.prefill_buckets)} < max_len "
+                    f"{kv.max_len}: a pool-pressure preemption whose "
+                    "replay prompt (original + generated) outgrows the "
+                    "bucket set fails that request — extend "
+                    "prefill_buckets to max_len if preemptions are "
+                    "expected", var="prefill_buckets")
+        if self._draft_cfg is not None:
+            dc = self._draft_cfg
+            if dc.vocab_size != mc.vocab_size:
+                add(_an.ERROR,
+                    f"draft vocab_size {dc.vocab_size} != target "
+                    f"{mc.vocab_size}: proposed ids would be "
+                    "meaningless to the verifier", var="draft")
+            if dc.max_len < kv.max_len:
+                add(_an.ERROR,
+                    f"draft max_len {dc.max_len} < serving max_len "
+                    f"{kv.max_len}: the draft runs every position the "
+                    "target does", var="draft")
+            if getattr(dc, "n_experts", 0):
+                add(_an.ERROR, "MoE draft is unsupported (same "
+                    "constraint as the target model)", var="draft")
+        if self.spec_k and self.spec_k >= kv.max_len:
+            add(_an.ERROR, f"spec_k {self.spec_k} >= max_len "
+                f"{kv.max_len}", var="spec_k")
         for s in self.decode_slots:
             if s < 1:
                 add(_an.ERROR, f"decode slot count {s} < 1",
@@ -413,8 +631,7 @@ class DecodeEngine:
             # of an opaque trace error inside the first live request
             for key in self._phase_keys():
                 try:
-                    disp = (self._prefill if key[0] == "prefill"
-                            else self._decode)[key[1]]
+                    disp = self._phase_dispatch(key)
                     jax.eval_shape(disp._jit, *self._phase_avals(key))
                 except Exception as e:
                     findings.append(_an.Finding(
@@ -422,7 +639,7 @@ class DecodeEngine:
                         message=f"{key[0]}@{key[1]} fails to trace: "
                                 f"{type(e).__name__}: {str(e)[:200]}"))
         _telemetry.record_analysis(
-            findings, n_ops=len(self._prefill) + len(self._decode),
+            findings, n_ops=len(self._phase_keys()),
             where="decode", seconds=time.perf_counter() - t0)
         out = {"errors": 0, "warnings": 0, "infos": 0}
         for f in findings:
@@ -435,21 +652,57 @@ class DecodeEngine:
     # -- phase grid / warmstart ----------------------------------------
 
     def _phase_keys(self) -> List[Tuple[str, int]]:
-        return ([("prefill", t) for t in self.prefill_buckets] +
-                [("decode", s) for s in self.decode_slots])
+        keys: List[Tuple[str, int]] = []
+        if self.prefill_chunk:
+            keys.append(("chunk", self.prefill_chunk))
+        else:
+            keys.extend(("prefill", t) for t in self.prefill_buckets)
+        keys.extend(("decode", s) for s in self.decode_slots)
+        if self._draft is not None:
+            if self.prefill_chunk:
+                keys.append(("draft_chunk", self.prefill_chunk))
+            else:
+                keys.extend(("draft_prefill", t)
+                            for t in self.prefill_buckets)
+            keys.extend(("draft_decode", s) for s in self.decode_slots)
+            keys.extend(("verify", s) for s in self.decode_slots)
+        return keys
+
+    def _phase_dispatch(self, key) -> _JitDispatch:
+        """The grid is a flat (kind, size) → dispatcher map; every
+        consumer (boot trace, warmup, warmstart export/load) walks it
+        through this one lookup."""
+        kind, n = key
+        return {"prefill": self._prefill, "chunk": self._chunk,
+                "decode": self._decode,
+                "draft_prefill": self._draft_prefill,
+                "draft_chunk": self._draft_chunk,
+                "draft_decode": self._draft_decode,
+                "verify": self._verify}[kind][n]
 
     def _phase_avals(self, key):
         sds = jax.ShapeDtypeStruct
+        kind, n = key
+        draft = kind.startswith("draft_")
+        params = self._draft_params if draft else self.params
         p_sds = jax.tree_util.tree_map(
-            lambda a: sds(a.shape, a.dtype), self.params)
-        kv = self.kv_cfg
+            lambda a: sds(a.shape, a.dtype), params)
+        kv = self._draft_kv_cfg if draft else self.kv_cfg
         pool = sds((kv.layers, kv.num_blocks, kv.block_size,
                     kv.kv_heads, kv.head_dim), np.dtype(kv.dtype))
         mb = kv.max_blocks_per_seq
-        kind, n = key
-        if kind == "prefill":
+        base = kind[6:] if draft else kind
+        if base == "prefill":
             return (p_sds, sds((1, n), np.int32), sds((), np.int32),
                     pool, pool, sds((mb,), np.int32))
+        if base == "chunk":
+            return (p_sds, sds((1, n), np.int32), sds((), np.int32),
+                    sds((), np.int32), pool, pool,
+                    sds((mb,), np.int32))
+        if base == "verify":
+            return (p_sds, sds((n, self.spec_k + 1), np.int32),
+                    sds((n,), np.int32), pool, pool,
+                    sds((n, mb), np.int32))
         return (p_sds, sds((n,), np.int32), sds((n,), np.int32),
                 pool, pool, sds((n, mb), np.int32))
 
@@ -459,9 +712,7 @@ class DecodeEngine:
         Returns how many phases are ready. Idempotent."""
         ready = 0
         for key in self._phase_keys():
-            disp = (self._prefill if key[0] == "prefill"
-                    else self._decode)[key[1]]
-            if disp.warm(*self._phase_avals(key)):
+            if self._phase_dispatch(key).warm(*self._phase_avals(key)):
                 ready += 1
         self.warmed = True
         return ready
@@ -475,11 +726,19 @@ class DecodeEngine:
                        self.decode_slots,
                        self.prefill_buckets,
                        self.config.precision,
-                       self.eos_id)).encode())
+                       self.eos_id,
+                       self.prefill_chunk, self.spec_k,
+                       self._draft_cfg)).encode())
         for name in sorted(self.params):
             a = np.ascontiguousarray(np.asarray(self.params[name]))
             h.update(f"{name}:{a.dtype}:{a.shape}".encode())
             h.update(a.tobytes())
+        if self._draft_params is not None:
+            for name in sorted(self._draft_params):
+                a = np.ascontiguousarray(
+                    np.asarray(self._draft_params[name]))
+                h.update(f"draft:{name}:{a.dtype}:{a.shape}".encode())
+                h.update(a.tobytes())
         return h.hexdigest()
 
     def export_warmstart(self, path: str) -> int:
@@ -488,8 +747,7 @@ class DecodeEngine:
         Call after warmup(); returns how many phases it carries."""
         entries = {}
         for key in self._phase_keys():
-            disp = (self._prefill if key[0] == "prefill"
-                    else self._decode)[key[1]]
+            disp = self._phase_dispatch(key)
             exe = disp._aot
             if exe is None:
                 continue
@@ -501,11 +759,19 @@ class DecodeEngine:
                     "fingerprint": fp}
             except Exception:
                 continue  # backend refused: artifact covers fewer phases
+        grid = {"decode": list(self.decode_slots)}
+        if self.prefill_chunk:
+            # chunked path: the bucket dimension is collapsed, so the
+            # artifact advertises the chunk size, not buckets
+            grid["chunk"] = self.prefill_chunk
+        else:
+            grid["prefill"] = list(self.prefill_buckets)
+        if self.spec_k:
+            grid["spec_k"] = self.spec_k
         art = dict(_cc.environment_meta(),
                    format=DECODE_WARMSTART_FORMAT,
                    model_digest=self._model_digest(),
-                   grid={"prefill": list(self.prefill_buckets),
-                         "decode": list(self.decode_slots)},
+                   grid=grid,
                    created_at=time.time(),
                    entries=entries)
         from ..resilience.atomic import write_bytes
@@ -551,10 +817,10 @@ class DecodeEngine:
         for key, entry in (art.get("entries") or {}).items():
             try:
                 kind, n = key
-                disp = (self._prefill if kind == "prefill"
-                        else self._decode).get(n)
-                if disp is None:
-                    continue
+                try:
+                    disp = self._phase_dispatch((kind, n))
+                except KeyError:
+                    continue  # artifact baked with a different grid
                 avals = self._phase_avals((kind, n))
                 fp = disp.cache_fingerprint(disp.lower(*avals))
                 if fp is None or fp != entry["fingerprint"]:
@@ -577,7 +843,8 @@ class DecodeEngine:
             if self._thread is not None or self._closed:
                 return
             self._thread = threading.Thread(
-                target=self._loop, name="paddle-tpu-decode", daemon=True)
+                target=self._loop_sync if self._sync else self._loop,
+                name="paddle-tpu-decode", daemon=True)
             self._thread.start()
             _events.emit("decode", action="start",
                          slots=list(self.decode_slots),
@@ -591,7 +858,14 @@ class DecodeEngine:
         prompt = np.asarray(prompt_ids, np.int32).ravel()
         if prompt.size < 1:
             raise ValueError("prompt must carry at least one token id")
-        if prompt.size > self.prefill_buckets[-1]:
+        if self.prefill_chunk:
+            # chunked prefill has no bucket ceiling: any prompt that
+            # leaves generation room under max_len is admissible
+            if prompt.size > self.kv_cfg.max_len - 1:
+                raise ValueError(
+                    f"prompt length {prompt.size} leaves no room to "
+                    f"generate under max_len {self.kv_cfg.max_len}")
+        elif prompt.size > self.prefill_buckets[-1]:
             raise ValueError(
                 f"prompt length {prompt.size} exceeds the largest "
                 f"prefill bucket {self.prefill_buckets[-1]}")
@@ -657,11 +931,13 @@ class DecodeEngine:
         while time.monotonic() < deadline:
             with self._cv:
                 if self._closed or (not self._waiting
-                                    and not self._active):
+                                    and not self._active
+                                    and not self._prefilling):
                     return True
             time.sleep(0.01)
         with self._cv:
-            return not self._waiting and not self._active
+            return not self._waiting and not self._active \
+                and not self._prefilling
 
     def stop(self):
         """Stop the scheduler: waiting and active requests are
@@ -694,20 +970,26 @@ class DecodeEngine:
         into its scalar load score without building the full status
         document."""
         with self._cv:
-            return len(self._waiting), len(self._active)
+            return (len(self._waiting),
+                    len(self._active) + len(self._prefilling))
 
     def status(self) -> Dict:
         with self._cv:
             waiting = len(self._waiting)
             active = len(self._active)
+            prefilling = len(self._prefilling)
             live_tokens = sum(r.pos for r in self._active)
+            live_tokens += sum(r.prefill_pos for r in self._prefilling)
             counts = dict(self._counts)
             draining = self._draining
-        return {
+        grid = {"decode_slots": list(self.decode_slots)}
+        if self.prefill_chunk:
+            grid["prefill_chunk"] = self.prefill_chunk
+        else:
+            grid["prefill_buckets"] = list(self.prefill_buckets)
+        out = {
             "draining": draining,
-            "phase_grid": {
-                "prefill_buckets": list(self.prefill_buckets),
-                "decode_slots": list(self.decode_slots)},
+            "phase_grid": grid,
             "queue_depth": waiting,
             "active": active,
             "slot_config": self._last_slot_config,
@@ -720,6 +1002,19 @@ class DecodeEngine:
             "kv": self._alloc.stats(live_tokens=live_tokens),
             "requests": counts,
         }
+        if self._sync:
+            out["prefilling"] = prefilling
+            out["kv_reuse"] = {
+                "prefix_cache": self.config.prefix_cache,
+                "prefill_chunk": self.prefill_chunk,
+                "spec_k": self.spec_k,
+                "spec_proposed": self._spec_proposed,
+                "spec_accepted": self._spec_accepted,
+                "spec_accept_rate": round(
+                    self._spec_accepted / self._spec_proposed, 4)
+                if self._spec_proposed else None,
+            }
+        return out
 
     # -- scheduler internals (single thread owns everything below) -----
 
@@ -762,10 +1057,12 @@ class DecodeEngine:
             cat="decode", rid=req.rid, tokens=len(req.generated),
             reason=reason)
         if req.blocks:
-            self._alloc.free(req.blocks)
-            req.blocks = []
+            self._alloc.free(req.blocks)   # reuse allocator: decref;
+            req.blocks = []                # cached blocks go to LRU
         if req in self._active:
             self._active.remove(req)
+        if req in self._prefilling:
+            self._prefilling.remove(req)
         self._count(reason)
         req.events.put(None)
         self._kv_gauges()
@@ -773,6 +1070,8 @@ class DecodeEngine:
     def _kv_gauges(self):
         KV_BLOCKS.set(self._alloc.used_blocks(), state="used")
         KV_BLOCKS.set(self._alloc.free_blocks(), state="free")
+        if self.config.prefix_cache:
+            KV_BLOCKS.set(self._alloc.cached_blocks(), state="cached")
         SLOTS.set(len(self._active), state="active")
 
     def _bucket_for_len(self, n: int) -> Optional[int]:
@@ -803,6 +1102,8 @@ class DecodeEngine:
         for r in gone_waiting:
             self._finish(r, "cancelled")
         for r in [r for r in self._active if r.cancelled]:
+            self._finish(r, "cancelled")
+        for r in [r for r in self._prefilling if r.cancelled]:
             self._finish(r, "cancelled")
 
     def _admit(self) -> bool:
@@ -871,6 +1172,16 @@ class DecodeEngine:
             flops=(self._prefill[bucket].current_cost() or {})
             .get("flops"),
             tokens=1, device_kind=self._device_kind)
+        if self._draft is not None:
+            # the draft prefills EVERY sequence (same ids, same block
+            # table, its own pools) so speculation can start at the
+            # first decode round
+            dkp, dvp = self._draft_pools
+            _, dkp, dvp = self._draft_prefill[bucket](
+                self._draft_params, ids, np.int32(plen), dkp, dvp, bt)
+            self._draft_pools = (dkp, dvp)
+            req.draft_pos = plen
+            STEPS.inc(phase="draft")
         req.pos = plen
         req.admitted_at = time.monotonic()
         self._active.append(req)
@@ -911,9 +1222,16 @@ class DecodeEngine:
         and requeue it (front) with prompt = original + generated; the
         replay prefill regenerates its KV and its NEXT token — tokens
         already streamed are not re-emitted."""
-        self._active.remove(req)
-        self._alloc.free(req.blocks)
-        req.blocks = []
+        if req in self._active:
+            self._active.remove(req)
+        else:
+            self._prefilling.remove(req)
+        self._alloc.free(req.blocks)   # reuse allocator: decref — a
+        req.blocks = []                # shared prefix survives for the
+        req.prefill_pos = 0            # replay to hit again
+        req.draft_pos = 0
+        req.n_reused = 0
+        req.hashes = None
         # replay prompt: original prompt + everything generated so far
         req.prompt = np.concatenate(
             [req.prompt[:req.prompt_len0],
@@ -1055,6 +1373,420 @@ class DecodeEngine:
                     pass
             with self._cv:
                 reqs = list(self._active) + list(self._waiting)
+                self._waiting.clear()
+                QUEUE_DEPTH.set(0)
+            for req in reqs:
+                self._finish(req, "cancelled")
+
+    # -- KV-reuse scheduler (chunked prefill / prefix cache / spec) ----
+    #
+    # Any reuse feature runs THIS loop instead of _loop: synchronous
+    # rounds (each resolves on the host before the next dispatch),
+    # trading the lazy-fetch step overlap for mid-prompt admission —
+    # one prompt chunk interleaves with every decode round — and for
+    # multi-token speculation rounds.
+
+    def _prefix_block_bytes(self) -> int:
+        """Device bytes ONE cached block retains across both models'
+        pools (K and V, all layers) — the unit of the memwatch
+        prefix_cache owner row."""
+        def per(kv: KVCacheConfig) -> int:
+            return (2 * kv.layers * kv.block_size * kv.kv_heads *
+                    kv.head_dim * np.dtype(kv.dtype).itemsize)
+        n = per(self.kv_cfg)
+        if self._draft_kv_cfg is not None:
+            n += per(self._draft_kv_cfg)
+        return n
+
+    def _reserve_chunked(self, req: _Request) -> bool:
+        """Reserve the full block span for a prompt before chunking
+        starts: prefix-cache hits splice cached blocks into the front
+        of the table (skipping their recompute entirely), fresh blocks
+        cover the rest. All-or-nothing — on a pool shortfall the hits
+        are released (decref) and the request stays queued. Caller
+        holds self._cv."""
+        plen = len(req.prompt)
+        bs = self.kv_cfg.block_size
+        need = -(-plen // bs)
+        reused: List[int] = []
+        req.hashes = None
+        if self.config.prefix_cache:
+            req.hashes = _kvr.hash_blocks(req.prompt, bs)
+            # block j is shareable iff (j+1)*bs <= plen-1: the computed
+            # suffix must keep >= 1 prompt token, so the chunk program
+            # always produces the first-token logits
+            usable = [h for j, h in enumerate(req.hashes)
+                      if (j + 1) * bs <= plen - 1]
+            reused = self._alloc.match_prefix(usable)
+        if not self._alloc.can_alloc(need - len(reused)):
+            if reused:
+                self._alloc.free(reused)
+            return False
+        req.blocks = list(reused) + self._alloc.alloc(need - len(reused))
+        req.n_reused = len(reused)
+        req.prefill_pos = len(reused) * bs
+        return True
+
+    def _admit_sync(self):
+        """Admission for the sync loop: chunked prompts reserve their
+        block span and join the prefilling stage (their compute is
+        spread over later iterations); without chunking (spec-only
+        engines) the whole-prompt prefill runs here as in _admit."""
+        max_slots = self.decode_slots[-1]
+        while True:
+            chunked = False
+            with self._cv:
+                if not self._waiting or self._closed:
+                    return
+                if self.config.static_batching and \
+                        (self._active or self._prefilling):
+                    return
+                if len(self._active) + len(self._prefilling) \
+                        >= max_slots:
+                    return
+                req = self._waiting[0]
+                if self.prefill_chunk:
+                    if not self._reserve_chunked(req):
+                        return
+                    chunked = True
+                else:
+                    need = -(-len(req.prompt) // self.kv_cfg.block_size)
+                    if not self._alloc.can_alloc(need):
+                        return
+                self._waiting.popleft()
+                QUEUE_DEPTH.set(len(self._waiting))
+            if chunked:
+                _tracing.record_trace_span(
+                    "decode.queue_wait", req.tctx,
+                    time.monotonic() - req.enqueued_at, cat="decode",
+                    rid=req.rid)
+                req.admitted_at = time.monotonic()
+                self._prefilling.append(req)
+                self._kv_gauges()
+            else:
+                self._prefill_one(req)
+
+    def _pump_chunk(self):
+        """Advance the FRONT prefilling request by one chunk (both
+        models when a draft rides along). On the final chunk the
+        request's full prompt blocks register in the prefix index, the
+        first token emits, and the request joins the decode batch."""
+        if not self._prefilling:
+            return
+        req = self._prefilling[0]
+        Ck = self.prefill_chunk
+        bs = self.kv_cfg.block_size
+        plen = len(req.prompt)
+        start = req.prefill_pos
+        cid = np.empty((1, Ck), np.int32)
+        seg = req.prompt[start:start + Ck]
+        cid[0, :len(seg)] = seg
+        cid[0, len(seg):] = req.prompt[-1]     # edge-pad (in-distribution)
+        bt = build_block_table(req.blocks, self.kv_cfg.max_blocks_per_seq)
+        kp, vp = self._pools
+        t0 = time.perf_counter()
+        tok, kp, vp = self._chunk[Ck](
+            self.params, cid, np.int32(start), np.int32(plen), kp, vp,
+            bt)
+        self._pools = (kp, vp)
+        STEPS.inc(phase="prefill")
+        if self._draft is not None:
+            dkp, dvp = self._draft_pools
+            _, dkp, dvp = self._draft_chunk[Ck](
+                self._draft_params, cid, np.int32(start), np.int32(plen),
+                dkp, dvp, bt)
+            self._draft_pools = (dkp, dvp)
+            STEPS.inc(phase="draft")
+        req.prefill_pos = start + Ck
+        done = req.prefill_pos >= plen
+        _perfwatch.record_step(
+            "prefill", time.perf_counter() - t0,
+            flops=(self._chunk[Ck].current_cost() or {}).get("flops"),
+            tokens=1 if done else 0, device_kind=self._device_kind)
+        if not done:
+            return
+        tok0 = int(np.asarray(tok)[0])         # end-of-prefill sync
+        if self.config.prefix_cache and req.hashes:
+            # contents are final: full prompt blocks are never written
+            # again (decode/verify writes land at positions >= plen)
+            for j, h in enumerate(req.hashes):
+                if (j + 1) * bs <= plen - 1:
+                    self._alloc.register(req.blocks[j], h)
+        _tracing.record_trace_span(
+            "decode.prefill", req.tctx,
+            time.monotonic() - req.admitted_at, cat="decode",
+            rid=req.rid, chunk=int(Ck), prompt_len=plen,
+            reused_blocks=req.n_reused)
+        req.pos = plen
+        req.draft_pos = plen
+        self._prefilling.popleft()
+        self._active.append(req)
+        self._emit_token(req, tok0, phase="prefill")
+        reason = self._finished_reason(req)
+        if reason:
+            self._finish(req, reason)
+        self._kv_gauges()
+
+    def _cow_guard(self, req: _Request, lo: int, hi: int):
+        """Copy-on-write safety net: any SHARED block among req's
+        block indices [lo, hi] (the imminent write span) is replaced
+        by a private device copy before the write. Unreachable in the
+        normal flow — shared blocks live strictly inside the prompt
+        prefix and writes land at positions >= prompt length — but a
+        forced share (tests; future partial-block reuse) must not let
+        one sequence corrupt another's prefix."""
+        if not self.config.prefix_cache:
+            return
+        for bi in range(lo, min(hi, len(req.blocks) - 1) + 1):
+            blk = req.blocks[bi]
+            if not self._alloc.is_shared(blk):
+                continue
+            new = self._alloc.cow_alloc(blk)
+            kp, vp = self._pools
+            kp, vp = self._copy_block_fn(kp, vp, blk, new)
+            self._pools = (kp, vp)
+            if self._draft_pools is not None:
+                dkp, dvp = self._draft_pools
+                dkp, dvp = self._copy_block_fn(dkp, dvp, blk, new)
+                self._draft_pools = (dkp, dvp)
+            req.blocks[bi] = new
+
+    def _grow_blocks_sync(self, span: int):
+        """Every active slot owns (privately) the blocks its next
+        `span` KV writes land in. On pool exhaustion the youngest
+        admitted sequence — active or still prefilling — is preempted
+        until the round fits."""
+        bs = self.kv_cfg.block_size
+        while True:
+            short = None
+            try:
+                for req in self._active:
+                    lo = req.pos // bs
+                    hi = (req.pos + span - 1) // bs
+                    while hi >= len(req.blocks):
+                        req.blocks.extend(self._alloc.alloc(1))
+                    self._cow_guard(req, lo, hi)
+            except NoBlocksError:
+                short = req
+            if short is None:
+                return
+            candidates = list(self._active) + list(self._prefilling)
+            victim = max(candidates, key=lambda r: r.admitted_at)
+            self._preempt(victim)
+            if not self._active:
+                return
+
+    def _step_plain_sync(self):
+        """One synchronous decode round: every active slot advances
+        one token. With a draft model present (speculation's near-
+        max_len fallback) the draft runs the same round in lockstep so
+        its KV stays position-aligned for the next spec round."""
+        self._grow_blocks_sync(1)
+        if not self._active:
+            return
+        C = self._slot_config()
+        sig, slots = self._snapshot(C)
+        ids = np.zeros((C,), np.int32)
+        positions = np.zeros((C,), np.int32)
+        bts = np.zeros((C, self.kv_cfg.max_blocks_per_seq), np.int32)
+        for i, req in enumerate(slots):
+            if req is None:
+                continue
+            ids[i] = req.last_token
+            positions[i] = req.pos
+            bts[i] = build_block_table(req.blocks,
+                                       self.kv_cfg.max_blocks_per_seq)
+        t0 = time.perf_counter()
+        kp, vp = self._pools
+        tok, kp, vp = self._decode[C](self.params, ids, positions, kp,
+                                      vp, bts)
+        self._pools = (kp, vp)
+        if self._draft is not None:
+            self._draft_catch_up()
+            dkp, dvp = self._draft_pools
+            _, dkp, dvp = self._draft_decode[C](
+                self._draft_params, ids, positions, dkp, dvp, bts)
+            self._draft_pools = (dkp, dvp)
+            STEPS.inc(phase="draft")
+        toks = np.asarray(tok)                 # synchronous resolve
+        wall = time.perf_counter() - t0
+        STEP_SECONDS.observe(wall)
+        STEPS.inc(phase="decode")
+        occupied = sum(1 for r in slots if r is not None)
+        OCCUPANCY.observe(occupied / C)
+        self._last_slot_config = C
+        _perfwatch.record_step(
+            "decode", wall,
+            flops=(self._decode[C].current_cost() or {}).get("flops"),
+            tokens=occupied, device_kind=self._device_kind)
+        for i, req in enumerate(slots):
+            if req is None or req not in self._active:
+                continue
+            req.pos += 1
+            if self._draft is not None:
+                req.draft_pos = req.pos
+            self._emit_token(req, int(toks[i]), phase="decode")
+            reason = self._finished_reason(req)
+            if reason:
+                self._finish(req, reason)
+
+    def _draft_catch_up(self):
+        """After a fully-accepted spec round the draft's KV trails the
+        target by EXACTLY one position (the round's bonus token never
+        passed through the draft). One batched draft step feeds each
+        lagging slot the token AT its missing position; non-lagging
+        slots ride along with all-zero block tables, so their writes
+        land in the null block."""
+        if not any(r.draft_pos < r.pos for r in self._active):
+            return
+        C = self._slot_config()
+        sig, slots = self._snapshot(C)
+        ids = np.zeros((C,), np.int32)
+        positions = np.zeros((C,), np.int32)
+        bts = np.zeros((C, self.kv_cfg.max_blocks_per_seq), np.int32)
+        for i, req in enumerate(slots):
+            if req is None or req.draft_pos >= req.pos:
+                continue
+            # token at position pos-1 is the second-newest emission
+            ids[i] = req.generated[-2] if len(req.generated) >= 2 \
+                else int(req.prompt[-1])
+            positions[i] = req.draft_pos
+            bts[i] = build_block_table(req.blocks,
+                                       self.kv_cfg.max_blocks_per_seq)
+        dkp, dvp = self._draft_pools
+        _, dkp, dvp = self._draft_decode[C](
+            self._draft_params, ids, positions, dkp, dvp, bts)
+        self._draft_pools = (dkp, dvp)
+        STEPS.inc(phase="draft")
+        for req in slots:
+            if req is not None and req.draft_pos < req.pos:
+                req.draft_pos += 1
+
+    def _step_spec(self):
+        """One speculation round: k device-chained draft proposals,
+        one batched target verification, exact greedy accept — the
+        emitted stream is bit-identical to plain decode, at up to k+1
+        tokens per target step. A slot too close to max_len for the
+        k+1-token span demotes the WHOLE round to the plain path (the
+        batch always runs one program per round)."""
+        k = self.spec_k
+        if any(r.pos + k > self.kv_cfg.max_len - 1
+               for r in self._active):
+            self._step_plain_sync()
+            return
+        self._grow_blocks_sync(k + 1)
+        if not self._active:
+            return
+        self._draft_catch_up()
+        C = self._slot_config()
+        sig, slots = self._snapshot(C)
+        ids = np.zeros((C,), np.int32)
+        positions = np.zeros((C,), np.int32)
+        bts = np.zeros((C, self.kv_cfg.max_blocks_per_seq), np.int32)
+        for i, req in enumerate(slots):
+            if req is None:
+                continue
+            ids[i] = req.last_token
+            positions[i] = req.pos
+            bts[i] = build_block_table(req.blocks,
+                                       self.kv_cfg.max_blocks_per_seq)
+        t0 = time.perf_counter()
+        # k draft steps, each feeding the previous step's DEVICE token
+        # — the chain dispatches without a host sync
+        dkp, dvp = self._draft_pools
+        dtok = ids
+        drafts = []
+        for j in range(k):
+            dtok, dkp, dvp = self._draft_decode[C](
+                self._draft_params, dtok,
+                (positions + j).astype(np.int32), dkp, dvp, bts)
+            drafts.append(dtok)
+            STEPS.inc(phase="draft")
+        self._draft_pools = (dkp, dvp)
+        ids_v = np.empty((C, k + 1), np.int32)
+        ids_v[:, 0] = ids
+        for j, d in enumerate(drafts):         # draft-chain sync point
+            ids_v[:, j + 1] = np.asarray(d)
+        kp, vp = self._pools
+        vtok, kp, vp = self._verify[C](self.params, ids_v, positions,
+                                       kp, vp, bts)
+        self._pools = (kp, vp)
+        STEPS.inc(phase="verify")
+        outs = np.asarray(vtok)                # [C, k+1]
+        wall = time.perf_counter() - t0
+        STEP_SECONDS.observe(wall)
+        occupied = sum(1 for r in slots if r is not None)
+        OCCUPANCY.observe(occupied / C)
+        self._last_slot_config = C
+        emitted = 0
+        for i, req in enumerate(slots):
+            if req is None or req not in self._active:
+                continue
+            props = [int(x) for x in ids_v[i, 1:]]
+            row = [int(x) for x in outs[i]]
+            a = _kvr.accept_length(props, row)
+            self._spec_proposed += k
+            self._spec_accepted += a
+            pos0 = req.pos
+            remaining = req.max_new - len(req.generated)
+            emit = []
+            for t in row[:min(a + 1, remaining)]:
+                emit.append(t)
+                if t == self.eos_id:
+                    break
+            req.pos = pos0 + len(emit)
+            # full accept leaves the draft one position behind (the
+            # bonus token o_k never passed through it); any rejection
+            # lands draft_pos exactly at the new pos
+            req.draft_pos = min(pos0 + k, req.pos)
+            for t in emit:
+                self._emit_token(req, int(t), phase="decode")
+            emitted += len(emit)
+            reason = self._finished_reason(req)
+            if reason:
+                self._finish(req, reason)
+        if self._spec_proposed:
+            _kvr.SPEC_ACCEPT_RATE.set(
+                self._spec_accepted / self._spec_proposed)
+        _perfwatch.record_step(
+            "decode", wall,
+            flops=(self._verify[C].current_cost() or {}).get("flops"),
+            tokens=emitted, device_kind=self._device_kind)
+
+    def _loop_sync(self):
+        try:
+            while True:
+                with self._cv:
+                    while not self._closed and not self._waiting \
+                            and not self._active \
+                            and not self._prefilling:
+                        self._cv.wait(timeout=0.5)
+                    if self._closed:
+                        break
+                self._sweep_cancelled()
+                self._admit_sync()
+                self._pump_chunk()             # one slice per iteration
+                if not self._active:
+                    continue
+                if self.spec_k:
+                    self._step_spec()
+                else:
+                    self._step_plain_sync()
+        except BaseException as e:  # scheduler death must not hang clients
+            with self._cv:
+                reqs = (list(self._active) + list(self._prefilling) +
+                        list(self._waiting))
+                self._waiting.clear()
+            for req in reqs:
+                req.error = RuntimeError(
+                    f"decode scheduler failed: {type(e).__name__}: {e}")
+                req.error.__cause__ = e
+                self._finish(req, "error")
+            raise
+        finally:
+            with self._cv:
+                reqs = (list(self._active) + list(self._prefilling) +
+                        list(self._waiting))
                 self._waiting.clear()
                 QUEUE_DEPTH.set(0)
             for req in reqs:

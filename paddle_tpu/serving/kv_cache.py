@@ -36,7 +36,8 @@ import numpy as np
 
 __all__ = ["KVCacheConfig", "BlockAllocator", "NoBlocksError",
            "init_pools", "write_token_kv", "write_prefill_kv",
-           "gather_kv", "NULL_BLOCK"]
+           "write_chunk_kv", "write_span_kv", "gather_kv",
+           "NULL_BLOCK"]
 
 NULL_BLOCK = 0
 
@@ -190,6 +191,44 @@ def write_prefill_kv(pool_l: jax.Array, kv: jax.Array,
     blk = block_table[t // block_size]
     slot = t % block_size
     return pool_l.at[blk, slot].set(kv)
+
+
+def write_chunk_kv(pool_l: jax.Array, kv: jax.Array,
+                   block_table: jax.Array, start: jax.Array,
+                   block_size: int) -> jax.Array:
+    """Scatter one prompt SLICE's K (or V) into one layer's pool slice
+    (chunked prefill). pool_l `[NB, BS, H, D]`, kv `[C, H, D]` holding
+    positions start..start+C-1, block_table `[MB]`. Positions past the
+    table width are redirected to the null block (the final chunk's
+    edge-padded tail can run past max_len); positions inside allocated
+    blocks but past the true prompt length write garbage slots that
+    later writes overwrite before any mask lets them be read — the
+    same contract as write_prefill_kv."""
+    t = jnp.arange(kv.shape[0], dtype=jnp.int32) + start
+    bi = t // block_size
+    mb = block_table.shape[0]
+    blk = jnp.where(bi < mb, block_table[jnp.minimum(bi, mb - 1)],
+                    NULL_BLOCK)
+    return pool_l.at[blk, t % block_size].set(kv)
+
+
+def write_span_kv(pool_l: jax.Array, kv: jax.Array,
+                  block_tables: jax.Array, positions: jax.Array,
+                  block_size: int) -> jax.Array:
+    """Scatter a W-token span per slot into one layer's pool slice
+    (speculative verification). pool_l `[NB, BS, H, D]`, kv
+    `[S, W, H, D]` holding each slot's positions p..p+W-1, block_tables
+    `[S, MB]`, positions `[S]` = each slot's span start. Slots with
+    all-zero tables (inactive / masked out) write the null block; span
+    positions past the table width are redirected there too."""
+    w = kv.shape[1]
+    t = positions[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    bi = t // block_size
+    mb = block_tables.shape[1]
+    blk = jnp.take_along_axis(block_tables, jnp.minimum(bi, mb - 1),
+                              axis=1)
+    blk = jnp.where(bi < mb, blk, NULL_BLOCK)
+    return pool_l.at[blk, t % block_size].set(kv)
 
 
 def gather_kv(pool_l: jax.Array, block_tables: jax.Array) -> jax.Array:

@@ -122,6 +122,7 @@ _INSTRUMENTED_MODULES = (
     "paddle_tpu.serving.engine",
     "paddle_tpu.serving.router",
     "paddle_tpu.serving.decode",
+    "paddle_tpu.serving.kv_reuse",
     "paddle_tpu.serving.autoscale",
     "paddle_tpu.serving.httpd",
     "paddle_tpu.distributed.launch_serve",
@@ -145,6 +146,9 @@ _MUST_BE_DOCUMENTED = (
     "paddle_tpu_hbm_budget_bytes",
     "paddle_tpu_executable_bytes",
     "paddle_tpu_oom_total",
+    "paddle_tpu_prefix_cache_total",
+    "paddle_tpu_decode_blocks_reused",
+    "paddle_tpu_decode_spec_accept_rate",
 )
 
 

@@ -789,6 +789,14 @@ def cmd_decode(args) -> int:
         occ_avg = round(float(occ[0]["sum"]) / occ[0]["count"], 3)
     ttft = _hist_summary(snap, "paddle_tpu_decode_ttft_seconds")
     step_h = _hist_summary(snap, "paddle_tpu_decode_step_seconds")
+    prefix = {k: int(v) for k, v in
+              labeled("paddle_tpu_prefix_cache_total", "event").items()}
+    reused = next((int(s["value"]) for s in
+                   series("paddle_tpu_decode_blocks_reused")), None)
+    accept = next((round(float(s["value"]), 4) for s in
+                   series("paddle_tpu_decode_spec_accept_rate")), None)
+    kv_reuse = {"prefix_cache": prefix, "blocks_reused": reused,
+                "spec_accept_rate": accept}
 
     if not tokens and not steps and gauges["queue_depth"] is None:
         print("no decode_* samples in this snapshot (did a DecodeEngine "
@@ -796,7 +804,7 @@ def cmd_decode(args) -> int:
         return 0
     out = dict(gauges, tokens=tokens, steps=steps, requests=outcomes,
                preemptions=preempt, slot_occupancy_avg=occ_avg,
-               ttft=ttft, step_seconds=step_h)
+               ttft=ttft, step_seconds=step_h, kv_reuse=kv_reuse)
     if args.json:
         print(json.dumps(out, indent=2))
         return 0
@@ -816,6 +824,14 @@ def cmd_decode(args) -> int:
                                     sorted(outcomes.items()) if v)
                           or "none"))
     print(f"preemptions: {preempt}")
+    if prefix or reused or accept is not None:
+        line = "kv reuse: " + (", ".join(
+            f"{k}={v}" for k, v in sorted(prefix.items())) or "none")
+        if reused is not None:
+            line += f"  blocks_reused={reused}"
+        if accept is not None:
+            line += f"  spec_accept_rate={accept}"
+        print(line)
     for label, h in (("ttft", ttft), ("step", step_h)):
         if h and h.get("count"):
             print(f"{label}: n={h['count']} avg={h['avg_ms']}ms "

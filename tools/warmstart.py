@@ -13,6 +13,7 @@ Usage:
                     [--max-batch N] [--cpu]
   warmstart.py bake-decode --out ART [--preset tiny] [--seed 0]
                     [--slots 4,8] [--prefill-buckets 8,16,32]
+                    [--prefill-chunk C] [--spec-k K]
                     [--block-size 16] [--num-blocks N]
                     [--precision bf16] [--cpu]
   warmstart.py inspect ART
@@ -25,6 +26,14 @@ rebuilt deterministically from --preset/--seed (jax PRNG is
 reproducible across processes for a fixed jax version), and the
 artifact is bound to the params digest + grid geometry, so a drifted
 model or config is rejected at adoption, never silently served.
+
+`--prefill-chunk` re-keys the grid for the chunked-prefill path
+(SERVING.md §KV reuse): the per-prompt-length prefill buckets collapse
+into one fixed-size chunk program, so the artifact carries
+chunk+decode phases instead of bucket+decode phases. `--spec-k` adds
+the speculative-decoding phases (draft prefill/decode + verify); the
+draft is the same preset model (self-draft), deterministic from the
+same --seed, so the digest binding still holds.
 
 `bake` prints one JSON line: buckets warmed, entries serialized,
 warmup seconds, artifact size. `inspect` reads only the artifact
@@ -134,9 +143,20 @@ def cmd_bake_decode(args) -> int:
     blocks_per_seq = -(-max_len // args.block_size)
     num_blocks = args.num_blocks or \
         (1 + max(slots) * blocks_per_seq)
+    grid_kw = {}
+    if args.prefill_chunk:
+        # chunked path: the bucket dimension collapses into one chunk
+        # program, so --prefill-buckets is ignored for the grid key
+        grid_kw["prefill_chunk"] = args.prefill_chunk
+    else:
+        grid_kw["prefill_buckets"] = buckets
     dc = DecodeConfig(block_size=args.block_size, num_blocks=num_blocks,
-                      decode_slots=slots, prefill_buckets=buckets,
-                      max_len=max_len, precision=args.precision)
+                      decode_slots=slots, max_len=max_len,
+                      precision=args.precision, spec_k=args.spec_k,
+                      **grid_kw)
+    # self-draft: same params serve as the draft model, so the baked
+    # draft/verify phases stay deterministic from --preset/--seed
+    draft = (params, cfg) if args.spec_k else None
     if args.cpu:
         guard = contextlib.nullcontext()
     else:
@@ -145,15 +165,19 @@ def cmd_bake_decode(args) -> int:
         guard = tpu_singleflight(timeout=600.0)
     with guard:
         t0 = time.perf_counter()
-        engine = DecodeEngine(params, cfg, dc)
+        engine = DecodeEngine(params, cfg, dc, draft=draft)
         ready = engine.warmup()
         warm_s = time.perf_counter() - t0
         n = engine.export_warmstart(args.out)
+    grid_out = {"decode_slots": slots, "spec_k": args.spec_k}
+    if args.prefill_chunk:
+        grid_out["prefill_chunk"] = args.prefill_chunk
+    else:
+        grid_out["prefill_buckets"] = buckets
     print(json.dumps({
         "artifact": args.out,
         "preset": args.preset, "seed": args.seed,
-        "phase_grid": {"prefill_buckets": buckets,
-                       "decode_slots": slots},
+        "phase_grid": grid_out,
         "phases_ready": ready,
         "entries": n,
         "precision": args.precision,
@@ -248,6 +272,12 @@ def main(argv=None) -> int:
                     help="comma-separated decode slot counts")
     dp.add_argument("--prefill-buckets", default="8,16,32",
                     help="comma-separated prompt-length buckets")
+    dp.add_argument("--prefill-chunk", type=int, default=0,
+                    help="chunked-prefill slice size; collapses the "
+                    "prefill buckets into one chunk phase (0 = off)")
+    dp.add_argument("--spec-k", type=int, default=0,
+                    help="speculative-decoding draft length; bakes the "
+                    "draft + verify phases with a self-draft (0 = off)")
     dp.add_argument("--block-size", type=int, default=16)
     dp.add_argument("--num-blocks", type=int, default=None,
                     help="KV pool blocks (default: worst-case for the "
