@@ -47,6 +47,16 @@ class QuietHandler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
 
+class _DeepBacklogServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a deep listen backlog: socketserver's
+    default of 5 drops SYNs under a concurrent-connect burst, turning
+    overload into ~1s TCP retransmit stalls for EVERY caller — before
+    QoS admission (which can only order connections the kernel
+    accepted) gets a say."""
+
+    request_queue_size = 128
+
+
 class HTTPServerHandle:
     """Lifecycle for one ThreadingHTTPServer daemon thread.
 
@@ -107,7 +117,8 @@ class HTTPServerHandle:
             if host is None and self._host_env:
                 host = os.environ.get(self._host_env)
             host = host or self._default_host
-            srv = ThreadingHTTPServer((host, int(port)), self._handler_cls)
+            srv = _DeepBacklogServer((host, int(port)),
+                                     self._handler_cls)
             srv.daemon_threads = True
             t = threading.Thread(target=srv.serve_forever,
                                  name=self._thread_name, daemon=True)

@@ -28,9 +28,18 @@ deployment surface in front of it:
                  decoding (SERVING.md §Continuous batching, §KV
                  reuse).
 - httpd.py     — JSON-over-HTTP frontend (POST /v1/predict, chunked
-                 POST /v1/generate token streaming, GET /v1/status,
-                 the /v1/load probe + stateful /v1/healthz) on the
-                 shared observability HTTP base.
+                 POST /v1/generate token streaming, GET /v1/status
+                 /v1/models, the /v1/load probe + stateful
+                 /v1/healthz) on the shared observability HTTP base;
+                 multi-model Server (one engine+batcher slot per model
+                 id) with zero-downtime hot-swap.
+- qos.py       — per-tenant QoS (SERVING.md §Multi-tenancy): tier/
+                 weight/quota policy, start-time-fair weighted token
+                 scheduling, shed-lowest-tier-first admission and the
+                 typed ShedError behind the Retry-After 503.
+- registry.py  — content-addressed model registry: publish warmstart
+                 artifacts under digest, replicas watch and hot-swap
+                 new versions with zero failed requests.
 - router.py    — fleet front tier (SERVING.md §Fleet): power-of-two-
                  choices load balancing over N replicas, health
                  ejection, per-endpoint circuit breakers, idempotent
@@ -55,9 +64,13 @@ from .kv_cache import BlockAllocator, KVCacheConfig, NoBlocksError  # noqa: F401
 from .kv_reuse import ReuseBlockAllocator, accept_length, hash_blocks  # noqa: F401
 from .decode import DecodeConfig, DecodeEngine, DecodeHandle  # noqa: F401
 from .httpd import Server  # noqa: F401
+from .qos import (  # noqa: F401
+    QoSPolicy, ShedError, TenantSpec, WeightedFairScheduler,
+)
+from .registry import ModelRegistry, RegistryError  # noqa: F401
 from .router import (  # noqa: F401
     FleetError, FleetTimeout, NoReplicasError, ReplicaRejected, Router,
-    RouterServer, StreamBrokenError,
+    RouterServer, StreamBrokenError, TierShed,
 )
 from .autoscale import Autoscaler  # noqa: F401
 
@@ -69,7 +82,9 @@ __all__ = [
     "BlockAllocator", "KVCacheConfig", "NoBlocksError",
     "ReuseBlockAllocator", "accept_length", "hash_blocks",
     "DecodeConfig", "DecodeEngine", "DecodeHandle",
+    "QoSPolicy", "ShedError", "TenantSpec", "WeightedFairScheduler",
+    "ModelRegistry", "RegistryError",
     "Router", "RouterServer", "Autoscaler",
     "FleetError", "NoReplicasError", "ReplicaRejected", "FleetTimeout",
-    "StreamBrokenError",
+    "StreamBrokenError", "TierShed",
 ]

@@ -33,6 +33,14 @@ seconds because replicas boot from the PR 6 warmstart artifact;
 scale-in is graceful because the supervisor SIGTERMs and the replica
 runs leave→drain→stop (zero dropped in-flight requests, tested by
 `serve_bench --fleet`).
+
+Multi-model fleets (SERVING.md §Multi-tenancy) allocate replica counts
+per model by running one Autoscaler + ReplicaSupervisor pair per model
+id against the SAME router: `Autoscaler(model="bert")` scopes the
+utilization signal to `router.mean_load_per_healthy(model="bert")` —
+the replicas advertising that model in /v1/load — while each model's
+supervisor boots replicas serving only its model. Models then scale
+independently on their own load, sharing the fleet's front door.
 """
 
 from __future__ import annotations
@@ -62,6 +70,7 @@ class Autoscaler:
     replica_count(), scale_out() and scale_in()."""
 
     def __init__(self, router, supervisor, *,
+                 model: Optional[str] = None,
                  min_replicas: int = 1, max_replicas: int = 4,
                  high_load: float = 4.0, low_load: float = 0.5,
                  p99_high_ms: Optional[float] = None,
@@ -81,6 +90,10 @@ class Autoscaler:
                 "is the hysteresis band; without it the fleet flaps")
         self.router = router
         self.supervisor = supervisor
+        # scope the utilization signal to one model's replica slice
+        # (per-model allocation: one Autoscaler+Supervisor pair per
+        # model id, all sharing one Router). None = whole fleet.
+        self.model = str(model) if model is not None else None
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
         self.high_load = float(high_load)
@@ -142,7 +155,12 @@ class Autoscaler:
         real signal: an empty fleet (load None) is the supervisor's /
         router's problem, not a scale-in signal."""
         n = self.supervisor.replica_count()
-        load = self.router.mean_load_per_healthy()
+        if self.model is not None:
+            load = self.router.mean_load_per_healthy(model=self.model)
+        else:
+            # keyword-free call keeps duck-typed test fakes (zero-arg
+            # mean_load_per_healthy) working unchanged
+            load = self.router.mean_load_per_healthy()
         p99 = self.router.recent_p99()
         p99_ms = p99 * 1000.0 if p99 is not None else None
         TARGET.set(n)
@@ -201,6 +219,7 @@ class Autoscaler:
 
     def status(self) -> Dict:
         return {
+            "model": self.model,
             "min": self.min_replicas, "max": self.max_replicas,
             "high_load": self.high_load, "low_load": self.low_load,
             "p99_high_ms": self.p99_high_ms,

@@ -81,7 +81,9 @@ class ServingConfig:
                  precision: str = "f32",
                  calibration=None,
                  accuracy_check_batches: int = 4,
-                 slo_spec=None):
+                 slo_spec=None,
+                 qos=None,
+                 model_id: str = "default"):
         self.model_dir = model_dir
         self.buckets = tuple(buckets) if buckets is not None else None
         self.max_batch = int(max_batch)
@@ -120,6 +122,12 @@ class ServingConfig:
         # evaluator; recording (PADDLE_TPU_TS_DIR) must be on for the
         # burn rates to have data (PROFILE.md §Time series & SLOs)
         self.slo_spec = slo_spec
+        # qos: a serving.qos.QoSPolicy or its from_spec dict (None =
+        # single-tenant FIFO); model_id: this config's slot name in a
+        # multi-model Server and the fleet's routing key (SERVING.md
+        # §Multi-tenancy)
+        self.qos = qos
+        self.model_id = str(model_id)
 
 
 class Engine:

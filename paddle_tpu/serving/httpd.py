@@ -39,6 +39,22 @@ Routes (schema documented in SERVING.md §HTTP API):
                      batcher is gone. (The process-wide anomaly-aware
                      probe stays on the observability server,
                      PADDLE_TPU_METRICS_PORT.)
+  GET  /v1/models    the multi-model surface (SERVING.md
+                     §Multi-tenancy): one row per model slot — id,
+                     program digest, adopted registry version, warm
+                     state, per-slot request counts.
+
+Multi-tenancy (SERVING.md §Multi-tenancy): /v1/predict and
+/v1/generate accept optional "model" and "tenant" payload fields. A
+`Server` holds one engine+batcher slot per model id (all sharing the
+process and its HBM budget); QoS shed/quota rejections answer 503 with
+a Retry-After header and the typed body {"shed": "<tier>", "kind":
+"queue"|"quota"} that the fleet router classifies as an answer rather
+than a retryable failure. `hot_swap()` (and the registry watcher
+behind `attach_registry()`) replaces a slot's engine with one built
+from a newly published artifact while the old batcher drains — zero
+failed requests, and zero fresh compiles when the artifact's
+executables are adopted.
 
 Built on `observability.httpbase` — same silent logging, locked
 idempotent start/stop, daemon threading, and atexit discipline as the
@@ -64,13 +80,20 @@ from ..observability import memwatch as _memwatch
 from ..observability import slo as _slo
 from ..observability import timeseries as _timeseries
 from ..observability import tracing as _tracing
+from ..observability import metrics as _m
 from ..observability.metrics import _json_safe
 from .decode import DecodeEngine
 from .batcher import (Batcher, EngineError, QueueFullError,
                       RequestTimeout, ServerClosed)
 from .engine import Engine, ServingConfig
+from .qos import QoSPolicy, ShedError
 
 __all__ = ["Server"]
+
+MODEL_SWAPS = _m.counter(
+    "paddle_tpu_model_swaps_total",
+    "Completed zero-downtime model hot-swaps, by model id",
+    labelnames=("model",))
 
 
 class _ServingHandler(_base.QuietHandler):
@@ -111,10 +134,13 @@ class _ServingHandler(_base.QuietHandler):
                     200 if state == "serving" else 503,
                     {"status": "ok" if state == "serving"
                      else "unavailable", "state": state})
+            elif path == "/v1/models":
+                self._json_reply(200, {"models": self.serving.models()})
             else:
                 self._reply(404, "text/plain",
                             "not found; routes: POST /v1/predict, "
-                            "GET /v1/status /v1/load /v1/healthz\n")
+                            "GET /v1/status /v1/load /v1/healthz "
+                            "/v1/models\n")
         except _base.CLIENT_GONE:
             pass
 
@@ -127,11 +153,29 @@ class _ServingHandler(_base.QuietHandler):
         self.wfile.write(b"\r\n")
         self.wfile.flush()
 
+    def _shed_reply(self, e: ShedError):
+        """The typed shed/quota 503: Retry-After + {"shed": tier} body
+        the fleet router classifies as an ANSWER (no failover retry) —
+        re-sending a deliberately shed request onto a surviving replica
+        amplifies exactly the overload the shed is relieving."""
+        self._json_reply(
+            503, {"error": str(e), "shed": e.tier, "kind": e.kind,
+                  "tenant": e.tenant,
+                  "retry_after_s": e.retry_after_s},
+            headers={"Retry-After":
+                     str(max(1, int(round(e.retry_after_s))))})
+
     def _do_generate(self, payload: Dict):
         from .batcher import QueueFullError, ServerClosed
 
-        decode = self.serving._decode
+        model = payload.get("model")
+        decode = self.serving._decode_for(model)
         if decode is None:
+            if model is not None \
+                    and str(model) not in self.serving._decodes:
+                self._json_reply(404, {"error": f"unknown model "
+                                                f"{str(model)!r}"})
+                return
             self._json_reply(404, {"error": "no decode engine attached "
                                             "to this server"})
             return
@@ -151,7 +195,11 @@ class _ServingHandler(_base.QuietHandler):
         stream = bool(payload.get("stream", True))
         timeout = payload.get("timeout_s")
         try:
-            handle = decode.submit(ids, max_new_tokens=int(max_new))
+            handle = decode.submit(ids, max_new_tokens=int(max_new),
+                                   tenant=payload.get("tenant"))
+        except ShedError as e:
+            self._shed_reply(e)
+            return
         except (QueueFullError, ServerClosed) as e:
             self._json_reply(503, {"error": str(e)},
                              headers=self.serving._retry_after())
@@ -287,8 +335,19 @@ class _ServingHandler(_base.QuietHandler):
                                                "numeric arrays"})
                 return
             timeout = payload.get("timeout_s")
+            model = payload.get("model")
+            if model is not None \
+                    and str(model) not in self.serving._model_ids():
+                self._json_reply(404, {"error": f"unknown model "
+                                                f"{str(model)!r}"})
+                return
             try:
-                outs = self.serving.submit(arrays, timeout_s=timeout)
+                outs = self.serving.submit(
+                    arrays, timeout_s=timeout, model=model,
+                    tenant=payload.get("tenant"))
+            except ShedError as e:
+                self._shed_reply(e)
+                return
             except (QueueFullError, ServerClosed) as e:
                 # draining replicas add Retry-After so the fleet router
                 # (and any well-behaved client) re-sends elsewhere NOW
@@ -329,21 +388,48 @@ class Server:
     crashing deployments never leak the listener or batcher thread."""
 
     def __init__(self, config: ServingConfig,
-                 predictor=None, decode=None):
-        """`decode`, when given, is a `decode.DecodeEngine`; the server
-        then also answers POST /v1/generate and folds the decode block
-        into /v1/status. A decode-only server (no model_dir, no
+                 predictor=None, decode=None, models=None,
+                 registry=None):
+        """`decode`, when given, is a `decode.DecodeEngine` (or a dict
+        `{model_id: DecodeEngine}` for multi-model generation); the
+        server then also answers POST /v1/generate and folds the decode
+        block into /v1/status. A decode-only server (no model_dir, no
         predictor) skips the predict engine entirely — /v1/predict
-        answers 503."""
+        answers 503. `models`, when given, is `{model_id:
+        ServingConfig}` for ADDITIONAL predict models served from this
+        process alongside `config`'s (the default slot, named by
+        `config.model_id`); all slots share the process, its HBM
+        budget, and one listener. `registry`, when given, is a
+        `registry.ModelRegistry` the server watches for hot-swaps
+        (see attach_registry)."""
         self.config = config
+        self._default_id = getattr(config, "model_id", "default")
+        decodes = decode if isinstance(decode, dict) else \
+            ({self._default_id: decode} if decode is not None else {})
+        self._decodes: Dict[str, DecodeEngine] = \
+            {str(k): v for k, v in decodes.items()}
         # annotated so tools/lockgraph.py can type the attribute (the
         # value is a constructor parameter it cannot infer from)
-        self._decode: Optional[DecodeEngine] = decode
+        self._decode: Optional[DecodeEngine] = \
+            self._decodes.get(self._default_id)
         self._engine = None \
-            if (decode is not None and config.model_dir is None
+            if (self._decodes and config.model_dir is None
                 and predictor is None) \
             else Engine(config, predictor=predictor)
         self._batcher: Optional[Batcher] = None
+        # additional predict-model slots: model_id -> {config, engine,
+        # batcher}; engines build NOW (fail a bad config at
+        # construction like the default slot), batchers at start()
+        self._extra: Dict[str, Dict] = {}
+        for mid, mcfg in (models or {}).items():
+            mid = str(mid)
+            if mid == self._default_id:
+                raise ValueError(
+                    f"models= duplicates the default slot {mid!r}")
+            self._extra[mid] = {"config": mcfg,
+                                "engine": Engine(mcfg),
+                                "batcher": None}
+        self._qos = QoSPolicy.from_spec(getattr(config, "qos", None))
         handler = type("_BoundServingHandler", (_ServingHandler,),
                        {"serving": self})
         self._http = _base.HTTPServerHandle(
@@ -355,6 +441,16 @@ class Server:
         self._lock = _lockcheck.Lock("serving.httpd.Server._lock")
         self._started_t: Optional[float] = None
         self._draining = False
+        # registry hot-swap state: adopted version per model slot, the
+        # watcher thread, and its stop flag
+        self._versions: Dict[str, int] = {}
+        self._registry = None
+        self._watch_ids = None
+        self._watch_poll_s = 1.0
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+        if registry is not None:
+            self.attach_registry(registry)
 
     # -- lifecycle -----------------------------------------------------
 
@@ -373,30 +469,37 @@ class Server:
             # decode scheduler starts only after the bind succeeds, so
             # a failed start never leaves it running (and never kills
             # the caller's engine, whose stop() is terminal).
-            if self._decode is not None and self.config.warmup \
-                    and not self._decode.warmed:
-                self._decode.warmup()
+            if self.config.warmup:
+                for dec in self._decodes.values():
+                    if not dec.warmed:
+                        dec.warmup()
             batcher = None
             if self._engine is not None:
                 if self.config.warmup:
                     self._engine.warmup()
-                batcher = Batcher(
-                    self._engine.run_batch, self._engine.policy,
-                    max_queue=self.config.max_queue,
-                    max_wait_ms=self.config.max_wait_ms,
-                    timeout_s=self.config.timeout_s,
-                    output_batched=self._engine.output_batched)
+                batcher = self._make_batcher(self._engine, self.config)
+            extra_batchers = []
             try:
+                for mid, slot in self._extra.items():
+                    if slot["config"].warmup:
+                        slot["engine"].warmup()
+                    extra_batchers.append(
+                        (mid, self._make_batcher(slot["engine"],
+                                                 slot["config"])))
                 bound = self._http.start(
                     self.config.port if port is None else port,
                     host=self.config.host)
             except BaseException:
                 if batcher is not None:
                     batcher.stop()  # failed bind must not leak the thread
+                for _, b in extra_batchers:
+                    b.stop()
                 raise
-            if self._decode is not None:
-                self._decode.start()
+            for dec in self._decodes.values():
+                dec.start()
             self._batcher = batcher
+            for mid, b in extra_batchers:
+                self._extra[mid]["batcher"] = b
             self._started_t = time.monotonic()
             import atexit
 
@@ -410,10 +513,22 @@ class Server:
             _events.emit("serve_start", port=bound,
                          buckets=list(self._engine.policy.buckets)
                          if self._engine is not None else [],
-                         decode=self._decode is not None,
+                         decode=bool(self._decodes),
+                         models=self._model_ids(),
+                         qos=self._qos is not None,
                          max_queue=self.config.max_queue,
                          max_wait_ms=self.config.max_wait_ms)
+            self._maybe_start_watcher()
             return bound
+
+    def _make_batcher(self, engine: Engine, cfg: ServingConfig) -> Batcher:
+        return Batcher(
+            engine.run_batch, engine.policy,
+            max_queue=cfg.max_queue,
+            max_wait_ms=cfg.max_wait_ms,
+            timeout_s=cfg.timeout_s,
+            output_batched=engine.output_batched,
+            qos=self._qos)
 
     def drain(self, timeout: float = 30.0):
         """Graceful drain, the fleet's scale-in half-step (SERVING.md
@@ -429,19 +544,20 @@ class Server:
             else:
                 self._draining = True
                 already = False
-            batcher, decode = self._batcher, self._decode
+            batchers = self._all_batchers()
+            decodes = list(self._decodes.values())
         if not already:
             _events.emit("serve_drain",
-                         queue_depth=batcher.depth() if batcher else 0)
-        # ONE deadline across both engines: `timeout` bounds the whole
+                         queue_depth=sum(b.depth() for b in batchers))
+        # ONE deadline across every engine: `timeout` bounds the whole
         # drain, not each stage (a supervisor sizing its SIGKILL grace
         # against drain_timeout_s must not be off by 2x)
         deadline = time.monotonic() + float(timeout)
-        if batcher is not None:
+        for batcher in batchers:
             # stop() is the drain: no new admissions, pending batches
             # finish, the thread joins
-            batcher.stop(timeout=timeout)
-        if decode is not None:
+            batcher.stop(timeout=max(0.0, deadline - time.monotonic()))
+        for decode in decodes:
             decode.drain(timeout_s=max(0.0,
                                        deadline - time.monotonic()))
 
@@ -465,16 +581,16 @@ class Server:
                 return "stopped"
             if self._draining:
                 return "draining"
-            batcher, decode = self._batcher, self._decode
-        if decode is not None and decode._closed:
+            batchers = self._all_batchers()
+            decodes = list(self._decodes.values())
+            engines = self._all_engines()
+        if any(d._closed for d in decodes):
             return "stopped"
-        if batcher is not None and batcher.draining():
+        if any(b.draining() for b in batchers):
             return "draining"
-        if self._engine is not None and not self._engine.warmed \
-                and self.config.warmup:
-            return "warming"
-        if decode is not None and not decode.warmed \
-                and self.config.warmup:
+        if self.config.warmup and (
+                any(not e.warmed for e in engines)
+                or any(not d.warmed for d in decodes)):
             return "warming"
         return "serving"
 
@@ -483,20 +599,27 @@ class Server:
         in-flight work as one scalar, touching only counters (no bucket
         table, no KV stats — the router polls this per replica per
         interval)."""
-        batcher, decode = self._batcher, self._decode
-        depth = batcher.depth() if batcher is not None else 0
-        inflight = batcher.inflight() if batcher is not None else 0
-        if decode is not None:
+        depth = sum(b.depth() for b in self._all_batchers())
+        inflight = sum(b.inflight() for b in self._all_batchers())
+        for decode in self._decodes.values():
             d_wait, d_active = decode.load()
             depth += d_wait
             inflight += d_active
         return {"load": float(depth + inflight), "inflight": inflight,
-                "queue_depth": depth, "state": self.state()}
+                "queue_depth": depth, "state": self.state(),
+                "models": self._model_ids()}
 
     def stop(self):
         """Stop accepting (listener down first), drain the batcher so
         in-flight requests finish, then emit `serve_stop`. Idempotent;
         unregisters its atexit hook so stopped servers are collectable."""
+        # the registry watcher joins OUTSIDE the lock: its poll loop
+        # takes the lock for hot-swaps, so joining under it deadlocks
+        self._watch_stop.set()
+        watcher = self._watch_thread
+        if watcher is not None and watcher.is_alive():
+            watcher.join(timeout=10.0)
+        self._watch_thread = None
         # the whole teardown runs under the lock so a concurrent start()
         # cannot interleave (and e.g. have its fresh batcher killed or
         # its "bound" port be the one being closed)
@@ -507,10 +630,7 @@ class Server:
 
             atexit.unregister(self.stop)
             self._http.stop()
-            if self._batcher is not None:
-                self._batcher.stop()
-            if self._decode is not None:
-                self._decode.stop()
+            self._stop_slots_locked()
             if not started:
                 return  # safety path: a start() that raised mid-way
             counts = self._counts()
@@ -519,28 +639,262 @@ class Server:
                      timeout=counts["timeout"])
 
     def _counts(self) -> Dict[str, int]:
-        """THIS server's outcomes (the Prometheus counter is process-
-        global; the batcher keeps per-instance counts)."""
-        b = self._batcher
-        return b.outcome_counts() if b is not None else \
-            {o: 0 for o in ("ok", "rejected", "timeout", "error")}
+        """THIS server's outcomes, summed over model slots (the
+        Prometheus counter is process-global; batchers keep
+        per-instance counts)."""
+        out = {o: 0 for o in ("ok", "rejected", "timeout", "error")}
+        for b in self._all_batchers():
+            for k, v in b.outcome_counts().items():
+                out[k] = out.get(k, 0) + v
+        return out
+
+    def _stop_slots_locked(self):
+        """Stop every slot's batcher and decode engine (caller holds
+        Server._lock). The typed default-slot references double as the
+        lockgraph witness for the ledgered order: Server._lock wraps
+        the inner component locks during teardown."""
+        if self._batcher is not None:
+            self._batcher.stop()
+        if self._decode is not None:
+            self._decode.stop()
+        for batcher in self._all_batchers():
+            if batcher is not self._batcher:
+                batcher.stop()
+        for decode in self._decodes.values():
+            if decode is not self._decode:
+                decode.stop()
 
     def port(self) -> Optional[int]:
         return self._http.port()
 
+    # -- model slots (multi-model surface) -----------------------------
+
+    def _all_batchers(self):
+        out = [] if self._batcher is None else [self._batcher]
+        out.extend(s["batcher"] for s in self._extra.values()
+                   if s["batcher"] is not None)
+        return out
+
+    def _all_engines(self):
+        out = [] if self._engine is None else [self._engine]
+        out.extend(s["engine"] for s in self._extra.values())
+        return out
+
+    def _model_ids(self):
+        ids = set(self._extra) | set(self._decodes)
+        if self._engine is not None:
+            ids.add(self._default_id)
+        return sorted(ids)
+
+    def _slot(self, model: Optional[str]):
+        """(engine, batcher) for a model id; None model = the default
+        slot. Raises KeyError for an unknown id."""
+        mid = self._default_id if model is None else str(model)
+        if mid == self._default_id and mid not in self._extra:
+            # the default slot, possibly empty (decode-only server)
+            return self._engine, self._batcher
+        slot = self._extra[mid]
+        return slot["engine"], slot["batcher"]
+
+    def _decode_for(self, model: Optional[str]) -> Optional[DecodeEngine]:
+        if model is None:
+            return self._decode
+        return self._decodes.get(str(model))
+
+    def models(self) -> list:
+        """The /v1/models rows: one per model slot (predict and/or
+        decode), with the served program's digest, the adopted registry
+        version, and warm state. Slot pointers are snapshotted under
+        the server lock but read AFTER it: outcome_counts() takes the
+        batcher condition, and holding Server._lock across another
+        component's lock would widen the lock order for a status
+        read."""
+        slots = []
+        with self._lock:
+            for mid in self._model_ids():
+                try:
+                    eng, batcher = self._slot(mid)
+                except KeyError:
+                    eng, batcher = None, None
+                slots.append((mid, self._versions.get(mid), eng,
+                              batcher, self._decodes.get(mid)))
+        rows = []
+        for mid, version, eng, batcher, dec in slots:
+            row = {"id": mid, "version": version,
+                   "default": mid == self._default_id}
+            if eng is not None:
+                row.update(
+                    kind="predict",
+                    digest=eng._model_digest(),
+                    warmed=eng.warmed,
+                    warmstart_adopted=eng.warmstart_adopted,
+                    buckets=[int(b) for b in eng.policy.buckets])
+                if batcher is not None:
+                    row["requests"] = batcher.outcome_counts()
+            if dec is not None:
+                row["decode"] = {
+                    "warmed": dec.warmed,
+                    "warmstart_adopted": dec.warmstart_adopted,
+                    "digest": dec._model_digest()}
+                row.setdefault("kind", "decode")
+            rows.append(row)
+        return rows
+
+    # -- zero-downtime hot-swap ----------------------------------------
+
+    def hot_swap(self, model_id: Optional[str] = None, *,
+                 model_dir: Optional[str] = None,
+                 warmstart: Optional[str] = None,
+                 version: Optional[int] = None) -> Dict:
+        """Replace one predict slot's engine with one built from a new
+        artifact, without dropping traffic: the replacement engine
+        builds and WARMS before the slot pointer moves (with an adopted
+        warmstart this is deserialization, zero fresh compiles), new
+        requests flow to it from the swap instant, and the old slot's
+        batcher then drains so every in-flight request completes —
+        zero failed requests. Returns the swap record (also emitted as
+        a `model_swap` event)."""
+        mid = self._default_id if model_id is None else str(model_id)
+        if mid == self._default_id and self._engine is not None:
+            old_cfg = self.config
+        elif mid in self._extra:
+            old_cfg = self._extra[mid]["config"]
+        else:
+            raise KeyError(f"unknown model slot {mid!r}; serving "
+                           f"{self._model_ids()}")
+        import copy
+
+        new_cfg = copy.copy(old_cfg)
+        if model_dir is not None:
+            new_cfg.model_dir = model_dir
+        new_cfg.warmstart = warmstart
+        t0 = time.monotonic()
+        # the expensive part happens OFF the serving path: the old
+        # engine keeps answering while this one builds and warms
+        new_engine = Engine(new_cfg)
+        if new_cfg.warmup:
+            new_engine.warmup()
+        new_batcher = None
+        with self._lock:
+            started = self._started_t is not None
+            if started:
+                new_batcher = self._make_batcher(new_engine, new_cfg)
+            if mid == self._default_id and self._engine is not None:
+                old_batcher = self._batcher
+                self.config = new_cfg
+                self._engine = new_engine
+                self._batcher = new_batcher
+            else:
+                slot = self._extra[mid]
+                old_batcher = slot["batcher"]
+                self._extra[mid] = {"config": new_cfg,
+                                    "engine": new_engine,
+                                    "batcher": new_batcher}
+            if version is not None:
+                self._versions[mid] = int(version)
+        # drain the displaced batcher AFTER the pointer moved: its
+        # in-flight and queued requests complete against the OLD engine
+        # (their feeds were validated against its signature set) while
+        # new arrivals already land on the new one
+        if old_batcher is not None:
+            old_batcher.stop()
+        record = {
+            "model": mid, "version": version,
+            "digest": new_engine._model_digest(),
+            "warmstart_adopted": new_engine.warmstart_adopted,
+            "swap_s": round(time.monotonic() - t0, 3)}
+        MODEL_SWAPS.inc(model=mid)
+        if version is not None:
+            from .registry import MODEL_VERSION
+
+            MODEL_VERSION.set(int(version), model=mid)
+        _events.emit("model_swap", **record)
+        return record
+
+    def attach_registry(self, registry, model_ids=None,
+                        poll_s: float = 1.0):
+        """Watch a `registry.ModelRegistry` and hot-swap slots as new
+        versions publish. `model_ids` bounds the watch (default: this
+        server's predict slots). The watcher starts with the server
+        (or immediately if already started) and stops with it. A slot
+        already serving the published program digest just records the
+        version — no redundant swap."""
+        self._registry = registry
+        self._watch_ids = None if model_ids is None \
+            else [str(m) for m in model_ids]
+        self._watch_poll_s = float(poll_s)
+        self._maybe_start_watcher()
+
+    def _maybe_start_watcher(self):
+        if self._registry is None or self._watch_thread is not None \
+                or self._started_t is None:
+            return
+        self._watch_stop.clear()
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop, name="paddle-tpu-registry-watch",
+            daemon=True)
+        self._watch_thread.start()
+
+    def _watch_ids_now(self):
+        if self._watch_ids is not None:
+            return self._watch_ids
+        ids = [] if self._engine is None else [self._default_id]
+        ids.extend(self._extra)
+        return ids
+
+    def _watch_loop(self):
+        while not self._watch_stop.wait(self._watch_poll_s):
+            for mid in self._watch_ids_now():
+                try:
+                    self._adopt_if_new(mid)
+                except Exception as e:
+                    # a bad publish must not kill the watcher (the
+                    # current engine keeps serving); surface it
+                    _events.emit("model_swap_failed", model=mid,
+                                 error=f"{type(e).__name__}: "
+                                       f"{str(e)[:200]}")
+
+    def _adopt_if_new(self, mid: str):
+        reg = self._registry
+        ver = reg.version(mid)
+        if ver is None or ver <= self._versions.get(mid, 0):
+            return
+        entry = reg.resolve(mid)   # digest-verified blob
+        try:
+            eng, _ = self._slot(mid)
+        except KeyError:
+            eng = None
+        if eng is not None and entry.get("model_digest") is not None \
+                and entry["model_digest"] == eng._model_digest() \
+                and eng.warmstart_adopted:
+            # same program, already warm from an adopted artifact:
+            # record the version, skip the redundant rebuild
+            with self._lock:
+                self._versions[mid] = ver
+            return
+        self.hot_swap(mid, model_dir=entry.get("model_dir"),
+                      warmstart=entry["path"], version=ver)
+
     # -- request path --------------------------------------------------
 
     def submit(self, feeds: Dict[str, np.ndarray],
-               timeout_s: Optional[float] = None) -> Dict[str, np.ndarray]:
+               timeout_s: Optional[float] = None,
+               model: Optional[str] = None,
+               tenant: Optional[str] = None) -> Dict[str, np.ndarray]:
         """In-process entry to the batched path (the HTTP handler and
-        embedded deployments share it)."""
-        batcher = self._batcher
+        embedded deployments share it). `model` picks the slot (None =
+        default); `tenant` flows to QoS admission."""
+        try:
+            engine, batcher = self._slot(model)
+        except KeyError:
+            raise ValueError(f"unknown model {str(model)!r}; serving "
+                             f"{self._model_ids()}")
         if batcher is None:
             raise ServerClosed("server not started"
-                               if self._engine is not None else
+                               if engine is not None else
                                "no predict engine on this server "
                                "(decode-only deployment)")
-        return batcher.submit(feeds, timeout_s=timeout_s)
+        return batcher.submit(feeds, timeout_s=timeout_s, tenant=tenant)
 
     def status(self) -> Dict:
         up = None if self._started_t is None \
@@ -559,9 +913,15 @@ class Server:
             "timeout_s": self.config.timeout_s,
             "requests": self._counts(),
             "memory": _memwatch.status_block(),
+            "models": probe["models"],
         }
+        if self._qos is not None:
+            st["qos"] = self._qos.spec_dict()
         if self._engine is not None:
             st.update(self._engine.status())
         if self._decode is not None:
             st["decode"] = self._decode.status()
+        for mid, dec in self._decodes.items():
+            if dec is not self._decode:
+                st.setdefault("decodes", {})[mid] = dec.status()
         return st

@@ -21,6 +21,12 @@ rendezvous protocol; the router reads `live_members()`.
 Stdout speaks one JSON "ready" line once serving (the supervisor and
 benches wait on it): {"ready": true, "endpoint": ..., "pid": ...,
 "warmstart_adopted": n, "slot": k}.
+
+Multi-tenant flags (SERVING.md §Multi-tenancy): `--model-id` names the
+model this replica serves (advertised to the router through /v1/load),
+`--qos FILE` loads a tier/tenant policy JSON enabling weighted-fair
+admission, and `--registry DIR` watches a model registry so newly
+published artifact versions are hot-swapped in without a restart.
 """
 
 from __future__ import annotations
@@ -70,6 +76,19 @@ def _build_args(argv=None):
     ap.add_argument("--cpu", action="store_true",
                     help="pin JAX_PLATFORMS=cpu before jax loads "
                     "(fleet simulation / tests)")
+    ap.add_argument("--model-id", default="default",
+                    help="model id this replica's default slot serves "
+                    "(advertised in /v1/load for the router's "
+                    "model-aware picks; SERVING.md §Multi-tenancy)")
+    ap.add_argument("--qos", default="",
+                    help="path to a QoS policy JSON file ({tiers, "
+                    "default_tier, tenants}) enabling tiered "
+                    "admission + weighted-fair scheduling")
+    ap.add_argument("--registry", default="",
+                    help="model registry root to watch: newly "
+                    "published artifact versions are hot-swapped in "
+                    "with zero downtime")
+    ap.add_argument("--registry-poll-s", type=float, default=1.0)
     return ap.parse_args(argv)
 
 
@@ -101,14 +120,23 @@ def main(argv=None) -> int:
             prefill_buckets=(8, 16), precision="f32", max_len=64))
     buckets = tuple(int(b) for b in args.buckets.split(",")) \
         if args.buckets else None
+    qos = None
+    if args.qos:
+        with open(args.qos) as f:
+            qos = json.load(f)
     cfg = ServingConfig(
         args.model_dir or None, buckets=buckets,
         max_batch=args.max_batch,
         max_queue=args.max_queue, max_wait_ms=args.max_wait_ms,
         timeout_s=args.timeout_s, precision=args.precision,
         warmstart=args.warmstart or None, use_tpu=not args.cpu,
-        host=args.host)
+        host=args.host, qos=qos, model_id=args.model_id)
     server = Server(cfg, decode=decode)
+    if args.registry:
+        from .registry import ModelRegistry
+
+        server.attach_registry(ModelRegistry(args.registry),
+                               poll_s=args.registry_poll_s)
     port = server.start(args.port)
     endpoint = f"{args.host}:{port}"
     # env-gated time-series recording (PADDLE_TPU_TS_DIR): Server.start

@@ -44,6 +44,24 @@ replica set:
   client consumed half of it could emit a token sequence that
   disagrees with what was already delivered (composition-dependent
   sampling, non-greedy decode), so the CLIENT owns that retry.
+* **QoS shed passthrough** — a 503 whose body carries a `"shed"` key
+  is a tier shed (SERVING.md §Multi-tenancy): the replica
+  deliberately rejected the request under its admission policy, so
+  the router treats it as an ANSWER, not a failure — no failover
+  retry (re-sending a shed request onto a surviving replica amplifies
+  exactly the overload the shed is relieving), no breaker penalty,
+  and the typed body + Retry-After propagate to the client unchanged
+  (`paddle_tpu_fleet_sheds_total{tier}`, typed `TierShed` from the
+  library API).
+* **model routing** — replicas advertise the model ids they serve in
+  the /v1/load body (multi-model Server, SERVING.md §Multi-tenancy);
+  a request carrying `"model"` is picked only among replicas
+  advertising that id (a replica advertising nothing is assumed to
+  serve everything — single-model fleets predate the field), and a
+  replica 404 "unknown model" fails over without a breaker penalty —
+  the replica is alive, the router's model map was just stale.
+  `mean_load_per_healthy(model=...)` scopes the autoscaler's
+  utilization signal to one model's slice of the fleet.
 * **elastic membership** — point the router at the same PR 9
   `FileRendezvous` store the replicas heartbeat into
   (`Router(rdzv_dir=...)`): member ids ARE endpoints ("host:port"),
@@ -79,7 +97,8 @@ from ..observability.metrics import _json_safe
 from ..resilience.retry import CircuitBreaker
 
 __all__ = ["Router", "RouterServer", "FleetError", "NoReplicasError",
-           "StreamBrokenError", "ReplicaRejected", "FleetTimeout"]
+           "StreamBrokenError", "ReplicaRejected", "FleetTimeout",
+           "TierShed"]
 
 
 REPLICAS = _m.gauge(
@@ -95,7 +114,7 @@ REQUESTS = _m.counter(
 RETRIES = _m.counter(
     "paddle_tpu_fleet_retries_total",
     "Requests re-sent to another replica, by failure class "
-    "(connect|server_error|busy|stream_restart)",
+    "(connect|server_error|busy|no_model|stream_restart)",
     labelnames=("reason",))
 EJECTIONS = _m.counter(
     "paddle_tpu_fleet_ejections_total",
@@ -116,6 +135,10 @@ REQUEST_SECONDS = _m.histogram(
     "paddle_tpu_fleet_request_seconds",
     "Router end-to-end request latency (successful predicts, incl. "
     "failover retries)")
+FLEET_SHEDS = _m.counter(
+    "paddle_tpu_fleet_sheds_total",
+    "QoS tier-shed 503s the router passed through as answers (no "
+    "failover), by shed tier", labelnames=("tier",))
 
 _BREAKER_LEVEL = {CircuitBreaker.CLOSED: 0, CircuitBreaker.HALF_OPEN: 1,
                   CircuitBreaker.OPEN: 2}
@@ -139,6 +162,25 @@ class FleetTimeout(FleetError):
     re-sending it elsewhere would only double the damage (HTTP 504)."""
 
 
+class TierShed(FleetError):
+    """A replica answered with a QoS tier-shed 503 — a deliberate,
+    policy-scoped ANSWER, not a failure: the router does not fail
+    over (that would amplify the overload the shed is relieving) and
+    the replica takes no breaker penalty. Carries the replica's typed
+    `body` ({"shed": tier, "kind": "queue"|"quota", "tenant": ...})
+    and its suggested `retry_after_s` backoff."""
+
+    def __init__(self, msg: str, body: Optional[Dict] = None,
+                 retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.body = dict(body or {})
+        self.retry_after_s = float(retry_after_s)
+
+    @property
+    def tier(self) -> Optional[str]:
+        return self.body.get("shed")
+
+
 class StreamBrokenError(FleetError):
     """A streamed generation died AFTER tokens were delivered. The
     router must not silently resubmit — the replayed sequence is not
@@ -155,7 +197,7 @@ class _Replica:
 
     __slots__ = ("endpoint", "breaker", "healthy", "consec_fail",
                  "load", "inflight", "picks", "source", "last_error",
-                 "last_state")
+                 "last_state", "models")
 
     def __init__(self, endpoint: str, breaker: CircuitBreaker,
                  source: str):
@@ -169,6 +211,9 @@ class _Replica:
         self.source = source     # "static" | "rendezvous"
         self.last_error: Optional[str] = None
         self.last_state: Optional[str] = None
+        # model ids the replica advertises in /v1/load; None = the
+        # replica predates the field (or no poll yet) = serves anything
+        self.models: Optional[frozenset] = None
 
 
 class Router:
@@ -345,8 +390,11 @@ class Router:
             code, load = self._get_json(rep.endpoint, "/v1/load",
                                         self.probe_timeout_s)
             if code == 200 and isinstance(load, dict):
+                models = load.get("models")
                 with self._lock:
                     rep.load = float(load.get("load", 0.0))
+                    if isinstance(models, (list, tuple)):
+                        rep.models = frozenset(str(m) for m in models)
         except Exception:
             # load staleness is benign (health just passed); the next
             # poll refreshes it
@@ -406,17 +454,22 @@ class Router:
 
     # -- picking (power-of-two-choices) --------------------------------
 
-    def _pick(self, exclude: frozenset) -> Optional[_Replica]:
+    def _pick(self, exclude: frozenset,
+              model: Optional[str] = None) -> Optional[_Replica]:
         """Choose a replica: sample two healthy candidates, take the
         lower (cached load + local in-flight delta), then ask its
         breaker. A breaker refusal excludes the candidate and re-picks,
         so an un-chosen candidate never consumes the half-open probe
-        slot. Returns None when nothing is admissible."""
+        slot. `model` restricts candidates to replicas advertising that
+        model id (None advertisement = serves anything). Returns None
+        when nothing is admissible."""
         tried = set(exclude)
         while True:
             with self._lock:
                 cands = [r for r in self._replicas.values()
-                         if r.healthy and r.endpoint not in tried]
+                         if r.healthy and r.endpoint not in tried
+                         and (model is None or r.models is None
+                              or model in r.models)]
                 if not cands:
                     return None
                 if len(cands) > 2:
@@ -494,17 +547,42 @@ class Router:
         _events.emit("fleet", action="retry", reason=reason,
                      endpoint=rep.endpoint, error=error[:200])
 
-    def predict(self, feeds: Dict, timeout_s: Optional[float] = None
-                ) -> Dict:
+    def _shed_answer(self, rep: _Replica, body: Dict):
+        """Classify a replica's typed shed 503 as the request's ANSWER
+        (metric + event + counts), then raise TierShed — never called
+        on a path that would fail over afterwards."""
+        tier = str(body.get("shed"))
+        FLEET_SHEDS.inc(tier=tier)
+        _events.emit("fleet", action="shed", endpoint=rep.endpoint,
+                     tier=tier, shed=body.get("kind"),
+                     tenant=body.get("tenant"))
+        self._finish("rejected")
+        try:
+            retry_after = float(body.get("retry_after_s", 1.0))
+        except (TypeError, ValueError):
+            retry_after = 1.0
+        raise TierShed(str(body.get("error") or f"request shed "
+                           f"(tier {tier})"),
+                       body=body, retry_after_s=retry_after)
+
+    def predict(self, feeds: Dict, timeout_s: Optional[float] = None,
+                model: Optional[str] = None,
+                tenant: Optional[str] = None) -> Dict:
         """Route one idempotent predict: pick → POST → on failure,
         fail over to a different surviving replica (`retries` times).
-        Raises NoReplicasError / ReplicaRejected / FleetTimeout /
-        FleetError (replica 500 everywhere) / ValueError (the replica's
-        400 validation echo)."""
-        return self._route_predict({"feeds": feeds,
-                                    **({"timeout_s": timeout_s}
-                                       if timeout_s is not None else {})},
-                                   timeout_s)
+        `model` routes to replicas serving that model id; `tenant`
+        rides to the replica's QoS admission. Raises NoReplicasError /
+        ReplicaRejected / TierShed (QoS shed: an answer, not retried) /
+        FleetTimeout / FleetError (replica 500 everywhere) / ValueError
+        (the replica's 400 validation echo)."""
+        payload = {"feeds": feeds}
+        if timeout_s is not None:
+            payload["timeout_s"] = timeout_s
+        if model is not None:
+            payload["model"] = str(model)
+        if tenant is not None:
+            payload["tenant"] = str(tenant)
+        return self._route_predict(payload, timeout_s)
 
     def _route_predict(self, payload: Dict,
                        timeout_s: Optional[float]) -> Dict:
@@ -515,11 +593,13 @@ class Router:
                               timeout_s: Optional[float]) -> Dict:
         timeout = self.request_timeout_s if timeout_s is None \
             else float(timeout_s)
+        model = payload.get("model")
+        model = str(model) if model is not None else None
         t0 = time.monotonic()
         exclude: set = set()
         last: Tuple[str, str] = ("", "no replicas known")
         for _attempt in range(self.retries + 1):
-            rep = self._pick(frozenset(exclude))
+            rep = self._pick(frozenset(exclude), model=model)
             if rep is None:
                 break
             try:
@@ -559,12 +639,24 @@ class Router:
             err = str(body.get("error", "")) if isinstance(body, dict) \
                 else ""
             if code == 503:
-                # admission control (queue full / draining): the
-                # replica is alive — no breaker penalty, but fail over
                 rep.breaker.record_success()
+                if isinstance(body, dict) and body.get("shed"):
+                    # QoS tier shed: a deliberate, policy-scoped
+                    # ANSWER — failing over would amplify exactly the
+                    # overload the shed is relieving
+                    self._shed_answer(rep, body)
                 self._retry("busy", rep, err)
                 exclude.add(rep.endpoint)
                 last = (rep.endpoint, f"503: {err}")
+                continue
+            if code == 404:
+                # unknown model on this replica: it is alive — the
+                # router's model map was just stale. No breaker
+                # penalty; try a replica that does serve it.
+                rep.breaker.record_success()
+                self._retry("no_model", rep, err)
+                exclude.add(rep.endpoint)
+                last = (rep.endpoint, f"404: {err}")
                 continue
             if code == 504:
                 # the request's own deadline died inside the replica;
@@ -603,19 +695,26 @@ class Router:
     # -- token generation ----------------------------------------------
 
     def generate(self, ids: Sequence[int], max_new_tokens: int = 16,
-                 timeout_s: Optional[float] = None) -> Iterator[Dict]:
+                 timeout_s: Optional[float] = None,
+                 model: Optional[str] = None,
+                 tenant: Optional[str] = None) -> Iterator[Dict]:
         """Streamed generation through the fleet: yields the replica's
         ndjson records ({"token": t}... then the {"done": ...} tail).
         Failover rule (SERVING.md §Fleet): a stream that dies with ZERO
         tokens delivered is resubmitted from scratch on another
         replica; once a token has been yielded a failure raises
         StreamBrokenError — the router will not splice two generations
-        together."""
+        together. A QoS tier shed raises TierShed without failover;
+        `model` restricts the pick to replicas serving that id."""
         timeout = self.request_timeout_s if timeout_s is None \
             else float(timeout_s)
         payload = {"ids": list(int(i) for i in ids),
                    "max_new_tokens": int(max_new_tokens),
                    "stream": True}
+        if model is not None:
+            payload["model"] = str(model)
+        if tenant is not None:
+            payload["tenant"] = str(tenant)
         # captured ONCE: the generator body runs on the consumer's
         # thread across yields, so the ambient contextvar must not be
         # mutated here — per-attempt children are minted explicitly and
@@ -624,7 +723,8 @@ class Router:
         exclude: set = set()
         last: Tuple[str, str] = ("", "no replicas known")
         for _attempt in range(self.retries + 1):
-            rep = self._pick(frozenset(exclude))
+            rep = self._pick(frozenset(exclude),
+                             model=payload.get("model"))
             if rep is None:
                 break
             delivered = 0
@@ -668,6 +768,11 @@ class Router:
                 exclude.add(rep.endpoint)
                 last = (rep.endpoint, f"{type(e).__name__}: {e}")
                 continue
+            except _ReplicaShed as e:
+                # QoS tier shed: the ANSWER — no failover, no penalty
+                rep.breaker.record_success()
+                self._release(rep)
+                self._shed_answer(rep, e.body)
             except _ReplicaBusy as e:
                 rep.breaker.record_success()
                 self._release(rep)
@@ -677,6 +782,14 @@ class Router:
                 continue
             except _ReplicaHTTPError as e:
                 self._release(rep)
+                if e.code == 404:
+                    # unknown model here: alive replica, stale model
+                    # map — fail over without a breaker penalty
+                    rep.breaker.record_success()
+                    self._retry("no_model", rep, str(e))
+                    exclude.add(rep.endpoint)
+                    last = (rep.endpoint, f"404: {e}")
+                    continue
                 if e.code == 400:
                     # deterministic client error: every replica would
                     # reject it the same way — no retry, no breaker
@@ -719,10 +832,15 @@ class Router:
             resp = urllib.request.urlopen(req, timeout=timeout)
         except urllib.error.HTTPError as e:
             try:
-                err = json.loads(e.read()).get("error", "")
+                parsed = json.loads(e.read())
             except ValueError:
-                err = ""
+                parsed = {}
+            if not isinstance(parsed, dict):
+                parsed = {}
+            err = str(parsed.get("error", ""))
             if e.code == 503:
+                if parsed.get("shed"):
+                    raise _ReplicaShed(parsed)
                 raise _ReplicaBusy(err or "replica busy")
             # any other HTTP status: the replica answered — this is NOT
             # a broken wire, and must not ride the URLError-subclass
@@ -753,13 +871,19 @@ class Router:
 
     # -- status --------------------------------------------------------
 
-    def mean_load_per_healthy(self) -> Optional[float]:
+    def mean_load_per_healthy(self,
+                              model: Optional[str] = None
+                              ) -> Optional[float]:
         """Mean (cached load + in-flight) across healthy replicas —
-        the autoscaler's utilization signal. None when no replica is
-        healthy (which is its own, louder signal)."""
+        the autoscaler's utilization signal. `model` scopes the mean
+        to replicas advertising that model id (per-model autoscaling,
+        SERVING.md §Multi-tenancy). None when no replica qualifies
+        (which is its own, louder signal)."""
         with self._lock:
             loads = [r.load + r.inflight
-                     for r in self._replicas.values() if r.healthy]
+                     for r in self._replicas.values()
+                     if r.healthy and (model is None or r.models is None
+                                       or model in r.models)]
         if not loads:
             return None
         return sum(loads) / len(loads)
@@ -837,6 +961,8 @@ class Router:
                 "consec_fail": r.consec_fail,
                 "source": r.source,
                 "error": r.last_error,
+                "models": sorted(r.models)
+                if r.models is not None else None,
             } for r in sorted(self._replicas.values(),
                               key=lambda r: r.endpoint)]
             counts = dict(self._counts)
@@ -856,6 +982,15 @@ class Router:
 
 class _ReplicaBusy(RuntimeError):
     """Internal: replica answered 503 to a generate submit."""
+
+
+class _ReplicaShed(RuntimeError):
+    """Internal: replica answered a generate submit with a typed QoS
+    shed 503 — an answer, not saturation. Carries the parsed body."""
+
+    def __init__(self, body: Dict):
+        super().__init__(str(body.get("error", "request shed")))
+        self.body = dict(body)
 
 
 class _ReplicaHTTPError(RuntimeError):
@@ -886,6 +1021,16 @@ class _RouterHandler(_base.QuietHandler):
         self._reply(code, "application/json",
                     json.dumps(_json_safe(payload)) + "\n",
                     extra_headers=hdrs)
+
+    def _shed_reply(self, e: TierShed):
+        """Propagate a replica's typed QoS shed 503 unchanged: the
+        body ({"shed": tier, ...}) and Retry-After the replica chose —
+        clients of the fleet see exactly what single-replica clients
+        see."""
+        self._json_reply(
+            503, e.body or {"error": str(e), "shed": e.tier},
+            headers={"Retry-After":
+                     str(max(1, int(round(e.retry_after_s))))})
 
     def do_GET(self):  # noqa: N802 - stdlib naming
         try:
@@ -939,7 +1084,9 @@ class _RouterHandler(_base.QuietHandler):
             kw = dict(max_new_tokens=int(payload.get("max_new_tokens",
                                                      16)),
                       timeout_s=float(timeout)
-                      if timeout is not None else None)
+                      if timeout is not None else None,
+                      model=payload.get("model"),
+                      tenant=payload.get("tenant"))
         except (ValueError, TypeError) as e:
             self._json_reply(400, {"error": f"malformed generate "
                                            f"request: {e}"})
@@ -952,6 +1099,9 @@ class _RouterHandler(_base.QuietHandler):
                         toks.append(int(rec["token"]))
                     elif rec.get("done"):
                         tail = rec
+            except TierShed as e:
+                self._shed_reply(e)
+                return
             except (NoReplicasError, ReplicaRejected) as e:
                 self._json_reply(503, {"error": str(e)})
                 return
@@ -980,6 +1130,9 @@ class _RouterHandler(_base.QuietHandler):
             first = next(gen)
         except StopIteration:
             self._json_reply(502, {"error": "empty stream from fleet"})
+            return
+        except TierShed as e:
+            self._shed_reply(e)
             return
         except (NoReplicasError, ReplicaRejected) as e:
             self._json_reply(503, {"error": str(e)})
@@ -1087,6 +1240,9 @@ class _RouterHandler(_base.QuietHandler):
                 with _tracing.activate(self._tctx):
                     body = router._route_predict(
                         payload, payload.get("timeout_s"))
+            except TierShed as e:
+                self._shed_reply(e)
+                return
             except (NoReplicasError, ReplicaRejected) as e:
                 self._json_reply(503, {"error": str(e)},
                                  headers={"Retry-After": "1"})

@@ -125,6 +125,8 @@ _INSTRUMENTED_MODULES = (
     "paddle_tpu.serving.kv_reuse",
     "paddle_tpu.serving.autoscale",
     "paddle_tpu.serving.httpd",
+    "paddle_tpu.serving.qos",
+    "paddle_tpu.serving.registry",
     "paddle_tpu.distributed.launch_serve",
     "paddle_tpu.observability.perfwatch",
     "paddle_tpu.observability.memwatch",
@@ -149,6 +151,16 @@ _MUST_BE_DOCUMENTED = (
     "paddle_tpu_prefix_cache_total",
     "paddle_tpu_decode_blocks_reused",
     "paddle_tpu_decode_spec_accept_rate",
+    # multi-tenant QoS + model registry (ISSUE 19)
+    "paddle_tpu_serving_sheds_total",
+    "paddle_tpu_serving_tenant_requests_total",
+    "paddle_tpu_serving_tenant_tokens_total",
+    "paddle_tpu_serving_tenant_request_seconds",
+    "paddle_tpu_decode_tenant_ttft_seconds",
+    "paddle_tpu_model_version",
+    "paddle_tpu_model_swaps_total",
+    "paddle_tpu_registry_publishes_total",
+    "paddle_tpu_fleet_sheds_total",
 )
 
 

@@ -519,7 +519,7 @@ def test_threaded_families_clean_under_lockcheck2(tmp_path):
     env["PADDLE_TPU_LOCKCHECK"] = "2"
     env.pop("PADDLE_TPU_METRICS_DIR", None)
     families = ["tests/test_serving.py", "tests/test_decode.py",
-                "tests/test_fleet.py",
+                "tests/test_fleet.py", "tests/test_multitenant.py",
                 "tests/test_elastic.py", "tests/test_ps_resilience.py"]
     proc = subprocess.run(
         [sys.executable, "-m", "pytest", "-q", "-m", "not slow",

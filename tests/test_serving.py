@@ -766,8 +766,10 @@ def test_healthz_reports_state_and_load_probe(tmp_path, rng):
         probe = json.loads(body)
         assert code == 200
         assert set(probe) == {"load", "inflight", "queue_depth",
-                              "state"}
+                              "state", "models"}
         assert probe["load"] == 0.0 and probe["state"] == "serving"
+        # the model advertisement the router's model-aware picks read
+        assert probe["models"] == ["default"]
         # /v1/status carries the same fields for the full view
         code, body = _post(base + "/v1/predict",
                            {"feeds": {"x": X[:1].tolist()}})

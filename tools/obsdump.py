@@ -47,6 +47,14 @@ Usage:
                                             # states, autoscale actions
                                             # (--live, --json,
                                             # --events LOG)
+  obsdump.py tenants METRICS.json           # multi-tenant serving
+                                            # summary: per-tenant
+                                            # outcomes/tokens/p99,
+                                            # sheds by tier+kind,
+                                            # per-model registry
+                                            # versions + hot-swaps
+                                            # (--live, --json,
+                                            # --events LOG)
   obsdump.py top TS_DIR                     # fleet dashboard from a
                                             # PADDLE_TPU_TS_DIR: rates,
                                             # error %, p50/p99, token
@@ -845,6 +853,136 @@ def cmd_decode(args) -> int:
     return 0
 
 
+def cmd_tenants(args) -> int:
+    """Multi-tenant serving story from a metrics snapshot (SERVING.md
+    §Multi-tenancy): per-tenant request outcomes, token consumption
+    and latency quantiles, shed counts by tier and kind (queue vs
+    quota, replica-side and router-side), and the per-model registry
+    view (adopted version, hot-swaps, publishes). With --events it
+    also tails the shed/model_swap/registry events from a JSONL log."""
+    snap = _load_snap(args)
+    if snap is None:
+        print("tenants: need a metrics.json path or --live",
+              file=sys.stderr)
+        return 2
+
+    def series(name):
+        return (snap.get(name) or {}).get("series", [])
+
+    def labeled(name, label):
+        out = {}
+        for s in series(name):
+            key = s.get("labels", {}).get(label, "?")
+            out[key] = out.get(key, 0) + s["value"]
+        return out
+
+    bq = _load_obs_module("metrics").bucket_quantile
+
+    def hist_by(name, label):
+        """label value -> {count, avg_ms, p50_ms, p99_ms} for a
+        labeled histogram."""
+        out = {}
+        for s in series(name):
+            key = s.get("labels", {}).get(label, "?")
+            count = int(s.get("count", 0))
+            if not count:
+                continue
+            buckets = s.get("buckets", [])
+            out[key] = {
+                "count": count,
+                "avg_ms": round(1000 * float(s.get("sum", 0.0))
+                                / count, 3),
+                "p50_ms": round(1000 * (bq(0.50, buckets, count)
+                                        or 0.0), 3),
+                "p99_ms": round(1000 * (bq(0.99, buckets, count)
+                                        or 0.0), 3)}
+        return out
+
+    # tenant -> tier and tenant -> outcome counts from the one
+    # three-way labeled counter
+    tiers, outcomes = {}, {}
+    for s in series("paddle_tpu_serving_tenant_requests_total"):
+        lab = s.get("labels", {})
+        t = lab.get("tenant", "?")
+        tiers.setdefault(t, lab.get("tier", "?"))
+        outcomes.setdefault(t, {})
+        oc = lab.get("outcome", "?")
+        outcomes[t][oc] = outcomes[t].get(oc, 0) + int(s["value"])
+    tokens = {k: int(v) for k, v in labeled(
+        "paddle_tpu_serving_tenant_tokens_total", "tenant").items()}
+    lat = hist_by("paddle_tpu_serving_tenant_request_seconds", "tenant")
+    ttft = hist_by("paddle_tpu_decode_tenant_ttft_seconds", "tenant")
+    sheds = {}  # (tier, kind) -> n, replica-side
+    for s in series("paddle_tpu_serving_sheds_total"):
+        lab = s.get("labels", {})
+        key = (lab.get("tier", "?"), lab.get("kind", "?"))
+        sheds[key] = sheds.get(key, 0) + int(s["value"])
+    fleet_sheds = {k: int(v) for k, v in labeled(
+        "paddle_tpu_fleet_sheds_total", "tier").items()}
+    models = {}  # model -> {version, swaps, publishes}
+    for name, field in (("paddle_tpu_model_version", "version"),
+                        ("paddle_tpu_model_swaps_total", "swaps"),
+                        ("paddle_tpu_registry_publishes_total",
+                         "publishes")):
+        for m, v in labeled(name, "model").items():
+            models.setdefault(m, {})[field] = int(v)
+
+    if not outcomes and not sheds and not models:
+        print("no tenant/model samples in this snapshot (QoS policy "
+              "and per-tenant metrics only record when a policy is "
+              "configured — SERVING.md §Multi-tenancy)")
+        return 0
+
+    tenant_rows = []
+    for t in sorted(set(outcomes) | set(tokens) | set(lat)):
+        oc = outcomes.get(t, {})
+        row = {"tenant": t, "tier": tiers.get(t, "?"),
+               "ok": oc.get("ok", 0),
+               "rejected": oc.get("rejected", 0),
+               "timeout": oc.get("timeout", 0),
+               "error": oc.get("error", 0),
+               "tokens": tokens.get(t, 0)}
+        h = lat.get(t) or ttft.get(t)
+        row["p99_ms"] = h["p99_ms"] if h else None
+        tenant_rows.append(row)
+    shed_rows = [{"tier": tier, "kind": kind, "sheds": n}
+                 for (tier, kind), n in sorted(sheds.items())]
+    model_rows = [{"model": m, "version": info.get("version", 0),
+                   "swaps": info.get("swaps", 0),
+                   "publishes": info.get("publishes", 0)}
+                  for m, info in sorted(models.items())]
+    out = {"tenants": tenant_rows, "sheds": shed_rows,
+           "fleet_sheds": fleet_sheds, "models": model_rows,
+           "ttft": ttft}
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    if tenant_rows:
+        _print_aligned(tenant_rows, ("tenant", "tier", "ok",
+                                     "rejected", "timeout", "error",
+                                     "tokens", "p99_ms"))
+    if shed_rows:
+        print("\nsheds (replica admission):")
+        _print_aligned(shed_rows, ("tier", "kind", "sheds"))
+    if fleet_sheds:
+        print("router shed answers: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(fleet_sheds.items())))
+    if model_rows:
+        print("\nmodels:")
+        _print_aligned(model_rows, ("model", "version", "swaps",
+                                    "publishes"))
+    if args.events:
+        evs = [ev for ev in _load_obs_module("events").read_jsonl(
+            args.events)
+            if ev.get("kind") in ("shed", "model_swap",
+                                  "model_swap_failed", "registry")]
+        evs = evs[-args.n:]
+        print(f"\nlast {len(evs)} tenant/model events:")
+        for ev in evs:
+            print("  " + _fmt_event(ev))
+    return 0
+
+
 def _top_view(store, window):
     """One frame of the fleet dashboard: windowed rates/quantiles merged
     across every recording pid in the TS dir."""
@@ -1286,6 +1424,24 @@ def main(argv=None) -> int:
     fp.add_argument("-n", type=int, default=20,
                     help="with --events: last N events (default 20)")
     fp.set_defaults(fn=cmd_fleet)
+
+    tnp = sub.add_parser("tenants", help="multi-tenant serving summary "
+                         "(per-tenant outcomes/tokens/latency, sheds "
+                         "by tier+kind, per-model registry versions "
+                         "and hot-swaps) from a metrics snapshot")
+    tnp.add_argument("path", nargs="?", help="metrics.json from "
+                     "PADDLE_TPU_METRICS_DIR (omit with --live)")
+    tnp.add_argument("--live", action="store_true",
+                     help="read this process's registry instead of a "
+                     "file")
+    tnp.add_argument("--json", action="store_true",
+                     help="JSON instead of the summary tables")
+    tnp.add_argument("--events", default=None, metavar="JSONL",
+                     help="also tail shed/model_swap/registry events "
+                     "from this event log")
+    tnp.add_argument("-n", type=int, default=20,
+                     help="with --events: last N events (default 20)")
+    tnp.set_defaults(fn=cmd_tenants)
 
     top = sub.add_parser("top", help="live fleet dashboard from a "
                          "PADDLE_TPU_TS_DIR time-series dir: request/"

@@ -119,6 +119,21 @@ def _build_args():
                     help="fleet chaos mode: replica kill under load, "
                     "2x traffic step with autoscaling, graceful "
                     "scale-in (ISSUE 14 gates)")
+    ap.add_argument("--tenants", action="store_true",
+                    help="multi-tenant chaos mode: bronze-tier noisy-"
+                    "neighbor flood vs a gold-tier trickle (sheds "
+                    "must land on bronze only, gold p99 holds), then "
+                    "a registry hot-swap under load with zero failed "
+                    "requests and zero fresh compiles (SERVING.md "
+                    "§Multi-tenancy gates)")
+    ap.add_argument("--tenant-p99-factor", type=float, default=10.0,
+                    help="noisy-neighbor gate: gold p99 under the "
+                    "bronze flood must be <= this x its unloaded "
+                    "baseline (plus a 100ms absolute allowance for "
+                    "CI noise)")
+    ap.add_argument("--flood-threads", type=int, default=8,
+                    help="closed-loop bronze flood senders "
+                    "(tenants mode)")
     ap.add_argument("--replicas", type=int, default=2,
                     help="initial fleet size (fleet mode)")
     ap.add_argument("--fleet-max", type=int, default=3,
@@ -688,6 +703,281 @@ def run_prefix_bench(args) -> int:
         # validates correctness + the report schema, not timings
         ok = ok and ttft_gain is not None and ttft_gain > 1.0
     return 0 if ok else 1
+
+
+# ---------------------------------------------------------------------------
+# Multi-tenant chaos mode (SERVING.md §Multi-tenancy)
+# ---------------------------------------------------------------------------
+
+
+def run_tenants_bench(args) -> int:
+    """The two multi-tenant acceptance gates:
+
+      1. **noisy neighbor** — a bronze-tier closed-loop flood
+         saturates a deliberately small queue while a gold-tier
+         trickle keeps measuring. Degradation must be tier-scoped:
+         every shed lands on bronze (checked per-response AND against
+         the paddle_tpu_serving_sheds_total{tier} counter), gold sees
+         ZERO failures, and gold p99 stays within
+         --tenant-p99-factor x its unloaded baseline (+100ms CI
+         allowance).
+      2. **hot-swap under load** — with both tenants still firing,
+         the serving program's warmstart artifact is published into a
+         ModelRegistry and the watcher hot-swaps it in: zero failed
+         requests across the swap window and zero fresh XLA compiles
+         on the adopting slot (warmstart adoption, PR 6 contract).
+    """
+    import random
+    import urllib.error
+    import urllib.request
+
+    import jax
+
+    from paddle_tpu.serving import Server, ServingConfig
+    from paddle_tpu.serving.registry import ModelRegistry
+
+    tmpdir = tempfile.mkdtemp(prefix="serve_bench_mt_")
+    probe = _save_model(tmpdir)
+    qos = {"tiers": ["gold", "bronze"], "default_tier": "bronze",
+           "tenants": {"gold-client": {"tier": "gold", "weight": 4}}}
+    qsize = max(8, args.max_queue // 8)
+    cfg = ServingConfig(
+        tmpdir, max_batch=args.max_batch,
+        # small queue: the flood must actually hit the shed path
+        max_queue=qsize,
+        max_wait_ms=args.max_wait_ms, timeout_s=args.timeout_s,
+        qos=qos, model_id="bench")
+    server = Server(cfg)
+    port = server.start(0)
+    url = f"http://127.0.0.1:{port}/v1/predict"
+    rows = probe[:args.batch].tolist()
+
+    def fire(tenant):
+        """One predict; returns (outcome, latency_ms, shed_tier)."""
+        t0 = time.perf_counter()
+        body = json.dumps({"feeds": {"x": rows},
+                           "tenant": tenant}).encode()
+        try:
+            req = urllib.request.Request(
+                url, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=args.timeout_s + 5):
+                pass
+            return "ok", (time.perf_counter() - t0) * 1000, None
+        except urllib.error.HTTPError as e:
+            try:
+                info = json.loads(e.read())
+            except ValueError:
+                info = {}
+            if e.code == 503 and isinstance(info, dict) \
+                    and info.get("shed"):
+                return "shed", None, str(info["shed"])
+            if e.code == 503:
+                return "rejected", None, None
+            return ("timeout" if e.code == 504 else "error"), None, None
+        except Exception:
+            return "error", None, None
+
+    def shed_counts():
+        from paddle_tpu import observability
+
+        snap = observability.snapshot()
+        out = {}
+        for s in (snap.get("paddle_tpu_serving_sheds_total")
+                  or {"series": []})["series"]:
+            tier = s["labels"].get("tier", "?")
+            out[tier] = out.get(tier, 0) + int(s["value"])
+        return out
+
+    try:
+        # ---- unloaded gold baseline ---------------------------------
+        base_lat = []
+        for _ in range(20):
+            oc, ms, _tier = fire("gold-client")
+            if oc == "ok":
+                base_lat.append(ms)
+        p99_base = _percentile(base_lat, 99)
+
+        # ---- gate 1: bronze flood vs gold trickle -------------------
+        sheds_t0 = shed_counts()
+        stop = threading.Event()
+        flood_stats = {"ok": 0, "shed": 0, "rejected": 0, "error": 0,
+                       "timeout": 0}
+        flood_shed_tiers = set()
+        flood_lock = threading.Lock()
+
+        flood_lat = []
+
+        def flood():
+            while not stop.is_set():
+                oc, ms, tier = fire("bronze-flood")
+                with flood_lock:
+                    flood_stats[oc] += 1
+                    if oc == "ok":
+                        flood_lat.append(ms)
+                    if tier is not None:
+                        flood_shed_tiers.add(tier)
+
+        # closed-loop senders bound the in-flight count at the thread
+        # count, so the flood must outnumber queue + one active batch
+        # or the queue never fills and nothing sheds
+        n_flood = max(args.flood_threads, qsize + args.max_batch + 4)
+        flooders = [threading.Thread(target=flood, daemon=True)
+                    for _ in range(n_flood)]
+        for th in flooders:
+            th.start()
+        gold_lat, gold_fails, gold_shed_tiers = [], [], set()
+        gold_lock = threading.Lock()
+        gold_rate = max(5.0, args.rate / 4.0)
+        t_end = time.perf_counter() + args.duration
+
+        # several open-loop gold probes: one slow reply must not
+        # serialize the sampler down to a single latency point (the
+        # p99 of one contended sample is pure machine noise)
+        def gold_trickle(seed):
+            tr = random.Random(seed)
+            while time.perf_counter() < t_end:
+                oc, ms, tier = fire("gold-client")
+                with gold_lock:
+                    if oc == "ok":
+                        gold_lat.append(ms)
+                    else:
+                        gold_fails.append(oc)
+                        if tier is not None:
+                            gold_shed_tiers.add(tier)
+                time.sleep(tr.expovariate(gold_rate))
+
+        golds = [threading.Thread(target=gold_trickle,
+                                  args=(args.seed + i,), daemon=True)
+                 for i in range(3)]
+        for th in golds:
+            th.start()
+        for th in golds:
+            th.join(timeout=args.duration + args.timeout_s + 10)
+        stop.set()
+        for th in flooders:
+            th.join(timeout=args.timeout_s + 10)
+        gold_fail = len(gold_fails)
+        sheds_t1 = shed_counts()
+        shed_delta = {t: sheds_t1.get(t, 0) - sheds_t0.get(t, 0)
+                      for t in set(sheds_t0) | set(sheds_t1)}
+        p99_flood = _percentile(gold_lat, 99)
+        p99_bronze = _percentile(flood_lat, 99)
+        bronze_sheds = shed_delta.get("bronze", 0)
+        p99_bound = None
+        if p99_base is not None:
+            p99_bound = args.tenant_p99_factor * p99_base + 100.0
+        # primary gate: gold p99 within factor x unloaded baseline.
+        # Relative escape for badly contended CI hosts (everything is
+        # slow, including the unloaded baseline's scale): the tier-
+        # isolation claim still holds when gold's p99 is far below the
+        # flooding tier's — bronze absorbs the degradation.
+        abs_ok = (p99_flood is not None and p99_bound is not None
+                  and p99_flood <= p99_bound)
+        rel_ok = (p99_flood is not None and p99_bronze is not None
+                  and p99_flood <= 0.5 * p99_bronze)
+        neighbor_ok = (
+            gold_fail == 0 and not gold_shed_tiers
+            and bronze_sheds > 0
+            and flood_shed_tiers <= {"bronze"}
+            and shed_delta.get("gold", 0) == 0
+            and (abs_ok or rel_ok))
+
+        # ---- gate 2: registry hot-swap under load -------------------
+        ws = os.path.join(tmpdir, "bench.warmstart")
+        server._engine.export_warmstart(ws)
+        registry = ModelRegistry(os.path.join(tmpdir, "registry"))
+        entry = registry.publish("bench", ws, model_dir=tmpdir)
+        compiles_t0 = sum(_compile_counts().values())
+        stop = threading.Event()
+        swap_stats = {"ok": 0, "shed": 0, "rejected": 0, "error": 0,
+                      "timeout": 0}
+        swap_lock = threading.Lock()
+
+        def light_load(tenant, rate):
+            lr = random.Random(hash(tenant) & 0xFFFF)
+            while not stop.is_set():
+                oc, _ms, _tier = fire(tenant)
+                with swap_lock:
+                    swap_stats[oc] += 1
+                time.sleep(lr.expovariate(rate))
+
+        loaders = [
+            threading.Thread(target=light_load,
+                             args=("gold-client", gold_rate),
+                             daemon=True),
+            threading.Thread(target=light_load,
+                             args=("bronze-steady", gold_rate),
+                             daemon=True)]
+        for th in loaders:
+            th.start()
+        server.attach_registry(registry, poll_s=0.1)
+        adopted, deadline = None, time.perf_counter() + 60.0
+        while time.perf_counter() < deadline:
+            row = next((r for r in server.models()
+                        if r["id"] == "bench"), None)
+            if row is not None and row.get("version") \
+                    == entry["version"]:
+                adopted = row
+                break
+            time.sleep(0.05)
+        # keep load flowing briefly past the swap so post-swap
+        # requests land in the window too
+        time.sleep(0.3)
+        stop.set()
+        for th in loaders:
+            th.join(timeout=args.timeout_s + 10)
+        compiles_t1 = sum(_compile_counts().values())
+        swap_failed = (swap_stats["error"] + swap_stats["rejected"]
+                       + swap_stats["shed"] + swap_stats["timeout"])
+        swap_ok = (
+            adopted is not None
+            and adopted.get("warmstart_adopted", 0) > 0
+            and swap_failed == 0 and swap_stats["ok"] > 0
+            and compiles_t1 == compiles_t0)
+    finally:
+        server.stop()
+
+    detail = {
+        "platform": jax.devices()[0].platform, "smoke": bool(args.smoke),
+        "qos": qos, "flood_threads": n_flood,
+        "duration_s": args.duration,
+        "gold": {"ok": len(gold_lat), "failed": gold_fail,
+                 "p99_base_ms": p99_base, "p99_flood_ms": p99_flood,
+                 "p99_bound_ms": round(p99_bound, 3)
+                 if p99_bound is not None else None,
+                 "p99_bronze_ms": p99_bronze,
+                 "abs_ok": abs_ok, "rel_ok": rel_ok},
+        "flood": dict(flood_stats),
+        "shed_delta": shed_delta,
+        "swap": {"requests": dict(swap_stats),
+                 "failed": swap_failed,
+                 "adopted_version": adopted.get("version")
+                 if adopted else None,
+                 "warmstart_adopted": adopted.get("warmstart_adopted")
+                 if adopted else None,
+                 "fresh_compiles": compiles_t1 - compiles_t0},
+    }
+    for metric, value, unit, extra in (
+            ("tenant_gold_p99_ms", p99_flood, "ms",
+             dict(gate_ok=neighbor_ok,
+                  acceptance="bronze flood sheds bronze ONLY, zero "
+                             "gold failures, gold p99 <= "
+                             "factor x baseline + 100ms (or well "
+                             "under the flooding tier's p99)")),
+            ("tenant_bronze_sheds", bronze_sheds, "count",
+             dict(gate_ok=neighbor_ok)),
+            ("hot_swap_failed_requests", swap_failed, "count",
+             dict(gate_ok=swap_ok,
+                  acceptance="registry hot-swap under load: zero "
+                             "failed requests, zero fresh compiles, "
+                             "warmstart adopted"))):
+        print(json.dumps({"metric": metric,
+                          "value": round(value, 3)
+                          if isinstance(value, float) else value,
+                          "unit": unit,
+                          "detail": {**detail, **extra}}), flush=True)
+    return 0 if (neighbor_ok and swap_ok) else 1
 
 
 # ---------------------------------------------------------------------------
@@ -1339,6 +1629,15 @@ def main() -> int:
             args.rate, args.duration = 600.0, 0.08
             args.slots, args.prefill_buckets = "4", "8,16"
             args.timeout_s = 120.0
+        if args.tenants:
+            args.rate, args.duration = 40.0, 1.2
+            args.max_batch, args.max_queue = 8, 64
+            args.flood_threads = 4
+            args.timeout_s = 30.0
+            # ~20 GIL-bound flood threads on a shared CPU box add
+            # scheduler noise the real TPU shape doesn't have; keep
+            # the p99 claim but widen the smoke allowance
+            args.tenant_p99_factor = max(args.tenant_p99_factor, 15.0)
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     from paddle_tpu.core.tpu_lock import tpu_singleflight
@@ -1346,6 +1645,8 @@ def main() -> int:
     with tpu_singleflight():  # one real chip: serialize vs bench/tools
         if args.fleet:
             return run_fleet_bench(args)
+        if args.tenants:
+            return run_tenants_bench(args)
         if args.tokens and args.prefix_share:
             return run_prefix_bench(args)
         return run_token_bench(args) if args.tokens else run_bench(args)
